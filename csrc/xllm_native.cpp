@@ -1,0 +1,136 @@
+// Native substrate for xllm-service-tpu.
+//
+// MurmurHash3_x64_128 (Austin Appleby's public-domain algorithm, re-implemented
+// from the spec) plus the chained block-hash used by the cluster-wide prefix
+// KV-cache index: digest(block_i) = H(digest(block_{i-1}) || tokens(block_i)).
+// Mirrors the behavior of the reference's common/hash_util.cpp:16-42 (which
+// feeds Murmur3Key keys into GlobalKVCacheMgr), without its strncmp equality
+// bug (hash_util.h:31-35).
+//
+// Exposed as a plain C ABI and loaded from Python via ctypes
+// (xllm_service_tpu/utils/hashing.py). A pure-Python fallback exists for
+// environments without a toolchain; tests assert the two agree bit-for-bit.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+inline uint64_t rotl64(uint64_t x, int8_t r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+inline uint64_t fmix64(uint64_t k) {
+  k ^= k >> 33;
+  k *= 0xff51afd7ed558ccdULL;
+  k ^= k >> 33;
+  k *= 0xc4ceb9fe1a85ec53ULL;
+  k ^= k >> 33;
+  return k;
+}
+
+void murmur3_x64_128_impl(const uint8_t* data, size_t len, uint32_t seed,
+                          uint8_t out[16]) {
+  const size_t nblocks = len / 16;
+  uint64_t h1 = seed;
+  uint64_t h2 = seed;
+  const uint64_t c1 = 0x87c37b91114253d5ULL;
+  const uint64_t c2 = 0x4cf5ad432745937fULL;
+
+  for (size_t i = 0; i < nblocks; i++) {
+    uint64_t k1, k2;
+    std::memcpy(&k1, data + i * 16, 8);
+    std::memcpy(&k2, data + i * 16 + 8, 8);
+
+    k1 *= c1; k1 = rotl64(k1, 31); k1 *= c2; h1 ^= k1;
+    h1 = rotl64(h1, 27); h1 += h2; h1 = h1 * 5 + 0x52dce729;
+    k2 *= c2; k2 = rotl64(k2, 33); k2 *= c1; h2 ^= k2;
+    h2 = rotl64(h2, 31); h2 += h1; h2 = h2 * 5 + 0x38495ab5;
+  }
+
+  const uint8_t* tail = data + nblocks * 16;
+  uint64_t k1 = 0;
+  uint64_t k2 = 0;
+  switch (len & 15) {
+    case 15: k2 ^= ((uint64_t)tail[14]) << 48; [[fallthrough]];
+    case 14: k2 ^= ((uint64_t)tail[13]) << 40; [[fallthrough]];
+    case 13: k2 ^= ((uint64_t)tail[12]) << 32; [[fallthrough]];
+    case 12: k2 ^= ((uint64_t)tail[11]) << 24; [[fallthrough]];
+    case 11: k2 ^= ((uint64_t)tail[10]) << 16; [[fallthrough]];
+    case 10: k2 ^= ((uint64_t)tail[9]) << 8;   [[fallthrough]];
+    case 9:  k2 ^= ((uint64_t)tail[8]) << 0;
+             k2 *= c2; k2 = rotl64(k2, 33); k2 *= c1; h2 ^= k2;
+             [[fallthrough]];
+    case 8:  k1 ^= ((uint64_t)tail[7]) << 56; [[fallthrough]];
+    case 7:  k1 ^= ((uint64_t)tail[6]) << 48; [[fallthrough]];
+    case 6:  k1 ^= ((uint64_t)tail[5]) << 40; [[fallthrough]];
+    case 5:  k1 ^= ((uint64_t)tail[4]) << 32; [[fallthrough]];
+    case 4:  k1 ^= ((uint64_t)tail[3]) << 24; [[fallthrough]];
+    case 3:  k1 ^= ((uint64_t)tail[2]) << 16; [[fallthrough]];
+    case 2:  k1 ^= ((uint64_t)tail[1]) << 8;  [[fallthrough]];
+    case 1:  k1 ^= ((uint64_t)tail[0]) << 0;
+             k1 *= c1; k1 = rotl64(k1, 31); k1 *= c2; h1 ^= k1;
+  }
+
+  h1 ^= (uint64_t)len;
+  h2 ^= (uint64_t)len;
+  h1 += h2;
+  h2 += h1;
+  h1 = fmix64(h1);
+  h2 = fmix64(h2);
+  h1 += h2;
+  h2 += h1;
+
+  std::memcpy(out, &h1, 8);
+  std::memcpy(out + 8, &h2, 8);
+}
+
+}  // namespace
+
+extern "C" {
+
+void xllm_murmur3_x64_128(const void* key, int32_t len, uint32_t seed,
+                          void* out16) {
+  murmur3_x64_128_impl(static_cast<const uint8_t*>(key),
+                       static_cast<size_t>(len), seed,
+                       static_cast<uint8_t*>(out16));
+}
+
+// digest(block) = murmur3(prev_digest[16] || le32(tokens)...).
+// prev16 may be NULL for the first block (no chaining prefix).
+void xllm_chained_block_hash(const int32_t* tokens, int32_t n_tokens,
+                             const uint8_t* prev16, uint32_t seed,
+                             uint8_t* out16) {
+  std::vector<uint8_t> buf;
+  buf.reserve(16 + 4 * (size_t)n_tokens);
+  if (prev16 != nullptr) {
+    buf.insert(buf.end(), prev16, prev16 + 16);
+  }
+  for (int32_t i = 0; i < n_tokens; i++) {
+    uint8_t b[4];
+    std::memcpy(b, &tokens[i], 4);
+    buf.insert(buf.end(), b, b + 4);
+  }
+  murmur3_x64_128_impl(buf.data(), buf.size(), seed, out16);
+}
+
+// Hash a full token sequence into per-block chained digests.
+// tokens: [n_tokens]; block_size: tokens per block; out: [n_blocks * 16].
+// Returns the number of *complete* blocks hashed (trailing partial block is
+// ignored — matches the prefix-index granularity of the reference's
+// GlobalKVCacheMgr::match, global_kvcache_mgr.cpp:71-129).
+int32_t xllm_prefix_block_hashes(const int32_t* tokens, int32_t n_tokens,
+                                 int32_t block_size, uint32_t seed,
+                                 uint8_t* out) {
+  const int32_t n_blocks = n_tokens / block_size;
+  const uint8_t* prev = nullptr;
+  for (int32_t b = 0; b < n_blocks; b++) {
+    xllm_chained_block_hash(tokens + b * block_size, block_size, prev, seed,
+                            out + b * 16);
+    prev = out + b * 16;
+  }
+  return n_blocks;
+}
+
+}  // extern "C"

@@ -1,0 +1,28 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip TPU hardware is unavailable in CI; all sharding/parallelism tests
+run against ``--xla_force_host_platform_device_count=8`` CPU devices, which
+exercises the same Mesh/pjit/shard_map/collective code paths the TPU uses.
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual cpu devices, got {devs}"
+    return devs

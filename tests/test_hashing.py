@@ -1,0 +1,88 @@
+"""MurmurHash3 + chained block hashing: native/python parity and known vectors."""
+
+import struct
+
+import pytest
+
+from xllm_service_tpu.utils import hashing
+
+
+# Known-good MurmurHash3_x64_128 vectors (computed with the canonical smhasher
+# reference implementation).
+KNOWN_VECTORS = [
+    (b"", 0, "00000000000000000000000000000000"),
+    (b"a", 0, "897859f6655555855a890e51483ab5e6"),
+    (b"abc", 0, "6778ad3f3f3f96b4522dca264174a23b"),
+    (b"hello world", 0, "0e617feb46603f53b163eb607d4697ab"),
+    (b"The quick brown fox jumps over the lazy dog", 0,
+     "6c1b07bc7bbc4be347939ac4a93c437a"),
+    (b"abc", 123, "a2bdf7a7bdbfab14f3a348a6d6c27db4"),
+]
+
+
+@pytest.mark.parametrize("data,seed,hexdigest", KNOWN_VECTORS)
+def test_murmur3_py_known_vectors(data, seed, hexdigest):
+    assert hashing.murmur3_x64_128_py(data, seed).hex() == hexdigest
+
+
+def test_native_matches_python():
+    if not hashing.native_available():
+        pytest.skip("native lib unavailable")
+    for data, seed, _ in KNOWN_VECTORS:
+        assert hashing.murmur3_x64_128(data, seed) == \
+            hashing.murmur3_x64_128_py(data, seed)
+    blob = bytes(range(256)) * 7 + b"tail"
+    assert hashing.murmur3_x64_128(blob, 42) == \
+        hashing.murmur3_x64_128_py(blob, 42)
+
+
+def test_prefix_block_hashes_chaining():
+    tokens = list(range(300))
+    bs = 128
+    digests = hashing.prefix_block_hashes(tokens, bs, seed=7)
+    # 300 tokens → 2 complete blocks; trailing partial block excluded.
+    assert len(digests) == 2
+
+    # Manual chain: block0 = H(tokens[0:128]); block1 = H(d0 || tokens[128:256]).
+    d0 = hashing.murmur3_x64_128_py(struct.pack("<128i", *tokens[:128]), 7)
+    d1 = hashing.murmur3_x64_128_py(
+        d0 + struct.pack("<128i", *tokens[128:256]), 7)
+    assert digests[0] == d0
+    assert digests[1] == d1
+
+
+def test_prefix_block_hashes_prefix_property():
+    """Shared prefixes share digests; divergence changes all later digests."""
+    a = list(range(512))
+    b = list(range(512))
+    b[300] = 9999  # diverge inside block 2
+    da = hashing.prefix_block_hashes(a, 128)
+    db = hashing.prefix_block_hashes(b, 128)
+    assert da[0] == db[0] and da[1] == db[1]
+    assert da[2] != db[2]
+    assert da[3] != db[3]  # chained: divergence propagates
+
+
+def test_native_prefix_matches_python_fallback(monkeypatch):
+    if not hashing.native_available():
+        pytest.skip("native lib unavailable")
+    tokens = [(i * 2654435761) % 50000 for i in range(1000)]
+    native = hashing.prefix_block_hashes(tokens, 64, seed=3)
+    monkeypatch.setattr(hashing, "_load_native", lambda: None)
+    pure = hashing.prefix_block_hashes(tokens, 64, seed=3)
+    assert native == pure
+
+
+def test_out_of_range_token_ids_native_python_parity(monkeypatch):
+    """Out-of-int32 ids must wrap identically on both paths (cluster-wide
+    hash stability)."""
+    tokens = [2**31, -5, 2**40 + 3, 1] * 32
+    a = hashing.prefix_block_hashes(tokens, 128)
+    monkeypatch.setattr(hashing, "_load_native", lambda: None)
+    b = hashing.prefix_block_hashes(tokens, 128)
+    assert a == b
+
+
+def test_empty_and_short():
+    assert hashing.prefix_block_hashes([], 128) == []
+    assert hashing.prefix_block_hashes([1, 2, 3], 128) == []

@@ -1,0 +1,71 @@
+"""Common substrate tests: ordered fan-in pools, uuid, json path, config."""
+
+import threading
+
+from xllm_service_tpu.config import EngineConfig, ModelConfig, ServiceOptions
+from xllm_service_tpu.utils import (
+    OrderedFanInPools,
+    RequestOutput,
+    SequenceOutput,
+    json_path,
+    short_uuid,
+)
+
+
+def test_short_uuid_unique_and_urlsafe():
+    ids = {short_uuid() for _ in range(200)}
+    assert len(ids) == 200
+    for i in ids:
+        assert i.isalnum() and len(i) == 22
+
+
+def test_json_path():
+    d = {"a": {"b": {"c": 3}}, "x": 1}
+    assert json_path(d, "a.b.c") == 3
+    assert json_path(d, "x") == 1
+    assert json_path(d, "a.b.missing", "dflt") == "dflt"
+
+
+def test_ordered_fanin_preserves_per_request_order():
+    pools = OrderedFanInPools(num_pools=4)
+    results = {f"req{i}": [] for i in range(16)}
+    lock = threading.Lock()
+
+    def make_cb(rid, n):
+        def cb():
+            with lock:
+                results[rid].append(n)
+        return cb
+
+    # Interleave submissions across requests; per-request order must hold.
+    for n in range(50):
+        for rid in results:
+            pools.submit(rid, make_cb(rid, n))
+    pools.drain()
+    for rid, seq in results.items():
+        assert seq == list(range(50)), rid
+    # Pinning: same request always maps to the same pool.
+    assert pools.pool_for("req0") == pools.pool_for("req0")
+    pools.stop()
+
+
+def test_request_output_json_roundtrip():
+    ro = RequestOutput(
+        request_id="r1", service_request_id="s1", finished=True,
+        outputs=[SequenceOutput(index=0, text="hi", token_ids=[1, 2])])
+    d = ro.to_json()
+    back = RequestOutput.from_json(d)
+    assert back.request_id == "r1"
+    assert back.outputs[0].token_ids == [1, 2]
+    assert back.finished
+
+
+def test_model_config_presets():
+    c = ModelConfig.llama3_8b()
+    assert c.num_kv_heads == 8 and c.head_dim == 128
+    t = ModelConfig.tiny()
+    assert t.head_dim == 16
+    e = EngineConfig(page_size=64, max_model_len=2048)
+    assert e.max_pages_per_seq == 32
+    o = ServiceOptions()
+    assert o.block_size == 128 and o.target_tpot_ms == 50.0

@@ -1,0 +1,20 @@
+"""xllm-service-tpu: a TPU-native LLM serving-orchestration framework.
+
+A ground-up rebuild of the capabilities of ``czynb666/xllm-service`` for
+Google TPUs: an OpenAI-compatible front door and cluster scheduler
+(``service/``) orchestrating JAX/XLA/Pallas worker engines (``runtime/``,
+``models/``, ``ops/``, ``parallel/``) with prefill/decode disaggregation,
+a cluster-wide prefix KV-cache index, SLO-aware routing, and multi-model
+sleep/wakeup — plus the net-new TPU engine the reference delegated to
+NPU-side xLLM.
+"""
+
+__version__ = "0.1.0"
+
+from xllm_service_tpu.config import (  # noqa: F401
+    EngineConfig,
+    InstanceType,
+    LoadBalancePolicyType,
+    ModelConfig,
+    ServiceOptions,
+)

@@ -1,0 +1,206 @@
+"""Configuration objects for the service layer, worker engine, and models.
+
+``ServiceOptions`` mirrors the reference's gflags surface
+(``common/global_gflags.cpp`` — ports, thread counts, etcd address, load
+balance policy, block_size, murmur seed, SLO targets) as a typed dataclass;
+``EngineConfig`` and ``ModelConfig`` configure the net-new TPU worker engine
+that the reference delegated to NPU-side xLLM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+
+class LoadBalancePolicyType(str, enum.Enum):
+    ROUND_ROBIN = "RR"
+    CACHE_AWARE = "CAR"
+    SLO_AWARE = "SLO_AWARE"
+
+
+class InstanceType(str, enum.Enum):
+    """Worker roles. Mirrors reference ``common/types.h:71-79``; ENCODE is the
+    net-new EPD multimodal encode role (reference claims EPD but keeps it
+    engine-side)."""
+
+    DEFAULT = "DEFAULT"
+    PREFILL = "PREFILL"
+    DECODE = "DECODE"
+    MIX = "MIX"
+    ENCODE = "ENCODE"
+
+
+@dataclasses.dataclass
+class ServiceOptions:
+    """Service-process options (reference: common/global_gflags.cpp + options.h)."""
+
+    host: str = "127.0.0.1"
+    http_port: int = 9888
+    rpc_port: int = 9889
+    num_threads: int = 32
+    max_concurrency: int = 128
+
+    etcd_addr: str = ""           # empty → in-process coordination store
+    load_balance_policy: LoadBalancePolicyType = LoadBalancePolicyType.CACHE_AWARE
+
+    block_size: int = 128          # prefix-hash granularity (tokens per KV block)
+    murmur_hash3_seed: int = 0
+
+    tokenizer_path: str = ""
+    model_id: str = ""
+
+    enable_request_trace: bool = False
+    trace_path: str = "trace/trace.json"
+    enable_decode_response_to_service: bool = False
+
+    # SLO routing thresholds (hot-reloadable in the reference,
+    # global_gflags.cpp:95-104).
+    target_ttft_ms: float = 1000.0
+    target_tpot_ms: float = 50.0
+
+    # Cluster cadences.
+    heartbeat_interval_s: float = 3.0
+    master_upload_interval_s: float = 3.0
+    detect_disconnected_instance_interval_s: float = 10.0
+
+    # Token fan-in ordering pools (reference: scheduler.h:114).
+    num_output_pools: int = 128
+
+    # Multi-model serverless allocator budget per instance, GB
+    # (reference: instance_mgr.h:143).
+    instance_memory_budget_gb: float = 60.0
+
+    def to_json(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["load_balance_policy"] = self.load_balance_policy.value
+        return d
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """Architecture config covering Llama-2/3, Qwen2(.5), TinyLlama, and the
+    MoE (Mixtral-style) variant used for expert parallelism."""
+
+    name: str = "llama"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None            # default hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False              # True for Qwen2 QKV
+    # MoE (0 experts → dense MLP).
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    dtype: str = "bfloat16"
+
+    def __post_init__(self) -> None:
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @classmethod
+    def llama3_8b(cls) -> "ModelConfig":
+        return cls(name="llama3-8b", vocab_size=128256, hidden_size=4096,
+                   intermediate_size=14336, num_layers=32, num_heads=32,
+                   num_kv_heads=8, rope_theta=500000.0,
+                   max_position_embeddings=8192)
+
+    @classmethod
+    def llama3_1b(cls) -> "ModelConfig":
+        # Llama-3.2-1B shape: the single-chip flagship for bench.py.
+        return cls(name="llama3-1b", vocab_size=128256, hidden_size=2048,
+                   intermediate_size=8192, num_layers=16, num_heads=32,
+                   num_kv_heads=8, head_dim=64, rope_theta=500000.0,
+                   max_position_embeddings=8192, tie_word_embeddings=True)
+
+    @classmethod
+    def qwen2_7b(cls) -> "ModelConfig":
+        return cls(name="qwen2-7b", vocab_size=152064, hidden_size=3584,
+                   intermediate_size=18944, num_layers=28, num_heads=28,
+                   num_kv_heads=4, rope_theta=1000000.0, rms_norm_eps=1e-6,
+                   attention_bias=True, max_position_embeddings=32768)
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 256, num_experts: int = 0) -> "ModelConfig":
+        """Small config for CPU tests."""
+        return cls(name="tiny", vocab_size=vocab_size, hidden_size=64,
+                   intermediate_size=128, num_layers=2, num_heads=4,
+                   num_kv_heads=2, head_dim=16, rope_theta=10000.0,
+                   max_position_embeddings=512, num_experts=num_experts)
+
+    @classmethod
+    def from_hf_config(cls, d: Dict[str, Any], name: str = "hf") -> "ModelConfig":
+        """Build from a HuggingFace ``config.json`` dict (LlamaConfig/Qwen2Config)."""
+        return cls(
+            name=name,
+            vocab_size=d["vocab_size"],
+            hidden_size=d["hidden_size"],
+            intermediate_size=d["intermediate_size"],
+            num_layers=d["num_hidden_layers"],
+            num_heads=d["num_attention_heads"],
+            num_kv_heads=d.get("num_key_value_heads", d["num_attention_heads"]),
+            head_dim=d.get("head_dim"),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rms_norm_eps=d.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=d.get("max_position_embeddings", 4096),
+            tie_word_embeddings=d.get("tie_word_embeddings", False),
+            attention_bias=d.get("attention_bias",
+                                 d.get("model_type") == "qwen2"),
+            num_experts=d.get("num_local_experts", 0),
+            num_experts_per_tok=d.get("num_experts_per_tok", 2),
+        )
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Worker-engine runtime config (paged KV cache + continuous batching)."""
+
+    page_size: int = 64                 # tokens per KV page (HBM granularity)
+    num_pages: int = 1024               # KV pool size (per layer, per chip-shard)
+    max_model_len: int = 2048           # max tokens per sequence
+    max_batch_size: int = 8             # decode batch capacity
+    max_prefill_tokens: int = 2048      # prefill token budget per step
+    prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
+    enable_prefix_cache: bool = True
+    # Parallel degrees of this instance's mesh.
+    tp: int = 1
+    dp: int = 1
+    sp: int = 1
+    # Offline (batch) requests are preempted by online ones.
+    max_num_seqs: int = 256             # scheduler queue cap
+
+    def __post_init__(self) -> None:
+        if self.max_model_len % self.page_size != 0:
+            raise ValueError(
+                f"max_model_len={self.max_model_len} must be a multiple of "
+                f"page_size={self.page_size}")
+        self.max_pages_per_seq = self.max_model_len // self.page_size
+
+
+def load_json(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def options_from_env(**overrides: Any) -> ServiceOptions:
+    """Build ServiceOptions honoring the reference's env toggles
+    (``ENABLE_DECODE_RESPONSE_TO_SERVICE``, ``ENABLE_XLLM_DEBUG_LOG`` —
+    http_service/service.cpp:54-55, common/utils.cpp:28-41)."""
+    opts = ServiceOptions(**overrides)
+    if os.environ.get("ENABLE_DECODE_RESPONSE_TO_SERVICE", "").lower() in (
+            "1", "true", "yes"):
+        opts.enable_decode_response_to_service = True
+    return opts

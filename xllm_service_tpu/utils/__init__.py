@@ -1,0 +1,27 @@
+from xllm_service_tpu.utils.hashing import (  # noqa: F401
+    murmur3_x64_128,
+    murmur3_x64_128_py,
+    native_available,
+    prefix_block_hashes,
+)
+from xllm_service_tpu.utils.misc import (  # noqa: F401
+    AtomicCounter,
+    OrderedFanInPools,
+    is_port_available,
+    json_path,
+    pick_free_port,
+    short_uuid,
+)
+from xllm_service_tpu.utils.types import (  # noqa: F401
+    FinishReason,
+    LogProb,
+    OutputCallback,
+    Request,
+    RequestOutput,
+    Routing,
+    SamplingParams,
+    SequenceOutput,
+    Status,
+    StatusCode,
+    Usage,
+)

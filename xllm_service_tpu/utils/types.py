@@ -1,0 +1,242 @@
+"""Core request/response value types shared by the service and the worker.
+
+Python equivalents of the reference's ``common/xllm/output.h:33-132``
+(``RequestOutput``/``SequenceOutput``/``LogProb``/``Usage``/``FinishReason``),
+``common/xllm/status.h:26-74`` (``Status``/``StatusCode``) and
+``request/request.h:26-61`` (``Request``). These cross the wire as JSON
+between service and workers, so every type has ``to_json``/``from_json``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Dict, List, Optional
+
+
+class StatusCode(enum.IntEnum):
+    OK = 0
+    CANCELLED = 1
+    UNKNOWN = 2
+    INVALID_ARGUMENT = 3
+    DEADLINE_EXCEEDED = 4
+    RESOURCE_EXHAUSTED = 8
+    UNAVAILABLE = 14
+    INTERNAL = 13
+
+
+@dataclasses.dataclass
+class Status:
+    code: StatusCode = StatusCode.OK
+    message: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.code == StatusCode.OK
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"code": int(self.code), "message": self.message}
+
+    @classmethod
+    def from_json(cls, d: Optional[Dict[str, Any]]) -> "Status":
+        if not d:
+            return cls()
+        try:
+            code = StatusCode(d.get("code", 0))
+        except ValueError:  # unknown code from a newer/older peer
+            code = StatusCode.UNKNOWN
+        return cls(code, d.get("message", ""))
+
+
+class FinishReason(str, enum.Enum):
+    NONE = ""
+    STOP = "stop"
+    LENGTH = "length"
+    FUNCTION_CALL = "function_call"
+    CANCELLED = "cancelled"
+
+    @property
+    def openai(self) -> Optional[str]:
+        return self.value or None
+
+
+@dataclasses.dataclass
+class Usage:
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+    @property
+    def total_tokens(self) -> int:
+        return self.prompt_tokens + self.completion_tokens
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"prompt_tokens": self.prompt_tokens,
+                "completion_tokens": self.completion_tokens,
+                "total_tokens": self.total_tokens}
+
+    @classmethod
+    def from_json(cls, d: Optional[Dict[str, Any]]) -> "Usage":
+        if not d:
+            return cls()
+        return cls(d.get("prompt_tokens", 0), d.get("completion_tokens", 0))
+
+
+@dataclasses.dataclass
+class LogProb:
+    token: str = ""
+    token_id: int = 0
+    logprob: float = 0.0
+    top_logprobs: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "LogProb":
+        return cls(d.get("token", ""), d.get("token_id", 0),
+                   d.get("logprob", 0.0), d.get("top_logprobs", []))
+
+
+@dataclasses.dataclass
+class SequenceOutput:
+    index: int = 0
+    text: str = ""
+    token_ids: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: FinishReason = FinishReason.NONE
+    logprobs: List[LogProb] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "index": self.index,
+            "text": self.text,
+            "token_ids": self.token_ids,
+            "finish_reason": self.finish_reason.value,
+            "logprobs": [lp.to_json() for lp in self.logprobs],
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "SequenceOutput":
+        try:
+            fr = FinishReason(d.get("finish_reason", ""))
+        except ValueError:  # unknown reason from a newer peer → treat as stop
+            fr = FinishReason.STOP
+        return cls(
+            index=d.get("index", 0),
+            text=d.get("text", ""),
+            token_ids=d.get("token_ids", []),
+            finish_reason=fr,
+            logprobs=[LogProb.from_json(x) for x in d.get("logprobs", [])],
+        )
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    """One generation update for a request (a token delta or the final chunk)."""
+
+    request_id: str = ""
+    service_request_id: str = ""
+    status: Status = dataclasses.field(default_factory=Status)
+    outputs: List[SequenceOutput] = dataclasses.field(default_factory=list)
+    usage: Optional[Usage] = None
+    finished: bool = False
+    cancelled: bool = False
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "request_id": self.request_id,
+            "service_request_id": self.service_request_id,
+            "status": self.status.to_json(),
+            "outputs": [o.to_json() for o in self.outputs],
+            "usage": self.usage.to_json() if self.usage else None,
+            "finished": self.finished,
+            "cancelled": self.cancelled,
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "RequestOutput":
+        return cls(
+            request_id=d.get("request_id", ""),
+            service_request_id=d.get("service_request_id", ""),
+            status=Status.from_json(d.get("status")),
+            outputs=[SequenceOutput.from_json(x) for x in d.get("outputs", [])],
+            usage=Usage.from_json(d["usage"]) if d.get("usage") else None,
+            finished=d.get("finished", False),
+            cancelled=d.get("cancelled", False),
+        )
+
+
+# Callback invoked per RequestOutput; returning False cancels the request
+# (mirrors reference output_callback semantics, scheduler.cpp:207-236).
+OutputCallback = Callable[[RequestOutput], bool]
+
+
+@dataclasses.dataclass
+class Routing:
+    """Instance routing decision attached to a forwarded request
+    (reference: chat.proto extension fields 24-28)."""
+
+    prefill_name: str = ""
+    decode_name: str = ""
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"prefill_name": self.prefill_name,
+                "decode_name": self.decode_name}
+
+    @classmethod
+    def from_json(cls, d: Optional[Dict[str, Any]]) -> "Routing":
+        if not d:
+            return cls()
+        return cls(d.get("prefill_name", ""), d.get("decode_name", ""))
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 16
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    n: int = 1
+    stop: List[str] = dataclasses.field(default_factory=list)
+    stop_token_ids: List[int] = dataclasses.field(default_factory=list)
+    seed: Optional[int] = None
+    logprobs: bool = False
+    ignore_eos: bool = False
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: Optional[Dict[str, Any]]) -> "SamplingParams":
+        if not d:
+            return cls()
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class Request:
+    """Scheduler-side request record (reference: request/request.h:26-61).
+
+    The ``offline`` flag is *implemented* here (online-over-offline
+    preemption in the worker and tiered admission in the service) — in the
+    reference it exists in the proto (chat.proto:115) but nothing reads it.
+    """
+
+    model: str = ""
+    service_request_id: str = ""
+    stream: bool = False
+    include_usage: bool = False
+    offline: bool = False
+    priority: int = 0
+    prompt: str = ""
+    messages: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    token_ids: List[int] = dataclasses.field(default_factory=list)
+    routing: Routing = dataclasses.field(default_factory=Routing)
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    # Multimodal inputs for the EPD encode stage.
+    mm_inputs: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    num_generated_tokens: int = 0
+    estimated_ttft_ms: float = 0.0
+    arrival_time: float = 0.0
+    output_callback: Optional[OutputCallback] = None
+    trace_callback: Optional[Callable[[str, Dict[str, Any]], None]] = None
