@@ -1,0 +1,167 @@
+"""Model-level consistency tests on tiny configs (CPU, fp32)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from xllm_service_tpu.config import ModelConfig
+from xllm_service_tpu.models import (
+    init_params, init_kv_cache, forward_prefill, forward_decode)
+
+
+def _cfg(**kw):
+    kw.setdefault("dtype", "float32")  # fp32 on CPU for tight comparisons
+    return ModelConfig(**{**ModelConfig.tiny().__dict__, **kw})
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _fresh_cache(cfg, num_pages=16, page_size=4):
+    return init_kv_cache(cfg, num_pages, page_size, jnp.float32), page_size
+
+
+def test_prefill_then_decode_matches_full_prefill(tiny):
+    """Logits for token T from prefill(T tokens)+decode(token T) must match
+    prefill(T+1 tokens) — the continuous-batching correctness invariant."""
+    cfg, params = tiny
+    (kv, ps) = _fresh_cache(cfg)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, size=9).astype(np.int32)
+    pt = jnp.asarray([[1, 2, 3, 0]], jnp.int32)  # 4-slot table, 3 real pages
+
+    # Path A: prefill all 9 tokens at once.
+    kv_a = jax.tree_util.tree_map(jnp.copy, kv)
+    last_a, _, kv_a = forward_prefill(
+        params, cfg, jnp.asarray(toks[None]), jnp.zeros(1, jnp.int32),
+        jnp.asarray([9], jnp.int32), kv_a, pt)
+
+    # Path B: prefill 8, then decode token 8.
+    kv_b = jax.tree_util.tree_map(jnp.copy, kv)
+    _, _, kv_b = forward_prefill(
+        params, cfg, jnp.asarray(toks[None, :8]), jnp.zeros(1, jnp.int32),
+        jnp.asarray([8], jnp.int32), kv_b, pt)
+    logits_b, kv_b = forward_decode(
+        params, cfg, jnp.asarray(toks[8:9]), jnp.asarray([8], jnp.int32),
+        jnp.asarray([True]), kv_b, pt)
+
+    np.testing.assert_allclose(np.asarray(last_a), np.asarray(logits_b),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_prefix_cache_prefill_matches_full(tiny):
+    """prefill(prefix) + prefill(rest, start_pos=len(prefix)) ==
+    prefill(full) — the prefix-cache reuse invariant."""
+    cfg, params = tiny
+    (kv, ps) = _fresh_cache(cfg)
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, cfg.vocab_size, size=12).astype(np.int32)
+    pt = jnp.asarray([[1, 2, 3], [0, 0, 0]], jnp.int32)
+
+    kv_a = jax.tree_util.tree_map(jnp.copy, kv)
+    last_a, _, _ = forward_prefill(
+        params, cfg, jnp.asarray(np.stack([toks, toks])),
+        jnp.zeros(2, jnp.int32), jnp.asarray([12, 0], jnp.int32), kv_a, pt)
+
+    kv_b = jax.tree_util.tree_map(jnp.copy, kv)
+    _, _, kv_b = forward_prefill(
+        params, cfg, jnp.asarray(toks[None, :8]), jnp.zeros(1, jnp.int32),
+        jnp.asarray([8], jnp.int32), kv_b, pt[:1])
+    last_b, _, _ = forward_prefill(
+        params, cfg, jnp.asarray(toks[None, 8:]),
+        jnp.asarray([8], jnp.int32), jnp.asarray([4], jnp.int32), kv_b,
+        pt[:1])
+
+    np.testing.assert_allclose(np.asarray(last_a[0]), np.asarray(last_b[0]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_padded_batch_independence(tiny):
+    """A sequence's logits must not depend on other batch slots or padding."""
+    cfg, params = tiny
+    (kv, ps) = _fresh_cache(cfg)
+    rng = np.random.default_rng(2)
+    toks = rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+
+    kv1 = jax.tree_util.tree_map(jnp.copy, kv)
+    solo, _, _ = forward_prefill(
+        params, cfg, jnp.asarray(toks[None]), jnp.zeros(1, jnp.int32),
+        jnp.asarray([6], jnp.int32), kv1, jnp.asarray([[1, 2]], jnp.int32))
+
+    other = rng.integers(0, cfg.vocab_size, size=8).astype(np.int32)
+    batch = np.zeros((2, 8), np.int32)
+    batch[0, :6] = toks        # padded with zeros
+    batch[1] = other
+    kv2 = jax.tree_util.tree_map(jnp.copy, kv)
+    duo, _, _ = forward_prefill(
+        params, cfg, jnp.asarray(batch), jnp.zeros(2, jnp.int32),
+        jnp.asarray([6, 8], jnp.int32), kv2,
+        jnp.asarray([[1, 2], [3, 4]], jnp.int32))
+
+    np.testing.assert_allclose(np.asarray(solo[0]), np.asarray(duo[0]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_qwen_bias_and_tied_embeddings():
+    cfg = ModelConfig(**{**ModelConfig.tiny().__dict__,
+                         "attention_bias": True,
+                         "tie_word_embeddings": True, "dtype": "float32"})
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    assert "lm_head" not in params and "q_bias" in params["layers"]
+    kv = init_kv_cache(cfg, 8, 4, jnp.float32)
+    last, _, _ = forward_prefill(
+        params, cfg, jnp.asarray([[1, 2, 3]], jnp.int32),
+        jnp.zeros(1, jnp.int32), jnp.asarray([3], jnp.int32), kv,
+        jnp.asarray([[1]], jnp.int32))
+    assert np.isfinite(np.asarray(last)).all()
+
+
+def test_moe_single_expert_equals_dense():
+    """With 1 expert and top-1 routing the MoE layer is exactly a dense MLP
+    (router weight softmaxes to 1.0)."""
+    base = ModelConfig(**{**ModelConfig.tiny().__dict__, "dtype": "float32"})
+    moe = ModelConfig(**{**ModelConfig.tiny().__dict__, "dtype": "float32",
+                         "num_experts": 1, "num_experts_per_tok": 1})
+    pd = init_params(base, jax.random.PRNGKey(4))
+    pm = init_params(moe, jax.random.PRNGKey(4))
+    # Share every weight; expert 0 of the MoE = the dense MLP.
+    for nm in ("gate_proj", "up_proj", "down_proj"):
+        pm["layers"][nm] = pd["layers"][nm][:, None]
+    for nm in ("input_norm", "q_proj", "k_proj", "v_proj", "o_proj",
+               "post_norm"):
+        pm["layers"][nm] = pd["layers"][nm]
+    pm["embed"], pm["final_norm"] = pd["embed"], pd["final_norm"]
+    pm["lm_head"] = pd["lm_head"]
+
+    toks = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    pt = jnp.asarray([[1]], jnp.int32)
+    kv1 = init_kv_cache(base, 4, 4, jnp.float32)
+    kv2 = init_kv_cache(moe, 4, 4, jnp.float32)
+    ld, _, _ = forward_prefill(pd, base, toks, jnp.zeros(1, jnp.int32),
+                               jnp.asarray([4], jnp.int32), kv1, pt)
+    lm, _, _ = forward_prefill(pm, moe, toks, jnp.zeros(1, jnp.int32),
+                               jnp.asarray([4], jnp.int32), kv2, pt)
+    np.testing.assert_allclose(np.asarray(ld), np.asarray(lm),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_topk_runs_finite():
+    cfg = ModelConfig(**{**ModelConfig.tiny().__dict__, "dtype": "float32",
+                         "num_experts": 4, "num_experts_per_tok": 2})
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    kv = init_kv_cache(cfg, 4, 4, jnp.float32)
+    last, _, kv = forward_prefill(
+        params, cfg, jnp.asarray([[1, 2, 3, 4]], jnp.int32),
+        jnp.zeros(1, jnp.int32), jnp.asarray([4], jnp.int32), kv,
+        jnp.asarray([[1]], jnp.int32))
+    logits, _ = forward_decode(
+        params, cfg, jnp.asarray([9], jnp.int32), jnp.asarray([4], jnp.int32),
+        jnp.asarray([True]), kv, jnp.asarray([[1, 2]], jnp.int32))
+    assert np.isfinite(np.asarray(last)).all()
+    assert np.isfinite(np.asarray(logits)).all()

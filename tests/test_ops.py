@@ -1,0 +1,178 @@
+"""Unit tests for the compute ops against naive NumPy references."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from xllm_service_tpu.ops import (
+    rms_norm, apply_rope, mha_prefill, paged_decode_attention,
+    gather_pages, write_prefill_kv, write_decode_kv, sample_tokens, greedy,
+)
+from xllm_service_tpu.ops.sampling import SamplingTensors, compute_logprobs
+
+
+def test_rms_norm_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 5, 16)).astype(np.float32)
+    w = rng.standard_normal(16).astype(np.float32)
+    got = np.asarray(rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-5))
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-5) * w
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_rope_identity_at_position_zero_and_norm_preserving():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 3, 2, 8)).astype(np.float32)
+    pos = jnp.asarray([[0, 1, 7]], dtype=jnp.int32)
+    out = np.asarray(apply_rope(jnp.asarray(x), pos, theta=10000.0))
+    np.testing.assert_allclose(out[0, 0], x[0, 0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.linalg.norm(out, axis=-1),
+                               np.linalg.norm(x, axis=-1), rtol=1e-4)
+
+
+def test_rope_relative_property():
+    # <rope(q, m), rope(k, n)> depends only on m - n.
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((1, 1, 1, 16)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((1, 1, 1, 16)).astype(np.float32))
+
+    def dot_at(m, n):
+        qr = apply_rope(q, jnp.asarray([[m]], jnp.int32), 10000.0)
+        kr = apply_rope(k, jnp.asarray([[n]], jnp.int32), 10000.0)
+        return float(jnp.sum(qr * kr))
+
+    assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-4)
+    assert dot_at(5, 3) == pytest.approx(dot_at(2, 0), rel=1e-4)
+
+
+def _naive_attention(q, k, v, kv_len, q_start):
+    """Loop reference: q [T,Hq,D], k/v [S,Hkv,D]."""
+    T, Hq, D = q.shape
+    S, Hkv, _ = k.shape
+    G = Hq // Hkv
+    out = np.zeros_like(q)
+    for t in range(T):
+        for h in range(Hq):
+            kv_h = h // G
+            scores = (k[:, kv_h] @ q[t, h]) / np.sqrt(D)
+            mask = (np.arange(S) <= q_start + t) & (np.arange(S) < kv_len)
+            scores = np.where(mask, scores, -1e30)
+            p = np.exp(scores - scores.max())
+            p /= p.sum()
+            out[t, h] = p @ v[:, kv_h]
+    return out
+
+
+def test_mha_prefill_matches_naive():
+    rng = np.random.default_rng(3)
+    B, T, S, Hq, Hkv, D = 2, 4, 6, 4, 2, 8
+    q = rng.standard_normal((B, T, Hq, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, Hkv, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, Hkv, D)).astype(np.float32)
+    q_start = np.array([2, 0], np.int32)   # seq 0 has a 2-token cached prefix
+    kv_len = np.array([6, 4], np.int32)
+    got = np.asarray(mha_prefill(jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v), jnp.asarray(kv_len),
+                                 jnp.asarray(q_start)))
+    for b in range(B):
+        ref = _naive_attention(q[b], k[b], v[b], kv_len[b], q_start[b])
+        np.testing.assert_allclose(got[b], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_paged_kv_roundtrip_and_decode_attention():
+    rng = np.random.default_rng(4)
+    P, ps, Hkv, D, Hq = 8, 4, 2, 8, 4
+    B, T = 2, 6
+    k_pages = jnp.zeros((P, ps, Hkv, D), jnp.float32)
+    v_pages = jnp.zeros((P, ps, Hkv, D), jnp.float32)
+    # seq0 pages [1,2], seq1 pages [3,4]; page 0 is NULL.
+    page_table = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    k = rng.standard_normal((B, T, Hkv, D)).astype(np.float32)
+    v = rng.standard_normal((B, T, Hkv, D)).astype(np.float32)
+    lengths = np.array([6, 5], np.int32)
+    start = np.zeros(B, np.int32)
+    k_pages, v_pages = write_prefill_kv(
+        k_pages, v_pages, jnp.asarray(k), jnp.asarray(v), page_table,
+        jnp.asarray(start), jnp.asarray(lengths))
+    gk = np.asarray(gather_pages(k_pages, page_table))
+    for b in range(B):
+        np.testing.assert_allclose(gk[b, :lengths[b]], k[b, :lengths[b]])
+    # Padding of seq1 (t=5) must not have been written anywhere.
+    assert np.all(np.asarray(k_pages)[0] == 0)  # NULL page untouched
+
+    # Decode one token for each sequence at position lengths[b].
+    newk = rng.standard_normal((B, Hkv, D)).astype(np.float32)
+    newv = rng.standard_normal((B, Hkv, D)).astype(np.float32)
+    positions = jnp.asarray(lengths, jnp.int32)
+    k_pages, v_pages = write_decode_kv(
+        k_pages, v_pages, jnp.asarray(newk), jnp.asarray(newv), page_table,
+        positions, jnp.asarray([True, True]))
+    q = rng.standard_normal((B, Hq, D)).astype(np.float32)
+    ctx = np.asarray(positions) + 1
+    got = np.asarray(paged_decode_attention(
+        jnp.asarray(q), k_pages, v_pages, page_table, jnp.asarray(ctx)))
+    for b in range(B):
+        fullk = np.concatenate([k[b, :lengths[b]], newk[b][None]], 0)
+        fullv = np.concatenate([v[b, :lengths[b]], newv[b][None]], 0)
+        ref = _naive_attention(q[b][None], fullk, fullv,
+                               kv_len=ctx[b], q_start=ctx[b] - 1)[0]
+        np.testing.assert_allclose(got[b], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_invalid_kv_writes_do_not_touch_last_page():
+    """Regression: invalid (padding/inactive/NULL-page) writes must be
+    dropped, not wrapped to the last pool slot (a -1 scatter index is
+    normalized by JAX to num_slots-1 before the bounds check)."""
+    P, ps, Hkv, D = 4, 2, 1, 4
+    k_pages = jnp.zeros((P, ps, Hkv, D), jnp.float32)
+    v_pages = jnp.zeros((P, ps, Hkv, D), jnp.float32)
+    ones = jnp.ones((1, 2, Hkv, D), jnp.float32)
+    # Sequence owns page 1 but declares length 1: token t=1 is padding.
+    k2, v2 = write_prefill_kv(k_pages, v_pages, ones, ones,
+                              jnp.asarray([[1]], jnp.int32),
+                              jnp.zeros(1, jnp.int32),
+                              jnp.asarray([1], jnp.int32))
+    assert np.all(np.asarray(k2)[2:] == 0)          # pages 2,3 untouched
+    assert np.all(np.asarray(k2)[0] == 0)           # NULL page untouched
+    # Inactive decode write must be dropped too.
+    k3, v3 = write_decode_kv(k_pages, v_pages, ones[:, 0], ones[:, 0],
+                             jnp.asarray([[1]], jnp.int32),
+                             jnp.asarray([0], jnp.int32),
+                             jnp.asarray([False]))
+    assert np.all(np.asarray(k3) == 0)
+
+
+def test_sampling_greedy_and_filters():
+    rng = np.random.default_rng(5)
+    logits = jnp.asarray(rng.standard_normal((3, 50)).astype(np.float32))
+    g = np.asarray(greedy(logits))
+    assert g.tolist() == np.argmax(np.asarray(logits), -1).tolist()
+
+    key = jax.random.PRNGKey(0)
+    # temperature 0 → greedy regardless of key.
+    st = SamplingTensors(temperature=jnp.zeros(3), top_p=jnp.ones(3),
+                         top_k=jnp.zeros(3, jnp.int32))
+    assert np.asarray(sample_tokens(logits, st, key)).tolist() == g.tolist()
+    # top_k=1 → greedy even at high temperature.
+    st = SamplingTensors(temperature=jnp.full((3,), 5.0), top_p=jnp.ones(3),
+                         top_k=jnp.ones(3, jnp.int32))
+    assert np.asarray(sample_tokens(logits, st, key)).tolist() == g.tolist()
+    # tiny top_p → greedy.
+    st = SamplingTensors(temperature=jnp.full((3,), 5.0),
+                         top_p=jnp.full((3,), 1e-6),
+                         top_k=jnp.zeros(3, jnp.int32))
+    assert np.asarray(sample_tokens(logits, st, key)).tolist() == g.tolist()
+    # high temperature + full top_p samples valid ids.
+    st = SamplingTensors(temperature=jnp.full((3,), 1.0), top_p=jnp.ones(3),
+                         top_k=jnp.zeros(3, jnp.int32))
+    toks = np.asarray(sample_tokens(logits, st, key))
+    assert toks.shape == (3,) and (toks >= 0).all() and (toks < 50).all()
+
+
+def test_compute_logprobs():
+    logits = jnp.asarray([[0.0, 1.0, 2.0]], jnp.float32)
+    lp = np.asarray(compute_logprobs(logits, jnp.asarray([2])))
+    ref = 2.0 - np.log(np.exp([0.0, 1.0, 2.0]).sum())
+    assert lp[0] == pytest.approx(ref, rel=1e-5)

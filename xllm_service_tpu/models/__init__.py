@@ -1,0 +1,20 @@
+"""Model zoo for the TPU worker engine.
+
+A single functional transformer (``transformer.py``) covers the Llama-2/3,
+TinyLlama, and Qwen-2/2.5 dense families plus Mixtral-style MoE via
+``ModelConfig`` switches; ``vision.py`` adds the ViT encoder used by the EPD
+multimodal pipeline. Parameters are plain pytrees with layers stacked on a
+leading axis so the forward pass is one ``lax.scan`` — one compiled layer
+body regardless of depth.
+"""
+
+from xllm_service_tpu.models.transformer import (
+    init_params,
+    init_kv_cache,
+    forward_prefill,
+    forward_decode,
+    num_params,
+)
+
+__all__ = ["init_params", "init_kv_cache", "forward_prefill",
+           "forward_decode", "num_params"]

@@ -1,0 +1,248 @@
+"""Functional decoder-only transformer over a paged KV cache.
+
+One implementation serves every dense family the reference stack deploys
+(Llama-2/3, TinyLlama, Qwen-2/2.5 — see ``ModelConfig``) plus Mixtral-style
+MoE. Design choices are TPU-first (SURVEY.md §7.1):
+
+- **Stacked layers + ``lax.scan``**: every per-layer weight carries a leading
+  ``[L, ...]`` axis and the layer body is traced once, so compile time and
+  program size are depth-independent and XLA pipelines HBM prefetch of layer
+  l+1's weights behind layer l's compute.
+- **Plain pytree params** (no framework modules): the sharding layer
+  (``parallel/sharding.py``) attaches ``NamedSharding`` per leaf path; pjit
+  then partitions the same function over any mesh.
+- **Paged KV cache** threaded through scan as per-layer xs/ys (see
+  ``ops/attention.py`` for the page pool layout).
+- **bfloat16 weights/activations, float32 softmax/norm/rope/logits** — the
+  MXU-native mix.
+
+The reference repo has no model code at all (its engine is out-of-repo,
+SURVEY.md §2 intro); this file is the net-new compute path it assumes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from xllm_service_tpu.config import ModelConfig
+from xllm_service_tpu.ops.norm import rms_norm
+from xllm_service_tpu.ops.rope import apply_rope
+from xllm_service_tpu.ops.attention import (
+    mha_prefill,
+    paged_decode_attention,
+    gather_pages,
+    write_prefill_kv,
+    write_decode_kv,
+)
+
+Params = Dict[str, Any]
+KVCache = Tuple[jnp.ndarray, jnp.ndarray]  # k_pages, v_pages: [L, P, ps, Hkv, Dh]
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: ModelConfig, key: jax.Array,
+                dtype: Optional[jnp.dtype] = None) -> Params:
+    """Random-init a parameter pytree with the stacked-layer layout."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    Hq, Hkv, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    keys = iter(jax.random.split(key, 32))
+
+    def w(shape, fan_in):
+        return (jax.random.normal(next(keys), shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(dtype)
+
+    layers: Dict[str, jnp.ndarray] = {
+        "input_norm": jnp.ones((L, D), dtype),
+        "q_proj": w((L, D, Hq * Dh), D),
+        "k_proj": w((L, D, Hkv * Dh), D),
+        "v_proj": w((L, D, Hkv * Dh), D),
+        "o_proj": w((L, Hq * Dh, D), Hq * Dh),
+        "post_norm": jnp.ones((L, D), dtype),
+    }
+    if cfg.attention_bias:
+        layers["q_bias"] = jnp.zeros((L, Hq * Dh), dtype)
+        layers["k_bias"] = jnp.zeros((L, Hkv * Dh), dtype)
+        layers["v_bias"] = jnp.zeros((L, Hkv * Dh), dtype)
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["router"] = w((L, D, E), D)
+        layers["gate_proj"] = w((L, E, D, F), D)
+        layers["up_proj"] = w((L, E, D, F), D)
+        layers["down_proj"] = w((L, E, F, D), F)
+    else:
+        layers["gate_proj"] = w((L, D, F), D)
+        layers["up_proj"] = w((L, D, F), D)
+        layers["down_proj"] = w((L, F, D), F)
+
+    params: Params = {
+        "embed": w((cfg.vocab_size, D), D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w((D, cfg.vocab_size), D)
+    return params
+
+
+def num_params(params: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
+                  dtype: Optional[jnp.dtype] = None) -> KVCache:
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
+             cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Layer body (shared by prefill and decode via an `is_prefill` closure switch
+# — two separate compiled programs, one source of truth)
+# ---------------------------------------------------------------------------
+
+def _qkv(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray):
+    """x: [B, T, D] → q [B, T, Hq, Dh], k/v [B, T, Hkv, Dh]."""
+    B, T, _ = x.shape
+    q = x @ lp["q_proj"]
+    k = x @ lp["k_proj"]
+    v = x @ lp["v_proj"]
+    if "q_bias" in lp:
+        q = q + lp["q_bias"]
+        k = k + lp["k_bias"]
+        v = v + lp["v_bias"]
+    q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
+         x: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU MLP; MoE routes each token through its top-k experts."""
+    if not cfg.is_moe:
+        return (jax.nn.silu(x @ lp["gate_proj"]) * (x @ lp["up_proj"])) \
+            @ lp["down_proj"]
+    # Mixtral-style MoE. Dense formulation: every expert runs on every token
+    # and a top-k routing weight combines them. FLOPs scale with E, which is
+    # fine at test scale; the expert-parallel shard_map path
+    # (parallel/expert.py) replaces this with an all-to-all dispatch when the
+    # mesh has an 'ep' axis.
+    gates = jax.nn.softmax((x @ lp["router"]).astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(gates, cfg.num_experts_per_tok)   # [B,T,K]
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    weights = jnp.zeros_like(gates).at[
+        jnp.arange(gates.shape[0])[:, None, None],
+        jnp.arange(gates.shape[1])[None, :, None],
+        topi].set(topv)                                          # [B,T,E]
+    h = jax.nn.silu(jnp.einsum("btd,edf->btef", x, lp["gate_proj"])) \
+        * jnp.einsum("btd,edf->btef", x, lp["up_proj"])
+    out = jnp.einsum("btef,efd->bted", h, lp["down_proj"])
+    return jnp.einsum("bted,bte->btd", out, weights.astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Prefill
+# ---------------------------------------------------------------------------
+
+def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                    start_pos: jnp.ndarray, lengths: jnp.ndarray,
+                    kv: KVCache, page_table: jnp.ndarray,
+                    return_all_logits: bool = False,
+                    ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], KVCache]:
+    """Prefill ``tokens`` [B, T] (padded; true new-token counts in
+    ``lengths``; nonzero ``start_pos`` = prefix-cache hit, those tokens are
+    already resident in the cache).
+
+    Returns (last_logits [B, V] fp32, all_logits [B, T, V] fp32 or None,
+    kv'). ``return_all_logits`` (static) gates the full-prompt lm_head: at
+    serving shapes a [B, T, V] fp32 tensor is gigabytes of HBM and a T×
+    larger matmul, so by default only the last valid hidden state per
+    sequence hits the head — all_logits exists for prompt-logprob requests.
+    """
+    k_pages, v_pages = kv
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))     # [B, T, D]
+    positions = start_pos[:, None] + jnp.arange(tokens.shape[1],
+                                                dtype=jnp.int32)[None, :]
+    kv_lengths = start_pos + lengths                             # [B]
+
+    def layer(x, xs):
+        lp, kp, vp = xs
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, cfg, h)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        kp, vp = write_prefill_kv(kp, vp, k, v, page_table, start_pos,
+                                  lengths)
+        # Attend against the cache so prefix-cache hits see their history;
+        # the gather covers only the pages this batch's table references.
+        k_all = gather_pages(kp, page_table)
+        v_all = gather_pages(vp, page_table)
+        attn = mha_prefill(q, k_all, v_all, kv_lengths, start_pos)
+        B, T = tokens.shape
+        x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
+        h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, cfg, h)
+        return x, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer, x, (params["layers"], k_pages, v_pages))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_x = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
+    last_logits = (last_x @ head).astype(jnp.float32)            # [B, V]
+    all_logits = (x @ head).astype(jnp.float32) if return_all_logits else None
+    return last_logits, all_logits, (k_pages, v_pages)
+
+
+# ---------------------------------------------------------------------------
+# Decode
+# ---------------------------------------------------------------------------
+
+def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                   positions: jnp.ndarray, active: jnp.ndarray,
+                   kv: KVCache, page_table: jnp.ndarray,
+                   ) -> Tuple[jnp.ndarray, KVCache]:
+    """One decode step for ``tokens`` [B] at ``positions`` [B]
+    (``active`` [B] bool masks empty batch slots). Returns
+    (logits [B, V] fp32, kv')."""
+    k_pages, v_pages = kv
+    x = params["embed"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))  # [B,1,D]
+    context_lens = jnp.where(active, positions + 1, 0)
+
+    def layer(x, xs):
+        lp, kp, vp = xs
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, cfg, h)                               # [B,1,H,Dh]
+        pos2 = positions[:, None]
+        q = apply_rope(q, pos2, cfg.rope_theta)
+        k = apply_rope(k, pos2, cfg.rope_theta)
+        kp, vp = write_decode_kv(kp, vp, k[:, 0], v[:, 0], page_table,
+                                 positions, active)
+        attn = paged_decode_attention(q[:, 0], kp, vp, page_table,
+                                      context_lens)              # [B,Hq,Dh]
+        B = tokens.shape[0]
+        x = x + (attn.reshape(B, 1, -1) @ lp["o_proj"])
+        h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, cfg, h)
+        return x, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer, x, (params["layers"], k_pages, v_pages))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (x[:, 0] @ head).astype(jnp.float32)                # [B, V]
+    return logits, (k_pages, v_pages)

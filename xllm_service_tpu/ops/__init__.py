@@ -1,0 +1,33 @@
+"""TPU-native compute ops: norms, rotary embeddings, paged attention, sampling.
+
+These are the building blocks of the worker engine's forward pass — the part
+of the stack the reference (`czynb666/xllm-service`) delegates to the
+out-of-repo NPU engine (SURVEY.md §2.3). Everything here is pure-functional
+JAX, static-shaped, and jit-friendly; the Pallas kernels in
+``xllm_service_tpu.ops.pallas`` provide TPU-optimized versions of the hot
+paths with these as reference/fallback implementations.
+"""
+
+from xllm_service_tpu.ops.norm import rms_norm
+from xllm_service_tpu.ops.rope import apply_rope, rope_cos_sin
+from xllm_service_tpu.ops.attention import (
+    mha_prefill,
+    paged_decode_attention,
+    gather_pages,
+    write_prefill_kv,
+    write_decode_kv,
+)
+from xllm_service_tpu.ops.sampling import sample_tokens, greedy
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_cos_sin",
+    "mha_prefill",
+    "paged_decode_attention",
+    "gather_pages",
+    "write_prefill_kv",
+    "write_decode_kv",
+    "sample_tokens",
+    "greedy",
+]

@@ -1,0 +1,162 @@
+"""Attention over a paged KV cache — the core of the worker engine.
+
+The KV cache is a pool of fixed-size pages per layer:
+``k_pages, v_pages : [num_pages, page_size, num_kv_heads, head_dim]``.
+A sequence owns an ordered list of page ids (its *page table*), so HBM is
+allocated in page_size-token granules with no per-sequence max-length
+reservation — the TPU-native equivalent of the engine-side paged KV cache the
+reference assumes (SURVEY.md §5.7; block_size flag global_gflags.cpp:87-89).
+
+Page id 0 is the NULL page: writes targeting it are dropped and reads from it
+are masked out. The allocator (engine/kv_cache.py) never hands out page 0.
+
+All functions are static-shaped and jit-safe. GQA is expressed by grouping
+query heads over KV heads ([B, Hkv, G, D]) so the einsums keep the MXU busy
+without materializing repeated KV. Softmax runs in float32 on the VPU.
+
+These are the XLA reference implementations; ``ops/pallas/`` holds the fused
+TPU kernels that replace the gather-then-attend pattern on the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+NULL_PAGE = 0
+_NEG_INF = -1e30
+
+
+def _flat_kv_index(page_table: jnp.ndarray, positions: jnp.ndarray,
+                   page_size: int, num_slots: int,
+                   valid: jnp.ndarray) -> jnp.ndarray:
+    """Map logical token ``positions`` [B, T] to flat slot indices into the
+    pool viewed as [num_pages * page_size, ...]. Invalid tokens map to
+    ``num_slots`` — a *positive* out-of-bounds sentinel that
+    scatter-with-mode=drop discards. (-1 would NOT work: JAX normalizes
+    negative indices before the bounds check, so -1 silently aliases the
+    last slot of the pool.)"""
+    page_idx = positions // page_size                      # [B, T]
+    slot = positions % page_size
+    page_id = jnp.take_along_axis(page_table, page_idx, axis=1)  # [B, T]
+    flat = page_id * page_size + slot
+    flat = jnp.where(valid & (page_id != NULL_PAGE), flat, num_slots)
+    return flat
+
+
+def write_prefill_kv(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                     k: jnp.ndarray, v: jnp.ndarray,
+                     page_table: jnp.ndarray, start_pos: jnp.ndarray,
+                     lengths: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter freshly-computed prefill K/V [B, T, Hkv, D] into the page pool.
+
+    Token t of sequence b lands at logical position ``start_pos[b] + t`` (a
+    nonzero start_pos is a prefix-cache hit: the first start_pos tokens were
+    already resident). Tokens with ``t >= lengths[b]`` (padding) are dropped.
+    """
+    B, T = k.shape[0], k.shape[1]
+    page_size = k_pages.shape[1]
+    num_slots = k_pages.shape[0] * page_size
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]            # [1, T]
+    positions = start_pos[:, None] + t                      # [B, T]
+    valid = t < lengths[:, None]
+    flat = _flat_kv_index(page_table, positions, page_size, num_slots,
+                          valid)                            # [B, T]
+
+    pool_shape = (-1,) + k_pages.shape[2:]
+    k_flat = k_pages.reshape(pool_shape)
+    v_flat = v_pages.reshape(pool_shape)
+    idx = flat.reshape(-1)
+    k_flat = k_flat.at[idx].set(k.reshape((B * T,) + k.shape[2:]), mode="drop")
+    v_flat = v_flat.at[idx].set(v.reshape((B * T,) + v.shape[2:]), mode="drop")
+    return k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape)
+
+
+def write_decode_kv(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                    k: jnp.ndarray, v: jnp.ndarray,
+                    page_table: jnp.ndarray,
+                    positions: jnp.ndarray,
+                    active: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter one decode-step K/V [B, Hkv, D] at per-sequence ``positions``
+    [B]. Inactive batch slots are dropped."""
+    page_size = k_pages.shape[1]
+    num_slots = k_pages.shape[0] * page_size
+    flat = _flat_kv_index(page_table, positions[:, None], page_size,
+                          num_slots, active[:, None])[:, 0]  # [B]
+    pool_shape = (-1,) + k_pages.shape[2:]
+    k_flat = k_pages.reshape(pool_shape).at[flat].set(k, mode="drop")
+    v_flat = v_pages.reshape(pool_shape).at[flat].set(v, mode="drop")
+    return k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape)
+
+
+def gather_pages(pages: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
+    """Gather a sequence's pages into [B, max_pages * page_size, Hkv, D]."""
+    g = pages[page_table]                                   # [B, MP, page, H, D]
+    B, MP, PS = g.shape[0], g.shape[1], g.shape[2]
+    return g.reshape(B, MP * PS, *g.shape[3:])
+
+
+def _group_heads(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
+    """[..., Hq, D] → [..., Hkv, G, D]."""
+    *lead, hq, d = q.shape
+    return q.reshape(*lead, num_kv_heads, hq // num_kv_heads, d)
+
+
+def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                kv_lengths: jnp.ndarray, q_start: jnp.ndarray,
+                logits_soft_cap: float = 0.0) -> jnp.ndarray:
+    """Causal GQA attention for prefill.
+
+    q: [B, T, Hq, D] — the new tokens, at global positions q_start[b] + t.
+    k/v: [B, S, Hkv, D] with S >= T — cached prefix (prefix-cache hit)
+      concatenated with the fresh tokens; kv position j is global position j.
+    kv_lengths: [B] — valid kv length per sequence (= q_start + true T).
+    Returns [B, T, Hq, D].
+    """
+    B, T, Hq, D = q.shape
+    Hkv = k.shape[2]
+    S = k.shape[1]
+    qg = _group_heads(q, Hkv)                               # [B, T, Hkv, G, D]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    logits = jnp.einsum("bthgd,bshd->bhgts", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if logits_soft_cap > 0.0:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+    q_pos = q_start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+    kv_pos = jnp.arange(S, dtype=jnp.int32)[None, :]                    # [1, S]
+    causal = kv_pos[:, None, :] <= q_pos[:, :, None]                    # [B, T, S]
+    in_range = kv_pos < kv_lengths[:, None]                             # [B, S]
+    mask = causal & in_range[:, None, :]                                # [B, T, S]
+    logits = jnp.where(mask[:, None, None, :, :], logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgts,bshd->bthgd", p.astype(v.dtype), v)
+    return out.reshape(B, T, Hq, D)
+
+
+def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
+                           v_pages: jnp.ndarray, page_table: jnp.ndarray,
+                           context_lens: jnp.ndarray,
+                           logits_soft_cap: float = 0.0) -> jnp.ndarray:
+    """Single-token GQA attention against the paged cache (XLA reference path).
+
+    q: [B, Hq, D]; page_table: [B, max_pages]; context_lens: [B] (number of
+    valid kv tokens, including the token written this step). Returns [B, Hq, D].
+    """
+    B, Hq, D = q.shape
+    k = gather_pages(k_pages, page_table)                   # [B, S, Hkv, D]
+    v = gather_pages(v_pages, page_table)
+    Hkv = k.shape[2]
+    qg = _group_heads(q, Hkv)                               # [B, Hkv, G, D]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    logits = jnp.einsum("bhgd,bshd->bhgs", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if logits_soft_cap > 0.0:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+    S = k.shape[1]
+    mask = jnp.arange(S, dtype=jnp.int32)[None, :] < context_lens[:, None]
+    logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgs,bshd->bhgd", p.astype(v.dtype), v)
+    return out.reshape(B, Hq, D)

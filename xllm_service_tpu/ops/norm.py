@@ -1,0 +1,15 @@
+"""RMSNorm. Accumulates in float32 regardless of activation dtype — on TPU the
+VPU does the reduction in fp32 and XLA fuses the normalize+scale into the
+surrounding matmul's epilogue, so there is no reason to ever norm in bf16."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,35 @@
+"""Rotary position embeddings (Llama/Qwen "neox" half-rotation layout).
+
+Cos/sin tables are computed on the fly from integer positions rather than
+precomputed-and-gathered: a handful of VPU transcendentals fuses into the
+attention prologue under XLA, while a [max_len, dim] table gather costs HBM
+bandwidth — the scarcer resource on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float,
+                 dtype=jnp.float32):
+    """cos/sin for integer ``positions`` (any shape), returned with a trailing
+    ``head_dim/2`` axis, always in float32 for accuracy at long context."""
+    half = head_dim // 2
+    freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freq  # [..., half]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotate ``x`` of shape [..., seq, heads, head_dim] by per-token
+    ``positions`` of shape [..., seq]. Half-rotation (GPT-NeoX/Llama) layout:
+    the first half of head_dim pairs with the second half."""
+    head_dim = x.shape[-1]
+    cos, sin = rope_cos_sin(positions, head_dim, theta)  # [..., seq, half]
+    cos = cos[..., None, :]  # broadcast over heads: [..., seq, 1, half]
+    sin = sin[..., None, :]
+    x1 = x[..., : head_dim // 2].astype(jnp.float32)
+    x2 = x[..., head_dim // 2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

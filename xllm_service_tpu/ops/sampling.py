@@ -1,0 +1,82 @@
+"""Token sampling: greedy, temperature, top-k, top-p — batched and jit-safe.
+
+Per-sequence sampling parameters arrive as dense arrays (one scalar per batch
+slot) so a single compiled program serves every request mix; there is no
+per-request recompilation. ``temperature == 0`` selects greedy via
+``jnp.where``, not Python control flow.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+class SamplingTensors(NamedTuple):
+    """Per-slot sampling state, shape [B] each."""
+
+    temperature: jnp.ndarray   # float32; 0.0 → greedy
+    top_p: jnp.ndarray         # float32 in (0, 1]
+    top_k: jnp.ndarray         # int32; 0 → disabled
+
+    @classmethod
+    def for_batch(cls, params_list) -> "SamplingTensors":
+        import numpy as np
+        return cls(
+            temperature=jnp.asarray(
+                np.array([p.temperature for p in params_list], np.float32)),
+            top_p=jnp.asarray(np.array([p.top_p for p in params_list],
+                                       np.float32)),
+            top_k=jnp.asarray(np.array([p.top_k for p in params_list],
+                                       np.int32)),
+        )
+
+
+def greedy(logits: jnp.ndarray) -> jnp.ndarray:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _apply_top_k(logits: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
+    """Mask all but the top-k logits per row. top_k==0 disables. Uses a full
+    sort — vocab-sized sorts are cheap on TPU relative to the lm_head matmul."""
+    vocab = logits.shape[-1]
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]          # desc
+    k = jnp.where(top_k > 0, top_k, vocab)
+    kth = jnp.take_along_axis(
+        sorted_logits, jnp.clip(k[:, None] - 1, 0, vocab - 1), axis=-1)
+    return jnp.where(logits >= kth, logits, _NEG_INF)
+
+
+def _apply_top_p(logits: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of the sorted distribution
+    with cumulative probability >= top_p (the kept set always includes the
+    top token)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
+    cumulative = jnp.cumsum(sorted_probs, axis=-1)
+    # Threshold probability: smallest kept prob mass row-wise.
+    keep_sorted = (cumulative - sorted_probs) < top_p[:, None]
+    min_kept = jnp.min(jnp.where(keep_sorted, sorted_probs, 2.0), axis=-1)
+    return jnp.where(probs >= min_kept[:, None], logits, _NEG_INF)
+
+
+def sample_tokens(logits: jnp.ndarray, tensors: SamplingTensors,
+                  key: jax.Array) -> jnp.ndarray:
+    """Sample one token per row of ``logits`` [B, V] → int32 [B]."""
+    greedy_tok = greedy(logits)
+    temp = jnp.maximum(tensors.temperature, 1e-6)[:, None]
+    scaled = logits.astype(jnp.float32) / temp
+    scaled = _apply_top_k(scaled, tensors.top_k)
+    scaled = _apply_top_p(scaled, tensors.top_p)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(tensors.temperature <= 0.0, greedy_tok, sampled)
+
+
+def compute_logprobs(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Log-prob of each chosen token: [B, V], [B] → [B] float32."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
