@@ -1,5 +1,7 @@
 """Model-level consistency tests on tiny configs (CPU, fp32)."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -13,7 +15,7 @@ from xllm_service_tpu.models import (
 
 def _cfg(**kw):
     kw.setdefault("dtype", "float32")  # fp32 on CPU for tight comparisons
-    return ModelConfig(**{**ModelConfig.tiny().__dict__, **kw})
+    return dataclasses.replace(ModelConfig.tiny(), **kw)
 
 
 @pytest.fixture(scope="module")
@@ -109,9 +111,7 @@ def test_padded_batch_independence(tiny):
 
 
 def test_qwen_bias_and_tied_embeddings():
-    cfg = ModelConfig(**{**ModelConfig.tiny().__dict__,
-                         "attention_bias": True,
-                         "tie_word_embeddings": True, "dtype": "float32"})
+    cfg = _cfg(attention_bias=True, tie_word_embeddings=True)
     params = init_params(cfg, jax.random.PRNGKey(3))
     assert "lm_head" not in params and "q_bias" in params["layers"]
     kv = init_kv_cache(cfg, 8, 4, jnp.float32)
@@ -125,9 +125,8 @@ def test_qwen_bias_and_tied_embeddings():
 def test_moe_single_expert_equals_dense():
     """With 1 expert and top-1 routing the MoE layer is exactly a dense MLP
     (router weight softmaxes to 1.0)."""
-    base = ModelConfig(**{**ModelConfig.tiny().__dict__, "dtype": "float32"})
-    moe = ModelConfig(**{**ModelConfig.tiny().__dict__, "dtype": "float32",
-                         "num_experts": 1, "num_experts_per_tok": 1})
+    base = _cfg()
+    moe = _cfg(num_experts=1, num_experts_per_tok=1)
     pd = init_params(base, jax.random.PRNGKey(4))
     pm = init_params(moe, jax.random.PRNGKey(4))
     # Share every weight; expert 0 of the MoE = the dense MLP.
@@ -152,8 +151,7 @@ def test_moe_single_expert_equals_dense():
 
 
 def test_moe_topk_runs_finite():
-    cfg = ModelConfig(**{**ModelConfig.tiny().__dict__, "dtype": "float32",
-                         "num_experts": 4, "num_experts_per_tok": 2})
+    cfg = _cfg(num_experts=4, num_experts_per_tok=2)
     params = init_params(cfg, jax.random.PRNGKey(5))
     kv = init_kv_cache(cfg, 4, 4, jnp.float32)
     last, _, kv = forward_prefill(

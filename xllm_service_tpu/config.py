@@ -80,10 +80,14 @@ class ServiceOptions:
         return d
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class ModelConfig:
     """Architecture config covering Llama-2/3, Qwen2(.5), TinyLlama, and the
-    MoE (Mixtral-style) variant used for expert parallelism."""
+    MoE (Mixtral-style) variant used for expert parallelism.
+
+    Frozen (hashable) so it can be a static jit argument — one compiled
+    program per architecture. Derive variants with ``dataclasses.replace``.
+    """
 
     name: str = "llama"
     vocab_size: int = 32000
@@ -105,7 +109,8 @@ class ModelConfig:
 
     def __post_init__(self) -> None:
         if self.head_dim is None:
-            self.head_dim = self.hidden_size // self.num_heads
+            object.__setattr__(self, "head_dim",
+                               self.hidden_size // self.num_heads)
 
     @property
     def is_moe(self) -> bool:

@@ -40,9 +40,14 @@ def _flat_kv_index(page_table: jnp.ndarray, positions: jnp.ndarray,
     last slot of the pool.)"""
     page_idx = positions // page_size                      # [B, T]
     slot = positions % page_size
-    page_id = jnp.take_along_axis(page_table, page_idx, axis=1)  # [B, T]
+    # A position past the table's capacity must be dropped, not clamped —
+    # take_along_axis would otherwise silently alias the last table entry.
+    in_table = page_idx < page_table.shape[1]
+    page_id = jnp.take_along_axis(
+        page_table, jnp.minimum(page_idx, page_table.shape[1] - 1), axis=1)
     flat = page_id * page_size + slot
-    flat = jnp.where(valid & (page_id != NULL_PAGE), flat, num_slots)
+    flat = jnp.where(valid & in_table & (page_id != NULL_PAGE), flat,
+                     num_slots)
     return flat
 
 

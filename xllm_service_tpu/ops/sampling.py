@@ -40,28 +40,31 @@ def greedy(logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
-def _apply_top_k(logits: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
-    """Mask all but the top-k logits per row. top_k==0 disables. Uses a full
-    sort — vocab-sized sorts are cheap on TPU relative to the lm_head matmul."""
+def _apply_top_k_top_p(logits: jnp.ndarray, top_k: jnp.ndarray,
+                       top_p: jnp.ndarray) -> jnp.ndarray:
+    """Joint top-k + nucleus filtering from ONE descending sort of the
+    logits (sorts over a 152k vocab are the dominant sampling-filter cost;
+    softmax of the already-sorted values is monotone-equivalent to softmax of
+    the originals, so both thresholds fall out of the same sorted array).
+
+    top_k == 0 disables top-k; the nucleus set always keeps the top token.
+    """
     vocab = logits.shape[-1]
     sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]          # desc
+    # Top-k threshold: the kth largest logit.
     k = jnp.where(top_k > 0, top_k, vocab)
     kth = jnp.take_along_axis(
         sorted_logits, jnp.clip(k[:, None] - 1, 0, vocab - 1), axis=-1)
-    return jnp.where(logits >= kth, logits, _NEG_INF)
-
-
-def _apply_top_p(logits: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
-    """Nucleus filtering: keep the smallest prefix of the sorted distribution
-    with cumulative probability >= top_p (the kept set always includes the
-    top token)."""
-    probs = jax.nn.softmax(logits, axis=-1)
-    sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
+    # Nucleus: keep ranks whose *exclusive* cumulative mass is below top_p,
+    # then convert the boundary rank back to a logit threshold (softmax is
+    # monotone in logit, so prob-space and logit-space cuts are identical).
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
     cumulative = jnp.cumsum(sorted_probs, axis=-1)
-    # Threshold probability: smallest kept prob mass row-wise.
-    keep_sorted = (cumulative - sorted_probs) < top_p[:, None]
-    min_kept = jnp.min(jnp.where(keep_sorted, sorted_probs, 2.0), axis=-1)
-    return jnp.where(probs >= min_kept[:, None], logits, _NEG_INF)
+    num_keep = jnp.sum(cumulative - sorted_probs < top_p[:, None], axis=-1)
+    nucleus_kth = jnp.take_along_axis(
+        sorted_logits, jnp.clip(num_keep[:, None] - 1, 0, vocab - 1), axis=-1)
+    return jnp.where(logits >= jnp.maximum(kth, nucleus_kth), logits,
+                     _NEG_INF)
 
 
 def sample_tokens(logits: jnp.ndarray, tensors: SamplingTensors,
@@ -70,8 +73,7 @@ def sample_tokens(logits: jnp.ndarray, tensors: SamplingTensors,
     greedy_tok = greedy(logits)
     temp = jnp.maximum(tensors.temperature, 1e-6)[:, None]
     scaled = logits.astype(jnp.float32) / temp
-    scaled = _apply_top_k(scaled, tensors.top_k)
-    scaled = _apply_top_p(scaled, tensors.top_p)
+    scaled = _apply_top_k_top_p(scaled, tensors.top_k, tensors.top_p)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(tensors.temperature <= 0.0, greedy_tok, sampled)
 

@@ -1,0 +1,24 @@
+"""Parallelism layer: device meshes, sharding rules, and SPMD collectives.
+
+The reference repo contains no parallelism implementation at all — it only
+carries ``dp_size``/cluster-id metadata for the out-of-repo engine
+(SURVEY.md §2.3). This package is the TPU-native data plane it assumes:
+
+- ``mesh.py`` — one ``jax.sharding.Mesh`` per worker instance with axes
+  ``(dp, ep, sp, tp)``; tp innermost so tensor-parallel collectives ride the
+  fastest ICI links.
+- ``sharding.py`` — ``PartitionSpec`` rules for every parameter/KV-cache
+  leaf; GSPMD inserts the all-reduce/all-gather/reduce-scatter collectives
+  from these annotations alone (no hand-written NCCL-style calls — the
+  pjit/XLA analogue of the reference stack's engine-side comm backend).
+- ``ring.py`` — ring attention over the ``sp`` axis (shard_map + ppermute)
+  for long-context prefill, where sequence length exceeds one chip's HBM.
+"""
+
+from xllm_service_tpu.parallel.mesh import MeshSpec, make_mesh
+from xllm_service_tpu.parallel.sharding import (
+    param_pspecs, kv_cache_pspec, shard_params, shard_kv_cache)
+from xllm_service_tpu.parallel.ring import ring_attention
+
+__all__ = ["MeshSpec", "make_mesh", "param_pspecs", "kv_cache_pspec",
+           "shard_params", "shard_kv_cache", "ring_attention"]

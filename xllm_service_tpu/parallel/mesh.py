@@ -1,0 +1,54 @@
+"""Device mesh construction for a worker instance.
+
+Axis order is (dp, ep, sp, tp) with tp fastest-varying: JAX assigns the last
+mesh axis to adjacent devices, so tensor-parallel all-reduces — the
+per-layer, latency-critical collectives — stay on nearest-neighbor ICI
+links, while dp/ep/sp collectives (per-step or per-block) span longer hops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_EP = "ep"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+MESH_AXES = (AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Parallel degrees of one worker instance's mesh."""
+
+    dp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.ep * self.sp * self.tp
+
+    @classmethod
+    def for_devices(cls, n: int, tp: Optional[int] = None) -> "MeshSpec":
+        """Default spec: all devices to tensor parallelism (the right default
+        for single-host serving of a dense model)."""
+        return cls(tp=tp or n)
+
+
+def make_mesh(spec: MeshSpec,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    if spec.num_devices > len(devices):
+        raise ValueError(
+            f"mesh {spec} needs {spec.num_devices} devices, have "
+            f"{len(devices)}")
+    grid = np.asarray(devices[: spec.num_devices]).reshape(
+        spec.dp, spec.ep, spec.sp, spec.tp)
+    return Mesh(grid, MESH_AXES)
