@@ -1,0 +1,238 @@
+"""Engine-level tests: allocator, prefix cache, continuous batching,
+online-over-offline preemption — all on CPU with a tiny model."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from xllm_service_tpu.config import EngineConfig, ModelConfig
+from xllm_service_tpu.models import (
+    init_params, init_kv_cache, forward_prefill, forward_decode)
+from xllm_service_tpu.ops.sampling import greedy
+from xllm_service_tpu.runtime.kv_cache import PageAllocator, PrefixCacheIndex
+from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+from xllm_service_tpu.utils.types import FinishReason, SamplingParams
+
+
+# ---------------------------------------------------------------------------
+# Allocator + prefix index
+# ---------------------------------------------------------------------------
+
+def test_page_allocator_basics():
+    a = PageAllocator(8)
+    assert a.num_free == 7          # page 0 reserved
+    p = a.alloc(3)
+    assert len(p) == 3 and 0 not in p
+    assert a.alloc(5) is None       # only 4 left
+    a.free(p)
+    assert a.num_free == 7
+    with pytest.raises(ValueError):
+        a.free([0])
+
+
+def test_prefix_cache_match_register_reclaim():
+    a = PageAllocator(8)
+    idx = PrefixCacheIndex(a, page_size=4)
+    toks = list(range(12))
+    pages = idx.alloc(3)
+    idx.register_full_pages(toks, pages)
+    ev = idx.drain_event()
+    assert len(ev.stored) == 3
+
+    # Full-prompt match is trimmed so at least one token is recomputed.
+    m, n = idx.match_prefix(toks)
+    assert n == 8 and m == pages[:2]
+    idx.release_pages(m)
+
+    # Longest-prefix semantics: diverging tokens stop the walk.
+    m2, n2 = idx.match_prefix(toks[:8] + [99, 98, 97, 96])
+    assert n2 == 8
+    idx.release_pages(m2)
+
+    # Release makes pages reclaimable (not free) until pressure demands.
+    idx.release_pages(pages)
+    assert a.num_free == 4
+    big = idx.alloc(6)               # forces reclamation of 2 LRU pages
+    assert big is not None and len(big) == 6
+    ev = idx.drain_event()
+    assert len(ev.removed) == 2
+
+
+def _tiny_engine(**eng_kw) -> Engine:
+    cfg = dataclasses.replace(ModelConfig.tiny(), dtype="float32")
+    defaults = dict(page_size=4, num_pages=32, max_model_len=64,
+                    max_batch_size=4, max_prefill_tokens=64,
+                    prefill_buckets=(8, 16, 32, 64))
+    defaults.update(eng_kw)
+    return Engine(cfg, EngineConfig(**defaults), seed=0)
+
+
+def _collect(engine, max_steps=200):
+    """Drive the engine until idle; return {request_id: (tokens, reason)}."""
+    done = {}
+    toks = {}
+    for _ in range(max_steps):
+        if not engine.has_work():
+            break
+        for out in engine.step():
+            toks.setdefault(out.request_id, []).extend(out.new_token_ids)
+            if out.finished:
+                done[out.request_id] = out.finish_reason
+    assert not engine.has_work(), "engine did not drain"
+    return toks, done
+
+
+# ---------------------------------------------------------------------------
+# Generation correctness
+# ---------------------------------------------------------------------------
+
+def test_engine_greedy_matches_direct_model_loop():
+    """The batched, paged, continuously-scheduled engine must produce exactly
+    the tokens a naive prefill+decode loop produces."""
+    eng = _tiny_engine()
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    eng.add_request(EngineRequest(
+        request_id="r1", token_ids=list(prompt),
+        sampling=SamplingParams(max_tokens=10, temperature=0.0)))
+    toks, done = _collect(eng)
+    assert done["r1"] == FinishReason.LENGTH
+    got = toks["r1"]
+    assert len(got) == 10
+
+    # Direct loop with the same params.
+    cfg = eng.cfg
+    kv = init_kv_cache(cfg, 32, 4, jnp.float32)
+    pt = jnp.asarray([np.arange(1, 17)], jnp.int32)
+    last, _, kv = forward_prefill(
+        eng.params, cfg, jnp.asarray([prompt], jnp.int32),
+        jnp.zeros(1, jnp.int32), jnp.asarray([len(prompt)], jnp.int32),
+        kv, pt)
+    ref = [int(greedy(last)[0])]
+    pos = len(prompt)
+    for _ in range(9):
+        logits, kv = forward_decode(
+            eng.params, cfg, jnp.asarray(ref[-1:], jnp.int32),
+            jnp.asarray([pos], jnp.int32), jnp.asarray([True]), kv, pt)
+        ref.append(int(greedy(logits)[0]))
+        pos += 1
+    assert got == ref
+
+
+def test_engine_batched_matches_solo():
+    """Concurrent requests must not perturb each other's greedy outputs."""
+    prompts = [[1, 2, 3], [7, 7, 7, 7, 7], [9, 8, 7, 6]]
+    solo_results = []
+    for i, p in enumerate(prompts):
+        eng = _tiny_engine()
+        eng.add_request(EngineRequest(
+            request_id=f"s{i}", token_ids=list(p),
+            sampling=SamplingParams(max_tokens=6, temperature=0.0)))
+        toks, _ = _collect(eng)
+        solo_results.append(toks[f"s{i}"])
+
+    eng = _tiny_engine()
+    for i, p in enumerate(prompts):
+        eng.add_request(EngineRequest(
+            request_id=f"b{i}", token_ids=list(p),
+            sampling=SamplingParams(max_tokens=6, temperature=0.0)))
+    toks, _ = _collect(eng)
+    for i in range(len(prompts)):
+        assert toks[f"b{i}"] == solo_results[i], f"request {i} diverged"
+
+
+def test_engine_eos_stops():
+    eng = _tiny_engine()
+    # Discover the greedy first token, then use it as the EOS id.
+    eng.add_request(EngineRequest(
+        request_id="probe", token_ids=[5, 5, 5],
+        sampling=SamplingParams(max_tokens=1, temperature=0.0)))
+    toks, _ = _collect(eng)
+    eos = toks["probe"][0]
+    eng.add_request(EngineRequest(
+        request_id="r", token_ids=[5, 5, 5],
+        sampling=SamplingParams(max_tokens=10, temperature=0.0),
+        eos_token_ids=(eos,)))
+    toks, done = _collect(eng)
+    assert done["r"] == FinishReason.STOP
+    assert toks["r"] == [eos]
+
+
+def test_engine_prefix_cache_reuse():
+    eng = _tiny_engine()
+    prompt = list(range(1, 13))           # 12 tokens = 3 full pages
+    eng.add_request(EngineRequest(
+        request_id="a", token_ids=list(prompt),
+        sampling=SamplingParams(max_tokens=4, temperature=0.0)))
+    toks_a, _ = _collect(eng)
+    eng.add_request(EngineRequest(
+        request_id="b", token_ids=list(prompt),
+        sampling=SamplingParams(max_tokens=4, temperature=0.0)))
+    toks_b, _ = _collect(eng)
+    assert toks_b["b"] == toks_a["a"]     # identical despite cached prefill
+    # The second request must have hit the cache (8 tokens = 2 pages; the
+    # third page is excluded by the never-full-prompt rule... prompt is 12
+    # tokens so blocks 0,1,2 are cached; trimming keeps 2).
+    # Engine metrics expose the hit via num_preemptions==0 and event flow.
+    assert eng.prefix_cache.num_cached_pages >= 3
+
+
+def test_online_preempts_offline():
+    """With pages for only ~1 long sequence, an online arrival must preempt
+    the running offline one and still complete; the offline request finishes
+    afterwards via recompute."""
+    eng = _tiny_engine(num_pages=9, max_model_len=32,
+                       prefill_buckets=(8, 16, 32))
+    eng.ecfg.enable_prefix_cache = False
+    eng.prefix_cache.enable = False
+    eng.add_request(EngineRequest(
+        request_id="off", token_ids=[2] * 8, offline=True,
+        sampling=SamplingParams(max_tokens=20, temperature=0.0)))
+    # Let the offline request start and generate a few tokens.
+    early = []
+    for _ in range(5):
+        early.extend(eng.step())
+    eng.add_request(EngineRequest(
+        request_id="on", token_ids=[3] * 16,
+        sampling=SamplingParams(max_tokens=8, temperature=0.0)))
+    toks, done = _collect(eng, max_steps=400)
+    # Prepend the tokens emitted during the manual warm-start steps.
+    pre = {}
+    for out in early:
+        pre.setdefault(out.request_id, []).extend(out.new_token_ids)
+    for rid, t in pre.items():
+        toks[rid] = t + toks.get(rid, [])
+    assert done["on"] == FinishReason.LENGTH
+    assert done["off"] == FinishReason.LENGTH
+    assert len(toks["on"]) == 8 and len(toks["off"]) == 20
+    assert eng.num_preemptions >= 1
+
+
+def test_cancel_request():
+    eng = _tiny_engine()
+    eng.add_request(EngineRequest(
+        request_id="c", token_ids=[1, 2, 3],
+        sampling=SamplingParams(max_tokens=30, temperature=0.0)))
+    eng.step()                        # prefill + first token
+    eng.cancel("c")
+    toks, done = _collect(eng)
+    assert done["c"] == FinishReason.CANCELLED
+    # All pages returned.
+    assert eng.allocator.num_free + eng.prefix_cache.num_cached_pages == \
+        eng.ecfg.num_pages - 1
+
+
+def test_load_metrics_and_events():
+    eng = _tiny_engine()
+    eng.add_request(EngineRequest(
+        request_id="m", token_ids=[4, 5, 6, 7, 8, 9, 10, 11],
+        sampling=SamplingParams(max_tokens=6, temperature=0.0)))
+    eng.step()
+    lm = eng.load_metrics()
+    assert lm["running_requests"] == 1 and 0 < lm["kv_cache_usage"] <= 1
+    _collect(eng)
+    ev = eng.drain_kvcache_event()
+    assert len(ev.stored) >= 2        # full pages registered while finishing

@@ -1,0 +1,22 @@
+"""Worker-engine runtime: the TPU inference engine the reference assumes.
+
+The reference repo is only the service/orchestration tier — its engine
+(model execution, KV cache, batching) lives out-of-repo on NPUs
+(SURVEY.md §2 intro). This package is that engine, built TPU-first:
+
+- ``kv_cache.py`` — host-side page allocator + chained-hash prefix cache
+  index (block granularity == page size, hashes bit-compatible with the
+  service's ``GlobalKVCacheMgr`` index).
+- ``engine.py`` — continuous-batching loop: bucketed prefill, fixed-slot
+  decode, online-over-offline preemption, per-step sampling; one compiled
+  XLA program per (bucket, batch) shape.
+- ``worker.py`` — the process wrapper: HTTP endpoints the service routes to
+  (OpenAI surface + control verbs /sleep /wakeup /fork_master), etcd
+  registration, heartbeats, profiling mode.
+"""
+
+from xllm_service_tpu.runtime.kv_cache import PageAllocator, PrefixCacheIndex
+from xllm_service_tpu.runtime.engine import Engine, EngineRequest, StepOutput
+
+__all__ = ["PageAllocator", "PrefixCacheIndex", "Engine", "EngineRequest",
+           "StepOutput"]

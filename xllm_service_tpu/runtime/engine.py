@@ -1,0 +1,537 @@
+"""Continuous-batching inference engine over the paged KV cache.
+
+One ``Engine`` drives one model on one mesh (a worker instance). The step
+loop interleaves bucketed prefill with fixed-slot decode — the in-worker
+scheduler the reference delegates to its out-of-repo NPU engine
+(SURVEY.md §7.3 item 2). TPU-first design decisions:
+
+- **Static shapes everywhere**: prefill pads to a bucket from
+  ``EngineConfig.prefill_buckets`` and a power-of-two batch; decode always
+  runs the full ``max_batch_size`` slot array with an active mask. The
+  whole serving life of the engine touches a handful of XLA programs, all
+  compiled (and cached) up front by ``warmup()``.
+- **Sampling inside the compiled step**: logits never leave HBM; each step
+  transfers only the sampled token ids (a few bytes) host-ward.
+- **Donated KV buffers**: the cache pytree is donated through every step,
+  so XLA updates pages in place — no pool-sized copies.
+- **Online-over-offline preemption**: offline (batch-tier) sequences are
+  admitted only when online work is absent, and are preempted (pages freed,
+  recompute-on-readmit) when online work needs pages or slots — this
+  *implements* the hybrid scheduling the reference's README claims but its
+  code never reads (``offline`` flag, request/request.h:38, SURVEY.md §2
+  #17).
+- **Prefix cache**: chained-hash full-page reuse (kv_cache.py), consistent
+  with the service's cluster-wide index.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import enum
+import functools
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xllm_service_tpu.config import EngineConfig, ModelConfig
+from xllm_service_tpu.models import transformer
+from xllm_service_tpu.ops.sampling import (
+    SamplingTensors, compute_logprobs, sample_tokens)
+from xllm_service_tpu.runtime.kv_cache import (
+    KvCacheEvent, PageAllocator, PrefixCacheIndex)
+from xllm_service_tpu.utils.types import FinishReason, SamplingParams
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class EngineRequest:
+    """What the service forwards to a worker (already tokenized upstream —
+    the rewritten request body carries token_ids, reference
+    http_service/service.cpp:457-463)."""
+
+    request_id: str
+    token_ids: List[int]
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    offline: bool = False
+    priority: int = 0
+    eos_token_ids: Tuple[int, ...] = ()
+    arrival_time: float = 0.0
+
+
+class SeqStatus(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclasses.dataclass
+class Sequence:
+    req: EngineRequest
+    tokens: List[int]                  # prompt + generated
+    pages: List[int] = dataclasses.field(default_factory=list)
+    num_computed: int = 0              # tokens with KV resident
+    num_cached_tokens: int = 0         # prefix-cache hit size (metrics)
+    slot: int = -1                     # decode batch slot, -1 = none
+    status: SeqStatus = SeqStatus.WAITING
+    first_token_time: float = 0.0
+    preemptions: int = 0
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.req.token_ids)
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.tokens) - self.num_prompt_tokens
+
+
+@dataclasses.dataclass
+class StepOutput:
+    """Per-request delta produced by one engine step."""
+
+    request_id: str
+    new_token_ids: List[int]
+    logprobs: List[float]
+    finish_reason: FinishReason = FinishReason.NONE
+    num_prompt_tokens: int = 0
+    num_generated: int = 0
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason != FinishReason.NONE
+
+
+class Engine:
+    """Single-model continuous-batching engine. Not thread-safe: drive
+    ``step()`` from one loop thread (worker.py owns that thread)."""
+
+    def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
+                 params: Optional[Dict[str, Any]] = None,
+                 mesh=None, seed: int = 0,
+                 murmur_seed: int = 0) -> None:
+        self.cfg = model_cfg
+        self.ecfg = engine_cfg
+        self.mesh = mesh
+        self._rng_key = jax.random.PRNGKey(seed)
+        dtype = jnp.dtype(model_cfg.dtype)
+
+        if params is None:
+            params = transformer.init_params(model_cfg, jax.random.PRNGKey(0))
+        self.kv = transformer.init_kv_cache(
+            model_cfg, engine_cfg.num_pages, engine_cfg.page_size, dtype)
+        if mesh is not None:
+            from xllm_service_tpu.parallel.sharding import (
+                shard_kv_cache, shard_params)
+            params = shard_params(params, mesh, model_cfg)
+            self.kv = shard_kv_cache(self.kv, mesh, model_cfg)
+        self.params = params
+
+        self.allocator = PageAllocator(engine_cfg.num_pages)
+        self.prefix_cache = PrefixCacheIndex(
+            self.allocator, engine_cfg.page_size, seed=murmur_seed,
+            enable=engine_cfg.enable_prefix_cache)
+
+        self.waiting: List[Sequence] = []
+        self.running: List[Sequence] = []
+        self._by_id: Dict[str, Sequence] = {}
+        self._slots: List[Optional[Sequence]] = \
+            [None] * engine_cfg.max_batch_size
+        self._cancelled: set = set()
+
+        # Decode-slot host mirrors (numpy, copied to device each step).
+        B, MP = engine_cfg.max_batch_size, engine_cfg.max_pages_per_seq
+        self._slot_last_token = np.zeros(B, np.int32)
+        self._slot_pos = np.zeros(B, np.int32)
+        self._slot_pt = np.zeros((B, MP), np.int32)
+        # Per-slot sampling params change only on admit/finish; the device
+        # tensors are rebuilt lazily instead of per decode step.
+        self._slot_sampling: List[SamplingParams] = [SamplingParams()] * B
+        self._slot_st: Optional[SamplingTensors] = None
+
+        self._jit_prefill = jax.jit(
+            functools.partial(_prefill_step, cfg=model_cfg),
+            donate_argnums=(4,))
+        self._jit_decode = jax.jit(
+            functools.partial(_decode_step, cfg=model_cfg),
+            donate_argnums=(4,))
+
+        self.step_count = 0
+        self.num_preemptions = 0
+
+    # ------------------------------------------------------------------
+    # Request intake
+    # ------------------------------------------------------------------
+    def add_request(self, req: EngineRequest) -> None:
+        if not req.token_ids:
+            raise ValueError("empty prompt")
+        max_prompt = min(self.ecfg.max_model_len - 1,
+                         self.ecfg.prefill_buckets[-1])
+        if len(req.token_ids) > max_prompt:
+            raise ValueError(
+                f"prompt of {len(req.token_ids)} tokens exceeds the "
+                f"engine's limit of {max_prompt}")
+        if len(req.token_ids) + req.sampling.max_tokens > \
+                self.ecfg.max_model_len:
+            req = dataclasses.replace(
+                req, sampling=dataclasses.replace(
+                    req.sampling,
+                    max_tokens=max(
+                        1, self.ecfg.max_model_len - len(req.token_ids))))
+        if req.arrival_time == 0.0:
+            req.arrival_time = time.monotonic()
+        seq = Sequence(req=req, tokens=list(req.token_ids))
+        self._by_id[req.request_id] = seq
+        self.waiting.append(seq)
+        self._sort_waiting()
+
+    def cancel(self, request_id: str) -> None:
+        self._cancelled.add(request_id)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def _sort_waiting(self) -> None:
+        # Online before offline, then priority, then arrival.
+        self.waiting.sort(key=lambda s: (
+            s.req.offline, -s.req.priority, s.req.arrival_time))
+
+    # ------------------------------------------------------------------
+    # Scheduling
+    # ------------------------------------------------------------------
+    def _free_slot(self) -> int:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return -1
+
+    def _pages_needed(self, num_tokens: int) -> int:
+        ps = self.ecfg.page_size
+        return (num_tokens + ps - 1) // ps
+
+    def _preempt_one_offline(self) -> bool:
+        """Evict the most recently arrived running offline sequence."""
+        victims = [s for s in self.running if s.req.offline]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda s: s.req.arrival_time)
+        self._preempt_seq(victim)
+        logger.info("preempted offline request %s", victim.req.request_id)
+        return True
+
+    def _try_admit(self, seq: Sequence) -> bool:
+        """Reserve a slot + pages (with prefix-cache match) for ``seq``.
+
+        Pages cover only the tokens prefilled now plus the first generated
+        token; decode grows the table page-by-page (``_grow_pages``) — true
+        paged allocation, no max-length reservation."""
+        slot = self._free_slot()
+        if slot < 0:
+            return False
+        cached_pages, cached_tokens = \
+            self.prefix_cache.match_prefix(seq.req.token_ids)
+        need = self._pages_needed(len(seq.tokens) + 1) - len(cached_pages)
+        new_pages = self.prefix_cache.alloc(max(need, 0))
+        while new_pages is None and not seq.req.offline and \
+                self._preempt_one_offline():
+            new_pages = self.prefix_cache.alloc(max(need, 0))
+        if new_pages is None:
+            self.prefix_cache.release_pages(cached_pages)
+            return False
+        seq.pages = list(cached_pages) + new_pages
+        seq.num_computed = cached_tokens
+        seq.num_cached_tokens = cached_tokens
+        seq.slot = slot
+        self._slots[slot] = seq
+        self._slot_sampling[slot] = seq.req.sampling
+        self._slot_st = None
+        return True
+
+    def _preempt_seq(self, seq: Sequence) -> None:
+        """Recompute-style preemption: free pages, requeue (generated
+        tokens are kept and re-prefilled on readmission)."""
+        self._release_seq_slot(seq)
+        self.prefix_cache.register_full_pages(
+            seq.tokens[:seq.num_computed], seq.pages)
+        self.prefix_cache.release_pages(seq.pages)
+        seq.pages = []
+        seq.num_computed = 0
+        seq.status = SeqStatus.WAITING
+        seq.preemptions += 1
+        self.num_preemptions += 1
+        if seq in self.running:
+            self.running.remove(seq)
+        self.waiting.append(seq)
+        self._sort_waiting()
+
+    def _grow_pages(self, seq: Sequence) -> bool:
+        """Ensure ``seq`` has a page for its next token write. On exhaustion
+        preempt offline victims, else preempt ``seq`` itself. Returns False
+        if the sequence was preempted."""
+        need = self._pages_needed(len(seq.tokens)) - len(seq.pages)
+        if need <= 0:
+            return True
+        pages = self.prefix_cache.alloc(need)
+        while pages is None:
+            victims = [s for s in self.running
+                       if s.req.offline and s is not seq]
+            if victims and not seq.req.offline:
+                victim = max(victims, key=lambda s: s.req.arrival_time)
+                self._preempt_seq(victim)
+            else:
+                self._preempt_seq(seq)
+                return False
+            pages = self.prefix_cache.alloc(need)
+        seq.pages.extend(pages)
+        self._sync_slot(seq)
+        return True
+
+    def _release_seq_slot(self, seq: Sequence) -> None:
+        if seq.slot >= 0:
+            self._slots[seq.slot] = None
+            seq.slot = -1
+
+    def _finish_seq(self, seq: Sequence, reason: FinishReason) -> None:
+        seq.status = SeqStatus.FINISHED
+        self._release_seq_slot(seq)
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq in self.waiting:
+            self.waiting.remove(seq)
+        # Make full pages reusable by future prompts, then drop ownership.
+        # Only tokens[:num_computed] have KV resident — the final sampled
+        # token was never fed, so its slot must not be content-addressed.
+        self.prefix_cache.register_full_pages(
+            seq.tokens[:seq.num_computed], seq.pages)
+        self.prefix_cache.release_pages(seq.pages)
+        seq.pages = []
+        self._by_id.pop(seq.req.request_id, None)
+        self._cancelled.discard(seq.req.request_id)
+
+    # ------------------------------------------------------------------
+    # Step
+    # ------------------------------------------------------------------
+    def step(self) -> List[StepOutput]:
+        """Run one engine iteration (one prefill batch OR one decode step)."""
+        self.step_count += 1
+        outs = self._drain_cancelled()
+        batch = self._schedule_prefill()
+        if batch:
+            outs.extend(self._run_prefill(batch))
+        elif self.running:
+            outs.extend(self._run_decode())
+        return outs
+
+    def _drain_cancelled(self) -> List[StepOutput]:
+        outs = []
+        for rid in list(self._cancelled):
+            seq = self._by_id.get(rid)
+            if seq is None:
+                self._cancelled.discard(rid)
+                continue
+            self._finish_seq(seq, FinishReason.CANCELLED)
+            outs.append(StepOutput(
+                request_id=rid, new_token_ids=[], logprobs=[],
+                finish_reason=FinishReason.CANCELLED,
+                num_prompt_tokens=seq.num_prompt_tokens,
+                num_generated=seq.num_generated))
+        return outs
+
+    def _schedule_prefill(self) -> List[Sequence]:
+        """Admit waiting sequences up to the prefill token budget."""
+        batch: List[Sequence] = []
+        budget = self.ecfg.max_prefill_tokens
+        for seq in list(self.waiting):
+            new_tokens = len(seq.tokens)  # recompute-all on readmit
+            if batch and new_tokens > budget:
+                break
+            if not self._try_admit(seq):
+                break
+            budget -= len(seq.tokens) - seq.num_computed
+            self.waiting.remove(seq)
+            batch.append(seq)
+            if budget <= 0 or len(batch) >= self.ecfg.max_batch_size:
+                break
+        return batch
+
+    def _bucket(self, n: int) -> int:
+        buckets = self.ecfg.prefill_buckets
+        i = bisect.bisect_left(buckets, n)
+        if i >= len(buckets):
+            raise ValueError(
+                f"prefill of {n} tokens exceeds largest bucket {buckets[-1]}")
+        return buckets[i]
+
+    def _run_prefill(self, batch: List[Sequence]) -> List[StepOutput]:
+        B = 1 << (len(batch) - 1).bit_length()          # pow2 batch bucket
+        T = self._bucket(max(len(s.tokens) - s.num_computed for s in batch))
+        MP = self.ecfg.max_pages_per_seq
+        toks = np.zeros((B, T), np.int32)
+        start = np.zeros(B, np.int32)
+        lens = np.zeros(B, np.int32)
+        pt = np.zeros((B, MP), np.int32)
+        for i, seq in enumerate(batch):
+            new = seq.tokens[seq.num_computed:]
+            toks[i, :len(new)] = new
+            start[i] = seq.num_computed
+            lens[i] = len(new)
+            pt[i, :len(seq.pages)] = seq.pages
+        st = self._sampling_tensors(
+            [s.req.sampling for s in batch], B)
+        self._rng_key, key = jax.random.split(self._rng_key)
+        next_tok, logprob, self.kv = self._jit_prefill(
+            self.params, jnp.asarray(toks), jnp.asarray(start),
+            jnp.asarray(lens), self.kv, jnp.asarray(pt), st, key)
+        next_tok = np.asarray(next_tok)
+        logprob = np.asarray(logprob)
+
+        now = time.monotonic()
+        outs: List[StepOutput] = []
+        for i, seq in enumerate(batch):
+            seq.status = SeqStatus.RUNNING
+            seq.num_computed = len(seq.tokens)
+            seq.first_token_time = now
+            self.running.append(seq)
+            tok = int(next_tok[i])
+            outs.append(self._append_token(seq, tok, float(logprob[i])))
+            self._sync_slot(seq)
+        return outs
+
+    def _run_decode(self) -> List[StepOutput]:
+        B = self.ecfg.max_batch_size
+        active = np.zeros(B, bool)
+        for seq in self.running:
+            i = seq.slot
+            active[i] = True
+            self._slot_last_token[i] = seq.tokens[-1]
+            self._slot_pos[i] = len(seq.tokens) - 1
+        if self._slot_st is None:
+            self._slot_st = SamplingTensors.for_batch(self._slot_sampling)
+        st = self._slot_st
+        self._rng_key, key = jax.random.split(self._rng_key)
+        next_tok, logprob, self.kv = self._jit_decode(
+            self.params, jnp.asarray(self._slot_last_token),
+            jnp.asarray(self._slot_pos), jnp.asarray(active), self.kv,
+            jnp.asarray(self._slot_pt), st, key)
+        next_tok = np.asarray(next_tok)
+        logprob = np.asarray(logprob)
+        outs: List[StepOutput] = []
+        # Snapshot (seq, slot) first: _append_token may preempt a *later*
+        # sequence in this list (page-growth pressure), clearing its slot
+        # before we read its sampled token.
+        for seq, i in [(s, s.slot) for s in self.running]:
+            if seq.status == SeqStatus.RUNNING:
+                seq.num_computed = len(seq.tokens)
+            # A sequence preempted earlier in this loop still gets its token
+            # (sampled while its KV was resident); it re-prefills later.
+            outs.append(self._append_token(
+                seq, int(next_tok[i]), float(logprob[i])))
+        return outs
+
+    def _append_token(self, seq: Sequence, tok: int,
+                      logprob: float) -> StepOutput:
+        seq.tokens.append(tok)
+        reason = self._finish_reason(seq, tok)
+        out = StepOutput(
+            request_id=seq.req.request_id, new_token_ids=[tok],
+            logprobs=[logprob], finish_reason=reason,
+            num_prompt_tokens=seq.num_prompt_tokens,
+            num_generated=seq.num_generated)
+        if reason != FinishReason.NONE:
+            self._finish_seq(seq, reason)
+        elif seq.status == SeqStatus.RUNNING:
+            # As the sequence crosses page boundaries its pages fill up;
+            # register them so other prompts can reuse the prefix (only
+            # computed tokens — the one just sampled has no KV yet), and
+            # grow the table for the next token's KV write (may preempt).
+            self.prefix_cache.register_full_pages(
+                seq.tokens[:seq.num_computed], seq.pages)
+            self._grow_pages(seq)
+        return out
+
+    def _finish_reason(self, seq: Sequence, tok: int) -> FinishReason:
+        sp = seq.req.sampling
+        if not sp.ignore_eos and (tok in seq.req.eos_token_ids or
+                                  tok in sp.stop_token_ids):
+            return FinishReason.STOP
+        if seq.num_generated >= sp.max_tokens:
+            return FinishReason.LENGTH
+        if len(seq.tokens) >= self.ecfg.max_model_len:
+            return FinishReason.LENGTH
+        return FinishReason.NONE
+
+    def _sync_slot(self, seq: Sequence) -> None:
+        if seq.slot < 0:
+            return
+        i = seq.slot
+        self._slot_pt[i] = 0
+        self._slot_pt[i, :len(seq.pages)] = seq.pages
+
+    @staticmethod
+    def _sampling_tensors(params: Sequence[SamplingParams],
+                          B: int) -> SamplingTensors:
+        padded = list(params) + [SamplingParams()] * (B - len(params))
+        return SamplingTensors.for_batch(padded)
+
+    # ------------------------------------------------------------------
+    # Warmup / metrics
+    # ------------------------------------------------------------------
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> float:
+        """Pre-compile the decode program and each prefill bucket at B=1.
+        Returns seconds spent."""
+        t0 = time.monotonic()
+        for T in (buckets or self.ecfg.prefill_buckets):
+            # A prompt needs room for two generated tokens (so the decode
+            # program compiles too) within max_model_len.
+            n = min(T, self.ecfg.max_model_len - 2)
+            if n <= 0:
+                continue
+            req = EngineRequest(
+                request_id=f"__warmup_{T}", token_ids=[1] * n,
+                sampling=SamplingParams(max_tokens=2), eos_token_ids=())
+            self.add_request(req)
+            while self.has_work():
+                self.step()
+        return time.monotonic() - t0
+
+    def load_metrics(self) -> Dict[str, Any]:
+        """The LoadMetrics the reference ships in heartbeats
+        (common/types.h:81-115): queue depth + cache usage."""
+        used = (self.ecfg.num_pages - 1 - self.allocator.num_free
+                - self.prefix_cache.num_reclaimable)
+        return {
+            "waiting_requests": len(self.waiting),
+            "running_requests": len(self.running),
+            "kv_cache_usage": used / max(self.ecfg.num_pages - 1, 1),
+            "num_preemptions": self.num_preemptions,
+        }
+
+    def drain_kvcache_event(self) -> KvCacheEvent:
+        return self.prefix_cache.drain_event()
+
+
+# ---------------------------------------------------------------------------
+# Compiled step bodies (sampling fused in; only token ids leave the device)
+# ---------------------------------------------------------------------------
+
+def _prefill_step(params, tokens, start_pos, lengths, kv, page_table,
+                  st: SamplingTensors, key, *, cfg: ModelConfig):
+    last_logits, _, kv = transformer.forward_prefill(
+        params, cfg, tokens, start_pos, lengths, kv, page_table)
+    tok = sample_tokens(last_logits, st, key)
+    lp = compute_logprobs(last_logits, tok)
+    return tok, lp, kv
+
+
+def _decode_step(params, tokens, positions, active, kv, page_table,
+                 st: SamplingTensors, key, *, cfg: ModelConfig):
+    logits, kv = transformer.forward_decode(
+        params, cfg, tokens, positions, active, kv, page_table)
+    tok = sample_tokens(logits, st, key)
+    lp = compute_logprobs(logits, tok)
+    return tok, lp, kv

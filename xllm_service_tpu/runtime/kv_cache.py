@@ -1,0 +1,209 @@
+"""Host-side paged KV cache bookkeeping: allocator + prefix-cache index.
+
+The device arrays live in ``models.init_kv_cache``; this module owns which
+page holds what. Pages are the unit of both HBM allocation and prefix
+caching: a *full* page of ``page_size`` tokens is content-addressed by the
+chained MurmurHash3 digest of its tokens (``utils.hashing``), the same
+digest scheme the service's cluster-wide ``GlobalKVCacheMgr`` keys on
+(reference: common/hash_util.cpp:16-42, global_kvcache_mgr.cpp:71-129) — so
+a worker's local prefix hits and the cluster's cache-aware routing agree
+bit-for-bit on block identity.
+
+Page id 0 is reserved as the NULL page (ops/attention.py) and never
+allocated. Freed cache-registered pages are not zeroed: they stay in an LRU
+pool and are only reclaimed when allocation pressure demands, giving
+cross-request prefix reuse for free.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from xllm_service_tpu.utils.hashing import prefix_block_hashes
+
+
+@dataclasses.dataclass
+class KvCacheEvent:
+    """Delta of the worker's prefix-cache content, shipped in heartbeats to
+    the service's global index (reference: xllm_rpc_service.proto KvCacheEvent
+    — stored/removed block digests)."""
+
+    stored: List[bytes] = dataclasses.field(default_factory=list)
+    removed: List[bytes] = dataclasses.field(default_factory=list)
+
+    def merge(self, other: "KvCacheEvent") -> None:
+        self.stored.extend(other.stored)
+        self.removed.extend(other.removed)
+
+    @property
+    def empty(self) -> bool:
+        return not (self.stored or self.removed)
+
+
+class PageAllocator:
+    """Free-list page allocator over ids [1, num_pages)."""
+
+    def __init__(self, num_pages: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is NULL)")
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if not 0 < p < self.num_pages:
+                raise ValueError(f"bad page id {p}")
+            self._free.append(p)
+
+
+class PrefixCacheIndex:
+    """Content-addressed index of *full* pages + LRU reclamation.
+
+    Lifecycle of a page:
+      allocated → (sequence fills it) → registered under its chained hash,
+      refcount tracks sharing → when every owner releases it, it becomes
+      *reclaimable* (still mapped, tokens still in HBM) → reused on a later
+      prefix hit, or reclaimed LRU-first under allocation pressure.
+    """
+
+    def __init__(self, allocator: PageAllocator, page_size: int,
+                 seed: int = 0, enable: bool = True) -> None:
+        self.allocator = allocator
+        self.page_size = page_size
+        self.seed = seed
+        self.enable = enable
+        self._by_hash: Dict[bytes, int] = {}
+        self._hash_of: Dict[int, bytes] = {}
+        self._ref: Dict[int, int] = collections.defaultdict(int)
+        # page id → last-release time; insertion order ~ LRU.
+        self._reclaimable: "collections.OrderedDict[int, float]" = \
+            collections.OrderedDict()
+        self._pending_event = KvCacheEvent()
+
+    # -- hashing ----------------------------------------------------------
+    def block_hashes(self, tokens: Sequence[int]) -> List[bytes]:
+        return prefix_block_hashes(tokens, self.page_size, self.seed)
+
+    # -- lookup -----------------------------------------------------------
+    def match_prefix(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of ``tokens`` in full-page units.
+
+        Returns (pages, num_cached_tokens); the pages are ref-counted for
+        the caller and must be released via ``release_pages``."""
+        if not self.enable:
+            return [], 0
+        pages: List[int] = []
+        for h in self.block_hashes(tokens):
+            pid = self._by_hash.get(h)
+            if pid is None:
+                break
+            pages.append(pid)
+        # Never hand out the *entire* prompt from cache: the last token must
+        # be recomputed so prefill has at least one new token to produce
+        # logits from.
+        while pages and len(pages) * self.page_size >= len(tokens):
+            pages = pages[:-1]
+        for pid in pages:
+            self._acquire(pid)
+        return pages, len(pages) * self.page_size
+
+    # -- registration -----------------------------------------------------
+    def register_full_pages(self, tokens: Sequence[int],
+                            pages: Sequence[int]) -> None:
+        """Register every full page of a sequence under its chained hash.
+        ``pages[i]`` holds tokens [i*ps, (i+1)*ps). Safe to call repeatedly
+        as a sequence grows."""
+        if not self.enable:
+            return
+        hashes = self.block_hashes(tokens)
+        for i, h in enumerate(hashes):
+            if i >= len(pages):
+                break
+            pid = pages[i]
+            if self._hash_of.get(pid) == h:
+                continue
+            if h in self._by_hash:
+                continue  # another sequence already owns this content
+            self._evict_mapping(pid)
+            self._by_hash[h] = pid
+            self._hash_of[pid] = h
+            self._pending_event.stored.append(h)
+
+    # -- refcounting ------------------------------------------------------
+    def _acquire(self, pid: int) -> None:
+        self._ref[pid] += 1
+        self._reclaimable.pop(pid, None)
+
+    def acquire_pages(self, pages: Sequence[int]) -> None:
+        for pid in pages:
+            self._acquire(pid)
+
+    def release_pages(self, pages: Sequence[int]) -> None:
+        """Owner is done with these pages. Registered pages become
+        reclaimable (content kept); unregistered ones go straight back to
+        the allocator."""
+        now = time.monotonic()
+        for pid in pages:
+            self._ref[pid] -= 1
+            if self._ref[pid] > 0:
+                continue
+            del self._ref[pid]
+            if pid in self._hash_of:
+                self._reclaimable[pid] = now
+                self._reclaimable.move_to_end(pid)
+            else:
+                self.allocator.free([pid])
+
+    # -- allocation under pressure ---------------------------------------
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` pages, reclaiming LRU cached pages if needed."""
+        need = n - self.allocator.num_free
+        while need > 0 and self._reclaimable:
+            pid, _ = self._reclaimable.popitem(last=False)
+            self._evict_mapping(pid)
+            self.allocator.free([pid])
+            need -= 1
+        pages = self.allocator.alloc(n)
+        if pages is not None:
+            for pid in pages:
+                self._acquire(pid)
+        return pages
+
+    def _evict_mapping(self, pid: int) -> None:
+        h = self._hash_of.pop(pid, None)
+        if h is not None:
+            self._by_hash.pop(h, None)
+            self._pending_event.removed.append(h)
+
+    # -- heartbeat plumbing ----------------------------------------------
+    def drain_event(self) -> KvCacheEvent:
+        ev = self._pending_event
+        self._pending_event = KvCacheEvent()
+        return ev
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def num_cached_pages(self) -> int:
+        return len(self._by_hash)
+
+    @property
+    def num_reclaimable(self) -> int:
+        """Pages holding cached content but instantly reclaimable (no live
+        owner) — effectively-free capacity for load reporting."""
+        return len(self._reclaimable)
+
+    def cached_hashes(self) -> Set[bytes]:
+        return set(self._by_hash)
