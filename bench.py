@@ -1,0 +1,89 @@
+"""Headline benchmark: continuous-batching decode throughput on one chip.
+
+Runs the flagship model (Llama-3.2-1B shapes, random weights) through the
+real serving engine — paged KV cache, fused sampling, donated buffers — and
+measures steady-state decode throughput and per-token latency (TPOT).
+
+The reference publishes no benchmark numbers (BASELINE.md); its implicit
+performance envelope is the SLO default ``target_tpot`` = 50 ms/token
+(reference common/global_gflags.cpp:100-102). ``vs_baseline`` is therefore
+measured-TPOT headroom against that 50 ms SLO: value N means each token
+arrives N× faster than the reference's own default target.
+
+Prints exactly one JSON line:
+  {"metric": "decode_throughput", "value": ..., "unit": "tokens/s",
+   "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    from xllm_service_tpu.config import EngineConfig, ModelConfig
+    from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+    from xllm_service_tpu.utils.types import SamplingParams
+
+    platform = jax.devices()[0].platform
+    tiny = bool(os.environ.get("BENCH_TINY")) or platform == "cpu"
+    if tiny:
+        cfg = ModelConfig.tiny(vocab_size=1024)
+        batch, prompt_len, gen_len, pages = 4, 32, 64, 64
+        ecfg = EngineConfig(page_size=16, num_pages=pages,
+                            max_model_len=256, max_batch_size=batch,
+                            max_prefill_tokens=256,
+                            prefill_buckets=(32, 64))
+    else:
+        cfg = ModelConfig.llama3_1b()
+        batch, prompt_len, gen_len = 8, 128, 256
+        ecfg = EngineConfig(page_size=64, num_pages=512,
+                            max_model_len=1024, max_batch_size=batch,
+                            max_prefill_tokens=2048,
+                            prefill_buckets=(128,))
+
+    engine = Engine(cfg, ecfg, seed=0)
+    engine.warmup()
+
+    sp = SamplingParams(max_tokens=gen_len, temperature=0.0, ignore_eos=True)
+    for i in range(batch):
+        engine.add_request(EngineRequest(
+            request_id=f"bench-{i}",
+            token_ids=list(range(1, prompt_len + 1)),
+            sampling=sp))
+    # Prefill outside the timed window: the metric is steady-state decode.
+    while engine.waiting:
+        engine.step()
+
+    t0 = time.monotonic()
+    tokens = 0
+    while engine.has_work():
+        for out in engine.step():
+            tokens += len(out.new_token_ids)
+    elapsed = time.monotonic() - t0
+
+    throughput = tokens / elapsed
+    steps = tokens / batch
+    tpot_ms = 1000.0 * elapsed / max(steps, 1)
+    print(json.dumps({
+        "metric": "decode_throughput",
+        "value": round(throughput, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(50.0 / tpot_ms, 3),
+        "detail": {
+            "model": cfg.name, "platform": platform, "batch": batch,
+            "prompt_len": prompt_len, "gen_len": gen_len,
+            "tpot_ms": round(tpot_ms, 3),
+            "reference_baseline": "target_tpot=50ms SLO default "
+                                  "(no published numbers)",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

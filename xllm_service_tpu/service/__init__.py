@@ -1,0 +1,3 @@
+"""Service/orchestration layer: the TPU-native rebuild of the reference's
+cluster front door (master, HTTP/RPC services, scheduler, managers, LB
+policies, coordination plane — reference layers A-D, SURVEY.md §1)."""

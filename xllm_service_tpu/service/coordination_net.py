@@ -1,0 +1,214 @@
+"""Networked coordination plane: ``InMemoryStore`` served over HTTP.
+
+Deployment equivalent of the reference's external etcd cluster: one process
+runs ``StoreServer`` (or any process embeds it — e.g. the first service
+replica), every other service replica / worker host connects a
+``RemoteStore``, which implements the same ``CoordinationStore`` interface.
+Watches are long-polls on the store's revision counter, so remote watchers
+see the same PUT/DELETE event stream in order.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from xllm_service_tpu.service.coordination import (
+    CoordinationStore, InMemoryStore, WatchCallback)
+from xllm_service_tpu.service.httpd import (
+    HttpServer, Request, Response, Router, http_json)
+
+
+class StoreServer:
+    """HTTP facade over an ``InMemoryStore``."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 store: Optional[InMemoryStore] = None) -> None:
+        self.store = store or InMemoryStore()
+        router = Router()
+        router.route("POST", "/kv/put", self._put)
+        router.route("GET", "/kv/get", self._get)
+        router.route("GET", "/kv/prefix", self._prefix)
+        router.route("POST", "/kv/delete", self._delete)
+        router.route("POST", "/kv/delete_prefix", self._delete_prefix)
+        router.route("POST", "/lease/grant", self._grant)
+        router.route("POST", "/lease/keepalive", self._keepalive)
+        router.route("POST", "/lease/revoke", self._revoke)
+        router.route("POST", "/txn/compare_create", self._compare_create)
+        router.route("GET", "/watch", self._watch)
+        self._srv = HttpServer(host, port, router)
+
+    @property
+    def address(self) -> str:
+        return self._srv.address
+
+    def start(self) -> "StoreServer":
+        self._srv.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.stop()
+        self.store.close()
+
+    # -- handlers ---------------------------------------------------------
+    def _put(self, req: Request) -> Response:
+        d = req.json()
+        try:
+            self.store.put(d["key"], d["value"], d.get("lease_id"))
+        except KeyError as e:
+            return Response.json({"ok": False, "error": str(e)}, status=400)
+        return Response.json({"ok": True})
+
+    def _get(self, req: Request) -> Response:
+        v = self.store.get(req.param("key"))
+        return Response.json({"value": v})
+
+    def _prefix(self, req: Request) -> Response:
+        return Response.json({"kvs": self.store.get_prefix(
+            req.param("prefix"))})
+
+    def _delete(self, req: Request) -> Response:
+        return Response.json(
+            {"deleted": self.store.delete(req.json()["key"])})
+
+    def _delete_prefix(self, req: Request) -> Response:
+        return Response.json(
+            {"count": self.store.delete_prefix(req.json()["prefix"])})
+
+    def _grant(self, req: Request) -> Response:
+        return Response.json(
+            {"lease_id": self.store.lease_grant(req.json()["ttl_s"])})
+
+    def _keepalive(self, req: Request) -> Response:
+        return Response.json(
+            {"ok": self.store.lease_keepalive(req.json()["lease_id"])})
+
+    def _revoke(self, req: Request) -> Response:
+        self.store.lease_revoke(req.json()["lease_id"])
+        return Response.json({"ok": True})
+
+    def _compare_create(self, req: Request) -> Response:
+        d = req.json()
+        created = self.store.compare_create(d["key"], d["value"],
+                                            d.get("lease_id"))
+        return Response.json({"created": created})
+
+    def _watch(self, req: Request) -> Response:
+        rev = int(req.param("rev", "0"))
+        timeout = min(float(req.param("timeout", "10")), 30.0)
+        new_rev, events = self.store.events_since(
+            rev, req.param("prefix"), timeout)
+        return Response.json({"rev": new_rev,
+                              "events": [list(e) for e in events]})
+
+
+class RemoteStore(CoordinationStore):
+    """Client-side ``CoordinationStore`` over a ``StoreServer``."""
+
+    def __init__(self, address: str, timeout: float = 10.0) -> None:
+        self.address = address
+        self.timeout = timeout
+        self._watches: Dict[int, threading.Event] = {}
+        self._next_watch = 1
+        self._lock = threading.Lock()
+
+    def _call(self, method: str, path: str, obj=None):
+        status, resp = http_json(method, self.address, path, obj,
+                                 timeout=self.timeout)
+        if status != 200:
+            raise RuntimeError(f"store {path} -> {status}: {resp}")
+        return resp
+
+    def put(self, key: str, value: str,
+            lease_id: Optional[int] = None) -> None:
+        self._call("POST", "/kv/put",
+                   {"key": key, "value": value, "lease_id": lease_id})
+
+    def get(self, key: str) -> Optional[str]:
+        return self._call("GET", f"/kv/get?key={_q(key)}")["value"]
+
+    def get_prefix(self, prefix: str) -> Dict[str, str]:
+        return self._call("GET", f"/kv/prefix?prefix={_q(prefix)}")["kvs"]
+
+    def delete(self, key: str) -> bool:
+        return self._call("POST", "/kv/delete", {"key": key})["deleted"]
+
+    def delete_prefix(self, prefix: str) -> int:
+        return self._call("POST", "/kv/delete_prefix",
+                          {"prefix": prefix})["count"]
+
+    def lease_grant(self, ttl_s: float) -> int:
+        return self._call("POST", "/lease/grant",
+                          {"ttl_s": ttl_s})["lease_id"]
+
+    def lease_keepalive(self, lease_id: int) -> bool:
+        return self._call("POST", "/lease/keepalive",
+                          {"lease_id": lease_id})["ok"]
+
+    def lease_revoke(self, lease_id: int) -> None:
+        self._call("POST", "/lease/revoke", {"lease_id": lease_id})
+
+    def compare_create(self, key: str, value: str,
+                       lease_id: Optional[int] = None) -> bool:
+        return self._call("POST", "/txn/compare_create",
+                          {"key": key, "value": value,
+                           "lease_id": lease_id})["created"]
+
+    def add_watch(self, prefix: str, callback: WatchCallback) -> int:
+        with self._lock:
+            wid = self._next_watch
+            self._next_watch += 1
+            stop = threading.Event()
+            self._watches[wid] = stop
+        threading.Thread(target=self._watch_loop,
+                         args=(prefix, callback, stop),
+                         name=f"remote-watch-{wid}", daemon=True).start()
+        return wid
+
+    def _watch_loop(self, prefix: str, callback: WatchCallback,
+                    stop: threading.Event) -> None:
+        rev = 0
+        while not stop.is_set():
+            try:
+                status, resp = http_json(
+                    "GET", self.address,
+                    f"/watch?prefix={_q(prefix)}&rev={rev}&timeout=5",
+                    timeout=self.timeout + 10)
+                if status != 200:
+                    stop.wait(1.0)
+                    continue
+                rev = resp["rev"]
+                for ev_type, key, value in resp["events"]:
+                    if stop.is_set():
+                        return
+                    try:
+                        callback((ev_type, key, value))
+                    except Exception:  # noqa: BLE001
+                        import traceback
+                        traceback.print_exc()
+            except Exception:  # noqa: BLE001 — store restarting/unreachable
+                stop.wait(1.0)
+
+    def cancel_watch(self, watch_id: int) -> None:
+        with self._lock:
+            stop = self._watches.pop(watch_id, None)
+        if stop:
+            stop.set()
+
+    def close(self) -> None:
+        with self._lock:
+            for stop in self._watches.values():
+                stop.set()
+            self._watches.clear()
+
+
+def _q(s: str) -> str:
+    from urllib.parse import quote
+    return quote(s, safe="")
+
+
+def connect_store(addr: str) -> CoordinationStore:
+    """'' → fresh in-process store; 'host:port' → RemoteStore."""
+    if not addr:
+        return InMemoryStore()
+    return RemoteStore(addr)

@@ -1,0 +1,254 @@
+"""Minimal threaded HTTP substrate: routed server + JSON/SSE client helpers.
+
+The reference runs two brpc servers (HTTP front door + worker RPC) and brpc
+channels between processes (master.cpp:60-140, instance_mgr.cpp:523-551).
+This module is the rebuild's equivalent transport: a stdlib-only threaded
+HTTP/1.1 server with a route table and chunked/SSE streaming responses, and
+client helpers for JSON calls and progressive SSE reads (the reference's
+``ProgressiveReader``, http_service/service.cpp:113-143). All of this is
+host-side CPU code on the TPU-VM — the data plane (tokens) is tiny compared
+to the compute, so HTTP/JSON over DCN matches the reference's control-plane
+role without vendoring an RPC stack.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Tuple)
+from urllib.parse import parse_qs, urlparse
+
+
+class Request:
+    def __init__(self, method: str, path: str, query: Dict[str, List[str]],
+                 headers: Dict[str, str], body: bytes) -> None:
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+
+    def param(self, name: str, default: str = "") -> str:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def json(self) -> Any:
+        if not self.body:
+            return {}
+        return json.loads(self.body.decode("utf-8"))
+
+
+class Response:
+    """``body`` for buffered responses; ``stream`` (an iterator of byte
+    chunks) for progressive/SSE responses — chunks are flushed as produced."""
+
+    def __init__(self, status: int = 200, body: Optional[bytes] = None,
+                 content_type: str = "application/json",
+                 headers: Optional[Dict[str, str]] = None,
+                 stream: Optional[Iterable[bytes]] = None) -> None:
+        self.status = status
+        self.body = body if body is not None else b""
+        self.content_type = content_type
+        self.headers = headers or {}
+        self.stream = stream
+
+    @classmethod
+    def json(cls, obj: Any, status: int = 200) -> "Response":
+        return cls(status=status,
+                   body=json.dumps(obj).encode("utf-8"))
+
+    @classmethod
+    def error(cls, status: int, message: str,
+              err_type: str = "invalid_request_error") -> "Response":
+        """OpenAI-style error envelope."""
+        return cls.json(
+            {"error": {"message": message, "type": err_type, "code": status}},
+            status=status)
+
+    @classmethod
+    def sse(cls, chunks: Iterable[bytes]) -> "Response":
+        return cls(content_type="text/event-stream",
+                   headers={"Cache-Control": "no-cache"}, stream=chunks)
+
+
+Handler = Callable[[Request], Response]
+
+
+class Router:
+    """Exact-path and prefix routes per method."""
+
+    def __init__(self) -> None:
+        self._exact: Dict[Tuple[str, str], Handler] = {}
+        self._prefix: List[Tuple[str, str, Handler]] = []
+
+    def route(self, method: str, path: str, handler: Handler) -> None:
+        self._exact[(method.upper(), path)] = handler
+
+    def route_prefix(self, method: str, prefix: str,
+                     handler: Handler) -> None:
+        self._prefix.append((method.upper(), prefix, handler))
+
+    def dispatch(self, req: Request) -> Response:
+        h = self._exact.get((req.method, req.path))
+        if h is None:
+            for method, prefix, ph in self._prefix:
+                if req.method == method and req.path.startswith(prefix):
+                    h = ph
+                    break
+        if h is None:
+            return Response.error(404, f"no route for {req.method} {req.path}")
+        try:
+            return h(req)
+        except Exception as e:  # noqa: BLE001 — route errors become 500s
+            import traceback
+            traceback.print_exc()
+            return Response.error(500, f"internal error: {e}",
+                                  "internal_error")
+
+
+class _RequestHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    router: Router  # set by server factory
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # quiet
+        pass
+
+    def _handle(self) -> None:
+        parsed = urlparse(self.path)
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        req = Request(self.command, parsed.path, parse_qs(parsed.query),
+                      dict(self.headers.items()), body)
+        resp = self.router.dispatch(req)
+        try:
+            self._write(resp)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-stream
+
+    def _write(self, resp: Response) -> None:
+        self.send_response(resp.status)
+        self.send_header("Content-Type", resp.content_type)
+        for k, v in resp.headers.items():
+            self.send_header(k, v)
+        if resp.stream is not None:
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for chunk in resp.stream:
+                if not chunk:
+                    continue
+                self.wfile.write(f"{len(chunk):X}\r\n".encode())
+                self.wfile.write(chunk)
+                self.wfile.write(b"\r\n")
+                self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        else:
+            self.send_header("Content-Length", str(len(resp.body)))
+            self.end_headers()
+            if resp.body:
+                self.wfile.write(resp.body)
+                self.wfile.flush()
+
+    do_GET = _handle
+    do_POST = _handle
+    do_PUT = _handle
+    do_DELETE = _handle
+
+
+class HttpServer:
+    """Threaded HTTP server bound to (host, port); port 0 picks a free one."""
+
+    def __init__(self, host: str, port: int, router: Router) -> None:
+        handler = type("BoundHandler", (_RequestHandler,),
+                       {"router": router})
+        self._srv = ThreadingHTTPServer((host, port), handler)
+        self._srv.daemon_threads = True
+        self.host = host
+        self.port = self._srv.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "HttpServer":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name=f"httpd-{self.port}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Client helpers
+# ---------------------------------------------------------------------------
+
+def http_json(method: str, address: str, path: str, obj: Any = None,
+              timeout: float = 30.0,
+              headers: Optional[Dict[str, str]] = None
+              ) -> Tuple[int, Any]:
+    """One JSON request to ``address`` ("host:port"). Returns
+    (status, parsed-json-or-None)."""
+    conn = HTTPConnection(address, timeout=timeout)
+    try:
+        body = None if obj is None else json.dumps(obj).encode("utf-8")
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        conn.request(method, path, body=body, headers=hdrs)
+        resp = conn.getresponse()
+        data = resp.read()
+        parsed = json.loads(data.decode("utf-8")) if data else None
+        return resp.status, parsed
+    finally:
+        conn.close()
+
+
+def http_stream(method: str, address: str, path: str, obj: Any = None,
+                timeout: float = 600.0,
+                headers: Optional[Dict[str, str]] = None
+                ) -> Iterator[bytes]:
+    """Progressive byte-chunk reader (reference CustomProgressiveReader,
+    service.cpp:113-143): yields raw chunks as they arrive."""
+    conn = HTTPConnection(address, timeout=timeout)
+    try:
+        body = None if obj is None else json.dumps(obj).encode("utf-8")
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        conn.request(method, path, body=body, headers=hdrs)
+        resp = conn.getresponse()
+        if resp.status != 200:
+            yield resp.read()
+            return
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                return
+            yield chunk
+    finally:
+        conn.close()
+
+
+def iter_sse_events(chunks: Iterable[bytes]) -> Iterator[str]:
+    """Reassemble SSE ``data:`` payloads from a progressive byte stream."""
+    buf = b""
+    for chunk in chunks:
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            for line in event.decode("utf-8").splitlines():
+                if line.startswith("data: "):
+                    yield line[len("data: "):]
+                elif line.startswith("data:"):
+                    yield line[len("data:"):]
