@@ -1,0 +1,115 @@
+"""Coordination store: leases, txn, watches — in-memory and over HTTP."""
+
+import threading
+import time
+
+import pytest
+
+from xllm_service_tpu.service.coordination import InMemoryStore
+from xllm_service_tpu.service.coordination_net import (
+    RemoteStore, StoreServer)
+
+
+@pytest.fixture()
+def store():
+    s = InMemoryStore(sweep_interval_s=0.02)
+    yield s
+    s.close()
+
+
+class TestInMemoryStore:
+    def test_put_get_delete(self, store):
+        store.put("XLLM:PREFILL:a", "1")
+        assert store.get("XLLM:PREFILL:a") == "1"
+        assert store.get_prefix("XLLM:PREFILL:") == {"XLLM:PREFILL:a": "1"}
+        assert store.delete("XLLM:PREFILL:a")
+        assert store.get("XLLM:PREFILL:a") is None
+        assert not store.delete("XLLM:PREFILL:a")
+
+    def test_compare_create_only_first_wins(self, store):
+        assert store.compare_create("XLLM:SERVICE:MASTER", "a")
+        assert not store.compare_create("XLLM:SERVICE:MASTER", "b")
+        assert store.get("XLLM:SERVICE:MASTER") == "a"
+
+    def test_lease_expiry_deletes_and_notifies(self, store):
+        events = []
+        done = threading.Event()
+
+        def cb(ev):
+            events.append(ev)
+            done.set()
+
+        store.add_watch("XLLM:PREFILL:", cb)
+        lid = store.lease_grant(0.1)
+        store.put("XLLM:PREFILL:w1", "meta", lid)
+        done.wait(1.0)          # PUT event
+        done.clear()
+        assert store.get("XLLM:PREFILL:w1") == "meta"
+        assert done.wait(2.0)   # DELETE on expiry
+        assert store.get("XLLM:PREFILL:w1") is None
+        types = [e[0] for e in events]
+        assert "PUT" in types and "DELETE" in types
+
+    def test_keepalive_extends_lease(self, store):
+        lid = store.lease_grant(0.15)
+        store.put("k", "v", lid)
+        for _ in range(4):
+            time.sleep(0.08)
+            assert store.lease_keepalive(lid)
+        assert store.get("k") == "v"
+        store.lease_revoke(lid)
+        assert store.get("k") is None
+        assert not store.lease_keepalive(lid)
+
+    def test_watch_prefix_filtering(self, store):
+        got = []
+        store.add_watch("A:", lambda ev: got.append(ev))
+        store.put("A:1", "x")
+        store.put("B:1", "y")
+        deadline = time.monotonic() + 2.0
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.05)
+        assert [k for _, k, _ in got] == ["A:1"]
+
+    def test_events_since_long_poll(self, store):
+        rev0 = store.revision
+
+        def later():
+            time.sleep(0.1)
+            store.put("P:x", "1")
+
+        threading.Thread(target=later, daemon=True).start()
+        rev, events = store.events_since(rev0, "P:", timeout_s=2.0)
+        assert events == [("PUT", "P:x", "1")]
+        assert rev > rev0
+
+
+class TestRemoteStore:
+    def test_roundtrip_over_http(self):
+        server = StoreServer().start()
+        try:
+            client = RemoteStore(server.address)
+            client.put("XLLM:DECODE:w", "meta")
+            assert client.get("XLLM:DECODE:w") == "meta"
+            assert client.get_prefix("XLLM:DECODE:") == {
+                "XLLM:DECODE:w": "meta"}
+            assert client.compare_create("M", "me")
+            assert not client.compare_create("M", "other")
+
+            lid = client.lease_grant(0.2)
+            client.put("L", "v", lid)
+            assert client.lease_keepalive(lid)
+            client.lease_revoke(lid)
+            assert client.get("L") is None
+
+            got = []
+            evt = threading.Event()
+            client.add_watch("W:", lambda ev: (got.append(ev), evt.set()))
+            time.sleep(0.1)  # let the long-poll engage
+            client.put("W:1", "z")
+            assert evt.wait(5.0)
+            assert got[0] == ("PUT", "W:1", "z")
+            client.close()
+        finally:
+            server.stop()

@@ -1,0 +1,119 @@
+"""Tokenizer backends + chat template (reference layer E)."""
+
+import base64
+import os
+
+import pytest
+
+from xllm_service_tpu.nlp.chat_template import (
+    ChatTemplate, IMAGE_PLACEHOLDER)
+from xllm_service_tpu.nlp.tokenizer import (
+    ByteTokenizer, IncrementalDecoder, TiktokenTokenizer, TokenizerFactory)
+
+
+class TestByteTokenizer:
+    def test_roundtrip(self):
+        tok = ByteTokenizer()
+        text = "Hello, TPU! ünïcode 漢字"
+        assert tok.decode(tok.encode(text)) == text
+
+    def test_specials(self):
+        tok = ByteTokenizer(add_bos=True)
+        ids = tok.encode("a")
+        assert ids[0] == ByteTokenizer.BOS
+        assert tok.decode(ids) == "a"
+        assert tok.eos_token_ids == (ByteTokenizer.EOS,)
+
+
+class TestTiktokenTokenizer:
+    @pytest.fixture()
+    def rank_file(self, tmp_path):
+        # Byte-level ranks for ascii plus two merges.
+        lines = []
+        rank = 0
+        for b in range(256):
+            lines.append(base64.b64encode(bytes([b])).decode()
+                         + f" {rank}")
+            rank += 1
+        for merged in (b"he", b"ll"):
+            lines.append(base64.b64encode(merged).decode() + f" {rank}")
+            rank += 1
+        p = tmp_path / "test.tiktoken"
+        p.write_text("\n".join(lines))
+        return str(p)
+
+    def test_bpe_merges_and_roundtrip(self, rank_file):
+        tok = TiktokenTokenizer(rank_file)
+        ids = tok.encode("hello")
+        # "hello" → "he" + "ll" + "o" with the given merges.
+        assert len(ids) == 3
+        assert tok.decode(ids) == "hello"
+
+    def test_factory_sniffs_tiktoken(self, rank_file):
+        model_dir = os.path.dirname(rank_file)
+        TokenizerFactory.create_tokenizer.cache_clear()
+        tok = TokenizerFactory.create_tokenizer(model_dir)
+        assert isinstance(tok, TiktokenTokenizer)
+
+
+class TestIncrementalDecoder:
+    def test_multibyte_held_back(self):
+        tok = ByteTokenizer()
+        dec = IncrementalDecoder(tok)
+        ids = tok.encode("é")   # two UTF-8 bytes
+        assert dec.feed(ids[:1]) == ""       # incomplete char withheld
+        assert dec.feed(ids[1:]) == "é"
+
+    def test_stream_equals_batch(self):
+        tok = ByteTokenizer()
+        text = "naïve 漢字 test"
+        ids = tok.encode(text)
+        dec = IncrementalDecoder(tok)
+        out = "".join(dec.feed([i]) for i in ids) + dec.flush()
+        assert out == text
+
+
+class TestChatTemplate:
+    def test_default_chatml(self):
+        ct = ChatTemplate()
+        prompt, mm = ct.apply([
+            {"role": "system", "content": "Be brief."},
+            {"role": "user", "content": "Hi"},
+        ])
+        assert prompt == ("<|im_start|>system\nBe brief.<|im_end|>\n"
+                          "<|im_start|>user\nHi<|im_end|>\n"
+                          "<|im_start|>assistant\n")
+        assert mm == []
+
+    def test_custom_template_with_tools(self):
+        # Shape of the reference's golden test
+        # (jinja_chat_template_test.cpp:22-56): a template with loops and
+        # conditionals over messages, exact-string checked.
+        tpl = ("{% if tools %}TOOLS:{{ tools | length }}\n{% endif %}"
+               "{% for m in messages %}{{ m.role }}: {{ m.content }}\n"
+               "{% endfor %}")
+        ct = ChatTemplate(tpl)
+        prompt, _ = ct.apply(
+            [{"role": "user", "content": "call a tool"}],
+            tools=[{"type": "function",
+                    "function": {"name": "get_weather"}}])
+        assert prompt == "TOOLS:1\nuser: call a tool\n"
+
+    def test_multimodal_placeholder(self):
+        ct = ChatTemplate()
+        prompt, mm = ct.apply([{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "What is this? "},
+                {"type": "image_url",
+                 "image_url": {"url": "http://x/cat.png"}},
+            ]}])
+        assert IMAGE_PLACEHOLDER in prompt
+        assert mm == [{"type": "image", "data": "http://x/cat.png"}]
+
+    def test_from_model_dir(self, tmp_path):
+        (tmp_path / "chat_template.jinja").write_text(
+            "{% for m in messages %}[{{ m.content }}]{% endfor %}")
+        ct = ChatTemplate.from_model_dir(str(tmp_path))
+        prompt, _ = ct.apply([{"role": "user", "content": "x"}])
+        assert prompt == "[x]"

@@ -1,0 +1,523 @@
+"""Service-layer units: predictor, kvcache mgr, instance mgr, LB policies,
+scheduler routing, response grammar, election."""
+
+import json
+import threading
+import time
+from typing import Dict, List, Tuple
+
+import pytest
+
+from xllm_service_tpu.config import (
+    InstanceType, LoadBalancePolicyType, ServiceOptions)
+from xllm_service_tpu.service.coordination import (
+    KEY_CACHE, KEY_MASTER, InMemoryStore, instance_prefix)
+from xllm_service_tpu.service.instance_mgr import (
+    MODEL_ASLEEP, MODEL_AWAKE, InstanceMgr)
+from xllm_service_tpu.service.instance_types import (
+    Heartbeat, InstanceMetaInfo, LoadMetrics, RequestPhase)
+from xllm_service_tpu.service.kvcache_mgr import GlobalKVCacheMgr
+from xllm_service_tpu.service.lb_policy import (
+    CacheAwareRoutingPolicy, RoundRobinPolicy, SloAwarePolicy)
+from xllm_service_tpu.service.response_handler import (
+    ChatStreamAssembler, SSE_DONE)
+from xllm_service_tpu.service.scheduler import Scheduler
+from xllm_service_tpu.service.time_predictor import TimePredictor
+from xllm_service_tpu.utils.hashing import prefix_block_hashes
+from xllm_service_tpu.utils.types import (
+    FinishReason, Request, RequestOutput, SequenceOutput, Usage)
+
+
+def wait_until(cond, timeout=3.0, step=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+@pytest.fixture()
+def store():
+    s = InMemoryStore(sweep_interval_s=0.02)
+    yield s
+    s.close()
+
+
+class FakeControl:
+    """Scriptable worker control transport (no sockets)."""
+
+    def __init__(self):
+        self.calls: List[Tuple[str, str, dict]] = []
+
+    def __call__(self, address, path, body):
+        self.calls.append((address, path, body))
+        return 200, {"ok": True}
+
+
+def register_worker(store, name, itype=InstanceType.PREFILL, models=(),
+                    ttl=5.0, **meta_kw):
+    meta = InstanceMetaInfo(name=name, rpc_address=name,
+                            instance_type=itype, models=list(models),
+                            **meta_kw)
+    lid = store.lease_grant(ttl)
+    store.put_json(instance_prefix(itype.value) + name, meta.to_json(), lid)
+    return lid
+
+
+def opts_(**kw):
+    kw.setdefault("num_output_pools", 4)
+    return ServiceOptions(**kw)
+
+
+class TestTimePredictor:
+    def test_ttft_quadratic_fit(self):
+        p = TimePredictor()
+        pts = [(n, 5 + 0.1 * n + 0.001 * n * n)
+               for n in (10, 50, 100, 200, 500)]
+        assert p.fit_ttft(pts)
+        assert p.predict_ttft(300) == pytest.approx(
+            5 + 0.1 * 300 + 0.001 * 300 * 300, rel=1e-6)
+
+    def test_tpot_linear_fit(self):
+        p = TimePredictor()
+        pts = []
+        for b in (1, 2, 4, 8):
+            for t in (64, 256):
+                pts.append((b, t, 2 + 0.5 * b + 0.001 * b * (t - 1)))
+        assert p.fit_tpot(pts)
+        assert p.predict_tpot(4 * 128, 4) == pytest.approx(
+            2 + 0.5 * 4 + 0.001 * 4 * 127, rel=1e-6)
+
+    def test_unfit_returns_zero(self):
+        p = TimePredictor()
+        assert p.predict_ttft(100) == 0.0
+        assert p.predict_tpot(100, 1) == 0.0
+        assert not p.fit_ttft([(1, 1)])
+
+
+class TestGlobalKVCacheMgr:
+    def test_match_walk_and_scores(self, store):
+        mgr = GlobalKVCacheMgr(store, block_size=4)
+        tokens = list(range(16))
+        h = prefix_block_hashes(tokens, 4)
+        mgr.record_updated_kvcaches("w1", stored=h[:3])
+        mgr.record_updated_kvcaches("w2", stored=h[:1])
+        matched, scores = mgr.match(tokens)
+        assert matched == 3
+        assert scores["w1"] == pytest.approx(3.0)
+        assert scores["w2"] == pytest.approx(1.0)
+
+    def test_contiguity_hole_ends_instance_score(self, store):
+        mgr = GlobalKVCacheMgr(store, block_size=4)
+        tokens = list(range(16))
+        h = prefix_block_hashes(tokens, 4)
+        # w2 has blocks 0 and 2 but not 1 → usable prefix is 1 block.
+        mgr.record_updated_kvcaches("w1", stored=h[:3])
+        mgr.record_updated_kvcaches("w2", stored=[h[0], h[2]])
+        _, scores = mgr.match(tokens)
+        assert scores["w2"] == pytest.approx(1.0)
+
+    def test_demotion_and_removal(self, store):
+        mgr = GlobalKVCacheMgr(store, block_size=4)
+        tokens = list(range(8))
+        h = prefix_block_hashes(tokens, 4)
+        mgr.record_updated_kvcaches("w1", stored=h)
+        mgr.record_updated_kvcaches("w1", offloaded=[h[0]])
+        _, scores = mgr.match(tokens)
+        assert scores["w1"] == pytest.approx(0.7 + 1.0)  # dram + hbm
+        mgr.record_updated_kvcaches("w1", removed=h)
+        matched, _ = mgr.match(tokens)
+        assert matched == 0
+
+    def test_master_upload_and_replica_watch(self, store):
+        master = GlobalKVCacheMgr(store, block_size=4, is_master=True)
+        replica = GlobalKVCacheMgr(store, block_size=4, is_master=False)
+        tokens = list(range(8))
+        h = prefix_block_hashes(tokens, 4)
+        master.record_updated_kvcaches("w1", stored=h)
+        assert master.upload_kvcache() == 2
+        assert wait_until(lambda: replica.match(tokens)[0] == 2)
+        # Removal propagates too.
+        master.record_updated_kvcaches("w1", removed=h)
+        master.upload_kvcache()
+        assert wait_until(lambda: replica.match(tokens)[0] == 0)
+
+    def test_remove_instance_scrubs(self, store):
+        mgr = GlobalKVCacheMgr(store, block_size=4)
+        tokens = list(range(8))
+        h = prefix_block_hashes(tokens, 4)
+        mgr.record_updated_kvcaches("w1", stored=h)
+        mgr.record_updated_kvcaches("w2", stored=h[:1])
+        mgr.remove_instance("w1")
+        matched, scores = mgr.match(tokens)
+        assert matched == 1 and "w1" not in scores
+
+
+class TestInstanceMgr:
+    def test_two_phase_registration(self, store):
+        mgr = InstanceMgr(opts_(), store, control=FakeControl())
+        register_worker(store, "w1", InstanceType.PREFILL)
+        # PUT alone leaves it pending (not routable)…
+        assert wait_until(lambda: "w1" in mgr._pending)
+        assert mgr.prefill_instances() == []
+        # …first heartbeat registers it.
+        assert mgr.on_heartbeat(Heartbeat(
+            name="w1", instance_type=InstanceType.PREFILL))
+        assert mgr.prefill_instances() == ["w1"]
+        mgr.close()
+
+    def test_lease_expiry_removes(self, store):
+        mgr = InstanceMgr(opts_(), store, control=FakeControl())
+        register_worker(store, "w1", InstanceType.PREFILL, ttl=0.15)
+        assert wait_until(lambda: "w1" in mgr._pending)
+        mgr.on_heartbeat(Heartbeat(name="w1",
+                                   instance_type=InstanceType.PREFILL))
+        assert mgr.prefill_instances() == ["w1"]
+        assert wait_until(lambda: mgr.prefill_instances() == [],
+                          timeout=3.0)
+        assert mgr.get("w1") is None
+        mgr.close()
+
+    def _mgr_with_pair(self, store, control=None, opts=None):
+        mgr = InstanceMgr(opts or opts_(), store,
+                          control=control or FakeControl())
+        for name, itype in (("p1", InstanceType.PREFILL),
+                            ("p2", InstanceType.PREFILL),
+                            ("d1", InstanceType.DECODE)):
+            register_worker(store, name, itype)
+        assert wait_until(lambda: len(mgr._pending) == 3)
+        for name, itype in (("p1", InstanceType.PREFILL),
+                            ("p2", InstanceType.PREFILL),
+                            ("d1", InstanceType.DECODE)):
+            mgr.on_heartbeat(Heartbeat(name=name, instance_type=itype))
+        return mgr
+
+    def test_round_robin_pairs(self, store):
+        mgr = self._mgr_with_pair(store)
+        p_first, d = mgr.get_next_instance_pair()
+        p_second, _ = mgr.get_next_instance_pair()
+        assert {p_first, p_second} == {"p1", "p2"}
+        assert d == "d1"
+        mgr.close()
+
+    def test_mix_split_first_decodes(self, store):
+        mgr = InstanceMgr(opts_(), store, control=FakeControl())
+        for name in ("m1", "m2", "m3"):
+            register_worker(store, name, InstanceType.MIX)
+        assert wait_until(lambda: len(mgr._pending) == 3)
+        for name in ("m1", "m2", "m3"):
+            mgr.on_heartbeat(Heartbeat(name=name,
+                                       instance_type=InstanceType.MIX))
+        assert mgr.decode_instances() == ["m1"]
+        assert sorted(mgr.prefill_instances()) == ["m2", "m3"]
+        mgr.close()
+
+    def test_flips(self, store):
+        ctl = FakeControl()
+        mgr = self._mgr_with_pair(store, control=ctl)
+        assert mgr.flip_prefill_to_decode("p2")
+        assert "p2" in mgr.decode_instances()
+        assert wait_until(lambda: any(
+            c[1] == "/flip_role" for c in ctl.calls))
+        # Drain decode → auto flip-back.
+        mgr.update_request_metrics("p2", RequestPhase.SCHEDULE, 10)
+        mgr.update_request_metrics("p2", RequestPhase.PREFILL_FINISH, 10)
+        mgr.update_request_metrics("p2", RequestPhase.FINISH_DECODE, 10)
+        assert "p2" in mgr.prefill_instances()
+        mgr.close()
+
+    def test_slo_selection_prefers_meeting_target(self, store):
+        mgr = self._mgr_with_pair(store)
+        # Give d1 a predictor meeting the target.
+        inst = mgr.get("d1")
+        inst.predictor.fit_tpot(
+            [(b, t, 1.0 + 0.1 * b) for b in (1, 2, 4) for t in (32, 64)])
+        p, d, ttft = mgr.select_instance_pair_on_slo(64)
+        assert p in ("p1", "p2") and d == "d1"
+        mgr.close()
+
+    def test_serverless_allocation_with_eviction(self, store):
+        ctl = FakeControl()
+        mgr = InstanceMgr(
+            opts_(), store, control=ctl,
+            model_memory_gb={"hot": 30.0, "cold1": 20.0, "cold2": 25.0,
+                             "big": 40.0},
+            serverless_models=["hot", "cold1", "cold2", "big"])
+        register_worker(store, "w1", InstanceType.PREFILL,
+                        models=["hot"], memory_budget_gb=60.0)
+        assert wait_until(lambda: "w1" in mgr._pending)
+        mgr.on_heartbeat(Heartbeat(name="w1",
+                                   instance_type=InstanceType.PREFILL))
+        inst = mgr.get("w1")
+        # fork_master staged the other models asleep.
+        assert inst.model_states == {
+            "hot": MODEL_AWAKE, "cold1": MODEL_ASLEEP,
+            "cold2": MODEL_ASLEEP, "big": MODEL_ASLEEP}
+        assert mgr.get_awake_instance("hot") == "w1"
+        assert mgr.get_awake_instance("big") is None
+
+        # Heat up "hot"; wake cold1+cold2: fits (30+20 ≤ 60 after waking
+        # cold1; then 30+20+25 > 60 → waking cold2 must evict; coldest is
+        # cold1 (heat 0 vs hot's heat).
+        mgr.update_model_heat("hot")
+        mgr.update_model_heat("hot")
+        assert mgr.allocate_instance_for_model("cold1") == "w1"
+        assert inst.model_states["cold1"] == MODEL_AWAKE
+        assert mgr.allocate_instance_for_model("cold2") == "w1"
+        slept = [c for c in ctl.calls if c[1] == "/sleep"]
+        assert slept and slept[0][2]["model"] == "cold1"
+        assert inst.model_states["cold2"] == MODEL_AWAKE
+        assert inst.model_states["cold1"] == MODEL_ASLEEP
+        mgr.close()
+
+
+class TestLBPolicies:
+    def _cluster(self, store, policy_type):
+        opts = opts_(load_balance_policy=policy_type)
+        mgr = InstanceMgr(opts, store, control=FakeControl())
+        kv = GlobalKVCacheMgr(store, block_size=4)
+        for name, itype in (("p1", InstanceType.PREFILL),
+                            ("p2", InstanceType.PREFILL),
+                            ("d1", InstanceType.DECODE)):
+            register_worker(store, name, itype)
+        assert wait_until(lambda: len(mgr._pending) == 3)
+        for name, itype in (("p1", InstanceType.PREFILL),
+                            ("p2", InstanceType.PREFILL),
+                            ("d1", InstanceType.DECODE)):
+            mgr.on_heartbeat(Heartbeat(name=name, instance_type=itype))
+        return opts, mgr, kv
+
+    def test_round_robin(self, store):
+        _, mgr, _ = self._cluster(store, LoadBalancePolicyType.ROUND_ROBIN)
+        pol = RoundRobinPolicy(mgr)
+        picks = {pol.select_instances_pair([1, 2, 3])[0]
+                 for _ in range(4)}
+        assert picks == {"p1", "p2"}
+        mgr.close()
+
+    def test_cache_aware_prefers_overlap(self, store):
+        _, mgr, kv = self._cluster(store, LoadBalancePolicyType.CACHE_AWARE)
+        tokens = list(range(16))
+        h = prefix_block_hashes(tokens, 4)
+        kv.record_updated_kvcaches("p2", stored=h)
+        pol = CacheAwareRoutingPolicy(mgr, kv, block_size=4)
+        prefill, decode = pol.select_instances_pair(tokens)
+        assert prefill == "p2"
+        assert decode == "d1"
+        mgr.close()
+
+    def test_cache_aware_falls_back_least_loaded(self, store):
+        _, mgr, kv = self._cluster(store, LoadBalancePolicyType.CACHE_AWARE)
+        mgr.get("p1").load = LoadMetrics(waiting_requests=10,
+                                         kv_cache_usage=0.9)
+        pol = CacheAwareRoutingPolicy(mgr, kv, block_size=4)
+        prefill, _ = pol.select_instances_pair(list(range(16)))
+        assert prefill == "p2"
+        mgr.close()
+
+    def test_slo_aware_falls_back_rr_without_tokens(self, store):
+        _, mgr, _ = self._cluster(store, LoadBalancePolicyType.SLO_AWARE)
+        pol = SloAwarePolicy(mgr)
+        prefill, decode = pol.select_instances_pair([])
+        assert prefill in ("p1", "p2")
+        mgr.close()
+
+
+class TestResponseGrammar:
+    def test_chat_stream_chunk_sequence(self):
+        """Golden test of the SSE grammar: role → deltas → finish →
+        usage → [DONE] (response_handler.cpp:20-134)."""
+        asm = ChatStreamAssembler("chatcmpl-1", "m", include_usage=True)
+        frames = []
+        frames += asm.on_output(RequestOutput(
+            request_id="chatcmpl-1",
+            outputs=[SequenceOutput(text="Hel", token_ids=[1])]))
+        frames += asm.on_output(RequestOutput(
+            request_id="chatcmpl-1",
+            outputs=[SequenceOutput(text="lo", token_ids=[2],
+                                    finish_reason=FinishReason.STOP)],
+            usage=Usage(prompt_tokens=3, completion_tokens=2),
+            finished=True))
+        payloads = [f.decode() for f in frames]
+        assert all(p.startswith("data: ") and p.endswith("\n\n")
+                   for p in payloads)
+        objs = [json.loads(p[6:]) for p in payloads[:-1]]
+        assert objs[0]["choices"][0]["delta"] == {"role": "assistant"}
+        assert objs[1]["choices"][0]["delta"] == {"content": "Hel"}
+        assert objs[2]["choices"][0]["delta"] == {"content": "lo"}
+        assert objs[3]["choices"][0]["finish_reason"] == "stop"
+        assert objs[3]["choices"][0]["delta"] == {}
+        assert objs[4]["choices"] == [] and \
+            objs[4]["usage"]["total_tokens"] == 5
+        assert frames[-1] == SSE_DONE
+
+
+class TestSchedulerCore:
+    def _scheduler(self, store, **opt_kw):
+        opts = opts_(**opt_kw)
+        sched = Scheduler(opts, store, control=FakeControl())
+        return sched
+
+    def test_master_election_and_takeover(self, store):
+        s1 = self._scheduler(store)
+        assert s1.is_master
+        s2 = self._scheduler(store)
+        assert not s2.is_master
+        assert store.get(KEY_MASTER) == s1.service_id
+        s1.stop()  # revokes lease → DELETE → s2 takes over
+        assert wait_until(lambda: s2.is_master, timeout=3.0)
+        assert store.get(KEY_MASTER) == s2.service_id
+        s2.stop()
+
+    def test_schedule_tokenizes_and_routes(self, store):
+        sched = self._scheduler(
+            store, load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN)
+        register_worker(store, "p1", InstanceType.PREFILL)
+        assert wait_until(
+            lambda: "p1" in sched.instance_mgr._pending)
+        sched.handle_instance_heartbeat(Heartbeat(
+            name="p1", instance_type=InstanceType.PREFILL))
+        req = Request(model="tiny", messages=[
+            {"role": "user", "content": "hello"}])
+        status, routing = sched.schedule(req)
+        assert status.ok
+        assert routing.prefill_name == "p1"
+        assert req.token_ids  # chat template applied + tokenized
+        assert "<|im_start|>user" in req.prompt
+        sched.stop()
+
+    def test_schedule_no_instances_unavailable(self, store):
+        sched = self._scheduler(store)
+        status, _ = sched.schedule(Request(prompt="hi"))
+        assert not status.ok and status.code.name == "UNAVAILABLE"
+        sched.stop()
+
+    def test_generation_fan_in_order_and_finish(self, store):
+        sched = self._scheduler(store)
+        req = Request(model="m", prompt="x", service_request_id="r1")
+        got: List[str] = []
+        done = threading.Event()
+
+        def cb(out: RequestOutput) -> bool:
+            got.extend(s.text for s in out.outputs)
+            if out.finished:
+                done.set()
+            return True
+
+        sched.record_new_request(req, cb)
+        for i in range(20):
+            sched.handle_generation(RequestOutput(
+                request_id="r1", service_request_id="r1",
+                outputs=[SequenceOutput(text=f"t{i}", token_ids=[i])],
+                finished=(i == 19)))
+        assert done.wait(3.0)
+        assert got == [f"t{i}" for i in range(20)]
+        assert sched.num_tracked_requests() == 0
+        sched.stop()
+
+    def test_callback_false_cancels(self, store):
+        sched = self._scheduler(store)
+        req = Request(model="m", prompt="x", service_request_id="r2")
+        sched.record_new_request(req, lambda out: False)
+        sched.handle_generation(RequestOutput(
+            request_id="r2", service_request_id="r2",
+            outputs=[SequenceOutput(text="a", token_ids=[1])]))
+        assert wait_until(lambda: sched.num_tracked_requests() == 0)
+        sched.stop()
+
+
+class TestReviewRegressions:
+    """Regressions for the code-review findings on the service layer."""
+
+    def test_match_mid_prefix_holder_scores_zero(self, store):
+        mgr = GlobalKVCacheMgr(store, block_size=4)
+        tokens = list(range(32))
+        h = prefix_block_hashes(tokens, 4)
+        mgr.record_updated_kvcaches("a", stored=h[:3])
+        # b holds only blocks 1-2 (no leading block) → unusable prefix.
+        mgr.record_updated_kvcaches("b", stored=h[1:3])
+        _, scores = mgr.match(tokens)
+        assert scores["a"] == pytest.approx(3.0)
+        assert "b" not in scores
+
+    def test_relay_mode_ledger_drains_on_finish(self, store):
+        sched = Scheduler(opts_(), store, control=FakeControl())
+        register_worker(store, "p1", InstanceType.PREFILL)
+        assert wait_until(lambda: "p1" in sched.instance_mgr._pending)
+        sched.handle_instance_heartbeat(Heartbeat(
+            name="p1", instance_type=InstanceType.PREFILL))
+        req = Request(model="m", prompt="hello")
+        status, routing = sched.schedule(req)
+        assert status.ok
+        m = sched.instance_mgr.get("p1").req_metrics
+        assert m.num_prefill_requests == 1
+        # Relay mode: no generations ever arrive; finish must drain.
+        sched.record_new_request(req, lambda out: True)
+        sched.finish_request(req.service_request_id)
+        assert m.num_prefill_requests == 0
+        assert m.num_prefill_tokens == 0
+        assert m.num_decode_requests == 0
+        sched.stop()
+
+    def test_instance_death_fails_tracked_requests(self, store):
+        sched = Scheduler(opts_(), store, control=FakeControl())
+        register_worker(store, "p1", InstanceType.PREFILL, ttl=0.3)
+        assert wait_until(lambda: "p1" in sched.instance_mgr._pending)
+        sched.handle_instance_heartbeat(Heartbeat(
+            name="p1", instance_type=InstanceType.PREFILL))
+        req = Request(model="m", prompt="x")
+        status, _ = sched.schedule(req)
+        assert status.ok
+        outs = []
+        done = threading.Event()
+
+        def cb(out):
+            outs.append(out)
+            if out.cancelled or out.finished:
+                done.set()
+            return True
+
+        sched.record_new_request(req, cb)
+        # Lease expires → DELETE → removal → request cancelled.
+        assert done.wait(5.0)
+        assert outs[-1].cancelled
+        assert wait_until(lambda: sched.num_tracked_requests() == 0)
+        sched.stop()
+
+    def test_watch_events_delivered_in_order(self, store):
+        got = []
+        evt = threading.Event()
+
+        def cb(ev):
+            got.append(ev)
+            if len(got) >= 40:
+                evt.set()
+
+        store.add_watch("O:", cb)
+        for i in range(20):
+            store.put("O:k", str(i))
+            store.delete("O:k")
+        assert evt.wait(5.0)
+        # Strict alternation PUT/DELETE — per-event threads would reorder.
+        for i, ev in enumerate(got[:40]):
+            assert ev[0] == ("PUT" if i % 2 == 0 else "DELETE")
+
+    def test_remote_watch_skips_history(self, store):
+        from xllm_service_tpu.service.coordination_net import (
+            RemoteStore, StoreServer)
+        server = StoreServer().start()
+        try:
+            for i in range(10):
+                server.store.put(f"H:{i}", "old")
+            client = RemoteStore(server.address)
+            got = []
+            evt = threading.Event()
+            client.add_watch("H:", lambda ev: (got.append(ev), evt.set()))
+            time.sleep(0.3)   # watcher engaged; history must NOT replay
+            server.store.put("H:new", "fresh")
+            assert evt.wait(5.0)
+            assert got == [("PUT", "H:new", "fresh")]
+            client.close()
+        finally:
+            server.stop()

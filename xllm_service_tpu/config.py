@@ -62,6 +62,9 @@ class ServiceOptions:
     target_ttft_ms: float = 1000.0
     target_tpot_ms: float = 50.0
 
+    # End-to-end bound on one generation (RPC fan-in waits, relay reads).
+    request_timeout_s: float = 600.0
+
     # Cluster cadences.
     heartbeat_interval_s: float = 3.0
     master_upload_interval_s: float = 3.0

@@ -1,0 +1,6 @@
+"""NLP preprocessing: tokenizer backends + chat templating (reference
+layer E — ``tokenizer/`` and ``chat_template/``, SURVEY.md §1)."""
+
+from xllm_service_tpu.nlp.tokenizer import (  # noqa: F401
+    ByteTokenizer, Tokenizer, TokenizerFactory)
+from xllm_service_tpu.nlp.chat_template import ChatTemplate  # noqa: F401

@@ -1,0 +1,685 @@
+"""Worker: one TPU engine host process — the "xLLM engine instance" the
+reference assumes but does not contain (SURVEY.md §2 intro, §7.1).
+
+A worker owns one or more ``ModelRuntime``s (model → engine) on one device
+mesh, drives a continuous-batching loop thread, and speaks the cluster
+contract:
+
+- registers by writing ``XLLM:<TYPE>:<name>`` to the coordination store
+  under a TTL lease (liveness = lease, instance_mgr.cpp:584-604);
+- heartbeats the service every ``heartbeat_interval_s`` with load/latency
+  metrics + prefix-cache deltas (rpc_service/client.cpp:55-77);
+- serves the forwarded OpenAI request body (``token_ids`` already attached
+  by the service, http_service/service.cpp:457-463) with SSE streaming
+  back through the relay — or pushes tokens straight to the service's
+  ``/rpc/generations`` fan-in when decode-response-to-service mode is on
+  (the reference's two response topologies, rpc_service/service.h:67-79);
+- implements the serverless control surface ``/fork_master``, ``/sleep``,
+  ``/wakeup`` (instance_mgr.cpp:229-285): on TPU, sleep = donate weights
+  to host RAM + drop KV pool; wakeup = re-shard weights back to HBM with
+  compiled executables still cached (SURVEY.md §7.1);
+- ``/flip_role`` switches PREFILL↔DECODE priority (both program sets stay
+  AOT-compiled, so a flip is bookkeeping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+import numpy as np
+
+from xllm_service_tpu.config import (
+    EngineConfig, InstanceType, ModelConfig)
+from xllm_service_tpu.nlp.tokenizer import (
+    IncrementalDecoder, Tokenizer, TokenizerFactory)
+from xllm_service_tpu.runtime.engine import Engine, EngineRequest, StepOutput
+from xllm_service_tpu.service.coordination import (
+    CoordinationStore, instance_prefix)
+from xllm_service_tpu.service.httpd import (
+    HttpServer, Request, Response, Router, http_json)
+from xllm_service_tpu.service.instance_types import (
+    Heartbeat, InstanceMetaInfo, LatencyMetrics, LoadMetrics)
+from xllm_service_tpu.service.response_handler import (
+    ChatStreamAssembler, CompletionStreamAssembler, full_chat_response,
+    full_completion_response, sse_frame, SSE_DONE)
+from xllm_service_tpu.utils.misc import short_uuid
+from xllm_service_tpu.utils.types import (
+    FinishReason, RequestOutput, SamplingParams, SequenceOutput, Status,
+    StatusCode, Usage)
+
+logger = logging.getLogger(__name__)
+
+MODEL_AWAKE = "awake"
+MODEL_ASLEEP = "asleep"
+
+
+@dataclasses.dataclass
+class WorkerOptions:
+    host: str = "127.0.0.1"
+    port: int = 0
+    instance_type: InstanceType = InstanceType.MIX
+    service_addr: str = ""              # service RPC address for heartbeats
+    model: str = "tiny"
+    model_dir: str = ""                 # HF dir (tokenizer + config.json)
+    heartbeat_interval_s: float = 3.0
+    lease_ttl_s: float = 9.0
+    enable_profiling: bool = False
+    memory_budget_gb: float = 60.0
+    seed: int = 0
+    murmur_seed: int = 0
+
+
+_MODEL_REGISTRY = {
+    # vocab 512 ≥ ByteTokenizer's id range (256 bytes + specials).
+    "tiny": lambda: ModelConfig.tiny(vocab_size=512),
+    "llama3-1b": ModelConfig.llama3_1b,
+    "llama3-8b": ModelConfig.llama3_8b,
+    "qwen2-7b": ModelConfig.qwen2_7b,
+    "tiny-moe": lambda: ModelConfig.tiny(num_experts=4),
+}
+
+
+def resolve_model_config(name: str, model_dir: str = "") -> ModelConfig:
+    if model_dir:
+        import os
+        cfg_path = os.path.join(model_dir, "config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path, "r", encoding="utf-8") as f:
+                return ModelConfig.from_hf_config(json.load(f), name=name)
+    factory = _MODEL_REGISTRY.get(name)
+    if factory is None:
+        raise ValueError(f"unknown model {name!r}; known: "
+                         f"{sorted(_MODEL_REGISTRY)}")
+    return factory()
+
+
+class ModelRuntime:
+    """One model's engine + sleep/wakeup state on this worker."""
+
+    def __init__(self, model: str, model_cfg: ModelConfig,
+                 engine_cfg: EngineConfig, tokenizer: Tokenizer,
+                 mesh=None, seed: int = 0, murmur_seed: int = 0,
+                 start_asleep: bool = False) -> None:
+        self.model = model
+        self.model_cfg = model_cfg
+        self.engine_cfg = engine_cfg
+        self.tokenizer = tokenizer
+        self.mesh = mesh
+        self.seed = seed
+        self.murmur_seed = murmur_seed
+        self.state = MODEL_ASLEEP if start_asleep else MODEL_AWAKE
+        self._host_params: Optional[Any] = None
+        self.engine: Optional[Engine] = None
+        if not start_asleep:
+            self.engine = Engine(model_cfg, engine_cfg, mesh=mesh,
+                                 seed=seed, murmur_seed=murmur_seed)
+
+    def sleep(self) -> None:
+        """Donate weights to host RAM, drop the KV pool (TPU sleep —
+        SURVEY.md §7.1 sleep/wakeup row)."""
+        if self.state == MODEL_ASLEEP:
+            return
+        if self.engine is not None:
+            self._host_params = jax.tree_util.tree_map(
+                np.asarray, jax.device_get(self.engine.params))
+            self.engine = None      # KV pool + device params released
+        self.state = MODEL_ASLEEP
+
+    def wakeup(self) -> None:
+        """Weights back to HBM (resharded); XLA executable cache makes
+        recompilation a no-op."""
+        if self.state == MODEL_AWAKE:
+            return
+        params = None
+        if self._host_params is not None:
+            import jax.numpy as jnp
+            params = jax.tree_util.tree_map(jnp.asarray, self._host_params)
+            self._host_params = None
+        self.engine = Engine(self.model_cfg, self.engine_cfg,
+                             params=params, mesh=self.mesh, seed=self.seed,
+                             murmur_seed=self.murmur_seed)
+        self.state = MODEL_AWAKE
+
+    @property
+    def memory_gb(self) -> float:
+        """Rough HBM footprint for the serverless allocator."""
+        cfg = self.model_cfg
+        n_params = (cfg.vocab_size * cfg.hidden_size * 2
+                    + cfg.num_layers * (
+                        4 * cfg.hidden_size * cfg.num_heads * cfg.head_dim
+                        + 3 * cfg.hidden_size * cfg.intermediate_size))
+        return 2.0 * n_params / 1e9
+
+
+class _LiveRequest:
+    """Host-side streaming state of one in-flight request."""
+
+    __slots__ = ("req", "q", "decoder", "stream_to_service",
+                 "service_request_id", "model", "is_chat", "stream",
+                 "include_usage", "first_out_time")
+
+    def __init__(self, req: EngineRequest, decoder: IncrementalDecoder,
+                 service_request_id: str, model: str, is_chat: bool,
+                 stream: bool, include_usage: bool,
+                 stream_to_service: bool) -> None:
+        self.req = req
+        self.q: "queue.Queue[Optional[StepOutput]]" = queue.Queue()
+        self.decoder = decoder
+        self.service_request_id = service_request_id
+        self.model = model
+        self.is_chat = is_chat
+        self.stream = stream
+        self.include_usage = include_usage
+        self.stream_to_service = stream_to_service
+        self.first_out_time = 0.0
+
+
+class Worker:
+    def __init__(self, opts: WorkerOptions, store: CoordinationStore,
+                 engine_cfg: Optional[EngineConfig] = None,
+                 mesh=None) -> None:
+        self.opts = opts
+        self.store = store
+        self.mesh = mesh
+        self.instance_type = opts.instance_type
+        self.engine_cfg = engine_cfg or EngineConfig()
+        self.tokenizer = TokenizerFactory.create_tokenizer(opts.model_dir)
+
+        self.runtimes: Dict[str, ModelRuntime] = {}
+        primary_cfg = resolve_model_config(opts.model, opts.model_dir)
+        self.runtimes[opts.model] = ModelRuntime(
+            opts.model, primary_cfg, self.engine_cfg, self.tokenizer,
+            mesh=mesh, seed=opts.seed, murmur_seed=opts.murmur_seed)
+
+        self._live: Dict[str, _LiveRequest] = {}
+        self._live_lock = threading.Lock()
+        # Engines are single-threaded; HTTP threads and the loop thread
+        # serialize on this (submission is cheap, steps hold it for one
+        # iteration).
+        self._engine_lock = threading.Lock()
+        self._work_event = threading.Event()
+        self._stop = threading.Event()
+        self._latency = LatencyMetrics()
+        self._decode_to_service = False
+
+        router = Router()
+        router.route("GET", "/hello", lambda r: Response.json({"ok": True}))
+        router.route("POST", "/v1/chat/completions",
+                     lambda r: self._serve_generate(r, is_chat=True))
+        router.route("POST", "/v1/completions",
+                     lambda r: self._serve_generate(r, is_chat=False))
+        router.route("GET", "/v1/models", self._serve_models)
+        router.route("GET", "/metrics", self._serve_metrics)
+        router.route("POST", "/sleep", self._serve_sleep)
+        router.route("POST", "/wakeup", self._serve_wakeup)
+        router.route("POST", "/fork_master", self._serve_fork_master)
+        router.route("POST", "/flip_role", self._serve_flip_role)
+        router.route("POST", "/cancel", self._serve_cancel)
+        self._router = router
+        self._srv = HttpServer(opts.host, opts.port, router)
+        self.name = self._srv.address
+
+        self._loop_thread = threading.Thread(
+            target=self._engine_loop, name=f"worker-loop-{self.name}",
+            daemon=True)
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name=f"worker-hb-{self.name}",
+            daemon=True)
+        self._lease_id: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "Worker":
+        self._srv.start()
+        self._register()
+        self._loop_thread.start()
+        self._hb_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._work_event.set()
+        self._srv.stop()
+        if self._lease_id is not None:
+            try:
+                self.store.lease_revoke(self._lease_id)
+            except Exception:  # noqa: BLE001
+                pass
+        self._loop_thread.join(timeout=5)
+        self._hb_thread.join(timeout=5)
+
+    def _register(self) -> None:
+        """Write the registration key under a TTL lease
+        (engine-side contract, rpc_service/client.cpp:55-77)."""
+        ttft_prof: List = []
+        tpot_prof: List = []
+        if self.opts.enable_profiling:
+            from xllm_service_tpu.service.time_predictor import \
+                profile_engine
+            rt = self.primary_runtime()
+            if rt.engine is not None:
+                ttft_prof, tpot_prof = profile_engine(rt.engine)
+        meta = InstanceMetaInfo(
+            name=self.name,
+            rpc_address=self.name,
+            instance_type=self.instance_type,
+            models=[m for m, rt in self.runtimes.items()
+                    if rt.state == MODEL_AWAKE],
+            dp_size=self.engine_cfg.dp,
+            ttft_profiling_data=ttft_prof,
+            tpot_profiling_data=tpot_prof,
+            memory_budget_gb=self.opts.memory_budget_gb,
+            k_cache_ids=list(range(
+                self.primary_runtime().model_cfg.num_layers)),
+            v_cache_ids=list(range(
+                self.primary_runtime().model_cfg.num_layers)),
+            addrs=[self.name],
+        )
+        self._lease_id = self.store.lease_grant(self.opts.lease_ttl_s)
+        self.store.put_json(
+            instance_prefix(self.instance_type.value) + self.name,
+            meta.to_json(), self._lease_id)
+
+    def primary_runtime(self) -> ModelRuntime:
+        return self.runtimes[self.opts.model]
+
+    # ------------------------------------------------------------------
+    # Engine loop
+    # ------------------------------------------------------------------
+    def _engine_loop(self) -> None:
+        while not self._stop.is_set():
+            busy = False
+            for rt in list(self.runtimes.values()):
+                eng = rt.engine
+                if eng is None or not eng.has_work():
+                    continue
+                busy = True
+                t0 = time.monotonic()
+                with self._engine_lock:
+                    outs = eng.step()
+                step_ms = 1000.0 * (time.monotonic() - t0)
+                self._dispatch_outputs(rt, outs, step_ms)
+            if not busy:
+                self._work_event.wait(timeout=0.05)
+                self._work_event.clear()
+
+    def _dispatch_outputs(self, rt: ModelRuntime,
+                          outs: List[StepOutput], step_ms: float) -> None:
+        now = time.monotonic()
+        to_service: List[RequestOutput] = []
+        for out in outs:
+            with self._live_lock:
+                live = self._live.get(out.request_id)
+            if live is None:
+                continue
+            if live.first_out_time == 0.0:
+                live.first_out_time = now
+                self._latency.recent_max_ttft_ms = max(
+                    self._latency.recent_max_ttft_ms, step_ms)
+            else:
+                self._latency.recent_max_tbt_ms = max(
+                    self._latency.recent_max_tbt_ms, step_ms)
+            if live.stream_to_service:
+                to_service.append(self._to_request_output(live, out))
+                if out.finished:
+                    self._drop_live(out.request_id)
+            else:
+                live.q.put(out)
+                if out.finished:
+                    self._drop_live(out.request_id)
+        if to_service and self.opts.service_addr:
+            try:
+                http_json("POST", self.opts.service_addr,
+                          "/rpc/generations",
+                          {"outputs": [o.to_json() for o in to_service]},
+                          timeout=30.0)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("generations push failed: %s", e)
+
+    def _drop_live(self, request_id: str) -> None:
+        with self._live_lock:
+            self._live.pop(request_id, None)
+
+    def _to_request_output(self, live: _LiveRequest,
+                           out: StepOutput) -> RequestOutput:
+        text = live.decoder.feed(out.new_token_ids)
+        if out.finished:
+            text += live.decoder.flush()
+        seq = SequenceOutput(
+            index=0, text=text, token_ids=list(out.new_token_ids),
+            finish_reason=out.finish_reason)
+        usage = None
+        if out.finished:
+            usage = Usage(prompt_tokens=out.num_prompt_tokens,
+                          completion_tokens=out.num_generated)
+        return RequestOutput(
+            request_id=live.req.request_id,
+            service_request_id=live.service_request_id,
+            outputs=[seq], usage=usage, finished=out.finished,
+            cancelled=out.finish_reason == FinishReason.CANCELLED)
+
+    # ------------------------------------------------------------------
+    # Serving
+    # ------------------------------------------------------------------
+    def _parse_generate(self, req: Request, is_chat: bool
+                        ) -> "_LiveRequest":
+        body = req.json()
+        model = body.get("model", self.opts.model)
+        rt = self.runtimes.get(model) or self.primary_runtime()
+        if rt.engine is None:
+            raise RuntimeError(f"model {model} is asleep on this worker")
+        srid = body.get("service_request_id") or f"req-{short_uuid()}"
+        token_ids = body.get("token_ids") or []
+        if not token_ids:
+            # Direct-to-worker use (no service in front): tokenize here.
+            if is_chat:
+                prompt = "\n".join(
+                    str(m.get("content", ""))
+                    for m in body.get("messages", []))
+            else:
+                prompt = body.get("prompt", "")
+            token_ids = rt.tokenizer.encode(prompt)
+        sampling = SamplingParams(
+            max_tokens=body.get("max_tokens", 16),
+            temperature=body.get("temperature", 1.0),
+            top_p=body.get("top_p", 1.0),
+            top_k=body.get("top_k", 0),
+            seed=body.get("seed"),
+            stop_token_ids=body.get("stop_token_ids", []),
+            ignore_eos=body.get("ignore_eos", False))
+        ereq = EngineRequest(
+            request_id=srid,
+            token_ids=list(token_ids),
+            sampling=sampling,
+            offline=bool(body.get("offline", False)),
+            priority=int(body.get("priority", 0)),
+            eos_token_ids=rt.tokenizer.eos_token_ids)
+        stream = bool(body.get("stream", False))
+        include_usage = bool(
+            (body.get("stream_options") or {}).get("include_usage", False))
+        live = _LiveRequest(
+            ereq, IncrementalDecoder(rt.tokenizer), srid, model, is_chat,
+            stream, include_usage,
+            stream_to_service=self._decode_to_service
+            and bool(self.opts.service_addr))
+        with self._live_lock:
+            self._live[srid] = live
+        with self._engine_lock:
+            rt.engine.add_request(ereq)
+        self._work_event.set()
+        return live
+
+    def _serve_generate(self, req: Request, is_chat: bool) -> Response:
+        try:
+            live = self._parse_generate(req, is_chat)
+        except (ValueError, RuntimeError) as e:
+            return Response.error(400, str(e))
+        if live.stream_to_service:
+            # Topology 2: tokens flow worker → service RPC fan-in; the
+            # relay response is a plain ack (rpc_service/service.h:67-79).
+            return Response.json({"status": "accepted",
+                                  "service_request_id":
+                                      live.service_request_id})
+        if live.stream:
+            return Response.sse(self._stream_sse(live))
+        return self._collect_full(live)
+
+    def _stream_sse(self, live: _LiveRequest) -> Iterator[bytes]:
+        asm = (ChatStreamAssembler if live.is_chat
+               else CompletionStreamAssembler)(
+            live.service_request_id, live.model, live.include_usage)
+        while True:
+            out = live.q.get()
+            if out is None:
+                yield SSE_DONE
+                return
+            ro = self._to_request_output(live, out)
+            for frame in asm.on_output(ro):
+                yield frame
+            if out.finished:
+                return
+
+    def _collect_full(self, live: _LiveRequest) -> Response:
+        text_parts: List[str] = []
+        usage = Usage()
+        finish = FinishReason.STOP
+        while True:
+            out = live.q.get()
+            if out is None:
+                break
+            ro = self._to_request_output(live, out)
+            for seq in ro.outputs:
+                text_parts.append(seq.text)
+            if out.finished:
+                finish = out.finish_reason
+                if ro.usage:
+                    usage = ro.usage
+                break
+        text = "".join(text_parts)
+        builder = full_chat_response if live.is_chat \
+            else full_completion_response
+        return Response.json(builder(live.service_request_id, live.model,
+                                     text, finish, usage))
+
+    # ------------------------------------------------------------------
+    # Control surface
+    # ------------------------------------------------------------------
+    def _serve_models(self, req: Request) -> Response:
+        return Response.json({
+            "object": "list",
+            "data": [{"id": m, "object": "model",
+                      "owned_by": "xllm-service-tpu",
+                      "state": rt.state}
+                     for m, rt in self.runtimes.items()]})
+
+    def _serve_metrics(self, req: Request) -> Response:
+        lines = []
+        for m, rt in self.runtimes.items():
+            if rt.engine is None:
+                continue
+            lm = rt.engine.load_metrics()
+            for k, v in lm.items():
+                lines.append(
+                    f'xllm_worker_{k}{{model="{m}"}} {v}')
+        return Response(body="\n".join(lines).encode() + b"\n",
+                        content_type="text/plain; version=0.0.4")
+
+    def _serve_sleep(self, req: Request) -> Response:
+        model = req.json().get("model", "")
+        rt = self.runtimes.get(model)
+        if rt is None:
+            return Response.error(404, f"model {model} not on this worker")
+        with self._engine_lock:
+            rt.sleep()
+        return Response.json({"ok": True, "model": model,
+                              "state": rt.state})
+
+    def _serve_wakeup(self, req: Request) -> Response:
+        model = req.json().get("model", "")
+        rt = self.runtimes.get(model)
+        if rt is None:
+            return Response.error(404, f"model {model} not on this worker")
+        with self._engine_lock:
+            rt.wakeup()
+        self._work_event.set()
+        return Response.json({"ok": True, "model": model,
+                              "state": rt.state})
+
+    def _serve_fork_master(self, req: Request) -> Response:
+        """Stage additional models asleep (weights on host, nothing in
+        HBM until wakeup) — instance_mgr.cpp:229-260's engine side."""
+        models = req.json().get("models", [])
+        created = []
+        for model in models:
+            if model in self.runtimes:
+                continue
+            try:
+                cfg = resolve_model_config(model)
+            except ValueError as e:
+                return Response.error(400, str(e))
+            self.runtimes[model] = ModelRuntime(
+                model, cfg, self.engine_cfg, self.tokenizer,
+                mesh=self.mesh, seed=self.opts.seed,
+                murmur_seed=self.opts.murmur_seed, start_asleep=True)
+            created.append(model)
+        return Response.json({"ok": True, "created": created})
+
+    def _serve_flip_role(self, req: Request) -> Response:
+        new_type = req.json().get("instance_type", "")
+        try:
+            self.instance_type = InstanceType(new_type)
+        except ValueError:
+            return Response.error(400, f"bad instance_type {new_type!r}")
+        # Re-write the registration key so replicas learn the new role.
+        if self._lease_id is not None:
+            try:
+                self._register_rewrite()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("flip re-register failed: %s", e)
+        return Response.json({"ok": True,
+                              "instance_type": self.instance_type.value})
+
+    def _register_rewrite(self) -> None:
+        for itype in InstanceType:
+            self.store.delete(instance_prefix(itype.value) + self.name)
+        self._register()
+
+    def _serve_cancel(self, req: Request) -> Response:
+        srid = req.json().get("service_request_id", "")
+        with self._live_lock:
+            live = self._live.get(srid)
+        if live is None:
+            return Response.json({"ok": False})
+        rt = self.runtimes.get(live.model) or self.primary_runtime()
+        if rt.engine is not None:
+            with self._engine_lock:
+                rt.engine.cancel(srid)
+            self._work_event.set()
+        return Response.json({"ok": True})
+
+    # ------------------------------------------------------------------
+    # Heartbeats
+    # ------------------------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        # Learn decode-response-to-service mode from the service's config
+        # (GetConfig, rpc_service/service.cpp:215-223).
+        if self.opts.service_addr:
+            try:
+                status, cfg = http_json("GET", self.opts.service_addr,
+                                        "/rpc/config", timeout=5.0)
+                if status == 200 and cfg:
+                    self._decode_to_service = bool(
+                        cfg.get("enable_decode_response_to_service"))
+            except Exception:  # noqa: BLE001
+                pass
+        while not self._stop.wait(self.opts.heartbeat_interval_s):
+            try:
+                if self._lease_id is not None:
+                    self.store.lease_keepalive(self._lease_id)
+                self._send_heartbeat()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("heartbeat failed: %s", e)
+
+    def _send_heartbeat(self) -> None:
+        if not self.opts.service_addr:
+            return
+        rt = self.primary_runtime()
+        load = LoadMetrics()
+        stored: List[str] = []
+        removed: List[str] = []
+        model_states = {m: r.state for m, r in self.runtimes.items()}
+        if rt.engine is not None:
+            lm = rt.engine.load_metrics()
+            load = LoadMetrics(
+                waiting_requests=lm["waiting_requests"],
+                running_requests=lm["running_requests"],
+                kv_cache_usage=lm["kv_cache_usage"],
+                num_preemptions=lm["num_preemptions"])
+            ev = rt.engine.drain_kvcache_event()
+            stored = [h.hex() for h in ev.stored]
+            removed = [h.hex() for h in ev.removed]
+        hb = Heartbeat(
+            name=self.name, instance_type=self.instance_type,
+            load=load, latency=self._latency,
+            cache_stored=stored, cache_removed=removed,
+            model_states=model_states)
+        self._latency = LatencyMetrics()
+        http_json("POST", self.opts.service_addr, "/rpc/heartbeat",
+                  hb.to_json(), timeout=10.0)
+
+    def heartbeat_once(self) -> None:
+        """Test helper: one synchronous heartbeat."""
+        self._send_heartbeat()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(
+        description="xllm-service-tpu worker (TPU engine instance)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--instance-type", default="MIX",
+                        choices=[t.value for t in InstanceType])
+    parser.add_argument("--service-addr", default="",
+                        help="service RPC host:port for heartbeats")
+    parser.add_argument("--store-addr", default="",
+                        help="coordination store host:port "
+                             "('' = private in-process store)")
+    parser.add_argument("--model", default="tiny")
+    parser.add_argument("--model-dir", default="")
+    parser.add_argument("--heartbeat-interval-s", type=float, default=3.0)
+    parser.add_argument("--enable-profiling", action="store_true")
+    parser.add_argument("--page-size", type=int, default=64)
+    parser.add_argument("--num-pages", type=int, default=512)
+    parser.add_argument("--max-model-len", type=int, default=2048)
+    parser.add_argument("--max-batch-size", type=int, default=8)
+    parser.add_argument("--tp", type=int, default=1)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    from xllm_service_tpu.service.coordination_net import connect_store
+    store = connect_store(args.store_addr)
+    engine_cfg = EngineConfig(
+        page_size=args.page_size, num_pages=args.num_pages,
+        max_model_len=args.max_model_len,
+        max_batch_size=args.max_batch_size, tp=args.tp)
+    mesh = None
+    if args.tp > 1:
+        from xllm_service_tpu.parallel.mesh import MeshSpec, make_mesh
+        mesh = make_mesh(MeshSpec(tp=args.tp))
+    opts = WorkerOptions(
+        host=args.host, port=args.port,
+        instance_type=InstanceType(args.instance_type),
+        service_addr=args.service_addr, model=args.model,
+        model_dir=args.model_dir,
+        heartbeat_interval_s=args.heartbeat_interval_s,
+        lease_ttl_s=3 * args.heartbeat_interval_s,
+        enable_profiling=args.enable_profiling)
+    worker = Worker(opts, store, engine_cfg=engine_cfg, mesh=mesh).start()
+    logger.info("worker %s serving model %s (type %s)",
+                worker.name, args.model, args.instance_type)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame) -> None:
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    stop.wait()
+    worker.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -122,6 +122,15 @@ class InMemoryStore(CoordinationStore):
         self._events_cv = threading.Condition(self._lock)
         self._max_events = 65536
         self._closed = False
+        # Watch callbacks run on ONE dispatcher thread draining an ordered
+        # queue — events are delivered in revision order (a per-event
+        # thread could reorder a worker's DELETE/re-PUT and permanently
+        # wedge registration state downstream).
+        import queue as _queue
+        self._dispatch_q: "_queue.Queue" = _queue.Queue()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="coord-dispatch", daemon=True)
+        self._dispatcher.start()
         self._sweeper = threading.Thread(
             target=self._sweep_loop, args=(sweep_interval_s,),
             name="coord-sweeper", daemon=True)
@@ -138,16 +147,21 @@ class InMemoryStore(CoordinationStore):
         callbacks = [cb for _, (pfx, cb) in self._watches.items()
                      if key.startswith(pfx)]
         self._events_cv.notify_all()
-        # Fire callbacks outside the lock to avoid re-entrancy deadlocks.
         if callbacks:
-            def run() -> None:
-                for cb in callbacks:
-                    try:
-                        cb(ev)
-                    except Exception:  # noqa: BLE001
-                        import traceback
-                        traceback.print_exc()
-            threading.Thread(target=run, daemon=True).start()
+            self._dispatch_q.put((callbacks, ev))
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._dispatch_q.get()
+            if item is None:
+                return
+            callbacks, ev = item
+            for cb in callbacks:
+                try:
+                    cb(ev)
+                except Exception:  # noqa: BLE001
+                    import traceback
+                    traceback.print_exc()
 
     def _delete_locked(self, key: str) -> bool:
         if key not in self._data:
@@ -263,5 +277,11 @@ class InMemoryStore(CoordinationStore):
                     return self.revision, []
                 self._events_cv.wait(remaining)
 
+    @property
+    def oldest_retained_revision(self) -> int:
+        with self._lock:
+            return self._events[0][0] if self._events else self.revision + 1
+
     def close(self) -> None:
         self._closed = True
+        self._dispatch_q.put(None)

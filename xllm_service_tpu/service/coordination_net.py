@@ -36,6 +36,7 @@ class StoreServer:
         router.route("POST", "/lease/revoke", self._revoke)
         router.route("POST", "/txn/compare_create", self._compare_create)
         router.route("GET", "/watch", self._watch)
+        router.route("GET", "/rev", self._rev)
         self._srv = HttpServer(host, port, router)
 
     @property
@@ -93,12 +94,18 @@ class StoreServer:
                                             d.get("lease_id"))
         return Response.json({"created": created})
 
+    def _rev(self, req: Request) -> Response:
+        return Response.json({"rev": self.store.revision})
+
     def _watch(self, req: Request) -> Response:
         rev = int(req.param("rev", "0"))
         timeout = min(float(req.param("timeout", "10")), 30.0)
+        # Events older than the bounded log's head are gone; tell the
+        # watcher so it can resync instead of silently missing deletes.
+        compacted = rev + 1 < self.store.oldest_retained_revision
         new_rev, events = self.store.events_since(
             rev, req.param("prefix"), timeout)
-        return Response.json({"rev": new_rev,
+        return Response.json({"rev": new_rev, "compacted": compacted,
                               "events": [list(e) for e in events]})
 
 
@@ -167,7 +174,18 @@ class RemoteStore(CoordinationStore):
 
     def _watch_loop(self, prefix: str, callback: WatchCallback,
                     stop: threading.Event) -> None:
-        rev = 0
+        # Like local add_watch, deliver only *future* events: start at the
+        # server's current revision, not 0 (a fresh watcher must not replay
+        # the whole retained history).
+        rev: Optional[int] = None
+        while not stop.is_set() and rev is None:
+            try:
+                status, resp = http_json("GET", self.address, "/rev",
+                                         timeout=self.timeout)
+                if status == 200:
+                    rev = resp["rev"]
+            except Exception:  # noqa: BLE001
+                stop.wait(1.0)
         while not stop.is_set():
             try:
                 status, resp = http_json(
@@ -177,6 +195,12 @@ class RemoteStore(CoordinationStore):
                 if status != 200:
                     stop.wait(1.0)
                     continue
+                if resp.get("compacted"):
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "watch on %r fell behind the event log; some "
+                        "events were compacted away — resync state from "
+                        "get_prefix", prefix)
                 rev = resp["rev"]
                 for ev_type, key, value in resp["events"]:
                     if stop.is_set():
@@ -212,3 +236,27 @@ def connect_store(addr: str) -> CoordinationStore:
     if not addr:
         return InMemoryStore()
     return RemoteStore(addr)
+
+
+def main(argv=None) -> int:
+    """Standalone coordination-store server (the deployment's 'etcd')."""
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(
+        description="xllm-service-tpu coordination store server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=12379)
+    args = parser.parse_args(argv)
+    server = StoreServer(args.host, args.port).start()
+    print(f"coordination store serving on {server.address}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda s, f: stop.set())
+    signal.signal(signal.SIGTERM, lambda s, f: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,335 @@
+"""OpenAI-compatible HTTP front door of the service.
+
+Rebuild of ``http_service/service.{h,cpp}`` (SURVEY.md §2 #2): parses the
+OpenAI request, schedules it, rewrites the body with ``service_request_id``
++ ``token_ids`` + ``routing`` (so the worker never re-tokenizes,
+service.cpp:457-463), forwards to the chosen prefill worker, and returns
+the response through one of the reference's two topologies
+(rpc_service/service.h:67-79):
+
+  relay mode  — the worker's SSE/JSON response is relayed byte-for-byte
+                through a progressive reader (service.cpp:113-143, 206-222);
+  rpc mode    — (``enable_decode_response_to_service``) tokens arrive at
+                the RPC plane's ``/rpc/generations`` fan-in; this layer
+                assembles OpenAI chunks from the per-request callback.
+
+``/v1/models`` and ``/metrics`` are served from service-local state (the
+reference reverse-proxies them to a worker, service.cpp:283-336 — an
+improvement called out in SURVEY.md §5.5). ``/model/triggers`` implements
+the manual sleep/wakeup surface (service.cpp:510-550).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+from xllm_service_tpu.config import ServiceOptions
+from xllm_service_tpu.service.httpd import (
+    Request, Response, Router, http_json, http_stream)
+from xllm_service_tpu.service.response_handler import (
+    ChatStreamAssembler, CompletionStreamAssembler, full_chat_response,
+    full_completion_response)
+from xllm_service_tpu.service.scheduler import Scheduler
+from xllm_service_tpu.service.tracer import RequestTracer
+from xllm_service_tpu.utils.misc import short_uuid
+from xllm_service_tpu.utils.types import (
+    FinishReason, Request as SchedRequest, RequestOutput, SamplingParams,
+    Usage)
+
+logger = logging.getLogger(__name__)
+
+
+class HttpService:
+    def __init__(self, opts: ServiceOptions, scheduler: Scheduler) -> None:
+        self.opts = opts
+        self.scheduler = scheduler
+        self.tracer = RequestTracer(opts.trace_path,
+                                    opts.enable_request_trace)
+        self._num_requests = 0
+        self._num_errors = 0
+        self._lock = threading.Lock()
+
+    def install(self, router: Router) -> None:
+        router.route("GET", "/hello",
+                     lambda r: Response.json({"ok": True}))
+        router.route("POST", "/v1/chat/completions",
+                     lambda r: self._completions(r, is_chat=True))
+        router.route("POST", "/v1/completions",
+                     lambda r: self._completions(r, is_chat=False))
+        router.route("POST", "/v1/embeddings", self._embeddings)
+        router.route("GET", "/v1/models", self._models)
+        router.route("GET", "/metrics", self._metrics)
+        router.route("POST", "/model/triggers", self._model_triggers)
+
+    # ------------------------------------------------------------------
+    # Request building (generate_request, service.cpp:239-267)
+    # ------------------------------------------------------------------
+    def _build_request(self, body: Dict[str, Any], is_chat: bool,
+                       headers: Dict[str, str]) -> SchedRequest:
+        srid = (headers.get("x-request-id")
+                or f"{'chatcmpl' if is_chat else 'cmpl'}-{short_uuid()}")
+        sampling = SamplingParams(
+            max_tokens=body.get("max_tokens",
+                                body.get("max_completion_tokens", 16)),
+            temperature=body.get("temperature", 1.0),
+            top_p=body.get("top_p", 1.0),
+            top_k=body.get("top_k", 0),
+            n=body.get("n", 1),
+            stop=body.get("stop") or [],
+            seed=body.get("seed"),
+            ignore_eos=bool(body.get("ignore_eos", False)))
+        req = SchedRequest(
+            model=body.get("model", ""),
+            service_request_id=srid,
+            stream=bool(body.get("stream", False)),
+            include_usage=bool((body.get("stream_options") or {})
+                               .get("include_usage", False)),
+            offline=bool(body.get("offline", False)),
+            priority=int(body.get("priority", 0)),
+            prompt=body.get("prompt", "") if not is_chat else "",
+            messages=body.get("messages", []) if is_chat else [],
+            token_ids=list(body.get("token_ids") or []),
+            sampling=sampling)
+        req.trace_callback = self.tracer.callback_for(srid)
+        return req
+
+    # ------------------------------------------------------------------
+    # Completions / ChatCompletions (service.cpp:338-475)
+    # ------------------------------------------------------------------
+    def _completions(self, http_req: Request, is_chat: bool) -> Response:
+        with self._lock:
+            self._num_requests += 1
+        try:
+            body = http_req.json()
+        except (ValueError, json.JSONDecodeError):
+            return Response.error(400, "invalid JSON body")
+        kind = "chat" if is_chat else "completion"
+        if is_chat and not body.get("messages"):
+            return Response.error(400, "messages is required")
+        if not is_chat and not (body.get("prompt")
+                                or body.get("token_ids")):
+            return Response.error(400, "prompt is required")
+
+        req = self._build_request(body, is_chat, http_req.headers)
+        self.tracer.trace(req.service_request_id,
+                          {"stage": "ingress", "kind": kind, "body": body})
+        status, routing = self.scheduler.schedule(req)
+        if not status.ok:
+            with self._lock:
+                self._num_errors += 1
+            code = 503 if status.code.name == "UNAVAILABLE" else 400
+            return Response.error(code, status.message)
+
+        # Rewrite the forwarded body (service.cpp:457-463).
+        fwd = dict(body)
+        fwd["service_request_id"] = req.service_request_id
+        fwd["token_ids"] = req.token_ids
+        fwd["routing"] = routing.to_json()
+        path = "/v1/chat/completions" if is_chat else "/v1/completions"
+        target = self.scheduler.instance_mgr.address_of(
+            routing.prefill_name)
+        if target is None:
+            return Response.error(503, "routed instance vanished")
+
+        if self.opts.enable_decode_response_to_service:
+            return self._rpc_mode_response(req, fwd, target, path, is_chat)
+        return self._relay_mode_response(req, fwd, target, path)
+
+    # -- topology 1: HTTP relay (service.cpp:168-236) ---------------------
+    def _relay_mode_response(self, req: SchedRequest, fwd: Dict[str, Any],
+                             target: str, path: str) -> Response:
+        self.scheduler.record_new_request(req, lambda out: True)
+        if req.stream:
+            def relay() -> Iterator[bytes]:
+                try:
+                    for chunk in http_stream("POST", target, path, fwd):
+                        yield chunk
+                finally:
+                    self.scheduler.finish_request(req.service_request_id)
+            return Response.sse(relay())
+        try:
+            status, resp = http_json("POST", target, path, fwd,
+                                     timeout=600.0)
+        except Exception as e:  # noqa: BLE001 — worker unreachable
+            self.scheduler.finish_request(req.service_request_id,
+                                          cancelled=True)
+            with self._lock:
+                self._num_errors += 1
+            return Response.error(503, f"worker error: {e}")
+        self.scheduler.finish_request(req.service_request_id)
+        self.tracer.trace(req.service_request_id,
+                          {"stage": "egress", "body": resp})
+        return Response.json(resp, status=status)
+
+    # -- topology 2: decode → service RPC fan-in --------------------------
+    def _rpc_mode_response(self, req: SchedRequest, fwd: Dict[str, Any],
+                           target: str, path: str,
+                           is_chat: bool) -> Response:
+        out_q: "queue.Queue[Optional[RequestOutput]]" = queue.Queue()
+
+        def on_output(out: RequestOutput) -> bool:
+            out_q.put(out)
+            if out.finished or out.cancelled:
+                out_q.put(None)
+            return True
+
+        self.scheduler.record_new_request(req, on_output)
+        try:
+            status, ack = http_json("POST", target, path, fwd,
+                                    timeout=600.0)
+            if status != 200:
+                raise RuntimeError(f"worker returned {status}: {ack}")
+        except Exception as e:  # noqa: BLE001
+            self.scheduler.finish_request(req.service_request_id,
+                                          cancelled=True)
+            with self._lock:
+                self._num_errors += 1
+            return Response.error(503, f"worker error: {e}")
+
+        timeout = self.opts.request_timeout_s
+
+        def next_output() -> Optional[RequestOutput]:
+            """None = finished sentinel; raises queue.Empty on timeout —
+            a worker that acked then died must not hang the client."""
+            return out_q.get(timeout=timeout)
+
+        if req.stream:
+            asm = (ChatStreamAssembler if is_chat
+                   else CompletionStreamAssembler)(
+                req.service_request_id, req.model, req.include_usage)
+
+            def gen() -> Iterator[bytes]:
+                while True:
+                    try:
+                        out = next_output()
+                    except queue.Empty:
+                        self.scheduler.finish_request(
+                            req.service_request_id, cancelled=True)
+                        yield (b'data: {"error": {"message": '
+                               b'"generation timed out", '
+                               b'"type": "timeout"}}\n\n')
+                        return
+                    if out is None:
+                        return
+                    for frame in asm.on_output(out):
+                        yield frame
+            return Response.sse(gen())
+
+        text_parts: List[str] = []
+        usage = Usage()
+        finish = FinishReason.STOP
+        while True:
+            try:
+                out = next_output()
+            except queue.Empty:
+                self.scheduler.finish_request(req.service_request_id,
+                                              cancelled=True)
+                with self._lock:
+                    self._num_errors += 1
+                return Response.error(504, "generation timed out",
+                                      "timeout")
+            if out is None:
+                break
+            for seq in out.outputs:
+                text_parts.append(seq.text)
+                if seq.finish_reason != FinishReason.NONE:
+                    finish = seq.finish_reason
+            if out.usage:
+                usage = out.usage
+        builder = full_chat_response if is_chat \
+            else full_completion_response
+        return Response.json(builder(
+            req.service_request_id, req.model, "".join(text_parts),
+            finish, usage))
+
+    # ------------------------------------------------------------------
+    # Embeddings — the reference returns "not support" (service.cpp:492).
+    # ------------------------------------------------------------------
+    def _embeddings(self, http_req: Request) -> Response:
+        return Response.error(
+            501, "embeddings are not supported yet", "not_implemented")
+
+    # ------------------------------------------------------------------
+    # Models / metrics — service-local (improves on the reference proxy)
+    # ------------------------------------------------------------------
+    def _models(self, http_req: Request) -> Response:
+        mgr = self.scheduler.instance_mgr
+        models: Dict[str, str] = {}
+        for name in mgr.names():
+            inst = mgr.get(name)
+            if inst is None:
+                continue
+            for m, state in inst.model_states.items():
+                if m not in models or state == "awake":
+                    models[m] = state
+        return Response.json({
+            "object": "list",
+            "data": [{"id": m, "object": "model",
+                      "owned_by": "xllm-service-tpu", "state": st}
+                     for m, st in sorted(models.items())]})
+
+    def _metrics(self, http_req: Request) -> Response:
+        mgr = self.scheduler.instance_mgr
+        lines = [
+            f"xllm_service_requests_total {self._num_requests}",
+            f"xllm_service_errors_total {self._num_errors}",
+            f"xllm_service_tracked_requests "
+            f"{self.scheduler.num_tracked_requests()}",
+            f"xllm_service_instances {len(mgr.names())}",
+            f"xllm_service_prefill_instances "
+            f"{len(mgr.prefill_instances())}",
+            f"xllm_service_decode_instances "
+            f"{len(mgr.decode_instances())}",
+            f"xllm_service_cache_blocks "
+            f"{self.scheduler.kvcache_mgr.num_blocks()}",
+            f"xllm_service_is_master "
+            f"{1 if self.scheduler.is_master else 0}",
+        ]
+        for name in mgr.names():
+            inst = mgr.get(name)
+            if inst is None:
+                continue
+            tag = f'instance="{name}"'
+            lines.append(f"xllm_instance_waiting_requests{{{tag}}} "
+                         f"{inst.load.waiting_requests}")
+            lines.append(f"xllm_instance_running_requests{{{tag}}} "
+                         f"{inst.load.running_requests}")
+            lines.append(f"xllm_instance_kv_cache_usage{{{tag}}} "
+                         f"{inst.load.kv_cache_usage}")
+        return Response(body="\n".join(lines).encode() + b"\n",
+                        content_type="text/plain; version=0.0.4")
+
+    # ------------------------------------------------------------------
+    # Manual sleep/wakeup (service.cpp:510-550)
+    # ------------------------------------------------------------------
+    def _model_triggers(self, http_req: Request) -> Response:
+        body = http_req.json()
+        model = body.get("model", "")
+        action = body.get("action", "")
+        if action not in ("sleep", "wakeup"):
+            return Response.error(400, "action must be sleep|wakeup")
+        mgr = self.scheduler.instance_mgr
+        targets = ([body["instance"]] if body.get("instance")
+                   else mgr.names())
+        results: Dict[str, Any] = {}
+        for name in targets:
+            inst = mgr.get(name)
+            if inst is None or model not in inst.model_states:
+                continue
+            try:
+                status, resp = mgr.control(
+                    inst.meta.rpc_address, f"/{action}", {"model": model})
+                if status == 200:
+                    inst.model_states[model] = (
+                        "asleep" if action == "sleep" else "awake")
+                results[name] = status
+            except Exception as e:  # noqa: BLE001
+                results[name] = str(e)
+        if not results:
+            return Response.error(404,
+                                  f"model {model} not found on any instance")
+        return Response.json({"ok": True, "results": results})

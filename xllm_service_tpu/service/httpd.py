@@ -29,7 +29,9 @@ class Request:
         self.method = method
         self.path = path
         self.query = query
-        self.headers = headers
+        # HTTP header names are case-insensitive; normalize to lowercase
+        # so lookups like headers.get("x-request-id") always hit.
+        self.headers = {k.lower(): v for k, v in headers.items()}
         self.body = body
 
     def param(self, name: str, default: str = "") -> str:

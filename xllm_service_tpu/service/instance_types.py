@@ -1,0 +1,187 @@
+"""Instance-plane value types: metainfo, load/latency/request metrics.
+
+Python equivalents of the reference's ``common/types.h`` cluster types:
+``InstanceMetaInfo`` (types.h:193-258 — name, rpc address, role type,
+KV-transfer handles, profiling data), ``LoadMetrics`` (types.h:81-115),
+``LatencyMetrics`` (types.h:118-127), ``RequestMetrics`` (types.h:138-155).
+These cross the coordination store and heartbeats as JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from xllm_service_tpu.config import InstanceType
+
+
+@dataclasses.dataclass
+class InstanceMetaInfo:
+    """Registration record a worker writes under ``XLLM:<TYPE>:<name>``.
+
+    ``cluster_ids``/``addrs``/``k_cache_ids``/``v_cache_ids``/``dp_size``
+    keep the reference's KV-transfer brokerage contract (types.h:174-178):
+    for the TPU worker, ``addrs`` are the worker KV-transfer endpoints and
+    the cache ids name its preallocated per-layer KV page pools.
+    """
+
+    name: str = ""                      # "host:port" of the worker HTTP server
+    rpc_address: str = ""               # where the service reaches the worker
+    instance_type: InstanceType = InstanceType.DEFAULT
+    models: List[str] = dataclasses.field(default_factory=list)
+    # KV-transfer brokerage handles.
+    cluster_ids: List[int] = dataclasses.field(default_factory=list)
+    addrs: List[str] = dataclasses.field(default_factory=list)
+    k_cache_ids: List[int] = dataclasses.field(default_factory=list)
+    v_cache_ids: List[int] = dataclasses.field(default_factory=list)
+    dp_size: int = 1
+    # Profiling samples for the SLO TimePredictor (types.h:180-182):
+    # ttft: [(num_tokens, ttft_ms)], tpot: [(batch, seq_len, tpot_ms)].
+    ttft_profiling_data: List[Tuple[float, float]] = \
+        dataclasses.field(default_factory=list)
+    tpot_profiling_data: List[Tuple[float, float, float]] = \
+        dataclasses.field(default_factory=list)
+    # Serverless memory accounting (GB) for the multi-model allocator.
+    memory_budget_gb: float = 60.0
+
+    def to_json(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["instance_type"] = self.instance_type.value
+        return d
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "InstanceMetaInfo":
+        try:
+            itype = InstanceType(d.get("instance_type", "DEFAULT"))
+        except ValueError:
+            itype = InstanceType.DEFAULT
+        return cls(
+            name=d.get("name", ""),
+            rpc_address=d.get("rpc_address", d.get("name", "")),
+            instance_type=itype,
+            models=list(d.get("models", [])),
+            cluster_ids=list(d.get("cluster_ids", [])),
+            addrs=list(d.get("addrs", [])),
+            k_cache_ids=list(d.get("k_cache_ids", [])),
+            v_cache_ids=list(d.get("v_cache_ids", [])),
+            dp_size=d.get("dp_size", 1),
+            ttft_profiling_data=[tuple(x) for x in
+                                 d.get("ttft_profiling_data", [])],
+            tpot_profiling_data=[tuple(x) for x in
+                                 d.get("tpot_profiling_data", [])],
+            memory_budget_gb=d.get("memory_budget_gb", 60.0),
+        )
+
+
+@dataclasses.dataclass
+class LoadMetrics:
+    """Queue/cache pressure shipped in every heartbeat (types.h:81-115)."""
+
+    waiting_requests: int = 0
+    running_requests: int = 0
+    kv_cache_usage: float = 0.0          # [0, 1]
+    num_preemptions: int = 0
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: Optional[Dict[str, Any]]) -> "LoadMetrics":
+        if not d:
+            return cls()
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class LatencyMetrics:
+    """Recent max TTFT / inter-token latency (types.h:118-127)."""
+
+    recent_max_ttft_ms: float = 0.0
+    recent_max_tbt_ms: float = 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: Optional[Dict[str, Any]]) -> "LatencyMetrics":
+        if not d:
+            return cls()
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class RequestMetrics:
+    """Service-side in-flight ledger per instance (types.h:138-155,
+    maintained like instance_mgr.cpp:745-817): what the SLO policy uses to
+    estimate prefill backlog and decode load."""
+
+    num_prefill_requests: int = 0
+    num_prefill_tokens: int = 0
+    num_decode_requests: int = 0
+    num_decode_tokens: int = 0
+    estimated_prefill_time_ms: float = 0.0
+    estimated_ttft_ms: float = 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class RequestPhase:
+    """Request-metrics transition points (reference update_request_metrics
+    call sites: SCHEDULE scheduler.cpp:127, PREFILL_FINISH :183-202,
+    GENERATE :345, FINISH_DECODE/CANCEL :304-327)."""
+
+    SCHEDULE = "schedule"
+    PREFILL_FINISH = "prefill_finish"
+    GENERATE = "generate"
+    FINISH_DECODE = "finish_decode"
+    CANCEL = "cancel"
+
+
+@dataclasses.dataclass
+class Heartbeat:
+    """Wire form of one worker heartbeat (xllm_rpc_service.proto
+    HeartbeatRequest)."""
+
+    name: str = ""
+    instance_type: InstanceType = InstanceType.DEFAULT
+    load: LoadMetrics = dataclasses.field(default_factory=LoadMetrics)
+    latency: LatencyMetrics = dataclasses.field(default_factory=LatencyMetrics)
+    # Prefix-cache delta: hex digests stored/removed since last beat.
+    cache_stored: List[str] = dataclasses.field(default_factory=list)
+    cache_removed: List[str] = dataclasses.field(default_factory=list)
+    # Per-model sleep/wake state for the serverless layer.
+    model_states: Dict[str, str] = dataclasses.field(default_factory=dict)
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "instance_type": self.instance_type.value,
+            "load": self.load.to_json(),
+            "latency": self.latency.to_json(),
+            "cache_stored": self.cache_stored,
+            "cache_removed": self.cache_removed,
+            "model_states": self.model_states,
+            "timestamp": self.timestamp,
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "Heartbeat":
+        try:
+            itype = InstanceType(d.get("instance_type", "DEFAULT"))
+        except ValueError:
+            itype = InstanceType.DEFAULT
+        return cls(
+            name=d.get("name", ""),
+            instance_type=itype,
+            load=LoadMetrics.from_json(d.get("load")),
+            latency=LatencyMetrics.from_json(d.get("latency")),
+            cache_stored=list(d.get("cache_stored", [])),
+            cache_removed=list(d.get("cache_removed", [])),
+            model_states=dict(d.get("model_states", {})),
+            timestamp=d.get("timestamp", time.time()),
+        )

@@ -1,0 +1,105 @@
+"""Load-balance policies: RoundRobin / CacheAwareRouting / SloAware.
+
+Rebuild of ``scheduler/loadbalance_policy/`` (SURVEY.md §2 #9-11). Each
+policy picks a (prefill, decode) instance pair for one tokenized request.
+Unlike the reference — whose ``schedule()`` bypasses the pluggable policy
+(scheduler.cpp:100-119, TODO at :102; SURVEY.md §7.4) — the scheduler here
+actually routes through the configured policy.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Optional, Tuple
+
+from xllm_service_tpu.config import LoadBalancePolicyType, ServiceOptions
+from xllm_service_tpu.service.instance_mgr import InstanceMgr
+from xllm_service_tpu.service.kvcache_mgr import GlobalKVCacheMgr
+
+
+class LoadBalancePolicy(abc.ABC):
+    """``select_instances_pair`` (reference loadbalance_policy.h:25-35)."""
+
+    def __init__(self, mgr: InstanceMgr) -> None:
+        self.mgr = mgr
+
+    @abc.abstractmethod
+    def select_instances_pair(self, token_ids: List[int]
+                              ) -> Tuple[Optional[str], Optional[str]]: ...
+
+
+class RoundRobinPolicy(LoadBalancePolicy):
+    """Delegates to the instance manager's RR indexes
+    (round_robin.cpp:18-22)."""
+
+    def select_instances_pair(self, token_ids):
+        return self.mgr.get_next_instance_pair()
+
+
+class CacheAwareRoutingPolicy(LoadBalancePolicy):
+    """Score = prefix-match ratio − kv-cache usage − waiting-queue ratio,
+    argmax per pool; least-loaded fallback when nothing overlaps
+    (cache_aware_routing.cpp:22-87)."""
+
+    def __init__(self, mgr: InstanceMgr, kvcache: GlobalKVCacheMgr,
+                 block_size: int = 128) -> None:
+        super().__init__(mgr)
+        self.kvcache = kvcache
+        self.block_size = block_size
+
+    def _cost(self, name: str, match_score: float,
+              total_blocks: int) -> Optional[float]:
+        inst = self.mgr.get(name)
+        if inst is None:
+            return None
+        match_ratio = match_score / max(total_blocks, 1)
+        waiting_ratio = min(inst.load.waiting_requests / 16.0, 1.0)
+        return match_ratio - inst.load.kv_cache_usage - waiting_ratio
+
+    def _pick(self, pool: List[str], scores, total_blocks: int
+              ) -> Optional[str]:
+        best, best_cost = None, None
+        for name in pool:
+            cost = self._cost(name, scores.get(name, 0.0), total_blocks)
+            if cost is None:
+                continue
+            if best_cost is None or cost > best_cost:
+                best, best_cost = name, cost
+        if best is None or scores.get(best, 0.0) == 0.0:
+            fallback = self.mgr.least_loaded_instance(pool)
+            return fallback or best
+        return best
+
+    def select_instances_pair(self, token_ids):
+        total_blocks = max(len(token_ids) // self.block_size, 1)
+        _, scores = self.kvcache.match(token_ids)
+        prefill = self._pick(self.mgr.prefill_instances(), scores,
+                             total_blocks)
+        decode = self._pick(self.mgr.decode_instances(), scores,
+                            total_blocks)
+        return prefill if prefill is not None else decode, decode
+
+
+class SloAwarePolicy(LoadBalancePolicy):
+    """Routes via the TimePredictor-driven SLO selection; RR fallback for
+    un-tokenized requests (slo_aware_policy.cpp:26-38)."""
+
+    def select_instances_pair(self, token_ids):
+        if not token_ids:
+            return self.mgr.get_next_instance_pair()
+        prefill, decode, _ = self.mgr.select_instance_pair_on_slo(
+            len(token_ids))
+        if prefill is None:
+            prefill, rr_decode = self.mgr.get_next_instance_pair()
+            decode = decode or rr_decode
+        return prefill, decode
+
+
+def create_policy(opts: ServiceOptions, mgr: InstanceMgr,
+                  kvcache: GlobalKVCacheMgr) -> LoadBalancePolicy:
+    """Factory (reference scheduler.cpp:47-54)."""
+    if opts.load_balance_policy == LoadBalancePolicyType.CACHE_AWARE:
+        return CacheAwareRoutingPolicy(mgr, kvcache, opts.block_size)
+    if opts.load_balance_policy == LoadBalancePolicyType.SLO_AWARE:
+        return SloAwarePolicy(mgr)
+    return RoundRobinPolicy(mgr)

@@ -1,0 +1,134 @@
+"""Master: service process entry — builds the scheduler and runs both
+servers.
+
+Rebuild of ``master.{h,cpp}`` (SURVEY.md §2 #1): constructs the Scheduler,
+installs the HTTP (OpenAI) and RPC (worker) services on two servers, and
+runs until asked to stop. The reference starts two brpc servers on separate
+threads (master.cpp:60-140); here each ``HttpServer`` owns its own accept
+thread, and ``main()`` mirrors the reference's gflags surface
+(common/global_gflags.cpp) with argparse.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+from typing import Dict, List, Optional
+
+from xllm_service_tpu.config import (
+    LoadBalancePolicyType, ServiceOptions, options_from_env)
+from xllm_service_tpu.service.coordination import CoordinationStore
+from xllm_service_tpu.service.coordination_net import connect_store
+from xllm_service_tpu.service.http_service import HttpService
+from xllm_service_tpu.service.httpd import HttpServer, Router
+from xllm_service_tpu.service.rpc_service import RpcService
+from xllm_service_tpu.service.scheduler import Scheduler
+
+logger = logging.getLogger(__name__)
+
+
+class Master:
+    def __init__(self, opts: ServiceOptions,
+                 store: Optional[CoordinationStore] = None,
+                 control=None,
+                 model_memory_gb: Optional[Dict[str, float]] = None,
+                 serverless_models: Optional[List[str]] = None) -> None:
+        self.opts = opts
+        self.store = store if store is not None \
+            else connect_store(opts.etcd_addr)
+        self.scheduler = Scheduler(
+            opts, self.store, control=control,
+            model_memory_gb=model_memory_gb,
+            serverless_models=serverless_models)
+        self.http_service = HttpService(opts, self.scheduler)
+        self.rpc_service = RpcService(opts, self.scheduler)
+
+        http_router = Router()
+        self.http_service.install(http_router)
+        self._http_srv = HttpServer(opts.host, opts.http_port, http_router)
+
+        rpc_router = Router()
+        self.rpc_service.install(rpc_router)
+        self._rpc_srv = HttpServer(opts.host, opts.rpc_port, rpc_router)
+
+        self._stopped = threading.Event()
+
+    @property
+    def http_address(self) -> str:
+        return self._http_srv.address
+
+    @property
+    def rpc_address(self) -> str:
+        return self._rpc_srv.address
+
+    def start(self) -> "Master":
+        self._http_srv.start()
+        self._rpc_srv.start()
+        logger.info("service up: http=%s rpc=%s master=%s",
+                    self.http_address, self.rpc_address,
+                    self.scheduler.is_master)
+        return self
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        self._http_srv.stop()
+        self._rpc_srv.stop()
+        self.scheduler.stop()
+
+    def wait(self) -> None:
+        self._stopped.wait()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="xllm-service-tpu master (service process)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--http-port", type=int, default=9888)
+    parser.add_argument("--rpc-port", type=int, default=9889)
+    parser.add_argument("--etcd-addr", default="",
+                        help="coordination store host:port "
+                             "('' = in-process store)")
+    parser.add_argument("--load-balance-policy", default="CAR",
+                        choices=[p.value for p in LoadBalancePolicyType])
+    parser.add_argument("--block-size", type=int, default=128)
+    parser.add_argument("--murmur-hash3-seed", type=int, default=0)
+    parser.add_argument("--tokenizer-path", default="")
+    parser.add_argument("--enable-request-trace", action="store_true")
+    parser.add_argument("--enable-decode-response-to-service",
+                        action="store_true")
+    parser.add_argument("--target-ttft-ms", type=float, default=1000.0)
+    parser.add_argument("--target-tpot-ms", type=float, default=50.0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    opts = options_from_env(
+        host=args.host, http_port=args.http_port, rpc_port=args.rpc_port,
+        etcd_addr=args.etcd_addr,
+        load_balance_policy=LoadBalancePolicyType(args.load_balance_policy),
+        block_size=args.block_size,
+        murmur_hash3_seed=args.murmur_hash3_seed,
+        tokenizer_path=args.tokenizer_path,
+        enable_request_trace=args.enable_request_trace,
+        target_ttft_ms=args.target_ttft_ms,
+        target_tpot_ms=args.target_tpot_ms)
+    if args.enable_decode_response_to_service:
+        opts.enable_decode_response_to_service = True
+
+    master = Master(opts).start()
+
+    def on_signal(signum, frame) -> None:
+        logger.info("signal %d: shutting down", signum)
+        master.stop()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    master.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

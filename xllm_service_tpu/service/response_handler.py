@@ -1,0 +1,166 @@
+"""ResponseHandler: OpenAI chat/completion payload assembly.
+
+Rebuild of ``scheduler/response_handler.{h,cpp}`` — the exact streaming
+chunk grammar matters for OpenAI-SDK compatibility and is golden-tested:
+
+  chat stream:  role chunk → content delta chunks → finish_reason chunk →
+                (optional) usage chunk → ``data: [DONE]``
+                (response_handler.cpp:20-134)
+  completion stream: text delta chunks → finish chunk → usage → [DONE]
+  non-stream:   one full JSON body (:136-216, :218-278, :280-326)
+
+SSE framing (``data: <json>\\n\\n``) mirrors the reference's
+``StreamCallData::write`` (common/call_data.h:173-201).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from xllm_service_tpu.utils.types import FinishReason, RequestOutput, Usage
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def sse_frame(obj: Dict[str, Any]) -> bytes:
+    return b"data: " + json.dumps(obj, separators=(",", ":")).encode() \
+        + b"\n\n"
+
+
+class ChatStreamAssembler:
+    """Builds the chat-completion SSE chunk sequence for one request."""
+
+    def __init__(self, request_id: str, model: str,
+                 include_usage: bool = False) -> None:
+        self.request_id = request_id
+        self.model = model
+        self.include_usage = include_usage
+        self.created = _now()
+        self._sent_role = False
+        self._usage = Usage()
+
+    def _chunk(self, delta: Dict[str, Any],
+               finish_reason: Optional[str] = None) -> Dict[str, Any]:
+        return {
+            "id": self.request_id,
+            "object": "chat.completion.chunk",
+            "created": self.created,
+            "model": self.model,
+            "choices": [{"index": 0, "delta": delta,
+                         "finish_reason": finish_reason}],
+        }
+
+    def on_output(self, out: RequestOutput) -> List[bytes]:
+        frames: List[bytes] = []
+        if not self._sent_role:
+            frames.append(sse_frame(self._chunk({"role": "assistant"})))
+            self._sent_role = True
+        if out.usage:
+            self._usage = out.usage
+        for seq in out.outputs:
+            if seq.text:
+                frames.append(sse_frame(
+                    self._chunk({"content": seq.text})))
+            if seq.finish_reason != FinishReason.NONE:
+                frames.append(sse_frame(
+                    self._chunk({}, seq.finish_reason.openai)))
+        if out.finished:
+            if self.include_usage:
+                frames.append(sse_frame({
+                    "id": self.request_id,
+                    "object": "chat.completion.chunk",
+                    "created": self.created,
+                    "model": self.model,
+                    "choices": [],
+                    "usage": self._usage.to_json(),
+                }))
+            frames.append(SSE_DONE)
+        return frames
+
+
+class CompletionStreamAssembler:
+    """Text-completion SSE chunks (response_handler.cpp:218-278)."""
+
+    def __init__(self, request_id: str, model: str,
+                 include_usage: bool = False) -> None:
+        self.request_id = request_id
+        self.model = model
+        self.include_usage = include_usage
+        self.created = _now()
+        self._usage = Usage()
+
+    def _chunk(self, text: str,
+               finish_reason: Optional[str] = None) -> Dict[str, Any]:
+        return {
+            "id": self.request_id,
+            "object": "text_completion",
+            "created": self.created,
+            "model": self.model,
+            "choices": [{"index": 0, "text": text, "logprobs": None,
+                         "finish_reason": finish_reason}],
+        }
+
+    def on_output(self, out: RequestOutput) -> List[bytes]:
+        frames: List[bytes] = []
+        if out.usage:
+            self._usage = out.usage
+        for seq in out.outputs:
+            if seq.text:
+                frames.append(sse_frame(self._chunk(seq.text)))
+            if seq.finish_reason != FinishReason.NONE:
+                frames.append(sse_frame(
+                    self._chunk("", seq.finish_reason.openai)))
+        if out.finished:
+            if self.include_usage:
+                frames.append(sse_frame({
+                    "id": self.request_id,
+                    "object": "text_completion",
+                    "created": self.created,
+                    "model": self.model,
+                    "choices": [],
+                    "usage": self._usage.to_json(),
+                }))
+            frames.append(SSE_DONE)
+        return frames
+
+
+def full_chat_response(request_id: str, model: str, text: str,
+                       finish_reason: FinishReason, usage: Usage
+                       ) -> Dict[str, Any]:
+    """Non-streaming chat completion (response_handler.cpp:136-216)."""
+    return {
+        "id": request_id,
+        "object": "chat.completion",
+        "created": _now(),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": finish_reason.openai or "stop",
+        }],
+        "usage": usage.to_json(),
+    }
+
+
+def full_completion_response(request_id: str, model: str, text: str,
+                             finish_reason: FinishReason, usage: Usage
+                             ) -> Dict[str, Any]:
+    return {
+        "id": request_id,
+        "object": "text_completion",
+        "created": _now(),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "text": text,
+            "logprobs": None,
+            "finish_reason": finish_reason.openai or "stop",
+        }],
+        "usage": usage.to_json(),
+    }

@@ -1,0 +1,81 @@
+"""Worker-facing RPC plane of the service.
+
+Rebuild of ``rpc_service/service.{h,cpp}`` (SURVEY.md §2 #3): heartbeat
+ingestion, instance metainfo queries, static PD lists, the ``Generations``
+token fan-in (decode worker → service, response topology 2), and
+``GetConfig`` exposing ``enable_decode_response_to_service``
+(rpc_service/service.cpp:215-223). Carried over HTTP/JSON instead of brpc
+baidu_std; the method surface is the same.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+from xllm_service_tpu.config import ServiceOptions
+from xllm_service_tpu.service.httpd import Request, Response, Router
+from xllm_service_tpu.service.instance_types import Heartbeat
+from xllm_service_tpu.service.scheduler import Scheduler
+from xllm_service_tpu.utils.types import RequestOutput
+
+logger = logging.getLogger(__name__)
+
+
+class RpcService:
+    def __init__(self, opts: ServiceOptions, scheduler: Scheduler) -> None:
+        self.opts = opts
+        self.scheduler = scheduler
+
+    def install(self, router: Router) -> None:
+        router.route("GET", "/rpc/hello",
+                     lambda r: Response.json({"ok": True}))
+        router.route("POST", "/rpc/heartbeat", self.heartbeat)
+        router.route("POST", "/rpc/generations", self.generations)
+        router.route("GET", "/rpc/instance_info", self.instance_info)
+        router.route("GET", "/rpc/static_prefill_list",
+                     self.static_prefill_list)
+        router.route("GET", "/rpc/static_decode_list",
+                     self.static_decode_list)
+        router.route("GET", "/rpc/config", self.get_config)
+
+    # -- Heartbeat (rpc_service/service.cpp:114-121) ----------------------
+    def heartbeat(self, req: Request) -> Response:
+        hb = Heartbeat.from_json(req.json())
+        if not hb.name:
+            return Response.error(400, "heartbeat missing name")
+        registered = self.scheduler.handle_instance_heartbeat(hb)
+        return Response.json({"ok": True, "registered": registered})
+
+    # -- Generations fan-in (rpc_service/service.cpp:149-213) -------------
+    def generations(self, req: Request) -> Response:
+        body = req.json()
+        for d in body.get("outputs", []):
+            out = RequestOutput.from_json(d)
+            self.scheduler.handle_generation(out)
+        return Response.json({"ok": True})
+
+    # -- Instance queries (rpc_service/service.cpp:81-147) ----------------
+    def instance_info(self, req: Request) -> Response:
+        name = req.param("name")
+        info = self.scheduler.instance_mgr.instance_info(name)
+        if info is None:
+            return Response.error(404, f"unknown instance {name}")
+        return Response.json(info)
+
+    def static_prefill_list(self, req: Request) -> Response:
+        return Response.json(
+            {"instances": self.scheduler.instance_mgr.prefill_instances()})
+
+    def static_decode_list(self, req: Request) -> Response:
+        return Response.json(
+            {"instances": self.scheduler.instance_mgr.decode_instances()})
+
+    # -- GetConfig (rpc_service/service.cpp:215-223) ----------------------
+    def get_config(self, req: Request) -> Response:
+        return Response.json({
+            "enable_decode_response_to_service":
+                self.opts.enable_decode_response_to_service,
+            "block_size": self.opts.block_size,
+            "murmur_hash3_seed": self.opts.murmur_hash3_seed,
+        })

@@ -1,0 +1,310 @@
+"""Scheduler: per-request orchestration + cluster state + HA election.
+
+Rebuild of ``scheduler/scheduler.{h,cpp}`` (SURVEY.md §2 #4, §3.2-3.5):
+
+- ``schedule(request)``: chat template → tokenize → model heat → route
+  (serverless awake/allocate for multi-model; the configured LB policy for
+  the PD pair — composed, fixing the reference quirk where ``schedule()``
+  bypasses ``lb_policy_``, scheduler.cpp:100-119 TODO, SURVEY.md §7.4);
+- request registry keyed by ``service_request_id`` with per-request output
+  callbacks (scheduler.cpp:197-302);
+- token fan-in through N single-thread pools with per-request pinning so
+  token order is preserved (scheduler.h:113-120, via
+  ``utils.misc.OrderedFanInPools``);
+- master election: ``compare_create`` on ``XLLM:SERVICE:MASTER`` with a TTL
+  lease + keepalive; replicas watch the key and take over on expiry
+  (scheduler.cpp:25-66, 158-175); the master uploads aggregated load
+  metrics and the KV-cache index every ``master_upload_interval_s``
+  (scheduler.cpp:138-146).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from xllm_service_tpu.config import ServiceOptions
+from xllm_service_tpu.nlp.chat_template import ChatTemplate
+from xllm_service_tpu.nlp.tokenizer import Tokenizer, TokenizerFactory
+from xllm_service_tpu.service.coordination import (
+    KEY_MASTER, CoordinationStore)
+from xllm_service_tpu.service.instance_mgr import InstanceMgr
+from xllm_service_tpu.service.instance_types import (
+    Heartbeat, RequestPhase)
+from xllm_service_tpu.service.kvcache_mgr import GlobalKVCacheMgr
+from xllm_service_tpu.service.lb_policy import create_policy
+from xllm_service_tpu.utils.misc import OrderedFanInPools, short_uuid
+from xllm_service_tpu.utils.types import (
+    OutputCallback, Request, RequestOutput, Routing, Status, StatusCode)
+
+logger = logging.getLogger(__name__)
+
+
+class _TrackedRequest:
+    __slots__ = ("request", "output_callback", "created",
+                 "prefill_name", "decode_name", "prefill_done",
+                 "num_generated")
+
+    def __init__(self, request: Request,
+                 output_callback: OutputCallback) -> None:
+        self.request = request
+        self.output_callback = output_callback
+        self.created = time.monotonic()
+        self.prefill_name = request.routing.prefill_name
+        self.decode_name = request.routing.decode_name
+        self.prefill_done = False
+        self.num_generated = 0
+
+
+class Scheduler:
+    def __init__(self, opts: ServiceOptions, store: CoordinationStore,
+                 control=None,
+                 model_memory_gb: Optional[Dict[str, float]] = None,
+                 serverless_models: Optional[List[str]] = None) -> None:
+        self.opts = opts
+        self.store = store
+        self.service_id = f"service-{short_uuid(8)}"
+
+        self.tokenizer: Tokenizer = TokenizerFactory.create_tokenizer(
+            opts.tokenizer_path)
+        self.chat_template = ChatTemplate.from_model_dir(opts.tokenizer_path)
+
+        # --- leader election (scheduler.cpp:25-66) -----------------------
+        self._lease_id = store.lease_grant(
+            max(3 * opts.heartbeat_interval_s, 3.0))
+        self.is_master = store.compare_create(
+            KEY_MASTER, self.service_id, self._lease_id)
+        self._master_watch: Optional[int] = None
+        if not self.is_master:
+            self._master_watch = store.add_watch(
+                KEY_MASTER, self._on_master_event)
+
+        self.instance_mgr = InstanceMgr(
+            opts, store, is_master=self.is_master, control=control,
+            model_memory_gb=model_memory_gb,
+            serverless_models=serverless_models)
+        self.kvcache_mgr = GlobalKVCacheMgr(
+            store, block_size=opts.block_size, seed=opts.murmur_hash3_seed,
+            is_master=self.is_master)
+        self.instance_mgr.on_removed = self._on_instance_removed
+        self.lb_policy = create_policy(opts, self.instance_mgr,
+                                       self.kvcache_mgr)
+
+        self._requests: Dict[str, _TrackedRequest] = {}
+        self._req_lock = threading.Lock()
+        self._pools = OrderedFanInPools(opts.num_output_pools)
+
+        self._stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._master_loop, name="scheduler-master-loop",
+            daemon=True)
+        self._hb_thread.start()
+
+    # ------------------------------------------------------------------
+    # Election / master loop
+    # ------------------------------------------------------------------
+    def _on_master_event(self, event) -> None:
+        ev_type, _key, _value = event
+        if ev_type != "DELETE" or self._stop.is_set():
+            return
+        # Master lease expired → try to take over (scheduler.cpp:158-175).
+        if self.store.compare_create(KEY_MASTER, self.service_id,
+                                     self._lease_id):
+            self.is_master = True
+            self.instance_mgr.is_master = True
+            self.kvcache_mgr.is_master = True
+            logger.info("%s took over as master", self.service_id)
+
+    def _master_loop(self) -> None:
+        """Keepalive + periodic state upload (scheduler.cpp:138-146)."""
+        interval = self.opts.master_upload_interval_s
+        while not self._stop.wait(interval):
+            try:
+                self.store.lease_keepalive(self._lease_id)
+                if self.is_master:
+                    self.instance_mgr.upload_load_metrics()
+                    self.kvcache_mgr.upload_kvcache()
+            except Exception as e:  # noqa: BLE001 — store hiccup, retry next tick
+                logger.warning("master loop error: %s", e)
+
+    # ------------------------------------------------------------------
+    # schedule (scheduler.cpp:70-131)
+    # ------------------------------------------------------------------
+    def preprocess(self, request: Request) -> None:
+        """Chat template + tokenize (fills prompt/token_ids/mm_inputs)."""
+        if request.messages and not request.prompt:
+            prompt, mm = self.chat_template.apply(request.messages)
+            request.prompt = prompt
+            if mm:
+                request.mm_inputs = mm
+        if not request.token_ids and request.prompt:
+            request.token_ids = self.tokenizer.encode(request.prompt)
+
+    def schedule(self, request: Request) -> Tuple[Status, Routing]:
+        if not request.service_request_id:
+            request.service_request_id = f"req-{short_uuid()}"
+        try:
+            self.preprocess(request)
+        except Exception as e:  # noqa: BLE001 — bad template/input is a 400
+            return Status(StatusCode.INVALID_ARGUMENT, str(e)), Routing()
+        if not request.token_ids:
+            return Status(StatusCode.INVALID_ARGUMENT,
+                          "empty prompt"), Routing()
+
+        if request.model:
+            self.instance_mgr.update_model_heat(request.model)
+
+        # Serverless multi-model path: the target must have the model awake
+        # (scheduler.cpp:100-119 → instance_mgr.cpp:1087-1185).
+        if request.model and self.instance_mgr.serverless_models:
+            name = self.instance_mgr.get_awake_instance(request.model)
+            if name is None:
+                name = self.instance_mgr.allocate_instance_for_model(
+                    request.model)
+            if name is None:
+                return Status(StatusCode.UNAVAILABLE,
+                              f"no instance for model {request.model}"
+                              ), Routing()
+            routing = Routing(prefill_name=name, decode_name=name)
+        else:
+            prefill, decode = self.lb_policy.select_instances_pair(
+                request.token_ids)
+            if prefill is None:
+                return Status(StatusCode.UNAVAILABLE,
+                              "no prefill instance available"), Routing()
+            routing = Routing(prefill_name=prefill,
+                              decode_name=decode or prefill)
+
+        request.routing = routing
+        self.instance_mgr.update_request_metrics(
+            routing.prefill_name, RequestPhase.SCHEDULE,
+            len(request.token_ids))
+        return Status(), routing
+
+    # ------------------------------------------------------------------
+    # Registry + token fan-in (scheduler.cpp:197-302, 329-372)
+    # ------------------------------------------------------------------
+    def record_new_request(self, request: Request,
+                           output_callback: OutputCallback) -> None:
+        tracked = _TrackedRequest(request, output_callback)
+        with self._req_lock:
+            self._requests[request.service_request_id] = tracked
+        # Pin to a fan-in pool up front so ordering starts at token one.
+        self._pools.pool_for(request.service_request_id)
+
+    def handle_generation(self, out: RequestOutput) -> None:
+        """Per-token hot path: dispatch to the request's pinned pool."""
+        srid = out.service_request_id or out.request_id
+        with self._req_lock:
+            tracked = self._requests.get(srid)
+        if tracked is None:
+            logger.debug("generation for unknown request %s", srid)
+            return
+        num_tokens = sum(len(s.token_ids) for s in out.outputs)
+        tracked.num_generated += num_tokens
+        decode_name = tracked.decode_name
+        if decode_name:
+            if not tracked.prefill_done:
+                tracked.prefill_done = True
+                self.instance_mgr.update_request_metrics(
+                    tracked.prefill_name, RequestPhase.PREFILL_FINISH,
+                    len(tracked.request.token_ids))
+            self.instance_mgr.update_request_metrics(
+                decode_name, RequestPhase.GENERATE, num_tokens)
+        self._pools.submit(srid, lambda: self._deliver(tracked, out))
+
+    def _deliver(self, tracked: _TrackedRequest,
+                 out: RequestOutput) -> None:
+        keep = True
+        try:
+            keep = tracked.output_callback(out)
+        except Exception:  # noqa: BLE001 — client callback must not kill the pool
+            keep = False
+        if out.finished or out.cancelled or not keep:
+            self.finish_request(
+                tracked.request.service_request_id,
+                cancelled=out.cancelled or not keep)
+
+    def finish_request(self, service_request_id: str,
+                       cancelled: bool = False) -> None:
+        """Teardown (scheduler.cpp:304-327)."""
+        with self._req_lock:
+            tracked = self._requests.pop(service_request_id, None)
+        if tracked is None:
+            return
+        self._pools.release(service_request_id)
+        # Relay mode never sees per-token generations, so the SCHEDULE-phase
+        # prefill increments must be drained here or the ledger grows
+        # forever and starves the busiest instances under SLO routing.
+        if not tracked.prefill_done and tracked.prefill_name:
+            tracked.prefill_done = True
+            self.instance_mgr.update_request_metrics(
+                tracked.prefill_name, RequestPhase.PREFILL_FINISH,
+                len(tracked.request.token_ids))
+        phase = RequestPhase.CANCEL if cancelled \
+            else RequestPhase.FINISH_DECODE
+        name = tracked.decode_name or tracked.prefill_name
+        if name:
+            self.instance_mgr.update_request_metrics(
+                name, phase, len(tracked.request.token_ids)
+                + tracked.num_generated)
+
+    def fail_requests_on_instance(self, instance: str) -> int:
+        """Cancel every tracked request routed to a dead instance so RPC-
+        mode clients get an error instead of hanging (the reference lacks
+        re-dispatch entirely, SURVEY.md §5.3 — here failures at least
+        terminate promptly)."""
+        with self._req_lock:
+            victims = [t for t in self._requests.values()
+                       if instance in (t.prefill_name, t.decode_name)]
+        for tracked in victims:
+            out = RequestOutput(
+                request_id=tracked.request.service_request_id,
+                service_request_id=tracked.request.service_request_id,
+                status=Status(StatusCode.UNAVAILABLE,
+                              f"instance {instance} died"),
+                finished=True, cancelled=True)
+            self.handle_generation(out)
+        return len(victims)
+
+    def num_tracked_requests(self) -> int:
+        with self._req_lock:
+            return len(self._requests)
+
+    def _on_instance_removed(self, name: str) -> None:
+        self.kvcache_mgr.remove_instance(name)
+        self.fail_requests_on_instance(name)
+
+    # ------------------------------------------------------------------
+    # Heartbeats (scheduler.cpp:148-156)
+    # ------------------------------------------------------------------
+    def handle_instance_heartbeat(self, hb: Heartbeat) -> bool:
+        registered = self.instance_mgr.on_heartbeat(hb)
+        if registered and (hb.cache_stored or hb.cache_removed):
+            self.kvcache_mgr.record_updated_kvcaches(
+                hb.name,
+                stored=[bytes.fromhex(h) for h in hb.cache_stored],
+                removed=[bytes.fromhex(h) for h in hb.cache_removed])
+        return registered
+
+    # ------------------------------------------------------------------
+    def pick_serving_instance(self) -> Optional[str]:
+        """Direct instance pick for /v1/models and /metrics proxying —
+        without a fake schedule() round-trip (fixes SURVEY.md §7.4 quirk)."""
+        prefill, _ = self.instance_mgr.get_next_instance_pair()
+        return prefill
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._hb_thread.join(timeout=5)
+        self.instance_mgr.close()
+        self.kvcache_mgr.close()
+        if self._master_watch is not None:
+            self.store.cancel_watch(self._master_watch)
+        try:
+            self.store.lease_revoke(self._lease_id)
+        except Exception:  # noqa: BLE001 — store may already be gone
+            pass
+        self._pools.stop()

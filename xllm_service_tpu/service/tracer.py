@@ -1,0 +1,48 @@
+"""RequestTracer: per-request JSONL trace appender.
+
+Rebuild of ``http_service/request_tracer.{h,cpp}``: when enabled, every
+inbound/outbound payload of a request is appended as
+``{"timestamp", "service_request_id", "data"}`` lines under a mutex
+(request_tracer.cpp:37-59), wired into request handling as a
+``trace_callback``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class RequestTracer:
+    def __init__(self, path: str = "trace/trace.json",
+                 enable: bool = False) -> None:
+        self.enable = enable
+        self.path = path
+        self._lock = threading.Lock()
+        if enable:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def trace(self, service_request_id: str, data: Any) -> None:
+        if not self.enable:
+            return
+        line = json.dumps({
+            "timestamp": time.time(),
+            "service_request_id": service_request_id,
+            "data": data,
+        })
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+
+    def callback_for(self, service_request_id: str):
+        """Bind a per-request trace callback (reference
+        http_service/service.cpp:258-264)."""
+        if not self.enable:
+            return None
+
+        def cb(stage: str, data: Dict[str, Any]) -> None:
+            self.trace(service_request_id, {"stage": stage, **data})
+        return cb
