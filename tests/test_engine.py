@@ -236,3 +236,92 @@ def test_load_metrics_and_events():
     _collect(eng)
     ev = eng.drain_kvcache_event()
     assert len(ev.stored) >= 2        # full pages registered while finishing
+
+
+class TestKvMigration:
+    """PD disaggregation: prefill-side export + decode-side import must be
+    bit-equivalent to running the whole request on one engine."""
+
+    def _cfg(self):
+        from xllm_service_tpu.config import EngineConfig, ModelConfig
+        mcfg = ModelConfig.tiny(vocab_size=128)
+        ecfg = EngineConfig(page_size=8, num_pages=32, max_model_len=128,
+                            max_batch_size=2, max_prefill_tokens=128,
+                            prefill_buckets=(16, 32))
+        return mcfg, ecfg
+
+    def test_export_import_continuation_matches_monolithic(self):
+        import dataclasses as dc
+
+        from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+        from xllm_service_tpu.utils.types import SamplingParams
+
+        mcfg, ecfg = self._cfg()
+        prompt = list(range(1, 21))
+        sp = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+        # Monolithic reference run.
+        mono = Engine(mcfg, ecfg, seed=0)
+        mono.add_request(EngineRequest(
+            request_id="m", token_ids=list(prompt), sampling=sp))
+        mono_tokens = []
+        while mono.has_work():
+            for out in mono.step():
+                mono_tokens.extend(out.new_token_ids)
+        assert len(mono_tokens) == 8
+
+        # Disaggregated: prefill on A (one token, hold), decode on B.
+        a = Engine(mcfg, ecfg, seed=0)
+        b = Engine(mcfg, ecfg, seed=0)
+        a.add_request(EngineRequest(
+            request_id="r", token_ids=list(prompt),
+            sampling=dc.replace(sp, max_tokens=1),
+            hold_after_finish=True))
+        first = []
+        while a.has_work():
+            for out in a.step():
+                first.extend(out.new_token_ids)
+        assert first == mono_tokens[:1]
+
+        exported = a.export_held("r")
+        assert exported is not None
+        tokens, k, v = exported
+        assert tokens == prompt + first
+        assert k.shape[0] == mcfg.num_layers
+        assert a.export_held("r") is None   # single-shot
+
+        ok = b.import_sequence(
+            EngineRequest(request_id="r", token_ids=list(prompt),
+                          sampling=sp),
+            tokens, k, v)
+        assert ok
+        cont = []
+        while b.has_work():
+            for out in b.step():
+                cont.extend(out.new_token_ids)
+        assert first + cont == mono_tokens
+
+    def test_import_respects_capacity(self):
+        import numpy as np
+
+        from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+        from xllm_service_tpu.utils.types import SamplingParams
+
+        mcfg, ecfg = self._cfg()
+        b = Engine(mcfg, ecfg, seed=0)
+        # Fill both slots.
+        for i in range(2):
+            b.add_request(EngineRequest(
+                request_id=f"f{i}", token_ids=list(range(1, 17)),
+                sampling=SamplingParams(max_tokens=64, temperature=0.0,
+                                        ignore_eos=True)))
+        while b.waiting:
+            b.step()
+        L, ps = mcfg.num_layers, ecfg.page_size
+        k = np.zeros((L, 2, ps, mcfg.num_kv_heads, mcfg.head_dim),
+                     np.float32)
+        ok = b.import_sequence(
+            EngineRequest(request_id="x", token_ids=list(range(1, 16)),
+                          sampling=SamplingParams(max_tokens=4)),
+            list(range(1, 17)), k, k)
+        assert not ok   # no free slot → clean refusal

@@ -1,0 +1,160 @@
+"""PD disaggregation end-to-end: dedicated PREFILL + DECODE workers, KV
+migrated over the wire, both response topologies (reference config #3,
+SURVEY.md §7.2 step 7)."""
+
+import json
+import time
+
+import pytest
+
+from xllm_service_tpu.config import (
+    EngineConfig, InstanceType, LoadBalancePolicyType, ServiceOptions)
+from xllm_service_tpu.runtime.worker import Worker, WorkerOptions
+from xllm_service_tpu.service.coordination import InMemoryStore
+from xllm_service_tpu.service.httpd import (
+    http_json, http_stream, iter_sse_events)
+from xllm_service_tpu.service.master import Master
+
+
+def wait_until(cond, timeout=15.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+def small_engine_cfg() -> EngineConfig:
+    return EngineConfig(page_size=16, num_pages=64, max_model_len=256,
+                        max_batch_size=4, max_prefill_tokens=256,
+                        prefill_buckets=(32, 64))
+
+
+def make_pd_cluster(store, decode_to_service=False):
+    opts = ServiceOptions(
+        http_port=0, rpc_port=0, num_output_pools=4,
+        load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
+        block_size=16, heartbeat_interval_s=0.2,
+        master_upload_interval_s=0.2,
+        enable_decode_response_to_service=decode_to_service)
+    master = Master(opts, store=store).start()
+    workers = []
+    for itype in (InstanceType.PREFILL, InstanceType.DECODE):
+        wopts = WorkerOptions(
+            port=0, instance_type=itype,
+            service_addr=master.rpc_address, model="tiny",
+            heartbeat_interval_s=0.2, lease_ttl_s=2.0)
+        workers.append(Worker(wopts, store,
+                              engine_cfg=small_engine_cfg()).start())
+    mgr = master.scheduler.instance_mgr
+    assert wait_until(lambda: len(mgr.prefill_instances()) == 1
+                      and len(mgr.decode_instances()) == 1), \
+        "PD pair never registered"
+    return master, workers
+
+
+@pytest.fixture()
+def store():
+    s = InMemoryStore(sweep_interval_s=0.02)
+    yield s
+    s.close()
+
+
+class TestPdDisaggregation:
+    def test_relay_topology_migrates_and_streams(self, store):
+        master, workers = make_pd_cluster(store)
+        prefill_w, decode_w = workers
+        try:
+            status, resp = http_json(
+                "POST", master.http_address, "/v1/completions",
+                {"model": "tiny", "prompt": "migrate me please",
+                 "max_tokens": 6, "temperature": 0.0,
+                 "ignore_eos": True}, timeout=120.0)
+            assert status == 200, resp
+            assert resp["usage"]["completion_tokens"] == 6
+            # The KV actually moved: prefill exported bytes, decode ran it.
+            assert prefill_w.kv_migration_bytes > 0
+            dl = decode_w.primary_runtime().engine.load_metrics()
+            assert decode_w.primary_runtime().engine.step_count > 0
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
+    def test_relay_stream_sse(self, store):
+        master, workers = make_pd_cluster(store)
+        try:
+            payloads = list(iter_sse_events(http_stream(
+                "POST", master.http_address, "/v1/chat/completions",
+                {"model": "tiny",
+                 "messages": [{"role": "user", "content": "pd stream"}],
+                 "max_tokens": 4, "temperature": 0.0, "stream": True,
+                 "ignore_eos": True}, timeout=120.0)))
+            assert payloads[-1] == "[DONE]"
+            objs = [json.loads(p) for p in payloads[:-1]]
+            assert objs[0]["choices"][0]["delta"]["role"] == "assistant"
+            content = "".join(
+                o["choices"][0]["delta"].get("content", "")
+                for o in objs if o["choices"])
+            finishes = [o["choices"][0]["finish_reason"]
+                        for o in objs if o["choices"]]
+            assert finishes[-1] == "length"
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
+    def test_decode_to_service_topology(self, store):
+        master, workers = make_pd_cluster(store, decode_to_service=True)
+        prefill_w, decode_w = workers
+        try:
+            assert wait_until(lambda: decode_w._decode_to_service,
+                              timeout=5.0)
+            status, resp = http_json(
+                "POST", master.http_address, "/v1/completions",
+                {"model": "tiny", "prompt": "rpc mode pd",
+                 "max_tokens": 5, "temperature": 0.0,
+                 "ignore_eos": True}, timeout=120.0)
+            assert status == 200, resp
+            assert resp["usage"]["completion_tokens"] == 5
+            assert prefill_w.kv_migration_bytes > 0
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
+    def test_pd_output_equals_single_worker(self, store):
+        """Greedy continuation after migration must match a single-worker
+        run token for token (engines share the same seed-0 params)."""
+        master, workers = make_pd_cluster(store)
+        try:
+            body = {"model": "tiny", "prompt": "determinism check",
+                    "max_tokens": 6, "temperature": 0.0,
+                    "ignore_eos": True}
+            status, pd_resp = http_json(
+                "POST", master.http_address, "/v1/completions",
+                dict(body), timeout=120.0)
+            assert status == 200, pd_resp
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
+        solo_store = InMemoryStore(sweep_interval_s=0.02)
+        from tests.test_e2e import make_cluster
+        master2, workers2 = make_cluster(solo_store)
+        try:
+            status, solo_resp = http_json(
+                "POST", master2.http_address, "/v1/completions",
+                {"model": "tiny", "prompt": "determinism check",
+                 "max_tokens": 6, "temperature": 0.0,
+                 "ignore_eos": True}, timeout=120.0)
+            assert status == 200, solo_resp
+            assert pd_resp["choices"][0]["text"] == \
+                solo_resp["choices"][0]["text"]
+        finally:
+            for w in workers2:
+                w.stop()
+            master2.stop()
+            solo_store.close()

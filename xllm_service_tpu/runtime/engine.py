@@ -62,6 +62,10 @@ class EngineRequest:
     priority: int = 0
     eos_token_ids: Tuple[int, ...] = ()
     arrival_time: float = 0.0
+    # PD disaggregation: keep the sequence's pages resident after it
+    # finishes so its KV can be exported to a decode instance
+    # (prefill-side handoff, SURVEY.md §7.3 item 1).
+    hold_after_finish: bool = False
 
 
 class SeqStatus(enum.Enum):
@@ -143,6 +147,7 @@ class Engine:
         self._slots: List[Optional[Sequence]] = \
             [None] * engine_cfg.max_batch_size
         self._cancelled: set = set()
+        self._held: Dict[str, Sequence] = {}   # finished, pages resident
 
         # Decode-slot host mirrors (numpy, copied to device each step).
         B, MP = engine_cfg.max_batch_size, engine_cfg.max_pages_per_seq
@@ -308,8 +313,12 @@ class Engine:
         # token was never fed, so its slot must not be content-addressed.
         self.prefix_cache.register_full_pages(
             seq.tokens[:seq.num_computed], seq.pages)
-        self.prefix_cache.release_pages(seq.pages)
-        seq.pages = []
+        if seq.req.hold_after_finish and reason != FinishReason.CANCELLED:
+            # PD handoff: pages stay refcounted until export_held().
+            self._held[seq.req.request_id] = seq
+        else:
+            self.prefix_cache.release_pages(seq.pages)
+            seq.pages = []
         self._by_id.pop(seq.req.request_id, None)
         self._cancelled.discard(seq.req.request_id)
 
@@ -479,6 +488,84 @@ class Engine:
         return SamplingTensors.for_batch(padded)
 
     # ------------------------------------------------------------------
+    # PD disaggregation: KV export/import (host-shuttle v0 path —
+    # SURVEY.md §7.3 item 1; the cross-slice jax.device_put path can slot
+    # in behind the same interface)
+    # ------------------------------------------------------------------
+    def export_held(self, request_id: str
+                    ) -> Optional[Tuple[List[int], np.ndarray, np.ndarray]]:
+        """Pull a held (prefill-finished) sequence's KV out of HBM.
+
+        Returns (tokens, k, v) with k/v shaped
+        [L, n_pages, page_size, Hkv, Dh]; tokens include the first sampled
+        token (whose KV is NOT resident — the decode side writes it on its
+        first step). Releases the pages."""
+        seq = self._held.pop(request_id, None)
+        if seq is None:
+            return None
+        k_pages, v_pages = self.kv
+        idx = jnp.asarray(seq.pages, jnp.int32)
+        k = np.asarray(jax.device_get(k_pages[:, idx]))
+        v = np.asarray(jax.device_get(v_pages[:, idx]))
+        self.prefix_cache.release_pages(seq.pages)
+        seq.pages = []
+        return list(seq.tokens), k, v
+
+    def drop_held(self, request_id: str) -> None:
+        seq = self._held.pop(request_id, None)
+        if seq is not None:
+            self.prefix_cache.release_pages(seq.pages)
+            seq.pages = []
+
+    def import_sequence(self, req: EngineRequest, tokens: List[int],
+                        k: np.ndarray, v: np.ndarray) -> bool:
+        """Adopt a migrated sequence mid-generation (decode-side handoff).
+
+        ``tokens`` = prompt + first generated token; ``k``/``v`` hold KV for
+        ``tokens[:-1]``. Returns False (clean refusal → caller falls back)
+        when no slot/pages are free or the payload doesn't match this
+        engine's KV layout."""
+        n_pages_needed = self._pages_needed(len(tokens))
+        k_pages, v_pages = self.kv
+        expect = (k_pages.shape[0], n_pages_needed, k_pages.shape[2],
+                  k_pages.shape[3], k_pages.shape[4])
+        if (tuple(k.shape) != expect or tuple(v.shape) != expect
+                or k.dtype != v.dtype):
+            # Page-size / layer / head mismatch between prefill and decode
+            # engine configs must fail safe, not truncate silently.
+            logger.warning("kv import layout mismatch: got %s expected %s",
+                           k.shape, expect)
+            return False
+        slot = self._free_slot()
+        if slot < 0:
+            return False
+        pages = self.prefix_cache.alloc(n_pages_needed)
+        while pages is None and not req.offline \
+                and self._preempt_one_offline():
+            pages = self.prefix_cache.alloc(n_pages_needed)
+        if pages is None:
+            return False
+        idx = jnp.asarray(pages, jnp.int32)
+        self.kv = _kv_scatter(k_pages, v_pages, idx,
+                              jnp.asarray(k).astype(k_pages.dtype),
+                              jnp.asarray(v).astype(v_pages.dtype))
+        seq = Sequence(req=req, tokens=list(tokens), pages=pages,
+                       num_computed=len(tokens) - 1, slot=slot,
+                       status=SeqStatus.RUNNING,
+                       first_token_time=time.monotonic())
+        self._by_id[req.request_id] = seq
+        self.running.append(seq)
+        self._slots[slot] = seq
+        self._slot_sampling[slot] = req.sampling
+        self._slot_st = None
+        self._sync_slot(seq)
+        # Migrated prefixes are content-addressed here too, so future
+        # prompts on this instance reuse them.
+        self.prefix_cache.register_full_pages(
+            seq.tokens[:seq.num_computed], seq.pages)
+        return True
+
+    # ------------------------------------------------------------------
     # Warmup / metrics
     # ------------------------------------------------------------------
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> float:
@@ -518,6 +605,14 @@ class Engine:
 # ---------------------------------------------------------------------------
 # Compiled step bodies (sampling fused in; only token ids leave the device)
 # ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _kv_scatter(k_pages, v_pages, idx, k_new, v_new):
+    """In-place (donated) write of migrated KV pages — no pool-sized copy.
+    Recompiles per distinct imported-page count; serving shapes hit a
+    handful of counts, all cached after first use."""
+    return k_pages.at[:, idx].set(k_new), v_pages.at[:, idx].set(v_new)
+
 
 def _prefill_step(params, tokens, start_pos, lengths, kv, page_table,
                   st: SamplingTensors, key, *, cfg: ModelConfig):

@@ -163,7 +163,7 @@ class _LiveRequest:
 
     __slots__ = ("req", "q", "decoder", "stream_to_service",
                  "service_request_id", "model", "is_chat", "stream",
-                 "include_usage", "first_out_time")
+                 "include_usage", "first_out_time", "sampling")
 
     def __init__(self, req: EngineRequest, decoder: IncrementalDecoder,
                  service_request_id: str, model: str, is_chat: bool,
@@ -200,6 +200,11 @@ class Worker:
 
         self._live: Dict[str, _LiveRequest] = {}
         self._live_lock = threading.Lock()
+        # Outputs queued for the service fan-in ahead of the next engine
+        # dispatch (ordering: appended under the engine lock, drained by
+        # the engine-loop thread before it pushes step outputs — no network
+        # calls ever happen inside the engine lock).
+        self._service_push_buffer: List[RequestOutput] = []
         # Engines are single-threaded; HTTP threads and the loop thread
         # serialize on this (submission is cheap, steps hold it for one
         # iteration).
@@ -222,7 +227,11 @@ class Worker:
         router.route("POST", "/fork_master", self._serve_fork_master)
         router.route("POST", "/flip_role", self._serve_flip_role)
         router.route("POST", "/cancel", self._serve_cancel)
+        router.route("POST", "/kv/import", self._serve_kv_import)
         self._router = router
+        # KV-migration throughput book (BASELINE.md north-star metric).
+        self.kv_migration_bytes = 0
+        self.kv_migration_seconds = 0.0
         self._srv = HttpServer(opts.host, opts.port, router)
         self.name = self._srv.address
 
@@ -314,7 +323,9 @@ class Worker:
     def _dispatch_outputs(self, rt: ModelRuntime,
                           outs: List[StepOutput], step_ms: float) -> None:
         now = time.monotonic()
-        to_service: List[RequestOutput] = []
+        with self._engine_lock:
+            to_service: List[RequestOutput] = self._service_push_buffer
+            self._service_push_buffer = []
         for out in outs:
             with self._live_lock:
                 live = self._live.get(out.request_id)
@@ -369,9 +380,8 @@ class Worker:
     # ------------------------------------------------------------------
     # Serving
     # ------------------------------------------------------------------
-    def _parse_generate(self, req: Request, is_chat: bool
-                        ) -> "_LiveRequest":
-        body = req.json()
+    def _parse_generate(self, body: Dict[str, Any], is_chat: bool,
+                        pd_prefill: bool = False) -> "_LiveRequest":
         model = body.get("model", self.opts.model)
         rt = self.runtimes.get(model) or self.primary_runtime()
         if rt.engine is None:
@@ -395,21 +405,28 @@ class Worker:
             seed=body.get("seed"),
             stop_token_ids=body.get("stop_token_ids", []),
             ignore_eos=body.get("ignore_eos", False))
+        engine_sampling = sampling
+        if pd_prefill:
+            import dataclasses as _dc
+            engine_sampling = _dc.replace(sampling, max_tokens=1,
+                                          ignore_eos=False)
         ereq = EngineRequest(
             request_id=srid,
             token_ids=list(token_ids),
-            sampling=sampling,
+            sampling=engine_sampling,
             offline=bool(body.get("offline", False)),
             priority=int(body.get("priority", 0)),
-            eos_token_ids=rt.tokenizer.eos_token_ids)
+            eos_token_ids=rt.tokenizer.eos_token_ids,
+            hold_after_finish=pd_prefill)
         stream = bool(body.get("stream", False))
         include_usage = bool(
             (body.get("stream_options") or {}).get("include_usage", False))
         live = _LiveRequest(
             ereq, IncrementalDecoder(rt.tokenizer), srid, model, is_chat,
             stream, include_usage,
-            stream_to_service=self._decode_to_service
+            stream_to_service=(not pd_prefill) and self._decode_to_service
             and bool(self.opts.service_addr))
+        live.sampling = sampling          # original (pre-pd) params
         with self._live_lock:
             self._live[srid] = live
         with self._engine_lock:
@@ -419,7 +436,18 @@ class Worker:
 
     def _serve_generate(self, req: Request, is_chat: bool) -> Response:
         try:
-            live = self._parse_generate(req, is_chat)
+            body = req.json()
+        except Exception:  # noqa: BLE001
+            return Response.error(400, "invalid JSON body")
+        routing = body.get("routing") or {}
+        if (routing.get("prefill_name") == self.name
+                and routing.get("decode_name")
+                and routing["decode_name"] != self.name
+                and int(body.get("max_tokens", 16)) > 1):
+            return self._serve_pd_prefill(body, is_chat,
+                                          routing["decode_name"])
+        try:
+            live = self._parse_generate(body, is_chat)
         except (ValueError, RuntimeError) as e:
             return Response.error(400, str(e))
         if live.stream_to_service:
@@ -432,10 +460,15 @@ class Worker:
             return Response.sse(self._stream_sse(live))
         return self._collect_full(live)
 
-    def _stream_sse(self, live: _LiveRequest) -> Iterator[bytes]:
+    def _stream_sse(self, live: _LiveRequest,
+                    initial: Optional[List[RequestOutput]] = None
+                    ) -> Iterator[bytes]:
         asm = (ChatStreamAssembler if live.is_chat
                else CompletionStreamAssembler)(
             live.service_request_id, live.model, live.include_usage)
+        for ro in (initial or []):
+            for frame in asm.on_output(ro):
+                yield frame
         while True:
             out = live.q.get()
             if out is None:
@@ -447,8 +480,11 @@ class Worker:
             if out.finished:
                 return
 
-    def _collect_full(self, live: _LiveRequest) -> Response:
-        text_parts: List[str] = []
+    def _collect_full(self, live: _LiveRequest,
+                      initial: Optional[List[RequestOutput]] = None
+                      ) -> Response:
+        text_parts: List[str] = [s.text for ro in (initial or [])
+                                 for s in ro.outputs]
         usage = Usage()
         finish = FinishReason.STOP
         while True:
@@ -489,6 +525,14 @@ class Worker:
             for k, v in lm.items():
                 lines.append(
                     f'xllm_worker_{k}{{model="{m}"}} {v}')
+        lines.append(f"xllm_worker_kv_migration_bytes_total "
+                     f"{self.kv_migration_bytes}")
+        lines.append(f"xllm_worker_kv_migration_seconds_total "
+                     f"{self.kv_migration_seconds:.6f}")
+        if self.kv_migration_seconds > 0:
+            lines.append(
+                f"xllm_worker_kv_migration_gbps "
+                f"{self.kv_migration_bytes / self.kv_migration_seconds / 1e9:.4f}")
         return Response(body="\n".join(lines).encode() + b"\n",
                         content_type="text/plain; version=0.0.4")
 
@@ -564,6 +608,278 @@ class Worker:
                 rt.engine.cancel(srid)
             self._work_event.set()
         return Response.json({"ok": True})
+
+    # ------------------------------------------------------------------
+    # PD disaggregation (SURVEY.md §7.2 step 7): prefill here, decode on
+    # the routed decode instance. v0 transfer is the host shuttle
+    # (device_get → HTTP octet-stream → device_put); the wire format is
+    # one meta-JSON line + raw K bytes + raw V bytes.
+    # ------------------------------------------------------------------
+    def _serve_pd_prefill(self, body: Dict[str, Any], is_chat: bool,
+                          decode_name: str) -> Response:
+        try:
+            live = self._parse_generate(body, is_chat, pd_prefill=True)
+        except (ValueError, RuntimeError) as e:
+            return Response.error(400, str(e))
+        rt = self.runtimes.get(live.model) or self.primary_runtime()
+        srid = live.service_request_id
+        try:
+            first = live.q.get(timeout=600.0)      # the prefill StepOutput
+        except queue.Empty:
+            # Saturated prefill queue: cancel so the held entry can never
+            # leak pages when the request eventually completes.
+            with self._engine_lock:
+                if rt.engine is not None:
+                    rt.engine.cancel(srid)
+                    rt.engine.drop_held(srid)
+            self._drop_live(srid)
+            return Response.error(504, "prefill timed out")
+        self._drop_live(srid)
+        if first is None or first.finish_reason == FinishReason.STOP \
+                or first.finish_reason == FinishReason.CANCELLED:
+            # EOS on the very first token (or cancel): nothing to migrate.
+            with self._engine_lock:
+                rt.engine.drop_held(srid)
+            outs = [self._to_request_output(live, first)] if first else []
+            if self._topology2():
+                self._push_outputs_to_service(outs)
+                return Response.json({"status": "accepted",
+                                      "service_request_id": srid})
+            return self._respond_outputs(live, outs)
+        with self._engine_lock:
+            exported = rt.engine.export_held(srid)
+        if exported is None:
+            return Response.error(500, "prefill KV export failed")
+        tokens, k, v = exported
+
+        t0 = time.monotonic()
+        meta = {
+            "service_request_id": srid,
+            "model": live.model,
+            "tokens": tokens,
+            "prompt_len": len(live.req.token_ids),
+            "sampling": live.sampling.to_json(),
+            "shape": list(k.shape),
+            "dtype": str(k.dtype),
+            "stream": live.stream,
+        }
+        payload = (json.dumps(meta).encode("utf-8") + b"\n"
+                   + k.tobytes() + v.tobytes())
+        from xllm_service_tpu.service.httpd import http_stream
+        head = b""
+        chunks = iter(())
+        try:
+            chunks = http_stream("POST", decode_name, "/kv/import",
+                                 raw=payload, timeout=600.0)
+            head = next(chunks, b"")
+        except Exception as e:  # noqa: BLE001 — decode instance unreachable
+            logger.warning("kv migration to %s failed (%s); decoding "
+                           "locally", decode_name, e)
+            return self._local_decode_fallback(live, tokens, k, v)
+        self.kv_migration_bytes += len(payload)
+        self.kv_migration_seconds += time.monotonic() - t0
+        if head.startswith(b"{"):
+            # JSON (ack in decode-to-service mode, or an error) — fall back
+            # to local decode on failure so the request still completes.
+            try:
+                parsed = json.loads(head.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                parsed = None
+            if parsed and parsed.get("status") == "accepted":
+                return Response.json(parsed)
+            logger.warning("kv import rejected by %s (%r); decoding "
+                           "locally", decode_name, head[:120])
+            return self._local_decode_fallback(live, tokens, k, v)
+        # Relay topology: decode streams raw RequestOutput SSE frames back
+        # on this same connection; re-assemble client-facing chunks here.
+        return self._relay_decode_stream(live, head, chunks)
+
+    def _topology2(self) -> bool:
+        return self._decode_to_service and bool(self.opts.service_addr)
+
+    def _push_outputs_to_service(self, outs: List[RequestOutput]) -> None:
+        if not outs:
+            return
+        try:
+            http_json("POST", self.opts.service_addr, "/rpc/generations",
+                      {"outputs": [o.to_json() for o in outs]},
+                      timeout=30.0)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("generations push failed: %s", e)
+
+    def _respond_outputs(self, live: "_LiveRequest",
+                         outs: List[RequestOutput]) -> Response:
+        if live.stream:
+            asm = (ChatStreamAssembler if live.is_chat
+                   else CompletionStreamAssembler)(
+                live.service_request_id, live.model, live.include_usage)
+            frames: List[bytes] = []
+            for ro in outs:
+                frames.extend(asm.on_output(ro))
+            return Response.sse(iter(frames))
+        text = "".join(s.text for ro in outs for s in ro.outputs)
+        finish = FinishReason.STOP
+        usage = Usage()
+        for ro in outs:
+            if ro.usage:
+                usage = ro.usage
+            for s in ro.outputs:
+                if s.finish_reason != FinishReason.NONE:
+                    finish = s.finish_reason
+        builder = full_chat_response if live.is_chat \
+            else full_completion_response
+        return Response.json(builder(live.service_request_id, live.model,
+                                     text, finish, usage))
+
+    def _relay_decode_stream(self, live: "_LiveRequest", head: bytes,
+                             chunks) -> Response:
+        from xllm_service_tpu.service.httpd import iter_sse_events
+
+        def all_chunks():
+            if head:
+                yield head
+            for c in chunks:
+                yield c
+
+        if live.stream:
+            asm = (ChatStreamAssembler if live.is_chat
+                   else CompletionStreamAssembler)(
+                live.service_request_id, live.model, live.include_usage)
+
+            def gen() -> Iterator[bytes]:
+                for payload in iter_sse_events(all_chunks()):
+                    if payload == "[DONE]":
+                        return
+                    ro = RequestOutput.from_json(json.loads(payload))
+                    for frame in asm.on_output(ro):
+                        yield frame
+            return Response.sse(gen())
+        outs = []
+        for payload in iter_sse_events(all_chunks()):
+            if payload == "[DONE]":
+                break
+            outs.append(RequestOutput.from_json(json.loads(payload)))
+        return self._respond_outputs(live, outs)
+
+    def _local_decode_fallback(self, live: "_LiveRequest",
+                               tokens: List[int], k, v) -> Response:
+        """Decode here when the decode instance refused the migration."""
+        rt = self.runtimes.get(live.model) or self.primary_runtime()
+        srid = live.service_request_id
+        ereq = EngineRequest(
+            request_id=srid, token_ids=list(live.req.token_ids),
+            sampling=live.sampling,
+            eos_token_ids=live.req.eos_token_ids)
+        new_live = _LiveRequest(
+            ereq, IncrementalDecoder(rt.tokenizer), srid, live.model,
+            live.is_chat, live.stream, live.include_usage,
+            stream_to_service=self._topology2())
+        new_live.sampling = live.sampling
+        first_out = RequestOutput(
+            request_id=srid, service_request_id=srid,
+            outputs=[SequenceOutput(
+                index=0, text=new_live.decoder.feed([tokens[-1]]),
+                token_ids=[tokens[-1]])])
+        with self._live_lock:
+            self._live[srid] = new_live
+        with self._engine_lock:
+            ok = rt.engine.import_sequence(ereq, tokens, k, v)
+            if ok and new_live.stream_to_service:
+                self._service_push_buffer.append(first_out)
+        if not ok:
+            self._drop_live(srid)
+            return Response.error(503, "no local capacity for fallback")
+        self._work_event.set()
+        if new_live.stream_to_service:
+            return Response.json({"status": "accepted",
+                                  "service_request_id": srid})
+        if live.stream:
+            return Response.sse(
+                self._stream_sse(new_live, initial=[first_out]))
+        return self._collect_full(new_live, initial=[first_out])
+
+    def _serve_kv_import(self, req: Request) -> Response:
+        """Decode-side adoption of a migrated sequence."""
+        nl = req.body.find(b"\n")
+        if nl < 0:
+            return Response.error(400, "missing meta line")
+        try:
+            meta = json.loads(req.body[:nl].decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            return Response.error(400, f"bad meta: {e}")
+        import ml_dtypes
+        dtype = (ml_dtypes.bfloat16 if meta["dtype"] == "bfloat16"
+                 else np.dtype(meta["dtype"]))
+        shape = tuple(meta["shape"])
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        blob = req.body[nl + 1:]
+        if len(blob) != 2 * nbytes:
+            return Response.error(400, f"payload size mismatch: "
+                                       f"{len(blob)} != {2 * nbytes}")
+        k = np.frombuffer(blob[:nbytes], dtype=dtype).reshape(shape)
+        v = np.frombuffer(blob[nbytes:], dtype=dtype).reshape(shape)
+
+        model = meta.get("model", self.opts.model)
+        rt = self.runtimes.get(model) or self.primary_runtime()
+        if rt.engine is None:
+            return Response.error(503, f"model {model} asleep")
+        tokens = list(meta["tokens"])
+        srid = meta["service_request_id"]
+        sampling = SamplingParams.from_json(meta.get("sampling"))
+        prompt = tokens[:int(meta.get("prompt_len", len(tokens) - 1))]
+        ereq = EngineRequest(
+            request_id=srid, token_ids=prompt, sampling=sampling,
+            eos_token_ids=rt.tokenizer.eos_token_ids)
+        live = _LiveRequest(
+            ereq, IncrementalDecoder(rt.tokenizer), srid, model,
+            is_chat=False, stream=bool(meta.get("stream")),
+            include_usage=False,
+            stream_to_service=self._decode_to_service
+            and bool(self.opts.service_addr))
+        live.sampling = sampling
+        with self._live_lock:
+            self._live[srid] = live
+        first_out = RequestOutput(
+            request_id=srid, service_request_id=srid,
+            outputs=[SequenceOutput(
+                index=0, text=live.decoder.feed([tokens[-1]]),
+                token_ids=[tokens[-1]])])
+        with self._engine_lock:
+            ok = rt.engine.import_sequence(ereq, tokens, k, v)
+            if ok and live.stream_to_service:
+                # Topology 2: buffering under the engine lock puts the
+                # first token ahead of any later step output; the engine
+                # loop drains the buffer in order, off this lock.
+                self._service_push_buffer.append(first_out)
+        if not ok:
+            self._drop_live(srid)
+            return Response.error(503, "no capacity on decode instance")
+        self._work_event.set()
+        if live.stream_to_service:
+            return Response.json({"status": "accepted",
+                                  "service_request_id": srid})
+
+        # Relay topology: stream raw RequestOutput frames back to the
+        # prefill worker on this response.
+        def gen() -> Iterator[bytes]:
+            yield sse_frame(first_out.to_json())
+            while True:
+                try:
+                    out = live.q.get(timeout=600.0)
+                except queue.Empty:
+                    with self._engine_lock:
+                        if rt.engine is not None:
+                            rt.engine.cancel(srid)
+                    self._drop_live(srid)
+                    return
+                if out is None:
+                    return
+                ro = self._to_request_output(live, out)
+                yield sse_frame(ro.to_json())
+                if out.finished:
+                    yield SSE_DONE
+                    return
+        return Response.sse(gen())
 
     # ------------------------------------------------------------------
     # Heartbeats

@@ -218,14 +218,20 @@ def http_json(method: str, address: str, path: str, obj: Any = None,
 
 def http_stream(method: str, address: str, path: str, obj: Any = None,
                 timeout: float = 600.0,
-                headers: Optional[Dict[str, str]] = None
+                headers: Optional[Dict[str, str]] = None,
+                raw: Optional[bytes] = None
                 ) -> Iterator[bytes]:
     """Progressive byte-chunk reader (reference CustomProgressiveReader,
-    service.cpp:113-143): yields raw chunks as they arrive."""
+    service.cpp:113-143): yields raw chunks as they arrive. ``raw`` sends
+    an octet-stream body instead of JSON (KV migration payloads)."""
     conn = HTTPConnection(address, timeout=timeout)
     try:
-        body = None if obj is None else json.dumps(obj).encode("utf-8")
-        hdrs = {"Content-Type": "application/json"}
+        if raw is not None:
+            body = raw
+            hdrs = {"Content-Type": "application/octet-stream"}
+        else:
+            body = None if obj is None else json.dumps(obj).encode("utf-8")
+            hdrs = {"Content-Type": "application/json"}
         if headers:
             hdrs.update(headers)
         conn.request(method, path, body=body, headers=hdrs)
