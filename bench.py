@@ -45,7 +45,9 @@ def main() -> None:
         ecfg = EngineConfig(page_size=64, num_pages=512,
                             max_model_len=1024, max_batch_size=batch,
                             max_prefill_tokens=2048,
-                            prefill_buckets=(128,))
+                            prefill_buckets=(128,),
+                            decode_steps=int(os.environ.get(
+                                "BENCH_DECODE_STEPS", "8")))
 
     engine = Engine(cfg, ecfg, seed=0)
     engine.warmup()
@@ -68,7 +70,7 @@ def main() -> None:
     elapsed = time.monotonic() - t0
 
     throughput = tokens / elapsed
-    steps = tokens / batch
+    steps = tokens / batch              # decode iterations per sequence
     tpot_ms = 1000.0 * elapsed / max(steps, 1)
     print(json.dumps({
         "metric": "decode_throughput",

@@ -1,0 +1,187 @@
+"""Load generator + latency harness for the serving stack.
+
+Fills the measurement gap the reference leaves open (it publishes no
+benchmarks — BASELINE.md): ShareGPT-style mixed-length replay against any
+OpenAI endpoint (this framework's service, a single worker, or anything
+else speaking the API), with Poisson arrivals, SSE-timed TTFT/TPOT, and
+SLA-tier attainment for the online/offline hybrid config (BASELINE.json
+configs #2 and #4).
+
+Usage:
+  python -m benchmarks.loadgen --target 127.0.0.1:9888 --model tiny \
+      --num-requests 64 --request-rate 8 --max-tokens 32
+
+Prints one JSON summary: req/s, p50/p99 TTFT, p50/p99 TPOT, SLO
+attainment vs --target-ttft/--target-tpot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import random
+import threading
+import time
+from typing import List, Optional
+
+from xllm_service_tpu.service.httpd import http_stream, iter_sse_events
+
+
+@dataclasses.dataclass
+class RequestResult:
+    ok: bool = False
+    ttft_ms: float = 0.0
+    tpot_ms: float = 0.0
+    total_ms: float = 0.0
+    num_tokens: int = 0
+    offline: bool = False
+    error: str = ""
+
+
+def _percentile(vals: List[float], p: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    idx = min(int(round(p / 100.0 * (len(s) - 1))), len(s) - 1)
+    return s[idx]
+
+
+def sample_prompt_lens(n: int, seed: int = 0,
+                       mean: int = 64, cap: int = 512) -> List[int]:
+    """ShareGPT-like mixed lengths: log-normalish with a long tail."""
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        ln = int(rng.lognormvariate(0, 0.6) * mean)
+        out.append(max(4, min(ln, cap)))
+    return out
+
+
+def run_one(target: str, model: str, prompt_len: int, max_tokens: int,
+            offline: bool, timeout: float) -> RequestResult:
+    res = RequestResult(offline=offline)
+    prompt = " ".join("tok" for _ in range(max(prompt_len // 4, 1)))
+    body = {
+        "model": model, "prompt": prompt, "max_tokens": max_tokens,
+        "temperature": 0.0, "ignore_eos": True, "stream": True,
+        "offline": offline,
+    }
+    t0 = time.monotonic()
+    first = last = 0.0
+    tokens = 0
+    try:
+        for payload in iter_sse_events(http_stream(
+                "POST", target, "/v1/completions", body, timeout=timeout)):
+            if payload == "[DONE]":
+                break
+            now = time.monotonic()
+            obj = json.loads(payload)
+            if obj.get("error"):
+                res.error = str(obj["error"])
+                return res
+            if not obj.get("choices"):
+                continue
+            if first == 0.0:
+                first = now
+            last = now
+            tokens += 1
+    except Exception as e:  # noqa: BLE001
+        res.error = str(e)
+        return res
+    if first == 0.0:
+        res.error = "no tokens"
+        return res
+    res.ok = True
+    res.ttft_ms = 1000.0 * (first - t0)
+    res.total_ms = 1000.0 * (last - t0)
+    res.num_tokens = tokens
+    if tokens > 1:
+        res.tpot_ms = 1000.0 * (last - first) / (tokens - 1)
+    return res
+
+
+def run_load(target: str, model: str, num_requests: int,
+             request_rate: float, max_tokens: int,
+             offline_fraction: float = 0.0, seed: int = 0,
+             timeout: float = 600.0, mean_prompt_len: int = 64,
+             target_ttft_ms: float = 1000.0,
+             target_tpot_ms: float = 50.0) -> dict:
+    lens = sample_prompt_lens(num_requests, seed, mean=mean_prompt_len)
+    rng = random.Random(seed + 1)
+    results: List[Optional[RequestResult]] = [None] * num_requests
+    threads: List[threading.Thread] = []
+    t_start = time.monotonic()
+
+    def fire(i: int, plen: int, off: bool) -> None:
+        results[i] = run_one(target, model, plen, max_tokens, off, timeout)
+
+    for i, plen in enumerate(lens):
+        off = rng.random() < offline_fraction
+        th = threading.Thread(target=fire, args=(i, plen, off), daemon=True)
+        threads.append(th)
+        th.start()
+        if request_rate > 0:
+            # Poisson arrivals at the requested rate.
+            time.sleep(rng.expovariate(request_rate))
+    for th in threads:
+        th.join(timeout=timeout)
+    wall = time.monotonic() - t_start
+
+    done = [r for r in results if r is not None]
+    ok = [r for r in done if r.ok]
+    online = [r for r in ok if not r.offline]
+    ttfts = [r.ttft_ms for r in ok]
+    tpots = [r.tpot_ms for r in ok if r.tpot_ms > 0]
+    return {
+        "num_requests": num_requests,
+        "num_ok": len(ok),
+        "num_errors": len(done) - len(ok),
+        "wall_s": round(wall, 3),
+        "req_per_s": round(len(ok) / wall, 3) if wall > 0 else 0.0,
+        "tokens_per_s": round(sum(r.num_tokens for r in ok) / wall, 2),
+        "ttft_ms": {"p50": round(_percentile(ttfts, 50), 2),
+                    "p99": round(_percentile(ttfts, 99), 2)},
+        "tpot_ms": {"p50": round(_percentile(tpots, 50), 2),
+                    "p99": round(_percentile(tpots, 99), 2)},
+        # SLA attainment of the ONLINE tier only (offline requests are
+        # best-effort by design — reference target_ttft/target_tpot flags).
+        "online_slo": {
+            "ttft": round(sum(1 for r in online
+                              if r.ttft_ms <= target_ttft_ms)
+                          / max(len(online), 1), 4),
+            "tpot": round(sum(1 for r in online if r.tpot_ms > 0
+                              and r.tpot_ms <= target_tpot_ms)
+                          / max(sum(1 for r in online if r.tpot_ms > 0),
+                                1), 4),
+        },
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="xllm-service-tpu loadgen")
+    ap.add_argument("--target", required=True, help="host:port of service")
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--num-requests", type=int, default=32)
+    ap.add_argument("--request-rate", type=float, default=4.0,
+                    help="Poisson arrival rate (req/s); 0 = all at once")
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--mean-prompt-len", type=int, default=64)
+    ap.add_argument("--offline-fraction", type=float, default=0.0)
+    ap.add_argument("--target-ttft-ms", type=float, default=1000.0)
+    ap.add_argument("--target-tpot-ms", type=float, default=50.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    summary = run_load(
+        args.target, args.model, args.num_requests, args.request_rate,
+        args.max_tokens, args.offline_fraction, args.seed,
+        mean_prompt_len=args.mean_prompt_len,
+        target_ttft_ms=args.target_ttft_ms,
+        target_tpot_ms=args.target_tpot_ms)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

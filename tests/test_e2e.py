@@ -219,3 +219,42 @@ class TestEndToEnd:
             for w in workers:
                 w.stop()
             master.stop()
+
+
+class TestEmbeddings:
+    def test_embeddings_endpoint(self, store):
+        master, workers = make_cluster(store)
+        try:
+            status, resp = http_json(
+                "POST", master.http_address, "/v1/embeddings",
+                {"model": "tiny",
+                 "input": ["hello world", "hello world", "different"]},
+                timeout=120.0)
+            assert status == 200, resp
+            assert resp["object"] == "list"
+            assert len(resp["data"]) == 3
+            import numpy as np
+            e0 = np.array(resp["data"][0]["embedding"])
+            e1 = np.array(resp["data"][1]["embedding"])
+            e2 = np.array(resp["data"][2]["embedding"])
+            # Unit-norm, deterministic, and input-sensitive.
+            assert abs(np.linalg.norm(e0) - 1.0) < 1e-3
+            np.testing.assert_allclose(e0, e1, atol=1e-5)
+            assert np.linalg.norm(e0 - e2) > 1e-3
+            assert resp["usage"]["prompt_tokens"] > 0
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
+    def test_embeddings_requires_input(self, store):
+        master, workers = make_cluster(store)
+        try:
+            status, resp = http_json(
+                "POST", master.http_address, "/v1/embeddings",
+                {"model": "tiny"}, timeout=30.0)
+            assert status == 400
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()

@@ -325,3 +325,86 @@ class TestKvMigration:
                           sampling=SamplingParams(max_tokens=4)),
             list(range(1, 17)), k, k)
         assert not ok   # no free slot → clean refusal
+
+
+class TestMultiStepDecode:
+    """Fused N-step decode must produce the same greedy tokens as
+    single-step decode, including finish handling."""
+
+    def _run(self, decode_steps, max_tokens, prompt, vocab=128):
+        from xllm_service_tpu.config import EngineConfig, ModelConfig
+        from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+        from xllm_service_tpu.utils.types import SamplingParams
+
+        mcfg = ModelConfig.tiny(vocab_size=vocab)
+        ecfg = EngineConfig(page_size=8, num_pages=32, max_model_len=64,
+                            max_batch_size=2, max_prefill_tokens=64,
+                            prefill_buckets=(16, 32),
+                            decode_steps=decode_steps)
+        eng = Engine(mcfg, ecfg, seed=0)
+        eng.add_request(EngineRequest(
+            request_id="r", token_ids=list(prompt),
+            sampling=SamplingParams(max_tokens=max_tokens,
+                                    temperature=0.0, ignore_eos=True)))
+        toks = []
+        steps = 0
+        while eng.has_work():
+            for out in eng.step():
+                toks.extend(out.new_token_ids)
+            steps += 1
+        return toks, steps
+
+    def test_greedy_equivalence(self):
+        prompt = list(range(1, 13))
+        single, s_steps = self._run(1, 12, prompt)
+        multi, m_steps = self._run(4, 12, prompt)
+        assert multi == single
+        assert len(multi) == 12
+        # 1 prefill + ceil(11/4) multi rounds vs 1 + 11 single rounds.
+        assert m_steps < s_steps
+
+    def test_max_tokens_not_multiple_of_steps(self):
+        prompt = list(range(1, 9))
+        single, _ = self._run(1, 5, prompt)
+        multi, _ = self._run(4, 5, prompt)
+        assert multi == single
+        assert len(multi) == 5
+
+    def test_eos_mid_scan_stops(self):
+        from xllm_service_tpu.config import EngineConfig, ModelConfig
+        from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+        from xllm_service_tpu.utils.types import SamplingParams
+
+        mcfg = ModelConfig.tiny(vocab_size=64)
+        ecfg = EngineConfig(page_size=8, num_pages=32, max_model_len=64,
+                            max_batch_size=2, max_prefill_tokens=64,
+                            prefill_buckets=(16,), decode_steps=4)
+        eng = Engine(mcfg, ecfg, seed=0)
+        # First, find what greedy emits so we can make token #2 the "eos".
+        eng.add_request(EngineRequest(
+            request_id="probe", token_ids=list(range(1, 9)),
+            sampling=SamplingParams(max_tokens=6, temperature=0.0,
+                                    ignore_eos=True)))
+        probe = []
+        while eng.has_work():
+            for out in eng.step():
+                probe.extend(out.new_token_ids)
+        eos = probe[1]
+        eng2 = Engine(mcfg, ecfg, seed=0)
+        eng2.add_request(EngineRequest(
+            request_id="r", token_ids=list(range(1, 9)),
+            sampling=SamplingParams(max_tokens=6, temperature=0.0),
+            eos_token_ids=(eos,)))
+        got = []
+        reasons = []
+        while eng2.has_work():
+            for out in eng2.step():
+                got.extend(out.new_token_ids)
+                if out.finished:
+                    reasons.append(out.finish_reason)
+        assert got == probe[:2]          # truncated at the eos token
+        from xllm_service_tpu.utils.types import FinishReason
+        assert reasons == [FinishReason.STOP]
+        # Pages were released on finish (no leak from discarded lookahead).
+        assert eng2.allocator.num_free + eng2.prefix_cache.num_reclaimable \
+            == ecfg.num_pages - 1

@@ -1,0 +1,36 @@
+"""Loadgen harness against an in-process cluster (smoke + stats shape)."""
+
+import time
+
+import pytest
+
+from benchmarks.loadgen import run_load, sample_prompt_lens
+from tests.test_e2e import make_cluster
+from xllm_service_tpu.service.coordination import InMemoryStore
+
+
+def test_sample_prompt_lens_deterministic():
+    a = sample_prompt_lens(16, seed=3)
+    b = sample_prompt_lens(16, seed=3)
+    assert a == b
+    assert all(4 <= x <= 512 for x in a)
+
+
+def test_loadgen_against_cluster():
+    store = InMemoryStore(sweep_interval_s=0.02)
+    master, workers = make_cluster(store)
+    try:
+        summary = run_load(
+            master.http_address, "tiny", num_requests=6,
+            request_rate=0.0, max_tokens=4, mean_prompt_len=16,
+            timeout=120.0)
+        assert summary["num_ok"] == 6, summary
+        assert summary["num_errors"] == 0
+        assert summary["req_per_s"] > 0
+        assert summary["ttft_ms"]["p50"] > 0
+        assert 0.0 <= summary["online_slo"]["ttft"] <= 1.0
+    finally:
+        for w in workers:
+            w.stop()
+        master.stop()
+        store.close()

@@ -176,3 +176,50 @@ def test_compute_logprobs():
     lp = np.asarray(compute_logprobs(logits, jnp.asarray([2])))
     ref = 2.0 - np.log(np.exp([0.0, 1.0, 2.0]).sum())
     assert lp[0] == pytest.approx(ref, rel=1e-5)
+
+
+class TestPallasPagedAttention:
+    """Fused kernel vs XLA reference, via the Pallas interpreter on CPU."""
+
+    def test_matches_reference(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from xllm_service_tpu.ops.attention import paged_decode_attention
+        from xllm_service_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_pallas)
+
+        rng = np.random.default_rng(0)
+        B, Hq, Hkv, D, P, ps, MP = 3, 8, 2, 32, 16, 8, 6
+        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        pt = jnp.asarray(rng.integers(1, P, size=(B, MP)), jnp.int32)
+        # Mixed contexts incl. a 1-token row and a full-table row.
+        ctx = jnp.asarray([13, 1, MP * ps], jnp.int32)
+        ref = paged_decode_attention(q, k, v, pt, ctx)
+        out = paged_decode_attention_pallas(q, k, v, pt, ctx,
+                                            interpret=True)
+        assert jnp.allclose(ref, out, atol=1e-5), \
+            float(jnp.max(jnp.abs(ref - out)))
+
+    def test_null_pages_masked(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from xllm_service_tpu.ops.attention import paged_decode_attention
+        from xllm_service_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_pallas)
+
+        rng = np.random.default_rng(1)
+        B, Hq, Hkv, D, P, ps, MP = 2, 4, 2, 16, 8, 8, 4
+        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        # Tables padded with NULL page 0 beyond the first entries.
+        pt = jnp.asarray([[3, 0, 0, 0], [5, 2, 0, 0]], jnp.int32)
+        ctx = jnp.asarray([5, 12], jnp.int32)
+        ref = paged_decode_attention(q, k, v, pt, ctx)
+        out = paged_decode_attention_pallas(q, k, v, pt, ctx,
+                                            interpret=True)
+        assert jnp.allclose(ref, out, atol=1e-5)

@@ -183,6 +183,13 @@ class EngineConfig:
     max_prefill_tokens: int = 2048      # prefill token budget per step
     prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
     enable_prefix_cache: bool = True
+    # Decode steps fused into ONE compiled program per host round-trip
+    # (lax.scan over the step body). >1 amortizes host↔device dispatch
+    # latency across N tokens — the dominant cost when the chip sits
+    # behind a network tunnel or under Python dispatch overhead. Finish
+    # detection runs on host afterwards; tokens sampled past a stop are
+    # discarded (bounded waste of N-1 steps worst case).
+    decode_steps: int = 1
     # Parallel degrees of this instance's mesh.
     tp: int = 1
     dp: int = 1

@@ -33,10 +33,11 @@ from xllm_service_tpu.ops.norm import rms_norm
 from xllm_service_tpu.ops.rope import apply_rope
 from xllm_service_tpu.ops.attention import (
     mha_prefill,
-    paged_decode_attention,
+    paged_decode_attention_current_auto,
     gather_pages,
-    write_prefill_kv,
-    write_decode_kv,
+    overlay_fresh_kv,
+    write_prefill_kv_all_layers,
+    write_decode_kv_all_layers,
 )
 
 Params = Dict[str, Any]
@@ -157,10 +158,17 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                     start_pos: jnp.ndarray, lengths: jnp.ndarray,
                     kv: KVCache, page_table: jnp.ndarray,
                     return_all_logits: bool = False,
+                    mm_embeds: Optional[jnp.ndarray] = None,
+                    mm_positions: Optional[jnp.ndarray] = None,
                     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], KVCache]:
     """Prefill ``tokens`` [B, T] (padded; true new-token counts in
     ``lengths``; nonzero ``start_pos`` = prefix-cache hit, those tokens are
     already resident in the cache).
+
+    ``mm_embeds`` [B, M, D] + ``mm_positions`` [B, M] splice multimodal
+    (vision-encoder) embeddings over the token embeddings at the given
+    window-relative positions (EPD prefill stage; pad positions ≥ T are
+    dropped).
 
     Returns (last_logits [B, V] fp32, all_logits [B, T, V] fp32 or None,
     kv'). ``return_all_logits`` (static) gates the full-prompt lm_head: at
@@ -170,6 +178,11 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     """
     k_pages, v_pages = kv
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))     # [B, T, D]
+    if mm_embeds is not None:
+        x = jax.vmap(
+            lambda xb, eb, pb: xb.at[pb].set(
+                eb.astype(xb.dtype), mode="drop"))(
+            x, mm_embeds, mm_positions)
     positions = start_pos[:, None] + jnp.arange(tokens.shape[1],
                                                 dtype=jnp.int32)[None, :]
     kv_lengths = start_pos + lengths                             # [B]
@@ -180,21 +193,24 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         q, k, v = _qkv(lp, cfg, h)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        kp, vp = write_prefill_kv(kp, vp, k, v, page_table, start_pos,
-                                  lengths)
-        # Attend against the cache so prefix-cache hits see their history;
-        # the gather covers only the pages this batch's table references.
-        k_all = gather_pages(kp, page_table)
-        v_all = gather_pages(vp, page_table)
+        # Attend against cache (prefix-cache hits) + this step's fresh K/V
+        # overlaid on the gathered view. The pool itself is NOT written
+        # here: emitting updated pools as scan ys would rewrite the whole
+        # pool per call — the fresh rows come out as small ys instead and
+        # land in one scatter after the scan.
+        k_all = overlay_fresh_kv(gather_pages(kp, page_table), k, start_pos)
+        v_all = overlay_fresh_kv(gather_pages(vp, page_table), v, start_pos)
         attn = mha_prefill(q, k_all, v_all, kv_lengths, start_pos)
         B, T = tokens.shape
         x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
         h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
         x = x + _mlp(lp, cfg, h)
-        return x, (kp, vp)
+        return x, (k, v)
 
-    x, (k_pages, v_pages) = jax.lax.scan(
+    x, (k_new, v_new) = jax.lax.scan(
         layer, x, (params["layers"], k_pages, v_pages))
+    k_pages, v_pages = write_prefill_kv_all_layers(
+        k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
@@ -204,6 +220,44 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     last_logits = (last_x @ head).astype(jnp.float32)            # [B, V]
     all_logits = (x @ head).astype(jnp.float32) if return_all_logits else None
     return last_logits, all_logits, (k_pages, v_pages)
+
+
+# ---------------------------------------------------------------------------
+# Embeddings (net-new capability: the reference's /v1/embeddings returns
+# "not support", http_service/service.cpp:492)
+# ---------------------------------------------------------------------------
+
+def forward_embedding(params: Params, cfg: ModelConfig,
+                      tokens: jnp.ndarray, lengths: jnp.ndarray
+                      ) -> jnp.ndarray:
+    """Sequence embeddings: causal forward (no KV cache), masked mean-pool
+    of the final hidden states, L2-normalized. tokens [B, T] padded,
+    lengths [B] → [B, hidden] float32."""
+    B, T = tokens.shape
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+
+    def layer(x, lp):
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, cfg, h)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        attn = mha_prefill(q, k, v, lengths,
+                           jnp.zeros((B,), jnp.int32))
+        x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
+        h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, cfg, h)
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps).astype(
+        jnp.float32)
+    mask = (jnp.arange(T, dtype=jnp.int32)[None] <
+            lengths[:, None]).astype(jnp.float32)
+    pooled = jnp.sum(x * mask[..., None], axis=1) / \
+        jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
 
 
 # ---------------------------------------------------------------------------
@@ -219,7 +273,7 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     (logits [B, V] fp32, kv')."""
     k_pages, v_pages = kv
     x = params["embed"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))  # [B,1,D]
-    context_lens = jnp.where(active, positions + 1, 0)
+    cache_lens = jnp.where(active, positions, 0)   # tokens already written
 
     def layer(x, xs):
         lp, kp, vp = xs
@@ -228,18 +282,22 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         pos2 = positions[:, None]
         q = apply_rope(q, pos2, cfg.rope_theta)
         k = apply_rope(k, pos2, cfg.rope_theta)
-        kp, vp = write_decode_kv(kp, vp, k[:, 0], v[:, 0], page_table,
-                                 positions, active)
-        attn = paged_decode_attention(q[:, 0], kp, vp, page_table,
-                                      context_lens)              # [B,Hq,Dh]
+        # The current token's K/V stays in-registers for attention; the
+        # pool write happens once for all layers after the scan (carrying
+        # the pool as scan ys would rewrite the whole pool per step).
+        attn = paged_decode_attention_current_auto(
+            q[:, 0], kp, vp, page_table, cache_lens,
+            k[:, 0], v[:, 0])                                    # [B,Hq,Dh]
         B = tokens.shape[0]
         x = x + (attn.reshape(B, 1, -1) @ lp["o_proj"])
         h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
         x = x + _mlp(lp, cfg, h)
-        return x, (kp, vp)
+        return x, (k[:, 0], v[:, 0])
 
-    x, (k_pages, v_pages) = jax.lax.scan(
+    x, (k_new, v_new) = jax.lax.scan(
         layer, x, (params["layers"], k_pages, v_pages))
+    k_pages, v_pages = write_decode_kv_all_layers(
+        k_pages, v_pages, k_new, v_new, page_table, positions, active)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:

@@ -1,0 +1,129 @@
+"""Vision encoder for the EPD (encode→prefill→decode) multimodal pipeline.
+
+The reference *claims* EPD multimodal disaggregation but keeps the encode
+stage engine-side and out of repo (README.md:44, SURVEY.md §2 intro); this
+is the net-new TPU implementation: a ViT-style patch encoder compiled as
+its own XLA program (SURVEY.md §7.1 EPD row), runnable on a dedicated
+ENCODE worker or inline on a prefill worker. Output is a sequence of patch
+embeddings projected into the language model's hidden size, spliced into
+the prompt at image-placeholder token positions.
+
+TPU-first choices mirror the text stack: stacked layers + ``lax.scan``,
+bfloat16 matmuls / fp32 norms, static shapes (images are resized host-side
+to a fixed grid; the token count per image is a compile-time constant).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from xllm_service_tpu.config import ModelConfig
+from xllm_service_tpu.ops.norm import rms_norm
+
+VisionParams = Dict[str, Any]
+
+
+def num_patches(image_size: int, patch_size: int) -> int:
+    return (image_size // patch_size) ** 2
+
+
+def init_vision_params(cfg: "VisionConfig", key: jax.Array) -> VisionParams:
+    dtype = jnp.dtype(cfg.dtype)
+    D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    H, Dh = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    P = cfg.patch_size
+    keys = iter(jax.random.split(key, 16))
+
+    def w(shape, fan_in):
+        return (jax.random.normal(next(keys), shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(dtype)
+
+    n_patch = num_patches(cfg.image_size, P)
+    return {
+        "patch_embed": w((P * P * 3, D), P * P * 3),
+        "pos_embed": w((n_patch, D), D),
+        "layers": {
+            "input_norm": jnp.ones((L, D), dtype),
+            "qkv": w((L, D, 3 * H * Dh), D),
+            "o_proj": w((L, H * Dh, D), H * Dh),
+            "post_norm": jnp.ones((L, D), dtype),
+            "up_proj": w((L, D, F), D),
+            "down_proj": w((L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), dtype),
+        "proj": w((D, cfg.output_size), D),
+    }
+
+
+def patchify(pixels: jnp.ndarray, patch_size: int) -> jnp.ndarray:
+    """[B, H, W, 3] → [B, n_patches, P*P*3]."""
+    B, H, W, C = pixels.shape
+    P = patch_size
+    x = pixels.reshape(B, H // P, P, W // P, P, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, (H // P) * (W // P), P * P * C)
+
+
+def encode_image(params: VisionParams, cfg: "VisionConfig",
+                 pixels: jnp.ndarray) -> jnp.ndarray:
+    """pixels [B, H, W, 3] float in [0, 1] → patch embeddings
+    [B, n_patches, output_size] in the LLM's hidden space."""
+    dtype = jnp.dtype(cfg.dtype)
+    H, Dh = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    x = patchify(pixels.astype(dtype), cfg.patch_size) @ params["patch_embed"]
+    x = x + params["pos_embed"][None]
+
+    def layer(x, lp):
+        B, T, D = x.shape
+        h = rms_norm(x, lp["input_norm"], 1e-5)
+        qkv = (h @ lp["qkv"]).reshape(B, T, 3, H, Dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+        logits = jnp.einsum("bthd,bshd->bhts", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        p = jax.nn.softmax(logits, axis=-1)          # bidirectional
+        attn = jnp.einsum("bhts,bshd->bthd", p.astype(v.dtype), v)
+        x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
+        h = rms_norm(x, lp["post_norm"], 1e-5)
+        x = x + jax.nn.gelu(h @ lp["up_proj"]) @ lp["down_proj"]
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], 1e-5)
+    return (x @ params["proj"]).astype(dtype)
+
+
+class VisionConfig:
+    """Static config; hashable for use as a jit closure constant."""
+
+    def __init__(self, image_size: int = 224, patch_size: int = 14,
+                 hidden_size: int = 1024, intermediate_size: int = 4096,
+                 num_layers: int = 24, num_heads: int = 16,
+                 output_size: int = 4096, dtype: str = "bfloat16") -> None:
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.output_size = output_size
+        self.dtype = dtype
+
+    @property
+    def tokens_per_image(self) -> int:
+        return num_patches(self.image_size, self.patch_size)
+
+    @classmethod
+    def tiny(cls, output_size: int = 64) -> "VisionConfig":
+        return cls(image_size=16, patch_size=4, hidden_size=32,
+                   intermediate_size=64, num_layers=2, num_heads=2,
+                   output_size=output_size)
+
+    @classmethod
+    def for_model(cls, model_cfg: ModelConfig) -> "VisionConfig":
+        """Qwen2-VL-flavored encoder sized for ``model_cfg``'s hidden."""
+        return cls(output_size=model_cfg.hidden_size)

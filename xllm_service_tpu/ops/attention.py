@@ -96,6 +96,66 @@ def write_decode_kv(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     return k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape)
 
 
+def write_decode_kv_all_layers(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                               k_new: jnp.ndarray, v_new: jnp.ndarray,
+                               page_table: jnp.ndarray,
+                               positions: jnp.ndarray,
+                               active: jnp.ndarray
+                               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Write ONE decode token's K/V for ALL layers in a single scatter.
+
+    k_pages: [L, P, ps, Hkv, D]; k_new: [L, B, Hkv, D] (per-layer scan ys).
+    This exists so the layer scan never carries the pool as stacked ys —
+    which would rewrite the entire pool in HBM every decode step (measured
+    ~13 ms/step per GB of pool). One donated scatter after the scan is
+    in-place."""
+    L = k_pages.shape[0]
+    page_size = k_pages.shape[2]
+    num_slots = k_pages.shape[1] * page_size
+    flat = _flat_kv_index(page_table, positions[:, None], page_size,
+                          num_slots, active[:, None])[:, 0]     # [B]
+    pool_shape = (L, -1) + k_pages.shape[3:]
+    k_flat = k_pages.reshape(pool_shape).at[:, flat].set(
+        k_new, mode="drop")
+    v_flat = v_pages.reshape(pool_shape).at[:, flat].set(
+        v_new, mode="drop")
+    return (k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape))
+
+
+def write_prefill_kv_all_layers(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                                k_new: jnp.ndarray, v_new: jnp.ndarray,
+                                page_table: jnp.ndarray,
+                                start_pos: jnp.ndarray,
+                                lengths: jnp.ndarray
+                                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Prefill counterpart: k_new [L, B, T, Hkv, D] → one scatter."""
+    L, B, T = k_new.shape[0], k_new.shape[1], k_new.shape[2]
+    page_size = k_pages.shape[2]
+    num_slots = k_pages.shape[1] * page_size
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    positions = start_pos[:, None] + t
+    valid = t < lengths[:, None]
+    flat = _flat_kv_index(page_table, positions, page_size, num_slots,
+                          valid).reshape(-1)                    # [B*T]
+    pool_shape = (L, -1) + k_pages.shape[3:]
+    new_shape = (L, B * T) + k_new.shape[3:]
+    k_flat = k_pages.reshape(pool_shape).at[:, flat].set(
+        k_new.reshape(new_shape), mode="drop")
+    v_flat = v_pages.reshape(pool_shape).at[:, flat].set(
+        v_new.reshape(new_shape), mode="drop")
+    return (k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape))
+
+
+def overlay_fresh_kv(k_all: jnp.ndarray, k_fresh: jnp.ndarray,
+                     start_pos: jnp.ndarray) -> jnp.ndarray:
+    """Overlay this step's fresh K/V [B, T, H, D] onto the gathered cache
+    view [B, S, H, D] at per-sequence offsets (prefill attends against
+    cache + fresh without the fresh tokens having been written yet)."""
+    return jax.vmap(
+        lambda arr, upd, s: jax.lax.dynamic_update_slice(
+            arr, upd, (s, 0, 0)))(k_all, k_fresh, start_pos)
+
+
 def gather_pages(pages: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
     """Gather a sequence's pages into [B, max_pages * page_size, Hkv, D]."""
     g = pages[page_table]                                   # [B, MP, page, H, D]
@@ -138,6 +198,81 @@ def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     p = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhgts,bshd->bthgd", p.astype(v.dtype), v)
     return out.reshape(B, T, Hq, D)
+
+
+def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
+                                   v_pages: jnp.ndarray,
+                                   page_table: jnp.ndarray,
+                                   cache_lens: jnp.ndarray,
+                                   k_cur: jnp.ndarray, v_cur: jnp.ndarray,
+                                   logits_soft_cap: float = 0.0
+                                   ) -> jnp.ndarray:
+    """Decode attention over the cache PLUS the current token's K/V held
+    in-registers (XLA reference path).
+
+    The hot-loop restructure that motivates this: writing the current
+    token's KV into the pool before attending forces the per-layer scan to
+    emit a full pool copy as stacked ys (a whole-pool HBM rewrite per
+    decode step). Keeping the current token out of the pool lets layers
+    read the cache as scan xs and defer all writes to one donated scatter
+    after the layer scan.
+
+    q: [B, Hq, D]; k_cur/v_cur: [B, Hkv, D]; cache_lens: [B] valid tokens
+    already in the cache (EXcluding the current token). Returns [B, Hq, D].
+    """
+    B, Hq, D = q.shape
+    Hkv = k_cur.shape[1]
+    k = gather_pages(k_pages, page_table)                   # [B, S, Hkv, D]
+    v = gather_pages(v_pages, page_table)
+    k = jnp.concatenate([k, k_cur[:, None]], axis=1)        # [B, S+1, ...]
+    v = jnp.concatenate([v, v_cur[:, None]], axis=1)
+    qg = _group_heads(q, Hkv)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    logits = jnp.einsum("bhgd,bshd->bhgs", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if logits_soft_cap > 0.0:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+    S1 = k.shape[1]
+    pos = jnp.arange(S1, dtype=jnp.int32)[None, :]
+    # Cache positions < cache_lens valid; the appended slot (index S1-1)
+    # is the current token, always valid.
+    mask = (pos < cache_lens[:, None]) | (pos == S1 - 1)
+    logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgs,bshd->bhgd", p.astype(v.dtype), v)
+    return out.reshape(B, Hq, D)
+
+
+def paged_decode_attention_current_auto(q, k_pages, v_pages, page_table,
+                                        cache_lens, k_cur, v_cur,
+                                        logits_soft_cap: float = 0.0):
+    """Trace-time dispatch for the current-token variant."""
+    if logits_soft_cap == 0.0:
+        from xllm_service_tpu.ops import pallas
+        if pallas.enabled():
+            return pallas.paged_decode_attention_pallas(
+                q, k_pages, v_pages, page_table, cache_lens,
+                k_cur=k_cur, v_cur=v_cur)
+    return paged_decode_attention_current(
+        q, k_pages, v_pages, page_table, cache_lens, k_cur, v_cur,
+        logits_soft_cap)
+
+
+def paged_decode_attention_auto(q: jnp.ndarray, k_pages: jnp.ndarray,
+                                v_pages: jnp.ndarray,
+                                page_table: jnp.ndarray,
+                                context_lens: jnp.ndarray,
+                                logits_soft_cap: float = 0.0
+                                ) -> jnp.ndarray:
+    """Trace-time dispatch: fused Pallas kernel on TPU (XLLM_PALLAS
+    overrides), XLA gather-then-attend reference elsewhere."""
+    if logits_soft_cap == 0.0:
+        from xllm_service_tpu.ops import pallas
+        if pallas.enabled():
+            return pallas.paged_decode_attention_pallas(
+                q, k_pages, v_pages, page_table, context_lens)
+    return paged_decode_attention(q, k_pages, v_pages, page_table,
+                                  context_lens, logits_soft_cap)
 
 
 def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,

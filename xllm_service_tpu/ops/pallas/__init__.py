@@ -1,0 +1,35 @@
+"""Fused Pallas TPU kernels for the serving hot path.
+
+``ops/attention.py`` holds the XLA reference implementations (gather →
+attend); these kernels replace them where it pays: paged decode attention
+reads KV pages HBM→VMEM directly via a scalar-prefetched page table, so
+the per-layer, per-step dense gather of the whole page table disappears
+(half the HBM traffic of gather-then-attend, and no [B, S, Hkv, D]
+materialization).
+
+Selection: ``enabled()`` — on for TPU backends, off elsewhere, overridable
+with XLLM_PALLAS=0/1. On CPU the kernels still run under the Pallas
+interpreter for tests (``interpret=True``).
+"""
+
+import os
+
+import jax
+
+
+def enabled() -> bool:
+    env = os.environ.get("XLLM_PALLAS", "").strip()
+    if env in ("0", "false", "no"):
+        return False
+    if env in ("1", "true", "yes"):
+        return True
+    try:
+        # devices()[0].platform is "tpu" even when the backend registers
+        # under another name (e.g. the tunneled "axon" plugin).
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001 — backend init failure → reference path
+        return False
+
+
+from xllm_service_tpu.ops.pallas.paged_attention import (  # noqa: E402,F401
+    paged_decode_attention_pallas)

@@ -1,0 +1,166 @@
+"""Pallas TPU kernel: single-token GQA attention over the paged KV cache.
+
+Replaces the reference XLA path (ops/attention.py ``paged_decode_attention``)
+which gathers every referenced page into a dense [B, S, Hkv, D] tensor
+before attending — 2× the HBM traffic and a full materialization per layer
+per decode step. Here each batch program streams its sequence's pages
+HBM→VMEM via a **scalar-prefetched page table** (the BlockSpec index map
+reads ``page_table[b, p]`` before the kernel body runs, so the pipeline
+DMAs exactly the right page), folding each page into a flash-style
+online-softmax accumulator in VMEM scratch.
+
+Grid: (B, max_pages), pages fastest → the scratch accumulator carries
+across the page walk of one batch row (standard TPU flash pattern). Each
+block is a whole page with all KV heads ([ps, Hkv, D] — Pallas TPU wants
+the trailing two block dims full or (8,128)-aligned, so heads stay in the
+block and the GQA grouping happens in-kernel). NULL pages (id 0) and
+positions ≥ context_len are masked; fully out-of-range pages skip compute
+via ``pl.when`` (their DMA lands on page 0 and is discarded).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
+            m_ref, l_ref, acc_ref, *, page_size: int, pages_per_seq: int,
+            num_kv_heads: int, has_current: bool):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_ref[b]
+    page_start = p * page_size
+
+    @pl.when(page_start < ctx)
+    def _fold():
+        hq, d = q_ref.shape[1], q_ref.shape[2]
+        g = hq // num_kv_heads
+        q = q_ref[0].astype(jnp.float32)                     # [Hq, D]
+        qg = q.reshape(num_kv_heads, g, d)                   # [Hkv, G, D]
+        k = k_ref[0].astype(jnp.float32)                     # [ps, Hkv, D]
+        v = v_ref[0].astype(jnp.float32)
+        kt = jnp.transpose(k, (1, 0, 2))                     # [Hkv, ps, D]
+        vt = jnp.transpose(v, (1, 0, 2))
+        scale = 1.0 / (d ** 0.5)
+        # Batched over Hkv: [Hkv, G, D] x [Hkv, ps, D] -> [Hkv, G, ps]
+        logits = jax.lax.dot_general(
+            qg, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        logits = logits.reshape(hq, page_size)               # [Hq, ps]
+        pos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        mask = pos < ctx                                     # [1, ps]
+        logits = jnp.where(mask, logits, _NEG_INF)
+        m_prev = m_ref[:]                                    # [Hq, 1]
+        blk_max = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, blk_max)
+        prob = jnp.exp(logits - m_new)
+        prob = jnp.where(mask, prob, 0.0)                    # [Hq, ps]
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(prob, axis=-1,
+                                             keepdims=True)
+        # [Hkv, G, ps] x [Hkv, ps, D] -> [Hkv, G, D]
+        pv = jax.lax.dot_general(
+            prob.reshape(num_kv_heads, g, page_size), vt,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + pv.reshape(hq, d)
+        m_ref[:] = m_new
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finalize():
+        if has_current:
+            # Fold the current token's K/V (held in-registers, not yet in
+            # the pool) as a final always-valid single-position block.
+            hq, d = q_ref.shape[1], q_ref.shape[2]
+            g = hq // num_kv_heads
+            q = q_ref[0].astype(jnp.float32)
+            qg = q.reshape(num_kv_heads, g, d)
+            kc = kc_ref[0].astype(jnp.float32)               # [Hkv, D]
+            vc = vc_ref[0].astype(jnp.float32)
+            scale = 1.0 / (d ** 0.5)
+            lc = jnp.sum(qg * kc[:, None, :], axis=-1) * scale  # [Hkv, G]
+            lc = lc.reshape(hq, 1)
+            m_prev = m_ref[:]
+            m_new = jnp.maximum(m_prev, lc)
+            corr = jnp.exp(m_prev - m_new)
+            pc = jnp.exp(lc - m_new)                         # [Hq, 1]
+            l_fin = l_ref[:] * corr + pc
+            vc_full = jnp.broadcast_to(
+                vc[:, None, :], (num_kv_heads, g, d)).reshape(hq, d)
+            acc_fin = acc_ref[:] * corr + pc * vc_full
+        else:
+            l_fin = l_ref[:]
+            acc_fin = acc_ref[:]
+        denom = jnp.maximum(l_fin, 1e-30)
+        o_ref[0] = (acc_fin / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
+                                  v_pages: jnp.ndarray,
+                                  page_table: jnp.ndarray,
+                                  context_lens: jnp.ndarray,
+                                  k_cur: jnp.ndarray = None,
+                                  v_cur: jnp.ndarray = None,
+                                  interpret: bool = False) -> jnp.ndarray:
+    """q: [B, Hq, D]; k/v_pages: [P, ps, Hkv, D]; page_table: [B, MP];
+    context_lens: [B] valid cache tokens. With ``k_cur``/``v_cur``
+    [B, Hkv, D], the current (not-yet-written) token is folded as a final
+    block — the contract of ``paged_decode_attention_current``. Returns
+    [B, Hq, D]."""
+    B, Hq, D = q.shape
+    _, page_size, Hkv, _ = k_pages.shape
+    MP = page_table.shape[1]
+    has_current = k_cur is not None
+    if not has_current:
+        k_cur = jnp.zeros((B, Hkv, D), q.dtype)
+        v_cur = jnp.zeros((B, Hkv, D), q.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,               # context_lens, page_table
+        grid=(B, MP),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D),
+                         lambda b, p, ctx, pt: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, Hkv, D),
+                         lambda b, p, ctx, pt: (pt[b, p], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, Hkv, D),
+                         lambda b, p, ctx, pt: (pt[b, p], 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, D),
+                         lambda b, p, ctx, pt: (b, 0, 0)),
+            pl.BlockSpec((1, Hkv, D),
+                         lambda b, p, ctx, pt: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D),
+                               lambda b, p, ctx, pt: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hq, 1), jnp.float32),    # running max
+            pltpu.VMEM((Hq, 1), jnp.float32),    # running denom
+            pltpu.VMEM((Hq, D), jnp.float32),    # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, page_size=page_size, pages_per_seq=MP,
+                          num_kv_heads=Hkv, has_current=has_current),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(context_lens, page_table, q, k_pages, v_pages, k_cur, v_cur)
+    return out

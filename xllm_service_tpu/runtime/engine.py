@@ -66,6 +66,10 @@ class EngineRequest:
     # finishes so its KV can be exported to a decode instance
     # (prefill-side handoff, SURVEY.md §7.3 item 1).
     hold_after_finish: bool = False
+    # EPD multimodal: vision embeddings [M, hidden] and the absolute prompt
+    # positions they splice into (image-placeholder token spans).
+    mm_embeds: Optional[np.ndarray] = None
+    mm_positions: Optional[List[int]] = None
 
 
 class SeqStatus(enum.Enum):
@@ -165,6 +169,10 @@ class Engine:
         self._jit_decode = jax.jit(
             functools.partial(_decode_step, cfg=model_cfg),
             donate_argnums=(4,))
+        self._jit_decode_multi = jax.jit(
+            functools.partial(_decode_multi_step, cfg=model_cfg,
+                              n_steps=engine_cfg.decode_steps),
+            donate_argnums=(4,))
 
         self.step_count = 0
         self.num_preemptions = 0
@@ -238,8 +246,14 @@ class Engine:
         slot = self._free_slot()
         if slot < 0:
             return False
-        cached_pages, cached_tokens = \
-            self.prefix_cache.match_prefix(seq.req.token_ids)
+        if seq.req.mm_embeds is None:
+            cached_pages, cached_tokens = \
+                self.prefix_cache.match_prefix(seq.req.token_ids)
+        else:
+            # Multimodal KV depends on image content, not just token ids
+            # (placeholder spans are identical across images) — such
+            # sequences neither hit nor feed the content-addressed cache.
+            cached_pages, cached_tokens = [], 0
         need = self._pages_needed(len(seq.tokens) + 1) - len(cached_pages)
         new_pages = self.prefix_cache.alloc(max(need, 0))
         while new_pages is None and not seq.req.offline and \
@@ -261,8 +275,9 @@ class Engine:
         """Recompute-style preemption: free pages, requeue (generated
         tokens are kept and re-prefilled on readmission)."""
         self._release_seq_slot(seq)
-        self.prefix_cache.register_full_pages(
-            seq.tokens[:seq.num_computed], seq.pages)
+        if seq.req.mm_embeds is None:
+            self.prefix_cache.register_full_pages(
+                seq.tokens[:seq.num_computed], seq.pages)
         self.prefix_cache.release_pages(seq.pages)
         seq.pages = []
         seq.num_computed = 0
@@ -274,11 +289,12 @@ class Engine:
         self.waiting.append(seq)
         self._sort_waiting()
 
-    def _grow_pages(self, seq: Sequence) -> bool:
-        """Ensure ``seq`` has a page for its next token write. On exhaustion
-        preempt offline victims, else preempt ``seq`` itself. Returns False
-        if the sequence was preempted."""
-        need = self._pages_needed(len(seq.tokens)) - len(seq.pages)
+    def _grow_pages(self, seq: Sequence, lookahead: int = 0) -> bool:
+        """Ensure ``seq`` has pages for its next ``1 + lookahead`` token
+        writes. On exhaustion preempt offline victims, else preempt ``seq``
+        itself. Returns False if the sequence was preempted."""
+        need = self._pages_needed(len(seq.tokens) + lookahead) \
+            - len(seq.pages)
         if need <= 0:
             return True
         pages = self.prefix_cache.alloc(need)
@@ -311,8 +327,9 @@ class Engine:
         # Make full pages reusable by future prompts, then drop ownership.
         # Only tokens[:num_computed] have KV resident — the final sampled
         # token was never fed, so its slot must not be content-addressed.
-        self.prefix_cache.register_full_pages(
-            seq.tokens[:seq.num_computed], seq.pages)
+        if seq.req.mm_embeds is None:
+            self.prefix_cache.register_full_pages(
+                seq.tokens[:seq.num_computed], seq.pages)
         if seq.req.hold_after_finish and reason != FinishReason.CANCELLED:
             # PD handoff: pages stay refcounted until export_held().
             self._held[seq.req.request_id] = seq
@@ -333,7 +350,18 @@ class Engine:
         if batch:
             outs.extend(self._run_prefill(batch))
         elif self.running:
-            outs.extend(self._run_decode())
+            N = self.ecfg.decode_steps
+            # The fused scan writes KV at positions up to len+N-2; any
+            # sequence that would cross max_model_len must take single
+            # steps (a clamped out-of-bounds page write could corrupt a
+            # content-addressed page). Only the last few tokens of a
+            # near-limit sequence hit this path.
+            if N > 1 and all(
+                    len(s.tokens) + N - 1 <= self.ecfg.max_model_len
+                    for s in self.running):
+                outs.extend(self._run_decode_multi())
+            else:
+                outs.extend(self._run_decode())
         return outs
 
     def _drain_cancelled(self) -> List[StepOutput]:
@@ -379,7 +407,17 @@ class Engine:
     def _run_prefill(self, batch: List[Sequence]) -> List[StepOutput]:
         B = 1 << (len(batch) - 1).bit_length()          # pow2 batch bucket
         T = self._bucket(max(len(s.tokens) - s.num_computed for s in batch))
-        MP = self.ecfg.max_pages_per_seq
+        # Table width must cover both every sequence's pages AND the
+        # padded overlay window [start, start+T) that prefill attention
+        # writes fresh K/V into (ops/attention.overlay_fresh_kv).
+        mp = max(max(len(s.pages) for s in batch),
+                 max(self._pages_needed(s.num_computed + T)
+                     for s in batch))
+        # Deliberately NOT clamped to max_pages_per_seq: a bucketed T can
+        # overshoot a late-start sequence's true window, and the overlay
+        # view must still cover [start, start+T) — extra columns are NULL
+        # pages, masked in attention and dropped by the pool scatter.
+        MP = 1 << max(mp - 1, 0).bit_length()
         toks = np.zeros((B, T), np.int32)
         start = np.zeros(B, np.int32)
         lens = np.zeros(B, np.int32)
@@ -393,9 +431,30 @@ class Engine:
         st = self._sampling_tensors(
             [s.req.sampling for s in batch], B)
         self._rng_key, key = jax.random.split(self._rng_key)
+        mm_e = mm_p = None
+        if any(s.req.mm_embeds is not None for s in batch):
+            # Pad the multimodal splice to a pow2 bucket; positions are
+            # window-relative, already-cached or pad slots point at T
+            # (dropped by the scatter).
+            max_m = max(len(s.req.mm_positions or ()) for s in batch)
+            M = 1 << max(max_m - 1, 0).bit_length()
+            D = self.cfg.hidden_size
+            mm_e = np.zeros((B, M, D), np.float32)
+            mm_p = np.full((B, M), T, np.int32)
+            for i, seq in enumerate(batch):
+                if seq.req.mm_embeds is None:
+                    continue
+                for j, pos in enumerate(seq.req.mm_positions):
+                    rel = pos - seq.num_computed
+                    if 0 <= rel < T:
+                        mm_p[i, j] = rel
+                        mm_e[i, j] = seq.req.mm_embeds[j]
+            mm_e = jnp.asarray(mm_e)
+            mm_p = jnp.asarray(mm_p)
         next_tok, logprob, self.kv = self._jit_prefill(
             self.params, jnp.asarray(toks), jnp.asarray(start),
-            jnp.asarray(lens), self.kv, jnp.asarray(pt), st, key)
+            jnp.asarray(lens), self.kv, jnp.asarray(pt), st, key,
+            mm_e, mm_p)
         next_tok = np.asarray(next_tok)
         logprob = np.asarray(logprob)
 
@@ -411,6 +470,15 @@ class Engine:
             self._sync_slot(seq)
         return outs
 
+    def _table_width(self) -> int:
+        """Page-table columns actually needed by the running batch, bucketed
+        to a power of two. Attention cost (page DMAs / gather width) scales
+        with table width, so shipping the full max_pages_per_seq table
+        makes every short-context batch pay long-context prices."""
+        mp = max((len(s.pages) for s in self.running), default=1)
+        mp = 1 << max(mp - 1, 0).bit_length()
+        return min(mp, self.ecfg.max_pages_per_seq)
+
     def _run_decode(self) -> List[StepOutput]:
         B = self.ecfg.max_batch_size
         active = np.zeros(B, bool)
@@ -423,10 +491,12 @@ class Engine:
             self._slot_st = SamplingTensors.for_batch(self._slot_sampling)
         st = self._slot_st
         self._rng_key, key = jax.random.split(self._rng_key)
+        mp = self._table_width()
         next_tok, logprob, self.kv = self._jit_decode(
             self.params, jnp.asarray(self._slot_last_token),
             jnp.asarray(self._slot_pos), jnp.asarray(active), self.kv,
-            jnp.asarray(self._slot_pt), st, key)
+            jnp.asarray(np.ascontiguousarray(self._slot_pt[:, :mp])),
+            st, key)
         next_tok = np.asarray(next_tok)
         logprob = np.asarray(logprob)
         outs: List[StepOutput] = []
@@ -440,6 +510,72 @@ class Engine:
             # (sampled while its KV was resident); it re-prefills later.
             outs.append(self._append_token(
                 seq, int(next_tok[i]), float(logprob[i])))
+        return outs
+
+    def _run_decode_multi(self) -> List[StepOutput]:
+        """N fused decode steps per host round-trip (one lax.scan program).
+
+        Pages are pre-grown for the whole lookahead; finish detection runs
+        on host afterwards, discarding tokens sampled past a stop. Each
+        surviving sequence gets ONE StepOutput carrying its accepted token
+        run, so streaming consumers see a burst of up to N tokens."""
+        N = self.ecfg.decode_steps
+        B = self.ecfg.max_batch_size
+        # Pre-grow pages to cover positions len-1 .. len-1+N-1 (may preempt
+        # — iterate over a snapshot).
+        for seq in list(self.running):
+            if seq.status == SeqStatus.RUNNING:
+                self._grow_pages(seq, lookahead=N - 1)
+        if not self.running:
+            return []
+        active = np.zeros(B, bool)
+        for seq in self.running:
+            i = seq.slot
+            active[i] = True
+            self._slot_last_token[i] = seq.tokens[-1]
+            self._slot_pos[i] = len(seq.tokens) - 1
+        if self._slot_st is None:
+            self._slot_st = SamplingTensors.for_batch(self._slot_sampling)
+        st = self._slot_st
+        self._rng_key, key = jax.random.split(self._rng_key)
+        # Width must cover the lookahead pages pre-grown above.
+        mp = self._table_width()
+        toks, logps, self.kv = self._jit_decode_multi(
+            self.params, jnp.asarray(self._slot_last_token),
+            jnp.asarray(self._slot_pos), jnp.asarray(active), self.kv,
+            jnp.asarray(np.ascontiguousarray(self._slot_pt[:, :mp])),
+            st, key)
+        toks = np.asarray(toks)          # [N, B]
+        logps = np.asarray(logps)        # [N, B]
+
+        outs: List[StepOutput] = []
+        for seq, slot in [(s, s.slot) for s in self.running]:
+            accepted: List[int] = []
+            lps: List[float] = []
+            reason = FinishReason.NONE
+            for k_step in range(N):
+                tok = int(toks[k_step, slot])
+                seq.tokens.append(tok)
+                accepted.append(tok)
+                lps.append(float(logps[k_step, slot]))
+                reason = self._finish_reason(seq, tok)
+                if reason != FinishReason.NONE:
+                    break
+            if seq.status == SeqStatus.RUNNING:
+                # KV resident for every token but the last sampled one.
+                seq.num_computed = len(seq.tokens) - 1
+            out = StepOutput(
+                request_id=seq.req.request_id, new_token_ids=accepted,
+                logprobs=lps, finish_reason=reason,
+                num_prompt_tokens=seq.num_prompt_tokens,
+                num_generated=seq.num_generated)
+            outs.append(out)
+            if reason != FinishReason.NONE:
+                self._finish_seq(seq, reason)
+            elif seq.status == SeqStatus.RUNNING \
+                    and seq.req.mm_embeds is None:
+                self.prefix_cache.register_full_pages(
+                    seq.tokens[:seq.num_computed], seq.pages)
         return outs
 
     def _append_token(self, seq: Sequence, tok: int,
@@ -458,8 +594,9 @@ class Engine:
             # register them so other prompts can reuse the prefix (only
             # computed tokens — the one just sampled has no KV yet), and
             # grow the table for the next token's KV write (may preempt).
-            self.prefix_cache.register_full_pages(
-                seq.tokens[:seq.num_computed], seq.pages)
+            if seq.req.mm_embeds is None:
+                self.prefix_cache.register_full_pages(
+                    seq.tokens[:seq.num_computed], seq.pages)
             self._grow_pages(seq)
         return out
 
@@ -561,8 +698,9 @@ class Engine:
         self._sync_slot(seq)
         # Migrated prefixes are content-addressed here too, so future
         # prompts on this instance reuse them.
-        self.prefix_cache.register_full_pages(
-            seq.tokens[:seq.num_computed], seq.pages)
+        if req.mm_embeds is None:
+            self.prefix_cache.register_full_pages(
+                seq.tokens[:seq.num_computed], seq.pages)
         return True
 
     # ------------------------------------------------------------------
@@ -615,9 +753,11 @@ def _kv_scatter(k_pages, v_pages, idx, k_new, v_new):
 
 
 def _prefill_step(params, tokens, start_pos, lengths, kv, page_table,
-                  st: SamplingTensors, key, *, cfg: ModelConfig):
+                  st: SamplingTensors, key, mm_embeds=None,
+                  mm_positions=None, *, cfg: ModelConfig):
     last_logits, _, kv = transformer.forward_prefill(
-        params, cfg, tokens, start_pos, lengths, kv, page_table)
+        params, cfg, tokens, start_pos, lengths, kv, page_table,
+        mm_embeds=mm_embeds, mm_positions=mm_positions)
     tok = sample_tokens(last_logits, st, key)
     lp = compute_logprobs(last_logits, tok)
     return tok, lp, kv
@@ -630,3 +770,24 @@ def _decode_step(params, tokens, positions, active, kv, page_table,
     tok = sample_tokens(logits, st, key)
     lp = compute_logprobs(logits, tok)
     return tok, lp, kv
+
+
+def _decode_multi_step(params, tokens, positions, active, kv, page_table,
+                       st: SamplingTensors, key, *, cfg: ModelConfig,
+                       n_steps: int):
+    """``n_steps`` fused greedy/sampled decode iterations: the scan body is
+    traced once, tokens feed forward on-device, and only the [N, B] token/
+    logprob blocks cross back to the host — one dispatch per N tokens."""
+
+    def body(carry, key_i):
+        tok, pos, kv = carry
+        logits, kv = transformer.forward_decode(
+            params, cfg, tok, pos, active, kv, page_table)
+        new_tok = sample_tokens(logits, st, key_i)
+        lp = compute_logprobs(logits, new_tok)
+        return (new_tok, pos + 1, kv), (new_tok, lp)
+
+    keys = jax.random.split(key, n_steps)
+    (_, _, kv), (toks, lps) = jax.lax.scan(
+        body, (tokens, positions, kv), keys)
+    return toks, lps, kv

@@ -1,0 +1,105 @@
+"""Multimodal plumbing for the EPD pipeline: image loading, placeholder
+expansion, embedding wire format.
+
+The chat template flattens OpenAI image content parts into
+``<|image_pad|>`` placeholders plus ``mm_inputs`` descriptors
+(nlp/chat_template.py). Worker-side, each placeholder span is expanded to
+``tokens_per_image`` copies of the model's image token id, and the vision
+encoder's patch embeddings are spliced at those positions
+(transformer.forward_prefill ``mm_embeds``).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+def image_token_id(vocab_size: int) -> int:
+    """Reserved splice-marker token: the last vocab id (never produced by
+    tokenizers, which stop well short of padded vocab sizes)."""
+    return vocab_size - 1
+
+
+def load_image(spec: Any, image_size: int) -> np.ndarray:
+    """Resolve one mm_inputs descriptor to pixels [H, W, 3] float32 in
+    [0, 1], resized (nearest) to the encoder's fixed grid.
+
+    Supported: ``"random:<seed>"`` (deterministic synthetic — tests and
+    loadgen), a dict with ``pixels_b64``+``shape`` (raw float32), or a
+    ``data:`` URI with base64 payload decoded via PIL when available."""
+    if isinstance(spec, dict) and spec.get("type") in ("image", "video"):
+        spec = spec.get("data")
+    if isinstance(spec, str) and spec.startswith("random:"):
+        seed = int(spec.split(":", 1)[1] or 0)
+        rng = np.random.default_rng(seed)
+        return rng.random((image_size, image_size, 3), np.float32)
+    if isinstance(spec, dict) and "pixels_b64" in spec:
+        arr = np.frombuffer(base64.b64decode(spec["pixels_b64"]),
+                            np.float32).reshape(spec["shape"])
+        return _resize_nearest(arr, image_size)
+    if isinstance(spec, str) and spec.startswith("data:"):
+        try:
+            from io import BytesIO
+
+            from PIL import Image
+        except ImportError as e:
+            raise ValueError("data: URI images need PIL") from e
+        payload = spec.split(",", 1)[1]
+        img = Image.open(BytesIO(base64.b64decode(payload))).convert("RGB")
+        img = img.resize((image_size, image_size))
+        return np.asarray(img, np.float32) / 255.0
+    raise ValueError(f"unsupported image spec: {type(spec)} "
+                     f"{str(spec)[:60]!r}")
+
+
+def _resize_nearest(arr: np.ndarray, size: int) -> np.ndarray:
+    h, w = arr.shape[:2]
+    yi = (np.arange(size) * h // size).clip(0, h - 1)
+    xi = (np.arange(size) * w // size).clip(0, w - 1)
+    return arr[yi][:, xi]
+
+
+def expand_image_placeholders(token_ids: Sequence[int],
+                              placeholder_ids: Sequence[int],
+                              num_images: int, tokens_per_image: int,
+                              img_tok: int
+                              ) -> Tuple[List[int], List[int]]:
+    """Replace each placeholder-id span with ``tokens_per_image`` image
+    tokens. Returns (new_token_ids, splice positions — one per image
+    token, in image order, aligned with the flattened embedding rows)."""
+    if not placeholder_ids:
+        raise ValueError("tokenizer produced empty placeholder encoding")
+    out: List[int] = []
+    positions: List[int] = []
+    i = 0
+    found = 0
+    pl = list(placeholder_ids)
+    n = len(token_ids)
+    while i < n:
+        if found < num_images and token_ids[i:i + len(pl)] == pl:
+            start = len(out)
+            out.extend([img_tok] * tokens_per_image)
+            positions.extend(range(start, start + tokens_per_image))
+            i += len(pl)
+            found += 1
+        else:
+            out.append(token_ids[i])
+            i += 1
+    if found != num_images:
+        raise ValueError(
+            f"found {found} image placeholders for {num_images} images")
+    return out, positions
+
+
+def embeds_to_wire(embeds: np.ndarray) -> Dict[str, Any]:
+    arr = np.ascontiguousarray(embeds, dtype=np.float32)
+    return {"embeds_b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+            "shape": list(arr.shape)}
+
+
+def embeds_from_wire(d: Dict[str, Any]) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(d["embeds_b64"]),
+                         np.float32).reshape(d["shape"]).copy()

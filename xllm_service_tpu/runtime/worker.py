@@ -228,7 +228,15 @@ class Worker:
         router.route("POST", "/flip_role", self._serve_flip_role)
         router.route("POST", "/cancel", self._serve_cancel)
         router.route("POST", "/kv/import", self._serve_kv_import)
+        router.route("POST", "/encode", self._serve_encode)
+        router.route("POST", "/v1/embeddings", self._serve_embeddings)
         self._router = router
+        self._embed_fn = None
+        # EPD vision encoder (lazy; eager for dedicated ENCODE workers).
+        self._vision = None
+        self._vision_lock = threading.Lock()
+        if opts.instance_type == InstanceType.ENCODE:
+            self._get_vision()
         # KV-migration throughput book (BASELINE.md north-star metric).
         self.kv_migration_bytes = 0
         self.kv_migration_seconds = 0.0
@@ -410,6 +418,20 @@ class Worker:
             import dataclasses as _dc
             engine_sampling = _dc.replace(sampling, max_tokens=1,
                                           ignore_eos=False)
+        mm_embeds = mm_positions = None
+        mm_inputs = body.get("mm_inputs") or []
+        if mm_inputs:
+            from xllm_service_tpu.nlp.chat_template import IMAGE_PLACEHOLDER
+            from xllm_service_tpu.runtime.multimodal import (
+                expand_image_placeholders, image_token_id)
+            routing = body.get("routing") or {}
+            embeds = self._resolve_mm_embeds(
+                mm_inputs, routing.get("encode_name", ""))
+            n_img, tpi, _ = embeds.shape
+            token_ids, mm_positions = expand_image_placeholders(
+                list(token_ids), rt.tokenizer.encode(IMAGE_PLACEHOLDER),
+                n_img, tpi, image_token_id(rt.model_cfg.vocab_size))
+            mm_embeds = embeds.reshape(n_img * tpi, -1)
         ereq = EngineRequest(
             request_id=srid,
             token_ids=list(token_ids),
@@ -417,7 +439,9 @@ class Worker:
             offline=bool(body.get("offline", False)),
             priority=int(body.get("priority", 0)),
             eos_token_ids=rt.tokenizer.eos_token_ids,
-            hold_after_finish=pd_prefill)
+            hold_after_finish=pd_prefill,
+            mm_embeds=mm_embeds,
+            mm_positions=mm_positions)
         stream = bool(body.get("stream", False))
         include_usage = bool(
             (body.get("stream_options") or {}).get("include_usage", False))
@@ -608,6 +632,117 @@ class Worker:
                 rt.engine.cancel(srid)
             self._work_event.set()
         return Response.json({"ok": True})
+
+    # ------------------------------------------------------------------
+    # Embeddings (net-new vs the reference's "not support",
+    # http_service/service.cpp:492): masked-mean-pool of the final hidden
+    # states, served from the same weights as generation.
+    # ------------------------------------------------------------------
+    def _serve_embeddings(self, req: Request) -> Response:
+        import functools as _ft
+
+        import jax.numpy as _jnp
+
+        from xllm_service_tpu.models.transformer import forward_embedding
+        body = req.json()
+        inputs = body.get("input", [])
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not inputs:
+            return Response.error(400, "input is required")
+        model = body.get("model", self.opts.model)
+        rt = self.runtimes.get(model) or self.primary_runtime()
+        if rt.engine is None:
+            return Response.error(503, f"model {model} asleep")
+        if self._embed_fn is None:
+            self._embed_fn = jax.jit(_ft.partial(
+                forward_embedding, cfg=rt.model_cfg))
+        id_lists = [rt.tokenizer.encode(t)[:256] or [0] for t in inputs]
+        B = 1 << max(len(id_lists) - 1, 0).bit_length()
+        T = 1 << max(max(len(i) for i in id_lists) - 1, 0).bit_length()
+        toks = np.zeros((B, T), np.int32)
+        lens = np.zeros(B, np.int32)
+        for i, ids in enumerate(id_lists):
+            toks[i, :len(ids)] = ids
+            lens[i] = len(ids)
+        with self._engine_lock:
+            out = np.asarray(self._embed_fn(
+                rt.engine.params, tokens=_jnp.asarray(toks),
+                lengths=_jnp.asarray(lens)))
+        total = int(lens.sum())
+        return Response.json({
+            "object": "list",
+            "model": model,
+            "data": [{"object": "embedding", "index": i,
+                      "embedding": out[i].tolist()}
+                     for i in range(len(id_lists))],
+            "usage": {"prompt_tokens": total, "total_tokens": total},
+        })
+
+    # ------------------------------------------------------------------
+    # EPD multimodal encode stage (SURVEY.md §7.1 EPD row): the vision
+    # encoder is its own AOT XLA computation, served by dedicated ENCODE
+    # workers or run locally as fallback.
+    # ------------------------------------------------------------------
+    def _get_vision(self):
+        with self._vision_lock:
+            if self._vision is None:
+                import functools as _ft
+
+                import jax.numpy as _jnp
+
+                from xllm_service_tpu.models import vision as _vision
+                cfg = self.primary_runtime().model_cfg
+                vcfg = (_vision.VisionConfig.tiny(cfg.hidden_size)
+                        if cfg.name.startswith("tiny")
+                        else _vision.VisionConfig.for_model(cfg))
+                params = _vision.init_vision_params(
+                    vcfg, jax.random.PRNGKey(0))
+                fn = jax.jit(_ft.partial(_vision.encode_image, params,
+                                         vcfg))
+                self._vision = (vcfg, fn)
+            return self._vision
+
+    def encode_images(self, mm_inputs: List[Any]) -> np.ndarray:
+        """Run the vision encoder on this worker → [N, tokens_per_image,
+        hidden] float32."""
+        from xllm_service_tpu.runtime.multimodal import load_image
+        vcfg, fn = self._get_vision()
+        pixels = np.stack([load_image(m, vcfg.image_size)
+                           for m in mm_inputs])
+        return np.asarray(fn(pixels), np.float32)
+
+    def _serve_encode(self, req: Request) -> Response:
+        from xllm_service_tpu.runtime.multimodal import embeds_to_wire
+        body = req.json()
+        images = body.get("images") or body.get("mm_inputs") or []
+        if not images:
+            return Response.error(400, "no images")
+        try:
+            embeds = self.encode_images(images)
+        except ValueError as e:
+            return Response.error(400, str(e))
+        return Response.json(embeds_to_wire(embeds))
+
+    def _resolve_mm_embeds(self, mm_inputs: List[Any],
+                           encode_name: str) -> np.ndarray:
+        """EPD encode stage: remote ENCODE worker when routed, local
+        fallback otherwise (the reference's EPD routing reuses the PD
+        machinery with a third role — SURVEY.md §7.1)."""
+        from xllm_service_tpu.runtime.multimodal import embeds_from_wire
+        if encode_name and encode_name != self.name:
+            try:
+                status, resp = http_json(
+                    "POST", encode_name, "/encode",
+                    {"images": mm_inputs}, timeout=120.0)
+                if status == 200:
+                    return embeds_from_wire(resp)
+                logger.warning("encode worker %s returned %s; encoding "
+                               "locally", encode_name, status)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("encode worker %s unreachable (%s); "
+                               "encoding locally", encode_name, e)
+        return self.encode_images(mm_inputs)
 
     # ------------------------------------------------------------------
     # PD disaggregation (SURVEY.md §7.2 step 7): prefill here, decode on

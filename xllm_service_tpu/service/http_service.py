@@ -129,6 +129,8 @@ class HttpService:
         fwd["service_request_id"] = req.service_request_id
         fwd["token_ids"] = req.token_ids
         fwd["routing"] = routing.to_json()
+        if req.mm_inputs:
+            fwd["mm_inputs"] = req.mm_inputs
         path = "/v1/chat/completions" if is_chat else "/v1/completions"
         target = self.scheduler.instance_mgr.address_of(
             routing.prefill_name)
@@ -247,11 +249,27 @@ class HttpService:
             finish, usage))
 
     # ------------------------------------------------------------------
-    # Embeddings — the reference returns "not support" (service.cpp:492).
+    # Embeddings — implemented for real (the reference returns
+    # "not support", service.cpp:492): routed to a least-loaded worker.
     # ------------------------------------------------------------------
     def _embeddings(self, http_req: Request) -> Response:
-        return Response.error(
-            501, "embeddings are not supported yet", "not_implemented")
+        try:
+            body = http_req.json()
+        except (ValueError, json.JSONDecodeError):
+            return Response.error(400, "invalid JSON body")
+        if not body.get("input"):
+            return Response.error(400, "input is required")
+        name = self.scheduler.pick_serving_instance()
+        target = self.scheduler.instance_mgr.address_of(name) if name \
+            else None
+        if target is None:
+            return Response.error(503, "no instance available")
+        try:
+            status, resp = http_json("POST", target, "/v1/embeddings",
+                                     body, timeout=300.0)
+        except Exception as e:  # noqa: BLE001
+            return Response.error(503, f"worker error: {e}")
+        return Response.json(resp, status=status)
 
     # ------------------------------------------------------------------
     # Models / metrics — service-local (improves on the reference proxy)

@@ -311,6 +311,18 @@ class InstanceMgr:
             return [n for n, s in self._instances.items()
                     if s.instance_type == InstanceType.ENCODE]
 
+    def get_next_encode_instance(self) -> Optional[str]:
+        """RR over the EPD encode pool."""
+        with self._lock:
+            pool = [n for n, s in self._instances.items()
+                    if s.instance_type == InstanceType.ENCODE]
+            if not pool:
+                return None
+            self._rr_encode = getattr(self, "_rr_encode", 0)
+            name = pool[self._rr_encode % len(pool)]
+            self._rr_encode += 1
+            return name
+
     def address_of(self, name: str) -> Optional[str]:
         inst = self.get(name)
         return inst.meta.rpc_address if inst else None

@@ -177,6 +177,13 @@ class Scheduler:
             routing = Routing(prefill_name=prefill,
                               decode_name=decode or prefill)
 
+        # EPD: route the encode stage to a dedicated ENCODE instance when
+        # one exists (the prefill worker falls back to local encode).
+        if request.mm_inputs:
+            enc = self.instance_mgr.get_next_encode_instance()
+            if enc:
+                routing.encode_name = enc
+
         request.routing = routing
         self.instance_mgr.update_request_metrics(
             routing.prefill_name, RequestPhase.SCHEDULE,

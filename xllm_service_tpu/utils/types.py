@@ -173,20 +173,25 @@ OutputCallback = Callable[[RequestOutput], bool]
 @dataclasses.dataclass
 class Routing:
     """Instance routing decision attached to a forwarded request
-    (reference: chat.proto extension fields 24-28)."""
+    (reference: chat.proto extension fields 24-28). ``encode_name`` is the
+    EPD multimodal encode stage — a third role the reference claims but
+    keeps engine-side (SURVEY.md §7.1)."""
 
     prefill_name: str = ""
     decode_name: str = ""
+    encode_name: str = ""
 
     def to_json(self) -> Dict[str, Any]:
         return {"prefill_name": self.prefill_name,
-                "decode_name": self.decode_name}
+                "decode_name": self.decode_name,
+                "encode_name": self.encode_name}
 
     @classmethod
     def from_json(cls, d: Optional[Dict[str, Any]]) -> "Routing":
         if not d:
             return cls()
-        return cls(d.get("prefill_name", ""), d.get("decode_name", ""))
+        return cls(d.get("prefill_name", ""), d.get("decode_name", ""),
+                   d.get("encode_name", ""))
 
 
 @dataclasses.dataclass
