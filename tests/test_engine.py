@@ -408,3 +408,33 @@ class TestMultiStepDecode:
         # Pages were released on finish (no leak from discarded lookahead).
         assert eng2.allocator.num_free + eng2.prefix_cache.num_reclaimable \
             == ecfg.num_pages - 1
+
+    def test_multi_to_single_fallback_no_kv_hole(self):
+        """Regression: a multi-step burst leaves pages covering only its
+        own lookahead; the single-step fallback near max_model_len must
+        grow pages before dispatch or its KV write is silently dropped
+        (NULL-page mode="drop"), leaving a hole in the cache."""
+        mcfg = ModelConfig.tiny(vocab_size=64)
+        # decode_steps=6 with max_model_len=16: multi runs while
+        # len+5 <= 16; prompt 6 -> prefill len 7 -> one multi burst to
+        # len 13 (pages pre-grown for 12 tokens = 3 pages) -> single-step
+        # fallback writes position 12, which needs an unmapped 4th page.
+        ecfg = EngineConfig(page_size=4, num_pages=32, max_model_len=16,
+                            max_batch_size=2, max_prefill_tokens=16,
+                            prefill_buckets=(8,), decode_steps=6)
+        eng = Engine(mcfg, ecfg, seed=0)
+        eng.add_request(EngineRequest(
+            request_id="r", token_ids=list(range(1, 7)),
+            sampling=SamplingParams(max_tokens=12, temperature=0.0,
+                                    ignore_eos=True),
+            hold_after_finish=True))
+        while eng.has_work():
+            eng.step()
+        tokens, k, v = eng.export_held("r")
+        assert len(tokens) == 16
+        # KV is resident for tokens[:-1]; every such position must hold a
+        # real (nonzero) key vector — a zero row is the dropped write.
+        ps = ecfg.page_size
+        for pos in range(len(tokens) - 1):
+            row = np.asarray(k[:, pos // ps, pos % ps])   # [L, Hkv, Dh]
+            assert np.abs(row).max() > 0, f"KV hole at position {pos}"

@@ -481,6 +481,19 @@ class Engine:
 
     def _run_decode(self) -> List[StepOutput]:
         B = self.ecfg.max_batch_size
+        # Restore the pages-cover-len invariant at dispatch regardless of
+        # which decode path ran last: the fused multi-step accepts up to N
+        # tokens but pre-grows only its own lookahead window, so a sequence
+        # arriving here right after a multi-step burst can have its next
+        # write position on an unmapped page — the KV scatter would drop
+        # the write silently (NULL-page mode="drop"), leaving a permanent
+        # KV hole that later attention reads and the prefix cache could
+        # content-address. May preempt, so iterate over a snapshot.
+        for seq in list(self.running):
+            if seq.status == SeqStatus.RUNNING:
+                self._grow_pages(seq)
+        if not self.running:
+            return []
         active = np.zeros(B, bool)
         for seq in self.running:
             i = seq.slot
