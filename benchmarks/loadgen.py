@@ -58,10 +58,49 @@ def sample_prompt_lens(n: int, seed: int = 0,
     return out
 
 
+def load_sharegpt(path: str, num_requests: int, seed: int = 0,
+                  max_output_cap: int = 512) -> List[tuple]:
+    """Parse a ShareGPT-format dump (list of ``{"conversations":
+    [{"from": "human"|"gpt", "value": ...}, ...]}``) into
+    ``(prompt_text, output_len)`` replay pairs (BASELINE.md row 2).
+
+    The first human→gpt exchange of each conversation becomes one request:
+    the human turn is replayed verbatim as the prompt; the gpt reply's
+    length (chars/4 ≈ tokens) sets that request's ``max_tokens``, so the
+    replayed load reproduces the trace's real output-length mix."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    pairs: List[tuple] = []
+    for conv in data:
+        msgs = conv.get("conversations") or conv.get("messages") or []
+        for i in range(len(msgs) - 1):
+            role = msgs[i].get("from") or msgs[i].get("role", "")
+            nxt = msgs[i + 1].get("from") or msgs[i + 1].get("role", "")
+            if role in ("human", "user") and nxt in ("gpt", "assistant"):
+                prompt = (msgs[i].get("value")
+                          or msgs[i].get("content") or "").strip()
+                reply = (msgs[i + 1].get("value")
+                         or msgs[i + 1].get("content") or "")
+                if prompt and reply:
+                    pairs.append((prompt,
+                                  max(1, min(len(reply) // 4,
+                                             max_output_cap))))
+                break
+    if not pairs:
+        raise ValueError(f"no usable conversations in {path}")
+    rng = random.Random(seed)
+    rng.shuffle(pairs)
+    while len(pairs) < num_requests:
+        pairs.extend(pairs)
+    return pairs[:num_requests]
+
+
 def run_one(target: str, model: str, prompt_len: int, max_tokens: int,
-            offline: bool, timeout: float) -> RequestResult:
+            offline: bool, timeout: float,
+            prompt_text: Optional[str] = None) -> RequestResult:
     res = RequestResult(offline=offline)
-    prompt = " ".join("tok" for _ in range(max(prompt_len // 4, 1)))
+    prompt = prompt_text if prompt_text is not None else \
+        " ".join("tok" for _ in range(max(prompt_len // 4, 1)))
     body = {
         "model": model, "prompt": prompt, "max_tokens": max_tokens,
         "temperature": 0.0, "ignore_eos": True, "stream": True,
@@ -106,19 +145,29 @@ def run_load(target: str, model: str, num_requests: int,
              offline_fraction: float = 0.0, seed: int = 0,
              timeout: float = 600.0, mean_prompt_len: int = 64,
              target_ttft_ms: float = 1000.0,
-             target_tpot_ms: float = 50.0) -> dict:
-    lens = sample_prompt_lens(num_requests, seed, mean=mean_prompt_len)
+             target_tpot_ms: float = 50.0,
+             sharegpt_path: Optional[str] = None) -> dict:
+    if sharegpt_path:
+        # Trace replay: real prompts + real per-request output lengths.
+        plan = [(None, text, out_len) for text, out_len in
+                load_sharegpt(sharegpt_path, num_requests, seed)]
+    else:
+        plan = [(plen, None, max_tokens) for plen in
+                sample_prompt_lens(num_requests, seed,
+                                   mean=mean_prompt_len)]
     rng = random.Random(seed + 1)
     results: List[Optional[RequestResult]] = [None] * num_requests
     threads: List[threading.Thread] = []
     t_start = time.monotonic()
 
-    def fire(i: int, plen: int, off: bool) -> None:
-        results[i] = run_one(target, model, plen, max_tokens, off, timeout)
+    def fire(i: int, plen, text, mt: int, off: bool) -> None:
+        results[i] = run_one(target, model, plen or 0, mt, off, timeout,
+                             prompt_text=text)
 
-    for i, plen in enumerate(lens):
+    for i, (plen, text, mt) in enumerate(plan):
         off = rng.random() < offline_fraction
-        th = threading.Thread(target=fire, args=(i, plen, off), daemon=True)
+        th = threading.Thread(target=fire, args=(i, plen, text, mt, off),
+                              daemon=True)
         threads.append(th)
         th.start()
         if request_rate > 0:
@@ -170,6 +219,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--offline-fraction", type=float, default=0.0)
     ap.add_argument("--target-ttft-ms", type=float, default=1000.0)
     ap.add_argument("--target-tpot-ms", type=float, default=50.0)
+    ap.add_argument("--sharegpt", default="",
+                    help="path to a ShareGPT-format JSON dump to replay "
+                         "(real prompts + output-length mix)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -178,7 +230,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.max_tokens, args.offline_fraction, args.seed,
         mean_prompt_len=args.mean_prompt_len,
         target_ttft_ms=args.target_ttft_ms,
-        target_tpot_ms=args.target_tpot_ms)
+        target_tpot_ms=args.target_tpot_ms,
+        sharegpt_path=args.sharegpt or None)
     print(json.dumps(summary))
     return 0
 

@@ -258,3 +258,22 @@ class TestEmbeddings:
             for w in workers:
                 w.stop()
             master.stop()
+
+    def test_role_flip_revokes_old_lease(self, store):
+        """A /flip_role re-registration must revoke the previous lease —
+        each flip otherwise leaks a live lease in the store."""
+        master, workers = make_cluster(store)
+        try:
+            w = workers[0]
+            base = len(store._leases)
+            for role in ("PREFILL", "DECODE", "PREFILL", "DEFAULT"):
+                status, resp = http_json(
+                    "POST", w.name, "/flip_role",
+                    {"instance_type": role}, timeout=10.0)
+                assert status == 200, resp
+            assert len(store._leases) == base, (
+                f"leaked {len(store._leases) - base} leases across flips")
+        finally:
+            for wk in workers:
+                wk.stop()
+            master.stop()

@@ -34,3 +34,48 @@ def test_loadgen_against_cluster():
             w.stop()
         master.stop()
         store.close()
+
+
+def test_sharegpt_replay(tmp_path):
+    """ShareGPT-format trace replay (BASELINE.md row 2): real prompts and
+    per-request output lengths from the trace's gpt replies."""
+    import json as _json
+
+    from benchmarks.loadgen import load_sharegpt
+
+    trace = [
+        {"conversations": [
+            {"from": "human", "value": "what is a tpu?"},
+            {"from": "gpt", "value": "x" * 40},       # ~10 tokens
+            {"from": "human", "value": "more?"},
+            {"from": "gpt", "value": "y" * 400}]},
+        {"conversations": [
+            {"from": "system", "value": "be nice"},
+            {"from": "human", "value": "hello there friend"},
+            {"from": "gpt", "value": "z" * 8}]},
+        {"conversations": [
+            {"from": "gpt", "value": "orphan reply"}]},   # skipped
+    ]
+    p = tmp_path / "sharegpt.json"
+    p.write_text(_json.dumps(trace))
+    pairs = load_sharegpt(str(p), num_requests=5, seed=1)
+    assert len(pairs) == 5
+    prompts = {t for t, _ in pairs}
+    assert prompts == {"what is a tpu?", "hello there friend"}
+    by_prompt = dict(pairs)
+    assert by_prompt["what is a tpu?"] == 10      # first exchange only
+    assert by_prompt["hello there friend"] == 2
+
+    store = InMemoryStore(sweep_interval_s=0.02)
+    master, workers = make_cluster(store)
+    try:
+        summary = run_load(
+            master.http_address, "tiny", num_requests=4,
+            request_rate=0.0, max_tokens=4, timeout=120.0,
+            sharegpt_path=str(p))
+        assert summary["num_ok"] == 4, summary
+    finally:
+        for w in workers:
+            w.stop()
+        master.stop()
+        store.close()

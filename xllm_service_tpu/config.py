@@ -190,6 +190,10 @@ class EngineConfig:
     # detection runs on host afterwards; tokens sampled past a stop are
     # discarded (bounded waste of N-1 steps worst case).
     decode_steps: int = 1
+    # Top-k alternative logprobs computed inside every compiled step
+    # (static k — 0 disables the top_k entirely; OpenAI callers may ask
+    # for at most this many ``top_logprobs``).
+    num_top_logprobs: int = 0
     # Parallel degrees of this instance's mesh.
     tp: int = 1
     dp: int = 1

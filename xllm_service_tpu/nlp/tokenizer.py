@@ -269,27 +269,47 @@ def _eos_from_config(model_dir: str) -> Tuple[int, ...]:
 
 class IncrementalDecoder:
     """Streaming detokenizer for one sequence: feeds token ids, emits only
-    complete UTF-8 text (held-back bytes flushed once the char completes)."""
+    complete UTF-8 text (held-back bytes flushed once the char completes).
+
+    Decodes a bounded trailing window, not the whole accumulated id list:
+    ``_prefix`` marks the window start and advances on every successful
+    emit, so per-token cost stays O(window) instead of O(generated) — the
+    per-token host hot path must not be quadratic in generation length."""
+
+    _CONTEXT_TOKENS = 4
 
     def __init__(self, tokenizer: Tokenizer) -> None:
         self._tok = tokenizer
         self._ids: List[int] = []
-        self._emitted = 0            # chars of decode(all ids) already out
+        self._prefix = 0             # window start (context for BPE joins)
+        self._win_emitted = 0        # chars of decode(window) already out
 
     def feed(self, new_ids: Sequence[int]) -> str:
         self._ids.extend(new_ids)
-        text = self._tok.decode(self._ids)
+        full = self._tok.decode(self._ids[self._prefix:])
         # A trailing replacement char usually means a split multi-byte
-        # sequence: hold it back until more tokens arrive.
-        safe_len = len(text)
-        while safe_len > 0 and text[safe_len - 1] == "�":
-            safe_len -= 1
-        delta = text[self._emitted:safe_len]
-        self._emitted = safe_len
+        # sequence: hold it back until the char completes; anything before
+        # it is final and emitted now.
+        safe = len(full)
+        while safe > 0 and full[safe - 1] == "�":
+            safe -= 1
+        delta = full[self._win_emitted:safe] \
+            if safe > self._win_emitted else ""
+        self._win_emitted = max(self._win_emitted, safe)
+        if safe == len(full):
+            # Window fully emitted: slide it forward, keeping a few tokens
+            # of context (boundary-marker tokenizers like SentencePiece
+            # mis-decode a word-start token with no left context).
+            new_prefix = max(len(self._ids) - self._CONTEXT_TOKENS, 0)
+            if new_prefix > self._prefix:
+                self._prefix = new_prefix
+                self._win_emitted = len(
+                    self._tok.decode(self._ids[self._prefix:]))
         return delta
 
     def flush(self) -> str:
-        text = self._tok.decode(self._ids)
-        delta = text[self._emitted:]
-        self._emitted = len(text)
+        full = self._tok.decode(self._ids[self._prefix:])
+        delta = full[self._win_emitted:]
+        self._prefix = len(self._ids)
+        self._win_emitted = 0
         return delta

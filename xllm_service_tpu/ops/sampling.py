@@ -1,14 +1,26 @@
-"""Token sampling: greedy, temperature, top-k, top-p — batched and jit-safe.
+"""Token sampling: greedy, temperature, top-k, top-p, penalties — batched
+and jit-safe.
 
 Per-sequence sampling parameters arrive as dense arrays (one scalar per batch
 slot) so a single compiled program serves every request mix; there is no
 per-request recompilation. ``temperature == 0`` selects greedy via
 ``jnp.where``, not Python control flow.
+
+OpenAI contract coverage (reference proto carries these end to end,
+xllm/chat.proto:1-192 — the rebuild must not silently drop them):
+- per-request ``seed``: each row derives its own PRNG key inside the
+  compiled step — ``fold_in(PRNGKey(seed), position)`` — so a seeded
+  request's token stream is deterministic regardless of batch composition;
+- ``presence_penalty`` / ``frequency_penalty``: applied against a [B, V]
+  output-token count tensor that lives on device (engine carries it only
+  while some active slot uses penalties);
+- ``logprobs`` / ``top_logprobs``: chosen-token logprob always computed;
+  top-k alternatives computed in-step when the engine enables them.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -19,9 +31,14 @@ _NEG_INF = -1e30
 class SamplingTensors(NamedTuple):
     """Per-slot sampling state, shape [B] each."""
 
-    temperature: jnp.ndarray   # float32; 0.0 → greedy
-    top_p: jnp.ndarray         # float32 in (0, 1]
-    top_k: jnp.ndarray         # int32; 0 → disabled
+    temperature: jnp.ndarray            # float32; 0.0 → greedy
+    top_p: jnp.ndarray                  # float32 in (0, 1]
+    top_k: jnp.ndarray                  # int32; 0 → disabled
+    # Defaults (None) mean "feature off for the whole batch" — direct
+    # construction stays terse; ``for_batch`` always fills them in.
+    seed: Optional[jnp.ndarray] = None        # int32; -1 → unseeded
+    presence: Optional[jnp.ndarray] = None    # float32; 0.0 → off
+    frequency: Optional[jnp.ndarray] = None   # float32; 0.0 → off
 
     @classmethod
     def for_batch(cls, params_list) -> "SamplingTensors":
@@ -33,11 +50,36 @@ class SamplingTensors(NamedTuple):
                                        np.float32)),
             top_k=jnp.asarray(np.array([p.top_k for p in params_list],
                                        np.int32)),
+            seed=jnp.asarray(np.array(
+                [-1 if p.seed is None else int(p.seed)
+                 for p in params_list], np.int32)),
+            presence=jnp.asarray(np.array(
+                [p.presence_penalty for p in params_list], np.float32)),
+            frequency=jnp.asarray(np.array(
+                [p.frequency_penalty for p in params_list], np.float32)),
         )
-
 
 def greedy(logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def apply_penalties(logits: jnp.ndarray, counts: jnp.ndarray,
+                    tensors: SamplingTensors) -> jnp.ndarray:
+    """OpenAI presence/frequency penalties over output-token ``counts``
+    [B, V] (vLLM semantics: generated tokens only, prompt excluded)."""
+    logits = logits.astype(jnp.float32)
+    return logits \
+        - tensors.frequency[:, None] * counts.astype(jnp.float32) \
+        - tensors.presence[:, None] * (counts > 0).astype(jnp.float32)
+
+
+def update_counts(counts: jnp.ndarray, tokens: jnp.ndarray,
+                  active: jnp.ndarray) -> jnp.ndarray:
+    """Add this step's sampled ``tokens`` [B] to the output-token histogram
+    (inactive slots unchanged)."""
+    B = tokens.shape[0]
+    return counts.at[jnp.arange(B), tokens].add(
+        active.astype(counts.dtype))
 
 
 def _apply_top_k_top_p(logits: jnp.ndarray, top_k: jnp.ndarray,
@@ -67,14 +109,44 @@ def _apply_top_k_top_p(logits: jnp.ndarray, top_k: jnp.ndarray,
                      _NEG_INF)
 
 
+def _row_keys(tensors: SamplingTensors, key: jax.Array,
+              positions: jnp.ndarray) -> jnp.ndarray:
+    """Per-row PRNG keys [B, 2]: seeded rows use
+    ``fold_in(PRNGKey(seed), position)`` (deterministic across batch
+    compositions and restarts); unseeded rows split the shared step key."""
+    B = positions.shape[0]
+    seeded = jax.vmap(
+        lambda s, p: jax.random.fold_in(
+            jax.random.PRNGKey(jnp.maximum(s, 0)), p))(
+        tensors.seed, positions)
+    unseeded = jax.random.split(key, B)
+    return jnp.where((tensors.seed >= 0)[:, None], seeded, unseeded)
+
+
 def sample_tokens(logits: jnp.ndarray, tensors: SamplingTensors,
-                  key: jax.Array) -> jnp.ndarray:
-    """Sample one token per row of ``logits`` [B, V] → int32 [B]."""
+                  key: jax.Array, positions: Optional[jnp.ndarray] = None,
+                  counts: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Sample one token per row of ``logits`` [B, V] → int32 [B].
+
+    ``positions`` [B] (generation position per row) drives per-request
+    seeded determinism; None falls back to the shared key for every row.
+    ``counts`` [B, V] enables presence/frequency penalties.
+    """
+    logits = logits.astype(jnp.float32)
+    if counts is not None:
+        logits = apply_penalties(logits, counts, tensors)
     greedy_tok = greedy(logits)
     temp = jnp.maximum(tensors.temperature, 1e-6)[:, None]
-    scaled = logits.astype(jnp.float32) / temp
+    scaled = logits / temp
     scaled = _apply_top_k_top_p(scaled, tensors.top_k, tensors.top_p)
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    if positions is None or tensors.seed is None:
+        sampled = jax.random.categorical(key, scaled, axis=-1).astype(
+            jnp.int32)
+    else:
+        keys = _row_keys(tensors, key, positions)
+        sampled = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row))(
+            keys, scaled).astype(jnp.int32)
     return jnp.where(tensors.temperature <= 0.0, greedy_tok, sampled)
 
 
@@ -82,3 +154,12 @@ def compute_logprobs(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     """Log-prob of each chosen token: [B, V], [B] → [B] float32."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     return jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+
+
+def compute_top_logprobs(logits: jnp.ndarray, k: int
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-``k`` alternative logprobs of the model distribution:
+    [B, V] → (ids [B, k] int32, logprobs [B, k] float32)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    top_lps, top_ids = jax.lax.top_k(logp, k)
+    return top_ids.astype(jnp.int32), top_lps

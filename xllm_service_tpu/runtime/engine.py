@@ -41,7 +41,8 @@ import numpy as np
 from xllm_service_tpu.config import EngineConfig, ModelConfig
 from xllm_service_tpu.models import transformer
 from xllm_service_tpu.ops.sampling import (
-    SamplingTensors, compute_logprobs, sample_tokens)
+    SamplingTensors, compute_logprobs, compute_top_logprobs, sample_tokens,
+    update_counts)
 from xllm_service_tpu.runtime.kv_cache import (
     KvCacheEvent, PageAllocator, PrefixCacheIndex)
 from xllm_service_tpu.utils.types import FinishReason, SamplingParams
@@ -109,6 +110,10 @@ class StepOutput:
     finish_reason: FinishReason = FinishReason.NONE
     num_prompt_tokens: int = 0
     num_generated: int = 0
+    # Per new token: top-k alternatives [{"token_id", "logprob"}, ...]
+    # (present only when the engine computes them and the request asked
+    # for logprobs).
+    top_logprobs: Optional[List[List[Dict[str, Any]]]] = None
 
     @property
     def finished(self) -> bool:
@@ -163,16 +168,20 @@ class Engine:
         self._slot_sampling: List[SamplingParams] = [SamplingParams()] * B
         self._slot_st: Optional[SamplingTensors] = None
 
+        K = engine_cfg.num_top_logprobs
         self._jit_prefill = jax.jit(
-            functools.partial(_prefill_step, cfg=model_cfg),
+            functools.partial(_prefill_step, cfg=model_cfg, num_top=K),
             donate_argnums=(4,))
         self._jit_decode = jax.jit(
-            functools.partial(_decode_step, cfg=model_cfg),
-            donate_argnums=(4,))
+            functools.partial(_decode_step, cfg=model_cfg, num_top=K),
+            donate_argnums=(4, 8))
         self._jit_decode_multi = jax.jit(
             functools.partial(_decode_multi_step, cfg=model_cfg,
-                              n_steps=engine_cfg.decode_steps),
-            donate_argnums=(4,))
+                              n_steps=engine_cfg.decode_steps, num_top=K),
+            donate_argnums=(4, 8))
+        # Output-token histogram [B, V] for presence/frequency penalties;
+        # lives on device only while some running slot uses penalties.
+        self._counts: Optional[jnp.ndarray] = None
 
         self.step_count = 0
         self.num_preemptions = 0
@@ -451,12 +460,19 @@ class Engine:
                         mm_e[i, j] = seq.req.mm_embeds[j]
             mm_e = jnp.asarray(mm_e)
             mm_p = jnp.asarray(mm_p)
-        next_tok, logprob, self.kv = self._jit_prefill(
+        next_tok, logprob, top_ids, top_lps, self.kv = self._jit_prefill(
             self.params, jnp.asarray(toks), jnp.asarray(start),
             jnp.asarray(lens), self.kv, jnp.asarray(pt), st, key,
             mm_e, mm_p)
         next_tok = np.asarray(next_tok)
         logprob = np.asarray(logprob)
+        if top_ids is not None:
+            # One bulk device->host transfer, not one per sequence.
+            top_ids = np.asarray(top_ids)
+            top_lps = np.asarray(top_lps)
+        # Batch membership changed: the penalty histogram (if any) must be
+        # rebuilt from host truth before the next penalized decode.
+        self._counts = None
 
         now = time.monotonic()
         outs: List[StepOutput] = []
@@ -466,7 +482,9 @@ class Engine:
             seq.first_token_time = now
             self.running.append(seq)
             tok = int(next_tok[i])
-            outs.append(self._append_token(seq, tok, float(logprob[i])))
+            outs.append(self._append_token(
+                seq, tok, float(logprob[i]),
+                top=self._top_entry(seq, top_ids, top_lps, i)))
             self._sync_slot(seq)
         return outs
 
@@ -505,13 +523,18 @@ class Engine:
         st = self._slot_st
         self._rng_key, key = jax.random.split(self._rng_key)
         mp = self._table_width()
-        next_tok, logprob, self.kv = self._jit_decode(
-            self.params, jnp.asarray(self._slot_last_token),
-            jnp.asarray(self._slot_pos), jnp.asarray(active), self.kv,
-            jnp.asarray(np.ascontiguousarray(self._slot_pt[:, :mp])),
-            st, key)
+        next_tok, logprob, top_ids, top_lps, self.kv, self._counts = \
+            self._jit_decode(
+                self.params, jnp.asarray(self._slot_last_token),
+                jnp.asarray(self._slot_pos), jnp.asarray(active), self.kv,
+                jnp.asarray(np.ascontiguousarray(self._slot_pt[:, :mp])),
+                st, key, self._ensure_counts())
         next_tok = np.asarray(next_tok)
         logprob = np.asarray(logprob)
+        if top_ids is not None:
+            # One bulk device->host transfer, not one per sequence.
+            top_ids = np.asarray(top_ids)
+            top_lps = np.asarray(top_lps)
         outs: List[StepOutput] = []
         # Snapshot (seq, slot) first: _append_token may preempt a *later*
         # sequence in this list (page-growth pressure), clearing its slot
@@ -522,7 +545,8 @@ class Engine:
             # A sequence preempted earlier in this loop still gets its token
             # (sampled while its KV was resident); it re-prefills later.
             outs.append(self._append_token(
-                seq, int(next_tok[i]), float(logprob[i])))
+                seq, int(next_tok[i]), float(logprob[i]),
+                top=self._top_entry(seq, top_ids, top_lps, i)))
         return outs
 
     def _run_decode_multi(self) -> List[StepOutput]:
@@ -553,24 +577,34 @@ class Engine:
         self._rng_key, key = jax.random.split(self._rng_key)
         # Width must cover the lookahead pages pre-grown above.
         mp = self._table_width()
-        toks, logps, self.kv = self._jit_decode_multi(
-            self.params, jnp.asarray(self._slot_last_token),
-            jnp.asarray(self._slot_pos), jnp.asarray(active), self.kv,
-            jnp.asarray(np.ascontiguousarray(self._slot_pt[:, :mp])),
-            st, key)
+        toks, logps, top_ids, top_lps, self.kv, self._counts = \
+            self._jit_decode_multi(
+                self.params, jnp.asarray(self._slot_last_token),
+                jnp.asarray(self._slot_pos), jnp.asarray(active), self.kv,
+                jnp.asarray(np.ascontiguousarray(self._slot_pt[:, :mp])),
+                st, key, self._ensure_counts())
         toks = np.asarray(toks)          # [N, B]
         logps = np.asarray(logps)        # [N, B]
+        if top_ids is not None:
+            top_ids = np.asarray(top_ids)    # [N, B, K]
+            top_lps = np.asarray(top_lps)
 
         outs: List[StepOutput] = []
         for seq, slot in [(s, s.slot) for s in self.running]:
             accepted: List[int] = []
             lps: List[float] = []
+            tops: Optional[List[List[Dict[str, Any]]]] = \
+                [] if (top_ids is not None
+                       and seq.req.sampling.logprobs) else None
             reason = FinishReason.NONE
             for k_step in range(N):
                 tok = int(toks[k_step, slot])
                 seq.tokens.append(tok)
                 accepted.append(tok)
                 lps.append(float(logps[k_step, slot]))
+                if tops is not None:
+                    tops.append(_top_row(top_ids[k_step], top_lps[k_step],
+                                         slot))
                 reason = self._finish_reason(seq, tok)
                 if reason != FinishReason.NONE:
                     break
@@ -581,7 +615,7 @@ class Engine:
                 request_id=seq.req.request_id, new_token_ids=accepted,
                 logprobs=lps, finish_reason=reason,
                 num_prompt_tokens=seq.num_prompt_tokens,
-                num_generated=seq.num_generated)
+                num_generated=seq.num_generated, top_logprobs=tops)
             outs.append(out)
             if reason != FinishReason.NONE:
                 self._finish_seq(seq, reason)
@@ -591,15 +625,43 @@ class Engine:
                     seq.tokens[:seq.num_computed], seq.pages)
         return outs
 
-    def _append_token(self, seq: Sequence, tok: int,
-                      logprob: float) -> StepOutput:
+    def _top_entry(self, seq: Sequence, top_ids, top_lps,
+                   row: int) -> Optional[List[List[Dict[str, Any]]]]:
+        """Top-k alternatives for one sampled token (None unless computed
+        and the request asked for logprobs)."""
+        if top_ids is None or not seq.req.sampling.logprobs:
+            return None
+        return [_top_row(top_ids, top_lps, row)]
+
+    def _ensure_counts(self) -> Optional[jnp.ndarray]:
+        """Device-resident output-token histogram for penalty sampling —
+        present exactly while some running slot uses penalties, rebuilt
+        from host token lists whenever batch membership changed."""
+        if not any(s.req.sampling.presence_penalty
+                   or s.req.sampling.frequency_penalty
+                   for s in self.running):
+            self._counts = None
+            return None
+        if self._counts is None:
+            B, V = self.ecfg.max_batch_size, self.cfg.vocab_size
+            c = np.zeros((B, V), np.int32)
+            for seq in self.running:
+                gen = seq.tokens[seq.num_prompt_tokens:]
+                if seq.slot >= 0 and gen:
+                    np.add.at(c[seq.slot], gen, 1)
+            self._counts = jnp.asarray(c)
+        return self._counts
+
+    def _append_token(self, seq: Sequence, tok: int, logprob: float,
+                      top: Optional[List[List[Dict[str, Any]]]] = None
+                      ) -> StepOutput:
         seq.tokens.append(tok)
         reason = self._finish_reason(seq, tok)
         out = StepOutput(
             request_id=seq.req.request_id, new_token_ids=[tok],
             logprobs=[logprob], finish_reason=reason,
             num_prompt_tokens=seq.num_prompt_tokens,
-            num_generated=seq.num_generated)
+            num_generated=seq.num_generated, top_logprobs=top)
         if reason != FinishReason.NONE:
             self._finish_seq(seq, reason)
         elif seq.status == SeqStatus.RUNNING:
@@ -719,22 +781,74 @@ class Engine:
     # ------------------------------------------------------------------
     # Warmup / metrics
     # ------------------------------------------------------------------
-    def warmup(self, buckets: Optional[Sequence[int]] = None) -> float:
-        """Pre-compile the decode program and each prefill bucket at B=1.
-        Returns seconds spent."""
+    def warmup(self, buckets: Optional[Sequence[int]] = None,
+               extended: bool = True) -> float:
+        """Pre-compile every steady-state program of this engine, so a
+        client request almost never pays a compile (round-1 weakness:
+        B=1-only warmup left pow2 batch buckets, table-width variants and
+        the fused multi-step program compiling mid-serving). Not covered:
+        rare shapes whose page-table width comes from a readmitted
+        sequence's long history (MP above the bucket's own need) — those
+        still compile lazily on first hit.
+
+        Shapes are driven directly through the jitted steps with inert
+        inputs (all-NULL page tables, inactive slots) — no allocator or
+        slot state is touched. Returns seconds spent."""
         t0 = time.monotonic()
-        for T in (buckets or self.ecfg.prefill_buckets):
-            # A prompt needs room for two generated tokens (so the decode
-            # program compiles too) within max_model_len.
-            n = min(T, self.ecfg.max_model_len - 2)
-            if n <= 0:
-                continue
-            req = EngineRequest(
-                request_id=f"__warmup_{T}", token_ids=[1] * n,
-                sampling=SamplingParams(max_tokens=2), eos_token_ids=())
-            self.add_request(req)
-            while self.has_work():
-                self.step()
+        buckets = tuple(buckets or self.ecfg.prefill_buckets)
+        Bmax = self.ecfg.max_batch_size
+        budget = self.ecfg.max_prefill_tokens
+        key = jax.random.PRNGKey(0)
+
+        batch_pows = []
+        b = 1
+        while b <= Bmax:
+            batch_pows.append(b)
+            b <<= 1
+
+        # Prefill: every (pow2 batch, bucket) combo the scheduler can form
+        # within the prefill token budget ((B-1) single-token readmits plus
+        # one bucket-sized prompt is the minimal occupancy of that shape).
+        for B in batch_pows:
+            for T in buckets:
+                if (B - 1) + T > max(budget, T):
+                    continue
+                mp = 1 << max(self._pages_needed(T) - 1, 0).bit_length()
+                st = self._sampling_tensors([], B)
+                _, _, _, _, self.kv = self._jit_prefill(
+                    self.params, jnp.zeros((B, T), jnp.int32),
+                    jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+                    self.kv, jnp.zeros((B, mp), jnp.int32), st, key,
+                    None, None)
+                if not extended:
+                    break
+            if not extended:
+                break
+
+        # Decode (single + fused multi): every pow2 table width. Inactive
+        # slots + NULL pages make the KV writes no-ops.
+        st = self._sampling_tensors([], Bmax)
+        widths = []
+        w = 1
+        while w <= self.ecfg.max_pages_per_seq:
+            widths.append(w)
+            w <<= 1
+        if widths[-1] != self.ecfg.max_pages_per_seq:
+            # _table_width clamps to max_pages_per_seq, which need not be
+            # a power of two — that clamped width is reachable too.
+            widths.append(self.ecfg.max_pages_per_seq)
+        if not extended:
+            widths = widths[:1]
+        for mp in widths:
+            args = (self.params, jnp.zeros(Bmax, jnp.int32),
+                    jnp.zeros(Bmax, jnp.int32), jnp.zeros(Bmax, bool),
+                    self.kv, jnp.zeros((Bmax, mp), jnp.int32), st, key,
+                    None)
+            *_, self.kv, _ = self._jit_decode(*args)
+            if self.ecfg.decode_steps > 1:
+                args = args[:4] + (self.kv,) + args[5:]
+                *_, self.kv, _ = self._jit_decode_multi(*args)
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.kv)[0])
         return time.monotonic() - t0
 
     def load_metrics(self) -> Dict[str, Any]:
@@ -765,42 +879,67 @@ def _kv_scatter(k_pages, v_pages, idx, k_new, v_new):
     return k_pages.at[:, idx].set(k_new), v_pages.at[:, idx].set(v_new)
 
 
+def _top_row(top_ids, top_lps, row: int) -> List[Dict[str, Any]]:
+    """One row of device top-k output → [{"token_id", "logprob"}, ...]."""
+    ids = np.asarray(top_ids[row])
+    lps = np.asarray(top_lps[row])
+    return [{"token_id": int(i), "logprob": float(l)}
+            for i, l in zip(ids, lps)]
+
+
 def _prefill_step(params, tokens, start_pos, lengths, kv, page_table,
                   st: SamplingTensors, key, mm_embeds=None,
-                  mm_positions=None, *, cfg: ModelConfig):
+                  mm_positions=None, *, cfg: ModelConfig, num_top: int = 0):
     last_logits, _, kv = transformer.forward_prefill(
         params, cfg, tokens, start_pos, lengths, kv, page_table,
         mm_embeds=mm_embeds, mm_positions=mm_positions)
-    tok = sample_tokens(last_logits, st, key)
+    positions = start_pos + jnp.maximum(lengths - 1, 0)
+    tok = sample_tokens(last_logits, st, key, positions=positions)
     lp = compute_logprobs(last_logits, tok)
-    return tok, lp, kv
+    top_ids = top_lps = None
+    if num_top > 0:
+        top_ids, top_lps = compute_top_logprobs(last_logits, num_top)
+    return tok, lp, top_ids, top_lps, kv
 
 
 def _decode_step(params, tokens, positions, active, kv, page_table,
-                 st: SamplingTensors, key, *, cfg: ModelConfig):
+                 st: SamplingTensors, key, counts=None, *,
+                 cfg: ModelConfig, num_top: int = 0):
     logits, kv = transformer.forward_decode(
         params, cfg, tokens, positions, active, kv, page_table)
-    tok = sample_tokens(logits, st, key)
+    tok = sample_tokens(logits, st, key, positions=positions, counts=counts)
     lp = compute_logprobs(logits, tok)
-    return tok, lp, kv
+    top_ids = top_lps = None
+    if num_top > 0:
+        top_ids, top_lps = compute_top_logprobs(logits, num_top)
+    if counts is not None:
+        counts = update_counts(counts, tok, active)
+    return tok, lp, top_ids, top_lps, kv, counts
 
 
 def _decode_multi_step(params, tokens, positions, active, kv, page_table,
-                       st: SamplingTensors, key, *, cfg: ModelConfig,
-                       n_steps: int):
+                       st: SamplingTensors, key, counts=None, *,
+                       cfg: ModelConfig, n_steps: int, num_top: int = 0):
     """``n_steps`` fused greedy/sampled decode iterations: the scan body is
     traced once, tokens feed forward on-device, and only the [N, B] token/
     logprob blocks cross back to the host — one dispatch per N tokens."""
 
     def body(carry, key_i):
-        tok, pos, kv = carry
+        tok, pos, kv, cnt = carry
         logits, kv = transformer.forward_decode(
             params, cfg, tok, pos, active, kv, page_table)
-        new_tok = sample_tokens(logits, st, key_i)
+        new_tok = sample_tokens(logits, st, key_i, positions=pos,
+                                counts=cnt)
         lp = compute_logprobs(logits, new_tok)
-        return (new_tok, pos + 1, kv), (new_tok, lp)
+        if num_top > 0:
+            top_ids, top_lps = compute_top_logprobs(logits, num_top)
+        else:
+            top_ids = top_lps = None
+        if cnt is not None:
+            cnt = update_counts(cnt, new_tok, active)
+        return (new_tok, pos + 1, kv, cnt), (new_tok, lp, top_ids, top_lps)
 
     keys = jax.random.split(key, n_steps)
-    (_, _, kv), (toks, lps) = jax.lax.scan(
-        body, (tokens, positions, kv), keys)
-    return toks, lps, kv
+    (_, _, kv, counts), (toks, lps, top_ids, top_lps) = jax.lax.scan(
+        body, (tokens, positions, kv, counts), keys)
+    return toks, lps, top_ids, top_lps, kv, counts

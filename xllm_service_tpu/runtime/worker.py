@@ -47,12 +47,12 @@ from xllm_service_tpu.service.httpd import (
 from xllm_service_tpu.service.instance_types import (
     Heartbeat, InstanceMetaInfo, LatencyMetrics, LoadMetrics)
 from xllm_service_tpu.service.response_handler import (
-    ChatStreamAssembler, CompletionStreamAssembler, full_chat_response,
-    full_completion_response, sse_frame, SSE_DONE)
+    ChatStreamAssembler, CompletionStreamAssembler, ResponseCollector,
+    sse_frame, SSE_DONE)
 from xllm_service_tpu.utils.misc import short_uuid
 from xllm_service_tpu.utils.types import (
-    FinishReason, RequestOutput, SamplingParams, SequenceOutput, Status,
-    StatusCode, Usage)
+    FinishReason, LogProb, RequestOutput, SamplingParams, SequenceOutput,
+    Status, StatusCode, Usage, parse_openai_sampling)
 
 logger = logging.getLogger(__name__)
 
@@ -70,6 +70,8 @@ class WorkerOptions:
     model_dir: str = ""                 # HF dir (tokenizer + config.json)
     heartbeat_interval_s: float = 3.0
     lease_ttl_s: float = 9.0
+    # End-to-end bound on one generation (PD relay reads, import waits).
+    request_timeout_s: float = 600.0
     enable_profiling: bool = False
     memory_budget_gb: float = 60.0
     seed: int = 0
@@ -158,20 +160,83 @@ class ModelRuntime:
         return 2.0 * n_params / 1e9
 
 
+class _StopWatcher:
+    """Detokenizer-level OpenAI ``stop`` string matching with holdback.
+
+    Streams may not emit text that could be the prefix of a stop string;
+    ``feed`` returns only safe-to-emit text and flags ``stopped`` when a
+    stop sequence appears (the stop text itself is never emitted)."""
+
+    __slots__ = ("stops", "pending", "stopped")
+
+    def __init__(self, stops: Optional[List[str]]) -> None:
+        self.stops = [s for s in (stops or []) if s]
+        self.pending = ""
+        self.stopped = False
+
+    def feed(self, text: str) -> str:
+        if not self.stops or self.stopped:
+            return text
+        self.pending += text
+        idx = -1
+        for s in self.stops:
+            i = self.pending.find(s)
+            if i >= 0 and (idx < 0 or i < idx):
+                idx = i
+        if idx >= 0:
+            self.stopped = True
+            out, self.pending = self.pending[:idx], ""
+            return out
+        hold = 0
+        for s in self.stops:
+            m = min(len(s) - 1, len(self.pending))
+            for h in range(m, 0, -1):
+                if s.startswith(self.pending[len(self.pending) - h:]):
+                    hold = max(hold, h)
+                    break
+        if hold:
+            out = self.pending[:-hold]
+            self.pending = self.pending[-hold:]
+        else:
+            out, self.pending = self.pending, ""
+        return out
+
+    def flush(self) -> str:
+        out, self.pending = self.pending, ""
+        return out
+
+
+class _Choice:
+    """Per-choice (OpenAI ``n``) streaming state."""
+
+    __slots__ = ("decoder", "stopper", "completion_tokens", "finished")
+
+    def __init__(self, decoder: IncrementalDecoder,
+                 stops: Optional[List[str]]) -> None:
+        self.decoder = decoder
+        self.stopper = _StopWatcher(stops)
+        self.completion_tokens = 0
+        self.finished = False
+
+
 class _LiveRequest:
-    """Host-side streaming state of one in-flight request."""
+    """Host-side streaming state of one in-flight request (all ``n``
+    choices; engine request ids are ``<srid>`` for n=1, ``<srid>#k``
+    otherwise)."""
 
-    __slots__ = ("req", "q", "decoder", "stream_to_service",
-                 "service_request_id", "model", "is_chat", "stream",
-                 "include_usage", "first_out_time", "sampling")
+    __slots__ = ("req", "q", "tokenizer", "choices", "engine_rids",
+                 "stream_to_service", "service_request_id", "model",
+                 "is_chat", "stream", "include_usage", "first_out_time",
+                 "sampling", "prompt_tokens")
 
-    def __init__(self, req: EngineRequest, decoder: IncrementalDecoder,
+    def __init__(self, req: EngineRequest, tokenizer: Tokenizer,
                  service_request_id: str, model: str, is_chat: bool,
                  stream: bool, include_usage: bool,
-                 stream_to_service: bool) -> None:
+                 stream_to_service: bool, n: int = 1,
+                 stops: Optional[List[str]] = None) -> None:
         self.req = req
         self.q: "queue.Queue[Optional[StepOutput]]" = queue.Queue()
-        self.decoder = decoder
+        self.tokenizer = tokenizer
         self.service_request_id = service_request_id
         self.model = model
         self.is_chat = is_chat
@@ -179,6 +244,29 @@ class _LiveRequest:
         self.include_usage = include_usage
         self.stream_to_service = stream_to_service
         self.first_out_time = 0.0
+        n = max(1, n)
+        self.engine_rids = ([service_request_id] if n == 1 else
+                            [f"{service_request_id}#{k}" for k in range(n)])
+        self.choices = [_Choice(IncrementalDecoder(tokenizer), stops)
+                        for _ in range(n)]
+        self.prompt_tokens = 0
+
+    def choice_index(self, engine_rid: str) -> int:
+        if len(self.choices) == 1:
+            return 0
+        try:
+            return int(engine_rid.rsplit("#", 1)[1])
+        except (IndexError, ValueError):
+            return 0
+
+    @property
+    def decoder(self) -> IncrementalDecoder:
+        # Single-choice shorthand used by the PD migration paths.
+        return self.choices[0].decoder
+
+    @property
+    def all_finished(self) -> bool:
+        return all(c.finished for c in self.choices)
 
 
 class Worker:
@@ -198,7 +286,8 @@ class Worker:
             opts.model, primary_cfg, self.engine_cfg, self.tokenizer,
             mesh=mesh, seed=opts.seed, murmur_seed=opts.murmur_seed)
 
-        self._live: Dict[str, _LiveRequest] = {}
+        self._live: Dict[str, _LiveRequest] = {}        # engine rid → live
+        self._live_srid: Dict[str, _LiveRequest] = {}   # srid → live
         self._live_lock = threading.Lock()
         # Outputs queued for the service fan-in ahead of the next engine
         # dispatch (ordering: appended under the engine lock, drained by
@@ -231,7 +320,10 @@ class Worker:
         router.route("POST", "/encode", self._serve_encode)
         router.route("POST", "/v1/embeddings", self._serve_embeddings)
         self._router = router
-        self._embed_fn = None
+        # Jitted embedding fns keyed by model name — a multi-model worker
+        # must never run model B's params through model A's closed-over
+        # ModelConfig (rope theta / eps / head counts differ).
+        self._embed_fns: Dict[str, Any] = {}
         # EPD vision encoder (lazy; eager for dedicated ENCODE workers).
         self._vision = None
         self._vision_lock = threading.Lock()
@@ -300,6 +392,14 @@ class Worker:
                 self.primary_runtime().model_cfg.num_layers)),
             addrs=[self.name],
         )
+        if self._lease_id is not None:
+            # Re-registration (role flip): the old lease must die with the
+            # old key or every flip leaks a live lease in the store.
+            try:
+                self.store.lease_revoke(self._lease_id)
+            except Exception:  # noqa: BLE001
+                pass
+            self._lease_id = None
         self._lease_id = self.store.lease_grant(self.opts.lease_ttl_s)
         self.store.put_json(
             instance_prefix(self.instance_type.value) + self.name,
@@ -347,8 +447,11 @@ class Worker:
                 self._latency.recent_max_tbt_ms = max(
                     self._latency.recent_max_tbt_ms, step_ms)
             if live.stream_to_service:
-                to_service.append(self._to_request_output(live, out))
-                if out.finished:
+                ro = self._to_request_output(live, out)
+                if ro is not None:
+                    to_service.append(ro)
+                if out.finished or live.choices[
+                        live.choice_index(out.request_id)].finished:
                     self._drop_live(out.request_id)
             else:
                 live.q.put(out)
@@ -365,25 +468,76 @@ class Worker:
 
     def _drop_live(self, request_id: str) -> None:
         with self._live_lock:
-            self._live.pop(request_id, None)
+            live = self._live.pop(request_id, None)
+            if live is not None and live.all_finished:
+                self._live_srid.pop(live.service_request_id, None)
 
     def _to_request_output(self, live: _LiveRequest,
-                           out: StepOutput) -> RequestOutput:
-        text = live.decoder.feed(out.new_token_ids)
-        if out.finished:
-            text += live.decoder.flush()
+                           out: StepOutput) -> Optional[RequestOutput]:
+        """Convert one engine StepOutput into the wire RequestOutput.
+
+        Handles the per-choice streaming state: incremental detokenize,
+        OpenAI stop-string matching (with holdback; the engine request is
+        cancelled once a stop fires), chosen-token + top-k logprobs, and
+        all-choices-finished aggregation for n>1. Returns None when the
+        output is for a choice that already stopped (nothing to emit)."""
+        idx = live.choice_index(out.request_id)
+        ch = live.choices[idx]
+        if ch.finished:
+            return None
+        finish = out.finish_reason
+        text = ch.decoder.feed(out.new_token_ids)
+        if finish != FinishReason.NONE:
+            text += ch.decoder.flush()
+        if ch.stopper.stops:
+            text = ch.stopper.feed(text)
+            if ch.stopper.stopped:
+                finish = FinishReason.STOP
+                self._cancel_engine_request(live, out.request_id)
+            elif finish != FinishReason.NONE:
+                text += ch.stopper.flush()
+        ch.completion_tokens += len(out.new_token_ids)
+        logprobs = []
+        if live.sampling.logprobs:
+            for j, tid in enumerate(out.new_token_ids):
+                top = []
+                if out.top_logprobs and live.sampling.top_logprobs > 0:
+                    top = [{"token": live.tokenizer.decode([e["token_id"]]),
+                            "token_id": e["token_id"],
+                            "logprob": e["logprob"]}
+                           for e in out.top_logprobs[j]
+                           [:live.sampling.top_logprobs]]
+                logprobs.append(LogProb(
+                    token=live.tokenizer.decode([tid]), token_id=tid,
+                    logprob=out.logprobs[j] if j < len(out.logprobs)
+                    else 0.0,
+                    top_logprobs=top))
+        if finish != FinishReason.NONE:
+            ch.finished = True
         seq = SequenceOutput(
-            index=0, text=text, token_ids=list(out.new_token_ids),
-            finish_reason=out.finish_reason)
+            index=idx, text=text, token_ids=list(out.new_token_ids),
+            finish_reason=finish, logprobs=logprobs)
+        all_done = live.all_finished
         usage = None
-        if out.finished:
-            usage = Usage(prompt_tokens=out.num_prompt_tokens,
-                          completion_tokens=out.num_generated)
+        if all_done:
+            usage = Usage(
+                prompt_tokens=live.prompt_tokens or out.num_prompt_tokens,
+                completion_tokens=sum(c.completion_tokens
+                                      for c in live.choices))
         return RequestOutput(
             request_id=live.req.request_id,
             service_request_id=live.service_request_id,
-            outputs=[seq], usage=usage, finished=out.finished,
-            cancelled=out.finish_reason == FinishReason.CANCELLED)
+            outputs=[seq], usage=usage, finished=all_done,
+            cancelled=finish == FinishReason.CANCELLED)
+
+    def _cancel_engine_request(self, live: _LiveRequest,
+                               engine_rid: str) -> None:
+        """Stop-string hit: the engine must stop generating this choice."""
+        rt = self.runtimes.get(live.model) or self.primary_runtime()
+        if rt.engine is not None:
+            with self._engine_lock:
+                rt.engine.cancel(engine_rid)
+            self._work_event.set()
 
     # ------------------------------------------------------------------
     # Serving
@@ -405,14 +559,14 @@ class Worker:
             else:
                 prompt = body.get("prompt", "")
             token_ids = rt.tokenizer.encode(prompt)
-        sampling = SamplingParams(
-            max_tokens=body.get("max_tokens", 16),
-            temperature=body.get("temperature", 1.0),
-            top_p=body.get("top_p", 1.0),
-            top_k=body.get("top_k", 0),
-            seed=body.get("seed"),
-            stop_token_ids=body.get("stop_token_ids", []),
-            ignore_eos=body.get("ignore_eos", False))
+        if body.get("sampling"):
+            # Service-parsed SamplingParams travel in the rewritten body
+            # (like token_ids/routing) — the single source of truth, so
+            # fields the service normalized (max_completion_tokens, stop
+            # strings, penalties) are never re-derived or lost here.
+            sampling = SamplingParams.from_json(body["sampling"])
+        else:
+            sampling = parse_openai_sampling(body, is_chat)
         engine_sampling = sampling
         if pd_prefill:
             import dataclasses as _dc
@@ -432,6 +586,10 @@ class Worker:
                 list(token_ids), rt.tokenizer.encode(IMAGE_PLACEHOLDER),
                 n_img, tpi, image_token_id(rt.model_cfg.vocab_size))
             mm_embeds = embeds.reshape(n_img * tpi, -1)
+        n = 1 if pd_prefill else max(1, engine_sampling.n)
+        stream = bool(body.get("stream", False))
+        include_usage = bool(
+            (body.get("stream_options") or {}).get("include_usage", False))
         ereq = EngineRequest(
             request_id=srid,
             token_ids=list(token_ids),
@@ -442,19 +600,33 @@ class Worker:
             hold_after_finish=pd_prefill,
             mm_embeds=mm_embeds,
             mm_positions=mm_positions)
-        stream = bool(body.get("stream", False))
-        include_usage = bool(
-            (body.get("stream_options") or {}).get("include_usage", False))
         live = _LiveRequest(
-            ereq, IncrementalDecoder(rt.tokenizer), srid, model, is_chat,
+            ereq, rt.tokenizer, srid, model, is_chat,
             stream, include_usage,
             stream_to_service=(not pd_prefill) and self._decode_to_service
-            and bool(self.opts.service_addr))
+            and bool(self.opts.service_addr),
+            n=n, stops=sampling.stop)
         live.sampling = sampling          # original (pre-pd) params
+        live.prompt_tokens = len(token_ids)
         with self._live_lock:
-            self._live[srid] = live
+            self._live_srid[srid] = live
+            for erid in live.engine_rids:
+                self._live[erid] = live
         with self._engine_lock:
-            rt.engine.add_request(ereq)
+            for k, erid in enumerate(live.engine_rids):
+                esp = engine_sampling
+                if n > 1:
+                    # Distinct choices: seeded requests offset the seed per
+                    # choice (identical streams otherwise), engine ids get
+                    # a #k suffix.
+                    esp = dataclasses.replace(
+                        engine_sampling,
+                        seed=(engine_sampling.seed + k
+                              if engine_sampling.seed is not None else None))
+                creq = ereq if n == 1 else dataclasses.replace(
+                    ereq, request_id=erid, sampling=esp,
+                    token_ids=list(token_ids))
+                rt.engine.add_request(creq)
         self._work_event.set()
         return live
 
@@ -464,10 +636,14 @@ class Worker:
         except Exception:  # noqa: BLE001
             return Response.error(400, "invalid JSON body")
         routing = body.get("routing") or {}
+        sp_body = body.get("sampling") or {}
+        max_toks = int(sp_body.get("max_tokens",
+                                   body.get("max_tokens", 16)))
+        n_choices = int(sp_body.get("n", body.get("n", 1)))
         if (routing.get("prefill_name") == self.name
                 and routing.get("decode_name")
                 and routing["decode_name"] != self.name
-                and int(body.get("max_tokens", 16)) > 1):
+                and max_toks > 1 and n_choices == 1):
             return self._serve_pd_prefill(body, is_chat,
                                           routing["decode_name"])
         try:
@@ -499,35 +675,31 @@ class Worker:
                 yield SSE_DONE
                 return
             ro = self._to_request_output(live, out)
+            if ro is None:
+                continue
             for frame in asm.on_output(ro):
                 yield frame
-            if out.finished:
+            if ro.finished:
                 return
 
     def _collect_full(self, live: _LiveRequest,
                       initial: Optional[List[RequestOutput]] = None
                       ) -> Response:
-        text_parts: List[str] = [s.text for ro in (initial or [])
-                                 for s in ro.outputs]
-        usage = Usage()
-        finish = FinishReason.STOP
+        coll = ResponseCollector(live.service_request_id, live.model,
+                                 live.is_chat)
+        for ro in (initial or []):
+            coll.add(ro)
         while True:
             out = live.q.get()
             if out is None:
                 break
             ro = self._to_request_output(live, out)
-            for seq in ro.outputs:
-                text_parts.append(seq.text)
-            if out.finished:
-                finish = out.finish_reason
-                if ro.usage:
-                    usage = ro.usage
+            if ro is None:
+                continue
+            coll.add(ro)
+            if ro.finished:
                 break
-        text = "".join(text_parts)
-        builder = full_chat_response if live.is_chat \
-            else full_completion_response
-        return Response.json(builder(live.service_request_id, live.model,
-                                     text, finish, usage))
+        return Response.json(coll.body())
 
     # ------------------------------------------------------------------
     # Control surface
@@ -623,13 +795,16 @@ class Worker:
     def _serve_cancel(self, req: Request) -> Response:
         srid = req.json().get("service_request_id", "")
         with self._live_lock:
-            live = self._live.get(srid)
+            # The srid index survives individual choice completions, so a
+            # cancel still reaches the remaining choices of an n>1 request.
+            live = self._live_srid.get(srid) or self._live.get(srid)
         if live is None:
             return Response.json({"ok": False})
         rt = self.runtimes.get(live.model) or self.primary_runtime()
         if rt.engine is not None:
             with self._engine_lock:
-                rt.engine.cancel(srid)
+                for erid in live.engine_rids:
+                    rt.engine.cancel(erid)
             self._work_event.set()
         return Response.json({"ok": True})
 
@@ -654,9 +829,11 @@ class Worker:
         rt = self.runtimes.get(model) or self.primary_runtime()
         if rt.engine is None:
             return Response.error(503, f"model {model} asleep")
-        if self._embed_fn is None:
-            self._embed_fn = jax.jit(_ft.partial(
+        embed_fn = self._embed_fns.get(rt.model)
+        if embed_fn is None:
+            embed_fn = jax.jit(_ft.partial(
                 forward_embedding, cfg=rt.model_cfg))
+            self._embed_fns[rt.model] = embed_fn
         id_lists = [rt.tokenizer.encode(t)[:256] or [0] for t in inputs]
         B = 1 << max(len(id_lists) - 1, 0).bit_length()
         T = 1 << max(max(len(i) for i in id_lists) - 1, 0).bit_length()
@@ -666,7 +843,7 @@ class Worker:
             toks[i, :len(ids)] = ids
             lens[i] = len(ids)
         with self._engine_lock:
-            out = np.asarray(self._embed_fn(
+            out = np.asarray(embed_fn(
                 rt.engine.params, tokens=_jnp.asarray(toks),
                 lengths=_jnp.asarray(lens)))
         total = int(lens.sum())
@@ -759,7 +936,8 @@ class Worker:
         rt = self.runtimes.get(live.model) or self.primary_runtime()
         srid = live.service_request_id
         try:
-            first = live.q.get(timeout=600.0)      # the prefill StepOutput
+            first = live.q.get(
+                timeout=self.opts.request_timeout_s)   # prefill StepOutput
         except queue.Empty:
             # Saturated prefill queue: cancel so the held entry can never
             # leak pages when the request eventually completes.
@@ -805,7 +983,8 @@ class Worker:
         chunks = iter(())
         try:
             chunks = http_stream("POST", decode_name, "/kv/import",
-                                 raw=payload, timeout=600.0)
+                                 raw=payload,
+                                 timeout=self.opts.request_timeout_s)
             head = next(chunks, b"")
         except Exception as e:  # noqa: BLE001 — decode instance unreachable
             logger.warning("kv migration to %s failed (%s); decoding "
@@ -852,19 +1031,11 @@ class Worker:
             for ro in outs:
                 frames.extend(asm.on_output(ro))
             return Response.sse(iter(frames))
-        text = "".join(s.text for ro in outs for s in ro.outputs)
-        finish = FinishReason.STOP
-        usage = Usage()
+        coll = ResponseCollector(live.service_request_id, live.model,
+                                 live.is_chat)
         for ro in outs:
-            if ro.usage:
-                usage = ro.usage
-            for s in ro.outputs:
-                if s.finish_reason != FinishReason.NONE:
-                    finish = s.finish_reason
-        builder = full_chat_response if live.is_chat \
-            else full_completion_response
-        return Response.json(builder(live.service_request_id, live.model,
-                                     text, finish, usage))
+            coll.add(ro)
+        return Response.json(coll.body())
 
     def _relay_decode_stream(self, live: "_LiveRequest", head: bytes,
                              chunks) -> Response:
@@ -906,10 +1077,15 @@ class Worker:
             sampling=live.sampling,
             eos_token_ids=live.req.eos_token_ids)
         new_live = _LiveRequest(
-            ereq, IncrementalDecoder(rt.tokenizer), srid, live.model,
+            ereq, rt.tokenizer, srid, live.model,
             live.is_chat, live.stream, live.include_usage,
-            stream_to_service=self._topology2())
+            stream_to_service=self._topology2(),
+            stops=live.sampling.stop)
         new_live.sampling = live.sampling
+        new_live.prompt_tokens = len(live.req.token_ids)
+        # The migrated first token reaches the client via first_out below,
+        # outside _to_request_output — count it here.
+        new_live.choices[0].completion_tokens = 1
         first_out = RequestOutput(
             request_id=srid, service_request_id=srid,
             outputs=[SequenceOutput(
@@ -917,6 +1093,7 @@ class Worker:
                 token_ids=[tokens[-1]])])
         with self._live_lock:
             self._live[srid] = new_live
+            self._live_srid[srid] = new_live
         with self._engine_lock:
             ok = rt.engine.import_sequence(ereq, tokens, k, v)
             if ok and new_live.stream_to_service:
@@ -966,14 +1143,19 @@ class Worker:
             request_id=srid, token_ids=prompt, sampling=sampling,
             eos_token_ids=rt.tokenizer.eos_token_ids)
         live = _LiveRequest(
-            ereq, IncrementalDecoder(rt.tokenizer), srid, model,
+            ereq, rt.tokenizer, srid, model,
             is_chat=False, stream=bool(meta.get("stream")),
             include_usage=False,
             stream_to_service=self._decode_to_service
-            and bool(self.opts.service_addr))
+            and bool(self.opts.service_addr),
+            stops=sampling.stop)
         live.sampling = sampling
+        live.prompt_tokens = len(prompt)
+        live.choices[0].completion_tokens = 1   # migrated first token
+
         with self._live_lock:
             self._live[srid] = live
+            self._live_srid[srid] = live
         first_out = RequestOutput(
             request_id=srid, service_request_id=srid,
             outputs=[SequenceOutput(
@@ -1000,7 +1182,7 @@ class Worker:
             yield sse_frame(first_out.to_json())
             while True:
                 try:
-                    out = live.q.get(timeout=600.0)
+                    out = live.q.get(timeout=self.opts.request_timeout_s)
                 except queue.Empty:
                     with self._engine_lock:
                         if rt.engine is not None:
@@ -1010,8 +1192,10 @@ class Worker:
                 if out is None:
                     return
                 ro = self._to_request_output(live, out)
+                if ro is None:
+                    continue
                 yield sse_frame(ro.to_json())
-                if out.finished:
+                if ro.finished:
                     yield SSE_DONE
                     return
         return Response.sse(gen())

@@ -31,14 +31,13 @@ from xllm_service_tpu.config import ServiceOptions
 from xllm_service_tpu.service.httpd import (
     Request, Response, Router, http_json, http_stream)
 from xllm_service_tpu.service.response_handler import (
-    ChatStreamAssembler, CompletionStreamAssembler, full_chat_response,
-    full_completion_response)
+    ChatStreamAssembler, CompletionStreamAssembler, ResponseCollector)
 from xllm_service_tpu.service.scheduler import Scheduler
 from xllm_service_tpu.service.tracer import RequestTracer
 from xllm_service_tpu.utils.misc import short_uuid
 from xllm_service_tpu.utils.types import (
-    FinishReason, Request as SchedRequest, RequestOutput, SamplingParams,
-    Usage)
+    FinishReason, Request as SchedRequest, RequestOutput,
+    parse_openai_sampling)
 
 logger = logging.getLogger(__name__)
 
@@ -72,16 +71,14 @@ class HttpService:
                        headers: Dict[str, str]) -> SchedRequest:
         srid = (headers.get("x-request-id")
                 or f"{'chatcmpl' if is_chat else 'cmpl'}-{short_uuid()}")
-        sampling = SamplingParams(
-            max_tokens=body.get("max_tokens",
-                                body.get("max_completion_tokens", 16)),
-            temperature=body.get("temperature", 1.0),
-            top_p=body.get("top_p", 1.0),
-            top_k=body.get("top_k", 0),
-            n=body.get("n", 1),
-            stop=body.get("stop") or [],
-            seed=body.get("seed"),
-            ignore_eos=bool(body.get("ignore_eos", False)))
+        # Client-stamped send time (reference call_data.h:41-59 captures
+        # x-request-id AND x-request-time); carried on the request and
+        # surfaced in the ingress trace record.
+        try:
+            arrival = float(headers.get("x-request-time", ""))
+        except ValueError:
+            arrival = 0.0
+        sampling = parse_openai_sampling(body, is_chat)
         req = SchedRequest(
             model=body.get("model", ""),
             service_request_id=srid,
@@ -93,7 +90,8 @@ class HttpService:
             prompt=body.get("prompt", "") if not is_chat else "",
             messages=body.get("messages", []) if is_chat else [],
             token_ids=list(body.get("token_ids") or []),
-            sampling=sampling)
+            sampling=sampling,
+            arrival_time=arrival)
         req.trace_callback = self.tracer.callback_for(srid)
         return req
 
@@ -116,7 +114,8 @@ class HttpService:
 
         req = self._build_request(body, is_chat, http_req.headers)
         self.tracer.trace(req.service_request_id,
-                          {"stage": "ingress", "kind": kind, "body": body})
+                          {"stage": "ingress", "kind": kind, "body": body,
+                           "x_request_time": req.arrival_time or None})
         status, routing = self.scheduler.schedule(req)
         if not status.ok:
             with self._lock:
@@ -124,11 +123,15 @@ class HttpService:
             code = 503 if status.code.name == "UNAVAILABLE" else 400
             return Response.error(code, status.message)
 
-        # Rewrite the forwarded body (service.cpp:457-463).
+        # Rewrite the forwarded body (service.cpp:457-463). The parsed
+        # SamplingParams travel with it so the worker honors exactly what
+        # the service normalized (max_completion_tokens, stop strings,
+        # penalties, logprobs) instead of re-deriving a subset.
         fwd = dict(body)
         fwd["service_request_id"] = req.service_request_id
         fwd["token_ids"] = req.token_ids
         fwd["routing"] = routing.to_json()
+        fwd["sampling"] = req.sampling.to_json()
         if req.mm_inputs:
             fwd["mm_inputs"] = req.mm_inputs
         path = "/v1/chat/completions" if is_chat else "/v1/completions"
@@ -155,7 +158,7 @@ class HttpService:
             return Response.sse(relay())
         try:
             status, resp = http_json("POST", target, path, fwd,
-                                     timeout=600.0)
+                                     timeout=self.opts.request_timeout_s)
         except Exception as e:  # noqa: BLE001 — worker unreachable
             self.scheduler.finish_request(req.service_request_id,
                                           cancelled=True)
@@ -221,9 +224,7 @@ class HttpService:
                         yield frame
             return Response.sse(gen())
 
-        text_parts: List[str] = []
-        usage = Usage()
-        finish = FinishReason.STOP
+        coll = ResponseCollector(req.service_request_id, req.model, is_chat)
         while True:
             try:
                 out = next_output()
@@ -236,17 +237,8 @@ class HttpService:
                                       "timeout")
             if out is None:
                 break
-            for seq in out.outputs:
-                text_parts.append(seq.text)
-                if seq.finish_reason != FinishReason.NONE:
-                    finish = seq.finish_reason
-            if out.usage:
-                usage = out.usage
-        builder = full_chat_response if is_chat \
-            else full_completion_response
-        return Response.json(builder(
-            req.service_request_id, req.model, "".join(text_parts),
-            finish, usage))
+            coll.add(out)
+        return Response.json(coll.body())
 
     # ------------------------------------------------------------------
     # Embeddings — implemented for real (the reference returns

@@ -196,6 +196,10 @@ class Routing:
 
 @dataclasses.dataclass
 class SamplingParams:
+    """Full OpenAI sampling contract (reference carries these end to end:
+    xllm/chat.proto:1-192, completion.proto:1-143). Every field here is
+    honored by the engine — none are accepted-and-ignored."""
+
     max_tokens: int = 16
     temperature: float = 1.0
     top_p: float = 1.0
@@ -205,6 +209,9 @@ class SamplingParams:
     stop_token_ids: List[int] = dataclasses.field(default_factory=list)
     seed: Optional[int] = None
     logprobs: bool = False
+    top_logprobs: int = 0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     ignore_eos: bool = False
 
     def to_json(self) -> Dict[str, Any]:
@@ -216,6 +223,42 @@ class SamplingParams:
             return cls()
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def parse_openai_sampling(body: Dict[str, Any],
+                          is_chat: bool) -> SamplingParams:
+    """Normalize an OpenAI request body into SamplingParams.
+
+    Field quirks handled here once (service and direct-to-worker paths
+    share it): ``max_completion_tokens`` aliases ``max_tokens``; ``stop``
+    may be a string or a list; the completion API's ``logprobs`` is an
+    int (top-k count) while the chat API uses ``logprobs: bool`` +
+    ``top_logprobs: int``."""
+    stop = body.get("stop") or []
+    if isinstance(stop, str):
+        stop = [stop]
+    if is_chat:
+        logprobs = bool(body.get("logprobs", False))
+        top_logprobs = int(body.get("top_logprobs") or 0)
+    else:
+        lp = body.get("logprobs")
+        logprobs = lp is not None and lp is not False
+        top_logprobs = int(lp) if isinstance(lp, int) else 0
+    return SamplingParams(
+        max_tokens=int(body.get("max_tokens",
+                                body.get("max_completion_tokens", 16))),
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", 0)),
+        n=int(body.get("n", 1)),
+        stop=[str(s) for s in stop],
+        stop_token_ids=list(body.get("stop_token_ids") or []),
+        seed=body.get("seed"),
+        logprobs=logprobs,
+        top_logprobs=top_logprobs,
+        presence_penalty=float(body.get("presence_penalty", 0.0)),
+        frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+        ignore_eos=bool(body.get("ignore_eos", False)))
 
 
 @dataclasses.dataclass
