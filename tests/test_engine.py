@@ -122,6 +122,98 @@ def test_engine_greedy_matches_direct_model_loop():
     assert got == ref
 
 
+def test_add_request_rejects_prompt_larger_than_pool():
+    """A prompt whose KV can never fit the page pool must fail fast at
+    add_request, not self-preempt forever (review finding)."""
+    eng = _tiny_engine(num_pages=8)          # 7 usable pages × 4 tokens
+    with pytest.raises(ValueError):
+        eng.add_request(EngineRequest(
+            "big", token_ids=[1] * 29,        # needs 8 pages (29+1 tokens)
+            sampling=SamplingParams(max_tokens=2)))
+    eng.add_request(EngineRequest(            # 27+1 tokens → 7 pages: fits
+        "ok", token_ids=[1] * 27,
+        sampling=SamplingParams(max_tokens=1, temperature=0.0)))
+    toks, done = _collect(eng)
+    assert done["ok"] == FinishReason.LENGTH
+
+
+def test_chunked_prefill_long_prompt_matches_single_shot():
+    """A prompt longer than the largest prefill bucket must prefill over
+    multiple windows and generate exactly what a single-shot prefill of the
+    same prompt produces (round-1 capped prompts at the largest bucket)."""
+    prompt = [(i * 7 + 3) % 50 for i in range(30)]
+    sp = SamplingParams(max_tokens=6, temperature=0.0)
+
+    e1 = _tiny_engine()                      # bucket 64: one-shot prefill
+    e1.add_request(EngineRequest("a", list(prompt), sampling=sp))
+    toks1, done1 = _collect(e1)
+
+    e2 = _tiny_engine(prefill_buckets=(8,), max_prefill_tokens=8)
+    e2.add_request(EngineRequest("a", list(prompt), sampling=sp))
+    toks2, done2 = _collect(e2)
+
+    assert done1["a"] == done2["a"] == FinishReason.LENGTH
+    assert toks1["a"] == toks2["a"]
+
+
+def test_chunked_prefill_interleaves_with_short_requests():
+    """Long and short prompts complete together; short ones are not
+    starved by a long prompt's windows."""
+    sp = SamplingParams(max_tokens=4, temperature=0.0)
+    eng = _tiny_engine(prefill_buckets=(8,), max_prefill_tokens=8)
+    long_prompt = [(i * 3 + 1) % 50 for i in range(28)]
+    eng.add_request(EngineRequest("long", long_prompt, sampling=sp))
+    eng.add_request(EngineRequest("short", [5, 6, 7], sampling=sp))
+    toks, done = _collect(eng)
+    assert done["long"] == FinishReason.LENGTH and len(toks["long"]) == 4
+    assert done["short"] == FinishReason.LENGTH and len(toks["short"]) == 4
+
+    # Same outputs as solo runs.
+    for rid, prompt in (("long", long_prompt), ("short", [5, 6, 7])):
+        solo = _tiny_engine()
+        solo.add_request(EngineRequest(rid, list(prompt), sampling=sp))
+        st, _ = _collect(solo)
+        assert st[rid] == toks[rid]
+
+
+def test_ring_prefill_long_prompt_matches_single_chip():
+    """Engine on an sp=8 mesh must prefill a prompt longer than the largest
+    bucket in ONE ring step and generate exactly what the single-chip
+    (chunked-window) engine produces."""
+    from xllm_service_tpu.parallel import MeshSpec, make_mesh
+
+    prompt = [(i * 11 + 2) % 50 for i in range(40)]
+    sp = SamplingParams(max_tokens=5, temperature=0.0)
+
+    ref = _tiny_engine(prefill_buckets=(8,), max_prefill_tokens=8)
+    ref.add_request(EngineRequest("a", list(prompt), sampling=sp))
+    toks_ref, done_ref = _collect(ref)
+
+    cfg = dataclasses.replace(ModelConfig.tiny(), dtype="float32")
+    from xllm_service_tpu.config import EngineConfig as EC
+    mesh = make_mesh(MeshSpec(sp=8))
+    eng = Engine(cfg, EC(page_size=4, num_pages=32, max_model_len=64,
+                         max_batch_size=4, max_prefill_tokens=8,
+                         prefill_buckets=(8,)),
+                 mesh=mesh, seed=0)
+    assert eng._jit_prefill_ring is not None
+    eng.add_request(EngineRequest("a", list(prompt), sampling=sp))
+    # First step must take the whole prompt (ring), not an 8-token window.
+    outs = eng.step()
+    assert outs and outs[0].new_token_ids, "ring prefill did not emit"
+    toks = {"a": list(outs[0].new_token_ids)}
+    done = {}
+    for _ in range(50):
+        if not eng.has_work():
+            break
+        for out in eng.step():
+            toks[out.request_id].extend(out.new_token_ids)
+            if out.finished:
+                done[out.request_id] = out.finish_reason
+    assert done["a"] == done_ref["a"] == FinishReason.LENGTH
+    assert toks["a"] == toks_ref["a"]
+
+
 def test_engine_batched_matches_solo():
     """Concurrent requests must not perturb each other's greedy outputs."""
     prompts = [[1, 2, 3], [7, 7, 7, 7, 7], [9, 8, 7, 6]]
@@ -208,6 +300,30 @@ def test_online_preempts_offline():
     assert done["on"] == FinishReason.LENGTH
     assert done["off"] == FinishReason.LENGTH
     assert len(toks["on"]) == 8 and len(toks["off"]) == 20
+    assert eng.num_preemptions >= 1
+
+
+def test_online_preempts_offline_mid_chunked_prefill():
+    """An offline prompt between chunked-prefill windows holds a slot and
+    pages while sitting in ``waiting`` — it must still be a preemption
+    victim when an online arrival needs pages (review finding: the victim
+    scan only covered ``running``)."""
+    eng = _tiny_engine(num_pages=8, max_model_len=32,
+                       prefill_buckets=(8,), max_prefill_tokens=8)
+    eng.ecfg.enable_prefix_cache = False
+    eng.prefix_cache.enable = False
+    eng.add_request(EngineRequest(
+        request_id="off", token_ids=[2] * 24, offline=True,
+        sampling=SamplingParams(max_tokens=4, temperature=0.0)))
+    eng.step()          # first window only: "off" now waits mid-prefill
+    off = eng._by_id["off"]
+    assert off.slot >= 0 and 0 < off.num_computed < 24
+    eng.add_request(EngineRequest(
+        request_id="on", token_ids=[3] * 20,
+        sampling=SamplingParams(max_tokens=4, temperature=0.0)))
+    toks, done = _collect(eng, max_steps=400)
+    assert done["on"] == FinishReason.LENGTH and len(toks["on"]) == 4
+    assert done["off"] == FinishReason.LENGTH and len(toks["off"]) == 4
     assert eng.num_preemptions >= 1
 
 

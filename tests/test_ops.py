@@ -81,6 +81,43 @@ def test_mha_prefill_matches_naive():
         np.testing.assert_allclose(got[b], ref, rtol=1e-4, atol=1e-5)
 
 
+def test_mha_prefill_chunked_matches_dense():
+    """Online-softmax chunked prefill ≡ dense path, incl. cached prefixes,
+    padding rows, and S not a multiple of the chunk size."""
+    from xllm_service_tpu.ops.attention import mha_prefill_chunked
+
+    rng = np.random.default_rng(7)
+    B, T, S, Hq, Hkv, D = 2, 8, 37, 4, 2, 8
+    q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, Hkv, D)), jnp.float32)
+    q_start = jnp.asarray([20, 0], jnp.int32)
+    kv_len = jnp.asarray([26, 5], jnp.int32)
+    ref = mha_prefill(q, k, v, kv_len, q_start)
+    for chunk in (4, 7, 16, 64):
+        got = mha_prefill_chunked(q, k, v, kv_len, q_start,
+                                  chunk_size=chunk)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_mha_prefill_chunked_soft_cap():
+    from xllm_service_tpu.ops.attention import mha_prefill_chunked
+
+    rng = np.random.default_rng(8)
+    B, T, S, Hq, Hkv, D = 1, 6, 24, 2, 1, 8
+    q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, Hkv, D)), jnp.float32)
+    q_start = jnp.asarray([18], jnp.int32)
+    kv_len = jnp.asarray([24], jnp.int32)
+    ref = mha_prefill(q, k, v, kv_len, q_start, logits_soft_cap=30.0)
+    got = mha_prefill_chunked(q, k, v, kv_len, q_start,
+                              logits_soft_cap=30.0, chunk_size=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_paged_kv_roundtrip_and_decode_attention():
     rng = np.random.default_rng(4)
     P, ps, Hkv, D, Hq = 8, 4, 2, 8, 4

@@ -108,6 +108,10 @@ class ModelConfig:
     # MoE (0 experts → dense MLP).
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Sparse dispatch capacity factor (parallel/expert.py): each expert
+    # takes ≤ ceil(k·N/E·cf) tokens per call. ≥ E/k guarantees no drops;
+    # 0 selects the dense-compute oracle (every expert on every token).
+    moe_capacity_factor: float = 2.0
     dtype: str = "bfloat16"
 
     def __post_init__(self) -> None:

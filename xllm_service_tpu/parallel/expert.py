@@ -1,0 +1,110 @@
+"""Expert-parallel MoE dispatch: top-k routing with capacity buckets.
+
+Round 1 ran *every* expert on *every* token and mixed by routing weight
+(dense MoE) — FLOPs scaled with the expert count E (VERDICT.md weak #4).
+This module implements the TPU-native sparse schedule (GShard/Switch
+style, PAPERS.md): tokens are dispatched into per-expert capacity buckets
+with one-hot einsums, experts run batched matmuls over their buckets only,
+and a combine einsum scatters results back — per-token FLOPs are
+``k × (expert MLP)``, independent of E.
+
+Everything is static-shaped and expressed as einsums contracting over the
+token axis, so GSPMD partitions the expert axis over the mesh's ``ep``
+axis purely from the weight shardings (parallel/sharding.py
+_MOE_LAYER_RULES) — expert buckets land on the devices holding those
+experts' weights, with XLA inserting the dispatch/combine collectives
+(the all-to-all a hand-written MoE implements with NCCL).
+
+Capacity semantics: each expert accepts at most ``C = ceil(k·N/E · cf)``
+tokens per call (``cf`` = ``ModelConfig.moe_capacity_factor``). Tokens
+routed past a full expert lose that expert's contribution and renormalize
+over their surviving experts (the residual stream still carries them) —
+the standard TPU MoE trade for static shapes. ``cf`` large enough (≥ E/k)
+guarantees no drops, which the equivalence tests use; serving defaults to
+2.0.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def capacity(num_tokens: int, num_experts: int, k: int,
+             factor: float) -> int:
+    """Static per-expert bucket size, ≥1, 8-aligned, ≤ num_tokens."""
+    c = int(num_tokens * k * factor / num_experts) + 1
+    c = -(-c // 8) * 8
+    return min(c, num_tokens)
+
+
+def topk_dispatch(gates: jnp.ndarray, k: int, cap: int,
+                  valid: jnp.ndarray = None
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Route each token to its top-``k`` experts with capacity ``cap``.
+
+    gates: [N, E] router softmax (fp32); ``valid`` [N] bool masks padding
+    / inactive-lane tokens OUT of routing entirely — they must not consume
+    expert capacity or a real token's output would depend on how much
+    padding shares its batch. Returns
+    ``dispatch`` [N, E, C] float (0/1 token→bucket-slot assignment) and
+    ``combine`` [N, E, C] float (dispatch × renormalized routing weight).
+    Bucket slots fill in token order (position = running count of earlier
+    tokens choosing the same expert — the GShard cumsum trick).
+    """
+    N, E = gates.shape
+    topv, topi = jax.lax.top_k(gates, k)                     # [N, k]
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    counts = jnp.zeros((E,), jnp.int32)
+    dispatch = jnp.zeros((N, E, cap), jnp.float32)
+    combine = jnp.zeros((N, E, cap), jnp.float32)
+    for j in range(k):                                       # k is tiny/static
+        oh = jax.nn.one_hot(topi[:, j], E, dtype=jnp.int32)  # [N, E]
+        if valid is not None:
+            oh = oh * valid.astype(jnp.int32)[:, None]
+        pos = jnp.cumsum(oh, axis=0) - oh + counts[None, :]  # [N, E]
+        counts = counts + jnp.sum(oh, axis=0)
+        pos_j = jnp.sum(pos * oh, axis=1)                    # [N]
+        keep = pos_j < cap
+        slot = jax.nn.one_hot(jnp.where(keep, pos_j, cap), cap,
+                              dtype=jnp.float32)             # [N, C]
+        d_j = oh.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d_j
+        combine = combine + topv[:, j][:, None, None] * d_j
+    # Renormalize over surviving experts so a token that lost one expert
+    # to capacity doesn't shrink toward zero.
+    w = jnp.sum(combine, axis=(1, 2), keepdims=True)         # [N, 1, 1]
+    combine = jnp.where(w > 0, combine / jnp.maximum(w, 1e-9), combine)
+    return dispatch, combine
+
+
+def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, gate_w: jnp.ndarray,
+            up_w: jnp.ndarray, down_w: jnp.ndarray, k: int,
+            capacity_factor: float = 2.0,
+            valid: jnp.ndarray = None) -> jnp.ndarray:
+    """Sparse SwiGLU MoE layer.
+
+    x: [B, T, D]; router_w [D, E]; gate/up [E, D, F]; down [E, F, D];
+    ``valid`` [B, T] bool marks real tokens (padding / inactive lanes are
+    excluded from routing so they never take capacity from real tokens).
+    Expert compute contracts over capacity buckets [E, C, D] — shard the
+    weights' E axis over ``ep`` and GSPMD keeps each bucket's matmuls on
+    its expert's devices.
+    """
+    B, T, D = x.shape
+    N = B * T
+    E = router_w.shape[-1]
+    xf = x.reshape(N, D)
+    gates = jax.nn.softmax((xf @ router_w).astype(jnp.float32), axis=-1)
+    cap = capacity(N, E, k, capacity_factor)
+    dispatch, combine = topk_dispatch(
+        gates, k, cap, None if valid is None else valid.reshape(N))
+    de = dispatch.astype(x.dtype)
+    x_e = jnp.einsum("nd,nec->ecd", xf, de)                  # [E, C, D]
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", x_e, gate_w)) \
+        * jnp.einsum("ecd,edf->ecf", x_e, up_w)
+    y_e = jnp.einsum("ecf,efd->ecd", h, down_w)              # [E, C, D]
+    out = jnp.einsum("ecd,nec->nd", y_e, combine.astype(x.dtype))
+    return out.reshape(B, T, D)

@@ -60,11 +60,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def fold_block(o, m, l, kb, vb, s):
-        """Fold KV block ``s`` hops upstream into the flash accumulator."""
+        """Fold KV block ``s`` hops upstream into the flash accumulator
+        (numerics shared with the chunked prefill path —
+        ops/attention.flash_fold)."""
+        from xllm_service_tpu.ops.attention import flash_fold
         src = (my_idx - s) % n
         k_pos = src * Tk + jnp.arange(Tk, dtype=jnp.int32)       # [Tk] global
-        logits = jnp.einsum("bthgd,bshd->bthgs", qg, kb,
-                            preferred_element_type=jnp.float32) * scale
         mask = k_pos[None, :] <= q_pos[:, None]                  # [Tq, Tk]
         if kv_lengths is not None:
             mask = mask[None] & (k_pos[None, None, :]
@@ -72,17 +73,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             mask = mask[:, :, None, None, :]
         else:
             mask = mask[None, :, None, None, :]
-        logits = jnp.where(mask, logits, _NEG_INF)
-        blk_max = jnp.max(logits, axis=-1)                       # [B,Tq,Hkv,G]
-        m_new = jnp.maximum(m, blk_max)
-        # exp of fully-masked rows must contribute zero, not exp(-inf - -inf).
-        p = jnp.exp(logits - m_new[..., None])
-        p = jnp.where(mask, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum(
-            "bthgs,bshd->bthgd", p, vb.astype(jnp.float32))
-        return o_new, m_new, l_new
+        return flash_fold(o, m, l, qg, kb, vb, mask, scale)
 
     # Local block first, then (n-1) permute-then-fold steps — the last
     # block is not rotated onward, saving one full KV ring hop per call.
@@ -98,14 +89,18 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if n > 1:
         (o, m, l, _, _), _ = jax.lax.scan(
             step, (o, m, l, k, v), jnp.arange(1, n))
-    out = o / jnp.maximum(l[..., None], 1e-30)
+    from xllm_service_tpu.ops.attention import flash_finalize
+    out = flash_finalize(o, l)
     return out.reshape(B, Tq, Hq, D).astype(q.dtype)
 
 
-def ring_attention_sharded(mesh: Mesh, axis_name: str = "sp"):
+def ring_attention_sharded(mesh: Mesh, axis_name: str = "sp",
+                           head_axis: Optional[str] = None):
     """Build a jit-able ring attention partitioned over ``mesh``: Q/K/V
-    [B, T, H, D] sharded on T over ``axis_name``, lengths replicated."""
-    qkv_spec = P(None, axis_name)
+    [B, T, H, D] sharded on T over ``axis_name`` (and optionally on H over
+    ``head_axis``, e.g. "tp" when both head counts divide it — the GQA
+    grouping inside the block must stay aligned), lengths replicated."""
+    qkv_spec = P(None, axis_name, head_axis, None)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,

@@ -185,7 +185,7 @@ class HttpService:
         self.scheduler.record_new_request(req, on_output)
         try:
             status, ack = http_json("POST", target, path, fwd,
-                                    timeout=600.0)
+                                    timeout=self.opts.request_timeout_s)
             if status != 200:
                 raise RuntimeError(f"worker returned {status}: {ack}")
         except Exception as e:  # noqa: BLE001
