@@ -1,0 +1,56 @@
+"""KV-migration bandwidth probe (BASELINE.md north star: KV GB/s).
+
+Builds two pool-layout-identical engines on the live backend and measures
+both PD transfer paths — device-to-device (donated scatter, the co-hosted
+fast path) and the host shuttle (serialize → deserialize → device, the
+cross-process wire floor). Prints ONE JSON line, BASELINE-style.
+
+    python -m benchmarks.kv_probe --model llama3-1b --pages 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--pages", type=int, default=32,
+                   help="KV pages to migrate per rep")
+    p.add_argument("--page-size", type=int, default=64)
+    p.add_argument("--num-pages", type=int, default=128,
+                   help="pool size per engine")
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args(argv)
+
+    from xllm_service_tpu.config import EngineConfig
+    from xllm_service_tpu.runtime.engine import Engine
+    from xllm_service_tpu.runtime.kv_transfer import probe_kv_migration
+    from xllm_service_tpu.runtime.worker import resolve_model_config
+
+    cfg = resolve_model_config(args.model)
+    ecfg = EngineConfig(page_size=args.page_size, num_pages=args.num_pages,
+                        max_model_len=args.page_size * 4, max_batch_size=1,
+                        prefill_buckets=(args.page_size,))
+    src = Engine(cfg, ecfg, seed=0)
+    dst = Engine(cfg, ecfg, seed=0)
+    out = probe_kv_migration(src, dst, n_pages=args.pages,
+                             iters=args.iters)
+    print(json.dumps({
+        "metric": "kv_migration_gbps",
+        "value": round(out["direct_gbps"], 3),
+        "unit": "GB/s",
+        "host_shuttle_gbps": round(out["host_gbps"], 3),
+        "block_bytes": int(out["bytes"]),
+        "model": args.model,
+        "pages": int(out["pages"]),     # effective (clamped to pool size)
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

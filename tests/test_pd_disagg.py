@@ -31,7 +31,9 @@ def small_engine_cfg() -> EngineConfig:
                         prefill_buckets=(32, 64))
 
 
-def make_pd_cluster(store, decode_to_service=False):
+def make_pd_cluster(store, decode_to_service=False, direct=False):
+    # direct=False forces the HTTP KV shuttle even though both workers
+    # share this process — the wire path must stay covered.
     opts = ServiceOptions(
         http_port=0, rpc_port=0, num_output_pools=4,
         load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
@@ -44,7 +46,8 @@ def make_pd_cluster(store, decode_to_service=False):
         wopts = WorkerOptions(
             port=0, instance_type=itype,
             service_addr=master.rpc_address, model="tiny",
-            heartbeat_interval_s=0.2, lease_ttl_s=2.0)
+            heartbeat_interval_s=0.2, lease_ttl_s=2.0,
+            pd_direct_kv=direct)
         workers.append(Worker(wopts, store,
                               engine_cfg=small_engine_cfg()).start())
     mgr = master.scheduler.instance_mgr
@@ -123,6 +126,61 @@ class TestPdDisaggregation:
             for w in workers:
                 w.stop()
             master.stop()
+
+    def test_direct_migration_same_process(self, store):
+        """Co-hosted PD pair with pd_direct_kv: the KV block moves
+        device-to-device (no HTTP shuttle) and greedy output matches the
+        wire path exactly."""
+        master, workers = make_pd_cluster(store, direct=True)
+        prefill_w, decode_w = workers
+        try:
+            body = {"model": "tiny", "prompt": "direct migrate please",
+                    "max_tokens": 6, "temperature": 0.0,
+                    "ignore_eos": True}
+            status, direct_resp = http_json(
+                "POST", master.http_address, "/v1/completions",
+                dict(body), timeout=120.0)
+            assert status == 200, direct_resp
+            assert direct_resp["usage"]["completion_tokens"] == 6
+            assert prefill_w.kv_migration_direct == 1
+            assert prefill_w.kv_migration_bytes > 0
+            assert decode_w.primary_runtime().engine.step_count > 0
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
+        wire_store = InMemoryStore(sweep_interval_s=0.02)
+        master2, workers2 = make_pd_cluster(wire_store, direct=False)
+        try:
+            status, wire_resp = http_json(
+                "POST", master2.http_address, "/v1/completions",
+                dict(body), timeout=120.0)
+            assert status == 200, wire_resp
+            assert workers2[0].kv_migration_direct == 0
+            assert direct_resp["choices"][0]["text"] == \
+                wire_resp["choices"][0]["text"]
+        finally:
+            for w in workers2:
+                w.stop()
+            master2.stop()
+            wire_store.close()
+
+    def test_kv_migration_probe(self):
+        """The transport probe reports positive bandwidth for both paths
+        on pool-layout-identical engines (BASELINE.md north star)."""
+        import dataclasses as dc
+        from xllm_service_tpu.config import ModelConfig
+        from xllm_service_tpu.runtime.engine import Engine
+        from xllm_service_tpu.runtime.kv_transfer import probe_kv_migration
+
+        cfg = dc.replace(ModelConfig.tiny(), dtype="float32")
+        ecfg = small_engine_cfg()
+        a = Engine(cfg, ecfg, seed=0)
+        b = Engine(cfg, ecfg, seed=0)
+        out = probe_kv_migration(a, b, n_pages=8, iters=3)
+        assert out["bytes"] > 0
+        assert out["direct_gbps"] > 0 and out["host_gbps"] > 0
 
     def test_pd_output_equals_single_worker(self, store):
         """Greedy continuation after migration must match a single-worker

@@ -846,21 +846,28 @@ class Engine:
     # SURVEY.md §7.3 item 1; the cross-slice jax.device_put path can slot
     # in behind the same interface)
     # ------------------------------------------------------------------
-    def export_held(self, request_id: str
-                    ) -> Optional[Tuple[List[int], np.ndarray, np.ndarray]]:
-        """Pull a held (prefill-finished) sequence's KV out of HBM.
+    def export_held(self, request_id: str, device: bool = False
+                    ) -> Optional[Tuple[List[int], Any, Any]]:
+        """Pull a held (prefill-finished) sequence's KV out of the pool.
 
         Returns (tokens, k, v) with k/v shaped
         [L, n_pages, page_size, Hkv, Dh]; tokens include the first sampled
         token (whose KV is NOT resident — the decode side writes it on its
-        first step). Releases the pages."""
+        first step). Releases the pages.
+
+        ``device=True`` keeps k/v as device arrays (the gathered block is
+        a fresh buffer, so releasing the pages is safe) — the
+        device-to-device migration path between co-hosted engines; default
+        returns host numpy for the HTTP wire."""
         seq = self._held.pop(request_id, None)
         if seq is None:
             return None
         k_pages, v_pages = self.kv
         idx = jnp.asarray(seq.pages, jnp.int32)
-        k = np.asarray(jax.device_get(k_pages[:, idx]))
-        v = np.asarray(jax.device_get(v_pages[:, idx]))
+        k, v = k_pages[:, idx], v_pages[:, idx]
+        if not device:
+            k = np.asarray(jax.device_get(k))
+            v = np.asarray(jax.device_get(v))
         self.prefix_cache.release_pages(seq.pages)
         seq.pages = []
         return list(seq.tokens), k, v

@@ -1,0 +1,84 @@
+"""KV-migration transport probe: the BASELINE.md north star (KV GB/s).
+
+Two transfer paths exist for PD disaggregation (SURVEY.md §7.3 item 1):
+
+- **direct** — both engines live in one process on one host's devices;
+  the exported page block stays a device array and lands in the decode
+  pool via one donated scatter (``Engine.export_held(device=True)`` →
+  ``Engine.import_sequence``). No host copy, no serialization.
+- **host shuttle** — the cross-process wire path
+  (device_get → meta+raw bytes → HTTP → frombuffer → device_put scatter,
+  runtime/worker.py ``_serve_pd_prefill``/``_serve_kv_import``).
+
+``probe_kv_migration`` measures both on the live hardware with
+pool-layout-identical engines, so deployments (and bench.py) can record
+``kv_migration_gbps`` instead of guessing. The HTTP hop itself is not
+simulated — the host path here measures the serialize/deserialize +
+device roundtrip floor, an upper bound on what any loopback wire gives.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xllm_service_tpu.runtime.engine import Engine, _kv_scatter
+
+
+def probe_kv_migration(src: Engine, dst: Engine, n_pages: int = 16,
+                       iters: int = 5) -> Dict[str, float]:
+    """Move an ``n_pages`` KV block src→dst via both paths, ``iters``
+    timed reps each (one warmup). Engines must share pool layout.
+    Returns {"bytes", "direct_gbps", "host_gbps"}."""
+    ks, vs = src.kv
+    if ks.shape[0:1] + ks.shape[2:] != \
+            dst.kv[0].shape[0:1] + dst.kv[0].shape[2:]:
+        raise ValueError("engines have different KV pool layouts")
+    n_pages = min(n_pages, ks.shape[1] - 1, dst.kv[0].shape[1] - 1)
+    if n_pages < 1:
+        raise ValueError("pool too small to probe (needs >= 2 pages)")
+    src_idx = jnp.arange(1, n_pages + 1, dtype=jnp.int32)
+    dst_idx = jnp.arange(1, n_pages + 1, dtype=jnp.int32)
+    nbytes = 2 * int(np.prod(ks[:, :n_pages].shape)) * ks.dtype.itemsize
+
+    def direct_once() -> None:
+        kd, vd = dst.kv
+        k = ks[:, src_idx]
+        v = vs[:, src_idx]
+        dst.kv = _kv_scatter(kd, vd, dst_idx, k.astype(kd.dtype),
+                             v.astype(vd.dtype))
+        jax.block_until_ready(dst.kv[0])
+
+    def host_once() -> None:
+        kd, vd = dst.kv
+        # The wire path: gather → host → bytes → host → device → scatter.
+        k_host = np.asarray(jax.device_get(ks[:, src_idx]))
+        v_host = np.asarray(jax.device_get(vs[:, src_idx]))
+        blob = k_host.tobytes() + v_host.tobytes()
+        half = len(blob) // 2
+        k2 = np.frombuffer(blob[:half], dtype=k_host.dtype).reshape(
+            k_host.shape)
+        v2 = np.frombuffer(blob[half:], dtype=v_host.dtype).reshape(
+            v_host.shape)
+        dst.kv = _kv_scatter(kd, vd, dst_idx,
+                             jnp.asarray(k2).astype(kd.dtype),
+                             jnp.asarray(v2).astype(vd.dtype))
+        jax.block_until_ready(dst.kv[0])
+
+    # Report the EFFECTIVE page count: callers print this next to the
+    # bandwidth, and a silently clamped request must not claim a larger
+    # measured block than was moved.
+    out: Dict[str, float] = {"bytes": float(nbytes),
+                             "pages": float(n_pages)}
+    for name, fn in (("direct", direct_once), ("host", host_once)):
+        fn()                                   # warmup / compile
+        t0 = time.monotonic()
+        for _ in range(iters):
+            fn()
+        dt = (time.monotonic() - t0) / iters
+        out[f"{name}_gbps"] = nbytes / dt / 1e9
+    return out

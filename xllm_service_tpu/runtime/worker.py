@@ -27,9 +27,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
 import queue
 import threading
 import time
+import weakref
 from typing import Any, Dict, Iterator, List, Optional
 
 import jax
@@ -74,6 +76,10 @@ class WorkerOptions:
     request_timeout_s: float = 600.0
     enable_profiling: bool = False
     memory_budget_gb: float = 60.0
+    # PD migration to a decode worker in this process skips the HTTP
+    # shuttle and moves KV device-to-device (off to force the wire path,
+    # e.g. for testing it).
+    pd_direct_kv: bool = True
     seed: int = 0
     murmur_seed: int = 0
 
@@ -86,6 +92,13 @@ _MODEL_REGISTRY = {
     "qwen2-7b": ModelConfig.qwen2_7b,
     "tiny-moe": lambda: ModelConfig.tiny(num_experts=4),
 }
+
+
+# Workers in this process, by address. PD migration consults it to keep a
+# co-hosted transfer device-to-device (export_held(device=True) → direct
+# adopt) instead of round-tripping KV bytes through the HTTP shuttle —
+# the data plane the reference drives over NCCL stays on-device here.
+_LOCAL_WORKERS: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
 
 
 def resolve_model_config(name: str, model_dir: str = "") -> ModelConfig:
@@ -108,7 +121,7 @@ class ModelRuntime:
     def __init__(self, model: str, model_cfg: ModelConfig,
                  engine_cfg: EngineConfig, tokenizer: Tokenizer,
                  mesh=None, seed: int = 0, murmur_seed: int = 0,
-                 start_asleep: bool = False) -> None:
+                 start_asleep: bool = False, model_dir: str = "") -> None:
         self.model = model
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
@@ -116,12 +129,27 @@ class ModelRuntime:
         self.mesh = mesh
         self.seed = seed
         self.murmur_seed = murmur_seed
+        self.model_dir = model_dir
         self.state = MODEL_ASLEEP if start_asleep else MODEL_AWAKE
         self._host_params: Optional[Any] = None
         self.engine: Optional[Engine] = None
         if not start_asleep:
-            self.engine = Engine(model_cfg, engine_cfg, mesh=mesh,
+            self.engine = Engine(model_cfg, engine_cfg,
+                                 params=self._load_params(), mesh=mesh,
                                  seed=seed, murmur_seed=murmur_seed)
+
+    def _load_params(self) -> Optional[Any]:
+        """Real weights from the HF model dir when present (sharded
+        device_put); None → Engine random-inits (tests / shape-only runs)."""
+        import glob
+        if self.model_dir and glob.glob(
+                os.path.join(self.model_dir, "*.safetensors")):
+            from xllm_service_tpu.runtime.checkpoint import load_checkpoint
+            logger.info("loading %s weights from %s", self.model,
+                        self.model_dir)
+            return load_checkpoint(self.model_dir, self.model_cfg,
+                                   mesh=self.mesh)
+        return None
 
     def sleep(self) -> None:
         """Donate weights to host RAM, drop the KV pool (TPU sleep —
@@ -144,6 +172,8 @@ class ModelRuntime:
             import jax.numpy as jnp
             params = jax.tree_util.tree_map(jnp.asarray, self._host_params)
             self._host_params = None
+        else:
+            params = self._load_params()    # cold wake: real weights
         self.engine = Engine(self.model_cfg, self.engine_cfg,
                              params=params, mesh=self.mesh, seed=self.seed,
                              murmur_seed=self.murmur_seed)
@@ -284,7 +314,8 @@ class Worker:
         primary_cfg = resolve_model_config(opts.model, opts.model_dir)
         self.runtimes[opts.model] = ModelRuntime(
             opts.model, primary_cfg, self.engine_cfg, self.tokenizer,
-            mesh=mesh, seed=opts.seed, murmur_seed=opts.murmur_seed)
+            mesh=mesh, seed=opts.seed, murmur_seed=opts.murmur_seed,
+            model_dir=opts.model_dir)
 
         self._live: Dict[str, _LiveRequest] = {}        # engine rid → live
         self._live_srid: Dict[str, _LiveRequest] = {}   # srid → live
@@ -332,6 +363,7 @@ class Worker:
         # KV-migration throughput book (BASELINE.md north-star metric).
         self.kv_migration_bytes = 0
         self.kv_migration_seconds = 0.0
+        self.kv_migration_direct = 0    # device-to-device (no host copy)
         self._srv = HttpServer(opts.host, opts.port, router)
         self.name = self._srv.address
 
@@ -348,6 +380,7 @@ class Worker:
     # ------------------------------------------------------------------
     def start(self) -> "Worker":
         self._srv.start()
+        _LOCAL_WORKERS[self.name] = self
         self._register()
         self._loop_thread.start()
         self._hb_thread.start()
@@ -356,6 +389,7 @@ class Worker:
     def stop(self) -> None:
         self._stop.set()
         self._work_event.set()
+        _LOCAL_WORKERS.pop(self.name, None)
         self._srv.stop()
         if self._lease_id is not None:
             try:
@@ -725,6 +759,8 @@ class Worker:
                      f"{self.kv_migration_bytes}")
         lines.append(f"xllm_worker_kv_migration_seconds_total "
                      f"{self.kv_migration_seconds:.6f}")
+        lines.append(f"xllm_worker_kv_migration_direct_total "
+                     f"{self.kv_migration_direct}")
         if self.kv_migration_seconds > 0:
             lines.append(
                 f"xllm_worker_kv_migration_gbps "
@@ -959,6 +995,11 @@ class Worker:
                 return Response.json({"status": "accepted",
                                       "service_request_id": srid})
             return self._respond_outputs(live, outs)
+        peer = (_LOCAL_WORKERS.get(decode_name)
+                if self.opts.pd_direct_kv else None)
+        if peer is not None and peer is not self:
+            return self._migrate_direct(live, rt, srid, peer)
+
         with self._engine_lock:
             exported = rt.engine.export_held(srid)
         if exported is None:
@@ -1007,6 +1048,68 @@ class Worker:
         # Relay topology: decode streams raw RequestOutput SSE frames back
         # on this same connection; re-assemble client-facing chunks here.
         return self._relay_decode_stream(live, head, chunks)
+
+    def _migrate_direct(self, live: "_LiveRequest", rt: ModelRuntime,
+                        srid: str, peer: "Worker") -> Response:
+        """PD migration to a decode worker in THIS process: the exported
+        page block stays a device array end to end (export_held(device=
+        True) → peer adopt → donated scatter) — no host copy, no wire.
+        The data plane the reference runs over NCCL stays on-device here."""
+        with self._engine_lock:
+            exported = rt.engine.export_held(srid, device=True)
+        if exported is None:
+            return Response.error(500, "prefill KV export failed")
+        tokens, k, v = exported
+        t0 = time.monotonic()
+        meta = {
+            "service_request_id": srid,
+            "model": live.model,
+            "tokens": tokens,
+            "prompt_len": len(live.req.token_ids),
+            "sampling": live.sampling.to_json(),
+            "stream": live.stream,
+        }
+        ok, dlive, first_out, drt = peer.adopt_migrated(meta, k, v)
+        if not ok:
+            # Nothing actually transferred — don't pollute the gbps gauge.
+            logger.warning("direct kv migration to %s refused; decoding "
+                           "locally", peer.name)
+            k = np.asarray(jax.device_get(k))
+            v = np.asarray(jax.device_get(v))
+            return self._local_decode_fallback(live, tokens, k, v)
+        try:
+            jax.block_until_ready(drt.engine.kv[0])
+        except Exception:  # noqa: BLE001 — engine may be stepping
+            pass
+        self.kv_migration_bytes += 2 * int(k.nbytes)
+        self.kv_migration_seconds += time.monotonic() - t0
+        self.kv_migration_direct += 1
+        if dlive.stream_to_service:
+            # Topology 2 — judged by the DECODE side's actual mode (its
+            # engine loop pushes to the service): a topology mismatch
+            # between co-hosted workers must not strand outputs in a
+            # queue nobody drains.
+            return Response.json({"status": "accepted",
+                                  "service_request_id": srid})
+        # Relay topology: consume the peer's live queue in-process (the
+        # wire path would re-assemble the same outputs from its SSE).
+        if live.stream:
+            asm = (ChatStreamAssembler if live.is_chat
+                   else CompletionStreamAssembler)(
+                srid, live.model, live.include_usage)
+
+            def gen() -> Iterator[bytes]:
+                for frame in asm.on_output(first_out):
+                    yield frame
+                for ro in peer._iter_live_outputs(drt, dlive, srid):
+                    for frame in asm.on_output(ro):
+                        yield frame
+            return Response.sse(gen())
+        coll = ResponseCollector(srid, live.model, live.is_chat)
+        coll.add(first_out)
+        for ro in peer._iter_live_outputs(drt, dlive, srid):
+            coll.add(ro)
+        return Response.json(coll.body())
 
     def _topology2(self) -> bool:
         return self._decode_to_service and bool(self.opts.service_addr)
@@ -1110,31 +1213,17 @@ class Worker:
                 self._stream_sse(new_live, initial=[first_out]))
         return self._collect_full(new_live, initial=[first_out])
 
-    def _serve_kv_import(self, req: Request) -> Response:
-        """Decode-side adoption of a migrated sequence."""
-        nl = req.body.find(b"\n")
-        if nl < 0:
-            return Response.error(400, "missing meta line")
-        try:
-            meta = json.loads(req.body[:nl].decode("utf-8"))
-        except (ValueError, UnicodeDecodeError) as e:
-            return Response.error(400, f"bad meta: {e}")
-        import ml_dtypes
-        dtype = (ml_dtypes.bfloat16 if meta["dtype"] == "bfloat16"
-                 else np.dtype(meta["dtype"]))
-        shape = tuple(meta["shape"])
-        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
-        blob = req.body[nl + 1:]
-        if len(blob) != 2 * nbytes:
-            return Response.error(400, f"payload size mismatch: "
-                                       f"{len(blob)} != {2 * nbytes}")
-        k = np.frombuffer(blob[:nbytes], dtype=dtype).reshape(shape)
-        v = np.frombuffer(blob[nbytes:], dtype=dtype).reshape(shape)
+    def adopt_migrated(self, meta: Dict[str, Any], k, v):
+        """Decode-side adoption of a migrated sequence (shared by the HTTP
+        wire handler and the same-process device-to-device path — ``k``/``v``
+        may be host numpy or device arrays).
 
+        Returns (ok, live, first_out, runtime); runtime is None when the
+        target model is asleep."""
         model = meta.get("model", self.opts.model)
         rt = self.runtimes.get(model) or self.primary_runtime()
         if rt.engine is None:
-            return Response.error(503, f"model {model} asleep")
+            return False, None, None, None
         tokens = list(meta["tokens"])
         srid = meta["service_request_id"]
         sampling = SamplingParams.from_json(meta.get("sampling"))
@@ -1170,8 +1259,38 @@ class Worker:
                 self._service_push_buffer.append(first_out)
         if not ok:
             self._drop_live(srid)
-            return Response.error(503, "no capacity on decode instance")
+            return False, None, None, rt
         self._work_event.set()
+        return True, live, first_out, rt
+
+    def _serve_kv_import(self, req: Request) -> Response:
+        """Decode-side adoption of a migrated sequence (HTTP wire path)."""
+        nl = req.body.find(b"\n")
+        if nl < 0:
+            return Response.error(400, "missing meta line")
+        try:
+            meta = json.loads(req.body[:nl].decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            return Response.error(400, f"bad meta: {e}")
+        import ml_dtypes
+        dtype = (ml_dtypes.bfloat16 if meta["dtype"] == "bfloat16"
+                 else np.dtype(meta["dtype"]))
+        shape = tuple(meta["shape"])
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        blob = req.body[nl + 1:]
+        if len(blob) != 2 * nbytes:
+            return Response.error(400, f"payload size mismatch: "
+                                       f"{len(blob)} != {2 * nbytes}")
+        k = np.frombuffer(blob[:nbytes], dtype=dtype).reshape(shape)
+        v = np.frombuffer(blob[nbytes:], dtype=dtype).reshape(shape)
+
+        ok, live, first_out, rt = self.adopt_migrated(meta, k, v)
+        if rt is None:
+            return Response.error(503,
+                                  f"model {meta.get('model')!r} asleep")
+        if not ok:
+            return Response.error(503, "no capacity on decode instance")
+        srid = meta["service_request_id"]
         if live.stream_to_service:
             return Response.json({"status": "accepted",
                                   "service_request_id": srid})
@@ -1180,25 +1299,35 @@ class Worker:
         # prefill worker on this response.
         def gen() -> Iterator[bytes]:
             yield sse_frame(first_out.to_json())
-            while True:
-                try:
-                    out = live.q.get(timeout=self.opts.request_timeout_s)
-                except queue.Empty:
-                    with self._engine_lock:
-                        if rt.engine is not None:
-                            rt.engine.cancel(srid)
-                    self._drop_live(srid)
-                    return
-                if out is None:
-                    return
-                ro = self._to_request_output(live, out)
-                if ro is None:
-                    continue
+            for ro in self._iter_live_outputs(rt, live, srid):
                 yield sse_frame(ro.to_json())
                 if ro.finished:
                     yield SSE_DONE
                     return
         return Response.sse(gen())
+
+    def _iter_live_outputs(self, rt: ModelRuntime, live: "_LiveRequest",
+                           srid: str) -> Iterator[RequestOutput]:
+        """Drain a live request's engine outputs as RequestOutputs,
+        cancelling on timeout. Shared by the wire and same-process
+        migration response paths."""
+        while True:
+            try:
+                out = live.q.get(timeout=self.opts.request_timeout_s)
+            except queue.Empty:
+                with self._engine_lock:
+                    if rt.engine is not None:
+                        rt.engine.cancel(srid)
+                self._drop_live(srid)
+                return
+            if out is None:
+                return
+            ro = self._to_request_output(live, out)
+            if ro is None:
+                continue
+            yield ro
+            if ro.finished:
+                return
 
     # ------------------------------------------------------------------
     # Heartbeats
