@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# curl walkthrough of the OpenAI front door (reference:
+# examples/curl_http_client.sh). Start a cluster first — see README "Run
+# it" — then:   ADDR=127.0.0.1:9888 MODEL=tiny ./examples/curl_client.sh
+set -euo pipefail
+ADDR="${ADDR:-127.0.0.1:9888}"
+MODEL="${MODEL:-tiny}"
+
+echo "== models"
+curl -sf "http://${ADDR}/v1/models"; echo
+
+echo "== chat (non-streaming)"
+curl -sf "http://${ADDR}/v1/chat/completions" \
+  -H 'Content-Type: application/json' \
+  -d "{\"model\": \"${MODEL}\", \"max_tokens\": 24,
+       \"messages\": [{\"role\": \"user\", \"content\": \"hi\"}]}"; echo
+
+echo "== chat (streaming SSE; -N disables buffering)"
+curl -sfN "http://${ADDR}/v1/chat/completions" \
+  -H 'Content-Type: application/json' \
+  -d "{\"model\": \"${MODEL}\", \"stream\": true, \"max_tokens\": 24,
+       \"messages\": [{\"role\": \"user\", \"content\": \"count to five\"}]}"
+
+echo "== completion with sampling controls"
+curl -sf "http://${ADDR}/v1/completions" \
+  -H 'Content-Type: application/json' \
+  -d "{\"model\": \"${MODEL}\", \"prompt\": \"once upon a time\",
+       \"max_tokens\": 32, \"temperature\": 0.8, \"top_p\": 0.95,
+       \"stop\": [\"\\n\\n\"], \"presence_penalty\": 0.5}"; echo
+
+echo "== embeddings"
+curl -sf "http://${ADDR}/v1/embeddings" \
+  -H 'Content-Type: application/json' \
+  -d "{\"model\": \"${MODEL}\", \"input\": \"embed me\"}" | head -c 300; echo
+
+echo "== service metrics"
+curl -sf "http://${ADDR}/metrics" | head -20

@@ -1,0 +1,122 @@
+#!/usr/bin/env python3
+"""Standalone OpenAI-protocol client for xllm-service-tpu.
+
+Stdlib-only (no SDK needed) demonstration of every front-door call the
+service exposes — the counterpart of the reference's
+examples/http_client_test.cpp:22-159 + curl_http_client.sh:
+
+    # non-streaming chat
+    python examples/http_client.py --addr 127.0.0.1:9888 --model tiny \
+        chat "hello there"
+    # streaming chat (prints deltas as they arrive)
+    python examples/http_client.py --stream chat "tell me a story"
+    # text completion / embeddings / model list
+    python examples/http_client.py complete "once upon a time"
+    python examples/http_client.py embed "embed this"
+    python examples/http_client.py models
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _post(addr: str, path: str, body: dict, stream: bool = False):
+    req = urllib.request.Request(
+        f"http://{addr}{path}", data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=600)
+    if not stream:
+        return json.load(resp)
+    return resp
+
+
+def _iter_sse(resp):
+    """Yield each SSE `data:` payload; stop at [DONE]."""
+    for raw in resp:
+        line = raw.decode("utf-8").strip()
+        if not line.startswith("data:"):
+            continue
+        payload = line[len("data:"):].strip()
+        if payload == "[DONE]":
+            return
+        yield json.loads(payload)
+
+
+def cmd_chat(args) -> int:
+    body = {
+        "model": args.model,
+        "messages": [{"role": "user", "content": args.text}],
+        "max_tokens": args.max_tokens,
+        "temperature": args.temperature,
+        "stream": args.stream,
+    }
+    if args.stream:
+        resp = _post(args.addr, "/v1/chat/completions", body, stream=True)
+        for chunk in _iter_sse(resp):
+            delta = chunk["choices"][0]["delta"].get("content", "")
+            print(delta, end="", flush=True)
+        print()
+        return 0
+    out = _post(args.addr, "/v1/chat/completions", body)
+    print(out["choices"][0]["message"]["content"])
+    print(f"-- usage: {out['usage']}", file=sys.stderr)
+    return 0
+
+
+def cmd_complete(args) -> int:
+    body = {
+        "model": args.model, "prompt": args.text,
+        "max_tokens": args.max_tokens, "temperature": args.temperature,
+        "stream": args.stream,
+    }
+    if args.stream:
+        resp = _post(args.addr, "/v1/completions", body, stream=True)
+        for chunk in _iter_sse(resp):
+            print(chunk["choices"][0].get("text", ""), end="", flush=True)
+        print()
+        return 0
+    out = _post(args.addr, "/v1/completions", body)
+    print(out["choices"][0]["text"])
+    print(f"-- usage: {out['usage']}", file=sys.stderr)
+    return 0
+
+
+def cmd_embed(args) -> int:
+    out = _post(args.addr, "/v1/embeddings",
+                {"model": args.model, "input": args.text})
+    vec = out["data"][0]["embedding"]
+    print(f"dim={len(vec)} head={[round(x, 4) for x in vec[:8]]}")
+    return 0
+
+
+def cmd_models(args) -> int:
+    with urllib.request.urlopen(f"http://{args.addr}/v1/models",
+                                timeout=30) as r:
+        out = json.load(r)
+    for m in out.get("data", []):
+        print(m["id"])
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--addr", default="127.0.0.1:9888",
+                   help="service http host:port")
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--max-tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.7)
+    p.add_argument("--stream", action="store_true")
+    p.add_argument("command", choices=["chat", "complete", "embed",
+                                       "models"])
+    p.add_argument("text", nargs="?", default="hello")
+    args = p.parse_args(argv)
+    return {"chat": cmd_chat, "complete": cmd_complete,
+            "embed": cmd_embed, "models": cmd_models}[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,3 +113,85 @@ class TestRemoteStore:
             client.close()
         finally:
             server.stop()
+
+
+class TestEtcdStore:
+    """EtcdStore contract against the etcd v3 JSON-gateway wire — served
+    by MockEtcdServer always, and by a real etcd when XLLM_ETCD_ADDR is
+    set (same assertions either way)."""
+
+    @pytest.fixture(params=["mock", "real"])
+    def etcd(self, request):
+        import os
+        from xllm_service_tpu.service.etcd_store import (
+            EtcdStore, MockEtcdServer)
+        if request.param == "real":
+            addr = os.environ.get("XLLM_ETCD_ADDR")
+            if not addr:
+                pytest.skip("XLLM_ETCD_ADDR not set")
+            client = EtcdStore(addr)
+            client.delete_prefix("XLLMTEST:")
+            yield client
+            client.delete_prefix("XLLMTEST:")
+            client.close()
+        else:
+            server = MockEtcdServer().start()
+            client = EtcdStore(server.address)
+            yield client
+            client.close()
+            server.stop()
+
+    def test_put_get_delete_prefix(self, etcd):
+        etcd.put("XLLMTEST:PREFILL:a", "1")
+        etcd.put("XLLMTEST:PREFILL:b", "2")
+        etcd.put("XLLMTEST:DECODE:c", "3")
+        assert etcd.get("XLLMTEST:PREFILL:a") == "1"
+        assert etcd.get("XLLMTEST:missing") is None
+        assert etcd.get_prefix("XLLMTEST:PREFILL:") == {
+            "XLLMTEST:PREFILL:a": "1", "XLLMTEST:PREFILL:b": "2"}
+        assert etcd.delete("XLLMTEST:PREFILL:a")
+        assert not etcd.delete("XLLMTEST:PREFILL:a")
+        assert etcd.delete_prefix("XLLMTEST:") == 2
+
+    def test_compare_create_election(self, etcd):
+        key = "XLLMTEST:SERVICE:MASTER"
+        assert etcd.compare_create(key, "me")
+        assert not etcd.compare_create(key, "other")
+        assert etcd.get(key) == "me"
+        etcd.delete(key)
+
+    def test_lease_roundtrip(self, etcd):
+        lid = etcd.lease_grant(5.0)
+        etcd.put("XLLMTEST:L", "v", lid)
+        assert etcd.get("XLLMTEST:L") == "v"
+        assert etcd.lease_keepalive(lid)
+        etcd.lease_revoke(lid)
+        assert etcd.get("XLLMTEST:L") is None
+        assert not etcd.lease_keepalive(lid)
+
+    def test_watch_put_and_delete(self, etcd):
+        got = []
+        evt = threading.Event()
+
+        def cb(ev):
+            got.append(ev)
+            evt.set()
+
+        wid = etcd.add_watch("XLLMTEST:W:", cb)
+        time.sleep(0.3)              # let the watch stream establish
+        etcd.put("XLLMTEST:W:1", "z")
+        assert evt.wait(5.0)
+        evt.clear()
+        etcd.delete("XLLMTEST:W:1")
+        assert evt.wait(5.0)
+        etcd.cancel_watch(wid)
+        types = [(t, k) for t, k, _ in got]
+        assert ("PUT", "XLLMTEST:W:1") in types
+        assert ("DELETE", "XLLMTEST:W:1") in types
+
+    def test_range_end_convention(self):
+        import base64
+        from xllm_service_tpu.service.etcd_store import range_end_for_prefix
+        assert base64.b64decode(range_end_for_prefix("A:")) == b"A;"
+        assert base64.b64decode(range_end_for_prefix("XLLM:")) == b"XLLM;"
+        assert base64.b64decode(range_end_for_prefix("")) == b"\0"

@@ -1,0 +1,75 @@
+"""Wire-contract discipline: the derived schema must match the pinned
+golden (proto-diff enforcement), round-trips must conform, and version
+negotiation must tolerate newer peers."""
+
+import json
+import os
+
+from xllm_service_tpu.utils import wire
+from xllm_service_tpu.utils.types import (
+    RequestOutput, SamplingParams, SequenceOutput, Status, Usage)
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "wire_contract_v1.json")
+
+
+def test_contract_matches_golden():
+    """Renaming/retyping/removing any wire field fails here until the
+    golden is regenerated AND WIRE_VERSION is bumped — the same
+    discipline a checked-in .proto enforces by diff.
+
+    Regenerate (after bumping wire.WIRE_VERSION for breaking changes):
+        python -c "from xllm_service_tpu.utils.wire import contract_json;
+                   open('tests/wire_contract_v1.json','w')
+                   .write(contract_json() + '\\n')"
+    """
+    with open(GOLDEN, encoding="utf-8") as f:
+        golden = json.load(f)
+    current = wire.describe()
+    assert current == golden, (
+        "wire contract drifted from tests/wire_contract_v1.json — "
+        "if intentional, bump WIRE_VERSION for breaking changes and "
+        "regenerate the golden (see docstring)")
+
+
+def test_every_registered_message_roundtrips_conformant():
+    """Each registry dataclass's to_json output validates against its own
+    schema, and from_json(to_json(x)) is stable."""
+    samples = {
+        "Status": Status(),
+        "Usage": Usage(prompt_tokens=3, completion_tokens=2),
+        "SequenceOutput": SequenceOutput(index=0, text="hi",
+                                         token_ids=[1, 2]),
+        "RequestOutput": RequestOutput(request_id="r", finished=True),
+        "SamplingParams": SamplingParams(max_tokens=4, stop=["x"]),
+    }
+    for name, obj in samples.items():
+        payload = obj.to_json()
+        assert wire.validate(name, payload) == [], name
+        again = type(obj).from_json(payload)
+        assert again.to_json() == payload, name
+
+
+def test_validate_flags_type_mismatch():
+    bad = {"request_id": 42, "finished": "yes"}
+    problems = wire.validate("RequestOutput", bad)
+    assert any("request_id" in p for p in problems)
+    assert any("finished" in p for p in problems)
+    assert wire.validate("NoSuchMessage", {}) != []
+
+
+def test_unknown_fields_ignored_and_newer_peer_accepted():
+    """Compat rules 1-2: a newer peer's extra fields and version stamp
+    must decode cleanly."""
+    payload = wire.stamp(RequestOutput(request_id="r").to_json())
+    payload["brand_new_field_v9"] = {"x": 1}
+    payload["v"] = wire.WIRE_VERSION + 7
+    v = wire.check_version(payload, "test_msg")
+    assert v == wire.WIRE_VERSION + 7
+    out = RequestOutput.from_json(payload)
+    assert out.request_id == "r"
+    # Unknown fields are not validation problems either.
+    assert wire.validate("RequestOutput", payload) == []
+
+
+def test_stamp_sets_current_version():
+    assert wire.stamp({})["v"] == wire.WIRE_VERSION

@@ -52,6 +52,7 @@ from xllm_service_tpu.service.response_handler import (
     ChatStreamAssembler, CompletionStreamAssembler, ResponseCollector,
     sse_frame, SSE_DONE)
 from xllm_service_tpu.utils.misc import short_uuid
+from xllm_service_tpu.utils.wire import check_version, stamp
 from xllm_service_tpu.utils.types import (
     FinishReason, LogProb, RequestOutput, SamplingParams, SequenceOutput,
     Status, StatusCode, Usage, parse_openai_sampling)
@@ -437,7 +438,7 @@ class Worker:
         self._lease_id = self.store.lease_grant(self.opts.lease_ttl_s)
         self.store.put_json(
             instance_prefix(self.instance_type.value) + self.name,
-            meta.to_json(), self._lease_id)
+            stamp(meta.to_json()), self._lease_id)
 
     def primary_runtime(self) -> ModelRuntime:
         return self.runtimes[self.opts.model]
@@ -1017,7 +1018,7 @@ class Worker:
             "dtype": str(k.dtype),
             "stream": live.stream,
         }
-        payload = (json.dumps(meta).encode("utf-8") + b"\n"
+        payload = (json.dumps(stamp(meta)).encode("utf-8") + b"\n"
                    + k.tobytes() + v.tobytes())
         from xllm_service_tpu.service.httpd import http_stream
         head = b""
@@ -1119,7 +1120,7 @@ class Worker:
             return
         try:
             http_json("POST", self.opts.service_addr, "/rpc/generations",
-                      {"outputs": [o.to_json() for o in outs]},
+                      stamp({"outputs": [o.to_json() for o in outs]}),
                       timeout=30.0)
         except Exception as e:  # noqa: BLE001
             logger.warning("generations push failed: %s", e)
@@ -1272,6 +1273,7 @@ class Worker:
             meta = json.loads(req.body[:nl].decode("utf-8"))
         except (ValueError, UnicodeDecodeError) as e:
             return Response.error(400, f"bad meta: {e}")
+        check_version(meta, "kv_import")
         import ml_dtypes
         dtype = (ml_dtypes.bfloat16 if meta["dtype"] == "bfloat16"
                  else np.dtype(meta["dtype"]))
@@ -1377,7 +1379,7 @@ class Worker:
             model_states=model_states)
         self._latency = LatencyMetrics()
         http_json("POST", self.opts.service_addr, "/rpc/heartbeat",
-                  hb.to_json(), timeout=10.0)
+                  stamp(hb.to_json()), timeout=10.0)
 
     def heartbeat_once(self) -> None:
         """Test helper: one synchronous heartbeat."""

@@ -232,9 +232,13 @@ def _q(s: str) -> str:
 
 
 def connect_store(addr: str) -> CoordinationStore:
-    """'' → fresh in-process store; 'host:port' → RemoteStore."""
+    """'' → fresh in-process store; 'etcd://host:port' → real etcd v3
+    (quorum deployments); 'host:port' → RemoteStore (StoreServer)."""
     if not addr:
         return InMemoryStore()
+    if addr.startswith("etcd://"):
+        from xllm_service_tpu.service.etcd_store import EtcdStore
+        return EtcdStore(addr[len("etcd://"):])
     return RemoteStore(addr)
 
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Dict
 
+from xllm_service_tpu.utils.wire import check_version
 from xllm_service_tpu.config import ServiceOptions
 from xllm_service_tpu.service.httpd import Request, Response, Router
 from xllm_service_tpu.service.instance_types import Heartbeat
@@ -41,7 +42,9 @@ class RpcService:
 
     # -- Heartbeat (rpc_service/service.cpp:114-121) ----------------------
     def heartbeat(self, req: Request) -> Response:
-        hb = Heartbeat.from_json(req.json())
+        body = req.json()
+        check_version(body, "Heartbeat")
+        hb = Heartbeat.from_json(body)
         if not hb.name:
             return Response.error(400, "heartbeat missing name")
         registered = self.scheduler.handle_instance_heartbeat(hb)
@@ -50,6 +53,7 @@ class RpcService:
     # -- Generations fan-in (rpc_service/service.cpp:149-213) -------------
     def generations(self, req: Request) -> Response:
         body = req.json()
+        check_version(body, "generations")
         for d in body.get("outputs", []):
             out = RequestOutput.from_json(d)
             self.scheduler.handle_generation(out)
