@@ -1,0 +1,33 @@
+import time, jax, jax.numpy as jnp, numpy as np
+import xllm_service_tpu.runtime.engine as E
+from xllm_service_tpu.config import EngineConfig, ModelConfig
+from xllm_service_tpu.utils.types import SamplingParams
+
+cfg = ModelConfig.llama3_1b()
+ecfg = EngineConfig(page_size=64, num_pages=1024, max_model_len=1024,
+                    max_batch_size=64, max_prefill_tokens=4096,
+                    prefill_buckets=(128,), decode_steps=64)
+t0 = time.perf_counter(); eng = E.Engine(cfg, ecfg, seed=0)
+print(f"init {time.perf_counter()-t0:.1f}s")
+t0 = time.perf_counter(); eng.warmup(); print(f"warmup {time.perf_counter()-t0:.1f}s")
+
+sp = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+t0 = time.perf_counter()
+for i in range(64):
+    eng.add_request(E.EngineRequest(request_id=f"r{i}", token_ids=list(range(1, 129)), sampling=sp))
+print(f"add_requests {time.perf_counter()-t0:.2f}s")
+
+orig_run = eng._run_prefill
+def timed_run(batch):
+    t = time.perf_counter()
+    out = orig_run(batch)
+    print(f"  _run_prefill batch={len(batch)}: {time.perf_counter()-t:.2f}s")
+    return out
+eng._run_prefill = timed_run
+
+while eng.waiting:
+    t = time.perf_counter()
+    eng.step()
+    print(f"step total {time.perf_counter()-t:.2f}s")
+# one decode burst
+t = time.perf_counter(); eng.step(); print(f"decode burst {time.perf_counter()-t:.2f}s")

@@ -327,6 +327,20 @@ def test_online_preempts_offline_mid_chunked_prefill():
     assert eng.num_preemptions >= 1
 
 
+def test_finished_request_slot_sampling_resets():
+    """A finished top-p request must not leave its sampling params in the
+    slot array — later greedy-only batches would pay the full-vocab
+    filter sort every step (review finding)."""
+    eng = _tiny_engine()
+    eng.add_request(EngineRequest(
+        "p", [1, 2, 3], sampling=SamplingParams(
+            max_tokens=2, temperature=1.0, top_p=0.5)))
+    _collect(eng)
+    assert all(sp.top_p == 1.0 and sp.temperature in (0.0, 1.0)
+               for sp in eng._slot_sampling)
+    assert all(sp.top_p == 1.0 for sp in eng._slot_sampling)
+
+
 def test_cancel_request():
     eng = _tiny_engine()
     eng.add_request(EngineRequest(

@@ -146,6 +146,22 @@ class ModelConfig:
                    attention_bias=True, max_position_embeddings=32768)
 
     @classmethod
+    def qwen25_7b(cls) -> "ModelConfig":
+        # Qwen2.5-7B: identical wiring to Qwen2-7B (per-checkpoint quirks
+        # come from config.json when loading from a model dir).
+        return dataclasses.replace(cls.qwen2_7b(), name="qwen2.5-7b")
+
+    @classmethod
+    def mixtral_8x7b(cls) -> "ModelConfig":
+        # Mixtral-8x7B: the expert-parallel flagship (parallel/expert.py
+        # top-k dispatch; experts shard over the mesh's ep axis).
+        return cls(name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
+                   intermediate_size=14336, num_layers=32, num_heads=32,
+                   num_kv_heads=8, rope_theta=1000000.0,
+                   max_position_embeddings=32768, num_experts=8,
+                   num_experts_per_tok=2)
+
+    @classmethod
     def tiny(cls, vocab_size: int = 256, num_experts: int = 0) -> "ModelConfig":
         """Small config for CPU tests."""
         return cls(name="tiny", vocab_size=vocab_size, hidden_size=64,

@@ -395,6 +395,11 @@ class Engine:
     def _release_seq_slot(self, seq: Sequence) -> None:
         if seq.slot >= 0:
             self._slots[seq.slot] = None
+            # Reset the slot's sampling params: a finished top-p request
+            # must not keep the full-vocab sampling filter (a ~2 ms/step
+            # vocab sort) enabled for later greedy-only batches.
+            self._slot_sampling[seq.slot] = SamplingParams()
+            self._slot_st = None
             seq.slot = -1
 
     def _finish_seq(self, seq: Sequence, reason: FinishReason) -> None:
@@ -962,13 +967,22 @@ class Engine:
             for T in buckets:
                 if (B - 1) + T > max(budget, T):
                     continue
-                mp = 1 << max(self._pages_needed(T) - 1, 0).bit_length()
+                # A fresh T-token window owns pages covering T+1 tokens
+                # (the sampled token's KV slot), so the serving table
+                # width is pow2(pages_needed(T+1)) — one wider than
+                # pages_needed(T) exactly when T is page-aligned. Compile
+                # both or the wider one compiles mid-serving (measured:
+                # a ~15 s TTFT spike inside the round-2 bench).
+                mps = {1 << max(self._pages_needed(T) - 1, 0).bit_length(),
+                       1 << max(self._pages_needed(T + 1) - 1,
+                                0).bit_length()}
                 st = self._sampling_tensors([], B)
-                _, _, _, _, self.kv = self._jit_prefill(
-                    self.params, jnp.zeros((B, T), jnp.int32),
-                    jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
-                    self.kv, jnp.zeros((B, mp), jnp.int32), st, key,
-                    None, None)
+                for mp in sorted(mps):
+                    _, _, _, _, self.kv = self._jit_prefill(
+                        self.params, jnp.zeros((B, T), jnp.int32),
+                        jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+                        self.kv, jnp.zeros((B, mp), jnp.int32), st, key,
+                        None, None)
                 if not extended:
                     break
             if not extended:

@@ -91,6 +91,8 @@ _MODEL_REGISTRY = {
     "llama3-1b": ModelConfig.llama3_1b,
     "llama3-8b": ModelConfig.llama3_8b,
     "qwen2-7b": ModelConfig.qwen2_7b,
+    "qwen2.5-7b": ModelConfig.qwen25_7b,
+    "mixtral-8x7b": ModelConfig.mixtral_8x7b,
     "tiny-moe": lambda: ModelConfig.tiny(num_experts=4),
 }
 
