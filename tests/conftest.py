@@ -14,6 +14,10 @@ import sys
 # plugin and rewrite jax_platforms at interpreter start, so we also override
 # the config after import (safe because no backend has been initialized yet).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Deterministic lock-order checking (utils/locks.py): every lock in the
+# codebase is rank-ordered; inversions raise instead of deadlocking
+# rarely. Must be set before any xllm_service_tpu import constructs locks.
+os.environ.setdefault("XLLM_LOCK_CHECK", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -34,3 +38,26 @@ def cpu_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual cpu devices, got {devs}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _no_swallowed_lock_violations(request):
+    """LockOrderViolation subclasses AssertionError, and several callback
+    paths wrap client code in broad `except Exception` — a detected
+    inversion could be swallowed there. The violation counter makes it
+    fail the test anyway. Tests that provoke violations on purpose mark
+    themselves ``expected_lock_violations``."""
+    from xllm_service_tpu.utils import locks
+    before = locks.violation_count()
+    yield
+    if request.node.get_closest_marker("expected_lock_violations"):
+        return
+    new = locks.violations()[before:]
+    assert not new, f"lock-order violations were raised (and possibly " \
+                    f"swallowed) during this test: {new}"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "expected_lock_violations: test provokes lock-order "
+        "violations on purpose (skips the swallowed-violation check)")

@@ -30,6 +30,7 @@ import logging
 import os
 import queue
 import threading
+
 import time
 import weakref
 from typing import Any, Dict, Iterator, List, Optional
@@ -56,6 +57,7 @@ from xllm_service_tpu.utils.wire import check_version, stamp
 from xllm_service_tpu.utils.types import (
     FinishReason, LogProb, RequestOutput, SamplingParams, SequenceOutput,
     Status, StatusCode, Usage, parse_openai_sampling)
+from xllm_service_tpu.utils.locks import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -322,7 +324,7 @@ class Worker:
 
         self._live: Dict[str, _LiveRequest] = {}        # engine rid → live
         self._live_srid: Dict[str, _LiveRequest] = {}   # srid → live
-        self._live_lock = threading.Lock()
+        self._live_lock = make_lock("worker.live", 10)
         # Outputs queued for the service fan-in ahead of the next engine
         # dispatch (ordering: appended under the engine lock, drained by
         # the engine-loop thread before it pushes step outputs — no network
@@ -331,7 +333,7 @@ class Worker:
         # Engines are single-threaded; HTTP threads and the loop thread
         # serialize on this (submission is cheap, steps hold it for one
         # iteration).
-        self._engine_lock = threading.Lock()
+        self._engine_lock = make_lock("worker.engine", 20)
         self._work_event = threading.Event()
         self._stop = threading.Event()
         self._latency = LatencyMetrics()
@@ -360,7 +362,7 @@ class Worker:
         self._embed_fns: Dict[str, Any] = {}
         # EPD vision encoder (lazy; eager for dedicated ENCODE workers).
         self._vision = None
-        self._vision_lock = threading.Lock()
+        self._vision_lock = make_lock("worker.vision", 90)
         if opts.instance_type == InstanceType.ENCODE:
             self._get_vision()
         # KV-migration throughput book (BASELINE.md north-star metric).

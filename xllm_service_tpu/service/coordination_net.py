@@ -11,12 +11,15 @@ see the same PUT/DELETE event stream in order.
 from __future__ import annotations
 
 import threading
+
+
 from typing import Dict, Optional
 
 from xllm_service_tpu.service.coordination import (
     CoordinationStore, InMemoryStore, WatchCallback)
 from xllm_service_tpu.service.httpd import (
     HttpServer, Request, Response, Router, http_json)
+from xllm_service_tpu.utils.locks import make_lock
 
 
 class StoreServer:
@@ -117,7 +120,7 @@ class RemoteStore(CoordinationStore):
         self.timeout = timeout
         self._watches: Dict[int, threading.Event] = {}
         self._next_watch = 1
-        self._lock = threading.Lock()
+        self._lock = make_lock("coordination_net", 60)
 
     def _call(self, method: str, path: str, obj=None):
         status, resp = http_json(method, self.address, path, obj,

@@ -27,10 +27,13 @@ import http.client
 import json
 import logging
 import threading
+
+
 from typing import Dict, Optional, Tuple
 
 from xllm_service_tpu.service.coordination import (
     CoordinationStore, InMemoryStore, WatchCallback)
+from xllm_service_tpu.utils.locks import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -70,7 +73,7 @@ class EtcdStore(CoordinationStore):
                                        Optional[http.client.HTTPConnection]]] \
             = {}
         self._watch_seq = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("etcd.watches", 60)
 
     # -- plumbing ----------------------------------------------------------
     def _call(self, path: str, body: Dict) -> Dict:

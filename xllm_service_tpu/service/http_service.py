@@ -25,6 +25,8 @@ import json
 import logging
 import queue
 import threading
+
+
 from typing import Any, Dict, Iterator, List, Optional
 
 from xllm_service_tpu.config import ServiceOptions
@@ -38,6 +40,7 @@ from xllm_service_tpu.utils.misc import short_uuid
 from xllm_service_tpu.utils.types import (
     FinishReason, Request as SchedRequest, RequestOutput,
     parse_openai_sampling)
+from xllm_service_tpu.utils.locks import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -50,7 +53,7 @@ class HttpService:
                                     opts.enable_request_trace)
         self._num_requests = 0
         self._num_errors = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("http.stats", 90)
 
     def install(self, router: Router) -> None:
         router.route("GET", "/hello",

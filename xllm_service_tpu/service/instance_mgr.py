@@ -29,6 +29,8 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
+
+
 import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -40,6 +42,7 @@ from xllm_service_tpu.service.instance_types import (
     Heartbeat, InstanceMetaInfo, LatencyMetrics, LoadMetrics, RequestMetrics,
     RequestPhase)
 from xllm_service_tpu.service.time_predictor import TimePredictor
+from xllm_service_tpu.utils.locks import make_rlock
 
 logger = logging.getLogger(__name__)
 
@@ -97,7 +100,7 @@ class InstanceMgr:
         # (fork_master_and_sleep, instance_mgr.cpp:229-260).
         self.serverless_models = list(serverless_models or [])
 
-        self._lock = threading.RLock()
+        self._lock = make_rlock("instance_mgr", 30)
         self._instances: Dict[str, InstanceState] = {}
         self._pending: Dict[str, InstanceMetaInfo] = {}
         self._removed: Set[str] = set()

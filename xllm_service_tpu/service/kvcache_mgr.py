@@ -19,11 +19,14 @@ page hashes, so service-side match and worker-side reuse agree exactly).
 from __future__ import annotations
 
 import threading
+
+
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from xllm_service_tpu.service.coordination import (
     KEY_CACHE, CoordinationStore)
 from xllm_service_tpu.utils.hashing import prefix_block_hashes
+from xllm_service_tpu.utils.locks import make_lock
 
 TIER_HBM = "hbm"
 TIER_DRAM = "dram"
@@ -59,7 +62,7 @@ class GlobalKVCacheMgr:
         self.block_size = block_size
         self.seed = seed
         self.is_master = is_master
-        self._lock = threading.Lock()
+        self._lock = make_lock("kvcache_mgr", 35)
         self._index: Dict[bytes, CacheLocations] = {}
         # Deltas accumulated since the last master upload, keyed by digest:
         # value None → block gone everywhere (delete the store key).

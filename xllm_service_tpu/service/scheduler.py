@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import logging
 import threading
+
+
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -38,6 +40,7 @@ from xllm_service_tpu.service.lb_policy import create_policy
 from xllm_service_tpu.utils.misc import OrderedFanInPools, short_uuid
 from xllm_service_tpu.utils.types import (
     OutputCallback, Request, RequestOutput, Routing, Status, StatusCode)
+from xllm_service_tpu.utils.locks import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -93,7 +96,7 @@ class Scheduler:
                                        self.kvcache_mgr)
 
         self._requests: Dict[str, _TrackedRequest] = {}
-        self._req_lock = threading.Lock()
+        self._req_lock = make_lock("scheduler.req", 10)
         self._pools = OrderedFanInPools(opts.num_output_pools)
 
         self._stop = threading.Event()

@@ -12,8 +12,11 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+
 import time
 from typing import Any, Dict, Optional
+from xllm_service_tpu.utils.locks import make_lock
 
 
 class RequestTracer:
@@ -21,7 +24,7 @@ class RequestTracer:
                  enable: bool = False) -> None:
         self.enable = enable
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracer", 90)
         if enable:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 

@@ -21,7 +21,10 @@ import os
 import struct
 import subprocess
 import threading
+
+
 from typing import List, Optional, Sequence
+from xllm_service_tpu.utils.locks import make_lock
 
 _MASK64 = (1 << 64) - 1
 
@@ -102,7 +105,7 @@ def murmur3_x64_128_py(data: bytes, seed: int = 0) -> bytes:
 # Native library loading (built on demand from csrc/xllm_native.cpp).
 # ---------------------------------------------------------------------------
 
-_native_lock = threading.Lock()
+_native_lock = make_lock("hashing.native", 95)
 _native_lib: Optional[ctypes.CDLL] = None
 _native_tried = False
 

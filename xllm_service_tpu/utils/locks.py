@@ -1,0 +1,140 @@
+"""Lock-order discipline checker — the race-detection subsystem.
+
+The reference project leans on sanitizer builds (TSan) to catch lock
+inversions; this framework's equivalent is deterministic: every lock in
+the codebase carries a NAME and a RANK from the table below, and when
+``XLLM_LOCK_CHECK`` is on (the test suite enables it in conftest.py), a
+thread may only acquire a lock whose rank is STRICTLY GREATER than every
+lock it already holds. Equal-rank nesting is forbidden — it encodes
+"these locks are never held together". Violations raise
+``LockOrderViolation`` immediately and deterministically, instead of
+deadlocking once in a thousand runs.
+
+Rank table (acquire order low → high; a thread's held ranks are strictly
+increasing):
+
+    10  scheduler.req, worker.live      — request registries
+    20  worker.engine                   — engine step/submit
+    30  instance_mgr                    — instance books (re-entrant)
+    35  kvcache_mgr                     — global prefix index
+    50  (reserved: coordination store — uses a Condition-wrapped RLock,
+         checked by its own single-class discipline, see coordination.py)
+    60  coordination_net, etcd.watches  — store transports
+    90  leaves: tracer, http stats, fan-in pools, worker.vision
+    91  misc.counter                    — may be bumped under any leaf
+    95  hashing.native                  — innermost (C call guard)
+
+Production (env unset) pays zero overhead: ``make_lock`` returns plain
+``threading.Lock``/``RLock``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Tuple, Union
+
+
+def enabled() -> bool:
+    return os.environ.get("XLLM_LOCK_CHECK", "").strip() in (
+        "1", "true", "yes")
+
+
+class LockOrderViolation(AssertionError):
+    pass
+
+
+# Raised violations also count here: worker/callback paths wrap client
+# code in broad `except Exception` handlers that would otherwise swallow
+# the signal — the test harness asserts this counter stays at zero
+# (tests/conftest.py), so a swallowed inversion still fails the run.
+_violations: List[str] = []
+
+
+def violation_count() -> int:
+    return len(_violations)
+
+
+def violations() -> List[str]:
+    return list(_violations)
+
+
+_tls = threading.local()
+
+
+def _held() -> List[Tuple[str, int]]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+class CheckedLock:
+    """Lock wrapper enforcing the global rank order (see module doc)."""
+
+    def __init__(self, name: str, rank: int, reentrant: bool = False):
+        self.name = name
+        self.rank = rank
+        self._reentrant = reentrant
+        self._lock: Union[threading.Lock, threading.RLock] = (
+            threading.RLock() if reentrant else threading.Lock())
+        self._owner = -1
+        self._depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        if self._reentrant and self._owner == me:
+            self._lock.acquire()
+            self._depth += 1
+            return True
+        held = _held()
+        if held and held[-1][1] >= self.rank:
+            msg = (f"acquiring {self.name!r} (rank {self.rank}) while "
+                   f"holding {held} — lock order must be strictly "
+                   f"increasing (utils/locks.py rank table)")
+            _violations.append(msg)
+            raise LockOrderViolation(msg)
+        ok = (self._lock.acquire(blocking) if timeout < 0
+              else self._lock.acquire(blocking, timeout))
+        if ok:
+            held.append((self.name, self.rank))
+            if self._reentrant:
+                self._owner = me
+                self._depth = 1
+        return ok
+
+    def release(self) -> None:
+        if self._reentrant:
+            self._depth -= 1
+            if self._depth > 0:
+                self._lock.release()
+                return
+            self._owner = -1
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == self.name:
+                del held[i]
+                break
+        self._lock.release()
+
+    def __enter__(self) -> "CheckedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked() if hasattr(self._lock, "locked") \
+            else False
+
+
+def make_lock(name: str, rank: int):
+    """A plain Lock in production; a rank-checked one under
+    XLLM_LOCK_CHECK."""
+    return CheckedLock(name, rank) if enabled() else threading.Lock()
+
+
+def make_rlock(name: str, rank: int):
+    return CheckedLock(name, rank, reentrant=True) if enabled() \
+        else threading.RLock()

@@ -12,7 +12,10 @@ import queue
 import secrets
 import socket
 import threading
+
+
 from typing import Any, Callable, Dict, List, Optional
+from xllm_service_tpu.utils.locks import make_lock
 
 _ALPHABET = "23456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
 
@@ -89,7 +92,7 @@ class OrderedFanInPools:
 
     def __init__(self, num_pools: int = 128) -> None:
         self._pools = [_SerialWorker(f"fanin-{i}") for i in range(num_pools)]
-        self._lock = threading.Lock()
+        self._lock = make_lock("misc.pool", 90)
         self._assignment: Dict[str, int] = {}
         self._next = 0
 
@@ -126,7 +129,7 @@ class OrderedFanInPools:
 class AtomicCounter:
     def __init__(self, start: int = 0) -> None:
         self._v = start
-        self._lock = threading.Lock()
+        self._lock = make_lock("misc.counter", 91)
 
     def inc(self, n: int = 1) -> int:
         with self._lock:
