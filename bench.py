@@ -223,6 +223,9 @@ def _run_bench(tiny: bool, force_cpu: bool = False) -> dict:
             if peak > 0 else None,
             "model_flops_per_token": flops_per_token,
             "chip_peak_flops": peak,
+            # Host/device wall-time attribution per engine phase (dispatch
+            # is async-call time; readback absorbs device compute + RTT).
+            "phases": engine.phase_report(),
             "reference_baseline": "target_tpot=50ms SLO default "
                                   "(no published numbers)",
         },

@@ -27,6 +27,8 @@ scheduler the reference delegates to its out-of-repo NPU engine
 from __future__ import annotations
 
 import bisect
+import collections
+import contextlib
 import dataclasses
 import enum
 import functools
@@ -195,6 +197,51 @@ class Engine:
 
         self.step_count = 0
         self.num_preemptions = 0
+
+        # Per-phase wall-time ledger (seconds) + event counts. On the
+        # tunneled backend the only trustworthy timings are host-side
+        # (docs/PERF_NOTES.md): "dispatch" is the async jit call (tracing
+        # cache lookup + argument transfer), "readback" absorbs device
+        # compute + the host round-trip. A "recompile" count > 0 after
+        # warmup means a shape escaped warmup's coverage.
+        self.phase_times: Dict[str, float] = collections.defaultdict(float)
+        self.phase_counts: Dict[str, int] = collections.defaultdict(int)
+
+    @contextlib.contextmanager
+    def _phase(self, name: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.phase_times[name] += time.monotonic() - t0
+            self.phase_counts[name] += 1
+
+    def _note_recompile(self, name: str, jitted, before: int) -> None:
+        after = self._jit_cache_size(jitted)
+        if after > before:
+            self.phase_counts[name + ".recompile"] += after - before
+            logger.warning("post-warmup compile of %s (cache %d -> %d)",
+                           name, before, after)
+
+    @staticmethod
+    def _jit_cache_size(jitted) -> int:
+        try:
+            return jitted._cache_size()
+        except Exception:  # noqa: BLE001 — diagnostic only
+            return 0
+
+    def phase_report(self) -> Dict[str, Any]:
+        """Compact ms-per-call breakdown for bench output/debugging."""
+        out: Dict[str, Any] = {}
+        for name, total in sorted(self.phase_times.items()):
+            n = max(self.phase_counts.get(name, 1), 1)
+            out[name] = {"total_ms": round(total * 1e3, 1),
+                         "calls": n,
+                         "ms_per_call": round(total * 1e3 / n, 2)}
+        for name, cnt in sorted(self.phase_counts.items()):
+            if name.endswith(".recompile"):
+                out[name] = cnt
+        return out
 
     # ------------------------------------------------------------------
     # Request intake
@@ -431,7 +478,8 @@ class Engine:
         """Run one engine iteration (one prefill batch OR one decode step)."""
         self.step_count += 1
         outs = self._drain_cancelled()
-        batch = self._schedule_prefill()
+        with self._phase("sched"):
+            batch = self._schedule_prefill()
         if batch:
             outs.extend(self._run_prefill(batch))
         elif self.running:
@@ -513,88 +561,98 @@ class Engine:
         windows = [self._next_window(s, s.num_computed) for s in batch]
         if windows[0] > self.ecfg.prefill_buckets[-1]:
             return self._run_prefill_ring(batch[0], windows[0])
-        B = 1 << (len(batch) - 1).bit_length()          # pow2 batch bucket
-        T = self._bucket(max(windows))
-        # Table width must cover both every sequence's pages AND the
-        # padded overlay window [start, start+T) that prefill attention
-        # writes fresh K/V into (ops/attention.overlay_fresh_kv).
-        mp = max(max(len(s.pages) for s in batch),
-                 max(self._pages_needed(s.num_computed + T)
-                     for s in batch))
-        # Deliberately NOT clamped to max_pages_per_seq: a bucketed T can
-        # overshoot a late-start sequence's true window, and the overlay
-        # view must still cover [start, start+T) — extra columns are NULL
-        # pages, masked in attention and dropped by the pool scatter.
-        MP = 1 << max(mp - 1, 0).bit_length()
-        toks = np.zeros((B, T), np.int32)
-        start = np.zeros(B, np.int32)
-        lens = np.zeros(B, np.int32)
-        pt = np.zeros((B, MP), np.int32)
-        for i, seq in enumerate(batch):
-            new = seq.tokens[seq.num_computed:seq.num_computed + windows[i]]
-            toks[i, :len(new)] = new
-            start[i] = seq.num_computed
-            lens[i] = len(new)
-            pt[i, :len(seq.pages)] = seq.pages
-        st = self._sampling_tensors(
-            [s.req.sampling for s in batch], B)
-        self._rng_key, key = jax.random.split(self._rng_key)
-        mm_e = mm_p = None
-        if any(s.req.mm_embeds is not None for s in batch):
-            # Pad the multimodal splice to a pow2 bucket; positions are
-            # window-relative, already-cached or pad slots point at T
-            # (dropped by the scatter).
-            max_m = max(len(s.req.mm_positions or ()) for s in batch)
-            M = 1 << max(max_m - 1, 0).bit_length()
-            D = self.cfg.hidden_size
-            mm_e = np.zeros((B, M, D), np.float32)
-            mm_p = np.full((B, M), T, np.int32)
+        with self._phase("prefill.pack"):
+            B = 1 << (len(batch) - 1).bit_length()      # pow2 batch bucket
+            T = self._bucket(max(windows))
+            # Table width must cover both every sequence's pages AND the
+            # padded overlay window [start, start+T) that prefill attention
+            # writes fresh K/V into (ops/attention.overlay_fresh_kv).
+            mp = max(max(len(s.pages) for s in batch),
+                     max(self._pages_needed(s.num_computed + T)
+                         for s in batch))
+            # Deliberately NOT clamped to max_pages_per_seq: a bucketed T
+            # can overshoot a late-start sequence's true window, and the
+            # overlay view must still cover [start, start+T) — extra
+            # columns are NULL pages, masked in attention and dropped by
+            # the pool scatter.
+            MP = 1 << max(mp - 1, 0).bit_length()
+            toks = np.zeros((B, T), np.int32)
+            start = np.zeros(B, np.int32)
+            lens = np.zeros(B, np.int32)
+            pt = np.zeros((B, MP), np.int32)
             for i, seq in enumerate(batch):
-                if seq.req.mm_embeds is None:
-                    continue
-                for j, pos in enumerate(seq.req.mm_positions):
-                    rel = pos - seq.num_computed
-                    if 0 <= rel < windows[i]:
-                        mm_p[i, j] = rel
-                        mm_e[i, j] = seq.req.mm_embeds[j]
-            mm_e = jnp.asarray(mm_e)
-            mm_p = jnp.asarray(mm_p)
-        next_tok, logprob, top_ids, top_lps, self.kv = self._jit_prefill(
-            self.params, jnp.asarray(toks), jnp.asarray(start),
-            jnp.asarray(lens), self.kv, jnp.asarray(pt), st, key,
-            mm_e, mm_p)
-        next_tok = np.asarray(next_tok)
-        logprob = np.asarray(logprob)
-        if top_ids is not None:
-            # One bulk device->host transfer, not one per sequence.
-            top_ids = np.asarray(top_ids)
-            top_lps = np.asarray(top_lps)
+                new = seq.tokens[seq.num_computed:
+                                 seq.num_computed + windows[i]]
+                toks[i, :len(new)] = new
+                start[i] = seq.num_computed
+                lens[i] = len(new)
+                pt[i, :len(seq.pages)] = seq.pages
+            st = self._sampling_tensors(
+                [s.req.sampling for s in batch], B)
+            self._rng_key, key = jax.random.split(self._rng_key)
+            mm_e = mm_p = None
+            if any(s.req.mm_embeds is not None for s in batch):
+                # Pad the multimodal splice to a pow2 bucket; positions are
+                # window-relative, already-cached or pad slots point at T
+                # (dropped by the scatter).
+                max_m = max(len(s.req.mm_positions or ()) for s in batch)
+                M = 1 << max(max_m - 1, 0).bit_length()
+                D = self.cfg.hidden_size
+                mm_e = np.zeros((B, M, D), np.float32)
+                mm_p = np.full((B, M), T, np.int32)
+                for i, seq in enumerate(batch):
+                    if seq.req.mm_embeds is None:
+                        continue
+                    for j, pos in enumerate(seq.req.mm_positions):
+                        rel = pos - seq.num_computed
+                        if 0 <= rel < windows[i]:
+                            mm_p[i, j] = rel
+                            mm_e[i, j] = seq.req.mm_embeds[j]
+                mm_e = jnp.asarray(mm_e)
+                mm_p = jnp.asarray(mm_p)
+        cache_before = self._jit_cache_size(self._jit_prefill)
+        with self._phase("prefill.dispatch"):
+            next_tok, logprob, top_ids, top_lps, self.kv = \
+                self._jit_prefill(
+                    self.params, jnp.asarray(toks), jnp.asarray(start),
+                    jnp.asarray(lens), self.kv, jnp.asarray(pt), st, key,
+                    mm_e, mm_p)
+        self._note_recompile("prefill", self._jit_prefill, cache_before)
+        with self._phase("prefill.readback"):
+            next_tok = np.asarray(next_tok)
+            logprob = np.asarray(logprob)
+            if top_ids is not None:
+                # One bulk device->host transfer, not one per sequence.
+                top_ids = np.asarray(top_ids)
+                top_lps = np.asarray(top_lps)
         # Batch membership changed: the penalty histogram (if any) must be
         # rebuilt from host truth before the next penalized decode.
         self._counts = None
 
         now = time.monotonic()
         outs: List[StepOutput] = []
-        for i, seq in enumerate(batch):
-            if seq.num_computed + windows[i] < len(seq.tokens):
-                # Mid-prompt window: KV is written, but the sampled token
-                # came from a mid-prompt position — discard it and requeue
-                # for the next window (slot + pages stay reserved).
-                seq.num_computed += windows[i]
+        with self._phase("prefill.post"):
+            for i, seq in enumerate(batch):
+                if seq.num_computed + windows[i] < len(seq.tokens):
+                    # Mid-prompt window: KV is written, but the sampled
+                    # token came from a mid-prompt position — discard it
+                    # and requeue for the next window (slot + pages stay
+                    # reserved).
+                    seq.num_computed += windows[i]
+                    self._sync_slot(seq)
+                    if seq not in self.waiting:
+                        self.waiting.append(seq)
+                    self._sort_waiting()
+                    continue
+                seq.status = SeqStatus.RUNNING
+                seq.num_computed = len(seq.tokens)
+                seq.first_token_time = now
+                self.running.append(seq)
+                tok = int(next_tok[i])
+                outs.append(self._append_token(
+                    seq, tok, float(logprob[i]),
+                    top=self._top_entry(seq, top_ids, top_lps, i)))
                 self._sync_slot(seq)
-                if seq not in self.waiting:
-                    self.waiting.append(seq)
-                self._sort_waiting()
-                continue
-            seq.status = SeqStatus.RUNNING
-            seq.num_computed = len(seq.tokens)
-            seq.first_token_time = now
-            self.running.append(seq)
-            tok = int(next_tok[i])
-            outs.append(self._append_token(
-                seq, tok, float(logprob[i]),
-                top=self._top_entry(seq, top_ids, top_lps, i)))
-            self._sync_slot(seq)
         return outs
 
     def _run_prefill_ring(self, seq: Sequence, window: int
@@ -604,26 +662,32 @@ class Engine:
         sequence axis pads to ``sp × bucket`` so every device holds an
         equal block."""
         sp = self._sp
-        per_dev = self._bucket(-(-window // sp))
-        T = per_dev * sp
-        mp = max(len(seq.pages), self._pages_needed(window + 1))
-        MP = 1 << max(mp - 1, 0).bit_length()
-        toks = np.zeros((1, T), np.int32)
-        toks[0, :window] = seq.tokens[:window]
-        lens = np.asarray([window], np.int32)
-        pt = np.zeros((1, MP), np.int32)
-        pt[0, :len(seq.pages)] = seq.pages
-        st = self._sampling_tensors([seq.req.sampling], 1)
-        self._rng_key, key = jax.random.split(self._rng_key)
-        next_tok, logprob, top_ids, top_lps, self.kv = \
-            self._jit_prefill_ring(
-                self.params, jnp.asarray(toks), jnp.asarray(lens), self.kv,
-                jnp.asarray(pt), st, key)
-        next_tok = np.asarray(next_tok)
-        logprob = np.asarray(logprob)
-        if top_ids is not None:
-            top_ids = np.asarray(top_ids)
-            top_lps = np.asarray(top_lps)
+        with self._phase("prefill_ring.pack"):
+            per_dev = self._bucket(-(-window // sp))
+            T = per_dev * sp
+            mp = max(len(seq.pages), self._pages_needed(window + 1))
+            MP = 1 << max(mp - 1, 0).bit_length()
+            toks = np.zeros((1, T), np.int32)
+            toks[0, :window] = seq.tokens[:window]
+            lens = np.asarray([window], np.int32)
+            pt = np.zeros((1, MP), np.int32)
+            pt[0, :len(seq.pages)] = seq.pages
+            st = self._sampling_tensors([seq.req.sampling], 1)
+            self._rng_key, key = jax.random.split(self._rng_key)
+        cache_before = self._jit_cache_size(self._jit_prefill_ring)
+        with self._phase("prefill_ring.dispatch"):
+            next_tok, logprob, top_ids, top_lps, self.kv = \
+                self._jit_prefill_ring(
+                    self.params, jnp.asarray(toks), jnp.asarray(lens),
+                    self.kv, jnp.asarray(pt), st, key)
+        self._note_recompile("prefill_ring", self._jit_prefill_ring,
+                             cache_before)
+        with self._phase("prefill_ring.readback"):
+            next_tok = np.asarray(next_tok)
+            logprob = np.asarray(logprob)
+            if top_ids is not None:
+                top_ids = np.asarray(top_ids)
+                top_lps = np.asarray(top_lps)
         self._counts = None
         seq.status = SeqStatus.RUNNING
         seq.num_computed = len(seq.tokens)
@@ -654,46 +718,56 @@ class Engine:
         # the write silently (NULL-page mode="drop"), leaving a permanent
         # KV hole that later attention reads and the prefix cache could
         # content-address. May preempt, so iterate over a snapshot.
-        for seq in list(self.running):
-            if seq.status == SeqStatus.RUNNING:
-                self._grow_pages(seq)
-        if not self.running:
-            return []
-        active = np.zeros(B, bool)
-        for seq in self.running:
-            i = seq.slot
-            active[i] = True
-            self._slot_last_token[i] = seq.tokens[-1]
-            self._slot_pos[i] = len(seq.tokens) - 1
-        if self._slot_st is None:
-            self._slot_st = SamplingTensors.for_batch(self._slot_sampling)
-        st = self._slot_st
-        self._rng_key, key = jax.random.split(self._rng_key)
-        mp = self._table_width()
-        next_tok, logprob, top_ids, top_lps, self.kv, self._counts = \
-            self._jit_decode(
-                self.params, jnp.asarray(self._slot_last_token),
-                jnp.asarray(self._slot_pos), jnp.asarray(active), self.kv,
-                jnp.asarray(np.ascontiguousarray(self._slot_pt[:, :mp])),
-                st, key, self._ensure_counts())
-        next_tok = np.asarray(next_tok)
-        logprob = np.asarray(logprob)
-        if top_ids is not None:
-            # One bulk device->host transfer, not one per sequence.
-            top_ids = np.asarray(top_ids)
-            top_lps = np.asarray(top_lps)
+        with self._phase("decode.pack"):
+            for seq in list(self.running):
+                if seq.status == SeqStatus.RUNNING:
+                    self._grow_pages(seq)
+            if not self.running:
+                return []
+            active = np.zeros(B, bool)
+            for seq in self.running:
+                i = seq.slot
+                active[i] = True
+                self._slot_last_token[i] = seq.tokens[-1]
+                self._slot_pos[i] = len(seq.tokens) - 1
+            if self._slot_st is None:
+                self._slot_st = SamplingTensors.for_batch(
+                    self._slot_sampling)
+            st = self._slot_st
+            self._rng_key, key = jax.random.split(self._rng_key)
+            mp = self._table_width()
+        cache_before = self._jit_cache_size(self._jit_decode)
+        with self._phase("decode.dispatch"):
+            next_tok, logprob, top_ids, top_lps, self.kv, self._counts = \
+                self._jit_decode(
+                    self.params, jnp.asarray(self._slot_last_token),
+                    jnp.asarray(self._slot_pos), jnp.asarray(active),
+                    self.kv,
+                    jnp.asarray(
+                        np.ascontiguousarray(self._slot_pt[:, :mp])),
+                    st, key, self._ensure_counts())
+        self._note_recompile("decode", self._jit_decode, cache_before)
+        with self._phase("decode.readback"):
+            next_tok = np.asarray(next_tok)
+            logprob = np.asarray(logprob)
+            if top_ids is not None:
+                # One bulk device->host transfer, not one per sequence.
+                top_ids = np.asarray(top_ids)
+                top_lps = np.asarray(top_lps)
         outs: List[StepOutput] = []
         # Snapshot (seq, slot) first: _append_token may preempt a *later*
         # sequence in this list (page-growth pressure), clearing its slot
         # before we read its sampled token.
-        for seq, i in [(s, s.slot) for s in self.running]:
-            if seq.status == SeqStatus.RUNNING:
-                seq.num_computed = len(seq.tokens)
-            # A sequence preempted earlier in this loop still gets its token
-            # (sampled while its KV was resident); it re-prefills later.
-            outs.append(self._append_token(
-                seq, int(next_tok[i]), float(logprob[i]),
-                top=self._top_entry(seq, top_ids, top_lps, i)))
+        with self._phase("decode.post"):
+            for seq, i in [(s, s.slot) for s in self.running]:
+                if seq.status == SeqStatus.RUNNING:
+                    seq.num_computed = len(seq.tokens)
+                # A sequence preempted earlier in this loop still gets its
+                # token (sampled while its KV was resident); it re-prefills
+                # later.
+                outs.append(self._append_token(
+                    seq, int(next_tok[i]), float(logprob[i]),
+                    top=self._top_entry(seq, top_ids, top_lps, i)))
         return outs
 
     def _run_decode_multi(self) -> List[StepOutput]:
@@ -705,71 +779,81 @@ class Engine:
         run, so streaming consumers see a burst of up to N tokens."""
         N = self.ecfg.decode_steps
         B = self.ecfg.max_batch_size
-        # Pre-grow pages to cover positions len-1 .. len-1+N-1 (may preempt
-        # — iterate over a snapshot).
-        for seq in list(self.running):
-            if seq.status == SeqStatus.RUNNING:
-                self._grow_pages(seq, lookahead=N - 1)
-        if not self.running:
-            return []
-        active = np.zeros(B, bool)
-        for seq in self.running:
-            i = seq.slot
-            active[i] = True
-            self._slot_last_token[i] = seq.tokens[-1]
-            self._slot_pos[i] = len(seq.tokens) - 1
-        if self._slot_st is None:
-            self._slot_st = SamplingTensors.for_batch(self._slot_sampling)
-        st = self._slot_st
-        self._rng_key, key = jax.random.split(self._rng_key)
-        # Width must cover the lookahead pages pre-grown above.
-        mp = self._table_width()
-        toks, logps, top_ids, top_lps, self.kv, self._counts = \
-            self._jit_decode_multi(
-                self.params, jnp.asarray(self._slot_last_token),
-                jnp.asarray(self._slot_pos), jnp.asarray(active), self.kv,
-                jnp.asarray(np.ascontiguousarray(self._slot_pt[:, :mp])),
-                st, key, self._ensure_counts())
-        toks = np.asarray(toks)          # [N, B]
-        logps = np.asarray(logps)        # [N, B]
-        if top_ids is not None:
-            top_ids = np.asarray(top_ids)    # [N, B, K]
-            top_lps = np.asarray(top_lps)
+        with self._phase("decode_multi.pack"):
+            # Pre-grow pages to cover positions len-1 .. len-1+N-1 (may
+            # preempt — iterate over a snapshot).
+            for seq in list(self.running):
+                if seq.status == SeqStatus.RUNNING:
+                    self._grow_pages(seq, lookahead=N - 1)
+            if not self.running:
+                return []
+            active = np.zeros(B, bool)
+            for seq in self.running:
+                i = seq.slot
+                active[i] = True
+                self._slot_last_token[i] = seq.tokens[-1]
+                self._slot_pos[i] = len(seq.tokens) - 1
+            if self._slot_st is None:
+                self._slot_st = SamplingTensors.for_batch(
+                    self._slot_sampling)
+            st = self._slot_st
+            self._rng_key, key = jax.random.split(self._rng_key)
+            # Width must cover the lookahead pages pre-grown above.
+            mp = self._table_width()
+        cache_before = self._jit_cache_size(self._jit_decode_multi)
+        with self._phase("decode_multi.dispatch"):
+            toks, logps, top_ids, top_lps, self.kv, self._counts = \
+                self._jit_decode_multi(
+                    self.params, jnp.asarray(self._slot_last_token),
+                    jnp.asarray(self._slot_pos), jnp.asarray(active),
+                    self.kv,
+                    jnp.asarray(
+                        np.ascontiguousarray(self._slot_pt[:, :mp])),
+                    st, key, self._ensure_counts())
+        self._note_recompile("decode_multi", self._jit_decode_multi,
+                             cache_before)
+        with self._phase("decode_multi.readback"):
+            toks = np.asarray(toks)          # [N, B]
+            logps = np.asarray(logps)        # [N, B]
+            if top_ids is not None:
+                top_ids = np.asarray(top_ids)    # [N, B, K]
+                top_lps = np.asarray(top_lps)
 
         outs: List[StepOutput] = []
-        for seq, slot in [(s, s.slot) for s in self.running]:
-            accepted: List[int] = []
-            lps: List[float] = []
-            tops: Optional[List[List[Dict[str, Any]]]] = \
-                [] if (top_ids is not None
-                       and seq.req.sampling.logprobs) else None
-            reason = FinishReason.NONE
-            for k_step in range(N):
-                tok = int(toks[k_step, slot])
-                seq.tokens.append(tok)
-                accepted.append(tok)
-                lps.append(float(logps[k_step, slot]))
-                if tops is not None:
-                    tops.append(_top_row(top_ids[k_step], top_lps[k_step],
-                                         slot))
-                reason = self._finish_reason(seq, tok)
+        with self._phase("decode_multi.post"):
+            for seq, slot in [(s, s.slot) for s in self.running]:
+                accepted: List[int] = []
+                lps: List[float] = []
+                tops: Optional[List[List[Dict[str, Any]]]] = \
+                    [] if (top_ids is not None
+                           and seq.req.sampling.logprobs) else None
+                reason = FinishReason.NONE
+                for k_step in range(N):
+                    tok = int(toks[k_step, slot])
+                    seq.tokens.append(tok)
+                    accepted.append(tok)
+                    lps.append(float(logps[k_step, slot]))
+                    if tops is not None:
+                        tops.append(_top_row(top_ids[k_step],
+                                             top_lps[k_step], slot))
+                    reason = self._finish_reason(seq, tok)
+                    if reason != FinishReason.NONE:
+                        break
+                if seq.status == SeqStatus.RUNNING:
+                    # KV resident for every token but the last sampled one.
+                    seq.num_computed = len(seq.tokens) - 1
+                out = StepOutput(
+                    request_id=seq.req.request_id, new_token_ids=accepted,
+                    logprobs=lps, finish_reason=reason,
+                    num_prompt_tokens=seq.num_prompt_tokens,
+                    num_generated=seq.num_generated, top_logprobs=tops)
+                outs.append(out)
                 if reason != FinishReason.NONE:
-                    break
-            if seq.status == SeqStatus.RUNNING:
-                # KV resident for every token but the last sampled one.
-                seq.num_computed = len(seq.tokens) - 1
-            out = StepOutput(
-                request_id=seq.req.request_id, new_token_ids=accepted,
-                logprobs=lps, finish_reason=reason,
-                num_prompt_tokens=seq.num_prompt_tokens,
-                num_generated=seq.num_generated, top_logprobs=tops)
-            outs.append(out)
-            if reason != FinishReason.NONE:
-                self._finish_seq(seq, reason)
-            elif seq.status == SeqStatus.RUNNING \
-                    and seq.req.mm_embeds is None:
-                self.prefix_cache.register_full_pages(
-                    seq.tokens[:seq.num_computed], seq.pages)
+                    self._finish_seq(seq, reason)
+                elif seq.status == SeqStatus.RUNNING \
+                        and seq.req.mm_embeds is None:
+                    self.prefix_cache.register_full_pages(
+                        seq.tokens[:seq.num_computed], seq.pages)
         return outs
 
     def _top_entry(self, seq: Sequence, top_ids, top_lps,
@@ -953,6 +1037,16 @@ class Engine:
         Bmax = self.ecfg.max_batch_size
         budget = self.ecfg.max_prefill_tokens
         key = jax.random.PRNGKey(0)
+        # jax.random.split AND the tuple-unpack of its result (an Array
+        # __getitem__ program) are tiny jitted computations. Warmup never
+        # used to run them, so the FIRST serving prefill paid their
+        # compiles inside prefill.pack — ~250 ms on CPU, whole seconds
+        # through the tunneled backend's remote-compile path (the round-2
+        # "unexplained prefill slowness", docs/PERF_NOTES.md item 1).
+        # Throwaway key: self._rng_key must not advance here or warmup
+        # would change seeded-sampling streams.
+        _k1, _k2 = jax.random.split(key)
+        del _k1, _k2
 
         batch_pows = []
         b = 1
