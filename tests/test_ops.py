@@ -240,6 +240,35 @@ class TestPallasPagedAttention:
         assert jnp.allclose(ref, out, atol=1e-5), \
             float(jnp.max(jnp.abs(ref - out)))
 
+    def test_transpose_free_variant_matches(self):
+        """The in-place-batched dot_general fold (transpose_free=True)
+        must be numerically identical to the transpose fold — it is the
+        same contraction expressed without the VMEM relayout."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from xllm_service_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_pallas)
+
+        rng = np.random.default_rng(7)
+        B, Hq, Hkv, D, P, ps, MP = 3, 8, 2, 32, 16, 8, 6
+        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        pt = jnp.asarray(rng.integers(1, P, size=(B, MP)), jnp.int32)
+        ctx = jnp.asarray([13, 1, MP * ps], jnp.int32)
+        kc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+        for cur in ((None, None), (kc, vc)):
+            ref = paged_decode_attention_pallas(
+                q, k, v, pt, ctx, *cur, interpret=True,
+                transpose_free=False)
+            out = paged_decode_attention_pallas(
+                q, k, v, pt, ctx, *cur, interpret=True,
+                transpose_free=True)
+            assert jnp.allclose(ref, out, atol=1e-6), \
+                float(jnp.max(jnp.abs(ref - out)))
+
     def test_null_pages_masked(self):
         import numpy as np
         import jax.numpy as jnp

@@ -21,6 +21,7 @@ via ``pl.when`` (their DMA lands on page 0 and is discarded).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -30,9 +31,21 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+
+def _transpose_free_default() -> bool:
+    """Transpose-free fold: contract the K/V page blocks in their native
+    [ps, Hkv, D] layout by batching the dot_generals over Hkv *in place*
+    (rhs batch dim at position 1) instead of materializing a transposed
+    [Hkv, ps, D] copy in VMEM per grid cell. Numerically identical
+    (interpret-mode bit-exact); gated until Mosaic lowering is validated
+    on hardware. Read per call, like the sibling XLLM_PALLAS gate, so a
+    runtime toggle (bench retry loops, test fixtures) takes effect."""
+    return os.environ.get("XLLM_PALLAS_DECODE_V2", "0") == "1"
+
+
 def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
             m_ref, l_ref, acc_ref, *, page_size: int, pages_per_seq: int,
-            num_kv_heads: int, has_current: bool):
+            num_kv_heads: int, has_current: bool, transpose_free: bool):
     b = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -53,13 +66,18 @@ def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
         qg = q.reshape(num_kv_heads, g, d)                   # [Hkv, G, D]
         k = k_ref[0].astype(jnp.float32)                     # [ps, Hkv, D]
         v = v_ref[0].astype(jnp.float32)
-        kt = jnp.transpose(k, (1, 0, 2))                     # [Hkv, ps, D]
-        vt = jnp.transpose(v, (1, 0, 2))
         scale = 1.0 / (d ** 0.5)
-        # Batched over Hkv: [Hkv, G, D] x [Hkv, ps, D] -> [Hkv, G, ps]
-        logits = jax.lax.dot_general(
-            qg, kt, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale
+        if transpose_free:
+            # Batch Hkv where it lives: [Hkv,G,D] x [ps,Hkv,D] -> [Hkv,G,ps]
+            logits = jax.lax.dot_general(
+                qg, k, (((2,), (2,)), ((0,), (1,))),
+                preferred_element_type=jnp.float32) * scale
+        else:
+            kt = jnp.transpose(k, (1, 0, 2))                 # [Hkv, ps, D]
+            # Batched over Hkv: [Hkv, G, D] x [Hkv, ps, D] -> [Hkv, G, ps]
+            logits = jax.lax.dot_general(
+                qg, kt, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) * scale
         logits = logits.reshape(hq, page_size)               # [Hq, ps]
         pos = page_start + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
@@ -73,11 +91,19 @@ def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
         corr = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * corr + jnp.sum(prob, axis=-1,
                                              keepdims=True)
-        # [Hkv, G, ps] x [Hkv, ps, D] -> [Hkv, G, D]
-        pv = jax.lax.dot_general(
-            prob.reshape(num_kv_heads, g, page_size), vt,
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
+        if transpose_free:
+            # [Hkv, G, ps] x [ps, Hkv, D] -> [Hkv, G, D]
+            pv = jax.lax.dot_general(
+                prob.reshape(num_kv_heads, g, page_size), v,
+                (((2,), (0,)), ((0,), (1,))),
+                preferred_element_type=jnp.float32)
+        else:
+            vt = jnp.transpose(v, (1, 0, 2))
+            # [Hkv, G, ps] x [Hkv, ps, D] -> [Hkv, G, D]
+            pv = jax.lax.dot_general(
+                prob.reshape(num_kv_heads, g, page_size), vt,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
         acc_ref[:] = acc_ref[:] * corr + pv.reshape(hq, d)
         m_ref[:] = m_new
 
@@ -110,19 +136,42 @@ def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
         o_ref[0] = (acc_fin / denom).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
                                   v_pages: jnp.ndarray,
                                   page_table: jnp.ndarray,
                                   context_lens: jnp.ndarray,
                                   k_cur: jnp.ndarray = None,
                                   v_cur: jnp.ndarray = None,
-                                  interpret: bool = False) -> jnp.ndarray:
+                                  interpret: bool = False,
+                                  transpose_free: bool = None
+                                  ) -> jnp.ndarray:
     """q: [B, Hq, D]; k/v_pages: [P, ps, Hkv, D]; page_table: [B, MP];
     context_lens: [B] valid cache tokens. With ``k_cur``/``v_cur``
     [B, Hkv, D], the current (not-yet-written) token is folded as a final
     block — the contract of ``paged_decode_attention_current``. Returns
-    [B, Hq, D]."""
+    [B, Hq, D].
+
+    ``transpose_free=None`` resolves the XLLM_PALLAS_DECODE_V2 env var
+    HERE, outside the jit cache, so runtime toggles take effect (the
+    sibling XLLM_PALLAS gate has the same call-time semantics)."""
+    if transpose_free is None:
+        transpose_free = _transpose_free_default()
+    return _paged_decode_attention_impl(
+        q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur,
+        interpret=interpret, transpose_free=transpose_free)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "transpose_free"))
+def _paged_decode_attention_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
+                                 v_pages: jnp.ndarray,
+                                 page_table: jnp.ndarray,
+                                 context_lens: jnp.ndarray,
+                                 k_cur: jnp.ndarray = None,
+                                 v_cur: jnp.ndarray = None,
+                                 interpret: bool = False,
+                                 transpose_free: bool = False
+                                 ) -> jnp.ndarray:
     B, Hq, D = q.shape
     _, page_size, Hkv, _ = k_pages.shape
     MP = page_table.shape[1]
@@ -156,7 +205,8 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
     )
     out = pl.pallas_call(
         functools.partial(_kernel, page_size=page_size, pages_per_seq=MP,
-                          num_kv_heads=Hkv, has_current=has_current),
+                          num_kv_heads=Hkv, has_current=has_current,
+                          transpose_free=transpose_free),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(

@@ -51,6 +51,14 @@ from xllm_service_tpu.utils.types import FinishReason, SamplingParams
 
 logger = logging.getLogger(__name__)
 
+# Packed int32 slot-state layout (single host->device transfer per step):
+# decode rows are [token, position, active, page_table...]; prefill rows
+# are [start, length, tokens..., page_table...]; ring-prefill rows are
+# [length, tokens..., page_table...].
+_PACK_COLS = 3          # decode header columns
+_PREFILL_HDR = 2        # prefill header columns
+_RING_HDR = 1           # ring-prefill header columns
+
 
 @dataclasses.dataclass
 class EngineRequest:
@@ -160,20 +168,28 @@ class Engine:
         self._cancelled: set = set()
         self._held: Dict[str, Sequence] = {}   # finished, pages resident
 
-        # Decode-slot host mirrors (numpy, copied to device each step).
+        # Decode-slot host mirror: ONE packed int32 buffer per step so the
+        # whole slot state (last token, position, active flag, page table)
+        # crosses host->device as a single transfer — each separate upload
+        # pays the backend's fixed dispatch RTT (~80 ms through the
+        # tunneled TPU; docs/PERF_NOTES.md item 3). Columns: [0]=token,
+        # [1]=pos, [2]=active, [3:]=page table. The named views below keep
+        # the update sites readable.
         B, MP = engine_cfg.max_batch_size, engine_cfg.max_pages_per_seq
-        self._slot_last_token = np.zeros(B, np.int32)
-        self._slot_pos = np.zeros(B, np.int32)
-        self._slot_pt = np.zeros((B, MP), np.int32)
-        # Per-slot sampling params change only on admit/finish; the device
-        # tensors are rebuilt lazily instead of per decode step.
+        self._slot_packed = np.zeros((B, _PACK_COLS + MP), np.int32)
+        self._slot_last_token = self._slot_packed[:, 0]
+        self._slot_pos = self._slot_packed[:, 1]
+        self._slot_active = self._slot_packed[:, 2]
+        self._slot_pt = self._slot_packed[:, _PACK_COLS:]
+        # Per-slot sampling params change only on admit/finish; the packed
+        # device pair is rebuilt lazily instead of per decode step.
         self._slot_sampling: List[SamplingParams] = [SamplingParams()] * B
-        self._slot_st: Optional[SamplingTensors] = None
+        self._slot_st: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
 
         K = engine_cfg.num_top_logprobs
         self._jit_prefill = jax.jit(
             functools.partial(_prefill_step, cfg=model_cfg, num_top=K),
-            donate_argnums=(4,))
+            donate_argnums=(2,), static_argnames=("t_len",))
         # Sequence-parallel ring prefill: available when the mesh has an
         # sp axis — prompts longer than the largest single-chip bucket
         # prefill in ONE sp-sharded step instead of many chunked windows.
@@ -183,14 +199,14 @@ class Engine:
             self._jit_prefill_ring = jax.jit(
                 functools.partial(_prefill_ring_step, cfg=model_cfg,
                                   num_top=K, mesh=mesh),
-                donate_argnums=(3,))
+                donate_argnums=(2,), static_argnames=("t_len",))
         self._jit_decode = jax.jit(
             functools.partial(_decode_step, cfg=model_cfg, num_top=K),
-            donate_argnums=(4, 8))
+            donate_argnums=(2, 6))
         self._jit_decode_multi = jax.jit(
             functools.partial(_decode_multi_step, cfg=model_cfg,
                               n_steps=engine_cfg.decode_steps, num_top=K),
-            donate_argnums=(4, 8))
+            donate_argnums=(2, 6))
         # Output-token histogram [B, V] for presence/frequency penalties;
         # lives on device only while some running slot uses penalties.
         self._counts: Optional[jnp.ndarray] = None
@@ -576,18 +592,17 @@ class Engine:
             # columns are NULL pages, masked in attention and dropped by
             # the pool scatter.
             MP = 1 << max(mp - 1, 0).bit_length()
-            toks = np.zeros((B, T), np.int32)
-            start = np.zeros(B, np.int32)
-            lens = np.zeros(B, np.int32)
-            pt = np.zeros((B, MP), np.int32)
+            # One packed transfer: [start, len, tokens…, page table…].
+            packed = np.zeros((B, _PREFILL_HDR + T + MP), np.int32)
             for i, seq in enumerate(batch):
                 new = seq.tokens[seq.num_computed:
                                  seq.num_computed + windows[i]]
-                toks[i, :len(new)] = new
-                start[i] = seq.num_computed
-                lens[i] = len(new)
-                pt[i, :len(seq.pages)] = seq.pages
-            st = self._sampling_tensors(
+                packed[i, 0] = seq.num_computed
+                packed[i, 1] = len(new)
+                packed[i, _PREFILL_HDR:_PREFILL_HDR + len(new)] = new
+                packed[i, _PREFILL_HDR + T:
+                       _PREFILL_HDR + T + len(seq.pages)] = seq.pages
+            st_f32, st_i32 = self._sampling_tensors(
                 [s.req.sampling for s in batch], B)
             self._rng_key, key = jax.random.split(self._rng_key)
             mm_e = mm_p = None
@@ -614,9 +629,8 @@ class Engine:
         with self._phase("prefill.dispatch"):
             next_tok, logprob, top_ids, top_lps, self.kv = \
                 self._jit_prefill(
-                    self.params, jnp.asarray(toks), jnp.asarray(start),
-                    jnp.asarray(lens), self.kv, jnp.asarray(pt), st, key,
-                    mm_e, mm_p)
+                    self.params, jnp.asarray(packed), self.kv,
+                    st_f32, st_i32, key, mm_e, mm_p, t_len=T)
         self._note_recompile("prefill", self._jit_prefill, cache_before)
         with self._phase("prefill.readback"):
             next_tok = np.asarray(next_tok)
@@ -667,19 +681,20 @@ class Engine:
             T = per_dev * sp
             mp = max(len(seq.pages), self._pages_needed(window + 1))
             MP = 1 << max(mp - 1, 0).bit_length()
-            toks = np.zeros((1, T), np.int32)
-            toks[0, :window] = seq.tokens[:window]
-            lens = np.asarray([window], np.int32)
-            pt = np.zeros((1, MP), np.int32)
-            pt[0, :len(seq.pages)] = seq.pages
-            st = self._sampling_tensors([seq.req.sampling], 1)
+            # One packed transfer: [len, tokens…, page table…].
+            packed = np.zeros((1, _RING_HDR + T + MP), np.int32)
+            packed[0, 0] = window
+            packed[0, _RING_HDR:_RING_HDR + window] = seq.tokens[:window]
+            packed[0, _RING_HDR + T:
+                   _RING_HDR + T + len(seq.pages)] = seq.pages
+            st_f32, st_i32 = self._sampling_tensors([seq.req.sampling], 1)
             self._rng_key, key = jax.random.split(self._rng_key)
         cache_before = self._jit_cache_size(self._jit_prefill_ring)
         with self._phase("prefill_ring.dispatch"):
             next_tok, logprob, top_ids, top_lps, self.kv = \
                 self._jit_prefill_ring(
-                    self.params, jnp.asarray(toks), jnp.asarray(lens),
-                    self.kv, jnp.asarray(pt), st, key)
+                    self.params, jnp.asarray(packed), self.kv,
+                    st_f32, st_i32, key, t_len=T)
         self._note_recompile("prefill_ring", self._jit_prefill_ring,
                              cache_before)
         with self._phase("prefill_ring.readback"):
@@ -724,28 +739,26 @@ class Engine:
                     self._grow_pages(seq)
             if not self.running:
                 return []
-            active = np.zeros(B, bool)
+            self._slot_active[:] = 0
             for seq in self.running:
                 i = seq.slot
-                active[i] = True
+                self._slot_active[i] = 1
                 self._slot_last_token[i] = seq.tokens[-1]
                 self._slot_pos[i] = len(seq.tokens) - 1
             if self._slot_st is None:
-                self._slot_st = SamplingTensors.for_batch(
-                    self._slot_sampling)
-            st = self._slot_st
+                self._slot_st = self._sampling_tensors(
+                    self._slot_sampling, B)
+            st_f32, st_i32 = self._slot_st
             self._rng_key, key = jax.random.split(self._rng_key)
             mp = self._table_width()
+            packed = jnp.asarray(np.ascontiguousarray(
+                self._slot_packed[:, :_PACK_COLS + mp]))
         cache_before = self._jit_cache_size(self._jit_decode)
         with self._phase("decode.dispatch"):
             next_tok, logprob, top_ids, top_lps, self.kv, self._counts = \
                 self._jit_decode(
-                    self.params, jnp.asarray(self._slot_last_token),
-                    jnp.asarray(self._slot_pos), jnp.asarray(active),
-                    self.kv,
-                    jnp.asarray(
-                        np.ascontiguousarray(self._slot_pt[:, :mp])),
-                    st, key, self._ensure_counts())
+                    self.params, packed, self.kv,
+                    st_f32, st_i32, key, self._ensure_counts())
         self._note_recompile("decode", self._jit_decode, cache_before)
         with self._phase("decode.readback"):
             next_tok = np.asarray(next_tok)
@@ -787,29 +800,27 @@ class Engine:
                     self._grow_pages(seq, lookahead=N - 1)
             if not self.running:
                 return []
-            active = np.zeros(B, bool)
+            self._slot_active[:] = 0
             for seq in self.running:
                 i = seq.slot
-                active[i] = True
+                self._slot_active[i] = 1
                 self._slot_last_token[i] = seq.tokens[-1]
                 self._slot_pos[i] = len(seq.tokens) - 1
             if self._slot_st is None:
-                self._slot_st = SamplingTensors.for_batch(
-                    self._slot_sampling)
-            st = self._slot_st
+                self._slot_st = self._sampling_tensors(
+                    self._slot_sampling, B)
+            st_f32, st_i32 = self._slot_st
             self._rng_key, key = jax.random.split(self._rng_key)
             # Width must cover the lookahead pages pre-grown above.
             mp = self._table_width()
+            packed = jnp.asarray(np.ascontiguousarray(
+                self._slot_packed[:, :_PACK_COLS + mp]))
         cache_before = self._jit_cache_size(self._jit_decode_multi)
         with self._phase("decode_multi.dispatch"):
             toks, logps, top_ids, top_lps, self.kv, self._counts = \
                 self._jit_decode_multi(
-                    self.params, jnp.asarray(self._slot_last_token),
-                    jnp.asarray(self._slot_pos), jnp.asarray(active),
-                    self.kv,
-                    jnp.asarray(
-                        np.ascontiguousarray(self._slot_pt[:, :mp])),
-                    st, key, self._ensure_counts())
+                    self.params, packed, self.kv,
+                    st_f32, st_i32, key, self._ensure_counts())
         self._note_recompile("decode_multi", self._jit_decode_multi,
                              cache_before)
         with self._phase("decode_multi.readback"):
@@ -926,9 +937,12 @@ class Engine:
 
     @staticmethod
     def _sampling_tensors(params: Sequence[SamplingParams],
-                          B: int) -> SamplingTensors:
+                          B: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Packed (float32 [B,4], int32 [B,2]) sampling-state pair — two
+        uploads; the jitted step rebuilds SamplingTensors on device."""
         padded = list(params) + [SamplingParams()] * (B - len(params))
-        return SamplingTensors.for_batch(padded)
+        f32, i32 = SamplingTensors.pack_batch(padded)
+        return jnp.asarray(f32), jnp.asarray(i32)
 
     # ------------------------------------------------------------------
     # PD disaggregation: KV export/import (host-shuttle v0 path —
@@ -1070,13 +1084,12 @@ class Engine:
                 mps = {1 << max(self._pages_needed(T) - 1, 0).bit_length(),
                        1 << max(self._pages_needed(T + 1) - 1,
                                 0).bit_length()}
-                st = self._sampling_tensors([], B)
+                st_f32, st_i32 = self._sampling_tensors([], B)
                 for mp in sorted(mps):
                     _, _, _, _, self.kv = self._jit_prefill(
-                        self.params, jnp.zeros((B, T), jnp.int32),
-                        jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
-                        self.kv, jnp.zeros((B, mp), jnp.int32), st, key,
-                        None, None)
+                        self.params,
+                        jnp.zeros((B, _PREFILL_HDR + T + mp), jnp.int32),
+                        self.kv, st_f32, st_i32, key, None, None, t_len=T)
                 if not extended:
                     break
             if not extended:
@@ -1084,7 +1097,7 @@ class Engine:
 
         # Decode (single + fused multi): every pow2 table width. Inactive
         # slots + NULL pages make the KV writes no-ops.
-        st = self._sampling_tensors([], Bmax)
+        st_f32, st_i32 = self._sampling_tensors([], Bmax)
         widths = []
         w = 1
         while w <= self.ecfg.max_pages_per_seq:
@@ -1097,14 +1110,13 @@ class Engine:
         if not extended:
             widths = widths[:1]
         for mp in widths:
-            args = (self.params, jnp.zeros(Bmax, jnp.int32),
-                    jnp.zeros(Bmax, jnp.int32), jnp.zeros(Bmax, bool),
-                    self.kv, jnp.zeros((Bmax, mp), jnp.int32), st, key,
-                    None)
-            *_, self.kv, _ = self._jit_decode(*args)
+            packed = jnp.zeros((Bmax, _PACK_COLS + mp), jnp.int32)
+            *_, self.kv, _ = self._jit_decode(
+                self.params, packed, self.kv, st_f32, st_i32, key, None)
             if self.ecfg.decode_steps > 1:
-                args = args[:4] + (self.kv,) + args[5:]
-                *_, self.kv, _ = self._jit_decode_multi(*args)
+                *_, self.kv, _ = self._jit_decode_multi(
+                    self.params, packed, self.kv, st_f32, st_i32, key,
+                    None)
         jax.block_until_ready(jax.tree_util.tree_leaves(self.kv)[0])
         return time.monotonic() - t0
 
@@ -1144,9 +1156,14 @@ def _top_row(top_ids, top_lps, row: int) -> List[Dict[str, Any]]:
             for i, l in zip(ids, lps)]
 
 
-def _prefill_step(params, tokens, start_pos, lengths, kv, page_table,
-                  st: SamplingTensors, key, mm_embeds=None,
-                  mm_positions=None, *, cfg: ModelConfig, num_top: int = 0):
+def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
+                  mm_positions=None, *, cfg: ModelConfig, num_top: int = 0,
+                  t_len: int = 0):
+    start_pos = packed[:, 0]
+    lengths = packed[:, 1]
+    tokens = packed[:, _PREFILL_HDR:_PREFILL_HDR + t_len]
+    page_table = packed[:, _PREFILL_HDR + t_len:]
+    st = SamplingTensors.unpack(st_f32, st_i32)
     last_logits, _, kv = transformer.forward_prefill(
         params, cfg, tokens, start_pos, lengths, kv, page_table,
         mm_embeds=mm_embeds, mm_positions=mm_positions)
@@ -1159,9 +1176,13 @@ def _prefill_step(params, tokens, start_pos, lengths, kv, page_table,
     return tok, lp, top_ids, top_lps, kv
 
 
-def _prefill_ring_step(params, tokens, lengths, kv, page_table,
-                       st: SamplingTensors, key, *, cfg: ModelConfig,
-                       num_top: int = 0, mesh=None):
+def _prefill_ring_step(params, packed, kv, st_f32, st_i32, key, *,
+                       cfg: ModelConfig, num_top: int = 0, mesh=None,
+                       t_len: int = 0):
+    lengths = packed[:, 0]
+    tokens = packed[:, _RING_HDR:_RING_HDR + t_len]
+    page_table = packed[:, _RING_HDR + t_len:]
+    st = SamplingTensors.unpack(st_f32, st_i32)
     last_logits, _, kv = transformer.forward_prefill_ring(
         params, cfg, tokens, lengths, kv, page_table, mesh)
     positions = jnp.maximum(lengths - 1, 0)
@@ -1173,9 +1194,13 @@ def _prefill_ring_step(params, tokens, lengths, kv, page_table,
     return tok, lp, top_ids, top_lps, kv
 
 
-def _decode_step(params, tokens, positions, active, kv, page_table,
-                 st: SamplingTensors, key, counts=None, *,
+def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None, *,
                  cfg: ModelConfig, num_top: int = 0):
+    tokens = packed[:, 0]
+    positions = packed[:, 1]
+    active = packed[:, 2].astype(bool)
+    page_table = packed[:, _PACK_COLS:]
+    st = SamplingTensors.unpack(st_f32, st_i32)
     logits, kv = transformer.forward_decode(
         params, cfg, tokens, positions, active, kv, page_table)
     tok = sample_tokens(logits, st, key, positions=positions, counts=counts)
@@ -1188,12 +1213,17 @@ def _decode_step(params, tokens, positions, active, kv, page_table,
     return tok, lp, top_ids, top_lps, kv, counts
 
 
-def _decode_multi_step(params, tokens, positions, active, kv, page_table,
-                       st: SamplingTensors, key, counts=None, *,
-                       cfg: ModelConfig, n_steps: int, num_top: int = 0):
+def _decode_multi_step(params, packed, kv, st_f32, st_i32, key,
+                       counts=None, *, cfg: ModelConfig, n_steps: int,
+                       num_top: int = 0):
     """``n_steps`` fused greedy/sampled decode iterations: the scan body is
     traced once, tokens feed forward on-device, and only the [N, B] token/
     logprob blocks cross back to the host — one dispatch per N tokens."""
+    tokens = packed[:, 0]
+    positions = packed[:, 1]
+    active = packed[:, 2].astype(bool)
+    page_table = packed[:, _PACK_COLS:]
+    st = SamplingTensors.unpack(st_f32, st_i32)
 
     def body(carry, key_i):
         tok, pos, kv, cnt = carry
