@@ -163,6 +163,49 @@ class TestEndToEnd:
                 w.stop()
             master.stop()
 
+    def test_admin_flags_hot_reload(self, store):
+        """SLO thresholds flip at runtime through /admin/flags (the
+        reference marks target_ttft/target_tpot brpc-reloadable,
+        global_gflags.cpp:95-104) and the routing layer sees the new
+        values because ServiceOptions is shared by reference."""
+        master, workers = make_cluster(store)
+        try:
+            status, flags = http_json("GET", master.http_address,
+                                      "/admin/flags")
+            assert status == 200
+            assert flags["target_tpot_ms"] == pytest.approx(
+                master.opts.target_tpot_ms)
+
+            status, resp = http_json(
+                "POST", master.http_address, "/admin/flags",
+                {"target_ttft_ms": 750, "target_tpot_ms": 25})
+            assert status == 200, resp
+            # The scheduler/InstanceMgr routing path reads the same
+            # options object — no restart, next request uses these.
+            assert master.scheduler.instance_mgr.opts.target_ttft_ms == 750
+            assert master.scheduler.opts.target_tpot_ms == 25
+
+            status, resp = http_json(
+                "POST", master.http_address, "/admin/flags",
+                {"nope": 1})
+            assert status == 400
+            # Atomicity: a rejected batch must leave EVERY flag untouched,
+            # including the valid keys that preceded the bad one.
+            status, resp = http_json(
+                "POST", master.http_address, "/admin/flags",
+                {"target_ttft_ms": 111, "target_tpot_ms": -5})
+            assert status == 400
+            assert master.opts.target_ttft_ms == 750
+            status, resp = http_json(
+                "POST", master.http_address, "/admin/flags",
+                {"target_tpot_ms": "nan"})
+            assert status == 400
+            assert master.opts.target_tpot_ms == 25
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
     def test_worker_failure_detected_via_lease(self, store):
         master, workers = make_cluster(store)
         try:

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import queue
 import threading
 
@@ -66,6 +67,8 @@ class HttpService:
         router.route("GET", "/v1/models", self._models)
         router.route("GET", "/metrics", self._metrics)
         router.route("POST", "/model/triggers", self._model_triggers)
+        router.route("POST", "/admin/flags", self._admin_flags)
+        router.route("GET", "/admin/flags", self._admin_flags_get)
 
     # ------------------------------------------------------------------
     # Request building (generate_request, service.cpp:239-267)
@@ -346,3 +349,45 @@ class HttpService:
             return Response.error(404,
                                   f"model {model} not found on any instance")
         return Response.json({"ok": True, "results": results})
+
+    # ------------------------------------------------------------------
+    # Hot-reloadable SLO flags (the reference marks target_ttft /
+    # target_tpot brpc-reloadable, global_gflags.cpp:95-104; here any
+    # field in _RELOADABLE flips at runtime — ServiceOptions is shared by
+    # reference with the scheduler and InstanceMgr, so routing sees the
+    # new thresholds on the next request)
+    # ------------------------------------------------------------------
+    _RELOADABLE = ("target_ttft_ms", "target_tpot_ms")
+
+    def _admin_flags_get(self, http_req: Request) -> Response:
+        return Response.json(
+            {k: getattr(self.opts, k) for k in self._RELOADABLE})
+
+    def _admin_flags(self, http_req: Request) -> Response:
+        try:
+            body = http_req.json()
+        except ValueError:
+            return Response.error(400, "invalid JSON body")
+        if not isinstance(body, dict):
+            return Response.error(400, "body must be a JSON object")
+        unknown = [k for k in body if k not in self._RELOADABLE]
+        if unknown:
+            return Response.error(
+                400, f"not reloadable: {unknown}; "
+                     f"reloadable flags: {list(self._RELOADABLE)}")
+        # Validate everything BEFORE mutating anything: a 400 must leave
+        # the service exactly as it was, never half-reconfigured.
+        validated = {}
+        for k, v in body.items():
+            try:
+                val = float(v)
+            except (TypeError, ValueError):
+                return Response.error(400, f"{k} must be a number")
+            if not (math.isfinite(val) and val > 0):
+                return Response.error(
+                    400, f"{k} must be a positive finite number")
+            validated[k] = val
+        for k, val in validated.items():
+            setattr(self.opts, k, val)
+        logger.info("admin flag reload: %s", validated)
+        return Response.json({"ok": True, "updated": validated})
