@@ -111,6 +111,15 @@ def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
 # — two separate compiled programs, one source of truth)
 # ---------------------------------------------------------------------------
 
+def _use_prefill_kernel(window: int, page_size: int) -> bool:
+    """Trace-time gate for the Pallas flash-prefill kernel: env-enabled
+    AND the window tiles exactly into pool pages (engine buckets are pow2
+    multiples of the page size at serving shapes; odd test shapes fall
+    back to the XLA path)."""
+    from xllm_service_tpu.ops.pallas import prefill_kernel_enabled
+    return prefill_kernel_enabled() and window % page_size == 0
+
+
 def _qkv(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray):
     """x: [B, T, D] → q [B, T, Hq, Dh], k/v [B, T, Hkv, Dh]."""
     B, T, _ = x.shape
@@ -205,15 +214,25 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         q, k, v = _qkv(lp, cfg, h)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        # Attend against cache (prefix-cache hits) + this step's fresh K/V
-        # overlaid on the gathered view. The pool itself is NOT written
-        # here: emitting updated pools as scan ys would rewrite the whole
-        # pool per call — the fresh rows come out as small ys instead and
-        # land in one scatter after the scan.
-        k_all = overlay_fresh_kv(gather_pages(kp, page_table), k, start_pos)
-        v_all = overlay_fresh_kv(gather_pages(vp, page_table), v, start_pos)
-        attn = mha_prefill_auto(q, k_all, v_all, kv_lengths, start_pos)
+        # Attend against cache (prefix-cache hits) + this step's fresh K/V.
+        # The pool itself is NOT written here: emitting updated pools as
+        # scan ys would rewrite the whole pool per call — the fresh rows
+        # come out as small ys instead and land in one scatter after the
+        # scan. Two paths (trace-time choice): the gated Pallas kernel
+        # streams pool pages + fresh blocks directly (no gathered-view
+        # materialization); the XLA reference gathers then overlays.
         B, T = tokens.shape
+        if _use_prefill_kernel(T, kp.shape[1]):
+            from xllm_service_tpu.ops.pallas import (
+                paged_prefill_attention_pallas)
+            attn = paged_prefill_attention_pallas(
+                q, k, v, kp, vp, page_table, start_pos, lengths)
+        else:
+            k_all = overlay_fresh_kv(gather_pages(kp, page_table), k,
+                                     start_pos)
+            v_all = overlay_fresh_kv(gather_pages(vp, page_table), v,
+                                     start_pos)
+            attn = mha_prefill_auto(q, k_all, v_all, kv_lengths, start_pos)
         x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
         h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
         x = x + _mlp(lp, cfg, h, valid=tok_valid)

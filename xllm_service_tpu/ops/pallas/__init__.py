@@ -23,6 +23,10 @@ def enabled() -> bool:
         return False
     if env in ("1", "true", "yes"):
         return True
+    return _on_tpu()
+
+
+def _on_tpu() -> bool:
     try:
         # devices()[0].platform is "tpu" even when the backend registers
         # under another name (e.g. the tunneled "axon" plugin).
@@ -31,5 +35,15 @@ def enabled() -> bool:
         return False
 
 
+def default_interpret() -> bool:
+    """Kernel ``interpret=None`` resolution, shared by every kernel: run
+    under the Pallas interpreter anywhere but a real TPU (so XLLM_PALLAS=1
+    on CPU exercises kernel paths in tests instead of crashing in
+    Mosaic)."""
+    return not _on_tpu()
+
+
 from xllm_service_tpu.ops.pallas.paged_attention import (  # noqa: E402,F401
     paged_decode_attention_pallas)
+from xllm_service_tpu.ops.pallas.prefill_attention import (  # noqa: E402,F401
+    paged_prefill_attention_pallas, prefill_kernel_enabled)

@@ -142,7 +142,7 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
                                   context_lens: jnp.ndarray,
                                   k_cur: jnp.ndarray = None,
                                   v_cur: jnp.ndarray = None,
-                                  interpret: bool = False,
+                                  interpret: bool = None,
                                   transpose_free: bool = None
                                   ) -> jnp.ndarray:
     """q: [B, Hq, D]; k/v_pages: [P, ps, Hkv, D]; page_table: [B, MP];
@@ -153,9 +153,14 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
 
     ``transpose_free=None`` resolves the XLLM_PALLAS_DECODE_V2 env var
     HERE, outside the jit cache, so runtime toggles take effect (the
-    sibling XLLM_PALLAS gate has the same call-time semantics)."""
+    sibling XLLM_PALLAS gate has the same call-time semantics).
+    ``interpret=None`` → Pallas interpreter off TPU (XLLM_PALLAS=1 on CPU
+    exercises the kernel path in tests instead of crashing in Mosaic)."""
     if transpose_free is None:
         transpose_free = _transpose_free_default()
+    if interpret is None:
+        from xllm_service_tpu.ops import pallas
+        interpret = pallas.default_interpret()
     return _paged_decode_attention_impl(
         q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur,
         interpret=interpret, transpose_free=transpose_free)

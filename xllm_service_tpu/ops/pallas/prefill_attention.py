@@ -1,0 +1,240 @@
+"""Pallas TPU kernel: causal GQA prefill attention over the paged KV cache.
+
+Replaces the XLA prefill path (ops/attention.py) which, per layer, gathers
+every referenced page into a dense [B, S, Hkv, D] view and overlays the
+window's fresh K/V before attending — a full cache materialization whose
+HBM traffic grows with table width even for short windows. Here each
+(batch, query-block) program walks the KV sources directly:
+
+- the first ``MP`` steps of the kv axis stream the sequence's *pool pages*
+  HBM→VMEM via a scalar-prefetched page table (exactly the decode kernel's
+  pattern, ops/pallas/paged_attention.py) — these cover the cached prefix
+  positions ``[0, q_start)``;
+- the remaining ``T // ps`` steps stream the *fresh* K/V blocks of the
+  current window (global positions ``[q_start, q_start + len)``), which at
+  attention time are not yet written to the pool (the engine defers pool
+  writes to one post-scan scatter, models/transformer.py).
+
+Each step folds one ``ps``-wide KV block into a flash-style online-softmax
+accumulator in VMEM scratch. The query block is re-laid out for the MXU
+once per (b, q-block) — at kv step 0, into scratch as [Hkv, QB·G, D] — so
+every fold uses the same batched-over-Hkv 3D dot shapes the decode kernel
+uses, with no per-step relayout.
+
+Both KV refs are DMA'd every step (Pallas loads every input block per grid
+cell); the unused source indexes block 0 and its bytes are ignored. The
+pipeline overlaps these DMAs with the previous step's compute.
+
+Masking: pool positions are valid while ``pos < q_start[b]`` (the cached
+prefix only — pool content past it is stale); fresh positions are valid
+while their window-local index is ``< lengths[b]``; causality masks
+``pos > q_pos``. Fully-masked steps skip their MXU work via ``pl.when``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def prefill_kernel_enabled() -> bool:
+    """Call-time gate (sibling of XLLM_PALLAS / XLLM_PALLAS_DECODE_V2):
+    off by default until validated on hardware. Requires the base Pallas
+    gate too — there is no interpret fallback on the serving path."""
+    if os.environ.get("XLLM_PALLAS_PREFILL", "0") != "1":
+        return False
+    from xllm_service_tpu.ops import pallas
+    return pallas.enabled()
+
+
+def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
+            vf_ref, o_ref, qt_ref, m_ref, l_ref, acc_ref, *,
+            page_size: int, q_block: int, num_pool_steps: int,
+            num_kv_steps: int, num_kv_heads: int):
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    s = pl.program_id(2)
+
+    hq, d = q_ref.shape[3], q_ref.shape[4]
+    g = hq // num_kv_heads
+    q_start = qstart_ref[b]
+    length = lens_ref[b]
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        # One MXU-friendly relayout of the query block per (b, qi):
+        # [QB, Hq, D] -> [Hkv, QB*G, D], reused by every kv fold.
+        q = q_ref[0, 0].astype(jnp.float32)                  # [QB, Hq, D]
+        qg = q.reshape(q_block, num_kv_heads, g, d)
+        qt_ref[:] = jnp.transpose(qg, (1, 0, 2, 3)).reshape(
+            num_kv_heads, q_block * g, d)
+
+    is_pool = s < num_pool_steps
+    # Global position of this block's first kv token.
+    pool_base = s * page_size
+    fresh_local_base = (s - num_pool_steps) * page_size
+    base = jnp.where(is_pool, pool_base, q_start + fresh_local_base)
+
+    # Query rows of this block sit at global positions q_start + qi*QB + t
+    # (padded rows past ``length`` produce garbage that the engine never
+    # reads — the last valid row is selected downstream).
+    q_lo = q_start + qi * q_block
+
+    # A pool step is live while it intersects the cached prefix; a fresh
+    # step while it intersects the true window AND is not entirely above
+    # the causal diagonal of this query block.
+    live_pool = is_pool & (pool_base < q_start)
+    live_fresh = jnp.logical_not(is_pool) & \
+        (fresh_local_base < length) & (base <= q_lo + q_block - 1)
+
+    @pl.when(live_pool | live_fresh)
+    def _fold():
+        kb = jnp.where(is_pool, kp_ref[0].astype(jnp.float32),
+                       kf_ref[0, 0].astype(jnp.float32))     # [ps, Hkv, D]
+        vb = jnp.where(is_pool, vp_ref[0].astype(jnp.float32),
+                       vf_ref[0, 0].astype(jnp.float32))
+        scale = 1.0 / (d ** 0.5)
+        qt = qt_ref[:]                                       # [Hkv, QB*G, D]
+        kt = jnp.transpose(kb, (1, 0, 2))                    # [Hkv, ps, D]
+        vt = jnp.transpose(vb, (1, 0, 2))
+        # [Hkv, QB*G, D] x [Hkv, ps, D] -> [Hkv, QB*G, ps]
+        logits = jax.lax.dot_general(
+            qt, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+
+        # Positions: kv along ps, queries along QB (replicated over G).
+        kv_pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, g, page_size), 2)
+        q_pos = q_lo + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, g, page_size), 0)
+        # Pool: valid while pos < q_start. Fresh: valid while the local
+        # index < length. Both: causal.
+        src_ok = jnp.where(is_pool, kv_pos < q_start,
+                           kv_pos < q_start + length)
+        mask3 = (src_ok & (kv_pos <= q_pos)).reshape(
+            1, q_block * g, page_size)                       # [1, QB*G, ps]
+
+        logits = jnp.where(mask3, logits, _NEG_INF)
+        m_prev = m_ref[:]                                    # [Hkv, QB*G, 1]
+        blk_max = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, blk_max)
+        prob = jnp.exp(logits - m_new)
+        prob = jnp.where(mask3, prob, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(prob, axis=-1,
+                                             keepdims=True)
+        # [Hkv, QB*G, ps] x [Hkv, ps, D] -> [Hkv, QB*G, D]
+        pv = jax.lax.dot_general(
+            prob, vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = m_new
+
+    @pl.when(s == num_kv_steps - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:], 1e-30)
+        out = acc_ref[:] / denom                             # [Hkv, QB*G, D]
+        out = out.reshape(num_kv_heads, q_block, g, d)
+        out = jnp.transpose(out, (1, 0, 2, 3)).reshape(q_block, hq, d)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
+                                   v_fresh: jnp.ndarray,
+                                   k_pages: jnp.ndarray,
+                                   v_pages: jnp.ndarray,
+                                   page_table: jnp.ndarray,
+                                   q_start: jnp.ndarray,
+                                   lengths: jnp.ndarray,
+                                   q_block: int = 128,
+                                   interpret: bool = None) -> jnp.ndarray:
+    """q/k_fresh/v_fresh: [B, T, H*, D] (this window, already roped);
+    k/v_pages: [P, ps, Hkv, D]; page_table: [B, MP]; q_start: [B] cached
+    prefix length; lengths: [B] true window length. Requires T % ps == 0
+    (engine buckets are pow2 multiples of the page size — callers check).
+    ``interpret=None`` → Pallas interpreter off TPU (so the gated serving
+    path stays runnable in CPU tests), Mosaic on TPU. Returns
+    [B, T, Hq, D]."""
+    if interpret is None:
+        from xllm_service_tpu.ops import pallas
+        interpret = pallas.default_interpret()
+    return _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table,
+                 q_start, lengths, q_block=q_block, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("q_block", "interpret"))
+def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
+          lengths, *, q_block: int, interpret: bool):
+    B, T, Hq, D = q.shape
+    _, page_size, Hkv, _ = k_pages.shape
+    MP = page_table.shape[1]
+    if T % page_size != 0:
+        raise ValueError(f"window {T} not a multiple of page {page_size}")
+    # Largest block ≤ q_block that tiles T exactly — any window passing
+    # the page-multiple check above gets a valid (if smaller) q block
+    # rather than a trace-time crash on non-pow2 buckets.
+    QB = math.gcd(T, min(q_block, T))
+    nQ = T // QB
+    nF = T // page_size
+    n_kv = MP + nF
+    G = Hq // Hkv
+
+    def pool_idx(b, qi, s, qstart, lens, pt):
+        # Pool steps DMA the mapped page; fresh steps DMA page 0 (unused).
+        return (jnp.where(s < MP, pt[b, jnp.minimum(s, MP - 1)], 0),
+                0, 0, 0)
+
+    def fresh_idx(b, qi, s, qstart, lens, pt):
+        # Fresh steps DMA their T-block; pool steps DMA block 0 (unused).
+        return (b, jnp.maximum(s - MP, 0), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,              # q_start, lengths, page_table
+        grid=(B, nQ, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, 1, QB, Hq, D),
+                         lambda b, qi, s, qstart, lens, pt:
+                         (b, qi, 0, 0, 0)),
+            pl.BlockSpec((1, page_size, Hkv, D), pool_idx),
+            pl.BlockSpec((1, page_size, Hkv, D), pool_idx),
+            pl.BlockSpec((1, 1, page_size, Hkv, D), fresh_idx),
+            pl.BlockSpec((1, 1, page_size, Hkv, D), fresh_idx),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, QB, Hq, D),
+            lambda b, qi, s, qstart, lens, pt: (b, qi, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, QB * G, D), jnp.float32),   # relaid-out q
+            pltpu.VMEM((Hkv, QB * G, 1), jnp.float32),   # running max
+            pltpu.VMEM((Hkv, QB * G, 1), jnp.float32),   # running denom
+            pltpu.VMEM((Hkv, QB * G, D), jnp.float32),   # accumulator
+        ],
+    )
+    # 4D blocks with two leading singleton/block dims: reshape q to
+    # [B, nQ, QB, Hq, D] so the (b, qi) block indexing is direct.
+    q5 = q.reshape(B, nQ, QB, Hq, D)
+    kf5 = k_fresh.reshape(B, nF, page_size, Hkv, D)
+    vf5 = v_fresh.reshape(B, nF, page_size, Hkv, D)
+    out = pl.pallas_call(
+        functools.partial(_kernel, page_size=page_size, q_block=QB,
+                          num_pool_steps=MP, num_kv_steps=n_kv,
+                          num_kv_heads=Hkv),
+        out_shape=jax.ShapeDtypeStruct((B, nQ, QB, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(q_start.astype(jnp.int32), lengths.astype(jnp.int32),
+      page_table, q5, k_pages, v_pages, kf5, vf5)
+    return out.reshape(B, T, Hq, D)
