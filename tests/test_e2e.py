@@ -158,6 +158,20 @@ class TestEndToEnd:
             conn.close()
             assert "xllm_service_instances 1" in text
             assert "xllm_service_is_master 1" in text
+
+            # Worker-local metrics carry the per-phase step-time ledger
+            # (pack/dispatch/readback per program) after serving traffic.
+            status, resp = http_json(
+                "POST", master.http_address, "/v1/completions",
+                {"model": "tiny", "prompt": "warm", "max_tokens": 2,
+                 "temperature": 0.0, "ignore_eos": True}, timeout=60.0)
+            assert status == 200
+            conn = http.client.HTTPConnection(workers[0].name, timeout=10)
+            conn.request("GET", "/metrics")
+            wtext = conn.getresponse().read().decode()
+            conn.close()
+            assert 'xllm_worker_phase_seconds_total' in wtext
+            assert 'phase="prefill.dispatch"' in wtext
         finally:
             for w in workers:
                 w.stop()

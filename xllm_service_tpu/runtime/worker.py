@@ -760,6 +760,23 @@ class Worker:
             for k, v in lm.items():
                 lines.append(
                     f'xllm_worker_{k}{{model="{m}"}} {v}')
+            # Per-phase step-time attribution (pack / dispatch / readback /
+            # post per program) + post-warmup recompile counters — the
+            # same ledger bench.py surfaces, live per serving worker.
+            for name, entry in rt.engine.phase_report().items():
+                if isinstance(entry, dict):
+                    lines.append(
+                        f'xllm_worker_phase_seconds_total'
+                        f'{{model="{m}",phase="{name}"}} '
+                        f'{entry["total_ms"] / 1e3:.6f}')
+                    lines.append(
+                        f'xllm_worker_phase_calls_total'
+                        f'{{model="{m}",phase="{name}"}} {entry["calls"]}')
+                else:   # "<prog>.recompile" counters
+                    program = name.rsplit(".", 1)[0]
+                    lines.append(
+                        f'xllm_worker_recompiles_total'
+                        f'{{model="{m}",program="{program}"}} {entry}')
         lines.append(f"xllm_worker_kv_migration_bytes_total "
                      f"{self.kv_migration_bytes}")
         lines.append(f"xllm_worker_kv_migration_seconds_total "
