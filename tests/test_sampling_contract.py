@@ -151,6 +151,52 @@ class TestApiContract:
         # Greedy: both choices identical text.
         assert choices[0]["text"] == choices[1]["text"]
 
+    def test_best_of_selects_highest_mean_logprob(self, cluster):
+        master, _ = cluster
+        status, resp = http_json(
+            "POST", master.http_address, "/v1/completions",
+            {"model": "tiny", "prompt": "pick the best", "max_tokens": 4,
+             "best_of": 3, "n": 1, "temperature": 1.5, "seed": 7,
+             "ignore_eos": True}, timeout=120.0)
+        assert status == 200, resp
+        choices = resp["choices"]
+        assert len(choices) == 1 and choices[0]["index"] == 0
+        # OpenAI billing: every candidate's tokens count.
+        assert resp["usage"]["completion_tokens"] == 12
+        # The survivor must be the greedy-favored candidate — rerank by
+        # asking for all 3 candidates' logprobs via n=3 with same seed.
+        status, all3 = http_json(
+            "POST", master.http_address, "/v1/completions",
+            {"model": "tiny", "prompt": "pick the best", "max_tokens": 4,
+             "n": 3, "temperature": 1.5, "seed": 7, "logprobs": 0,
+             "ignore_eos": True}, timeout=120.0)
+        assert status == 200, all3
+        means = []
+        for c in all3["choices"]:
+            lps = c["logprobs"]["token_logprobs"]
+            means.append(sum(lps) / len(lps))
+        best_text = all3["choices"][means.index(max(means))]["text"]
+        assert choices[0]["text"] == best_text
+
+    def test_best_of_validation(self, cluster):
+        master, _ = cluster
+        status, resp = http_json(
+            "POST", master.http_address, "/v1/completions",
+            {"model": "tiny", "prompt": "x", "max_tokens": 2,
+             "best_of": 1, "n": 2}, timeout=60.0)
+        assert status == 400
+        status, resp = http_json(
+            "POST", master.http_address, "/v1/completions",
+            {"model": "tiny", "prompt": "x", "max_tokens": 2,
+             "best_of": 3, "n": 1, "stream": True}, timeout=60.0)
+        assert status == 400
+        # Non-numeric best_of is a 400, not a 500.
+        status, resp = http_json(
+            "POST", master.http_address, "/v1/completions",
+            {"model": "tiny", "prompt": "x", "max_tokens": 2,
+             "best_of": "three"}, timeout=60.0)
+        assert status == 400
+
     def test_completion_logprobs(self, cluster):
         master, _ = cluster
         status, resp = http_json(

@@ -56,7 +56,7 @@ from xllm_service_tpu.utils.misc import short_uuid
 from xllm_service_tpu.utils.wire import check_version, stamp
 from xllm_service_tpu.utils.types import (
     FinishReason, LogProb, RequestOutput, SamplingParams, SequenceOutput,
-    Status, StatusCode, Usage, parse_openai_sampling)
+    Status, StatusCode, Usage, parse_openai_sampling, validate_sampling)
 from xllm_service_tpu.utils.locks import make_lock
 
 logger = logging.getLogger(__name__)
@@ -242,9 +242,10 @@ class _StopWatcher:
 
 
 class _Choice:
-    """Per-choice (OpenAI ``n``) streaming state."""
+    """Per-choice (OpenAI ``n`` / ``best_of`` candidate) streaming state."""
 
-    __slots__ = ("decoder", "stopper", "completion_tokens", "finished")
+    __slots__ = ("decoder", "stopper", "completion_tokens", "finished",
+                 "cum_logprob")
 
     def __init__(self, decoder: IncrementalDecoder,
                  stops: Optional[List[str]]) -> None:
@@ -252,6 +253,7 @@ class _Choice:
         self.stopper = _StopWatcher(stops)
         self.completion_tokens = 0
         self.finished = False
+        self.cum_logprob = 0.0
 
 
 class _LiveRequest:
@@ -262,7 +264,7 @@ class _LiveRequest:
     __slots__ = ("req", "q", "tokenizer", "choices", "engine_rids",
                  "stream_to_service", "service_request_id", "model",
                  "is_chat", "stream", "include_usage", "first_out_time",
-                 "sampling", "prompt_tokens")
+                 "sampling", "prompt_tokens", "target_n")
 
     def __init__(self, req: EngineRequest, tokenizer: Tokenizer,
                  service_request_id: str, model: str, is_chat: bool,
@@ -285,6 +287,9 @@ class _LiveRequest:
         self.choices = [_Choice(IncrementalDecoder(tokenizer), stops)
                         for _ in range(n)]
         self.prompt_tokens = 0
+        # best_of: ``n`` above is the CANDIDATE count; target_n is how
+        # many survive server-side selection (set by _parse_generate).
+        self.target_n = n
 
     def choice_index(self, engine_rid: str) -> int:
         if len(self.choices) == 1:
@@ -536,6 +541,7 @@ class Worker:
             elif finish != FinishReason.NONE:
                 text += ch.stopper.flush()
         ch.completion_tokens += len(out.new_token_ids)
+        ch.cum_logprob += sum(out.logprobs)
         logprobs = []
         if live.sampling.logprobs:
             for j, tid in enumerate(out.new_token_ids):
@@ -555,7 +561,10 @@ class Worker:
             ch.finished = True
         seq = SequenceOutput(
             index=idx, text=text, token_ids=list(out.new_token_ids),
-            finish_reason=finish, logprobs=logprobs)
+            finish_reason=finish, logprobs=logprobs,
+            # best_of ranking key, attached on the finish delta only.
+            mean_logprob=(ch.cum_logprob / max(ch.completion_tokens, 1)
+                          if finish != FinishReason.NONE else None))
         all_done = live.all_finished
         usage = None
         if all_done:
@@ -625,8 +634,12 @@ class Worker:
                 list(token_ids), rt.tokenizer.encode(IMAGE_PLACEHOLDER),
                 n_img, tpi, image_token_id(rt.model_cfg.vocab_size))
             mm_embeds = embeds.reshape(n_img * tpi, -1)
-        n = 1 if pd_prefill else max(1, engine_sampling.n)
         stream = bool(body.get("stream", False))
+        validate_sampling(engine_sampling, stream)
+        # best_of: run the larger candidate pool; selection happens at
+        # response assembly (ResponseCollector.target_n).
+        n = 1 if pd_prefill else max(1, engine_sampling.n,
+                                     engine_sampling.best_of or 0)
         include_usage = bool(
             (body.get("stream_options") or {}).get("include_usage", False))
         ereq = EngineRequest(
@@ -647,6 +660,8 @@ class Worker:
             n=n, stops=sampling.stop)
         live.sampling = sampling          # original (pre-pd) params
         live.prompt_tokens = len(token_ids)
+        if not pd_prefill:
+            live.target_n = max(1, sampling.n)
         with self._live_lock:
             self._live_srid[srid] = live
             for erid in live.engine_rids:
@@ -679,15 +694,26 @@ class Worker:
         max_toks = int(sp_body.get("max_tokens",
                                    body.get("max_tokens", 16)))
         n_choices = int(sp_body.get("n", body.get("n", 1)))
+        # best_of runs a candidate pool — like n>1, it decodes locally
+        # (the PD handoff path migrates exactly one sequence). best_of is
+        # a completion-API field; chat ignores it (parse_openai_sampling
+        # nulls it), so a stray best_of on a chat body must not disable
+        # the PD path.
+        try:
+            best_of = 1 if is_chat else int(
+                sp_body.get("best_of") or body.get("best_of")
+                or n_choices)
+        except (TypeError, ValueError):
+            best_of = 1     # _parse_generate rejects the body below
         if (routing.get("prefill_name") == self.name
                 and routing.get("decode_name")
                 and routing["decode_name"] != self.name
-                and max_toks > 1 and n_choices == 1):
+                and max_toks > 1 and n_choices == 1 and best_of <= 1):
             return self._serve_pd_prefill(body, is_chat,
                                           routing["decode_name"])
         try:
             live = self._parse_generate(body, is_chat)
-        except (ValueError, RuntimeError) as e:
+        except (TypeError, ValueError, RuntimeError) as e:
             return Response.error(400, str(e))
         if live.stream_to_service:
             # Topology 2: tokens flow worker → service RPC fan-in; the
@@ -725,7 +751,7 @@ class Worker:
                       initial: Optional[List[RequestOutput]] = None
                       ) -> Response:
         coll = ResponseCollector(live.service_request_id, live.model,
-                                 live.is_chat)
+                                 live.is_chat, target_n=live.target_n)
         for ro in (initial or []):
             coll.add(ro)
         while True:

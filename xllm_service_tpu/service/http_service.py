@@ -40,7 +40,7 @@ from xllm_service_tpu.service.tracer import RequestTracer
 from xllm_service_tpu.utils.misc import short_uuid
 from xllm_service_tpu.utils.types import (
     FinishReason, Request as SchedRequest, RequestOutput,
-    parse_openai_sampling)
+    parse_openai_sampling, validate_sampling)
 from xllm_service_tpu.utils.locks import make_lock
 
 logger = logging.getLogger(__name__)
@@ -118,7 +118,13 @@ class HttpService:
                                 or body.get("token_ids")):
             return Response.error(400, "prompt is required")
 
-        req = self._build_request(body, is_chat, http_req.headers)
+        try:
+            # Both the body parse (e.g. a non-numeric best_of/n) and the
+            # cross-field rules map to 400, never a 500.
+            req = self._build_request(body, is_chat, http_req.headers)
+            validate_sampling(req.sampling, req.stream)
+        except (TypeError, ValueError) as e:
+            return Response.error(400, f"invalid request: {e}")
         self.tracer.trace(req.service_request_id,
                           {"stage": "ingress", "kind": kind, "body": body,
                            "x_request_time": req.arrival_time or None})
@@ -230,7 +236,8 @@ class HttpService:
                         yield frame
             return Response.sse(gen())
 
-        coll = ResponseCollector(req.service_request_id, req.model, is_chat)
+        coll = ResponseCollector(req.service_request_id, req.model, is_chat,
+                                 target_n=max(1, req.sampling.n))
         while True:
             try:
                 out = next_output()

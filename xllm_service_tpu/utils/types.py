@@ -104,15 +104,22 @@ class SequenceOutput:
     token_ids: List[int] = dataclasses.field(default_factory=list)
     finish_reason: FinishReason = FinishReason.NONE
     logprobs: List[LogProb] = dataclasses.field(default_factory=list)
+    # Mean token logprob of the whole choice, attached on its finish
+    # delta — the server-side ``best_of`` ranking key (always computed
+    # engine-side even when the client didn't ask for logprobs).
+    mean_logprob: Optional[float] = None
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "index": self.index,
             "text": self.text,
             "token_ids": self.token_ids,
             "finish_reason": self.finish_reason.value,
             "logprobs": [lp.to_json() for lp in self.logprobs],
         }
+        if self.mean_logprob is not None:
+            out["mean_logprob"] = self.mean_logprob
+        return out
 
     @classmethod
     def from_json(cls, d: Dict[str, Any]) -> "SequenceOutput":
@@ -126,6 +133,7 @@ class SequenceOutput:
             token_ids=d.get("token_ids", []),
             finish_reason=fr,
             logprobs=[LogProb.from_json(x) for x in d.get("logprobs", [])],
+            mean_logprob=d.get("mean_logprob"),
         )
 
 
@@ -205,6 +213,9 @@ class SamplingParams:
     top_p: float = 1.0
     top_k: int = 0
     n: int = 1
+    # Completion API: generate ``best_of`` candidates server-side, return
+    # the ``n`` with the highest mean token logprob (None → best_of == n).
+    best_of: Optional[int] = None
     stop: List[str] = dataclasses.field(default_factory=list)
     stop_token_ids: List[int] = dataclasses.field(default_factory=list)
     seed: Optional[int] = None
@@ -244,6 +255,7 @@ def parse_openai_sampling(body: Dict[str, Any],
         lp = body.get("logprobs")
         logprobs = lp is not None and lp is not False
         top_logprobs = int(lp) if isinstance(lp, int) else 0
+    best_of = body.get("best_of")
     return SamplingParams(
         max_tokens=int(body.get("max_tokens",
                                 body.get("max_completion_tokens", 16))),
@@ -251,6 +263,9 @@ def parse_openai_sampling(body: Dict[str, Any],
         top_p=float(body.get("top_p", 1.0)),
         top_k=int(body.get("top_k", 0)),
         n=int(body.get("n", 1)),
+        # best_of is a completion-API field (reference completion.proto:21)
+        best_of=(int(best_of) if not is_chat and best_of is not None
+                 else None),
         stop=[str(s) for s in stop],
         stop_token_ids=list(body.get("stop_token_ids") or []),
         seed=body.get("seed"),
@@ -259,6 +274,18 @@ def parse_openai_sampling(body: Dict[str, Any],
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
         ignore_eos=bool(body.get("ignore_eos", False)))
+
+
+def validate_sampling(sp: SamplingParams, stream: bool) -> None:
+    """OpenAI cross-field rules, shared by the service front door and the
+    direct-to-worker path. Raises ValueError (callers map to HTTP 400)."""
+    if sp.n < 1:
+        raise ValueError("n must be >= 1")
+    if sp.best_of is not None:
+        if sp.best_of < sp.n:
+            raise ValueError("best_of must be >= n")
+        if stream and sp.best_of > sp.n:
+            raise ValueError("best_of > n cannot be used with streaming")
 
 
 @dataclasses.dataclass
