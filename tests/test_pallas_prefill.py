@@ -75,6 +75,52 @@ class TestPallasPrefill:
                   q_starts=[0], lengths=[24])
 
 
+class TestPromptLogprobs:
+    def test_values_match_direct_forward(self):
+        """Engine prompt scoring (echo+logprobs) must equal log-softmax
+        of the model's own next-token distributions — including across a
+        chunked-prefill window boundary (prompt > largest bucket)."""
+        import jax
+        import jax.numpy as jnp
+        from xllm_service_tpu.config import EngineConfig, ModelConfig
+        from xllm_service_tpu.models import transformer
+        from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+        from xllm_service_tpu.utils.types import SamplingParams
+
+        cfg = ModelConfig.tiny(vocab_size=128)
+        ecfg = EngineConfig(page_size=16, num_pages=64, max_model_len=128,
+                            max_batch_size=2, max_prefill_tokens=64,
+                            prefill_buckets=(16, 32))
+        prompt = [(7 * i + 3) % 120 + 1 for i in range(48)]  # 2 windows
+        eng = Engine(cfg, ecfg, seed=0)
+        eng.add_request(EngineRequest(
+            request_id="plp", token_ids=list(prompt),
+            sampling=SamplingParams(max_tokens=1, temperature=0.0,
+                                    ignore_eos=True),
+            prompt_logprobs=True))
+        got = None
+        while eng.has_work():
+            for out in eng.step():
+                if out.prompt_logprobs is not None:
+                    got = out.prompt_logprobs
+        assert got is not None and len(got) == len(prompt)
+        assert got[0] is None
+
+        # Reference: one monolithic forward over the whole prompt.
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        kv = transformer.init_kv_cache(cfg, 8, 64, jnp.dtype(cfg.dtype))
+        pt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        toks = jnp.asarray([prompt], jnp.int32)
+        _, all_logits, _ = transformer.forward_prefill(
+            params, cfg, toks, jnp.zeros(1, jnp.int32),
+            jnp.asarray([len(prompt)], jnp.int32), kv, pt,
+            return_all_logits=True)
+        ref_lps = jax.nn.log_softmax(all_logits[0], axis=-1)
+        for g in range(1, len(prompt)):
+            want = float(ref_lps[g - 1, prompt[g]])
+            assert got[g] == pytest.approx(want, abs=2e-3), g
+
+
 class TestEnginePrefillKernelPath:
     def test_generations_identical_to_xla_path(self, monkeypatch):
         """Two engines, same seed/prompts — one serving through the gated

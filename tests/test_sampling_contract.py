@@ -197,6 +197,72 @@ class TestApiContract:
              "best_of": "three"}, timeout=60.0)
         assert status == 400
 
+    def test_echo_prepends_prompt_text(self, cluster):
+        master, _ = cluster
+        status, plain = http_json(
+            "POST", master.http_address, "/v1/completions",
+            {"model": "tiny", "prompt": "echo me", "max_tokens": 3,
+             "temperature": 0.0, "ignore_eos": True}, timeout=120.0)
+        assert status == 200, plain
+        status, resp = http_json(
+            "POST", master.http_address, "/v1/completions",
+            {"model": "tiny", "prompt": "echo me", "max_tokens": 3,
+             "temperature": 0.0, "ignore_eos": True, "echo": True},
+            timeout=120.0)
+        assert status == 200, resp
+        assert resp["choices"][0]["text"] == \
+            "echo me" + plain["choices"][0]["text"]
+        # Usage is unchanged by echo — prompt tokens aren't billed twice.
+        assert resp["usage"] == plain["usage"]
+
+    def test_echo_with_logprobs_scores_prompt(self, cluster):
+        master, _ = cluster
+        prompt = "score the prompt"
+        status, resp = http_json(
+            "POST", master.http_address, "/v1/completions",
+            {"model": "tiny", "prompt": prompt, "max_tokens": 2,
+             "temperature": 0.0, "ignore_eos": True, "echo": True,
+             "logprobs": 0}, timeout=120.0)
+        assert status == 200, resp
+        ch = resp["choices"][0]
+        lp = ch["logprobs"]
+        n_prompt = resp["usage"]["prompt_tokens"]
+        n_total = n_prompt + resp["usage"]["completion_tokens"]
+        assert len(lp["tokens"]) == n_total
+        assert len(lp["token_logprobs"]) == n_total
+        # First prompt token has nothing to condition on → null; the
+        # rest are real (negative) log-probabilities.
+        assert lp["token_logprobs"][0] is None
+        assert all(isinstance(v, float) and v <= 0.0
+                   for v in lp["token_logprobs"][1:])
+        # The token strings reassemble exactly the echoed text.
+        assert "".join(lp["tokens"]) == ch["text"]
+        # Offsets line up with the echoed text.
+        assert lp["text_offset"][0] == 0
+        assert lp["text_offset"][-1] < len(ch["text"])
+
+    def test_echo_logprobs_with_candidates(self, cluster):
+        """echo + logprobs + n>1: the prompt is scored ONCE (candidate 0)
+        and every choice's arrays still lead with the prompt tokens."""
+        master, _ = cluster
+        status, resp = http_json(
+            "POST", master.http_address, "/v1/completions",
+            {"model": "tiny", "prompt": "shared scoring", "max_tokens": 2,
+             "n": 2, "temperature": 0.0, "ignore_eos": True,
+             "echo": True, "logprobs": 0}, timeout=120.0)
+        assert status == 200, resp
+        n_prompt = resp["usage"]["prompt_tokens"]
+        assert len(resp["choices"]) == 2
+        prompt_arrays = []
+        for ch in resp["choices"]:
+            lp = ch["logprobs"]
+            assert len(lp["tokens"]) == n_prompt + 2
+            assert lp["token_logprobs"][0] is None
+            assert "".join(lp["tokens"]) == ch["text"]
+            prompt_arrays.append(tuple(lp["token_logprobs"][1:n_prompt]))
+        # Same prompt scores on both choices (computed once, shared).
+        assert prompt_arrays[0] == prompt_arrays[1]
+
     def test_completion_logprobs(self, cluster):
         master, _ = cluster
         status, resp = http_json(
@@ -273,3 +339,44 @@ def test_seeded_sampling_deterministic_across_engines():
     c = _run_engine(SamplingParams(max_tokens=8, temperature=1.0,
                                    ignore_eos=True, seed=43))
     assert c != a
+
+
+def test_echo_scoring_source_cancelled_releases_held_choices():
+    """echo+logprobs with n>1: if candidate 0 (the score source) is
+    cancelled before its prefill scores the prompt, held choices must be
+    released (with empty prompt scores) instead of hanging forever."""
+    from xllm_service_tpu.nlp.tokenizer import TokenizerFactory
+    from xllm_service_tpu.runtime.engine import StepOutput
+    from xllm_service_tpu.runtime.worker import _LiveRequest
+    from xllm_service_tpu.utils.types import FinishReason
+
+    tok = TokenizerFactory.create_tokenizer(None)
+    req = EngineRequest(request_id="r", token_ids=[65, 66, 67],
+                        sampling=SamplingParams())
+    live = _LiveRequest(req, tok, "r", "tiny", is_chat=False, stream=False,
+                        include_usage=False, stream_to_service=False, n=2)
+    live.sampling = parse_openai_sampling(
+        {"echo": True, "logprobs": 0, "n": 2}, is_chat=False)
+    live.prompt_tokens = 3
+
+    class _W:  # only the two methods under test, unbound from a Worker
+        _process_step_output = Worker._process_step_output
+        _to_request_output = Worker._to_request_output
+        _cancel_engine_request = lambda self, live, rid: None  # noqa: E731
+    w = _W()
+
+    # Choice 1 finishes first — held (no scores yet).
+    out1 = StepOutput(request_id="r#1", new_token_ids=[70], logprobs=[-0.5],
+                      finish_reason=FinishReason.LENGTH,
+                      num_prompt_tokens=3, num_generated=1)
+    assert w._process_step_output(live, out1) == []
+    assert live.choices[1].pending
+    # Candidate 0 is cancelled before scoring: everything must flush.
+    out0 = StepOutput(request_id="r#0", new_token_ids=[], logprobs=[],
+                      finish_reason=FinishReason.CANCELLED,
+                      num_prompt_tokens=3, num_generated=0)
+    ros = w._process_step_output(live, out0)
+    texts = {ro.outputs[0].index: ro.outputs[0].text for ro in ros}
+    assert 1 in texts          # held choice released
+    assert live.prompt_lps == []
+    assert live.all_finished

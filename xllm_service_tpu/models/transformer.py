@@ -179,6 +179,7 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                     return_all_logits: bool = False,
                     mm_embeds: Optional[jnp.ndarray] = None,
                     mm_positions: Optional[jnp.ndarray] = None,
+                    prompt_lp_targets: Optional[jnp.ndarray] = None,
                     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], KVCache]:
     """Prefill ``tokens`` [B, T] (padded; true new-token counts in
     ``lengths``; nonzero ``start_pos`` = prefix-cache hit, those tokens are
@@ -250,7 +251,36 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     last_x = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
     last_logits = (last_x @ head).astype(jnp.float32)            # [B, V]
     all_logits = (x @ head).astype(jnp.float32) if return_all_logits else None
+    if prompt_lp_targets is not None:
+        # 4-tuple ONLY on the echo+logprobs path: existing callers (and
+        # the driver's entry contract) unpack three.
+        plp = _prompt_logprobs(x, head, prompt_lp_targets)
+        return last_logits, all_logits, (k_pages, v_pages), plp
     return last_logits, all_logits, (k_pages, v_pages)
+
+
+def _prompt_logprobs(x: jnp.ndarray, head: jnp.ndarray,
+                     targets: jnp.ndarray,
+                     chunk: int = 128) -> jnp.ndarray:
+    """logprob of ``targets[b, t]`` under the distribution predicted at
+    position ``t`` — the completion API's ``echo`` + ``logprobs`` prompt
+    scoring. Chunked over T so the [B, c, V] logits block (not the full
+    [B, T, V]) is the peak intermediate."""
+    B, T, D = x.shape
+    c = math.gcd(T, min(chunk, T))
+    xc = x.reshape(B, T // c, c, D).transpose(1, 0, 2, 3)     # [nc,B,c,D]
+    tc = targets.reshape(B, T // c, c).transpose(1, 0, 2)     # [nc,B,c]
+
+    def one(args):
+        xb, tb = args                                  # [B, c, D], [B, c]
+        logits = (xb @ head).astype(jnp.float32)       # [B, c, V]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits, tb[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return tgt - lse                               # [B, c]
+
+    out = jax.lax.map(one, (xc, tc))                   # [nc, B, c]
+    return out.transpose(1, 0, 2).reshape(B, T)
 
 
 def forward_prefill_ring(params: Params, cfg: ModelConfig,

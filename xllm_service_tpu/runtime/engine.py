@@ -81,6 +81,11 @@ class EngineRequest:
     # positions they splice into (image-placeholder token spans).
     mm_embeds: Optional[np.ndarray] = None
     mm_positions: Optional[List[int]] = None
+    # Completion-API echo+logprobs: score every prompt token (the first
+    # is None — nothing to condition on). Such sequences prefill in
+    # singleton batches through a separate jitted program and skip
+    # prefix-cache hits (cached positions are never re-scored).
+    prompt_logprobs: bool = False
 
 
 class SeqStatus(enum.Enum):
@@ -100,6 +105,9 @@ class Sequence:
     status: SeqStatus = SeqStatus.WAITING
     first_token_time: float = 0.0
     preemptions: int = 0
+    # echo+logprobs: per-prompt-token logprobs, filled window by window
+    # (index 0 stays None), emitted with the prompt-completion output.
+    prompt_lps: Optional[List[Optional[float]]] = None
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -124,6 +132,9 @@ class StepOutput:
     # (present only when the engine computes them and the request asked
     # for logprobs).
     top_logprobs: Optional[List[List[Dict[str, Any]]]] = None
+    # echo+logprobs: one entry per PROMPT token (first None), attached to
+    # the output that carries the first sampled token.
+    prompt_logprobs: Optional[List[Optional[float]]] = None
 
     @property
     def finished(self) -> bool:
@@ -189,6 +200,13 @@ class Engine:
         K = engine_cfg.num_top_logprobs
         self._jit_prefill = jax.jit(
             functools.partial(_prefill_step, cfg=model_cfg, num_top=K),
+            donate_argnums=(2,), static_argnames=("t_len",))
+        # echo+logprobs variant: also scores every window token. Compiled
+        # on first use (rare path; the recompile counter will note it) —
+        # warmup stays lean.
+        self._jit_prefill_plp = jax.jit(
+            functools.partial(_prefill_step, cfg=model_cfg, num_top=K,
+                              with_prompt_lps=True),
             donate_argnums=(2,), static_argnames=("t_len",))
         # Sequence-parallel ring prefill: available when the mesh has an
         # sp axis — prompts longer than the largest single-chip bucket
@@ -350,13 +368,15 @@ class Engine:
         slot = self._free_slot()
         if slot < 0:
             return False
-        if seq.req.mm_embeds is None:
+        if seq.req.mm_embeds is None and not seq.req.prompt_logprobs:
             cached_pages, cached_tokens = \
                 self.prefix_cache.match_prefix(seq.req.token_ids)
         else:
             # Multimodal KV depends on image content, not just token ids
             # (placeholder spans are identical across images) — such
             # sequences neither hit nor feed the content-addressed cache.
+            # prompt_logprobs sequences skip hits too: cached positions
+            # would never be scored.
             cached_pages, cached_tokens = [], 0
         window = self._next_window(seq, cached_tokens)
         final = cached_tokens + window >= len(seq.tokens)
@@ -399,9 +419,12 @@ class Engine:
     def _ring_eligible(self, seq: Sequence, start: int) -> bool:
         """Ring prefill takes whole prompts only (global positions start at
         0 inside the sp shard_map): no cached prefix, no partial windows,
-        no multimodal splice."""
+        no multimodal splice, and no prompt scoring (the ring program
+        never computes prompt logprobs — echo+logprobs prompts must take
+        the chunked-window path that does)."""
         return (self._jit_prefill_ring is not None and start == 0
                 and seq.req.mm_embeds is None
+                and not seq.req.prompt_logprobs
                 and len(seq.tokens) > self.ecfg.prefill_buckets[-1]
                 and len(seq.tokens) <=
                 self.ecfg.prefill_buckets[-1] * self._sp)
@@ -417,6 +440,7 @@ class Engine:
         seq.pages = []
         seq.num_computed = 0
         seq.status = SeqStatus.WAITING
+        seq.prompt_lps = None          # re-scored on re-prefill
         seq.preemptions += 1
         self.num_preemptions += 1
         if seq in self.running:
@@ -544,6 +568,8 @@ class Engine:
                 break
             if window > cap1 and batch:
                 break                       # ring window runs alone
+            if seq.req.prompt_logprobs and batch:
+                break                       # plp windows run alone too
             if seq.slot < 0:
                 if not self._try_admit(seq):
                     break
@@ -559,8 +585,8 @@ class Engine:
             budget -= window
             self.waiting.remove(seq)
             batch.append(seq)
-            if window > cap1:
-                break                       # ring batch is a singleton
+            if window > cap1 or seq.req.prompt_logprobs:
+                break          # ring / prompt-scored batch is a singleton
             if budget <= 0 or len(batch) >= self.ecfg.max_batch_size:
                 break
         return batch
@@ -605,6 +631,20 @@ class Engine:
             st_f32, st_i32 = self._sampling_tensors(
                 [s.req.sampling for s in batch], B)
             self._rng_key, key = jax.random.split(self._rng_key)
+            # echo+logprobs: singleton batch (scheduler guarantees it).
+            # targets[t] = the prompt token following window position t
+            # (next window's first token at the boundary; don't-care 0
+            # past the prompt).
+            plp_mode = batch[0].req.prompt_logprobs
+            plp_targets = None
+            if plp_mode:
+                seq0 = batch[0]
+                tgt = np.zeros((B, T), np.int32)
+                for t in range(windows[0]):
+                    g = seq0.num_computed + t + 1
+                    if g < seq0.num_prompt_tokens:
+                        tgt[0, t] = seq0.tokens[g]
+                plp_targets = jnp.asarray(tgt)
             mm_e = mm_p = None
             if any(s.req.mm_embeds is not None for s in batch):
                 # Pad the multimodal splice to a pow2 bucket; positions are
@@ -625,20 +665,40 @@ class Engine:
                             mm_e[i, j] = seq.req.mm_embeds[j]
                 mm_e = jnp.asarray(mm_e)
                 mm_p = jnp.asarray(mm_p)
-        cache_before = self._jit_cache_size(self._jit_prefill)
+        jitted = self._jit_prefill_plp if plp_mode else self._jit_prefill
+        cache_before = self._jit_cache_size(jitted)
         with self._phase("prefill.dispatch"):
-            next_tok, logprob, top_ids, top_lps, self.kv = \
-                self._jit_prefill(
-                    self.params, jnp.asarray(packed), self.kv,
-                    st_f32, st_i32, key, mm_e, mm_p, t_len=T)
-        self._note_recompile("prefill", self._jit_prefill, cache_before)
+            if plp_mode:
+                next_tok, logprob, top_ids, top_lps, self.kv, plp = \
+                    jitted(self.params, jnp.asarray(packed), self.kv,
+                           st_f32, st_i32, key, mm_e, mm_p,
+                           plp_targets, t_len=T)
+            else:
+                plp = None
+                next_tok, logprob, top_ids, top_lps, self.kv = \
+                    jitted(self.params, jnp.asarray(packed), self.kv,
+                           st_f32, st_i32, key, mm_e, mm_p, t_len=T)
+        self._note_recompile("prefill_plp" if plp_mode else "prefill",
+                             jitted, cache_before)
         with self._phase("prefill.readback"):
             next_tok = np.asarray(next_tok)
             logprob = np.asarray(logprob)
+            if plp is not None:
+                plp = np.asarray(plp)
             if top_ids is not None:
                 # One bulk device->host transfer, not one per sequence.
                 top_ids = np.asarray(top_ids)
                 top_lps = np.asarray(top_lps)
+        if plp is not None:
+            # Stitch this window's scores into the per-sequence ledger:
+            # window position t scored the token at global t+1.
+            seq0 = batch[0]
+            if seq0.prompt_lps is None:
+                seq0.prompt_lps = [None] * seq0.num_prompt_tokens
+            for t in range(windows[0]):
+                g = seq0.num_computed + t + 1
+                if g < seq0.num_prompt_tokens:
+                    seq0.prompt_lps[g] = float(plp[0, t])
         # Batch membership changed: the penalty histogram (if any) must be
         # rebuilt from host truth before the next penalized decode.
         self._counts = None
@@ -663,9 +723,13 @@ class Engine:
                 seq.first_token_time = now
                 self.running.append(seq)
                 tok = int(next_tok[i])
-                outs.append(self._append_token(
+                out = self._append_token(
                     seq, tok, float(logprob[i]),
-                    top=self._top_entry(seq, top_ids, top_lps, i)))
+                    top=self._top_entry(seq, top_ids, top_lps, i))
+                if seq.prompt_lps is not None:
+                    out.prompt_logprobs = seq.prompt_lps
+                    seq.prompt_lps = None
+                outs.append(out)
                 self._sync_slot(seq)
         return outs
 
@@ -1157,22 +1221,30 @@ def _top_row(top_ids, top_lps, row: int) -> List[Dict[str, Any]]:
 
 
 def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
-                  mm_positions=None, *, cfg: ModelConfig, num_top: int = 0,
-                  t_len: int = 0):
+                  mm_positions=None, plp_targets=None, *, cfg: ModelConfig,
+                  num_top: int = 0, t_len: int = 0,
+                  with_prompt_lps: bool = False):
     start_pos = packed[:, 0]
     lengths = packed[:, 1]
     tokens = packed[:, _PREFILL_HDR:_PREFILL_HDR + t_len]
     page_table = packed[:, _PREFILL_HDR + t_len:]
     st = SamplingTensors.unpack(st_f32, st_i32)
-    last_logits, _, kv = transformer.forward_prefill(
+    res = transformer.forward_prefill(
         params, cfg, tokens, start_pos, lengths, kv, page_table,
-        mm_embeds=mm_embeds, mm_positions=mm_positions)
+        mm_embeds=mm_embeds, mm_positions=mm_positions,
+        prompt_lp_targets=plp_targets if with_prompt_lps else None)
+    if with_prompt_lps:
+        last_logits, _, kv, plp = res
+    else:
+        last_logits, _, kv = res
     positions = start_pos + jnp.maximum(lengths - 1, 0)
     tok = sample_tokens(last_logits, st, key, positions=positions)
     lp = compute_logprobs(last_logits, tok)
     top_ids = top_lps = None
     if num_top > 0:
         top_ids, top_lps = compute_top_logprobs(last_logits, num_top)
+    if with_prompt_lps:
+        return tok, lp, top_ids, top_lps, kv, plp
     return tok, lp, top_ids, top_lps, kv
 
 

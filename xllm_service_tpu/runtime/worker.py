@@ -241,11 +241,28 @@ class _StopWatcher:
         return out
 
 
+def _merge_step_outputs(outs: List[StepOutput]) -> StepOutput:
+    """Concatenate held-back deltas of one choice (in arrival order) into
+    a single StepOutput; the final element supplies finish state."""
+    last = outs[-1]
+    merged = StepOutput(
+        request_id=last.request_id,
+        new_token_ids=[t for o in outs for t in o.new_token_ids],
+        logprobs=[l for o in outs for l in o.logprobs],
+        finish_reason=last.finish_reason,
+        num_prompt_tokens=last.num_prompt_tokens,
+        num_generated=last.num_generated)
+    if any(o.top_logprobs for o in outs):
+        merged.top_logprobs = [row for o in outs
+                               for row in (o.top_logprobs or [])]
+    return merged
+
+
 class _Choice:
     """Per-choice (OpenAI ``n`` / ``best_of`` candidate) streaming state."""
 
     __slots__ = ("decoder", "stopper", "completion_tokens", "finished",
-                 "cum_logprob")
+                 "cum_logprob", "echo_done", "pending")
 
     def __init__(self, decoder: IncrementalDecoder,
                  stops: Optional[List[str]]) -> None:
@@ -254,6 +271,10 @@ class _Choice:
         self.completion_tokens = 0
         self.finished = False
         self.cum_logprob = 0.0
+        self.echo_done = False
+        # echo+logprobs, multi-candidate: deltas held back until the
+        # (single, shared) prompt scoring arrives from candidate 0.
+        self.pending: List[StepOutput] = []
 
 
 class _LiveRequest:
@@ -264,7 +285,8 @@ class _LiveRequest:
     __slots__ = ("req", "q", "tokenizer", "choices", "engine_rids",
                  "stream_to_service", "service_request_id", "model",
                  "is_chat", "stream", "include_usage", "first_out_time",
-                 "sampling", "prompt_tokens", "target_n")
+                 "sampling", "prompt_tokens", "target_n", "prompt_lps",
+                 "_echo_cache")
 
     def __init__(self, req: EngineRequest, tokenizer: Tokenizer,
                  service_request_id: str, model: str, is_chat: bool,
@@ -290,6 +312,26 @@ class _LiveRequest:
         # best_of: ``n`` above is the CANDIDATE count; target_n is how
         # many survive server-side selection (set by _parse_generate).
         self.target_n = n
+        # echo+logprobs: prompt-token scores, computed ONCE (candidate 0)
+        # and shared by every choice's echo emission.
+        self.prompt_lps: Optional[List[Optional[float]]] = None
+        # (decoded prompt text, prompt LogProb entries) — identical for
+        # every choice; built once on first echo emission.
+        self._echo_cache: Optional[tuple] = None
+
+    def echo_prefix(self) -> tuple:
+        """(prompt_text, prompt LogProbs) for echo — cached: a best_of
+        pool must not re-decode the whole prompt per choice."""
+        if self._echo_cache is None:
+            text = self.tokenizer.decode(list(self.req.token_ids))
+            lps = []
+            if self.sampling.logprobs and self.prompt_lps:
+                for tid, plp in zip(self.req.token_ids, self.prompt_lps):
+                    lps.append(LogProb(
+                        token=self.tokenizer.decode([tid]), token_id=tid,
+                        logprob=plp, top_logprobs=[]))
+            self._echo_cache = (text, lps)
+        return self._echo_cache
 
     def choice_index(self, engine_rid: str) -> int:
         if len(self.choices) == 1:
@@ -491,12 +533,15 @@ class Worker:
                 self._latency.recent_max_tbt_ms = max(
                     self._latency.recent_max_tbt_ms, step_ms)
             if live.stream_to_service:
-                ro = self._to_request_output(live, out)
-                if ro is not None:
-                    to_service.append(ro)
+                to_service.extend(self._process_step_output(live, out))
                 if out.finished or live.choices[
                         live.choice_index(out.request_id)].finished:
                     self._drop_live(out.request_id)
+                if live.all_finished:
+                    # A flush may have finished choices whose engine rids
+                    # were already dropped — complete the srid cleanup.
+                    with self._live_lock:
+                        self._live_srid.pop(live.service_request_id, None)
             else:
                 live.q.put(out)
                 if out.finished:
@@ -515,6 +560,53 @@ class Worker:
             live = self._live.pop(request_id, None)
             if live is not None and live.all_finished:
                 self._live_srid.pop(live.service_request_id, None)
+
+    def _process_step_output(self, live: _LiveRequest,
+                             out: StepOutput) -> List[RequestOutput]:
+        """Convert one engine StepOutput into wire RequestOutputs.
+
+        Usually 0 or 1 outputs; more when this step's output unblocks
+        other choices: under echo+logprobs the prompt scoring rides
+        candidate 0's first output, and every other candidate's deltas
+        are held back until it lands (their logprob arrays must lead
+        with the prompt tokens). The arrival of the scores flushes ALL
+        held choices here — a held choice may never produce another
+        delta of its own (it can already be finished)."""
+        need_plp = (live.sampling.echo and live.sampling.logprobs
+                    and not live.is_chat)
+        arrived = out.prompt_logprobs is not None and live.prompt_lps is None
+        # Candidate 0 finishing WITHOUT scores (cancelled before its
+        # prefill scored the prompt) means scores will never arrive —
+        # release every held choice with empty scores instead of hanging
+        # the request forever.
+        source_died = (need_plp and live.prompt_lps is None
+                       and out.prompt_logprobs is None
+                       and live.choice_index(out.request_id) == 0
+                       and out.finish_reason != FinishReason.NONE)
+        if arrived or source_died:
+            live.prompt_lps = out.prompt_logprobs if arrived else []
+            ros: List[RequestOutput] = []
+            ro = self._to_request_output(live, out)
+            if ro is not None:
+                ros.append(ro)
+            for other in live.choices:
+                if other.pending:
+                    pend, other.pending = other.pending, []
+                    ro = self._to_request_output(
+                        live, _merge_step_outputs(pend))
+                    if ro is not None:
+                        ros.append(ro)
+            return ros
+        ch = live.choices[live.choice_index(out.request_id)]
+        if need_plp and not ch.echo_done and not ch.finished \
+                and live.prompt_lps is None:
+            ch.pending.append(out)
+            return []
+        if ch.pending:
+            pend, ch.pending = ch.pending, []
+            out = _merge_step_outputs(pend + [out])
+        ro = self._to_request_output(live, out)
+        return [ro] if ro is not None else []
 
     def _to_request_output(self, live: _LiveRequest,
                            out: StepOutput) -> Optional[RequestOutput]:
@@ -542,7 +634,16 @@ class Worker:
                 text += ch.stopper.flush()
         ch.completion_tokens += len(out.new_token_ids)
         ch.cum_logprob += sum(out.logprobs)
-        logprobs = []
+        echo_lps: List[LogProb] = []
+        if live.sampling.echo and not ch.echo_done:
+            # Completion-API echo: the first delta of each choice leads
+            # with the prompt — its text, and (echo+logprobs) per-prompt-
+            # token scores from the engine (first token null). Text and
+            # LogProb entries are identical across choices: cached.
+            ch.echo_done = True
+            prefix_text, echo_lps = live.echo_prefix()
+            text = prefix_text + text
+        logprobs = list(echo_lps)
         if live.sampling.logprobs:
             for j, tid in enumerate(out.new_token_ids):
                 top = []
@@ -563,8 +664,13 @@ class Worker:
             index=idx, text=text, token_ids=list(out.new_token_ids),
             finish_reason=finish, logprobs=logprobs,
             # best_of ranking key, attached on the finish delta only.
-            mean_logprob=(ch.cum_logprob / max(ch.completion_tokens, 1)
-                          if finish != FinishReason.NONE else None))
+            # Cancelled / zero-token candidates get None (ranked last by
+            # the collector) — 0.0 would outrank every real candidate's
+            # negative mean.
+            mean_logprob=(ch.cum_logprob / ch.completion_tokens
+                          if finish not in (FinishReason.NONE,
+                                            FinishReason.CANCELLED)
+                          and ch.completion_tokens > 0 else None))
         all_done = live.all_finished
         usage = None
         if all_done:
@@ -651,7 +757,9 @@ class Worker:
             eos_token_ids=rt.tokenizer.eos_token_ids,
             hold_after_finish=pd_prefill,
             mm_embeds=mm_embeds,
-            mm_positions=mm_positions)
+            mm_positions=mm_positions,
+            prompt_logprobs=(sampling.echo and sampling.logprobs
+                             and not is_chat and not pd_prefill))
         live = _LiveRequest(
             ereq, rt.tokenizer, srid, model, is_chat,
             stream, include_usage,
@@ -679,7 +787,10 @@ class Worker:
                               if engine_sampling.seed is not None else None))
                 creq = ereq if n == 1 else dataclasses.replace(
                     ereq, request_id=erid, sampling=esp,
-                    token_ids=list(token_ids))
+                    token_ids=list(token_ids),
+                    # Prompt scores are candidate-independent — compute
+                    # them once (candidate 0) and share via the live.
+                    prompt_logprobs=ereq.prompt_logprobs and k == 0)
                 rt.engine.add_request(creq)
         self._work_event.set()
         return live
@@ -691,9 +802,14 @@ class Worker:
             return Response.error(400, "invalid JSON body")
         routing = body.get("routing") or {}
         sp_body = body.get("sampling") or {}
-        max_toks = int(sp_body.get("max_tokens",
-                                   body.get("max_tokens", 16)))
-        n_choices = int(sp_body.get("n", body.get("n", 1)))
+        try:
+            max_toks = int(sp_body.get("max_tokens",
+                                       body.get("max_tokens", 16)))
+            n_choices = int(sp_body.get("n", body.get("n", 1)))
+        except (TypeError, ValueError) as e:
+            # Direct-to-worker bodies get the same 400-not-500 treatment
+            # as the service front door.
+            return Response.error(400, f"invalid request: {e}")
         # best_of runs a candidate pool — like n>1, it decodes locally
         # (the PD handoff path migrates exactly one sequence). best_of is
         # a completion-API field; chat ignores it (parse_openai_sampling
@@ -705,10 +821,16 @@ class Worker:
                 or n_choices)
         except (TypeError, ValueError):
             best_of = 1     # _parse_generate rejects the body below
+        # echo needs the prompt scored on the prefill engine and the
+        # prepend handled by the worker that owns the live request —
+        # decode it locally rather than through the PD handoff.
+        echo = (not is_chat) and bool(
+            sp_body.get("echo", body.get("echo", False)))
         if (routing.get("prefill_name") == self.name
                 and routing.get("decode_name")
                 and routing["decode_name"] != self.name
-                and max_toks > 1 and n_choices == 1 and best_of <= 1):
+                and max_toks > 1 and n_choices == 1 and best_of <= 1
+                and not echo):
             return self._serve_pd_prefill(body, is_chat,
                                           routing["decode_name"])
         try:
@@ -739,12 +861,12 @@ class Worker:
             if out is None:
                 yield SSE_DONE
                 return
-            ro = self._to_request_output(live, out)
-            if ro is None:
-                continue
-            for frame in asm.on_output(ro):
-                yield frame
-            if ro.finished:
+            done = False
+            for ro in self._process_step_output(live, out):
+                for frame in asm.on_output(ro):
+                    yield frame
+                done = done or ro.finished
+            if done:
                 return
 
     def _collect_full(self, live: _LiveRequest,
@@ -758,11 +880,11 @@ class Worker:
             out = live.q.get()
             if out is None:
                 break
-            ro = self._to_request_output(live, out)
-            if ro is None:
-                continue
-            coll.add(ro)
-            if ro.finished:
+            done = False
+            for ro in self._process_step_output(live, out):
+                coll.add(ro)
+                done = done or ro.finished
+            if done:
                 break
         return Response.json(coll.body())
 
@@ -1371,11 +1493,11 @@ class Worker:
                 return
             if out is None:
                 return
-            ro = self._to_request_output(live, out)
-            if ro is None:
-                continue
-            yield ro
-            if ro.finished:
+            done = False
+            for ro in self._process_step_output(live, out):
+                yield ro
+                done = done or ro.finished
+            if done:
                 return
 
     # ------------------------------------------------------------------

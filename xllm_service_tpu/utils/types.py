@@ -85,7 +85,9 @@ class Usage:
 class LogProb:
     token: str = ""
     token_id: int = 0
-    logprob: float = 0.0
+    # None = OpenAI's null for the very first prompt token under
+    # ``echo`` (no prefix to condition on).
+    logprob: Optional[float] = 0.0
     top_logprobs: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> Dict[str, Any]:
@@ -216,6 +218,9 @@ class SamplingParams:
     # Completion API: generate ``best_of`` candidates server-side, return
     # the ``n`` with the highest mean token logprob (None → best_of == n).
     best_of: Optional[int] = None
+    # Completion API: prepend the prompt to every choice's text; with
+    # ``logprobs`` also score the prompt tokens (first one null).
+    echo: bool = False
     stop: List[str] = dataclasses.field(default_factory=list)
     stop_token_ids: List[int] = dataclasses.field(default_factory=list)
     seed: Optional[int] = None
@@ -263,9 +268,11 @@ def parse_openai_sampling(body: Dict[str, Any],
         top_p=float(body.get("top_p", 1.0)),
         top_k=int(body.get("top_k", 0)),
         n=int(body.get("n", 1)),
-        # best_of is a completion-API field (reference completion.proto:21)
+        # best_of / echo are completion-API fields (reference
+        # completion.proto:21, :40)
         best_of=(int(best_of) if not is_chat and best_of is not None
                  else None),
+        echo=bool(body.get("echo", False)) and not is_chat,
         stop=[str(s) for s in stop],
         stop_token_ids=list(body.get("stop_token_ids") or []),
         seed=body.get("seed"),
