@@ -380,3 +380,57 @@ def test_echo_scoring_source_cancelled_releases_held_choices():
     assert 1 in texts          # held choice released
     assert live.prompt_lps == []
     assert live.all_finished
+
+
+def test_logit_bias_forces_and_bans():
+    """OpenAI logit_bias inside the fused sampling step: +100 forces a
+    token even under greedy; -100 bans the would-be argmax. (The
+    reference carries logit_bias only as a proto TODO.)"""
+    sp_force = SamplingParams(max_tokens=6, temperature=0.0,
+                              ignore_eos=True, logit_bias={5: 100.0})
+    toks = _run_engine(sp_force)
+    assert toks == [5] * 6
+
+    free = _run_engine(SamplingParams(max_tokens=1, temperature=0.0,
+                                      ignore_eos=True))
+    banned = free[0]
+    toks = _run_engine(SamplingParams(
+        max_tokens=6, temperature=0.0, ignore_eos=True,
+        logit_bias={banned: -100.0}))
+    assert banned not in toks
+
+
+def test_logit_bias_parses_from_json_body():
+    sp = parse_openai_sampling(
+        {"logit_bias": {"17": 55, "3": -20}}, is_chat=True)
+    assert sp.logit_bias == {17: 55.0, 3: -20.0}
+    # Wire round-trip restores int keys.
+    again = SamplingParams.from_json(
+        __import__("json").loads(__import__("json").dumps(sp.to_json())))
+    assert again.logit_bias == {17: 55.0, 3: -20.0}
+
+
+def test_logit_bias_validation(cluster=None):
+    import pytest as _pytest
+    from xllm_service_tpu.utils.types import _parse_logit_bias
+    with _pytest.raises(ValueError):
+        _parse_logit_bias([1, 2])                       # not an object
+    with _pytest.raises(ValueError):
+        _parse_logit_bias({"5": float("nan")})          # non-finite
+    with _pytest.raises(ValueError):
+        _parse_logit_bias({"5": 1000})                  # out of range
+    with _pytest.raises(ValueError):
+        _parse_logit_bias({"-3": 1.0})                  # negative id
+    with _pytest.raises(ValueError):
+        _parse_logit_bias({str(i): 0.0 for i in range(301)})  # cap
+    assert _parse_logit_bias({"5": -100, "9": 100}) == \
+        {5: -100.0, 9: 100.0}
+
+
+def test_logit_bias_out_of_vocab_rejected(cluster):
+    master, _ = cluster
+    status, resp = http_json(
+        "POST", master.http_address, "/v1/completions",
+        {"model": "tiny", "prompt": "x", "max_tokens": 2,
+         "logit_bias": {"99999999": -100}}, timeout=60.0)
+    assert status == 400, resp       # relay mode forwards the worker's 400

@@ -131,14 +131,24 @@ def _row_keys(tensors: SamplingTensors, key: jax.Array,
 
 def sample_tokens(logits: jnp.ndarray, tensors: SamplingTensors,
                   key: jax.Array, positions: Optional[jnp.ndarray] = None,
-                  counts: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                  counts: Optional[jnp.ndarray] = None,
+                  bias_ids: Optional[jnp.ndarray] = None,
+                  bias_vals: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Sample one token per row of ``logits`` [B, V] → int32 [B].
 
     ``positions`` [B] (generation position per row) drives per-request
     seeded determinism; None falls back to the shared key for every row.
     ``counts`` [B, V] enables presence/frequency penalties.
+    ``bias_ids``/``bias_vals`` [B, K] are the OpenAI logit_bias surface
+    in padded sparse form (pad entries (0, +0.0) are additive no-ops);
+    it applies to greedy too — reported logprobs stay those of the
+    model's true distribution.
     """
     logits = logits.astype(jnp.float32)
+    if bias_ids is not None:
+        B = logits.shape[0]
+        logits = logits.at[jnp.arange(B)[:, None], bias_ids].add(
+            bias_vals)
     if counts is not None:
         logits = apply_penalties(logits, counts, tensors)
     greedy_tok = greedy(logits)

@@ -58,6 +58,7 @@ logger = logging.getLogger(__name__)
 _PACK_COLS = 3          # decode header columns
 _PREFILL_HDR = 2        # prefill header columns
 _RING_HDR = 1           # ring-prefill header columns
+_BIAS_K = 8             # default sparse logit-bias columns (pow2-bucketed)
 
 
 @dataclasses.dataclass
@@ -228,6 +229,9 @@ class Engine:
         # Output-token histogram [B, V] for presence/frequency penalties;
         # lives on device only while some running slot uses penalties.
         self._counts: Optional[jnp.ndarray] = None
+        # Sparse logit-bias pair ([B, K] ids, [B, K] values) for decode,
+        # rebuilt when slot sampling changes.
+        self._bias: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
 
         self.step_count = 0
         self.num_preemptions = 0
@@ -396,6 +400,7 @@ class Engine:
         self._slots[slot] = seq
         self._slot_sampling[slot] = seq.req.sampling
         self._slot_st = None
+        self._bias = None
         return True
 
     def _next_window(self, seq: Sequence, start: int) -> int:
@@ -487,6 +492,7 @@ class Engine:
             # vocab sort) enabled for later greedy-only batches.
             self._slot_sampling[seq.slot] = SamplingParams()
             self._slot_st = None
+            self._bias = None
             seq.slot = -1
 
     def _finish_seq(self, seq: Sequence, reason: FinishReason) -> None:
@@ -630,6 +636,8 @@ class Engine:
                        _PREFILL_HDR + T + len(seq.pages)] = seq.pages
             st_f32, st_i32 = self._sampling_tensors(
                 [s.req.sampling for s in batch], B)
+            bias_ids, bias_vals = self._batch_bias(
+                [s.req.sampling for s in batch], B, self.cfg.vocab_size)
             self._rng_key, key = jax.random.split(self._rng_key)
             # echo+logprobs: singleton batch (scheduler guarantees it).
             # targets[t] = the prompt token following window position t
@@ -672,12 +680,13 @@ class Engine:
                 next_tok, logprob, top_ids, top_lps, self.kv, plp = \
                     jitted(self.params, jnp.asarray(packed), self.kv,
                            st_f32, st_i32, key, mm_e, mm_p,
-                           plp_targets, t_len=T)
+                           plp_targets, bias_ids, bias_vals, t_len=T)
             else:
                 plp = None
                 next_tok, logprob, top_ids, top_lps, self.kv = \
                     jitted(self.params, jnp.asarray(packed), self.kv,
-                           st_f32, st_i32, key, mm_e, mm_p, t_len=T)
+                           st_f32, st_i32, key, mm_e, mm_p, None,
+                           bias_ids, bias_vals, t_len=T)
         self._note_recompile("prefill_plp" if plp_mode else "prefill",
                              jitted, cache_before)
         with self._phase("prefill.readback"):
@@ -752,13 +761,15 @@ class Engine:
             packed[0, _RING_HDR + T:
                    _RING_HDR + T + len(seq.pages)] = seq.pages
             st_f32, st_i32 = self._sampling_tensors([seq.req.sampling], 1)
+            bias_ids, bias_vals = self._batch_bias(
+                [seq.req.sampling], 1, self.cfg.vocab_size)
             self._rng_key, key = jax.random.split(self._rng_key)
         cache_before = self._jit_cache_size(self._jit_prefill_ring)
         with self._phase("prefill_ring.dispatch"):
             next_tok, logprob, top_ids, top_lps, self.kv = \
                 self._jit_prefill_ring(
                     self.params, jnp.asarray(packed), self.kv,
-                    st_f32, st_i32, key, t_len=T)
+                    st_f32, st_i32, key, bias_ids, bias_vals, t_len=T)
         self._note_recompile("prefill_ring", self._jit_prefill_ring,
                              cache_before)
         with self._phase("prefill_ring.readback"):
@@ -822,7 +833,8 @@ class Engine:
             next_tok, logprob, top_ids, top_lps, self.kv, self._counts = \
                 self._jit_decode(
                     self.params, packed, self.kv,
-                    st_f32, st_i32, key, self._ensure_counts())
+                    st_f32, st_i32, key, self._ensure_counts(),
+                    *self._ensure_bias())
         self._note_recompile("decode", self._jit_decode, cache_before)
         with self._phase("decode.readback"):
             next_tok = np.asarray(next_tok)
@@ -884,7 +896,8 @@ class Engine:
             toks, logps, top_ids, top_lps, self.kv, self._counts = \
                 self._jit_decode_multi(
                     self.params, packed, self.kv,
-                    st_f32, st_i32, key, self._ensure_counts())
+                    st_f32, st_i32, key, self._ensure_counts(),
+                    *self._ensure_bias())
         self._note_recompile("decode_multi", self._jit_decode_multi,
                              cache_before)
         with self._phase("decode_multi.readback"):
@@ -957,6 +970,43 @@ class Engine:
                     np.add.at(c[seq.slot], gen, 1)
             self._counts = jnp.asarray(c)
         return self._counts
+
+    def _ensure_bias(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Decode-side sparse logit-bias pair, cached until slot sampling
+        params change (mirrors ``_slot_st``)."""
+        if self._bias is None:
+            self._bias = self._batch_bias(self._slot_sampling,
+                                          self.ecfg.max_batch_size,
+                                          self.cfg.vocab_size)
+        return self._bias
+
+    @staticmethod
+    def _batch_bias(params: Sequence[SamplingParams], B: int, V: int
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """OpenAI logit_bias as a padded SPARSE pair: [B, K] int32 token
+        ids + [B, K] float32 values, scatter-added onto the logits inside
+        the jitted step. Always built (zeros when the feature is unused)
+        so the trace signature never flips None→array mid-serving, and
+        the upload is K columns, not a dense [B, V] matrix. Padding rows
+        are (id 0, +0.0) — an additive no-op. K is pow2-bucketed above
+        the default so >K-entry requests cost one (counted) recompile."""
+        mx = max((len(p.logit_bias) for p in params if p.logit_bias),
+                 default=0)
+        K = _BIAS_K
+        while K < mx:
+            K <<= 1
+        ids = np.zeros((B, K), np.int32)
+        vals = np.zeros((B, K), np.float32)
+        for i, p in enumerate(params):
+            if not p.logit_bias:
+                continue
+            j = 0
+            for tid, val in p.logit_bias.items():
+                if 0 <= tid < V:
+                    ids[i, j] = tid
+                    vals[i, j] = val
+                    j += 1
+        return jnp.asarray(ids), jnp.asarray(vals)
 
     def _append_token(self, seq: Sequence, tok: int, logprob: float,
                       top: Optional[List[List[Dict[str, Any]]]] = None
@@ -1086,6 +1136,7 @@ class Engine:
         self._slots[slot] = seq
         self._slot_sampling[slot] = req.sampling
         self._slot_st = None
+        self._bias = None
         self._sync_slot(seq)
         # Migrated prefixes are content-addressed here too, so future
         # prompts on this instance reuse them.
@@ -1149,11 +1200,13 @@ class Engine:
                        1 << max(self._pages_needed(T + 1) - 1,
                                 0).bit_length()}
                 st_f32, st_i32 = self._sampling_tensors([], B)
+                b_ids, b_vals = self._batch_bias([], B, self.cfg.vocab_size)
                 for mp in sorted(mps):
                     _, _, _, _, self.kv = self._jit_prefill(
                         self.params,
                         jnp.zeros((B, _PREFILL_HDR + T + mp), jnp.int32),
-                        self.kv, st_f32, st_i32, key, None, None, t_len=T)
+                        self.kv, st_f32, st_i32, key, None, None, None,
+                        b_ids, b_vals, t_len=T)
                 if not extended:
                     break
             if not extended:
@@ -1162,6 +1215,7 @@ class Engine:
         # Decode (single + fused multi): every pow2 table width. Inactive
         # slots + NULL pages make the KV writes no-ops.
         st_f32, st_i32 = self._sampling_tensors([], Bmax)
+        b_ids, b_vals = self._batch_bias([], Bmax, self.cfg.vocab_size)
         widths = []
         w = 1
         while w <= self.ecfg.max_pages_per_seq:
@@ -1176,11 +1230,12 @@ class Engine:
         for mp in widths:
             packed = jnp.zeros((Bmax, _PACK_COLS + mp), jnp.int32)
             *_, self.kv, _ = self._jit_decode(
-                self.params, packed, self.kv, st_f32, st_i32, key, None)
+                self.params, packed, self.kv, st_f32, st_i32, key, None,
+                b_ids, b_vals)
             if self.ecfg.decode_steps > 1:
                 *_, self.kv, _ = self._jit_decode_multi(
                     self.params, packed, self.kv, st_f32, st_i32, key,
-                    None)
+                    None, b_ids, b_vals)
         jax.block_until_ready(jax.tree_util.tree_leaves(self.kv)[0])
         return time.monotonic() - t0
 
@@ -1221,9 +1276,9 @@ def _top_row(top_ids, top_lps, row: int) -> List[Dict[str, Any]]:
 
 
 def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
-                  mm_positions=None, plp_targets=None, *, cfg: ModelConfig,
-                  num_top: int = 0, t_len: int = 0,
-                  with_prompt_lps: bool = False):
+                  mm_positions=None, plp_targets=None, bias_ids=None,
+                  bias_vals=None, *, cfg: ModelConfig, num_top: int = 0,
+                  t_len: int = 0, with_prompt_lps: bool = False):
     start_pos = packed[:, 0]
     lengths = packed[:, 1]
     tokens = packed[:, _PREFILL_HDR:_PREFILL_HDR + t_len]
@@ -1238,7 +1293,8 @@ def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
     else:
         last_logits, _, kv = res
     positions = start_pos + jnp.maximum(lengths - 1, 0)
-    tok = sample_tokens(last_logits, st, key, positions=positions)
+    tok = sample_tokens(last_logits, st, key, positions=positions,
+                        bias_ids=bias_ids, bias_vals=bias_vals)
     lp = compute_logprobs(last_logits, tok)
     top_ids = top_lps = None
     if num_top > 0:
@@ -1248,9 +1304,9 @@ def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
     return tok, lp, top_ids, top_lps, kv
 
 
-def _prefill_ring_step(params, packed, kv, st_f32, st_i32, key, *,
-                       cfg: ModelConfig, num_top: int = 0, mesh=None,
-                       t_len: int = 0):
+def _prefill_ring_step(params, packed, kv, st_f32, st_i32, key,
+                       bias_ids=None, bias_vals=None, *, cfg: ModelConfig,
+                       num_top: int = 0, mesh=None, t_len: int = 0):
     lengths = packed[:, 0]
     tokens = packed[:, _RING_HDR:_RING_HDR + t_len]
     page_table = packed[:, _RING_HDR + t_len:]
@@ -1258,7 +1314,8 @@ def _prefill_ring_step(params, packed, kv, st_f32, st_i32, key, *,
     last_logits, _, kv = transformer.forward_prefill_ring(
         params, cfg, tokens, lengths, kv, page_table, mesh)
     positions = jnp.maximum(lengths - 1, 0)
-    tok = sample_tokens(last_logits, st, key, positions=positions)
+    tok = sample_tokens(last_logits, st, key, positions=positions,
+                        bias_ids=bias_ids, bias_vals=bias_vals)
     lp = compute_logprobs(last_logits, tok)
     top_ids = top_lps = None
     if num_top > 0:
@@ -1266,8 +1323,9 @@ def _prefill_ring_step(params, packed, kv, st_f32, st_i32, key, *,
     return tok, lp, top_ids, top_lps, kv
 
 
-def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None, *,
-                 cfg: ModelConfig, num_top: int = 0):
+def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None,
+                 bias_ids=None, bias_vals=None, *, cfg: ModelConfig,
+                 num_top: int = 0):
     tokens = packed[:, 0]
     positions = packed[:, 1]
     active = packed[:, 2].astype(bool)
@@ -1275,7 +1333,8 @@ def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None, *,
     st = SamplingTensors.unpack(st_f32, st_i32)
     logits, kv = transformer.forward_decode(
         params, cfg, tokens, positions, active, kv, page_table)
-    tok = sample_tokens(logits, st, key, positions=positions, counts=counts)
+    tok = sample_tokens(logits, st, key, positions=positions, counts=counts,
+                        bias_ids=bias_ids, bias_vals=bias_vals)
     lp = compute_logprobs(logits, tok)
     top_ids = top_lps = None
     if num_top > 0:
@@ -1286,8 +1345,8 @@ def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None, *,
 
 
 def _decode_multi_step(params, packed, kv, st_f32, st_i32, key,
-                       counts=None, *, cfg: ModelConfig, n_steps: int,
-                       num_top: int = 0):
+                       counts=None, bias_ids=None, bias_vals=None, *,
+                       cfg: ModelConfig, n_steps: int, num_top: int = 0):
     """``n_steps`` fused greedy/sampled decode iterations: the scan body is
     traced once, tokens feed forward on-device, and only the [N, B] token/
     logprob blocks cross back to the host — one dispatch per N tokens."""
@@ -1302,7 +1361,8 @@ def _decode_multi_step(params, packed, kv, st_f32, st_i32, key,
         logits, kv = transformer.forward_decode(
             params, cfg, tok, pos, active, kv, page_table)
         new_tok = sample_tokens(logits, st, key_i, positions=pos,
-                                counts=cnt)
+                                counts=cnt, bias_ids=bias_ids,
+                                bias_vals=bias_vals)
         lp = compute_logprobs(logits, new_tok)
         if num_top > 0:
             top_ids, top_lps = compute_top_logprobs(logits, num_top)

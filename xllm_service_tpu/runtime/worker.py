@@ -742,6 +742,16 @@ class Worker:
             mm_embeds = embeds.reshape(n_img * tpi, -1)
         stream = bool(body.get("stream", False))
         validate_sampling(engine_sampling, stream)
+        if engine_sampling.logit_bias:
+            # Only the worker knows the model's vocab — reject typo'd /
+            # wrong-tokenizer ids up front instead of silently ignoring
+            # a "banned" token (OpenAI rejects invalid ids too).
+            V = rt.model_cfg.vocab_size
+            bad = [t for t in engine_sampling.logit_bias if t >= V]
+            if bad:
+                raise ValueError(
+                    f"logit_bias token ids out of vocab range "
+                    f"(< {V}): {bad[:5]}")
         # best_of: run the larger candidate pool; selection happens at
         # response assembly (ResponseCollector.target_n).
         n = 1 if pd_prefill else max(1, engine_sampling.n,

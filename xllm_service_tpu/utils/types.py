@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import math
 from typing import Any, Callable, Dict, List, Optional
 
 
@@ -221,6 +222,11 @@ class SamplingParams:
     # Completion API: prepend the prompt to every choice's text; with
     # ``logprobs`` also score the prompt tokens (first one null).
     echo: bool = False
+    # OpenAI logit_bias: token_id → additive bias (-100..100; -100 ≈ ban,
+    # +100 ≈ force). The reference carries this as an unimplemented TODO
+    # (completion.proto:82-84, chat.proto:90-92); here the engine applies
+    # it inside the fused sampling step.
+    logit_bias: Optional[Dict[int, float]] = None
     stop: List[str] = dataclasses.field(default_factory=list)
     stop_token_ids: List[int] = dataclasses.field(default_factory=list)
     seed: Optional[int] = None
@@ -238,7 +244,10 @@ class SamplingParams:
         if not d:
             return cls()
         known = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in d.items() if k in known})
+        out = cls(**{k: v for k, v in d.items() if k in known})
+        if out.logit_bias:
+            out.logit_bias = _parse_logit_bias(out.logit_bias)
+        return out
 
 
 def parse_openai_sampling(body: Dict[str, Any],
@@ -273,6 +282,7 @@ def parse_openai_sampling(body: Dict[str, Any],
         best_of=(int(best_of) if not is_chat and best_of is not None
                  else None),
         echo=bool(body.get("echo", False)) and not is_chat,
+        logit_bias=_parse_logit_bias(body.get("logit_bias")),
         stop=[str(s) for s in stop],
         stop_token_ids=list(body.get("stop_token_ids") or []),
         seed=body.get("seed"),
@@ -281,6 +291,39 @@ def parse_openai_sampling(body: Dict[str, Any],
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
         ignore_eos=bool(body.get("ignore_eos", False)))
+
+
+_LOGIT_BIAS_MAX_ENTRIES = 300      # OpenAI's documented cap
+
+
+def _parse_logit_bias(lb: Any) -> Optional[Dict[int, float]]:
+    """JSON logit_bias (object with string token-id keys) → {int: float}.
+    Raises ValueError on malformed input — callers map to HTTP 400.
+
+    Enforced here because every entry becomes device state: the entry
+    cap bounds the engine's padded bias width (and its pow2 compile
+    buckets), and the [-100, 100]/finite rule keeps a client from
+    scatter-adding NaN/Inf into a shared batch's logits."""
+    if not lb:
+        return None
+    if not isinstance(lb, dict):
+        raise ValueError("logit_bias must be an object of "
+                         "token_id -> bias")
+    if len(lb) > _LOGIT_BIAS_MAX_ENTRIES:
+        raise ValueError(f"logit_bias accepts at most "
+                         f"{_LOGIT_BIAS_MAX_ENTRIES} entries")
+    try:
+        out = {int(k): float(v) for k, v in lb.items()}
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"invalid logit_bias entry: {e}") from e
+    for tid, val in out.items():
+        if tid < 0:
+            raise ValueError(f"logit_bias token id {tid} is negative")
+        if not (math.isfinite(val) and -100.0 <= val <= 100.0):
+            raise ValueError(
+                f"logit_bias value for token {tid} must be a finite "
+                f"number in [-100, 100]")
+    return out
 
 
 def validate_sampling(sp: SamplingParams, stream: bool) -> None:
