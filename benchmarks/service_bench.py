@@ -1,0 +1,257 @@
+"""Service-layer benchmark: orchestration overhead, no model, no TPU.
+
+The reference (`czynb666/xllm-service`) IS a service layer — its own
+performance is scheduling + routing + body rewrite + relay + SSE
+assembly. This benchmark measures exactly that for the rebuild by
+fronting FAKE workers that speak the full worker contract (store
+registration under a TTL lease, heartbeats, `/v1/*` endpoints) but
+synthesize completions instantly, so every measured microsecond is
+service-side work.
+
+Run (CPU-only):
+    python -m benchmarks.service_bench [--requests 400] [--concurrency 16]
+        [--workers 2] [--gen-tokens 16] [--stream]
+
+Prints one JSON line:
+    {"metric": "service_throughput", "value": <req/s>, "unit": "req/s",
+     "detail": {"p50_ms": ..., "p99_ms": ..., ...}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from typing import Dict, List
+
+from xllm_service_tpu.config import (
+    InstanceType, LoadBalancePolicyType, ServiceOptions)
+from xllm_service_tpu.service.coordination import (
+    InMemoryStore, instance_prefix)
+from xllm_service_tpu.service.httpd import (
+    HttpServer, Request, Response, Router, http_json, http_stream,
+    iter_sse_events)
+from xllm_service_tpu.service.instance_types import (
+    Heartbeat, InstanceMetaInfo, LatencyMetrics, LoadMetrics)
+from xllm_service_tpu.service.master import Master
+from xllm_service_tpu.service.response_handler import (
+    CompletionStreamAssembler)
+from xllm_service_tpu.utils.types import (
+    FinishReason, RequestOutput, SequenceOutput, Usage)
+from xllm_service_tpu.utils.wire import stamp
+
+
+class FakeWorker:
+    """Speaks the worker contract; generates ``gen_tokens`` instantly."""
+
+    def __init__(self, store: InMemoryStore, service_rpc: str,
+                 gen_tokens: int = 16) -> None:
+        self.store = store
+        self.service_rpc = service_rpc
+        self.gen_tokens = gen_tokens
+        router = Router()
+        router.route("GET", "/hello",
+                     lambda r: Response.json({"ok": True}))
+        router.route("POST", "/v1/completions",
+                     lambda r: self._generate(r, is_chat=False))
+        router.route("POST", "/v1/chat/completions",
+                     lambda r: self._generate(r, is_chat=True))
+        self._srv = HttpServer("127.0.0.1", 0, router)
+        self._srv.start()
+        self.name = self._srv.address
+        self._stop = threading.Event()
+        self._register()
+        self._hb_thread = threading.Thread(target=self._heartbeats,
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def _register(self) -> None:
+        meta = InstanceMetaInfo(
+            name=self.name, rpc_address=self.name,
+            instance_type=InstanceType.DEFAULT, models=["fake"],
+            addrs=[self.name])
+        self._lease = self.store.lease_grant(5.0)
+        self.store.put_json(
+            instance_prefix(InstanceType.DEFAULT.value) + self.name,
+            stamp(meta.to_json()), self._lease)
+        self._heartbeat_once()
+
+    def _heartbeat_once(self) -> None:
+        hb = Heartbeat(name=self.name,
+                       instance_type=InstanceType.DEFAULT,
+                       load=LoadMetrics(), latency=LatencyMetrics(),
+                       model_states={"fake": "awake"})
+        http_json("POST", self.service_rpc, "/rpc/heartbeat",
+                  stamp(hb.to_json()), timeout=10.0)
+
+    def _heartbeats(self) -> None:
+        while not self._stop.wait(1.0):
+            try:
+                self.store.lease_keepalive(self._lease)
+                self._heartbeat_once()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _generate(self, req: Request, is_chat: bool) -> Response:
+        body = req.json()
+        srid = body.get("service_request_id", "fake-req")
+        model = body.get("model", "fake")
+        toks = list(range(1, self.gen_tokens + 1))
+        n_prompt = len(body.get("token_ids") or [1])
+        if body.get("stream"):
+            def gen():
+                asm = CompletionStreamAssembler(srid, model)
+                for i, t in enumerate(toks):
+                    last = i == len(toks) - 1
+                    ro = RequestOutput(
+                        request_id=srid, service_request_id=srid,
+                        outputs=[SequenceOutput(
+                            index=0, text=f"t{t} ", token_ids=[t],
+                            finish_reason=(FinishReason.LENGTH if last
+                                           else FinishReason.NONE))],
+                        usage=(Usage(prompt_tokens=n_prompt,
+                                     completion_tokens=len(toks))
+                               if last else None),
+                        finished=last)
+                    for frame in asm.on_output(ro):
+                        yield frame
+            return Response.sse(gen())
+        text = "".join(f"t{t} " for t in toks)
+        return Response.json({
+            "id": srid, "object": "text_completion", "model": model,
+            "choices": [{"index": 0, "text": text,
+                         "logprobs": None, "finish_reason": "length"}],
+            "usage": {"prompt_tokens": n_prompt,
+                      "completion_tokens": len(toks),
+                      "total_tokens": n_prompt + len(toks)},
+        })
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._srv.stop()
+
+
+def run(num_requests: int, concurrency: int, n_workers: int,
+        gen_tokens: int, stream: bool) -> Dict:
+    store = InMemoryStore()
+    opts = ServiceOptions(
+        http_port=0, rpc_port=0,
+        load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
+        heartbeat_interval_s=0.5, master_upload_interval_s=0.5)
+    master = Master(opts, store=store).start()
+    workers: List[FakeWorker] = []
+    try:
+        return _measure(master, workers, store, num_requests, concurrency,
+                        n_workers, gen_tokens, stream)
+    finally:
+        for w in workers:
+            w.stop()
+        master.stop()
+        store.close()
+
+
+def _measure(master, workers, store, num_requests, concurrency,
+             n_workers, gen_tokens, stream) -> Dict:
+    workers.extend(FakeWorker(store, master.rpc_address, gen_tokens)
+                   for _ in range(n_workers))
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if len(master.scheduler.instance_mgr.prefill_instances()) \
+                == n_workers:
+            break
+        time.sleep(0.05)
+    else:
+        raise RuntimeError("fake workers never registered")
+
+    latencies: List[float] = []
+    lat_lock = threading.Lock()
+    errors = [0]
+    idx = [0]
+    idx_lock = threading.Lock()
+
+    def client() -> None:
+        while True:
+            with idx_lock:
+                if idx[0] >= num_requests:
+                    return
+                i = idx[0]
+                idx[0] += 1
+            body = {"model": "fake", "prompt": f"benchmark prompt {i}",
+                    "max_tokens": gen_tokens, "stream": stream}
+            t0 = time.monotonic()
+            try:
+                if stream:
+                    events = list(iter_sse_events(http_stream(
+                        "POST", master.http_address, "/v1/completions",
+                        body)))
+                    ok = any(e == "[DONE]" for e in events)
+                else:
+                    status, _ = http_json(
+                        "POST", master.http_address, "/v1/completions",
+                        body, timeout=60.0)
+                    ok = status == 200
+            except Exception:  # noqa: BLE001
+                ok = False
+            dt = time.monotonic() - t0
+            with lat_lock:
+                latencies.append(dt)
+                if not ok:
+                    errors[0] += 1
+
+    # Warm the measured path (tokenizer init, channel setup, stream
+    # relay/assembler first-use) outside the window, in the same mode.
+    warm = {"model": "fake", "prompt": "warm", "max_tokens": 2,
+            "stream": stream}
+    if stream:
+        list(iter_sse_events(http_stream(
+            "POST", master.http_address, "/v1/completions", warm)))
+    else:
+        http_json("POST", master.http_address, "/v1/completions", warm,
+                  timeout=60.0)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=client) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+
+    from benchmarks.loadgen import _percentile
+    lat_ms = sorted(1e3 * x for x in latencies)
+
+    def pct(p: float) -> float:
+        return _percentile(lat_ms, p)
+
+    return {
+        "metric": "service_throughput",
+        "value": round(num_requests / elapsed, 1),
+        "unit": "req/s",
+        "detail": {
+            "mode": "sse-relay" if stream else "relay",
+            "num_requests": num_requests, "concurrency": concurrency,
+            "workers": n_workers, "gen_tokens": gen_tokens,
+            "errors": errors[0],
+            "p50_ms": round(pct(50), 2),
+            "p99_ms": round(pct(99), 2),
+            "what": "pure service-layer overhead: schedule + route + "
+                    "rewrite + relay against instant fake workers",
+        },
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=400)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--gen-tokens", type=int, default=16)
+    ap.add_argument("--stream", action="store_true")
+    args = ap.parse_args()
+    print(json.dumps(run(args.requests, args.concurrency, args.workers,
+                         args.gen_tokens, args.stream)))
+
+
+if __name__ == "__main__":
+    main()

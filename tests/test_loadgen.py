@@ -79,3 +79,17 @@ def test_sharegpt_replay(tmp_path):
             w.stop()
         master.stop()
         store.close()
+
+
+def test_service_bench_smoke():
+    """The service-layer benchmark (fake instant workers, no model) runs
+    end to end and reports sane numbers."""
+    from benchmarks.service_bench import run
+    res = run(num_requests=24, concurrency=4, n_workers=1,
+              gen_tokens=4, stream=False)
+    assert res["metric"] == "service_throughput"
+    assert res["value"] > 0
+    assert res["detail"]["errors"] == 0
+    res = run(num_requests=12, concurrency=4, n_workers=1,
+              gen_tokens=4, stream=True)
+    assert res["detail"]["errors"] == 0
