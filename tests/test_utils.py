@@ -69,3 +69,29 @@ def test_model_config_presets():
     assert e.max_pages_per_seq == 32
     o = ServiceOptions()
     assert o.block_size == 128 and o.target_tpot_ms == 50.0
+
+
+def test_http_conn_pool_survives_peer_restart():
+    """Pooled keep-alive connections must not turn a peer restart into a
+    hard failure: the stale socket is detected (RemoteDisconnected) and
+    the request retried on a fresh connection."""
+    from xllm_service_tpu.service.httpd import (
+        HttpServer, Response, Router, http_json)
+
+    router = Router()
+    router.route("GET", "/ping", lambda r: Response.json({"ok": True}))
+    srv = HttpServer("127.0.0.1", 0, router)
+    srv.start()
+    addr = srv.address
+    try:
+        status, body = http_json("GET", addr, "/ping")
+        assert status == 200 and body["ok"]
+        # Restart the server on the SAME port: the pooled socket is dead.
+        port = srv.port
+        srv.stop()
+        srv = HttpServer("127.0.0.1", port, router)
+        srv.start()
+        status, body = http_json("GET", addr, "/ping")
+        assert status == 200 and body["ok"]
+    finally:
+        srv.stop()

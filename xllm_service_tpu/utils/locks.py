@@ -22,6 +22,7 @@ increasing):
     60  coordination_net, etcd.watches  — store transports
     90  leaves: tracer, http stats, fan-in pools, worker.vision
     91  misc.counter                    — may be bumped under any leaf
+    92  httpd.connpool                  — guards the keep-alive dict only
     95  hashing.native                  — innermost (C call guard)
 
 Production (env unset) pays zero overhead: ``make_lock`` returns plain
