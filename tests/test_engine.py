@@ -607,3 +607,25 @@ class TestMultiStepDecode:
         for pos in range(len(tokens) - 1):
             row = np.asarray(k[:, pos // ps, pos % ps])   # [L, Hkv, Dh]
             assert np.abs(row).max() > 0, f"KV hole at position {pos}"
+
+
+def test_multi_step_lookahead_clamped_to_max_tokens():
+    """A sequence about to hit max_tokens must not reserve decode_steps-1
+    pages of lookahead it can never use: in a pool with exactly enough
+    pages for its true need, unclamped growth would self-preempt."""
+    cfg = ModelConfig.tiny(vocab_size=64)
+    ecfg = EngineConfig(page_size=4, num_pages=4, max_model_len=32,
+                        max_batch_size=1, max_prefill_tokens=16,
+                        prefill_buckets=(8,), decode_steps=8,
+                        enable_prefix_cache=False)
+    eng = Engine(cfg, ecfg, seed=0)
+    eng.add_request(EngineRequest(
+        request_id="clamp", token_ids=list(range(1, 9)),
+        sampling=SamplingParams(max_tokens=2, temperature=0.0,
+                                ignore_eos=True)))
+    toks = []
+    while eng.has_work():
+        for out in eng.step():
+            toks.extend(out.new_token_ids)
+    assert len(toks) == 2
+    assert eng.num_preemptions == 0

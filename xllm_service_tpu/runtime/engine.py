@@ -869,11 +869,20 @@ class Engine:
         N = self.ecfg.decode_steps
         B = self.ecfg.max_batch_size
         with self._phase("decode_multi.pack"):
-            # Pre-grow pages to cover positions len-1 .. len-1+N-1 (may
-            # preempt — iterate over a snapshot).
+            # Pre-grow pages to cover the burst's KV writes (may preempt
+            # — iterate over a snapshot). Clamped to the tokens this
+            # sequence can still accept: a sequence 2 tokens from its
+            # max_tokens must not reserve N-1 pages of lookahead it will
+            # never use (page pressure preempts other work). Writes the
+            # scan performs past the clamp land on unmapped positions
+            # and are dropped — those sampled tokens are discarded on
+            # host anyway.
             for seq in list(self.running):
                 if seq.status == SeqStatus.RUNNING:
-                    self._grow_pages(seq, lookahead=N - 1)
+                    remaining = min(
+                        N, seq.req.sampling.max_tokens - seq.num_generated)
+                    self._grow_pages(seq,
+                                     lookahead=max(remaining - 1, 0))
             if not self.running:
                 return []
             self._slot_active[:] = 0
