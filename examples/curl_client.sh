@@ -35,3 +35,27 @@ curl -sf "http://${ADDR}/v1/embeddings" \
 
 echo "== service metrics"
 curl -sf "http://${ADDR}/metrics" | head -20
+
+echo "== best_of: 4 candidates server-side, best 1 returned (billed for all)"
+curl -sf "http://${ADDR}/v1/completions" \
+  -H 'Content-Type: application/json' \
+  -d "{\"model\": \"${MODEL}\", \"prompt\": \"the answer is\",
+       \"max_tokens\": 16, \"temperature\": 1.0, \"best_of\": 4, \"n\": 1}"; echo
+
+echo "== echo + logprobs: prompt tokens scored (first is null)"
+curl -sf "http://${ADDR}/v1/completions" \
+  -H 'Content-Type: application/json' \
+  -d "{\"model\": \"${MODEL}\", \"prompt\": \"score me\",
+       \"max_tokens\": 8, \"echo\": true, \"logprobs\": 2}"; echo
+
+echo "== logit_bias: ban token 13, boost token 42"
+curl -sf "http://${ADDR}/v1/completions" \
+  -H 'Content-Type: application/json' \
+  -d "{\"model\": \"${MODEL}\", \"prompt\": \"biased\",
+       \"max_tokens\": 8, \"logit_bias\": {\"13\": -100, \"42\": 5}}"; echo
+
+echo "== hot-reload SLO thresholds"
+curl -sf "http://${ADDR}/admin/flags" ; echo
+curl -sf -X POST "http://${ADDR}/admin/flags" \
+  -H 'Content-Type: application/json' \
+  -d '{"target_ttft_ms": 800, "target_tpot_ms": 40}'; echo
