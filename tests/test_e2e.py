@@ -220,6 +220,67 @@ class TestEndToEnd:
                 w.stop()
             master.stop()
 
+    def test_graceful_drain_completes_inflight_stream(self, store):
+        """drain_and_stop: the in-flight stream finishes cleanly while
+        new requests are refused, then the worker deregisters. (The
+        reference has no graceful shutdown at all — SURVEY.md §7.4.)"""
+        import json as _json
+        import threading
+        master, workers = make_cluster(store)
+        events = []
+        done = threading.Event()
+        body = {"model": "tiny", "prompt": "drain me", "max_tokens": 60,
+                "temperature": 0.0, "ignore_eos": True}
+
+        def reader():
+            for e in iter_sse_events(http_stream(
+                    "POST", master.http_address, "/v1/completions",
+                    dict(body, stream=True))):
+                events.append(e)
+            done.set()
+
+        try:
+            # Greedy baseline on the same engine: what the full stream
+            # must reproduce even though drain happens mid-generation.
+            status, base = http_json(
+                "POST", master.http_address, "/v1/completions", body,
+                timeout=60.0)
+            assert status == 200
+            want_text = base["choices"][0]["text"]
+
+            t = threading.Thread(target=reader, daemon=True)
+            t.start()
+            # Let the request reach the engine before draining.
+            assert wait_until(
+                lambda: any(rt.engine is not None and rt.engine.has_work()
+                            for rt in workers[0].runtimes.values()),
+                timeout=10.0)
+            assert workers[0].drain_and_stop(timeout_s=30.0)
+            assert done.wait(timeout=30.0)
+            # The stream completed: [DONE]-terminated, full greedy text.
+            assert events and events[-1] == "[DONE]"
+            got_text = "".join(
+                _json.loads(e)["choices"][0].get("text", "")
+                for e in events if e != "[DONE]")
+            assert got_text == want_text
+            # Worker deregistered: the service clears it via lease revoke.
+            assert wait_until(
+                lambda: master.scheduler.instance_mgr.prefill_instances()
+                == [], timeout=10.0)
+            # New requests now have nowhere to go.
+            status, _ = http_json(
+                "POST", master.http_address, "/v1/completions",
+                {"model": "tiny", "prompt": "late", "max_tokens": 1},
+                timeout=30.0)
+            assert status == 503
+        finally:
+            for w in workers:
+                try:
+                    w.stop()        # idempotent after drain_and_stop
+                except Exception:   # noqa: BLE001
+                    pass
+            master.stop()
+
     def test_worker_failure_detected_via_lease(self, store):
         master, workers = make_cluster(store)
         try:

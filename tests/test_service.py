@@ -201,6 +201,26 @@ class TestInstanceMgr:
         assert d == "d1"
         mgr.close()
 
+    def test_draining_instance_excluded_from_routing(self, store):
+        """A heartbeat advertising "draining" removes the instance from
+        every routing pool (RR pairs, policy candidates, least-loaded)
+        until its lease-revoked deregistration completes."""
+        mgr = self._mgr_with_pair(store)
+        mgr.on_heartbeat(Heartbeat(
+            name="p1", instance_type=InstanceType.PREFILL,
+            model_states={"tiny": "draining"}))
+        assert mgr.prefill_instances() == ["p2"]
+        for _ in range(4):
+            p, d = mgr.get_next_instance_pair()
+            assert p == "p2" and d == "d1"
+        assert mgr.least_loaded_instance() == "p2"
+        # A draining decode instance empties its pool too.
+        mgr.on_heartbeat(Heartbeat(
+            name="d1", instance_type=InstanceType.DECODE,
+            model_states={"tiny": "draining"}))
+        assert mgr.decode_instances() == []
+        mgr.close()
+
     def test_mix_split_first_decodes(self, store):
         mgr = InstanceMgr(opts_(), store, control=FakeControl())
         for name in ("m1", "m2", "m3"):

@@ -63,6 +63,7 @@ logger = logging.getLogger(__name__)
 
 MODEL_AWAKE = "awake"
 MODEL_ASLEEP = "asleep"
+MODEL_DRAINING = "draining"
 
 
 @dataclasses.dataclass
@@ -384,7 +385,26 @@ class Worker:
         self._work_event = threading.Event()
         self._stop = threading.Event()
         self._latency = LatencyMetrics()
+        # Serializes heartbeat BUILD+SEND: without it a pre-drain
+        # heartbeat still in flight can land after the drain heartbeat
+        # and re-mark the models awake at the router.
+        self._hb_lock = make_lock("worker.hb", 5)
         self._decode_to_service = False
+        # Graceful shutdown: while draining, heartbeats advertise every
+        # model as "draining" (the router neither routes to nor wakes
+        # those), new generate calls get 503, and stop() waits for
+        # in-flight work. _inflight_parse (under _live_lock) counts
+        # requests accepted but not yet registered in _live_srid — the
+        # drain loop must not declare idle inside that window.
+        self._draining = False
+        # Refusal starts only after the drain state is acknowledged (or
+        # its push retries are exhausted): a 503 issued while the router
+        # still considers us healthy would surface to end clients.
+        self._refuse_new = False
+        self._inflight_parse = 0
+        # PD relay/migrate streams proxied by THIS worker after its own
+        # live entry is finalized — drain must wait for them too.
+        self._relay_streams = 0
 
         router = Router()
         router.route("GET", "/hello", lambda r: Response.json({"ok": True}))
@@ -438,10 +458,72 @@ class Worker:
         self._hb_thread.start()
         return self
 
+    def drain_and_stop(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: advertise draining (router stops sending
+        work), refuse new requests, let in-flight requests finish, then
+        stop. Returns True if everything drained inside ``timeout_s``
+        (the reference has no graceful path at all — its handler is
+        effectively abort, master.cpp:144-148 / SURVEY.md §7.4)."""
+        self._draining = True
+        # Push the draining state until the router acknowledges (any
+        # successful heartbeat) BEFORE refusing work: 503s issued while
+        # the router still routes here would surface to end clients.
+        # A standalone worker (no service in front) has no router to
+        # convince — skip straight to refusing.
+        if self.opts.service_addr:
+            for _ in range(3):
+                try:
+                    if self._send_heartbeat():   # ack == HTTP 200, not
+                        break                    # "the POST didn't raise"
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.2)
+            else:
+                # Could not tell the router; give its next poll a beat.
+                time.sleep(min(1.0, self.opts.heartbeat_interval_s))
+        self._refuse_new = True
+        deadline = time.monotonic() + timeout_s
+        drained = False
+        try:
+            while time.monotonic() < deadline:
+                # list(): /fork_master can mutate runtimes mid-iteration.
+                busy = any(rt.engine is not None and rt.engine.has_work()
+                           for rt in list(self.runtimes.values()))
+                with self._live_lock:
+                    busy = busy or bool(self._live_srid) \
+                        or self._inflight_parse > 0 \
+                        or self._relay_streams > 0
+                if not busy:
+                    drained = True
+                    break
+                time.sleep(0.05)
+        finally:
+            self.stop()
+        return drained
+
     def stop(self) -> None:
         self._stop.set()
         self._work_event.set()
         _LOCAL_WORKERS.pop(self.name, None)
+        # Release consumer threads blocked on live.q.get(): the engine
+        # loop is about to exit, so no further outputs (or cancel
+        # effects) will ever arrive — without the sentinel a client of
+        # an abandoned request hangs until process exit instead of
+        # getting a terminated stream. A handler already past the
+        # refusal check may register AFTER a single snapshot, so refuse
+        # first and re-sentinel until the in-parse window empties
+        # (bounded; extra sentinels to finished lives are inert).
+        self._refuse_new = True
+        release_deadline = time.monotonic() + 1.0
+        while True:
+            with self._live_lock:
+                lives = list(self._live_srid.values())
+                inflight = self._inflight_parse
+            for live in lives:
+                live.q.put(None)
+            if inflight == 0 or time.monotonic() > release_deadline:
+                break
+            time.sleep(0.02)
         self._srv.stop()
         if self._lease_id is not None:
             try:
@@ -560,6 +642,31 @@ class Worker:
             live = self._live.pop(request_id, None)
             if live is not None and live.all_finished:
                 self._live_srid.pop(live.service_request_id, None)
+
+    def _finalize_live(self, live: _LiveRequest) -> None:
+        """Consumer-side cleanup when a response completes or its client
+        goes away. The engine thread's _drop_live alone leaked the srid
+        entry in relay mode: it runs when the finish StepOutput is
+        QUEUED, before the consumer marks the choice finished, so
+        all_finished was still false there. Unfinished engine work whose
+        consumer is gone (client disconnect mid-stream) is cancelled —
+        otherwise the engine generates into dropped outputs for the rest
+        of max_tokens and a drain waits on it."""
+        with self._live_lock:
+            self._live_srid.pop(live.service_request_id, None)
+            for erid in live.engine_rids:
+                if self._live.get(erid) is live:
+                    self._live.pop(erid, None)
+        unfinished = [erid for erid, ch
+                      in zip(live.engine_rids, live.choices)
+                      if not ch.finished]
+        if unfinished:
+            rt = self.runtimes.get(live.model) or self.primary_runtime()
+            if rt.engine is not None:
+                with self._engine_lock:
+                    for erid in unfinished:
+                        rt.engine.cancel(erid)
+                self._work_event.set()
 
     def _process_step_output(self, live: _LiveRequest,
                              out: StepOutput) -> List[RequestOutput]:
@@ -805,7 +912,50 @@ class Worker:
         self._work_event.set()
         return live
 
+    def _guarded(self, inner, *args) -> Response:
+        """Shared wrapper for every work-accepting handler: count the
+        request in _inflight_parse BEFORE the refusal check (the inverse
+        order races with drain_and_stop sampling the counters), refuse
+        while draining, and always decrement. By the time a handler
+        returns, its request is rejected, fully served, or registered in
+        _live_srid / _relay_streams — the drain busy-check takes over."""
+        with self._live_lock:
+            self._inflight_parse += 1
+        try:
+            if self._refuse_new:
+                return Response.error(503, "instance is draining",
+                                      "unavailable")
+            return inner(*args)
+        finally:
+            with self._live_lock:
+                self._inflight_parse -= 1
+
+    def _stream_response(self, stream: Iterator[bytes],
+                         *cleanups) -> Response:
+        """SSE response whose cleanups run exactly once when the server
+        finishes with it — INCLUDING when the body generator is never
+        started (a failed header write closes a never-started generator
+        without running its finally, PEP 342), via Response.on_close."""
+        done = [False]
+
+        def on_close() -> None:
+            if done[0]:
+                return
+            done[0] = True
+            for c in cleanups:
+                try:
+                    c()
+                except Exception:  # noqa: BLE001
+                    pass
+        resp = Response.sse(stream)
+        resp.on_close = on_close
+        return resp
+
     def _serve_generate(self, req: Request, is_chat: bool) -> Response:
+        return self._guarded(self._serve_generate_inner, req, is_chat)
+
+    def _serve_generate_inner(self, req: Request,
+                              is_chat: bool) -> Response:
         try:
             body = req.json()
         except Exception:  # noqa: BLE001
@@ -854,7 +1004,9 @@ class Worker:
                                   "service_request_id":
                                       live.service_request_id})
         if live.stream:
-            return Response.sse(self._stream_sse(live))
+            return self._stream_response(
+                self._stream_sse(live),
+                lambda: self._finalize_live(live))
         return self._collect_full(live)
 
     def _stream_sse(self, live: _LiveRequest,
@@ -863,21 +1015,26 @@ class Worker:
         asm = (ChatStreamAssembler if live.is_chat
                else CompletionStreamAssembler)(
             live.service_request_id, live.model, live.include_usage)
-        for ro in (initial or []):
-            for frame in asm.on_output(ro):
-                yield frame
-        while True:
-            out = live.q.get()
-            if out is None:
-                yield SSE_DONE
-                return
-            done = False
-            for ro in self._process_step_output(live, out):
+        try:
+            # The initial frames sit INSIDE the try: a client disconnect
+            # while they stream must still run the finalizer.
+            for ro in (initial or []):
                 for frame in asm.on_output(ro):
                     yield frame
-                done = done or ro.finished
-            if done:
-                return
+            while True:
+                out = live.q.get()
+                if out is None:
+                    yield SSE_DONE
+                    return
+                done = False
+                for ro in self._process_step_output(live, out):
+                    for frame in asm.on_output(ro):
+                        yield frame
+                    done = done or ro.finished
+                if done:
+                    return
+        finally:
+            self._finalize_live(live)
 
     def _collect_full(self, live: _LiveRequest,
                       initial: Optional[List[RequestOutput]] = None
@@ -886,16 +1043,19 @@ class Worker:
                                  live.is_chat, target_n=live.target_n)
         for ro in (initial or []):
             coll.add(ro)
-        while True:
-            out = live.q.get()
-            if out is None:
-                break
-            done = False
-            for ro in self._process_step_output(live, out):
-                coll.add(ro)
-                done = done or ro.finished
-            if done:
-                break
+        try:
+            while True:
+                out = live.q.get()
+                if out is None:
+                    break
+                done = False
+                for ro in self._process_step_output(live, out):
+                    coll.add(ro)
+                    done = done or ro.finished
+                if done:
+                    break
+        finally:
+            self._finalize_live(live)
         return Response.json(coll.body())
 
     # ------------------------------------------------------------------
@@ -959,6 +1119,10 @@ class Worker:
                               "state": rt.state})
 
     def _serve_wakeup(self, req: Request) -> Response:
+        if self._draining:       # refuse from the moment drain begins —
+            # a wake mid-drain would re-advertise the model as awake.
+            return Response.error(409, "instance is draining",
+                                  "unavailable")
         model = req.json().get("model", "")
         rt = self.runtimes.get(model)
         if rt is None:
@@ -1030,6 +1194,9 @@ class Worker:
     # states, served from the same weights as generation.
     # ------------------------------------------------------------------
     def _serve_embeddings(self, req: Request) -> Response:
+        return self._guarded(self._serve_embeddings_inner, req)
+
+    def _serve_embeddings_inner(self, req: Request) -> Response:
         import functools as _ft
 
         import jax.numpy as _jnp
@@ -1106,6 +1273,9 @@ class Worker:
         return np.asarray(fn(pixels), np.float32)
 
     def _serve_encode(self, req: Request) -> Response:
+        return self._guarded(self._serve_encode_inner, req)
+
+    def _serve_encode_inner(self, req: Request) -> Response:
         from xllm_service_tpu.runtime.multimodal import embeds_to_wire
         body = req.json()
         images = body.get("images") or body.get("mm_inputs") or []
@@ -1162,6 +1332,7 @@ class Worker:
                     rt.engine.cancel(srid)
                     rt.engine.drop_held(srid)
             self._drop_live(srid)
+            self._finalize_live(live)
             return Response.error(504, "prefill timed out")
         self._drop_live(srid)
         if first is None or first.finish_reason == FinishReason.STOP \
@@ -1170,11 +1341,19 @@ class Worker:
             with self._engine_lock:
                 rt.engine.drop_held(srid)
             outs = [self._to_request_output(live, first)] if first else []
+            outs = [o for o in outs if o is not None]
+            self._finalize_live(live)
             if self._topology2():
                 self._push_outputs_to_service(outs)
                 return Response.json({"status": "accepted",
                                       "service_request_id": srid})
             return self._respond_outputs(live, outs)
+        # The prefill-side live is only a metadata carrier from here on
+        # (assembly uses the decode side's outputs) — finalize it now or
+        # its srid entry outlives the request and blocks drains. The
+        # relay/migrate streams below are tracked by _relay_streams.
+        live.choices[0].finished = True
+        self._finalize_live(live)
         peer = (_LOCAL_WORKERS.get(decode_name)
                 if self.opts.pd_direct_kv else None)
         if peer is not None and peer is not self:
@@ -1279,17 +1458,38 @@ class Worker:
                 srid, live.model, live.include_usage)
 
             def gen() -> Iterator[bytes]:
-                for frame in asm.on_output(first_out):
-                    yield frame
-                for ro in peer._iter_live_outputs(drt, dlive, srid):
-                    for frame in asm.on_output(ro):
+                try:
+                    for frame in asm.on_output(first_out):
                         yield frame
-            return Response.sse(gen())
+                    for ro in peer._iter_live_outputs(drt, dlive, srid):
+                        for frame in asm.on_output(ro):
+                            yield frame
+                finally:
+                    peer._finalize_live(dlive)
+            # on_close backstop: the gen-level finally cannot run if the
+            # body is never started.
+            return self._tracked_relay(
+                gen(), lambda: peer._finalize_live(dlive))
         coll = ResponseCollector(srid, live.model, live.is_chat)
         coll.add(first_out)
         for ro in peer._iter_live_outputs(drt, dlive, srid):
             coll.add(ro)
         return Response.json(coll.body())
+
+    def _tracked_relay(self, stream: Iterator[bytes],
+                       *cleanups) -> Response:
+        """SSE response for a proxied (PD relay) stream, counted toward
+        the drain busy-check: incremented EAGERLY (while the handler
+        still holds _inflight_parse, closing the handoff window) and
+        decremented exactly once via the response's guaranteed cleanup
+        (generator finallies never run for never-started bodies)."""
+        with self._live_lock:
+            self._relay_streams += 1
+
+        def dec() -> None:
+            with self._live_lock:
+                self._relay_streams -= 1
+        return self._stream_response(stream, dec, *cleanups)
 
     def _topology2(self) -> bool:
         return self._decode_to_service and bool(self.opts.service_addr)
@@ -1342,7 +1542,7 @@ class Worker:
                     ro = RequestOutput.from_json(json.loads(payload))
                     for frame in asm.on_output(ro):
                         yield frame
-            return Response.sse(gen())
+            return self._tracked_relay(gen())
         outs = []
         for payload in iter_sse_events(all_chunks()):
             if payload == "[DONE]":
@@ -1389,8 +1589,9 @@ class Worker:
             return Response.json({"status": "accepted",
                                   "service_request_id": srid})
         if live.stream:
-            return Response.sse(
-                self._stream_sse(new_live, initial=[first_out]))
+            return self._stream_response(
+                self._stream_sse(new_live, initial=[first_out]),
+                lambda: self._finalize_live(new_live))
         return self._collect_full(new_live, initial=[first_out])
 
     def adopt_migrated(self, meta: Dict[str, Any], k, v):
@@ -1400,6 +1601,24 @@ class Worker:
 
         Returns (ok, live, first_out, runtime); runtime is None when the
         target model is asleep."""
+        # Counted like every other work-accepting entry point: the
+        # in-process PD handoff calls this directly (no HTTP wrapper),
+        # and the window between the refusal check and _live_srid
+        # registration must be covered or a concurrent drain declares
+        # idle, stops the engine loop, and strands the adopted request.
+        with self._live_lock:
+            self._inflight_parse += 1
+        try:
+            return self._adopt_migrated_inner(meta, k, v)
+        finally:
+            with self._live_lock:
+                self._inflight_parse -= 1
+
+    def _adopt_migrated_inner(self, meta: Dict[str, Any], k, v):
+        if self._refuse_new:
+            # Same refusal as the /kv/import wire path — the prefill
+            # side falls back to local decode.
+            return False, None, None, None
         model = meta.get("model", self.opts.model)
         rt = self.runtimes.get(model) or self.primary_runtime()
         if rt.engine is None:
@@ -1444,7 +1663,11 @@ class Worker:
         return True, live, first_out, rt
 
     def _serve_kv_import(self, req: Request) -> Response:
-        """Decode-side adoption of a migrated sequence (HTTP wire path)."""
+        """Decode-side adoption of a migrated sequence (HTTP wire path).
+        The prefill side falls back to local decode on a 503."""
+        return self._guarded(self._serve_kv_import_inner, req)
+
+    def _serve_kv_import_inner(self, req: Request) -> Response:
         nl = req.body.find(b"\n")
         if nl < 0:
             return Response.error(400, "missing meta line")
@@ -1479,36 +1702,49 @@ class Worker:
         # Relay topology: stream raw RequestOutput frames back to the
         # prefill worker on this response.
         def gen() -> Iterator[bytes]:
-            yield sse_frame(first_out.to_json())
-            for ro in self._iter_live_outputs(rt, live, srid):
-                yield sse_frame(ro.to_json())
-                if ro.finished:
-                    yield SSE_DONE
-                    return
-        return Response.sse(gen())
+            try:
+                yield sse_frame(first_out.to_json())
+                for ro in self._iter_live_outputs(rt, live, srid):
+                    yield sse_frame(ro.to_json())
+                    if ro.finished:
+                        yield SSE_DONE
+                        return
+            finally:
+                self._finalize_live(live)
+        # on_close backstop for the never-started-body case.
+        return self._stream_response(
+            gen(), lambda: self._finalize_live(live))
 
     def _iter_live_outputs(self, rt: ModelRuntime, live: "_LiveRequest",
                            srid: str) -> Iterator[RequestOutput]:
         """Drain a live request's engine outputs as RequestOutputs,
         cancelling on timeout. Shared by the wire and same-process
-        migration response paths."""
-        while True:
-            try:
-                out = live.q.get(timeout=self.opts.request_timeout_s)
-            except queue.Empty:
-                with self._engine_lock:
-                    if rt.engine is not None:
-                        rt.engine.cancel(srid)
-                self._drop_live(srid)
-                return
-            if out is None:
-                return
-            done = False
-            for ro in self._process_step_output(live, out):
-                yield ro
-                done = done or ro.finished
-            if done:
-                return
+        migration response paths.
+
+        Cleanup sits in a finally: consumers abandon this generator at
+        ``yield`` (the wire relay returns after the finished frame, so a
+        bare post-yield finalize would be skipped via GeneratorExit) —
+        without it the srid entry leaks and drain never sees idle."""
+        try:
+            while True:
+                try:
+                    out = live.q.get(timeout=self.opts.request_timeout_s)
+                except queue.Empty:
+                    with self._engine_lock:
+                        if rt.engine is not None:
+                            rt.engine.cancel(srid)
+                    self._drop_live(srid)
+                    return
+                if out is None:
+                    return
+                done = False
+                for ro in self._process_step_output(live, out):
+                    yield ro
+                    done = done or ro.finished
+                if done:
+                    return
+        finally:
+            self._finalize_live(live)
 
     # ------------------------------------------------------------------
     # Heartbeats
@@ -1533,14 +1769,22 @@ class Worker:
             except Exception as e:  # noqa: BLE001
                 logger.warning("heartbeat failed: %s", e)
 
-    def _send_heartbeat(self) -> None:
+    def _send_heartbeat(self) -> bool:
+        """→ True when the service acknowledged (HTTP 200) — the drain
+        handshake needs that distinction; a 500 must not count."""
         if not self.opts.service_addr:
-            return
+            return False
+        with self._hb_lock:
+            return self._send_heartbeat_locked()
+
+    def _send_heartbeat_locked(self) -> bool:
         rt = self.primary_runtime()
         load = LoadMetrics()
         stored: List[str] = []
         removed: List[str] = []
-        model_states = {m: r.state for m, r in self.runtimes.items()}
+        model_states = {
+            m: (MODEL_DRAINING if self._draining else r.state)
+            for m, r in self.runtimes.items()}
         if rt.engine is not None:
             lm = rt.engine.load_metrics()
             load = LoadMetrics(
@@ -1557,8 +1801,10 @@ class Worker:
             cache_stored=stored, cache_removed=removed,
             model_states=model_states)
         self._latency = LatencyMetrics()
-        http_json("POST", self.opts.service_addr, "/rpc/heartbeat",
-                  stamp(hb.to_json()), timeout=10.0)
+        status, _ = http_json("POST", self.opts.service_addr,
+                              "/rpc/heartbeat", stamp(hb.to_json()),
+                              timeout=10.0)
+        return status == 200
 
     def heartbeat_once(self) -> None:
         """Test helper: one synchronous heartbeat."""

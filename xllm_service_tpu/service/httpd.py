@@ -55,12 +55,19 @@ class Response:
     def __init__(self, status: int = 200, body: Optional[bytes] = None,
                  content_type: str = "application/json",
                  headers: Optional[Dict[str, str]] = None,
-                 stream: Optional[Iterable[bytes]] = None) -> None:
+                 stream: Optional[Iterable[bytes]] = None,
+                 on_close: Optional[Callable[[], None]] = None) -> None:
         self.status = status
         self.body = body if body is not None else b""
         self.content_type = content_type
         self.headers = headers or {}
         self.stream = stream
+        # Invoked by the server EXACTLY when it is done with this
+        # response — including when a stream body is never iterated
+        # (failed header write): a never-STARTED generator's finally
+        # does not run on close (PEP 342), so cleanup that must always
+        # happen belongs here, not in the generator.
+        self.on_close = on_close
 
     @classmethod
     def json(cls, obj: Any, status: int = 200) -> "Response":
@@ -143,6 +150,19 @@ class _RequestHandler(BaseHTTPRequestHandler):
             self._write(resp)
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-stream
+        finally:
+            # Run a STARTED stream generator's finally first, then the
+            # response-level cleanup (covers the never-started case).
+            if resp.stream is not None and hasattr(resp.stream, "close"):
+                try:
+                    resp.stream.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            if resp.on_close is not None:
+                try:
+                    resp.on_close()
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _write(self, resp: Response) -> None:
         self.send_response(resp.status)

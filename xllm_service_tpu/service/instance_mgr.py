@@ -48,6 +48,7 @@ logger = logging.getLogger(__name__)
 
 MODEL_AWAKE = "awake"
 MODEL_ASLEEP = "asleep"
+MODEL_DRAINING = "draining"    # graceful shutdown: route nothing, wake never
 
 # Default model memory footprints (GB) for the serverless allocator. The
 # reference hardcodes its list (instance_mgr.cpp:217-225, flagged TODO);
@@ -301,24 +302,36 @@ class InstanceMgr:
         with self._lock:
             return list(self._instances)
 
+    def _is_draining_locked(self, name: str) -> bool:
+        """Drain is whole-worker: any model advertising "draining"
+        means the instance must receive no new work of any kind (it is
+        finishing in-flight requests before a graceful shutdown)."""
+        inst = self._instances.get(name)
+        return inst is not None and any(
+            st == MODEL_DRAINING for st in inst.model_states.values())
+
     def prefill_instances(self) -> List[str]:
         with self._lock:
-            return list(self._prefill_idx)
+            return [n for n in self._prefill_idx
+                    if not self._is_draining_locked(n)]
 
     def decode_instances(self) -> List[str]:
         with self._lock:
-            return list(self._decode_idx)
+            return [n for n in self._decode_idx
+                    if not self._is_draining_locked(n)]
 
     def encode_instances(self) -> List[str]:
         with self._lock:
             return [n for n, s in self._instances.items()
-                    if s.instance_type == InstanceType.ENCODE]
+                    if s.instance_type == InstanceType.ENCODE
+                    and not self._is_draining_locked(n)]
 
     def get_next_encode_instance(self) -> Optional[str]:
         """RR over the EPD encode pool."""
         with self._lock:
             pool = [n for n, s in self._instances.items()
-                    if s.instance_type == InstanceType.ENCODE]
+                    if s.instance_type == InstanceType.ENCODE
+                    and not self._is_draining_locked(n)]
             if not pool:
                 return None
             self._rr_encode = getattr(self, "_rr_encode", 0)
@@ -340,13 +353,15 @@ class InstanceMgr:
     def get_next_instance_pair(self) -> Tuple[Optional[str], Optional[str]]:
         with self._lock:
             prefill = decode = None
-            if self._prefill_idx:
-                prefill = self._prefill_idx[
-                    self._rr_prefill % len(self._prefill_idx)]
+            prefills = [n for n in self._prefill_idx
+                        if not self._is_draining_locked(n)]
+            decodes = [n for n in self._decode_idx
+                       if not self._is_draining_locked(n)]
+            if prefills:
+                prefill = prefills[self._rr_prefill % len(prefills)]
                 self._rr_prefill += 1
-            if self._decode_idx:
-                decode = self._decode_idx[
-                    self._rr_decode % len(self._decode_idx)]
+            if decodes:
+                decode = decodes[self._rr_decode % len(decodes)]
                 self._rr_decode += 1
             if prefill is None:
                 # Degenerate pool (e.g. a single MIX instance that took the
@@ -363,7 +378,7 @@ class InstanceMgr:
             best, best_score = None, None
             for name in cands:
                 inst = self._instances.get(name)
-                if inst is None:
+                if inst is None or self._is_draining_locked(name):
                     continue
                 score = (inst.load.waiting_requests
                          + inst.load.kv_cache_usage)
@@ -421,6 +436,8 @@ class InstanceMgr:
             # the decode pool when no dedicated prefill instance exists).
             best_p, best_p_time = None, float("inf")
             for name in (self._prefill_idx or self._decode_idx):
+                if self._is_draining_locked(name):
+                    continue
                 inst = self._instances[name]
                 t = inst.req_metrics.estimated_prefill_time_ms
                 if t < best_p_time:
@@ -431,6 +448,10 @@ class InstanceMgr:
             target_tpot = self.opts.target_tpot_ms
             best_d, best_d_tpot = None, float("inf")
             for name in self._decode_idx:
+                if self._is_draining_locked(name):
+                    # A draining worker's emptying backlog makes it look
+                    # MOST attractive to the SLO argmin — skip it.
+                    continue
                 inst = self._instances[name]
                 m = inst.req_metrics
                 tpot = inst.predictor.predict_tpot(
@@ -456,7 +477,10 @@ class InstanceMgr:
                     and self._decode_idx):
                 idle = [n for n in self._decode_idx
                         if self._instances[n].req_metrics.num_decode_requests
-                        == 0 and n != best_d]
+                        == 0 and n != best_d
+                        # a draining worker is precisely the one
+                        # guaranteed to look idle — never overflow to it
+                        and not self._is_draining_locked(n)]
                 if idle:
                     best_p = idle[0]
                     est_ttft = self._instances[best_p].predictor.predict_ttft(
@@ -466,8 +490,9 @@ class InstanceMgr:
             # prefill instance to decode (instance_mgr.cpp:907-917).
             if (best_d is not None and best_d_tpot > target_tpot
                     and len(self._prefill_idx) > 1):
-                flip = next((n for n in self._prefill_idx if n != best_p),
-                            None)
+                flip = next((n for n in self._prefill_idx
+                             if n != best_p
+                             and not self._is_draining_locked(n)), None)
                 if flip:
                     self._flip_locked(flip, InstanceType.DECODE)
                     best_d = flip
@@ -545,6 +570,10 @@ class InstanceMgr:
             best_heat = float("inf")
             for name, inst in self._instances.items():
                 if model not in inst.model_states:
+                    continue
+                if inst.model_states[model] == MODEL_DRAINING:
+                    # A draining instance must not be woken back up —
+                    # it is finishing in-flight work before shutdown.
                     continue
                 awake = [m for m, st in inst.model_states.items()
                          if st == MODEL_AWAKE]

@@ -13,6 +13,7 @@ deadlocking once in a thousand runs.
 Rank table (acquire order low → high; a thread's held ranks are strictly
 increasing):
 
+     5  worker.hb                       — serializes heartbeat build+send
     10  scheduler.req, worker.live      — request registries
     20  worker.engine                   — engine step/submit
     30  instance_mgr                    — instance books (re-entrant)
