@@ -281,6 +281,36 @@ class TestEndToEnd:
                     pass
             master.stop()
 
+    def test_redispatch_on_worker_refusal(self, store):
+        """A request routed to a worker that refuses it (503: draining)
+        is re-dispatched to a healthy instance instead of surfacing the
+        error — the rescheduling the reference README claims but never
+        implements (SURVEY.md §5.3)."""
+        master, workers = make_cluster(store, n_workers=2)
+        try:
+            # Force refusal on worker 0 WITHOUT telling the router (the
+            # drain handshake normally removes it from routing first) —
+            # this exercises the re-dispatch path itself.
+            workers[0]._refuse_new = True
+            for i in range(4):     # RR alternates; ~half hit worker 0
+                status, resp = http_json(
+                    "POST", master.http_address, "/v1/completions",
+                    {"model": "tiny", "prompt": f"redispatch {i}",
+                     "max_tokens": 2, "temperature": 0.0,
+                     "ignore_eos": True}, timeout=60.0)
+                assert status == 200, resp
+            # Streaming takes the eager-open + re-dispatch path.
+            events = list(iter_sse_events(http_stream(
+                "POST", master.http_address, "/v1/completions",
+                {"model": "tiny", "prompt": "redispatch stream",
+                 "max_tokens": 2, "stream": True, "temperature": 0.0,
+                 "ignore_eos": True})))
+            assert events and events[-1] == "[DONE]"
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
     def test_worker_failure_detected_via_lease(self, store):
         master, workers = make_cluster(store)
         try:

@@ -32,7 +32,8 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from xllm_service_tpu.config import ServiceOptions
 from xllm_service_tpu.service.httpd import (
-    Request, Response, Router, http_json, http_stream)
+    Request, Response, Router, http_json, http_stream_status)
+from xllm_service_tpu.service.instance_types import RequestPhase
 from xllm_service_tpu.service.response_handler import (
     ChatStreamAssembler, CompletionStreamAssembler, ResponseCollector)
 from xllm_service_tpu.service.scheduler import Scheduler
@@ -156,21 +157,134 @@ class HttpService:
             return self._rpc_mode_response(req, fwd, target, path, is_chat)
         return self._relay_mode_response(req, fwd, target, path)
 
+    # -- re-dispatch ------------------------------------------------------
+    def _redispatch(self, req: SchedRequest,
+                    fwd: Dict[str, Any]) -> Optional[str]:
+        """Pick a new instance for a request its worker PROVABLY never
+        worked on — an HTTP 503 refusal (draining/asleep) or a refused
+        connection; never timeouts or mid-response failures, which could
+        double-generate. The reference README claims this rescheduling;
+        its code never implements it (SURVEY.md §5.3). Reverses the
+        failed instance's schedule bookkeeping and retargets the request
+        registry so finish metrics drain the instance that actually does
+        the work. Returns the new target address, or None."""
+        old = req.routing.prefill_name if req.routing else ""
+        status, routing = self.scheduler.schedule(req)
+        if not status.ok or routing.prefill_name == old:
+            if status.ok and old:
+                # Scheduled straight back onto the refuser: undo the
+                # duplicate SCHEDULE it just added; the original one is
+                # drained by the caller's finish/cancel path.
+                self.scheduler.instance_mgr.update_request_metrics(
+                    old, RequestPhase.UNSCHEDULE, len(req.token_ids))
+            return None
+        if old:
+            self.scheduler.instance_mgr.update_request_metrics(
+                old, RequestPhase.UNSCHEDULE, len(req.token_ids))
+        self.scheduler.retarget_request(req.service_request_id, routing)
+        fwd["routing"] = routing.to_json()
+        self.tracer.trace(req.service_request_id,
+                          {"stage": "redispatch", "from": old,
+                           "to": routing.prefill_name})
+        return self.scheduler.instance_mgr.address_of(
+            routing.prefill_name)
+
+    def _send_with_redispatch(self, req: SchedRequest,
+                              fwd: Dict[str, Any], target: str,
+                              path: str):
+        """One JSON forward with at most one re-dispatch, triggered ONLY
+        by refusal-class outcomes (503 status / refused connection) —
+        shared by the non-stream relay and the RPC ack so their retry
+        policies cannot drift apart."""
+        for attempt in (0, 1):
+            try:
+                status, resp = http_json(
+                    "POST", target, path, fwd,
+                    timeout=self.opts.request_timeout_s)
+            except ConnectionRefusedError:
+                new = self._redispatch(req, fwd) if attempt == 0 else None
+                if new:
+                    target = new
+                    continue
+                raise
+            if status == 503 and attempt == 0:
+                new = self._redispatch(req, fwd)
+                if new:
+                    target = new
+                    continue
+            return status, resp
+
     # -- topology 1: HTTP relay (service.cpp:168-236) ---------------------
     def _relay_mode_response(self, req: SchedRequest, fwd: Dict[str, Any],
                              target: str, path: str) -> Response:
         self.scheduler.record_new_request(req, lambda out: True)
         if req.stream:
+            # Eager open: the worker's status is known BEFORE any bytes
+            # reach the client, so a 503 can be re-dispatched and other
+            # errors surface with their real status code instead of
+            # error JSON inside a 200 SSE stream.
+            for attempt in (0, 1):
+                try:
+                    status, body = http_stream_status(
+                        "POST", target, path, fwd,
+                        timeout=self.opts.request_timeout_s)
+                except Exception as e:  # noqa: BLE001
+                    # Refusal-class failures only (see _redispatch):
+                    # a timeout may mean the worker already started.
+                    new = (self._redispatch(req, fwd)
+                           if attempt == 0
+                           and isinstance(e, ConnectionRefusedError)
+                           else None)
+                    if new:
+                        target = new
+                        continue
+                    self.scheduler.finish_request(req.service_request_id,
+                                                  cancelled=True)
+                    with self._lock:
+                        self._num_errors += 1
+                    return Response.error(503, f"worker error: {e}")
+                if status == 200:
+                    break
+                err = b"".join(body)        # drain + close the conn
+                if status == 503 and attempt == 0:
+                    new = self._redispatch(req, fwd)
+                    if new:
+                        target = new
+                        continue
+                self.scheduler.finish_request(req.service_request_id,
+                                              cancelled=True)
+                with self._lock:
+                    self._num_errors += 1
+                return Response(status=status, body=err)
+
             def relay() -> Iterator[bytes]:
                 try:
-                    for chunk in http_stream("POST", target, path, fwd):
+                    for chunk in body:
                         yield chunk
                 finally:
                     self.scheduler.finish_request(req.service_request_id)
-            return Response.sse(relay())
+            resp_obj = Response.sse(relay())
+            done = [False]
+
+            def on_close() -> None:
+                # Backstop for a never-started body (client died during
+                # header write): the generator finallies cannot run, but
+                # the registry entry must drain and the worker-side
+                # connection must drop or the worker generates the full
+                # completion into a dead socket.
+                if done[0]:
+                    return
+                done[0] = True
+                try:
+                    body.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self.scheduler.finish_request(req.service_request_id)
+            resp_obj.on_close = on_close
+            return resp_obj
         try:
-            status, resp = http_json("POST", target, path, fwd,
-                                     timeout=self.opts.request_timeout_s)
+            status, resp = self._send_with_redispatch(req, fwd, target,
+                                                      path)
         except Exception as e:  # noqa: BLE001 — worker unreachable
             self.scheduler.finish_request(req.service_request_id,
                                           cancelled=True)
@@ -196,8 +310,8 @@ class HttpService:
 
         self.scheduler.record_new_request(req, on_output)
         try:
-            status, ack = http_json("POST", target, path, fwd,
-                                    timeout=self.opts.request_timeout_s)
+            status, ack = self._send_with_redispatch(req, fwd, target,
+                                                     path)
             if status != 200:
                 raise RuntimeError(f"worker returned {status}: {ack}")
         except Exception as e:  # noqa: BLE001

@@ -383,14 +383,16 @@ def http_json(method: str, address: str, path: str, obj: Any = None,
         return resp.status, parsed
 
 
-def http_stream(method: str, address: str, path: str, obj: Any = None,
-                timeout: float = 600.0,
-                headers: Optional[Dict[str, str]] = None,
-                raw: Optional[bytes] = None
-                ) -> Iterator[bytes]:
-    """Progressive byte-chunk reader (reference CustomProgressiveReader,
-    service.cpp:113-143): yields raw chunks as they arrive. ``raw`` sends
-    an octet-stream body instead of JSON (KV migration payloads)."""
+def http_stream_status(method: str, address: str, path: str,
+                       obj: Any = None, timeout: float = 600.0,
+                       headers: Optional[Dict[str, str]] = None,
+                       raw: Optional[bytes] = None
+                       ) -> Tuple[int, Iterator[bytes]]:
+    """Like ``http_stream`` but connects EAGERLY and returns
+    (status, body-iterator) so callers can act on the status (e.g.
+    re-dispatch a 503) before relaying any bytes. The caller must
+    exhaust or close the iterator once it has been started; a non-200
+    body should simply be drained (it is small)."""
     conn = _NoDelayHTTPConnection(address, timeout=timeout)
     try:
         if raw is not None:
@@ -403,16 +405,47 @@ def http_stream(method: str, address: str, path: str, obj: Any = None,
             hdrs.update(headers)
         conn.request(method, path, body=body, headers=hdrs)
         resp = conn.getresponse()
-        if resp.status != 200:
-            yield resp.read()
-            return
-        while True:
-            chunk = resp.read1(65536)
-            if not chunk:
-                return
-            yield chunk
-    finally:
+    except Exception:
         conn.close()
+        raise
+
+    return resp.status, _StreamBody(resp, conn)
+
+
+class _StreamBody:
+    """Iterable response body that is ALSO closeable without having been
+    iterated — closing a never-started generator cannot run its finally
+    (PEP 342), but dropping the connection must always be possible."""
+
+    def __init__(self, resp, conn) -> None:
+        self._resp = resp
+        self._conn = conn
+
+    def __iter__(self) -> Iterator[bytes]:
+        try:
+            while True:
+                chunk = self._resp.read1(65536)
+                if not chunk:
+                    return
+                yield chunk
+        finally:
+            self._conn.close()
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+def http_stream(method: str, address: str, path: str, obj: Any = None,
+                timeout: float = 600.0,
+                headers: Optional[Dict[str, str]] = None,
+                raw: Optional[bytes] = None
+                ) -> Iterator[bytes]:
+    """Progressive byte-chunk reader (reference CustomProgressiveReader,
+    service.cpp:113-143): yields raw chunks as they arrive. ``raw`` sends
+    an octet-stream body instead of JSON (KV migration payloads)."""
+    _, body = http_stream_status(method, address, path, obj=obj,
+                                 timeout=timeout, headers=headers, raw=raw)
+    yield from body
 
 
 def iter_sse_events(chunks: Iterable[bytes]) -> Iterator[str]:

@@ -402,6 +402,14 @@ class InstanceMgr:
                 if inst.predictor.has_ttft:
                     m.estimated_prefill_time_ms += \
                         inst.predictor.predict_ttft(num_tokens)
+            elif phase == RequestPhase.UNSCHEDULE:
+                m.num_prefill_requests = max(0, m.num_prefill_requests - 1)
+                m.num_prefill_tokens = max(0, m.num_prefill_tokens
+                                           - num_tokens)
+                if inst.predictor.has_ttft:
+                    m.estimated_prefill_time_ms = max(
+                        0.0, m.estimated_prefill_time_ms
+                        - inst.predictor.predict_ttft(num_tokens))
             elif phase == RequestPhase.PREFILL_FINISH:
                 m.num_prefill_requests = max(0, m.num_prefill_requests - 1)
                 m.num_prefill_tokens = max(0, m.num_prefill_tokens

@@ -135,6 +135,10 @@ class RequestPhase:
     GENERATE :345, FINISH_DECODE/CANCEL :304-327)."""
 
     SCHEDULE = "schedule"
+    # Exact reversal of SCHEDULE — used when a scheduled request is
+    # re-dispatched to another instance before any work happened (the
+    # failed instance must not keep phantom prefill backlog).
+    UNSCHEDULE = "unschedule"
     PREFILL_FINISH = "prefill_finish"
     GENERATE = "generate"
     FINISH_DECODE = "finish_decode"

@@ -237,6 +237,17 @@ class Scheduler:
                 tracked.request.service_request_id,
                 cancelled=out.cancelled or not keep)
 
+    def retarget_request(self, service_request_id: str,
+                         routing: Routing) -> None:
+        """Point a tracked request at its re-dispatched instances so
+        finish/generation metrics drain the instance that actually does
+        the work, not the one that refused it."""
+        with self._req_lock:
+            tracked = self._requests.get(service_request_id)
+            if tracked is not None:
+                tracked.prefill_name = routing.prefill_name
+                tracked.decode_name = routing.decode_name
+
     def finish_request(self, service_request_id: str,
                        cancelled: bool = False) -> None:
         """Teardown (scheduler.cpp:304-327)."""
