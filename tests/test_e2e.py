@@ -281,6 +281,34 @@ class TestEndToEnd:
                     pass
             master.stop()
 
+    def test_graceful_drain_rpc_topology(self, store):
+        """Drain must also see idle in decode-to-service mode, where the
+        engine loop pushes outputs to the service fan-in and the worker
+        cleans its registry inline rather than via a response consumer."""
+        master, workers = make_cluster(store, decode_to_service=True)
+        try:
+            # The worker learns this mode from GET /rpc/config — the
+            # request must not race it into the relay topology.
+            assert wait_until(lambda: workers[0]._decode_to_service,
+                              timeout=10.0)
+            status, resp = http_json(
+                "POST", master.http_address, "/v1/completions",
+                {"model": "tiny", "prompt": "rpc mode warm",
+                 "max_tokens": 4, "temperature": 0.0,
+                 "ignore_eos": True}, timeout=120.0)
+            assert status == 200, resp
+            assert workers[0].drain_and_stop(timeout_s=20.0)
+            assert wait_until(
+                lambda: master.scheduler.instance_mgr.prefill_instances()
+                == [], timeout=10.0)
+        finally:
+            for w in workers:
+                try:
+                    w.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            master.stop()
+
     def test_redispatch_on_worker_refusal(self, store):
         """A request routed to a worker that refuses it (503: draining)
         is re-dispatched to a healthy instance instead of surfacing the
