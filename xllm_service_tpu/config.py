@@ -101,6 +101,10 @@ class ModelConfig:
     num_kv_heads: int = 32
     head_dim: Optional[int] = None            # default hidden_size // num_heads
     rope_theta: float = 10000.0
+    # Frequency scaling from config.json:rope_scaling, in hashable tuple
+    # form ("llama3", factor, low_freq, high_freq, original_max_pos) or
+    # ("linear", factor, 0, 0, 0) — see ops/rope.py. None = unscaled.
+    rope_scaling: Optional[Tuple[Any, ...]] = None
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
@@ -189,7 +193,29 @@ class ModelConfig:
                                  d.get("model_type") == "qwen2"),
             num_experts=d.get("num_local_experts", 0),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
+            rope_scaling=cls._parse_rope_scaling(d.get("rope_scaling")),
         )
+
+    @staticmethod
+    def _parse_rope_scaling(rs: Optional[Dict[str, Any]]
+                            ) -> Optional[Tuple[Any, ...]]:
+        """config.json:rope_scaling dict → the hashable tuple ops/rope.py
+        takes. Unknown types raise at load time rather than silently
+        mis-rotating positions (checkpoint-fidelity contract)."""
+        if not rs:
+            return None
+        kind = rs.get("rope_type", rs.get("type"))
+        if kind in (None, "default"):
+            return None
+        if kind == "llama3":
+            return ("llama3", float(rs["factor"]),
+                    float(rs["low_freq_factor"]),
+                    float(rs["high_freq_factor"]),
+                    int(rs["original_max_position_embeddings"]))
+        if kind == "linear":
+            return ("linear", float(rs["factor"]), 0.0, 0.0, 0)
+        raise NotImplementedError(
+            f"rope_scaling type {kind!r} not supported")
 
 
 @dataclasses.dataclass

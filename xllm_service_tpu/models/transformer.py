@@ -213,8 +213,8 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         lp, kp, vp = xs
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         # Attend against cache (prefix-cache hits) + this step's fresh K/V.
         # The pool itself is NOT written here: emitting updated pools as
         # scan ys would rewrite the whole pool per call — the fresh rows
@@ -326,8 +326,8 @@ def forward_prefill_ring(params: Params, cfg: ModelConfig,
     def layer(x, lp):
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn = _ring(q, k, v, lengths)
         x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
         h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
@@ -369,8 +369,8 @@ def forward_embedding(params: Params, cfg: ModelConfig,
     def layer(x, lp):
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn = mha_prefill(q, k, v, lengths,
                            jnp.zeros((B,), jnp.int32))
         x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
@@ -409,8 +409,8 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)                               # [B,1,H,Dh]
         pos2 = positions[:, None]
-        q = apply_rope(q, pos2, cfg.rope_theta)
-        k = apply_rope(k, pos2, cfg.rope_theta)
+        q = apply_rope(q, pos2, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, pos2, cfg.rope_theta, cfg.rope_scaling)
         # The current token's K/V stays in-registers for attention; the
         # pool write happens once for all layers after the scan (carrying
         # the pool as scan ys would rewrite the whole pool per step).

@@ -4,29 +4,70 @@ Cos/sin tables are computed on the fly from integer positions rather than
 precomputed-and-gathered: a handful of VPU transcendentals fuses into the
 attention prologue under XLA, while a [max_len, dim] table gather costs HBM
 bandwidth — the scarcer resource on TPU.
+
+Frequency scaling: Llama-3.1/3.2 checkpoints ship
+``config.json:rope_scaling = {rope_type: "llama3", factor, low_freq_factor,
+high_freq_factor, original_max_position_embeddings}`` — long-context
+extension by stretching low-frequency bands while keeping high-frequency
+(local-order) bands intact. ``scaling`` here is the hashable tuple form
+``("llama3", factor, low, high, orig_ctx)`` carried by ModelConfig (static
+under jit, so the branch below is trace-time).
 """
 
 from __future__ import annotations
 
+from typing import Optional, Tuple
+
 import jax.numpy as jnp
+
+RopeScaling = Tuple[str, float, float, float, int]
+
+
+def rope_inv_freq(head_dim: int, theta: float,
+                  scaling: Optional[RopeScaling] = None) -> jnp.ndarray:
+    """Per-band inverse frequencies [head_dim/2], with checkpoint scaling
+    applied. float32 throughout — bf16 frequencies destroy long-context
+    phase accuracy."""
+    half = head_dim // 2
+    inv = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if scaling is None:
+        return inv
+    kind = scaling[0]
+    if kind == "llama3":
+        _, factor, low_f, high_f, orig = scaling
+        wavelen = 2.0 * jnp.pi / inv
+        # Three bands by wavelength vs the original training context:
+        # short waves untouched, long waves fully slowed by `factor`,
+        # in-between smoothly interpolated.
+        smooth = (orig / wavelen - low_f) / (high_f - low_f)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = (1.0 - smooth) * inv / factor + smooth * inv
+        return jnp.where(wavelen > orig / low_f, inv / factor,
+                         jnp.where(wavelen < orig / high_f, inv, scaled))
+    if kind == "linear":
+        return inv / float(scaling[1])
+    raise NotImplementedError(
+        f"rope_scaling type {kind!r} not supported — refusing to load a "
+        f"checkpoint whose positions would be silently mis-rotated")
 
 
 def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32,
+                 scaling: Optional[RopeScaling] = None):
     """cos/sin for integer ``positions`` (any shape), returned with a trailing
     ``head_dim/2`` axis, always in float32 for accuracy at long context."""
-    half = head_dim // 2
-    freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    freq = rope_inv_freq(head_dim, theta, scaling)
     angles = positions.astype(jnp.float32)[..., None] * freq  # [..., half]
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 
 
-def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float,
+               scaling: Optional[RopeScaling] = None) -> jnp.ndarray:
     """Rotate ``x`` of shape [..., seq, heads, head_dim] by per-token
     ``positions`` of shape [..., seq]. Half-rotation (GPT-NeoX/Llama) layout:
     the first half of head_dim pairs with the second half."""
     head_dim = x.shape[-1]
-    cos, sin = rope_cos_sin(positions, head_dim, theta)  # [..., seq, half]
+    cos, sin = rope_cos_sin(positions, head_dim, theta, scaling=scaling)
     cos = cos[..., None, :]  # broadcast over heads: [..., seq, 1, half]
     sin = sin[..., None, :]
     x1 = x[..., : head_dim // 2].astype(jnp.float32)

@@ -224,6 +224,15 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
         "torch_dtype": cfg.dtype,
         "model_type": "qwen2" if cfg.attention_bias else "llama",
     }
+    if cfg.rope_scaling is not None:
+        kind = cfg.rope_scaling[0]
+        hf_cfg["rope_scaling"] = (
+            {"rope_type": "llama3", "factor": cfg.rope_scaling[1],
+             "low_freq_factor": cfg.rope_scaling[2],
+             "high_freq_factor": cfg.rope_scaling[3],
+             "original_max_position_embeddings": cfg.rope_scaling[4]}
+            if kind == "llama3" else
+            {"rope_type": "linear", "factor": cfg.rope_scaling[1]})
     if cfg.is_moe:
         hf_cfg["num_local_experts"] = cfg.num_experts
         hf_cfg["num_experts_per_tok"] = cfg.num_experts_per_tok
