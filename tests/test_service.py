@@ -390,6 +390,29 @@ class TestSchedulerCore:
         assert store.get(KEY_MASTER) == s2.service_id
         s2.stop()
 
+    def test_partitioned_master_demotes_no_split_brain(self, store):
+        """A master whose lease expired while partitioned must NOT keep
+        acting as master once it reconnects: its next keepalive returns
+        False and it demotes (or re-elects if the seat is still vacant)."""
+        s1 = self._scheduler(store, heartbeat_interval_s=0.2,
+                             master_upload_interval_s=0.1)
+        s2 = self._scheduler(store, heartbeat_interval_s=0.2,
+                             master_upload_interval_s=0.1)
+        assert s1.is_master and not s2.is_master
+        # Simulate s1's partition outliving the TTL: the store expires its
+        # lease (and master key) while s1 still believes it is master.
+        store.lease_revoke(s1._lease_id)
+        assert wait_until(lambda: s2.is_master, timeout=3.0)
+        # s1's next keepalive fails → demote, new lease, back to watching.
+        assert wait_until(lambda: not s1.is_master, timeout=3.0)
+        assert not s1.instance_mgr.is_master
+        assert store.get(KEY_MASTER) == s2.service_id
+        # The demoted replica still takes over when the new master dies.
+        s2.stop()
+        assert wait_until(lambda: s1.is_master, timeout=3.0)
+        assert store.get(KEY_MASTER) == s1.service_id
+        s1.stop()
+
     def test_schedule_tokenizes_and_routes(self, store):
         sched = self._scheduler(
             store, load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN)

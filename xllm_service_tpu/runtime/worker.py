@@ -44,7 +44,7 @@ from xllm_service_tpu.nlp.tokenizer import (
     IncrementalDecoder, Tokenizer, TokenizerFactory)
 from xllm_service_tpu.runtime.engine import Engine, EngineRequest, StepOutput
 from xllm_service_tpu.service.coordination import (
-    CoordinationStore, instance_prefix)
+    KEY_MASTER_ADDR, CoordinationStore, instance_prefix)
 from xllm_service_tpu.service.httpd import (
     HttpServer, Request, Response, Router, http_json)
 from xllm_service_tpu.service.instance_types import (
@@ -390,6 +390,15 @@ class Worker:
         # and re-mark the models awake at the router.
         self._hb_lock = make_lock("worker.hb", 5)
         self._decode_to_service = False
+        # Heartbeat / generation-push target. Starts at the configured
+        # address and FOLLOWS the store's master advertisement
+        # (KEY_MASTER_ADDR): after a service-replica takeover the worker
+        # retargets instead of orphaning on the dead master's address.
+        self._service_addr = opts.service_addr
+        self._addr_watch: Optional[int] = None
+        # Set on retarget; the heartbeat loop re-fetches /rpc/config so
+        # the decode-response topology follows the new master's mode.
+        self._service_config_stale = False
         # Graceful shutdown: while draining, heartbeats advertise every
         # model as "draining" (the router neither routes to nor wakes
         # those), new generate calls get 503, and stop() waits for
@@ -454,9 +463,62 @@ class Worker:
         self._srv.start()
         _LOCAL_WORKERS[self.name] = self
         self._register()
+        # Failover-follow is only for workers CONFIGURED with a service in
+        # front: a deliberately standalone worker sharing the store must
+        # not silently adopt the advertised master and start taking
+        # routed traffic.
+        if self.opts.service_addr:
+            # Adopt the advertised master address (may differ from the
+            # configured one after a takeover that happened before we
+            # booted), then follow future changes.
+            self._adopt_advertised_addr()
+            self._addr_watch = self.store.add_watch(
+                KEY_MASTER_ADDR, self._on_master_addr)
         self._loop_thread.start()
         self._hb_thread.start()
         return self
+
+    @property
+    def service_addr(self) -> str:
+        """Current service RPC target (configured, then store-advertised)."""
+        return self._service_addr
+
+    def _adopt_advertised_addr(self) -> bool:
+        """Re-read ``KEY_MASTER_ADDR`` and retarget if it moved. The
+        heartbeat loop calls this after consecutive failures too, closing
+        the get-then-watch race (a PUT landing before the watch is live)
+        and the watch-compaction gap."""
+        try:
+            info = self.store.get_json(KEY_MASTER_ADDR)
+        except Exception:  # noqa: BLE001 — store hiccup; retried next beat
+            return False
+        rpc = (info or {}).get("rpc")
+        if rpc and rpc != self._service_addr:
+            logger.info("service master moved %s -> %s (takeover by %s)",
+                        self._service_addr, rpc, (info or {}).get(
+                            "service_id"))
+            self._service_addr = rpc
+            self._service_config_stale = True
+            return True
+        return False
+
+    def _on_master_addr(self, event) -> None:
+        ev_type, _key, value = event
+        if ev_type != "PUT" or not value:
+            return   # DELETE = master lease expired; keep last known
+        try:
+            info = json.loads(value)
+        except ValueError:
+            return
+        rpc = info.get("rpc")
+        if rpc and rpc != self._service_addr:
+            logger.info("service master moved %s -> %s (takeover by %s)",
+                        self._service_addr, rpc, info.get("service_id"))
+            self._service_addr = rpc
+            # Topology mode may differ on the new master — the heartbeat
+            # loop re-fetches /rpc/config (no HTTP from the watch thread,
+            # it must stay responsive to further events).
+            self._service_config_stale = True
 
     def drain_and_stop(self, timeout_s: float = 30.0) -> bool:
         """Graceful shutdown: advertise draining (router stops sending
@@ -470,7 +532,7 @@ class Worker:
         # the router still routes here would surface to end clients.
         # A standalone worker (no service in front) has no router to
         # convince — skip straight to refusing.
-        if self.opts.service_addr:
+        if self.service_addr:
             for _ in range(3):
                 try:
                     if self._send_heartbeat():   # ack == HTTP 200, not
@@ -505,6 +567,12 @@ class Worker:
         self._stop.set()
         self._work_event.set()
         _LOCAL_WORKERS.pop(self.name, None)
+        if self._addr_watch is not None:
+            try:
+                self.store.cancel_watch(self._addr_watch)
+            except Exception:  # noqa: BLE001
+                pass
+            self._addr_watch = None
         # Release consumer threads blocked on live.q.get(): the engine
         # loop is about to exit, so no further outputs (or cancel
         # effects) will ever arrive — without the sentinel a client of
@@ -628,9 +696,9 @@ class Worker:
                 live.q.put(out)
                 if out.finished:
                     self._drop_live(out.request_id)
-        if to_service and self.opts.service_addr:
+        if to_service and self.service_addr:
             try:
-                http_json("POST", self.opts.service_addr,
+                http_json("POST", self.service_addr,
                           "/rpc/generations",
                           {"outputs": [o.to_json() for o in to_service]},
                           timeout=30.0)
@@ -881,7 +949,7 @@ class Worker:
             ereq, rt.tokenizer, srid, model, is_chat,
             stream, include_usage,
             stream_to_service=(not pd_prefill) and self._decode_to_service
-            and bool(self.opts.service_addr),
+            and bool(self.service_addr),
             n=n, stops=sampling.stop)
         live.sampling = sampling          # original (pre-pd) params
         live.prompt_tokens = len(token_ids)
@@ -1492,13 +1560,13 @@ class Worker:
         return self._stream_response(stream, dec, *cleanups)
 
     def _topology2(self) -> bool:
-        return self._decode_to_service and bool(self.opts.service_addr)
+        return self._decode_to_service and bool(self.service_addr)
 
     def _push_outputs_to_service(self, outs: List[RequestOutput]) -> None:
         if not outs:
             return
         try:
-            http_json("POST", self.opts.service_addr, "/rpc/generations",
+            http_json("POST", self.service_addr, "/rpc/generations",
                       stamp({"outputs": [o.to_json() for o in outs]}),
                       timeout=30.0)
         except Exception as e:  # noqa: BLE001
@@ -1635,7 +1703,7 @@ class Worker:
             is_chat=False, stream=bool(meta.get("stream")),
             include_usage=False,
             stream_to_service=self._decode_to_service
-            and bool(self.opts.service_addr),
+            and bool(self.service_addr),
             stops=sampling.stop)
         live.sampling = sampling
         live.prompt_tokens = len(prompt)
@@ -1749,30 +1817,52 @@ class Worker:
     # ------------------------------------------------------------------
     # Heartbeats
     # ------------------------------------------------------------------
+    def _fetch_service_config(self) -> bool:
+        """Learn decode-response-to-service mode from the service's config
+        (GetConfig, rpc_service/service.cpp:215-223). Re-run after every
+        retarget — the takeover master may run a different topology."""
+        if not self.service_addr:
+            return False
+        try:
+            status, cfg = http_json("GET", self.service_addr,
+                                    "/rpc/config", timeout=5.0)
+        except Exception:  # noqa: BLE001
+            return False
+        if status == 200 and cfg is not None:
+            self._decode_to_service = bool(
+                cfg.get("enable_decode_response_to_service"))
+            return True
+        return False
+
     def _heartbeat_loop(self) -> None:
-        # Learn decode-response-to-service mode from the service's config
-        # (GetConfig, rpc_service/service.cpp:215-223).
-        if self.opts.service_addr:
-            try:
-                status, cfg = http_json("GET", self.opts.service_addr,
-                                        "/rpc/config", timeout=5.0)
-                if status == 200 and cfg:
-                    self._decode_to_service = bool(
-                        cfg.get("enable_decode_response_to_service"))
-            except Exception:  # noqa: BLE001
-                pass
+        self._service_config_stale = not self._fetch_service_config() \
+            and bool(self.service_addr)
+        hb_failures = 0
         while not self._stop.wait(self.opts.heartbeat_interval_s):
             try:
                 if self._lease_id is not None:
                     self.store.lease_keepalive(self._lease_id)
-                self._send_heartbeat()
+                if self._service_config_stale:
+                    self._service_config_stale = not \
+                        self._fetch_service_config()
+                if self._send_heartbeat():
+                    hb_failures = 0
+                else:
+                    hb_failures += 1
             except Exception as e:  # noqa: BLE001
+                hb_failures += 1
                 logger.warning("heartbeat failed: %s", e)
+            if hb_failures >= 2 and self.opts.service_addr:
+                # The master may have moved while we missed the watch
+                # event (boot race, watch compaction): re-read the
+                # advertisement directly.
+                if self._adopt_advertised_addr():
+                    hb_failures = 0
 
     def _send_heartbeat(self) -> bool:
         """→ True when the service acknowledged (HTTP 200) — the drain
         handshake needs that distinction; a 500 must not count."""
-        if not self.opts.service_addr:
+        if not self.service_addr:
             return False
         with self._hb_lock:
             return self._send_heartbeat_locked()
@@ -1801,7 +1891,7 @@ class Worker:
             cache_stored=stored, cache_removed=removed,
             model_states=model_states)
         self._latency = LatencyMetrics()
-        status, _ = http_json("POST", self.opts.service_addr,
+        status, _ = http_json("POST", self.service_addr,
                               "/rpc/heartbeat", stamp(hb.to_json()),
                               timeout=10.0)
         return status == 200

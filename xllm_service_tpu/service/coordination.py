@@ -34,6 +34,12 @@ WatchEvent = Tuple[str, str, Optional[str]]
 WatchCallback = Callable[[WatchEvent], None]
 
 KEY_MASTER = "XLLM:SERVICE:MASTER"
+# Current master's reachable addresses, JSON {service_id, rpc, http},
+# written under the master's lease. Workers watch this key so heartbeats /
+# generation pushes follow a replica takeover instead of orphaning on the
+# dead master's static address (the reference leaves this to an external
+# VIP; here it is part of the coordination contract).
+KEY_MASTER_ADDR = "XLLM:SERVICE:ADDR"
 KEY_LOADMETRICS = "XLLM:LOADMETRICS:"
 KEY_CACHE = "XLLM:CACHE:"
 

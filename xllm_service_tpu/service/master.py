@@ -66,6 +66,10 @@ class Master:
     def start(self) -> "Master":
         self._http_srv.start()
         self._rpc_srv.start()
+        # Advertise reachable addresses through the store (current master
+        # publishes them; replicas re-publish on takeover) so workers can
+        # follow a failover without a fronting VIP.
+        self.scheduler.announce(self.rpc_address, self.http_address)
         logger.info("service up: http=%s rpc=%s master=%s",
                     self.http_address, self.rpc_address,
                     self.scheduler.is_master)
@@ -102,6 +106,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         action="store_true")
     parser.add_argument("--target-ttft-ms", type=float, default=1000.0)
     parser.add_argument("--target-tpot-ms", type=float, default=50.0)
+    parser.add_argument("--heartbeat-interval", type=float, default=3.0,
+                        help="election lease scale + instance liveness (s)")
+    parser.add_argument("--master-upload-interval", type=float, default=3.0)
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -114,11 +121,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         tokenizer_path=args.tokenizer_path,
         enable_request_trace=args.enable_request_trace,
         target_ttft_ms=args.target_ttft_ms,
-        target_tpot_ms=args.target_tpot_ms)
+        target_tpot_ms=args.target_tpot_ms,
+        heartbeat_interval_s=args.heartbeat_interval,
+        master_upload_interval_s=args.master_upload_interval)
     if args.enable_decode_response_to_service:
         opts.enable_decode_response_to_service = True
 
     master = Master(opts).start()
+    # Machine-parseable liveness line (HA test harness + ops scripts read
+    # this to learn the bound ports when started with --http-port 0).
+    print(f"XLLM_SERVICE_UP http={master.http_address} "
+          f"rpc={master.rpc_address} "
+          f"master={int(master.scheduler.is_master)}", flush=True)
 
     def on_signal(signum, frame) -> None:
         logger.info("signal %d: shutting down", signum)

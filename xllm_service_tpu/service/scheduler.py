@@ -31,7 +31,7 @@ from xllm_service_tpu.config import ServiceOptions
 from xllm_service_tpu.nlp.chat_template import ChatTemplate
 from xllm_service_tpu.nlp.tokenizer import Tokenizer, TokenizerFactory
 from xllm_service_tpu.service.coordination import (
-    KEY_MASTER, CoordinationStore)
+    KEY_MASTER, KEY_MASTER_ADDR, CoordinationStore)
 from xllm_service_tpu.service.instance_mgr import InstanceMgr
 from xllm_service_tpu.service.instance_types import (
     Heartbeat, RequestPhase)
@@ -95,6 +95,7 @@ class Scheduler:
         self.lb_policy = create_policy(opts, self.instance_mgr,
                                        self.kvcache_mgr)
 
+        self._addresses: Optional[Dict[str, str]] = None
         self._requests: Dict[str, _TrackedRequest] = {}
         self._req_lock = make_lock("scheduler.req", 10)
         self._pools = OrderedFanInPools(opts.num_output_pools)
@@ -118,17 +119,72 @@ class Scheduler:
             self.is_master = True
             self.instance_mgr.is_master = True
             self.kvcache_mgr.is_master = True
+            self._publish_addresses()
             logger.info("%s took over as master", self.service_id)
+
+    def announce(self, rpc_addr: str, http_addr: str) -> None:
+        """Record this replica's reachable addresses; the current master
+        publishes them under ``KEY_MASTER_ADDR`` (its lease) so workers
+        retarget heartbeats/pushes after a takeover."""
+        self._addresses = {"service_id": self.service_id,
+                           "rpc": rpc_addr, "http": http_addr}
+        if self.is_master:
+            self._publish_addresses()
+
+    def _publish_addresses(self) -> None:
+        if getattr(self, "_addresses", None):
+            try:
+                self.store.put_json(KEY_MASTER_ADDR, self._addresses,
+                                    self._lease_id)
+            except Exception as e:  # noqa: BLE001 — store hiccup; retried
+                logger.warning("publish master addr failed: %s", e)
+
+    def _on_lease_lost(self) -> None:
+        """Keepalive said the lease is gone (partition outlived the TTL):
+        whatever we were, that identity is dead. Grant a fresh lease, try
+        to win the (possibly vacant) election; otherwise demote — a stale
+        master must NOT keep writing LOADMETRICS/CACHE alongside the
+        takeover master (split-brain)."""
+        was_master = self.is_master
+        self._lease_id = self.store.lease_grant(
+            max(3 * self.opts.heartbeat_interval_s, 3.0))
+        if self.store.compare_create(KEY_MASTER, self.service_id,
+                                     self._lease_id):
+            self.is_master = True
+            self.instance_mgr.is_master = True
+            self.kvcache_mgr.is_master = True
+            self._publish_addresses()   # old advert died with the lease
+            if was_master:
+                logger.warning("%s lease expired but election was vacant; "
+                               "re-elected with a fresh lease",
+                               self.service_id)
+        else:
+            self.is_master = False
+            self.instance_mgr.is_master = False
+            self.kvcache_mgr.is_master = False
+            if self._master_watch is None:
+                self._master_watch = self.store.add_watch(
+                    KEY_MASTER, self._on_master_event)
+            if was_master:
+                logger.warning(
+                    "%s demoted: lease expired and %s took over",
+                    self.service_id, self.store.get(KEY_MASTER))
 
     def _master_loop(self) -> None:
         """Keepalive + periodic state upload (scheduler.cpp:138-146)."""
         interval = self.opts.master_upload_interval_s
         while not self._stop.wait(interval):
             try:
-                self.store.lease_keepalive(self._lease_id)
+                if not self.store.lease_keepalive(self._lease_id):
+                    self._on_lease_lost()
                 if self.is_master:
                     self.instance_mgr.upload_load_metrics()
                     self.kvcache_mgr.upload_kvcache()
+                    # Self-heal the address advertisement (lost store
+                    # write, or the key expired with a previous lease).
+                    if self._addresses is not None \
+                            and self.store.get(KEY_MASTER_ADDR) is None:
+                        self._publish_addresses()
             except Exception as e:  # noqa: BLE001 — store hiccup, retry next tick
                 logger.warning("master loop error: %s", e)
 
