@@ -113,9 +113,13 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     # Sparse dispatch capacity factor (parallel/expert.py): each expert
-    # takes ≤ ceil(k·N/E·cf) tokens per call. ≥ E/k guarantees no drops;
+    # takes ≤ ceil(k·G·cf/E) tokens per group. ≥ E/k guarantees no drops;
     # 0 selects the dense-compute oracle (every expert on every token).
     moe_capacity_factor: float = 2.0
+    # Dispatch group size G: tokens route in groups so the dispatch /
+    # combine masks are [G, E, C_g] per group — linear, not quadratic, in
+    # window length (GShard's group axis; parallel/expert.py).
+    moe_group_size: int = 512
     dtype: str = "bfloat16"
 
     def __post_init__(self) -> None:

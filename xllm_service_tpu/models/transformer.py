@@ -138,14 +138,20 @@ def _qkv(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray):
 
 def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
          x: jnp.ndarray, valid: Optional[jnp.ndarray] = None
-         ) -> jnp.ndarray:
+         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """SwiGLU MLP; MoE routes each token through its top-k experts.
     ``valid`` [B, T] bool marks real tokens — padding / inactive lanes are
     kept out of sparse-MoE routing so they can't consume expert capacity
-    (a real token's output must not depend on batch composition)."""
+    (a real token's output must not depend on batch composition).
+
+    Returns ``(out, moe_dropped)`` — the int32 count of (token, expert)
+    assignments lost to capacity (always 0 for dense / oracle paths), so
+    the serving layer can surface drop pressure instead of degrading
+    silently."""
+    zero = jnp.zeros((), jnp.int32)
     if not cfg.is_moe:
         return (jax.nn.silu(x @ lp["gate_proj"]) * (x @ lp["up_proj"])) \
-            @ lp["down_proj"]
+            @ lp["down_proj"], zero
     if cfg.moe_capacity_factor > 0:
         # Sparse top-k dispatch into capacity buckets: per-token FLOPs are
         # k×(expert MLP), independent of E; GSPMD partitions the expert
@@ -153,7 +159,8 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
         from xllm_service_tpu.parallel.expert import moe_mlp
         return moe_mlp(x, lp["router"], lp["gate_proj"], lp["up_proj"],
                        lp["down_proj"], cfg.num_experts_per_tok,
-                       cfg.moe_capacity_factor, valid=valid)
+                       cfg.moe_capacity_factor, valid=valid,
+                       group_size=cfg.moe_group_size)
     # Dense oracle (moe_capacity_factor == 0): every expert on every token,
     # mixed by routing weight — the test reference for the sparse path.
     gates = jax.nn.softmax((x @ lp["router"]).astype(jnp.float32), axis=-1)
@@ -166,7 +173,8 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
     h = jax.nn.silu(jnp.einsum("btd,edf->btef", x, lp["gate_proj"])) \
         * jnp.einsum("btd,edf->btef", x, lp["up_proj"])
     out = jnp.einsum("btef,efd->bted", h, lp["down_proj"])
-    return jnp.einsum("bted,bte->btd", out, weights.astype(x.dtype))
+    return jnp.einsum("bted,bte->btd", out,
+                      weights.astype(x.dtype)), zero
 
 
 # ---------------------------------------------------------------------------
@@ -180,10 +188,16 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                     mm_embeds: Optional[jnp.ndarray] = None,
                     mm_positions: Optional[jnp.ndarray] = None,
                     prompt_lp_targets: Optional[jnp.ndarray] = None,
+                    return_stats: bool = False,
                     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], KVCache]:
     """Prefill ``tokens`` [B, T] (padded; true new-token counts in
     ``lengths``; nonzero ``start_pos`` = prefix-cache hit, those tokens are
     already resident in the cache).
+
+    ``return_stats`` (static) appends a stats dict (``moe_dropped``:
+    int32 capacity-dropped assignments summed over layers) as the final
+    element — the serving engine's drop accounting; default off keeps the
+    3-tuple contract for existing callers.
 
     ``mm_embeds`` [B, M, D] + ``mm_positions`` [B, M] splice multimodal
     (vision-encoder) embeddings over the token embeddings at the given
@@ -236,10 +250,11 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             attn = mha_prefill_auto(q, k_all, v_all, kv_lengths, start_pos)
         x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
         h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, cfg, h, valid=tok_valid)
-        return x, (k, v)
+        m, dropped = _mlp(lp, cfg, h, valid=tok_valid)
+        x = x + m
+        return x, (k, v, dropped)
 
-    x, (k_new, v_new) = jax.lax.scan(
+    x, (k_new, v_new, dropped_l) = jax.lax.scan(
         layer, x, (params["layers"], k_pages, v_pages))
     k_pages, v_pages = write_prefill_kv_all_layers(
         k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths)
@@ -251,12 +266,14 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     last_x = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
     last_logits = (last_x @ head).astype(jnp.float32)            # [B, V]
     all_logits = (x @ head).astype(jnp.float32) if return_all_logits else None
+    outs = [last_logits, all_logits, (k_pages, v_pages)]
     if prompt_lp_targets is not None:
-        # 4-tuple ONLY on the echo+logprobs path: existing callers (and
-        # the driver's entry contract) unpack three.
-        plp = _prompt_logprobs(x, head, prompt_lp_targets)
-        return last_logits, all_logits, (k_pages, v_pages), plp
-    return last_logits, all_logits, (k_pages, v_pages)
+        # 4th element ONLY on the echo+logprobs path: existing callers
+        # (and the driver's entry contract) unpack three.
+        outs.append(_prompt_logprobs(x, head, prompt_lp_targets))
+    if return_stats:
+        outs.append({"moe_dropped": jnp.sum(dropped_l)})
+    return tuple(outs)
 
 
 def _prompt_logprobs(x: jnp.ndarray, head: jnp.ndarray,
@@ -287,6 +304,7 @@ def forward_prefill_ring(params: Params, cfg: ModelConfig,
                          tokens: jnp.ndarray, lengths: jnp.ndarray,
                          kv: KVCache, page_table: jnp.ndarray, mesh,
                          axis_name: str = "sp",
+                         return_stats: bool = False,
                          ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
                                     KVCache]:
     """Sequence-parallel long-context prefill: exact causal attention with
@@ -331,10 +349,11 @@ def forward_prefill_ring(params: Params, cfg: ModelConfig,
         attn = _ring(q, k, v, lengths)
         x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
         h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, cfg, h, valid=tok_valid)
-        return x, (k, v)
+        m, dropped = _mlp(lp, cfg, h, valid=tok_valid)
+        x = x + m
+        return x, (k, v, dropped)
 
-    x, (k_new, v_new) = jax.lax.scan(layer, x, params["layers"])
+    x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, params["layers"])
     k_pages, v_pages = write_prefill_kv_all_layers(
         k_pages, v_pages, k_new, v_new, page_table,
         jnp.zeros((B,), jnp.int32), lengths)
@@ -345,6 +364,9 @@ def forward_prefill_ring(params: Params, cfg: ModelConfig,
     last_idx = jnp.maximum(lengths - 1, 0)
     last_x = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
     last_logits = (last_x @ head).astype(jnp.float32)
+    if return_stats:
+        return last_logits, None, (k_pages, v_pages), \
+            {"moe_dropped": jnp.sum(dropped_l)}
     return last_logits, None, (k_pages, v_pages)
 
 
@@ -375,7 +397,7 @@ def forward_embedding(params: Params, cfg: ModelConfig,
                            jnp.zeros((B,), jnp.int32))
         x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
         h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, cfg, h, valid=tok_valid)
+        x = x + _mlp(lp, cfg, h, valid=tok_valid)[0]
         return x, None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])
@@ -396,10 +418,12 @@ def forward_embedding(params: Params, cfg: ModelConfig,
 def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                    positions: jnp.ndarray, active: jnp.ndarray,
                    kv: KVCache, page_table: jnp.ndarray,
+                   return_stats: bool = False,
                    ) -> Tuple[jnp.ndarray, KVCache]:
     """One decode step for ``tokens`` [B] at ``positions`` [B]
     (``active`` [B] bool masks empty batch slots). Returns
-    (logits [B, V] fp32, kv')."""
+    (logits [B, V] fp32, kv'); with ``return_stats`` (static) a trailing
+    stats dict (``moe_dropped``) is appended."""
     k_pages, v_pages = kv
     x = params["embed"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))  # [B,1,D]
     cache_lens = jnp.where(active, positions, 0)   # tokens already written
@@ -420,10 +444,11 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         B = tokens.shape[0]
         x = x + (attn.reshape(B, 1, -1) @ lp["o_proj"])
         h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, cfg, h, valid=active[:, None])
-        return x, (k[:, 0], v[:, 0])
+        m, dropped = _mlp(lp, cfg, h, valid=active[:, None])
+        x = x + m
+        return x, (k[:, 0], v[:, 0], dropped)
 
-    x, (k_new, v_new) = jax.lax.scan(
+    x, (k_new, v_new, dropped_l) = jax.lax.scan(
         layer, x, (params["layers"], k_pages, v_pages))
     k_pages, v_pages = write_decode_kv_all_layers(
         k_pages, v_pages, k_new, v_new, page_table, positions, active)
@@ -432,4 +457,7 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     if head is None:
         head = params["embed"].T
     logits = (x[:, 0] @ head).astype(jnp.float32)                # [B, V]
+    if return_stats:
+        return logits, (k_pages, v_pages), \
+            {"moe_dropped": jnp.sum(dropped_l)}
     return logits, (k_pages, v_pages)

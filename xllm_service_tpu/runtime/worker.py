@@ -483,6 +483,20 @@ class Worker:
         """Current service RPC target (configured, then store-advertised)."""
         return self._service_addr
 
+    def _retarget(self, info) -> bool:
+        """Adopt an advertised master address if it differs from the
+        current target. Marks the service config stale — the heartbeat
+        loop re-fetches /rpc/config (never HTTP from the watch thread,
+        it must stay responsive to further events)."""
+        rpc = (info or {}).get("rpc")
+        if not rpc or rpc == self._service_addr:
+            return False
+        logger.info("service master moved %s -> %s (takeover by %s)",
+                    self._service_addr, rpc, (info or {}).get("service_id"))
+        self._service_addr = rpc
+        self._service_config_stale = True
+        return True
+
     def _adopt_advertised_addr(self) -> bool:
         """Re-read ``KEY_MASTER_ADDR`` and retarget if it moved. The
         heartbeat loop calls this after consecutive failures too, closing
@@ -492,15 +506,7 @@ class Worker:
             info = self.store.get_json(KEY_MASTER_ADDR)
         except Exception:  # noqa: BLE001 — store hiccup; retried next beat
             return False
-        rpc = (info or {}).get("rpc")
-        if rpc and rpc != self._service_addr:
-            logger.info("service master moved %s -> %s (takeover by %s)",
-                        self._service_addr, rpc, (info or {}).get(
-                            "service_id"))
-            self._service_addr = rpc
-            self._service_config_stale = True
-            return True
-        return False
+        return self._retarget(info)
 
     def _on_master_addr(self, event) -> None:
         ev_type, _key, value = event
@@ -510,15 +516,7 @@ class Worker:
             info = json.loads(value)
         except ValueError:
             return
-        rpc = info.get("rpc")
-        if rpc and rpc != self._service_addr:
-            logger.info("service master moved %s -> %s (takeover by %s)",
-                        self._service_addr, rpc, info.get("service_id"))
-            self._service_addr = rpc
-            # Topology mode may differ on the new master — the heartbeat
-            # loop re-fetches /rpc/config (no HTTP from the watch thread,
-            # it must stay responsive to further events).
-            self._service_config_stale = True
+        self._retarget(info)
 
     def drain_and_stop(self, timeout_s: float = 30.0) -> bool:
         """Graceful shutdown: advertise draining (router stops sending
@@ -1820,15 +1818,18 @@ class Worker:
     def _fetch_service_config(self) -> bool:
         """Learn decode-response-to-service mode from the service's config
         (GetConfig, rpc_service/service.cpp:215-223). Re-run after every
-        retarget — the takeover master may run a different topology."""
-        if not self.service_addr:
+        retarget — the takeover master may run a different topology.
+        Returns True only when the fetched config still belongs to the
+        CURRENT target: a retarget that lands mid-fetch must not let the
+        old master's topology answer clear the stale flag."""
+        addr = self.service_addr
+        if not addr:
             return False
         try:
-            status, cfg = http_json("GET", self.service_addr,
-                                    "/rpc/config", timeout=5.0)
+            status, cfg = http_json("GET", addr, "/rpc/config", timeout=5.0)
         except Exception:  # noqa: BLE001
             return False
-        if status == 200 and cfg is not None:
+        if status == 200 and cfg is not None and addr == self.service_addr:
             self._decode_to_service = bool(
                 cfg.get("enable_decode_response_to_service"))
             return True
@@ -1881,7 +1882,8 @@ class Worker:
                 waiting_requests=lm["waiting_requests"],
                 running_requests=lm["running_requests"],
                 kv_cache_usage=lm["kv_cache_usage"],
-                num_preemptions=lm["num_preemptions"])
+                num_preemptions=lm["num_preemptions"],
+                moe_dropped_tokens=lm.get("moe_dropped_tokens", 0))
             ev = rt.engine.drain_kvcache_event()
             stored = [h.hex() for h in ev.stored]
             removed = [h.hex() for h in ev.removed]

@@ -82,6 +82,9 @@ class LoadMetrics:
     running_requests: int = 0
     kv_cache_usage: float = 0.0          # [0, 1]
     num_preemptions: int = 0
+    # MoE capacity-dropped (token, expert) assignments since engine boot
+    # (0 on dense models) — routing/ops visibility into quality pressure.
+    moe_dropped_tokens: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

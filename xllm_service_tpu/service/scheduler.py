@@ -145,6 +145,14 @@ class Scheduler:
         to win the (possibly vacant) election; otherwise demote — a stale
         master must NOT keep writing LOADMETRICS/CACHE alongside the
         takeover master (split-brain)."""
+        if self.is_master and self.store.get(KEY_MASTER) == self.service_id:
+            # Keepalive can return False on a transport blip (e.g. the
+            # etcd gateway 502ing one call) while the lease is actually
+            # alive. If we still own the master key, the lease has NOT
+            # expired (expiry deletes the key) — don't self-demote over
+            # one bad RPC; a genuine expiry shows up next tick as a
+            # deleted/foreign key.
+            return
         was_master = self.is_master
         self._lease_id = self.store.lease_grant(
             max(3 * self.opts.heartbeat_interval_s, 3.0))
