@@ -43,13 +43,16 @@ from xllm_service_tpu.utils.wire import stamp
 
 
 class FakeWorker:
-    """Speaks the worker contract; generates ``gen_tokens`` instantly."""
+    """Speaks the worker contract; generates ``gen_tokens`` instantly
+    (or after ``delay_ms`` — overload mode uses the delay to make
+    requests HOLD service threads the way real decode does)."""
 
     def __init__(self, store: InMemoryStore, service_rpc: str,
-                 gen_tokens: int = 16) -> None:
+                 gen_tokens: int = 16, delay_ms: float = 0.0) -> None:
         self.store = store
         self.service_rpc = service_rpc
         self.gen_tokens = gen_tokens
+        self.delay_ms = delay_ms
         router = Router()
         router.route("GET", "/hello",
                      lambda r: Response.json({"ok": True}))
@@ -94,6 +97,8 @@ class FakeWorker:
                 pass
 
     def _generate(self, req: Request, is_chat: bool) -> Response:
+        if self.delay_ms:
+            time.sleep(self.delay_ms / 1e3)
         body = req.json()
         srid = body.get("service_request_id", "fake-req")
         model = body.get("model", "fake")
@@ -241,6 +246,107 @@ def _measure(master, workers, store, num_requests, concurrency,
     }
 
 
+def overload_run(max_concurrency: int, offered_levels: List[int],
+                 requests_per_level: int, n_workers: int,
+                 worker_delay_ms: float) -> Dict:
+    """Saturation behavior: sweep offered concurrency past the admission
+    limit and show graceful shedding (flat p99 on accepted requests,
+    503s absorbing the excess) instead of a thread pile-up. Fake workers
+    hold each request ``worker_delay_ms`` so in-flight requests occupy
+    service threads the way real decode streams do."""
+    store = InMemoryStore()
+    opts = ServiceOptions(
+        http_port=0, rpc_port=0, max_concurrency=max_concurrency,
+        load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
+        heartbeat_interval_s=0.5, master_upload_interval_s=0.5)
+    master = Master(opts, store=store).start()
+    workers = [FakeWorker(store, master.rpc_address, gen_tokens=4,
+                          delay_ms=worker_delay_ms)
+               for _ in range(n_workers)]
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if len(master.scheduler.instance_mgr.prefill_instances()) \
+                    == n_workers:
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("fake workers never registered")
+        http_json("POST", master.http_address, "/v1/completions",
+                  {"model": "fake", "prompt": "warm", "max_tokens": 2},
+                  timeout=60.0)
+
+        from benchmarks.loadgen import _percentile
+        sweep = []
+        for offered in offered_levels:
+            lat_ms: List[float] = []
+            counts = {"accepted": 0, "rejected": 0, "errors": 0}
+            lock = threading.Lock()
+            idx = [0]
+
+            def client():
+                while True:
+                    with lock:
+                        if idx[0] >= requests_per_level:
+                            return
+                        idx[0] += 1
+                    t0 = time.monotonic()
+                    try:
+                        status, _ = http_json(
+                            "POST", master.http_address, "/v1/completions",
+                            {"model": "fake", "prompt": "x",
+                             "max_tokens": 4}, timeout=120.0)
+                    except Exception:  # noqa: BLE001
+                        status = -1
+                    dt = 1e3 * (time.monotonic() - t0)
+                    with lock:
+                        if status == 200:
+                            counts["accepted"] += 1
+                            lat_ms.append(dt)
+                        elif status == 503:
+                            counts["rejected"] += 1
+                        else:
+                            counts["errors"] += 1
+
+            t0 = time.monotonic()
+            threads = [threading.Thread(target=client)
+                       for _ in range(offered)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.monotonic() - t0
+            lat_ms.sort()
+            sweep.append({
+                "offered_concurrency": offered,
+                "accepted": counts["accepted"],
+                "rejected_503": counts["rejected"],
+                "errors": counts["errors"],
+                "accepted_rps": round(counts["accepted"] / elapsed, 1),
+                "p50_ms": round(_percentile(lat_ms, 50), 2),
+                "p99_ms": round(_percentile(lat_ms, 99), 2),
+            })
+        return {
+            "metric": "service_overload",
+            "value": sweep[-1]["p99_ms"],
+            "unit": "p99_ms_at_max_offered",
+            "detail": {
+                "max_concurrency": max_concurrency,
+                "worker_delay_ms": worker_delay_ms,
+                "requests_per_level": requests_per_level,
+                "sweep": sweep,
+                "what": "graceful saturation: past the admission limit "
+                        "excess load becomes fast 503s, accepted-request "
+                        "p99 stays bounded",
+            },
+        }
+    finally:
+        for w in workers:
+            w.stop()
+        master.stop()
+        store.close()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--requests", type=int, default=400)
@@ -248,7 +354,18 @@ def main() -> None:
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--gen-tokens", type=int, default=16)
     ap.add_argument("--stream", action="store_true")
+    ap.add_argument("--overload", action="store_true",
+                    help="saturation sweep past --max-concurrency")
+    ap.add_argument("--max-concurrency", type=int, default=32)
+    ap.add_argument("--worker-delay-ms", type=float, default=20.0)
     args = ap.parse_args()
+    if args.overload:
+        levels = [args.max_concurrency // 2, args.max_concurrency,
+                  2 * args.max_concurrency, 4 * args.max_concurrency]
+        print(json.dumps(overload_run(
+            args.max_concurrency, levels, args.requests, args.workers,
+            args.worker_delay_ms)))
+        return
     print(json.dumps(run(args.requests, args.concurrency, args.workers,
                          args.gen_tokens, args.stream)))
 

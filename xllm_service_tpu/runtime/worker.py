@@ -78,6 +78,10 @@ class WorkerOptions:
     lease_ttl_s: float = 9.0
     # End-to-end bound on one generation (PD relay reads, import waits).
     request_timeout_s: float = 600.0
+    # Concurrent-request admission cap on this worker's HTTP server
+    # (reference engine-side brpc max_concurrency; 0 = unlimited). A 503
+    # past the cap is the refusal class the service re-dispatches.
+    max_concurrency: int = 128
     enable_profiling: bool = False
     memory_budget_gb: float = 60.0
     # PD migration to a decode worker in this process skips the HTTP
@@ -445,7 +449,19 @@ class Worker:
         self.kv_migration_bytes = 0
         self.kv_migration_seconds = 0.0
         self.kv_migration_direct = 0    # device-to-device (no host copy)
-        self._srv = HttpServer(opts.host, opts.port, router)
+        # Admission guards the ENTRY endpoints (/v1/* generate /
+        # embeddings — the ones the service re-dispatches on 503).
+        # Control verbs and mid-request continuation traffic are exempt:
+        # shedding /sleep desyncs the router's model-state map, and
+        # shedding /kv/import or /encode breaks an already-admitted
+        # request's PD/EPD pipeline instead of reducing load.
+        from xllm_service_tpu.service.httpd import _ADMISSION_EXEMPT
+        self._srv = HttpServer(
+            opts.host, opts.port, router,
+            max_concurrency=lambda: self.opts.max_concurrency,
+            admission_exempt=_ADMISSION_EXEMPT + (
+                "/sleep", "/wakeup", "/cancel", "/flip_role",
+                "/fork_master", "/kv/import", "/encode"))
         self.name = self._srv.address
 
         self._loop_thread = threading.Thread(
@@ -696,10 +712,13 @@ class Worker:
                     self._drop_live(out.request_id)
         if to_service and self.service_addr:
             try:
-                http_json("POST", self.service_addr,
-                          "/rpc/generations",
-                          {"outputs": [o.to_json() for o in to_service]},
-                          timeout=30.0)
+                status, _ = http_json(
+                    "POST", self.service_addr, "/rpc/generations",
+                    {"outputs": [o.to_json() for o in to_service]},
+                    timeout=30.0)
+                if status != 200:
+                    logger.warning("generations push refused: %d (%d "
+                                   "outputs lost)", status, len(to_service))
             except Exception as e:  # noqa: BLE001
                 logger.warning("generations push failed: %s", e)
 
@@ -1564,9 +1583,13 @@ class Worker:
         if not outs:
             return
         try:
-            http_json("POST", self.service_addr, "/rpc/generations",
-                      stamp({"outputs": [o.to_json() for o in outs]}),
-                      timeout=30.0)
+            status, _ = http_json(
+                "POST", self.service_addr, "/rpc/generations",
+                stamp({"outputs": [o.to_json() for o in outs]}),
+                timeout=30.0)
+            if status != 200:
+                logger.warning("generations push refused: %d (%d outputs "
+                               "lost)", status, len(outs))
         except Exception as e:  # noqa: BLE001
             logger.warning("generations push failed: %s", e)
 

@@ -55,6 +55,9 @@ class HttpService:
                                     opts.enable_request_trace)
         self._num_requests = 0
         self._num_errors = 0
+        # {"http": Admission, "rpc": Admission} — injected by Master once
+        # the servers exist; /metrics reports their pressure.
+        self.admissions = None
         self._lock = make_lock("http.stats", 90)
 
     def install(self, router: Router) -> None:
@@ -426,6 +429,14 @@ class HttpService:
             f"xllm_service_is_master "
             f"{1 if self.scheduler.is_master else 0}",
         ]
+        # Admission pressure (set by Master after server construction):
+        # active slots + total 503-rejected per server.
+        for srv_name, adm in (self.admissions or {}).items():
+            tag = f'server="{srv_name}"'
+            lines.append(
+                f"xllm_service_admission_active{{{tag}}} {adm.active}")
+            lines.append(f"xllm_service_admission_rejected_total{{{tag}}} "
+                         f"{adm.rejected_total}")
         for name in mgr.names():
             inst = mgr.get(name)
             if inst is None:
@@ -478,7 +489,11 @@ class HttpService:
     # reference with the scheduler and InstanceMgr, so routing sees the
     # new thresholds on the next request)
     # ------------------------------------------------------------------
-    _RELOADABLE = ("target_ttft_ms", "target_tpot_ms")
+    # max_concurrency reloads live because the servers' Admission reads
+    # opts through a callable (master.py) — 0 disables the limit.
+    _RELOADABLE = ("target_ttft_ms", "target_tpot_ms", "max_concurrency")
+    _INT_FLAGS = ("max_concurrency",)
+    _ZERO_OK = ("max_concurrency",)
 
     def _admin_flags_get(self, http_req: Request) -> Response:
         return Response.json(
@@ -504,10 +519,11 @@ class HttpService:
                 val = float(v)
             except (TypeError, ValueError):
                 return Response.error(400, f"{k} must be a number")
-            if not (math.isfinite(val) and val > 0):
+            floor_ok = val >= 0 if k in self._ZERO_OK else val > 0
+            if not (math.isfinite(val) and floor_ok):
                 return Response.error(
                     400, f"{k} must be a positive finite number")
-            validated[k] = val
+            validated[k] = int(val) if k in self._INT_FLAGS else val
         for k, val in validated.items():
             setattr(self.opts, k, val)
         logger.info("admin flag reload: %s", validated)

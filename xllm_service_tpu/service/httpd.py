@@ -91,6 +91,61 @@ class Response:
 Handler = Callable[[Request], Response]
 
 
+class Admission:
+    """Server-wide concurrent-request limit — the rebuild of brpc's
+    ``max_concurrency`` backpressure (reference global_gflags.cpp:33-48,
+    applied to both servers in master.cpp:60-140). Past the limit a new
+    request gets an immediate 503 + Retry-After instead of an unbounded
+    thread pile-up; a 503 is exactly the refusal class the service's
+    re-dispatch path already handles, so worker-side overload shifts
+    load instead of failing requests.
+
+    ``limit`` may be an int, None (unlimited), or a zero-arg callable
+    returning either — the callable form reads a live options object so
+    ``/admin/flags`` hot-reload applies without a restart. A slot is
+    held for the FULL handler lifetime including streaming, so long SSE
+    responses count toward the limit (they hold a server thread)."""
+
+    def __init__(self, limit=None) -> None:
+        self._limit = limit
+        self._active = 0
+        self._lock = threading.Lock()
+        self.rejected_total = 0
+
+    def _current_limit(self) -> Optional[int]:
+        lim = self._limit() if callable(self._limit) else self._limit
+        return None if not lim or lim <= 0 else lim
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+    def try_enter(self) -> bool:
+        with self._lock:
+            lim = self._current_limit()
+            if lim is not None and self._active >= lim:
+                self.rejected_total += 1
+                return False
+            self._active += 1
+            return True
+
+    def leave(self) -> None:
+        with self._lock:
+            self._active -= 1
+
+
+# Admission bites at REQUEST ENTRY (client-facing /v1/*), never on
+# control-plane or continuation traffic:
+# - liveness (heartbeats), observability, and the knobs to RAISE the
+#   limit must not be starved by the congestion they diagnose;
+# - /rpc/* carries workers' pushes for ALREADY-admitted requests
+#   (generations fan-in) — shedding those doesn't reduce load, it
+#   corrupts in-flight streams (tokens silently dropped).
+# Servers with other continuation/control verbs extend this list
+# (worker.py: /sleep, /kv/import, /encode, ...).
+_ADMISSION_EXEMPT = ("/metrics", "/hello", "/admin/", "/rpc/")
+
+
 class Router:
     """Exact-path and prefix routes per method."""
 
@@ -134,23 +189,53 @@ class _RequestHandler(BaseHTTPRequestHandler):
     # connections well before this (see _ConnPool._MAX_IDLE_S), so a
     # reused client socket is never one the server already killed.
     timeout = 60.0
-    router: Router  # set by server factory
+    router: Router       # set by server factory
+    admission: Optional[Admission] = None      # set by server factory
+    admission_exempt: Tuple[str, ...] = _ADMISSION_EXEMPT
 
     def log_message(self, fmt: str, *args: Any) -> None:  # quiet
         pass
 
     def _handle(self) -> None:
         parsed = urlparse(self.path)
+        # Admission runs BEFORE the body read: a shed request must not
+        # pay an unbounded (or slow-loris) upload on a server thread —
+        # the reject path closes the connection instead of draining.
+        admitted = (self.admission is None
+                    or parsed.path.startswith(self.admission_exempt)
+                    or self.admission.try_enter())
+        if not admitted:
+            self.close_connection = True
+            try:
+                self._write(Response(
+                    status=503,
+                    body=json.dumps({"error": {
+                        "message": "server at max_concurrency",
+                        "type": "overloaded_error",
+                        "code": 503}}).encode("utf-8"),
+                    headers={"Retry-After": "1", "Connection": "close"}))
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            return
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
         req = Request(self.command, parsed.path, parse_qs(parsed.query),
                       dict(self.headers.items()), body)
-        resp = self.router.dispatch(req)
+        try:
+            resp = self.router.dispatch(req)
+        except BaseException:
+            if self.admission is not None \
+                    and not parsed.path.startswith(self.admission_exempt):
+                self.admission.leave()
+            raise
         try:
             self._write(resp)
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-stream
         finally:
+            if self.admission is not None \
+                    and not parsed.path.startswith(self.admission_exempt):
+                self.admission.leave()
             # Run a STARTED stream generator's finally first, then the
             # response-level cleanup (covers the never-started case).
             if resp.stream is not None and hasattr(resp.stream, "close"):
@@ -197,11 +282,20 @@ class _RequestHandler(BaseHTTPRequestHandler):
 
 
 class HttpServer:
-    """Threaded HTTP server bound to (host, port); port 0 picks a free one."""
+    """Threaded HTTP server bound to (host, port); port 0 picks a free one.
 
-    def __init__(self, host: str, port: int, router: Router) -> None:
+    ``max_concurrency``: int / None / zero-arg callable — see
+    ``Admission``. Control-plane paths (``_ADMISSION_EXEMPT``) bypass it."""
+
+    def __init__(self, host: str, port: int, router: Router,
+                 max_concurrency=None,
+                 admission_exempt: Tuple[str, ...] = _ADMISSION_EXEMPT
+                 ) -> None:
+        self.admission = (Admission(max_concurrency)
+                          if max_concurrency is not None else None)
         handler = type("BoundHandler", (_RequestHandler,),
-                       {"router": router})
+                       {"router": router, "admission": self.admission,
+                        "admission_exempt": tuple(admission_exempt)})
         self._srv = ThreadingHTTPServer((host, port), handler)
         self._srv.daemon_threads = True
         self.host = host

@@ -45,13 +45,23 @@ class Master:
         self.http_service = HttpService(opts, self.scheduler)
         self.rpc_service = RpcService(opts, self.scheduler)
 
+        # Both servers enforce opts.max_concurrency as live admission
+        # control (the reference's brpc max_concurrency backpressure,
+        # global_gflags.cpp:33-48) — the callable reads the shared opts
+        # so /admin/flags reloads apply immediately.
+        limit = lambda: self.opts.max_concurrency  # noqa: E731
         http_router = Router()
         self.http_service.install(http_router)
-        self._http_srv = HttpServer(opts.host, opts.http_port, http_router)
+        self._http_srv = HttpServer(opts.host, opts.http_port, http_router,
+                                    max_concurrency=limit)
 
         rpc_router = Router()
         self.rpc_service.install(rpc_router)
-        self._rpc_srv = HttpServer(opts.host, opts.rpc_port, rpc_router)
+        self._rpc_srv = HttpServer(opts.host, opts.rpc_port, rpc_router,
+                                   max_concurrency=limit)
+        self.http_service.admissions = {
+            "http": self._http_srv.admission,
+            "rpc": self._rpc_srv.admission}
 
         self._stopped = threading.Event()
 
