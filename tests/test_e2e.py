@@ -453,3 +453,69 @@ class TestEmbeddings:
             for wk in workers:
                 wk.stop()
             master.stop()
+
+
+class TestRequestTrace:
+    """--enable_request_trace captures BOTH halves: the inbound body and
+    every outbound write (per-frame egress — reference call_data.h:151-162
+    traces each payload the CallData writes)."""
+
+    @pytest.mark.parametrize("decode_to_service", [False, True])
+    def test_stream_egress_traced_per_frame(self, store, tmp_path,
+                                            decode_to_service):
+        trace_path = str(tmp_path / "trace.jsonl")
+        opts = ServiceOptions(
+            http_port=0, rpc_port=0, num_output_pools=4,
+            load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
+            block_size=16, heartbeat_interval_s=0.2,
+            master_upload_interval_s=0.2,
+            enable_request_trace=True, trace_path=trace_path,
+            enable_decode_response_to_service=decode_to_service)
+        master = Master(opts, store=store).start()
+        workers = [Worker(WorkerOptions(
+            port=0, instance_type=InstanceType.DEFAULT,
+            service_addr=master.rpc_address, model="tiny",
+            heartbeat_interval_s=0.2, lease_ttl_s=2.0), store,
+            engine_cfg=small_engine_cfg()).start()]
+        try:
+            assert wait_until(
+                lambda: len(master.scheduler.instance_mgr
+                            .prefill_instances()) == 1, timeout=15.0)
+            if decode_to_service:
+                assert wait_until(lambda: workers[0]._decode_to_service,
+                                  timeout=5.0)
+            frames = list(http_stream(
+                "POST", master.http_address, "/v1/completions",
+                {"model": "tiny", "prompt": "trace me", "max_tokens": 3,
+                 "temperature": 0.0, "stream": True, "ignore_eos": True},
+                timeout=120.0))
+            assert frames
+
+            with open(trace_path, encoding="utf-8") as f:
+                lines = [json.loads(l) for l in f if l.strip()]
+            srids = {l["service_request_id"] for l in lines}
+            assert len(srids) == 1
+            stages = [l["data"].get("stage") for l in lines]
+            assert "ingress" in stages
+            egress = [l["data"] for l in lines
+                      if l["data"].get("stage") == "egress"
+                      and "frame" in l["data"]]
+            # One trace line per WRITE, in write order. In the RPC fan-in
+            # topology a write is exactly one assembler frame; the relay
+            # topology writes transport chunks, which may coalesce
+            # several frames — so the per-frame count is only asserted
+            # where writes are frames.
+            assert egress
+            if decode_to_service:
+                assert len(egress) >= 3
+            assert [e["seq"] for e in egress] == list(range(len(egress)))
+            joined = "".join(e["frame"] for e in egress)
+            assert "[DONE]" in joined
+            # The ingress half survived alongside (the round-2 state).
+            ingress = [l["data"] for l in lines
+                       if l["data"].get("stage") == "ingress"]
+            assert ingress[0]["body"]["prompt"] == "trace me"
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()

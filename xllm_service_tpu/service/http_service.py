@@ -260,9 +260,13 @@ class HttpService:
                     self._num_errors += 1
                 return Response(status=status, body=err)
 
+            trace_egress = self.tracer.egress_for(req.service_request_id)
+
             def relay() -> Iterator[bytes]:
                 try:
                     for chunk in body:
+                        if trace_egress is not None:
+                            trace_egress(chunk)
                         yield chunk
                 finally:
                     self.scheduler.finish_request(req.service_request_id)
@@ -336,6 +340,8 @@ class HttpService:
                    else CompletionStreamAssembler)(
                 req.service_request_id, req.model, req.include_usage)
 
+            trace_egress = self.tracer.egress_for(req.service_request_id)
+
             def gen() -> Iterator[bytes]:
                 while True:
                     try:
@@ -343,13 +349,18 @@ class HttpService:
                     except queue.Empty:
                         self.scheduler.finish_request(
                             req.service_request_id, cancelled=True)
-                        yield (b'data: {"error": {"message": '
-                               b'"generation timed out", '
-                               b'"type": "timeout"}}\n\n')
+                        frame = (b'data: {"error": {"message": '
+                                 b'"generation timed out", '
+                                 b'"type": "timeout"}}\n\n')
+                        if trace_egress is not None:
+                            trace_egress(frame)
+                        yield frame
                         return
                     if out is None:
                         return
                     for frame in asm.on_output(out):
+                        if trace_egress is not None:
+                            trace_egress(frame)
                         yield frame
             return Response.sse(gen())
 
@@ -363,12 +374,18 @@ class HttpService:
                                               cancelled=True)
                 with self._lock:
                     self._num_errors += 1
+                self.tracer.trace(req.service_request_id,
+                                  {"stage": "egress", "status": 504,
+                                   "error": "generation timed out"})
                 return Response.error(504, "generation timed out",
                                       "timeout")
             if out is None:
                 break
             coll.add(out)
-        return Response.json(coll.body())
+        final = coll.body()
+        self.tracer.trace(req.service_request_id,
+                          {"stage": "egress", "body": final})
+        return Response.json(final)
 
     # ------------------------------------------------------------------
     # Embeddings — implemented for real (the reference returns

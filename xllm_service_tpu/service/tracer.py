@@ -25,6 +25,7 @@ class RequestTracer:
         self.enable = enable
         self.path = path
         self._lock = make_lock("tracer", 90)
+        self._f = None
         if enable:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
@@ -36,9 +37,20 @@ class RequestTracer:
             "service_request_id": service_request_id,
             "data": data,
         })
+        # One open for the process lifetime: per-frame egress tracing
+        # calls this once per streamed token, and an open/close cycle
+        # under the global lock would throttle every concurrent stream.
         with self._lock:
-            with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line + "\n")
+            if self._f is None:
+                self._f = open(self.path, "a", encoding="utf-8")
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
 
     def callback_for(self, service_request_id: str):
         """Bind a per-request trace callback (reference
@@ -48,4 +60,31 @@ class RequestTracer:
 
         def cb(stage: str, data: Dict[str, Any]) -> None:
             self.trace(service_request_id, {"stage": stage, **data})
+        return cb
+
+    def egress_for(self, service_request_id: str):
+        """Per-WRITE egress tracer for streamed responses — the response
+        half of ``--enable_request_trace`` (the reference captures every
+        outbound payload via the CallData trace callback,
+        common/call_data.h:151-162). Each write to the client becomes one
+        trace line, so response corruption (a malformed frame, a
+        truncated stream, an out-of-order delta) is debuggable from the
+        trace alone. In the relay topology a write is a transport chunk
+        (may carry several SSE frames, or split one); in the RPC fan-in
+        topology it is exactly one assembler frame. ``backslashreplace``
+        keeps the line lossless when a multibyte character straddles a
+        chunk boundary (``replace`` would forge corruption that never
+        reached the client). Returns None when disabled so hot paths
+        skip even the closure call."""
+        if not self.enable:
+            return None
+        seq = [0]
+
+        def cb(frame: bytes) -> None:
+            self.trace(service_request_id, {
+                "stage": "egress",
+                "seq": seq[0],
+                "frame": frame.decode("utf-8", errors="backslashreplace"),
+            })
+            seq[0] += 1
         return cb
