@@ -16,10 +16,20 @@ Resilience contract (this file must ALWAYS print exactly one JSON line):
   a hung or broken TPU tunnel (the round-1 failure: backend init raised
   UNAVAILABLE, and it can also hang indefinitely) can neither crash nor
   stall the bench; it falls back to CPU.
+- The TPU measured run itself executes in a killable subprocess with its
+  own sub-budget (the round-3 failure: warmup compiles through the
+  tunnel's remote-compile path ran past the WHOLE budget, so the
+  watchdog fired holding only an error payload — a 0.0 artifact). On
+  timeout the parent still has time to land the CPU fallback number.
+- Warmup inside the bench is scoped to exactly the programs its schedule
+  hits (~4 compiles instead of the ~24 pow2-sweep — minutes each through
+  the tunnel), and compiled programs persist in a jax compilation cache
+  under the repo (.jax_cache/) so a rerun — in particular the driver's
+  end-of-round run after a builder session already warmed the cache —
+  pays no tunnel compiles at all.
 - A watchdog thread emits an error-annotated JSON line and exits 0 if the
   whole run exceeds its budget.
-- The measured run falls back down a ladder: TPU → TPU without Pallas
-  kernels → tiny CPU run.
+- The measured run falls back down a ladder: TPU → tiny CPU run.
 
 Prints exactly one JSON line:
   {"metric": "decode_throughput", "value": ..., "unit": "tokens/s",
@@ -38,6 +48,25 @@ import time
 _EMIT_LOCK = threading.Lock()
 _RESULT_EMITTED = threading.Event()
 _STAGE = {"name": "start"}
+
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_cache")
+
+
+def _enable_compile_cache() -> None:
+    """Persist compiled executables across processes/sessions. Through the
+    tunneled TPU backend a single compile can take minutes; the cache is
+    the difference between a bench that fits its budget and one that dies
+    in warmup. Cache misses behave exactly as before, so this is safe even
+    if the experimental backend cannot serialize executables."""
+    import jax
+    try:
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
 
 
 def _emit(obj) -> None:
@@ -119,6 +148,31 @@ def _matmul_params(params, cfg) -> int:
     return total
 
 
+def scoped_warmup_shapes(ecfg, batch: int, prompt_len: int, gen_len: int):
+    """Predict exactly the (prefill, decode) programs the bench schedule
+    compiles, for Engine.warmup's scoped mode. The prediction mirrors the
+    engine: prefill batches fill max_prefill_tokens at one prompt_len
+    window each (pow2-padded batch, table wide enough for the sampled
+    token's page); decode table widths are pow2(pages(live context))
+    across the whole decoded trajectory including the fused burst's page
+    lookahead (covered by the range endpoint prompt+gen). A missed shape
+    is not a correctness problem — it compiles lazily and shows up in
+    detail.phases recompile counters. Unit-tested against the real engine
+    in tests/test_engine.py (zero post-warmup recompiles)."""
+    pages = lambda n: -(-n // ecfg.page_size)   # noqa: E731
+    pow2 = lambda n: 1 << max(n - 1, 0).bit_length()  # noqa: E731
+    # The engine buckets each prefill window's T (engine._bucket): predict
+    # with the bucketed value or a non-bucket-aligned prompt_len warms a
+    # program the engine never runs.
+    t_pf = next(b for b in ecfg.prefill_buckets if b >= prompt_len)
+    n_pf = min(batch, max(ecfg.max_prefill_tokens // prompt_len, 1))
+    mp_pf = pow2(max(pages(prompt_len + 1), pages(t_pf)))
+    widths = sorted({
+        min(pow2(pages(t)), ecfg.max_pages_per_seq)
+        for t in range(prompt_len + 1, prompt_len + gen_len + 1)})
+    return [(pow2(n_pf), t_pf, mp_pf)], widths
+
+
 def _run_bench(tiny: bool, force_cpu: bool = False,
                probe_failed: bool = False) -> dict:
     import jax
@@ -127,6 +181,7 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     from xllm_service_tpu.runtime.engine import Engine, EngineRequest
     from xllm_service_tpu.utils.types import SamplingParams
 
+    _enable_compile_cache()
     if force_cpu:
         # The site hook pins jax_platforms="axon,cpu" at import, which
         # overrides the JAX_PLATFORMS env var — only an explicit config
@@ -161,13 +216,28 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     _STAGE["name"] = "engine-init"
     engine = Engine(cfg, ecfg, seed=0)
     _STAGE["name"] = "warmup"
-    engine.warmup()
+    tw0 = time.monotonic()
+    if tiny:
+        engine.warmup()
+    else:
+        # Scoped warmup: exactly the programs this schedule compiles.
+        # Tunnel compiles run minutes each; the full pow2 sweep (~24
+        # programs) belongs to serving startup, not a budgeted bench.
+        pf_shapes, widths = scoped_warmup_shapes(
+            ecfg, batch, prompt_len, gen_len)
+        engine.warmup(prefill_shapes=pf_shapes, decode_widths=widths)
+    warmup_s = time.monotonic() - tw0
 
     sp = SamplingParams(max_tokens=gen_len, temperature=0.0, ignore_eos=True)
     for i in range(batch):
+        # Distinct prompts: identical ones would prefix-cache-hit after
+        # the first batch, silently benchmarking cache lookups instead of
+        # prefill compute (and shifting later batch shapes off the scoped
+        # warmup's prediction).
         engine.add_request(EngineRequest(
             request_id=f"bench-{i}",
-            token_ids=list(range(1, prompt_len + 1)),
+            token_ids=[(i + j) % (cfg.vocab_size - 1) + 1
+                       for j in range(prompt_len)],
             sampling=sp))
     # Prefill outside the timed window: the metric is steady-state decode.
     # Still measured — prefill is the compute-bound phase, so its MFU shows
@@ -215,6 +285,7 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             "model": cfg.name, "platform": platform,
             "device_kind": getattr(dev, "device_kind", ""),
             "batch": batch, "prompt_len": prompt_len, "gen_len": gen_len,
+            "warmup_s": round(warmup_s, 1),
             "tpot_ms": round(tpot_ms, 3),
             "mfu": round(mfu, 4) if mfu is not None else None,
             "prefill_tokens_per_s": round(prefill_tokens / prefill_s, 1),
@@ -239,10 +310,22 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
 def main() -> None:
     budget = float(os.environ.get("BENCH_WATCHDOG_S", "900"))
     _watchdog(budget)
+    t_start = time.monotonic()
+
+    if os.environ.get("BENCH_ROLE") == "measure":
+        # Child of the orchestrating parent below: the backend probe
+        # already succeeded, so measure directly and print the one line.
+        # A hang here is killed by the parent's subprocess timeout.
+        try:
+            _emit(_run_bench(tiny=bool(os.environ.get("BENCH_TINY"))))
+        except Exception as exc:  # noqa: BLE001
+            _emit(_error_payload(f"{type(exc).__name__}: {exc}"))
+        return
 
     _STAGE["name"] = "backend-probe"
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        platform = "cpu"           # already pinned (fallback subprocess)
+    requested_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    if requested_cpu:
+        platform = "cpu"           # caller pinned CPU on purpose
     else:
         # A wedged TPU tunnel can recover minutes later (observed: a
         # killed holder process stalls the chip, then it comes back) —
@@ -266,54 +349,71 @@ def main() -> None:
         # backend initialization happens.
         os.environ["JAX_PLATFORMS"] = "cpu"
         platform = "cpu"
-    # An env var alone is not enough (the site hook pins jax_platforms at
-    # import); any CPU run must also force it through jax.config.
-    force_cpu = platform == "cpu"
 
     tiny = bool(os.environ.get("BENCH_TINY")) or platform == "cpu"
-    attempts = [dict(tiny=tiny, force_cpu_cfg=force_cpu)]
-    if platform != "cpu":
-        # Same shapes but with the Pallas kernels disabled, then tiny CPU.
-        attempts.append(dict(tiny=tiny, no_pallas=True))
-        attempts.append(dict(tiny=True, force_cpu=True))
-
     last_err = "no attempts ran"
-    for att in attempts:
-        if att.get("no_pallas"):
-            os.environ["XLLM_PALLAS"] = "0"
-        if att.get("force_cpu"):
-            # Backend may already be initialized in-process; a clean retry
-            # needs a fresh process pinned to CPU.
-            env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_TINY="1",
-                       BENCH_NO_FALLBACK="1")
-            try:
-                r = subprocess.run([sys.executable, __file__],
-                                   capture_output=True, text=True,
-                                   timeout=max(budget - 60, 120), env=env)
-                line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() \
-                    else ""
-                parsed = json.loads(line)
-                parsed.setdefault("detail", {})["fallback"] = "cpu-subprocess"
+
+    if platform != "cpu":
+        # TPU measured run in a KILLABLE subprocess: a warmup/compile that
+        # outlives its sub-budget (round-3 failure mode: tunnel compiles
+        # run minutes each) must not eat the parent's whole budget — the
+        # parent still needs time to land the CPU fallback number.
+        elapsed = time.monotonic() - t_start
+        reserve = 180.0                      # CPU fallback headroom
+        tpu_budget = max(budget - elapsed - reserve, 120.0)
+        env = dict(os.environ, BENCH_ROLE="measure",
+                   BENCH_WATCHDOG_S=str(int(tpu_budget + 60)))
+        if tiny:
+            env["BENCH_TINY"] = "1"
+        try:
+            _STAGE["name"] = "tpu-subprocess"
+            r = subprocess.run([sys.executable, __file__],
+                               capture_output=True, text=True,
+                               timeout=tpu_budget, env=env)
+            line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() \
+                else ""
+            parsed = json.loads(line)
+            if parsed.get("value", 0) > 0:
                 _emit(parsed)
                 return
-            except Exception as exc:  # noqa: BLE001
-                last_err = f"cpu-subprocess fallback failed: {exc!r}"
-                continue
-        try:
-            result = _run_bench(tiny=att["tiny"],
-                                force_cpu=att.get("force_cpu_cfg", False),
-                                probe_failed=probe_failed)
-            if att.get("no_pallas"):
-                # A no-Pallas number must never masquerade as the
-                # full-kernel headline result.
-                result["detail"]["fallback"] = "no_pallas"
-            _emit(result)
-            return
+            last_err = "tpu subprocess: " + str(
+                parsed.get("detail", {}).get("error", "value 0"))
         except Exception as exc:  # noqa: BLE001
-            last_err = f"{type(exc).__name__}: {exc}"
-            if os.environ.get("BENCH_NO_FALLBACK"):
-                break
-            continue
+            last_err = f"tpu subprocess failed: {exc!r}"
+
+    if platform == "cpu" and os.environ.get("BENCH_NO_FALLBACK"):
+        # Pinned-CPU leaf invocation: measure inline, no recursion.
+        try:
+            _emit(_run_bench(tiny=True, force_cpu=True,
+                             probe_failed=probe_failed))
+        except Exception as exc:  # noqa: BLE001
+            _emit(_error_payload(f"{type(exc).__name__}: {exc}"))
+        return
+
+    # CPU fallback. The backend may already be initialized in-process;
+    # a clean run needs a fresh process pinned to CPU.
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_TINY="1",
+               BENCH_NO_FALLBACK="1")
+    env.pop("BENCH_ROLE", None)
+    try:
+        remaining = budget - (time.monotonic() - t_start)
+        r = subprocess.run([sys.executable, __file__],
+                           capture_output=True, text=True,
+                           timeout=max(remaining - 20, 100), env=env)
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+        parsed = json.loads(line)
+        if not requested_cpu:
+            # Only a run that WANTED the TPU and landed here is a
+            # fallback; an intentionally CPU-pinned run is just a CPU run.
+            parsed.setdefault("detail", {})["fallback"] = "cpu-subprocess"
+            if probe_failed:
+                parsed["detail"]["tpu_probe"] = "failed"
+            if last_err != "no attempts ran":
+                parsed["detail"]["tpu_error"] = last_err
+        _emit(parsed)
+        return
+    except Exception as exc:  # noqa: BLE001
+        last_err = f"cpu-subprocess fallback failed: {exc!r} (after {last_err})"
 
     _emit(_error_payload(last_err))
 

@@ -629,3 +629,44 @@ def test_multi_step_lookahead_clamped_to_max_tokens():
             toks.extend(out.new_token_ids)
     assert len(toks) == 2
     assert eng.num_preemptions == 0
+
+
+# ---------------------------------------------------------------------------
+# Scoped bench warmup (bench.py) predicts the real schedule's programs
+# ---------------------------------------------------------------------------
+
+def test_scoped_warmup_covers_bench_schedule():
+    """bench.py warms only the programs its workload compiles (tunnel
+    compiles cost minutes — round-3 budget failure). This pins the shape
+    prediction to the real engine: after scoped warmup, a bench-shaped
+    run must trigger ZERO post-warmup recompiles."""
+    import bench as bench_mod
+
+    cfg = ModelConfig.tiny(vocab_size=256)
+    ecfg = EngineConfig(page_size=16, num_pages=256, max_model_len=256,
+                        max_batch_size=16, max_prefill_tokens=128,
+                        prefill_buckets=(32,), decode_steps=8)
+    engine = Engine(cfg, ecfg, seed=0)
+    batch, prompt_len, gen_len = 16, 32, 64
+    pf_shapes, widths = bench_mod.scoped_warmup_shapes(
+        ecfg, batch, prompt_len, gen_len)
+    engine.warmup(prefill_shapes=pf_shapes, decode_widths=widths)
+
+    sp = SamplingParams(max_tokens=gen_len, temperature=0.0,
+                       ignore_eos=True)
+    for i in range(batch):
+        # Distinct prompts, as in bench.py — identical ones prefix-cache
+        # hit after the first batch and change later batch shapes.
+        engine.add_request(EngineRequest(
+            request_id=f"bench-{i}",
+            token_ids=[(i + j) % (cfg.vocab_size - 1) + 1
+                       for j in range(prompt_len)], sampling=sp))
+    done = 0
+    while engine.has_work():
+        for out in engine.step():
+            if out.finish_reason != FinishReason.NONE:
+                done += 1
+    assert done == batch
+    recompiles = {k: v for k, v in engine.phase_report().items()
+                  if k.endswith(".recompile") and v}
+    assert not recompiles, f"scoped warmup missed programs: {recompiles}"
