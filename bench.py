@@ -164,7 +164,12 @@ def scoped_warmup_shapes(ecfg, batch: int, prompt_len: int, gen_len: int):
     # The engine buckets each prefill window's T (engine._bucket): predict
     # with the bucketed value or a non-bucket-aligned prompt_len warms a
     # program the engine never runs.
-    t_pf = next(b for b in ecfg.prefill_buckets if b >= prompt_len)
+    t_pf = next((b for b in ecfg.prefill_buckets if b >= prompt_len), None)
+    if t_pf is None:
+        raise ValueError(
+            f"prompt_len {prompt_len} exceeds largest prefill bucket "
+            f"{ecfg.prefill_buckets[-1]} — fix the bench shape, don't "
+            "let it silently fall back to CPU")
     n_pf = min(batch, max(ecfg.max_prefill_tokens // prompt_len, 1))
     mp_pf = pow2(max(pages(prompt_len + 1), pages(t_pf)))
     widths = sorted({
@@ -352,6 +357,10 @@ def main() -> None:
 
     tiny = bool(os.environ.get("BENCH_TINY")) or platform == "cpu"
     last_err = "no attempts ran"
+    # Whether this invocation ever WANTED a TPU: distinguishes a genuine
+    # fallback (probe failed / measure subprocess died) from an ordinary
+    # CPU run (pinned by caller, or a machine with no TPU to begin with).
+    tpu_expected = probe_failed or platform not in ("", "cpu")
 
     if platform != "cpu":
         # TPU measured run in a KILLABLE subprocess: a warmup/compile that
@@ -361,6 +370,10 @@ def main() -> None:
         elapsed = time.monotonic() - t_start
         reserve = 180.0                      # CPU fallback headroom
         tpu_budget = max(budget - elapsed - reserve, 120.0)
+        # The 120s floor must never push past the watchdog itself: cap at
+        # what actually remains, less a margin for the fallback child.
+        tpu_budget = min(tpu_budget,
+                         max(budget - elapsed - 60.0, 60.0))
         env = dict(os.environ, BENCH_ROLE="measure",
                    BENCH_WATCHDOG_S=str(int(tpu_budget + 60)))
         if tiny:
@@ -399,12 +412,12 @@ def main() -> None:
         remaining = budget - (time.monotonic() - t_start)
         r = subprocess.run([sys.executable, __file__],
                            capture_output=True, text=True,
-                           timeout=max(remaining - 20, 100), env=env)
+                           timeout=max(remaining - 20, 30), env=env)
         line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
         parsed = json.loads(line)
-        if not requested_cpu:
-            # Only a run that WANTED the TPU and landed here is a
-            # fallback; an intentionally CPU-pinned run is just a CPU run.
+        if tpu_expected:
+            # Only a run that WANTED a TPU and landed here is a fallback;
+            # a CPU-pinned run or a machine with no TPU is just a CPU run.
             parsed.setdefault("detail", {})["fallback"] = "cpu-subprocess"
             if probe_failed:
                 parsed["detail"]["tpu_probe"] = "failed"

@@ -43,6 +43,21 @@ def _transpose_free_default() -> bool:
     return os.environ.get("XLLM_PALLAS_DECODE_V2", "0") == "1"
 
 
+def _row_kernel_default() -> bool:
+    """Whole-row decode kernel (grid (B,), double-buffered page DMA)
+    instead of one grid cell per (batch, page). The (B, pages) grid pays
+    per-cell overhead on B*MP tiny cells per layer per step — at the
+    bench shape (B=64, MP=8, 16 layers) that is 8192 cell invocations a
+    step, which dwarfs the actual attention FLOPs at decode. The row
+    kernel walks a sequence's pages inside ONE cell with its own
+    double-buffered HBM→VMEM copies, cutting cell count 8x and bounding
+    the page walk at the sequence's true page count (the grid version
+    visits all MP cells; `pl.when` skips compute but not the cell).
+    Gated off until validated on hardware (XLLM_PALLAS_DECODE_V3=1);
+    read per call like the sibling gates so runtime toggles work."""
+    return os.environ.get("XLLM_PALLAS_DECODE_V3", "0") == "1"
+
+
 def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
             m_ref, l_ref, acc_ref, *, page_size: int, pages_per_seq: int,
             num_kv_heads: int, has_current: bool, transpose_free: bool):
@@ -136,6 +151,141 @@ def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
         o_ref[0] = (acc_fin / denom).astype(o_ref.dtype)
 
 
+def _row_kernel(ctx_ref, pt_ref, q_ref, k_hbm, v_hbm, kc_ref, vc_ref,
+                o_ref, k_buf, v_buf, sems, *, page_size: int,
+                num_kv_heads: int, has_current: bool):
+    """One grid cell = one batch row's whole page walk.
+
+    K/V pools stay in HBM (memory_space=HBM, no automatic pipeline);
+    the kernel issues its own async copies, page p+1 in flight while
+    page p folds into the online-softmax accumulator. The loop runs
+    ceil(ctx/ps) iterations — a short sequence in a wide table does not
+    visit dead pages. Accumulators are fori_loop carries (f32 values,
+    not scratch refs)."""
+    b = pl.program_id(0)
+    ctx = ctx_ref[b]
+    npages = (ctx + page_size - 1) // page_size
+
+    hq, d = q_ref.shape[1], q_ref.shape[2]
+    g = hq // num_kv_heads
+    q = q_ref[0].astype(jnp.float32)                         # [Hq, D]
+    qg = q.reshape(num_kv_heads, g, d)                       # [Hkv, G, D]
+    scale = 1.0 / (d ** 0.5)
+
+    def k_dma(slot, p):
+        return pltpu.make_async_copy(k_hbm.at[pt_ref[b, p]],
+                                     k_buf.at[slot], sems.at[slot, 0])
+
+    def v_dma(slot, p):
+        return pltpu.make_async_copy(v_hbm.at[pt_ref[b, p]],
+                                     v_buf.at[slot], sems.at[slot, 1])
+
+    @pl.when(npages > 0)
+    def _prime():
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
+
+    def fold(p, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(p, 2)
+
+        @pl.when(p + 1 < npages)
+        def _prefetch_next():
+            nxt = jax.lax.rem(p + 1, 2)
+            k_dma(nxt, p + 1).start()
+            v_dma(nxt, p + 1).start()
+
+        k_dma(slot, p).wait()
+        v_dma(slot, p).wait()
+        k = k_buf[slot].astype(jnp.float32)                  # [ps, Hkv, D]
+        v = v_buf[slot].astype(jnp.float32)
+        # Contract in native [ps, Hkv, D] layout (transpose-free fold):
+        # [Hkv, G, D] x [ps, Hkv, D] -> [Hkv, G, ps]
+        logits = jax.lax.dot_general(
+            qg, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale
+        logits = logits.reshape(hq, page_size)
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        mask = pos < ctx
+        logits = jnp.where(mask, logits, _NEG_INF)
+        blk_max = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, blk_max)
+        prob = jnp.where(mask, jnp.exp(logits - m_new), 0.0)  # [Hq, ps]
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(prob, axis=-1, keepdims=True)
+        # [Hkv, G, ps] x [ps, Hkv, D] -> [Hkv, G, D]
+        pv = jax.lax.dot_general(
+            prob.reshape(num_kv_heads, g, page_size), v,
+            (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc * corr + pv.reshape(hq, d)
+
+    m0 = jnp.full((hq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((hq, 1), jnp.float32)
+    acc0 = jnp.zeros((hq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, npages, fold, (m0, l0, acc0))
+
+    if has_current:
+        # The current token's K/V (in-registers, not yet in the pool) as
+        # a final always-valid single-position block.
+        kc = kc_ref[0].astype(jnp.float32)                   # [Hkv, D]
+        vc = vc_ref[0].astype(jnp.float32)
+        lc = jnp.sum(qg * kc[:, None, :], axis=-1) * scale   # [Hkv, G]
+        lc = lc.reshape(hq, 1)
+        m_new = jnp.maximum(m, lc)
+        corr = jnp.exp(m - m_new)
+        pc = jnp.exp(lc - m_new)
+        l = l * corr + pc
+        vc_full = jnp.broadcast_to(
+            vc[:, None, :], (num_kv_heads, g, d)).reshape(hq, d)
+        acc = acc * corr + pc * vc_full
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_decode_attention_row_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
+                                     v_pages: jnp.ndarray,
+                                     page_table: jnp.ndarray,
+                                     context_lens: jnp.ndarray,
+                                     k_cur: jnp.ndarray = None,
+                                     v_cur: jnp.ndarray = None,
+                                     interpret: bool = False) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    _, page_size, Hkv, _ = k_pages.shape
+    has_current = k_cur is not None
+    if not has_current:
+        k_cur = jnp.zeros((B, Hkv, D), q.dtype)
+        v_cur = jnp.zeros((B, Hkv, D), q.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,               # context_lens, page_table
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, ctx, pt: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.HBM),    # whole K pool
+            pl.BlockSpec(memory_space=pltpu.HBM),    # whole V pool
+            pl.BlockSpec((1, Hkv, D), lambda b, ctx, pt: (b, 0, 0)),
+            pl.BlockSpec((1, Hkv, D), lambda b, ctx, pt: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, ctx, pt: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, Hkv, D), k_pages.dtype),
+            pltpu.VMEM((2, page_size, Hkv, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_row_kernel, page_size=page_size,
+                          num_kv_heads=Hkv, has_current=has_current),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(context_lens, page_table, q, k_pages, v_pages, k_cur, v_cur)
+
+
 def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
                                   v_pages: jnp.ndarray,
                                   page_table: jnp.ndarray,
@@ -161,6 +311,10 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
     if interpret is None:
         from xllm_service_tpu.ops import pallas
         interpret = pallas.default_interpret()
+    if _row_kernel_default():
+        return _paged_decode_attention_row_impl(
+            q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur,
+            interpret=interpret)
     return _paged_decode_attention_impl(
         q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur,
         interpret=interpret, transpose_free=transpose_free)
