@@ -87,7 +87,12 @@ def _error_payload(msg: str) -> dict:
     }
 
 
+_DEADLINE = {"t": float("inf")}
+
+
 def _watchdog(budget_s: float) -> threading.Timer:
+    _DEADLINE["t"] = time.monotonic() + budget_s
+
     def fire() -> None:
         _emit(_error_payload(f"watchdog: exceeded {budget_s}s budget"))
         os._exit(0)
@@ -278,6 +283,15 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     peak = _chip_peak_flops(dev)
     mfu = achieved / peak if peak > 0 else None
 
+    kv_probe = None
+    if not tiny and platform != "cpu":
+        # BASELINE.md north-star row: KV-migration GB/s on the real chip,
+        # folded into the headline artifact. Skipped (with a reason) when
+        # the remaining budget can't absorb its second-engine init +
+        # probe compiles, or when BENCH_KV_PROBE=0.
+        _STAGE["name"] = "kv-probe"
+        kv_probe = _maybe_kv_probe(engine, cfg, ecfg)
+
     return {
         "metric": "decode_throughput",
         "value": round(throughput, 2),
@@ -306,10 +320,34 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             # Host/device wall-time attribution per engine phase (dispatch
             # is async-call time; readback absorbs device compute + RTT).
             "phases": engine.phase_report(),
+            **({"kv_migration": kv_probe} if kv_probe else {}),
             "reference_baseline": "target_tpot=50ms SLO default "
                                   "(no published numbers)",
         },
     }
+
+
+def _maybe_kv_probe(engine, cfg, ecfg) -> dict:
+    """KV GB/s (direct + host-shuttle) using the bench engine as source
+    and a fresh pool-identical engine as destination."""
+    if os.environ.get("BENCH_KV_PROBE", "1") == "0":
+        return {"skipped": "BENCH_KV_PROBE=0"}
+    remaining = _DEADLINE["t"] - time.monotonic()
+    if remaining < 240:
+        return {"skipped": f"only {remaining:.0f}s of budget left"}
+    try:
+        from xllm_service_tpu.runtime.engine import Engine
+        from xllm_service_tpu.runtime.kv_transfer import probe_kv_migration
+        dst = Engine(cfg, ecfg, seed=1)
+        out = probe_kv_migration(engine, dst,
+                                 n_pages=min(128, ecfg.num_pages // 2),
+                                 iters=3)
+        return {"direct_gbps": round(out["direct_gbps"], 2),
+                "host_shuttle_gbps": round(out["host_gbps"], 2),
+                "block_mb": round(out["bytes"] / 1e6, 1),
+                "pages": int(out["pages"])}
+    except Exception as exc:  # noqa: BLE001 — probe must not kill the bench
+        return {"error": f"{type(exc).__name__}: {exc}"}
 
 
 def main() -> None:

@@ -221,16 +221,24 @@ class TestInstanceMgr:
         assert mgr.decode_instances() == []
         mgr.close()
 
-    def test_mix_split_first_decodes(self, store):
+    def test_mix_split_min_name_decodes_order_independent(self, store):
+        """The MIX decode seat is the smallest live name, derived from
+        membership alone — master (heartbeat order) and replicas (watch
+        order) must agree on the split regardless of arrival order."""
         mgr = InstanceMgr(opts_(), store, control=FakeControl())
         for name in ("m1", "m2", "m3"):
             register_worker(store, name, InstanceType.MIX)
         assert wait_until(lambda: len(mgr._pending) == 3)
-        for name in ("m1", "m2", "m3"):
+        # Reverse arrival order: the seat still lands on m1.
+        for name in ("m3", "m2", "m1"):
             mgr.on_heartbeat(Heartbeat(name=name,
                                        instance_type=InstanceType.MIX))
         assert mgr.decode_instances() == ["m1"]
         assert sorted(mgr.prefill_instances()) == ["m2", "m3"]
+        # Seat holder dies -> next smallest takes the decode seat.
+        mgr.remove_instance("m1")
+        assert mgr.decode_instances() == ["m2"]
+        assert mgr.prefill_instances() == ["m3"]
         mgr.close()
 
     def test_flips(self, store):
@@ -412,6 +420,25 @@ class TestSchedulerCore:
         assert wait_until(lambda: s1.is_master, timeout=3.0)
         assert store.get(KEY_MASTER) == s1.service_id
         s1.stop()
+
+    def test_replica_registers_instances_from_watch(self, store):
+        """A standing replica never receives worker heartbeats (those go
+        to the master), so a worker that registers AFTER the replica
+        booted must become routable from the store watch alone —
+        otherwise active-active serving and instant takeover both break
+        (reference instance_mgr.cpp:68-154 treats store presence as
+        registration on the replica path)."""
+        s1 = self._scheduler(store)          # master
+        s2 = self._scheduler(store)          # standing replica
+        assert s1.is_master and not s2.is_master
+        register_worker(store, "late-worker", InstanceType.PREFILL)
+        assert wait_until(
+            lambda: "late-worker" in s2.instance_mgr.prefill_instances(),
+            timeout=3.0)
+        # The master still gates on the first heartbeat (two-phase).
+        assert "late-worker" not in s1.instance_mgr.prefill_instances()
+        s1.stop()
+        s2.stop()
 
     def test_schedule_tokenizes_and_routes(self, store):
         sched = self._scheduler(

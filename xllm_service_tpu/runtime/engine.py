@@ -681,21 +681,20 @@ class Engine:
         cache_before = self._jit_cache_size(jitted)
         with self._phase("prefill.dispatch"):
             if plp_mode:
-                next_tok, logprob, top_ids, top_lps, self.kv, plp, mdrop = \
+                fused, top_ids, top_lps, self.kv, plp, mdrop = \
                     jitted(self.params, jnp.asarray(packed), self.kv,
                            st_f32, st_i32, key, mm_e, mm_p,
                            plp_targets, bias_ids, bias_vals, t_len=T)
             else:
                 plp = None
-                next_tok, logprob, top_ids, top_lps, self.kv, mdrop = \
+                fused, top_ids, top_lps, self.kv, mdrop = \
                     jitted(self.params, jnp.asarray(packed), self.kv,
                            st_f32, st_i32, key, mm_e, mm_p, None,
                            bias_ids, bias_vals, t_len=T)
         self._note_recompile("prefill_plp" if plp_mode else "prefill",
                              jitted, cache_before)
         with self._phase("prefill.readback"):
-            next_tok = np.asarray(next_tok)
-            logprob = np.asarray(logprob)
+            next_tok, logprob = _split_tok_lp(np.asarray(fused))
             self._note_moe_dropped(mdrop)
             if plp is not None:
                 plp = np.asarray(plp)
@@ -771,15 +770,14 @@ class Engine:
             self._rng_key, key = jax.random.split(self._rng_key)
         cache_before = self._jit_cache_size(self._jit_prefill_ring)
         with self._phase("prefill_ring.dispatch"):
-            next_tok, logprob, top_ids, top_lps, self.kv, mdrop = \
+            fused, top_ids, top_lps, self.kv, mdrop = \
                 self._jit_prefill_ring(
                     self.params, jnp.asarray(packed), self.kv,
                     st_f32, st_i32, key, bias_ids, bias_vals, t_len=T)
         self._note_recompile("prefill_ring", self._jit_prefill_ring,
                              cache_before)
         with self._phase("prefill_ring.readback"):
-            next_tok = np.asarray(next_tok)
-            logprob = np.asarray(logprob)
+            next_tok, logprob = _split_tok_lp(np.asarray(fused))
             self._note_moe_dropped(mdrop)
             if top_ids is not None:
                 top_ids = np.asarray(top_ids)
@@ -836,15 +834,14 @@ class Engine:
                 self._slot_packed[:, :_PACK_COLS + mp]))
         cache_before = self._jit_cache_size(self._jit_decode)
         with self._phase("decode.dispatch"):
-            (next_tok, logprob, top_ids, top_lps, self.kv, self._counts,
+            (fused, top_ids, top_lps, self.kv, self._counts,
              mdrop) = self._jit_decode(
                     self.params, packed, self.kv,
                     st_f32, st_i32, key, self._ensure_counts(),
                     *self._ensure_bias())
         self._note_recompile("decode", self._jit_decode, cache_before)
         with self._phase("decode.readback"):
-            next_tok = np.asarray(next_tok)
-            logprob = np.asarray(logprob)
+            next_tok, logprob = _split_tok_lp(np.asarray(fused))
             self._note_moe_dropped(mdrop)
             if top_ids is not None:
                 # One bulk device->host transfer, not one per sequence.
@@ -909,7 +906,7 @@ class Engine:
                 self._slot_packed[:, :_PACK_COLS + mp]))
         cache_before = self._jit_cache_size(self._jit_decode_multi)
         with self._phase("decode_multi.dispatch"):
-            (toks, logps, top_ids, top_lps, self.kv, self._counts,
+            (fused, top_ids, top_lps, self.kv, self._counts,
              mdrop) = self._jit_decode_multi(
                     self.params, packed, self.kv,
                     st_f32, st_i32, key, self._ensure_counts(),
@@ -917,8 +914,7 @@ class Engine:
         self._note_recompile("decode_multi", self._jit_decode_multi,
                              cache_before)
         with self._phase("decode_multi.readback"):
-            toks = np.asarray(toks)          # [N, B]
-            logps = np.asarray(logps)        # [N, B]
+            toks, logps = _split_tok_lp(np.asarray(fused))  # [N, B] each
             self._note_moe_dropped(mdrop)
             if top_ids is not None:
                 top_ids = np.asarray(top_ids)    # [N, B, K]
@@ -1238,7 +1234,7 @@ class Engine:
         for B, T, mp in prefill_shapes:
             st_f32, st_i32 = self._sampling_tensors([], B)
             b_ids, b_vals = self._batch_bias([], B, self.cfg.vocab_size)
-            _, _, _, _, self.kv, _ = self._jit_prefill(
+            _, _, _, self.kv, _ = self._jit_prefill(
                 self.params,
                 jnp.zeros((B, _PREFILL_HDR + T + mp), jnp.int32),
                 self.kv, st_f32, st_i32, key, None, None, None,
@@ -1325,6 +1321,19 @@ def _top_row(top_ids, top_lps, row: int) -> List[Dict[str, Any]]:
             for i, l in zip(ids, lps)]
 
 
+def _fuse_tok_lp(tok: jnp.ndarray, lp: jnp.ndarray) -> jnp.ndarray:
+    """Stack sampled token ids and their logprobs into ONE int32 block
+    ([2, ...]; logprobs bitcast) so they cross device->host in a single
+    transfer — through the tunneled backend every separate readback pays
+    a full ~80 ms round trip (docs/PERF_NOTES.md)."""
+    return jnp.stack([tok, jax.lax.bitcast_convert_type(lp, jnp.int32)])
+
+
+def _split_tok_lp(fused: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side inverse of _fuse_tok_lp (after the one np.asarray)."""
+    return fused[0], fused[1].view(np.float32)
+
+
 def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
                   mm_positions=None, plp_targets=None, bias_ids=None,
                   bias_vals=None, *, cfg: ModelConfig, num_top: int = 0,
@@ -1351,8 +1360,9 @@ def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
     if num_top > 0:
         top_ids, top_lps = compute_top_logprobs(last_logits, num_top)
     if with_prompt_lps:
-        return tok, lp, top_ids, top_lps, kv, plp, stats["moe_dropped"]
-    return tok, lp, top_ids, top_lps, kv, stats["moe_dropped"]
+        return (_fuse_tok_lp(tok, lp), top_ids, top_lps, kv, plp,
+                stats["moe_dropped"])
+    return _fuse_tok_lp(tok, lp), top_ids, top_lps, kv, stats["moe_dropped"]
 
 
 def _prefill_ring_step(params, packed, kv, st_f32, st_i32, key,
@@ -1372,7 +1382,7 @@ def _prefill_ring_step(params, packed, kv, st_f32, st_i32, key,
     top_ids = top_lps = None
     if num_top > 0:
         top_ids, top_lps = compute_top_logprobs(last_logits, num_top)
-    return tok, lp, top_ids, top_lps, kv, stats["moe_dropped"]
+    return _fuse_tok_lp(tok, lp), top_ids, top_lps, kv, stats["moe_dropped"]
 
 
 def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None,
@@ -1394,7 +1404,8 @@ def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None,
         top_ids, top_lps = compute_top_logprobs(logits, num_top)
     if counts is not None:
         counts = update_counts(counts, tok, active)
-    return tok, lp, top_ids, top_lps, kv, counts, stats["moe_dropped"]
+    return (_fuse_tok_lp(tok, lp), top_ids, top_lps, kv, counts,
+            stats["moe_dropped"])
 
 
 def _decode_multi_step(params, packed, kv, st_f32, st_i32, key,
@@ -1431,4 +1442,5 @@ def _decode_multi_step(params, packed, kv, st_f32, st_i32, key,
     (_, _, kv, counts, moe_dropped), (toks, lps, top_ids, top_lps) = \
         jax.lax.scan(body, (tokens, positions, kv, counts,
                             jnp.zeros((), jnp.int32)), keys)
-    return toks, lps, top_ids, top_lps, kv, counts, moe_dropped
+    return (_fuse_tok_lp(toks, lps), top_ids, top_lps, kv, counts,
+            moe_dropped)

@@ -45,13 +45,20 @@ def probe_kv_migration(src: Engine, dst: Engine, n_pages: int = 16,
     dst_idx = jnp.arange(1, n_pages + 1, dtype=jnp.int32)
     nbytes = 2 * int(np.prod(ks[:, :n_pages].shape)) * ks.dtype.itemsize
 
+    def _sync() -> None:
+        # block_until_ready returns WITHOUT synchronizing through the
+        # tunneled backend (docs/PERF_NOTES.md) — only a host readback is
+        # a true sync. Read one written page slice (64 KB-ish, negligible
+        # next to the measured block) whose value depends on the scatter.
+        np.asarray(jax.device_get(dst.kv[0][0, int(dst_idx[-1])]))
+
     def direct_once() -> None:
         kd, vd = dst.kv
         k = ks[:, src_idx]
         v = vs[:, src_idx]
         dst.kv = _kv_scatter(kd, vd, dst_idx, k.astype(kd.dtype),
                              v.astype(vd.dtype))
-        jax.block_until_ready(dst.kv[0])
+        _sync()
 
     def host_once() -> None:
         kd, vd = dst.kv
@@ -67,7 +74,7 @@ def probe_kv_migration(src: Engine, dst: Engine, n_pages: int = 16,
         dst.kv = _kv_scatter(kd, vd, dst_idx,
                              jnp.asarray(k2).astype(kd.dtype),
                              jnp.asarray(v2).astype(vd.dtype))
-        jax.block_until_ready(dst.kv[0])
+        _sync()
 
     # Report the EFFECTIVE page count: callers print this next to the
     # bandwidth, and a silently clamped request must not claim a larger

@@ -113,7 +113,7 @@ class InstanceMgr:
         self._rr_decode = 0
         self._model_heat: Dict[str, float] = {}
         self._watch_ids: List[int] = []
-        self._mix_count = 0
+        self._mix_names: Set[str] = set()
         # Removal hook: the scheduler fails in-flight requests routed to a
         # dead instance (set post-construction to avoid a ctor cycle).
         self.on_removed: Optional[Callable[[str], None]] = None
@@ -151,10 +151,27 @@ class InstanceMgr:
                     # Re-registration with new metadata (e.g. role flip
                     # confirmed by the worker re-writing its key).
                     self._instances[name].meta = meta
-                    self._set_role(name, meta.instance_type)
-                else:
+                    if meta.instance_type == InstanceType.MIX:
+                        self._mix_names.add(name)
+                        self._reseat_mix()
+                    else:
+                        self._mix_names.discard(name)
+                        self._set_role(name, meta.instance_type)
+                elif self.is_master:
                     self._pending[name] = meta
                     self._removed.discard(name)
+                else:
+                    # Replica path: heartbeats flow to the MASTER only, so
+                    # a replica must treat store presence as registration
+                    # (same rationale as _bootstrap: the key's lease is
+                    # alive, and the master is the one gating liveness) —
+                    # otherwise a standing replica can never route to
+                    # workers that registered after it booted, and
+                    # active-active serving / instant takeover both break.
+                    # Load state arrives via the master's KEY_LOADMETRICS
+                    # uploads; lease expiry arrives as a DELETE event.
+                    self._removed.discard(name)
+                    self._register(meta, from_bootstrap=True)
         elif ev_type == "DELETE":
             self.remove_instance(name)
 
@@ -208,12 +225,18 @@ class InstanceMgr:
         self._instances[meta.name] = inst
         itype = meta.instance_type
         if itype == InstanceType.MIX:
-            # MIX split: first MIX instance decodes, the rest prefill
-            # (instance_mgr.cpp:497-514).
-            itype = (InstanceType.DECODE if self._mix_count == 0
-                     else InstanceType.PREFILL)
-            self._mix_count += 1
-        self._set_role(meta.name, itype)
+            # MIX split: one MIX instance decodes, the rest prefill
+            # (instance_mgr.cpp:497-514). The reference seats whichever
+            # arrives first; with replicas registering from watch events
+            # (different delivery order than the master's heartbeat
+            # order) arrival order is NOT shared state, so the seat is
+            # the lexicographically smallest live MIX name — every node
+            # computes the same split from membership alone.
+            self._mix_names.add(meta.name)
+            self._set_role(meta.name, InstanceType.PREFILL)
+            self._reseat_mix()
+        else:
+            self._set_role(meta.name, itype)
         for m in meta.models:
             inst.model_states[m] = MODEL_AWAKE
         if self.serverless_models and not from_bootstrap and self.is_master:
@@ -240,6 +263,21 @@ class InstanceMgr:
         except Exception as e:  # noqa: BLE001
             logger.warning("fork_master_and_sleep(%s) failed: %s",
                            inst.name, e)
+
+    def _reseat_mix(self) -> None:
+        """Re-derive the MIX decode seat (min live name) after MIX
+        membership changes. Reassignments are routing-table-only: a MIX
+        worker serves both phases, so flipping its classification needs
+        no worker round trip."""
+        if not self._mix_names:
+            return
+        seat = min(self._mix_names)
+        for name in self._mix_names:
+            want = (InstanceType.DECODE if name == seat
+                    else InstanceType.PREFILL)
+            inst = self._instances.get(name)
+            if inst is not None and inst.instance_type != want:
+                self._set_role(name, want)
 
     def _set_role(self, name: str, itype: InstanceType) -> None:
         self._remove_from_indexes(name)
@@ -284,6 +322,9 @@ class InstanceMgr:
             self._remove_from_indexes(name)
             del self._instances[name]
             self._removed.add(name)
+            if name in self._mix_names:
+                self._mix_names.discard(name)
+                self._reseat_mix()
         logger.info("removed instance %s", name)
         if self.on_removed is not None:
             try:
