@@ -1,0 +1,208 @@
+"""Scan-slope microbench of the decode step's cost components.
+
+The tunneled TPU backend has ~80 ms of fixed host round-trip per
+dispatch+readback chain and a `block_until_ready` that returns early, so
+single-op timings are meaningless there (docs/PERF_NOTES.md). The only
+trustworthy method is SCAN-SLOPE: run the op N times inside one jitted
+`lax.scan` with a data dependency between iterations, read back once,
+time at two N values, and take the slope — the fixed RTT cancels out.
+
+Measures, at the headline bench shape (llama3-1b geometry, B=64,
+ctx≈384, table width 8):
+
+- paged decode attention per layer-call: XLA gather reference vs the
+  three Pallas kernels (grid (B,pages); its transpose-free fold; the
+  grid-(B,) double-buffered row kernel) — the kernel A/B the PERF_NOTES
+  runbook wants, without burning a full bench per variant;
+- the all-layer KV scatter (`write_decode_kv_all_layers`);
+- the lm_head matmul + greedy sampling tail.
+
+Run (any backend; Pallas kernels interpret off-TPU):
+    python -m benchmarks.decode_budget [--batch 64] [--ctx 384]
+        [--small] [--n-lo 4] [--n-hi 16]
+
+Prints ONE JSON line: {"metric": "decode_budget", ...,
+"detail": {<component>: ms_per_call, ...}}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _scan_slope(build_fn, n_lo: int, n_hi: int) -> float:
+    """ms per iteration of ``body`` = slope between a ``n_lo``- and a
+    ``n_hi``-iteration scan of it, one host readback each.
+
+    ``build_fn(n)`` must return a zero-arg jitted callable whose result
+    is a small array depending on every iteration. Each length is
+    compiled AND run once for warmup before timing, so compile time and
+    the first-dispatch cost stay out of the slope."""
+    times = {}
+    for n in (n_lo, n_hi):
+        fn = build_fn(n)
+        np.asarray(fn())                      # compile + warm
+        t0 = time.monotonic()
+        np.asarray(fn())
+        times[n] = time.monotonic() - t0
+    return 1e3 * (times[n_hi] - times[n_lo]) / (n_hi - n_lo)
+
+
+def main() -> None:
+    import os
+    if os.environ.get("JAX_PLATFORMS"):
+        # The site hook pins jax_platforms at import, overriding the env
+        # var — an explicit config update is the only way a CPU-pinned
+        # invocation stays off a (possibly wedged) TPU tunnel.
+        try:
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+        except Exception:  # noqa: BLE001
+            pass
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--ctx", type=int, default=384,
+                    help="live context per sequence (tokens)")
+    ap.add_argument("--n-lo", type=int, default=4)
+    ap.add_argument("--n-hi", type=int, default=16)
+    ap.add_argument("--small", action="store_true",
+                    help="tiny shapes for harness tests off-hardware")
+    args = ap.parse_args()
+
+    from xllm_service_tpu.ops import attention as att
+    from xllm_service_tpu.ops.pallas.paged_attention import (
+        _paged_decode_attention_impl, _paged_decode_attention_row_impl)
+    from xllm_service_tpu.ops import pallas as pallas_mod
+
+    if args.small:
+        B, Hq, Hkv, D, ps, L, V = 4, 4, 2, 16, 8, 2, 256
+        P = 64
+    else:
+        # llama3-1b geometry (config.py llama3_1b) + the bench pool.
+        B, Hq, Hkv, D, ps, L, V = args.batch, 32, 8, 64, 64, 16, 128256
+        P = 1024
+    ctx_tokens = args.ctx if not args.small else 24
+    MP = max(1, -(-(ctx_tokens + 1) // ps))
+    MP = 1 << (MP - 1).bit_length()
+    interpret = pallas_mod.default_interpret()
+
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16
+    k_pages = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), dt)
+    v_pages = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), dt)
+    # Distinct live pages per row, page 0 = NULL padding.
+    pt = np.zeros((B, MP), np.int32)
+    need = -(-ctx_tokens // ps)
+    for b in range(B):
+        pt[b, :need] = 1 + ((np.arange(need) + b * need) % (P - 1))
+    pt = jnp.asarray(pt)
+    ctx = jnp.full((B,), ctx_tokens, jnp.int32)
+    q0 = jnp.asarray(rng.normal(size=(B, Hq, D)), dt)
+    kc = jnp.asarray(rng.normal(size=(B, Hkv, D)), dt)
+    vc = jnp.asarray(rng.normal(size=(B, Hkv, D)), dt)
+
+    def attn_builder(kernel_fn):
+        def build(n):
+            @jax.jit
+            def run():
+                def body(q, _):
+                    out = kernel_fn(q, k_pages, v_pages, pt, ctx, kc, vc)
+                    # Data dependency: next q IS the output (same cost
+                    # profile, scan can't collapse or hoist).
+                    return out.astype(q.dtype), ()
+                q_fin, _ = jax.lax.scan(body, q0, None, length=n)
+                return q_fin[0, 0]
+            return run
+        return build
+
+    variants = {
+        "attn_xla_gather": lambda q, k, v, t, c, kcur, vcur:
+            att.paged_decode_attention_current(q, k, v, t, c, kcur, vcur),
+        "attn_pallas_grid": functools.partial(
+            _paged_decode_attention_impl, interpret=interpret,
+            transpose_free=False),
+        "attn_pallas_grid_v2": functools.partial(
+            _paged_decode_attention_impl, interpret=interpret,
+            transpose_free=True),
+        "attn_pallas_row_v3": functools.partial(
+            _paged_decode_attention_row_impl, interpret=interpret),
+    }
+
+    detail = {"shape": {"B": B, "Hq": Hq, "Hkv": Hkv, "D": D,
+                        "page_size": ps, "table_width": MP,
+                        "ctx_tokens": ctx_tokens, "layers": L},
+              "platform": jax.devices()[0].platform,
+              "note": "ms per single layer-call (multiply by layers for "
+                      "per-step attention cost); scan-slope timing"}
+    for name, fn in variants.items():
+        try:
+            detail[name + "_ms"] = round(
+                _scan_slope(attn_builder(fn), args.n_lo, args.n_hi), 4)
+        except Exception as exc:  # noqa: BLE001 — a kernel that fails to
+            # lower must not hide the others' numbers
+            detail[name + "_ms"] = f"error: {type(exc).__name__}: {exc}"
+
+    # All-layer KV scatter, as the engine issues it once per decode step.
+    k_all = jnp.asarray(rng.normal(size=(L, B, Hkv, D)), dt)
+    v_all = jnp.asarray(rng.normal(size=(L, B, Hkv, D)), dt)
+    kp_l = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), dt)
+    vp_l = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), dt)
+    # The last mapped position: page ctx//ps would be NULL (unmapped) and
+    # every row would collide on one flat slot — a degenerate scatter,
+    # not the engine's per-row distinct-page write.
+    positions = jnp.full((B,), ctx_tokens - 1, jnp.int32)
+    active = jnp.ones((B,), jnp.int32)
+
+    def scatter_build(n):
+        @jax.jit
+        def run():
+            def body(carry, _):
+                kp, vp = carry
+                kp2, vp2 = att.write_decode_kv_all_layers(
+                    kp, vp, k_all, v_all, pt, positions, active)
+                return (kp2, vp2), ()
+            (kp2, _), _ = jax.lax.scan(body, (kp_l, vp_l), None, length=n)
+            return kp2[0, 1, 0, 0, 0]
+        return run
+
+    detail["kv_scatter_all_layers_ms"] = round(
+        _scan_slope(scatter_build, args.n_lo, args.n_hi), 4)
+
+    # lm_head + greedy argmax tail.
+    h0 = jnp.asarray(rng.normal(size=(B, D * Hq)), dt)
+    head = jnp.asarray(rng.normal(size=(D * Hq, V)), dt)
+
+    def head_build(n):
+        @jax.jit
+        def run():
+            def body(h, _):
+                logits = (h @ head).astype(jnp.float32)
+                tok = jnp.argmax(logits, axis=-1)
+                h2 = h + tok[:, None].astype(h.dtype) * 1e-6
+                return h2, ()
+            h_fin, _ = jax.lax.scan(body, h0, None, length=n)
+            return h_fin[0, 0]
+        return run
+
+    detail["lm_head_greedy_ms"] = round(
+        _scan_slope(head_build, args.n_lo, args.n_hi), 4)
+
+    # Weight-read floor for context: params bytes / HBM bandwidth.
+    params_b = 1.24e9 * 2 if not args.small else 0
+    detail["weight_read_floor_ms"] = round(params_b / 819e9 * 1e3, 3) \
+        if params_b else None
+
+    print(json.dumps({"metric": "decode_budget", "value":
+                      detail.get("attn_pallas_grid_ms", 0),
+                      "unit": "ms/layer-call", "detail": detail}))
+
+
+if __name__ == "__main__":
+    main()

@@ -383,9 +383,13 @@ def run_multiproc(num_requests: int, concurrency: int, n_workers: int,
     procs: List = []
     helpers: List = []
     try:
-        spawned = [_spawn_service(store_srv.address)
-                   for _ in range(n_procs)]
-        procs = [s[0] for s in spawned]
+        # Append each replica to `procs` AS it boots: if a later spawn
+        # raises, the finally block must still reap the earlier ones.
+        spawned = []
+        for _ in range(n_procs):
+            s = _spawn_service(store_srv.address)
+            procs.append(s[0])
+            spawned.append(s)
         addrs = [s[1] for s in spawned]
         master_rpc = next((s[2] for s in spawned if s[3]), spawned[0][2])
 

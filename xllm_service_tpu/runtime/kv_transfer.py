@@ -50,7 +50,10 @@ def probe_kv_migration(src: Engine, dst: Engine, n_pages: int = 16,
         # tunneled backend (docs/PERF_NOTES.md) — only a host readback is
         # a true sync. Read one written page slice (64 KB-ish, negligible
         # next to the measured block) whose value depends on the scatter.
-        np.asarray(jax.device_get(dst.kv[0][0, int(dst_idx[-1])]))
+        # Index with the static int (n_pages == dst_idx[-1]): indexing
+        # via the device array would add a second blocking readback to
+        # every timed rep.
+        np.asarray(jax.device_get(dst.kv[0][0, n_pages]))
 
     def direct_once() -> None:
         kd, vd = dst.kv

@@ -155,8 +155,12 @@ class InstanceMgr:
                         self._mix_names.add(name)
                         self._reseat_mix()
                     else:
-                        self._mix_names.discard(name)
                         self._set_role(name, meta.instance_type)
+                        if name in self._mix_names:
+                            # A seat holder leaving the MIX pool must
+                            # hand the decode seat to the next MIX name.
+                            self._mix_names.discard(name)
+                            self._reseat_mix()
                 elif self.is_master:
                     self._pending[name] = meta
                     self._removed.discard(name)
