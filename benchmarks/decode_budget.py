@@ -199,8 +199,12 @@ def main() -> None:
     detail["weight_read_floor_ms"] = round(params_b / 819e9 * 1e3, 3) \
         if params_b else None
 
-    print(json.dumps({"metric": "decode_budget", "value":
-                      detail.get("attn_pallas_grid_ms", 0),
+    # "value" must stay numeric for aggregating harnesses even when a
+    # kernel failed to lower (its detail entry is an "error: ..." string).
+    value = detail.get("attn_pallas_grid_ms", 0)
+    if not isinstance(value, (int, float)):
+        value = 0
+    print(json.dumps({"metric": "decode_budget", "value": value,
                       "unit": "ms/layer-call", "detail": detail}))
 
 

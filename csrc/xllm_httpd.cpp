@@ -1,0 +1,926 @@
+// Native HTTP/1.1 front door: epoll event loop + chunked/SSE streaming.
+//
+// The reference's front door is a brpc server — a C++ event loop pulling
+// connections off epoll with a bounded worker pool behind it
+// (reference master.cpp:60-140, common/global_gflags.cpp:33-48). The
+// round-2 rebuild rode Python's ThreadingHTTPServer: one OS thread per
+// CONNECTION, including idle keep-alive sockets and slow readers. This
+// library is the brpc-shaped replacement: all socket work (accept, parse,
+// keep-alive lifecycle, buffered writes, chunked transfer encoding) lives
+// in one epoll thread with zero Python involvement; complete requests are
+// handed to Python on a dedicated dispatch thread (so a GIL stall can
+// never block the event loop), and responses — buffered or streamed —
+// are enqueued from any thread through an eventfd wakeup.
+//
+// Threading model:
+//   epoll thread    owns every fd; the ONLY thread that reads/writes
+//                   sockets. Never touches the GIL.
+//   dispatch thread pops completed requests and invokes the registered
+//                   callback (a ctypes trampoline that acquires the GIL).
+//   caller threads  xllm_httpd_respond / stream_* enqueue ops under a
+//                   mutex and wake the epoll thread via eventfd.
+//
+// Request ids are (slot << 32 | generation): a late write aimed at a
+// connection whose slot was recycled fails the generation check and
+// returns -1 instead of corrupting an unrelated client's stream.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr size_t kMaxHeaderBytes = 1 << 16;        // 64 KB of headers
+constexpr int64_t kMaxBodyBytes = int64_t(2) << 30; // 2 GB (KV shuttles)
+constexpr double kIdleTimeoutS = 60.0;             // matches Python server
+// Bodies larger than this consult the advisory admit callback BEFORE the
+// body is buffered — the shed-before-upload invariant of the Python
+// server (httpd.py: "a shed request must not pay an unbounded upload").
+// Below it, buffering a to-be-shed body is cheaper than a GIL hop.
+constexpr int64_t kEarlyShedBytes = 64 << 10;
+// A slow-but-alive reader must not buffer an unbounded stream in heap:
+// past this many queued bytes the connection is written off. The Python
+// server got backpressure for free by blocking in wfile.write; here the
+// producer sees stream_chunk() == -1 and stops.
+constexpr size_t kMaxQueuedBytes = size_t(256) << 20;
+
+double now_s() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
+
+struct Request {
+    uint64_t rid;
+    std::string method, path, query, headers_blob, body;
+    bool is_admit_query = false;   // large-body advisory admission check:
+                                   // only rid/method/path are meaningful
+};
+
+struct Conn {
+    int fd = -1;
+    uint32_t gen = 0;          // bumped on close; half of the rid
+    bool busy = false;         // a request is being handled in Python
+    bool streaming = false;
+    bool close_after = false;  // close once the write queue drains
+    bool dead = false;
+    bool peer_half_closed = false;  // FIN seen; peer may still be reading
+    bool awaiting_admit = false;    // header-complete large body, verdict
+                                    // pending on the dispatch thread;
+                                    // EPOLLIN masked meanwhile
+    bool shed_discard = false;      // rejected: drop every further byte
+    double last_active = 0.0;
+    std::string rbuf;
+    std::deque<std::string> wq;
+    size_t wq_bytes = 0;
+    size_t woff = 0;           // offset into wq.front()
+    // parse state for the in-progress request
+    bool have_head = false;
+    int64_t need_body = 0;
+    std::string method, path, query, headers_blob;
+    std::string lower_connection;  // value of Connection: header
+};
+
+enum class OpKind { Respond, StreamBegin, StreamChunk, StreamEnd,
+                    StreamAbort, StartAccept, AdmitVerdict };
+
+struct Op {
+    OpKind kind;
+    uint64_t rid;
+    int status = 0;
+    std::string headers_blob;
+    std::string body;
+};
+
+// headers is a "key\0value\0...\0\0" blob passed with an explicit length:
+// an embedded-NUL blob through a plain char* would be truncated by any
+// NUL-terminated string conversion on the receiving side.
+typedef void (*xllm_req_cb)(void* user, uint64_t rid, const char* method,
+                            const char* path, const char* query,
+                            const char* headers, int64_t headers_len,
+                            const char* body, int64_t body_len);
+// Advisory early-shed check, called from the EPOLL thread at
+// header-complete time for large-body requests only: 1 = proceed,
+// 0 = reply with the canned shed response without reading the body.
+// The authoritative admission decision still happens at dispatch.
+typedef int32_t (*xllm_admit_cb)(void* user, const char* method,
+                                 const char* path);
+
+struct Server {
+    int listen_fd = -1, ep = -1, evfd = -1;
+    int port = 0;
+    bool accepting = false;            // run() registers the listen fd
+    double accept_resume_at = 0.0;     // EMFILE backoff (epoll thread)
+    std::atomic<bool> stopping{false};
+    // In-flight extern-C callers (respond/stream_* from Python handler
+    // threads). stop() must wait for them to drain before delete — a
+    // handler mid-call would otherwise touch freed memory.
+    std::atomic<int> api_callers{0};
+    xllm_req_cb cb = nullptr;
+    xllm_admit_cb admit_cb = nullptr;
+    std::string shed_response;         // pre-rendered HTTP bytes
+    std::mutex shed_mu;
+    void* user = nullptr;
+    std::thread loop_thread, dispatch_thread;
+
+    std::vector<Conn*> conns;          // slot -> conn (epoll thread only)
+    std::vector<uint32_t> slot_gens;   // monotonic per SLOT, not per conn:
+                                       // a recycled slot must never reuse
+                                       // a generation a stale rid holds
+    std::vector<int> free_slots;
+
+    std::mutex op_mu;
+    std::vector<Op> ops;               // caller threads -> epoll thread
+
+    std::mutex disp_mu;
+    std::condition_variable disp_cv;
+    std::deque<Request> disp_q;        // epoll thread -> dispatch thread
+
+    // rid liveness check for stream_chunk fast-fail, written by the epoll
+    // thread, read by caller threads.
+    std::mutex live_mu;
+    std::unordered_map<uint64_t, bool> live;  // rid -> still writable
+};
+
+std::mutex g_mu;
+std::map<int64_t, Server*> g_servers;
+int64_t g_next_handle = 1;
+
+// Acquire = lookup + caller-count increment under ONE lock hold, so a
+// concurrent stop() can never delete the server between the two.
+Server* acquire_server(int64_t h) {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_servers.find(h);
+    if (it == g_servers.end()) return nullptr;
+    it->second->api_callers.fetch_add(1, std::memory_order_acquire);
+    return it->second;
+}
+
+struct ServerRef {
+    Server* s;
+    explicit ServerRef(int64_t h) : s(acquire_server(h)) {}
+    ~ServerRef() {
+        if (s) s->api_callers.fetch_sub(1, std::memory_order_release);
+    }
+    ServerRef(const ServerRef&) = delete;
+    ServerRef& operator=(const ServerRef&) = delete;
+};
+
+void set_nonblock(int fd) {
+    int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+uint64_t make_rid(int slot, uint32_t gen) {
+    return (uint64_t(uint32_t(slot)) << 32) | gen;
+}
+
+// --- epoll-thread helpers --------------------------------------------------
+
+void mark_live(Server* s, uint64_t rid, bool v) {
+    std::lock_guard<std::mutex> lk(s->live_mu);
+    if (v) s->live[rid] = true; else s->live.erase(rid);
+}
+
+void close_conn(Server* s, int slot) {
+    Conn* c = s->conns[slot];
+    if (!c || c->fd < 0) return;
+    mark_live(s, make_rid(slot, c->gen), false);
+    epoll_ctl(s->ep, EPOLL_CTL_DEL, c->fd, nullptr);
+    close(c->fd);
+    c->fd = -1;
+    c->dead = true;
+    delete c;
+    s->conns[slot] = nullptr;
+    s->free_slots.push_back(slot);
+}
+
+// A connection that died while its request is still in Python: deregister
+// the fd from epoll (a level-triggered EPOLLHUP would otherwise re-fire
+// every epoll_wait and peg the loop at 100% CPU until the handler ends),
+// fail the producer's next stream_chunk, and leave the close to the reap
+// pass that runs once the handler finishes.
+void quiesce_dead(Server* s, int slot, Conn* c) {
+    c->dead = true;
+    mark_live(s, make_rid(slot, c->gen), false);
+    c->wq.clear();
+    c->wq_bytes = 0;
+    epoll_ctl(s->ep, EPOLL_CTL_DEL, c->fd, nullptr);
+}
+
+void arm_write(Server* s, int slot, Conn* c) {
+    struct epoll_event ev{};
+    // While an admit verdict is pending the body is left in the kernel
+    // socket buffer (EPOLLIN masked): TCP flow control throttles the
+    // client and no user memory is spent on a request that may be shed.
+    ev.events = (c->awaiting_admit ? 0u : (EPOLLIN | EPOLLRDHUP)) |
+                (c->wq.empty() ? 0u : EPOLLOUT);
+    ev.data.u64 = uint64_t(slot);
+    epoll_ctl(s->ep, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+void queue_bytes(Server* s, int slot, Conn* c, std::string&& data) {
+    c->wq_bytes += data.size();
+    c->wq.emplace_back(std::move(data));
+    if (c->wq_bytes > kMaxQueuedBytes) {
+        // Slow-reader eviction: stop buffering, fail the producer's next
+        // stream_chunk, close once the handler finishes.
+        if (!c->busy) {
+            c->dead = true;
+            c->wq.clear();
+            c->wq_bytes = 0;
+            close_conn(s, slot);
+        } else {
+            quiesce_dead(s, slot, c);
+        }
+        return;
+    }
+    arm_write(s, slot, c);
+}
+
+std::string status_line_and_headers(int status, const std::string& blob,
+                                    const char* extra) {
+    const char* reason = "OK";
+    switch (status) {
+        case 200: reason = "OK"; break;
+        case 204: reason = "No Content"; break;
+        case 400: reason = "Bad Request"; break;
+        case 404: reason = "Not Found"; break;
+        case 500: reason = "Internal Server Error"; break;
+        case 503: reason = "Service Unavailable"; break;
+        default: reason = "Status"; break;
+    }
+    std::string out = "HTTP/1.1 " + std::to_string(status) + " " + reason +
+                      "\r\n";
+    // blob is "key\0value\0...\0\0"
+    const char* p = blob.c_str();
+    while (*p) {
+        const char* k = p;
+        p += strlen(p) + 1;
+        const char* v = p;
+        p += strlen(p) + 1;
+        out.append(k).append(": ").append(v).append("\r\n");
+    }
+    out.append(extra);
+    out.append("\r\n");
+    return out;
+}
+
+bool blob_requests_close(const std::string& blob) {
+    const char* p = blob.c_str();
+    while (*p) {
+        const char* k = p;
+        p += strlen(p) + 1;
+        const char* v = p;
+        p += strlen(p) + 1;
+        if (strcasecmp(k, "connection") == 0 && strcasecmp(v, "close") == 0)
+            return true;
+    }
+    return false;
+}
+
+void finish_response(Server* s, int slot, Conn* c) {
+    // Response fully queued: the connection either closes after the drain
+    // or goes back to parsing (data may already be buffered — pipelining).
+    mark_live(s, make_rid(slot, c->gen), false);
+    c->busy = false;
+    c->streaming = false;
+    c->have_head = false;
+    c->gen = ++s->slot_gens[slot];  // stale respond() for the finished
+                                    // request must miss the check
+}
+
+void resume_accept(Server* s);
+bool try_parse(Server* s, int slot, Conn* c);
+void push_op(Server* s, Op&& op);
+
+void apply_op(Server* s, Op& op) {
+    if (op.kind == OpKind::StartAccept) {
+        resume_accept(s);
+        return;
+    }
+    int slot = int(op.rid >> 32);
+    if (slot < 0 || size_t(slot) >= s->conns.size()) return;
+    Conn* c = s->conns[slot];
+    if (!c || c->fd < 0 || uint32_t(op.rid) != c->gen) return;
+    if (op.kind == OpKind::AdmitVerdict) {
+        if (!c->awaiting_admit) return;
+        c->awaiting_admit = false;
+        if (op.status != 0) {
+            // Admitted: resume reading the body and continue parsing
+            // whatever part already arrived.
+            arm_write(s, slot, c);
+            if (!try_parse(s, slot, c)) close_conn(s, slot);
+        } else {
+            // Shed before the upload: canned 503, then discard every
+            // byte the client still sends — re-parsing the rejected
+            // request's body as fresh requests would let a crafted
+            // payload smuggle an inner request past admission.
+            std::string shed;
+            {
+                std::lock_guard<std::mutex> lk(s->shed_mu);
+                shed = s->shed_response;
+            }
+            c->shed_discard = true;
+            c->close_after = true;
+            c->have_head = false;
+            c->rbuf.clear();
+            queue_bytes(s, slot, c, std::move(shed));
+        }
+        return;
+    }
+    if (!c->busy) return;
+    switch (op.kind) {
+        case OpKind::Respond: {
+            if (blob_requests_close(op.headers_blob)) c->close_after = true;
+            std::string head = status_line_and_headers(
+                op.status, op.headers_blob,
+                ("Content-Length: " + std::to_string(op.body.size()) +
+                 "\r\n").c_str());
+            head.append(op.body);
+            queue_bytes(s, slot, c, std::move(head));
+            finish_response(s, slot, c);
+            break;
+        }
+        case OpKind::StreamBegin: {
+            if (blob_requests_close(op.headers_blob)) c->close_after = true;
+            c->streaming = true;
+            queue_bytes(s, slot, c, status_line_and_headers(
+                op.status, op.headers_blob,
+                "Transfer-Encoding: chunked\r\n"));
+            break;
+        }
+        case OpKind::StreamChunk: {
+            if (!c->streaming || op.body.empty()) break;
+            char szline[32];
+            int n = snprintf(szline, sizeof szline, "%zX\r\n",
+                             op.body.size());
+            std::string frame;
+            frame.reserve(n + op.body.size() + 2);
+            frame.append(szline, n).append(op.body).append("\r\n");
+            queue_bytes(s, slot, c, std::move(frame));
+            break;
+        }
+        case OpKind::StreamEnd: {
+            if (!c->streaming) break;
+            queue_bytes(s, slot, c, std::string("0\r\n\r\n"));
+            finish_response(s, slot, c);
+            break;
+        }
+        case OpKind::StreamAbort: {
+            // Producer failed mid-stream: close WITHOUT the terminal
+            // 0-chunk so the client's chunked decoder sees a truncated
+            // (failed) response — a clean terminator would make it
+            // silently accept a partial answer as complete.
+            if (!c->streaming) break;
+            c->wq.clear();
+            c->wq_bytes = 0;
+            finish_response(s, slot, c);
+            close_conn(s, slot);
+            break;
+        }
+    }
+}
+
+// Returns false on fatal parse error (connection should close).
+bool try_parse(Server* s, int slot, Conn* c) {
+    while (!c->busy && !c->awaiting_admit && !c->shed_discard) {
+        if (!c->have_head) {
+            size_t he = c->rbuf.find("\r\n\r\n");
+            if (he == std::string::npos) {
+                if (c->rbuf.size() > kMaxHeaderBytes) return false;
+                return true;  // need more bytes
+            }
+            std::string head = c->rbuf.substr(0, he);
+            c->rbuf.erase(0, he + 4);
+            // request line
+            size_t le = head.find("\r\n");
+            bool headerless = le == std::string::npos;   // bare req line
+            if (headerless) le = head.size();
+            std::string rline = head.substr(0, le);
+            size_t sp1 = rline.find(' ');
+            size_t sp2 = rline.rfind(' ');
+            if (sp1 == std::string::npos || sp2 <= sp1) return false;
+            c->method = rline.substr(0, sp1);
+            std::string target = rline.substr(sp1 + 1, sp2 - sp1 - 1);
+            size_t q = target.find('?');
+            c->path = q == std::string::npos ? target : target.substr(0, q);
+            c->query = q == std::string::npos ? "" : target.substr(q + 1);
+            bool http10 = rline.compare(sp2 + 1, std::string::npos,
+                                        "HTTP/1.0") == 0;
+            // headers -> blob "key\0value\0"; keys lowercased
+            c->headers_blob.clear();
+            c->lower_connection = http10 ? "close" : "";
+            int64_t content_len = 0;
+            size_t pos = headerless ? head.size() : le + 2;
+            while (pos < head.size()) {
+                size_t eol = head.find("\r\n", pos);
+                if (eol == std::string::npos) eol = head.size();
+                size_t colon = head.find(':', pos);
+                if (colon != std::string::npos && colon < eol) {
+                    std::string k = head.substr(pos, colon - pos);
+                    size_t vs = colon + 1;
+                    while (vs < eol && head[vs] == ' ') vs++;
+                    std::string v = head.substr(vs, eol - vs);
+                    for (auto& ch : k)
+                        ch = char(tolower((unsigned char)ch));
+                    if (k == "content-length")
+                        content_len = strtoll(v.c_str(), nullptr, 10);
+                    if (k == "connection") {
+                        c->lower_connection = v;
+                        for (auto& ch : c->lower_connection)
+                            ch = char(tolower((unsigned char)ch));
+                    }
+                    c->headers_blob.append(k).push_back('\0');
+                    c->headers_blob.append(v).push_back('\0');
+                }
+                pos = eol + 2;
+            }
+            if (content_len < 0 || content_len > kMaxBodyBytes) return false;
+            c->need_body = content_len;
+            c->have_head = true;
+            if (content_len > kEarlyShedBytes && s->admit_cb) {
+                // Large upload: ask Python for an advisory verdict BEFORE
+                // buffering the body. The callback needs the GIL, so it
+                // runs on the dispatch thread — never here on the epoll
+                // thread, where a GIL stall would freeze every
+                // connection. Until the verdict lands, EPOLLIN is masked
+                // (see arm_write) and the upload waits in the kernel.
+                c->awaiting_admit = true;
+                arm_write(s, slot, c);
+                Request q;
+                q.rid = make_rid(slot, c->gen);
+                q.method = c->method;
+                q.path = c->path;
+                q.is_admit_query = true;
+                {
+                    std::lock_guard<std::mutex> lk(s->disp_mu);
+                    s->disp_q.emplace_back(std::move(q));
+                }
+                s->disp_cv.notify_one();
+                return true;
+            }
+        }
+        if (int64_t(c->rbuf.size()) < c->need_body) return true;
+        // Complete request: hand off to the dispatch thread.
+        c->busy = true;
+        if (c->lower_connection == "close") c->close_after = true;
+        Request req;
+        req.rid = make_rid(slot, c->gen);
+        req.method = std::move(c->method);
+        req.path = std::move(c->path);
+        req.query = std::move(c->query);
+        req.headers_blob = std::move(c->headers_blob);
+        req.body = c->rbuf.substr(0, size_t(c->need_body));
+        c->rbuf.erase(0, size_t(c->need_body));
+        mark_live(s, req.rid, true);
+        {
+            std::lock_guard<std::mutex> lk(s->disp_mu);
+            s->disp_q.emplace_back(std::move(req));
+        }
+        s->disp_cv.notify_one();
+    }
+    return true;
+}
+
+void suspend_accept(Server* s, double resume_delay_s) {
+    if (!s->accepting) return;
+    epoll_ctl(s->ep, EPOLL_CTL_DEL, s->listen_fd, nullptr);
+    s->accepting = false;
+    s->accept_resume_at = now_s() + resume_delay_s;
+}
+
+void resume_accept(Server* s) {
+    if (s->accepting) return;
+    struct epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = uint64_t(-2);
+    epoll_ctl(s->ep, EPOLL_CTL_ADD, s->listen_fd, &ev);
+    s->accepting = true;
+    s->accept_resume_at = 0.0;
+}
+
+void accept_new(Server* s) {
+    for (;;) {
+        int fd = accept4(s->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+        if (fd < 0) {
+            if (errno == EMFILE || errno == ENFILE)
+                // fd exhaustion with a non-empty backlog keeps the
+                // level-triggered listen fd readable — without a pause
+                // the loop would spin at 100% CPU doing failed accepts.
+                suspend_accept(s, 0.5);
+            return;
+        }
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        int slot;
+        if (!s->free_slots.empty()) {
+            slot = s->free_slots.back();
+            s->free_slots.pop_back();
+        } else {
+            slot = int(s->conns.size());
+            s->conns.push_back(nullptr);
+            s->slot_gens.push_back(0);
+        }
+        Conn* c = new Conn();
+        c->fd = fd;
+        c->gen = ++s->slot_gens[slot];
+        c->last_active = now_s();
+        s->conns[slot] = c;
+        struct epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLRDHUP;
+        ev.data.u64 = uint64_t(slot);
+        epoll_ctl(s->ep, EPOLL_CTL_ADD, fd, &ev);
+    }
+}
+
+void handle_readable(Server* s, int slot, Conn* c) {
+    char buf[65536];
+    for (;;) {
+        ssize_t n = read(c->fd, buf, sizeof buf);
+        if (n > 0) {
+            if (c->shed_discard) {
+                c->last_active = now_s();
+                continue;          // rejected upload: drop on the floor
+            }
+            c->rbuf.append(buf, size_t(n));
+            c->last_active = now_s();
+            if (c->rbuf.size() > size_t(kMaxBodyBytes)) {
+                close_conn(s, slot);
+                return;
+            }
+            continue;
+        }
+        if (n == 0) {
+            // FIN. A peer that shut down only its WRITE side may still be
+            // reading (curl --no-buffer piped to head, e.g.) — an
+            // in-flight response keeps flowing until a write actually
+            // fails. With no request in flight the connection is simply
+            // done.
+            if (!c->busy) close_conn(s, slot);
+            else c->peer_half_closed = true;
+            return;
+        }
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        if (!c->busy) close_conn(s, slot);
+        else quiesce_dead(s, slot, c);
+        return;
+    }
+    if (!try_parse(s, slot, c)) close_conn(s, slot);
+}
+
+void handle_writable(Server* s, int slot, Conn* c) {
+    while (!c->wq.empty()) {
+        const std::string& front = c->wq.front();
+        ssize_t n = write(c->fd, front.data() + c->woff,
+                          front.size() - c->woff);
+        if (n > 0) {
+            c->woff += size_t(n);
+            c->last_active = now_s();
+            if (c->woff == front.size()) {
+                c->wq_bytes -= front.size();
+                c->wq.pop_front();
+                c->woff = 0;
+            }
+            continue;
+        }
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        // Broken pipe mid-response: if Python is still producing (busy),
+        // keep the slot alive so stream_chunk returns -1 cleanly; the
+        // close completes at stream_end/respond.
+        if (!c->busy) {
+            c->dead = true;
+            mark_live(s, make_rid(slot, c->gen), false);
+            c->wq.clear();
+            c->wq_bytes = 0;
+            close_conn(s, slot);
+        } else {
+            quiesce_dead(s, slot, c);
+        }
+        return;
+    }
+    if (c->wq.empty() && !c->busy) {
+        if (c->close_after || c->dead || c->peer_half_closed) {
+            close_conn(s, slot);
+            return;
+        }
+        // Parse any pipelined request that arrived during the response.
+        if (!try_parse(s, slot, c)) { close_conn(s, slot); return; }
+    }
+    if (c->fd >= 0) arm_write(s, slot, c);
+}
+
+void sweep_idle(Server* s) {
+    double now = now_s();
+    for (int slot = 0; slot < int(s->conns.size()); slot++) {
+        Conn* c = s->conns[slot];
+        // wq non-empty does NOT exempt a connection: last_active stops
+        // advancing when the peer never reads, and a client that parks a
+        // queued response would otherwise hold its fd + heap forever.
+        if (c && c->fd >= 0 && !c->busy &&
+            now - c->last_active > kIdleTimeoutS)
+            close_conn(s, slot);
+    }
+}
+
+void epoll_loop(Server* s) {
+    struct epoll_event evs[256];
+    double last_sweep = now_s();
+    while (!s->stopping.load(std::memory_order_relaxed)) {
+        int n = epoll_wait(s->ep, evs, 256, 1000);
+        // Apply pending ops from Python threads first: a respond for a
+        // conn that also has a read event must be queued before the
+        // read handler could close it.
+        {
+            std::vector<Op> ops;
+            {
+                std::lock_guard<std::mutex> lk(s->op_mu);
+                ops.swap(s->ops);
+            }
+            for (auto& op : ops) apply_op(s, op);
+            // After a respond finished a request, a dead/broken conn can
+            // now be reaped.
+            for (int slot = 0; slot < int(s->conns.size()); slot++) {
+                Conn* c = s->conns[slot];
+                if (c && c->fd >= 0 && c->dead && !c->busy && c->wq.empty())
+                    close_conn(s, slot);
+            }
+        }
+        for (int i = 0; i < n; i++) {
+            uint64_t tag = evs[i].data.u64;
+            if (tag == uint64_t(-1)) {         // eventfd wakeup
+                uint64_t junk;
+                while (read(s->evfd, &junk, 8) == 8) {}
+                continue;
+            }
+            if (tag == uint64_t(-2)) {         // listen socket
+                accept_new(s);
+                continue;
+            }
+            int slot = int(tag);
+            Conn* c = slot < int(s->conns.size()) ? s->conns[slot] : nullptr;
+            if (!c || c->fd < 0) continue;
+            if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+                if (!c->busy) { close_conn(s, slot); continue; }
+                quiesce_dead(s, slot, c);
+                continue;
+            }
+            if (evs[i].events & (EPOLLIN | EPOLLRDHUP))
+                handle_readable(s, slot, c);
+            c = slot < int(s->conns.size()) ? s->conns[slot] : nullptr;
+            if (c && c->fd >= 0 && (evs[i].events & EPOLLOUT))
+                handle_writable(s, slot, c);
+        }
+        double now = now_s();
+        if (!s->accepting && s->accept_resume_at > 0.0 &&
+            now >= s->accept_resume_at)
+            resume_accept(s);    // EMFILE backoff expired
+        if (now - last_sweep > 5.0) {
+            last_sweep = now;
+            sweep_idle(s);
+        }
+    }
+    for (int slot = 0; slot < int(s->conns.size()); slot++) close_conn(s, slot);
+}
+
+void dispatch_loop(Server* s) {
+    for (;;) {
+        Request req;
+        {
+            std::unique_lock<std::mutex> lk(s->disp_mu);
+            s->disp_cv.wait(lk, [&] {
+                return s->stopping.load() || !s->disp_q.empty();
+            });
+            if (s->stopping.load() && s->disp_q.empty()) return;
+            req = std::move(s->disp_q.front());
+            s->disp_q.pop_front();
+        }
+        if (req.is_admit_query) {
+            int32_t verdict = s->admit_cb
+                ? s->admit_cb(s->user, req.method.c_str(), req.path.c_str())
+                : 1;
+            Op op;
+            op.kind = OpKind::AdmitVerdict;
+            op.rid = req.rid;
+            op.status = verdict;
+            push_op(s, std::move(op));
+            continue;
+        }
+        s->cb(s->user, req.rid, req.method.c_str(), req.path.c_str(),
+              req.query.c_str(), req.headers_blob.data(),
+              int64_t(req.headers_blob.size()), req.body.data(),
+              int64_t(req.body.size()));
+    }
+}
+
+void push_op(Server* s, Op&& op) {
+    {
+        std::lock_guard<std::mutex> lk(s->op_mu);
+        s->ops.emplace_back(std::move(op));
+    }
+    uint64_t one = 1;
+    ssize_t r = write(s->evfd, &one, 8);
+    (void)r;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t xllm_httpd_start(const char* host, int32_t port, xllm_req_cb cb,
+                         xllm_admit_cb admit_cb, void* user) {
+    Server* s = new Server();
+    s->cb = cb;
+    s->admit_cb = admit_cb;
+    s->user = user;
+    s->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (s->listen_fd < 0) { delete s; return 0; }
+    int one = 1;
+    setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    struct sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(uint16_t(port));
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1)
+        addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    if (bind(s->listen_fd, (struct sockaddr*)&addr, sizeof addr) != 0 ||
+        listen(s->listen_fd, 512) != 0) {
+        close(s->listen_fd);
+        delete s;
+        return 0;
+    }
+    socklen_t alen = sizeof addr;
+    getsockname(s->listen_fd, (struct sockaddr*)&addr, &alen);
+    s->port = ntohs(addr.sin_port);
+    s->ep = epoll_create1(0);
+    s->evfd = eventfd(0, EFD_NONBLOCK);
+    set_nonblock(s->evfd);
+    struct epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = uint64_t(-1);
+    epoll_ctl(s->ep, EPOLL_CTL_ADD, s->evfd, &ev);
+    // The listen fd is NOT registered yet: the socket is bound (the port
+    // is known, early connections queue in the TCP backlog) but nothing
+    // is accepted until xllm_httpd_run — matching the Python server's
+    // construct-then-start lifecycle that callers rely on.
+    s->loop_thread = std::thread(epoll_loop, s);
+    s->dispatch_thread = std::thread(dispatch_loop, s);
+    std::lock_guard<std::mutex> lk(g_mu);
+    int64_t h = g_next_handle++;
+    g_servers[h] = s;
+    return h;
+}
+
+int32_t xllm_httpd_port(int64_t h) {
+    ServerRef ref(h);
+    return ref.s ? ref.s->port : -1;
+}
+
+int32_t xllm_httpd_run(int64_t h) {
+    ServerRef ref(h);
+    if (!ref.s) return -1;
+    Op op;
+    op.kind = OpKind::StartAccept;
+    push_op(ref.s, std::move(op));
+    return 0;
+}
+
+// Pre-rendered HTTP response bytes written verbatim (then close) when the
+// advisory admit callback sheds a large-body request before its upload.
+int32_t xllm_httpd_set_shed_response(int64_t h, const char* data,
+                                     int64_t len) {
+    ServerRef ref(h);
+    if (!ref.s || !data || len <= 0) return -1;
+    std::lock_guard<std::mutex> lk(ref.s->shed_mu);
+    ref.s->shed_response.assign(data, size_t(len));
+    return 0;
+}
+
+void xllm_httpd_stop(int64_t h) {
+    Server* s;
+    {
+        std::lock_guard<std::mutex> lk(g_mu);
+        auto it = g_servers.find(h);
+        if (it == g_servers.end()) return;
+        s = it->second;
+        g_servers.erase(it);
+    }
+    s->stopping.store(true);
+    s->disp_cv.notify_all();
+    uint64_t one = 1;
+    ssize_t r = write(s->evfd, &one, 8);
+    (void)r;
+    s->loop_thread.join();
+    s->dispatch_thread.join();
+    // A Python handler thread may still be INSIDE respond/stream_*
+    // (it acquired the server before the map erase). Wait for every
+    // such caller to leave before freeing — delete under a live caller
+    // is a use-after-free on s->op_mu / s->live_mu.
+    while (s->api_callers.load(std::memory_order_acquire) != 0)
+        usleep(1000);
+    close(s->listen_fd);
+    close(s->ep);
+    close(s->evfd);
+    delete s;
+}
+
+int32_t xllm_httpd_respond(int64_t h, uint64_t rid, int32_t status,
+                           const char* headers, int64_t headers_len,
+                           const char* body, int64_t len) {
+    ServerRef ref(h);
+    Server* s = ref.s;
+    if (!s) return -1;
+    Op op;
+    op.kind = OpKind::Respond;
+    op.rid = rid;
+    op.status = status;
+    // Explicit length: the blob carries embedded NULs, so a C-string
+    // construction would truncate it at the first delimiter.
+    if (headers && headers_len > 0)
+        op.headers_blob.assign(headers, size_t(headers_len));
+    if (body && len > 0) op.body.assign(body, size_t(len));
+    push_op(s, std::move(op));
+    return 0;
+}
+
+int32_t xllm_httpd_stream_begin(int64_t h, uint64_t rid, int32_t status,
+                                const char* headers, int64_t headers_len) {
+    ServerRef ref(h);
+    Server* s = ref.s;
+    if (!s) return -1;
+    Op op;
+    op.kind = OpKind::StreamBegin;
+    op.rid = rid;
+    op.status = status;
+    if (headers && headers_len > 0)
+        op.headers_blob.assign(headers, size_t(headers_len));
+    push_op(s, std::move(op));
+    return 0;
+}
+
+int32_t xllm_httpd_stream_chunk(int64_t h, uint64_t rid, const char* data,
+                                int64_t len) {
+    ServerRef ref(h);
+    Server* s = ref.s;
+    if (!s) return -1;
+    {
+        // Fast liveness check so a producer streaming to a vanished
+        // client stops promptly instead of filling queues forever.
+        std::lock_guard<std::mutex> lk(s->live_mu);
+        auto it = s->live.find(rid);
+        if (it == s->live.end()) return -1;
+    }
+    Op op;
+    op.kind = OpKind::StreamChunk;
+    op.rid = rid;
+    if (data && len > 0) op.body.assign(data, size_t(len));
+    push_op(s, std::move(op));
+    return 0;
+}
+
+int32_t xllm_httpd_stream_end(int64_t h, uint64_t rid) {
+    ServerRef ref(h);
+    Server* s = ref.s;
+    if (!s) return -1;
+    Op op;
+    op.kind = OpKind::StreamEnd;
+    op.rid = rid;
+    push_op(s, std::move(op));
+    return 0;
+}
+
+// Producer-side failure: tear the connection down WITHOUT the chunked
+// terminator so the client sees the truncation instead of a falsely
+// complete response.
+int32_t xllm_httpd_stream_abort(int64_t h, uint64_t rid) {
+    ServerRef ref(h);
+    Server* s = ref.s;
+    if (!s) return -1;
+    Op op;
+    op.kind = OpKind::StreamAbort;
+    op.rid = rid;
+    push_op(s, std::move(op));
+    return 0;
+}
+
+}  // extern "C"

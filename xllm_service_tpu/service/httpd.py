@@ -129,6 +129,18 @@ class Admission:
             self._active += 1
             return True
 
+    def probe(self) -> bool:
+        """Advisory admission check WITHOUT claiming a slot (counts a
+        rejection). Used by the native front door to shed large-body
+        uploads at header-complete time, before buffering the body; the
+        authoritative ``try_enter`` still runs at dispatch."""
+        with self._lock:
+            lim = self._current_limit()
+            if lim is not None and self._active >= lim:
+                self.rejected_total += 1
+                return False
+            return True
+
     def leave(self) -> None:
         with self._lock:
             self._active -= 1
@@ -281,7 +293,7 @@ class _RequestHandler(BaseHTTPRequestHandler):
     do_DELETE = _handle
 
 
-class HttpServer:
+class PyHttpServer:
     """Threaded HTTP server bound to (host, port); port 0 picks a free one.
 
     ``max_concurrency``: int / None / zero-arg callable — see
@@ -318,6 +330,27 @@ class HttpServer:
         self._srv.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+
+
+def HttpServer(host: str, port: int, router: Router,  # noqa: N802
+               max_concurrency=None,
+               admission_exempt: Tuple[str, ...] = _ADMISSION_EXEMPT):
+    """Server factory: the native epoll front door (csrc/xllm_httpd.cpp,
+    the brpc-shaped event loop) when the library builds, else the
+    pure-Python threaded server. ``XLLM_NATIVE_HTTPD=0`` forces Python.
+    Both expose the same surface: ``start/stop/address/port/admission``."""
+    try:
+        from xllm_service_tpu.service.native_httpd import NativeHttpServer
+        return NativeHttpServer(host, port, router,
+                                max_concurrency=max_concurrency,
+                                admission_exempt=admission_exempt)
+    except (OSError, ImportError):
+        # Library unavailable, module missing from a partial deployment,
+        # or port-bind raced: the Python server's bind surfaces a genuine
+        # port conflict identically.
+        return PyHttpServer(host, port, router,
+                            max_concurrency=max_concurrency,
+                            admission_exempt=admission_exempt)
 
 
 # ---------------------------------------------------------------------------
