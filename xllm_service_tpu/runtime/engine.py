@@ -222,10 +222,21 @@ class Engine:
         self._jit_decode = jax.jit(
             functools.partial(_decode_step, cfg=model_cfg, num_top=K),
             donate_argnums=(2, 6))
+        # tokens/positions (1, 2) are donated too: each burst feeds back
+        # the previous burst's returned final-state handles, and a donated
+        # input lets XLA alias the new final state into the same buffers.
         self._jit_decode_multi = jax.jit(
             functools.partial(_decode_multi_step, cfg=model_cfg,
                               n_steps=engine_cfg.decode_steps, num_top=K),
-            donate_argnums=(2, 6))
+            donate_argnums=(1, 2, 4, 8))
+        # Device-resident decode state between bursts: the previous
+        # burst's final (tokens, positions) handles plus a host snapshot
+        # proving they still describe the running batch, and the device
+        # copy of the active+page-table block with its host mirror for
+        # change detection. (docs/PERF_NOTES.md "ranked next steps" #1.)
+        self._resident: Optional[Dict[str, Any]] = None
+        self._dev_active_pt: Optional[jnp.ndarray] = None
+        self._active_pt_mirror: Optional[np.ndarray] = None
         # Output-token histogram [B, V] for presence/frequency penalties;
         # lives on device only while some running slot uses penalties.
         self._counts: Optional[jnp.ndarray] = None
@@ -902,17 +913,42 @@ class Engine:
             self._rng_key, key = jax.random.split(self._rng_key)
             # Width must cover the lookahead pages pre-grown above.
             mp = self._table_width()
-            packed = jnp.asarray(np.ascontiguousarray(
-                self._slot_packed[:, :_PACK_COLS + mp]))
+            # active+page-table block: re-upload ONLY when it changed
+            # (page growth, admit/finish). Steady-state long bursts reuse
+            # the device copy — page tables change every page_size tokens,
+            # not every burst.
+            apt_now = self._slot_packed[:, 2:_PACK_COLS + mp]
+            if (self._active_pt_mirror is None
+                    or self._active_pt_mirror.shape != apt_now.shape
+                    or not np.array_equal(self._active_pt_mirror, apt_now)):
+                self._active_pt_mirror = apt_now.copy()
+                self._dev_active_pt = jnp.asarray(
+                    np.ascontiguousarray(apt_now))
+            # tokens/positions: reuse the previous burst's returned device
+            # arrays when the snapshot still matches the running batch —
+            # the common case inside a long all-decode stretch.
+            snap = tuple((s.req.request_id, s.slot, s.tokens[-1],
+                          len(s.tokens) - 1) for s in self.running)
+            resident = self._resident
+            if resident is not None and resident["snap"] == snap:
+                dev_tok, dev_pos = resident["tok"], resident["pos"]
+                resident_hit = True
+            else:
+                dev_tok = jnp.asarray(
+                    np.ascontiguousarray(self._slot_last_token))
+                dev_pos = jnp.asarray(np.ascontiguousarray(self._slot_pos))
+                resident_hit = False
+            self._resident = None     # handles are consumed (donated)
         cache_before = self._jit_cache_size(self._jit_decode_multi)
         with self._phase("decode_multi.dispatch"):
             (fused, top_ids, top_lps, self.kv, self._counts,
-             mdrop) = self._jit_decode_multi(
-                    self.params, packed, self.kv,
-                    st_f32, st_i32, key, self._ensure_counts(),
+             mdrop, fin_tok, fin_pos) = self._jit_decode_multi(
+                    self.params, dev_tok, dev_pos, self._dev_active_pt,
+                    self.kv, st_f32, st_i32, key, self._ensure_counts(),
                     *self._ensure_bias())
         self._note_recompile("decode_multi", self._jit_decode_multi,
                              cache_before)
+        self.phase_counts["decode_multi.resident_hit"] += int(resident_hit)
         with self._phase("decode_multi.readback"):
             toks, logps = _split_tok_lp(np.asarray(fused))  # [N, B] each
             self._note_moe_dropped(mdrop)
@@ -955,6 +991,18 @@ class Engine:
                         and seq.req.mm_embeds is None:
                     self.prefix_cache.register_full_pages(
                         seq.tokens[:seq.num_computed], seq.pages)
+            # Keep the scan's final (tokens, positions) as device-resident
+            # state for the next burst. Every still-RUNNING sequence
+            # accepted the full N tokens (early finish leaves running), so
+            # its host tail now EQUALS the device carry — the snapshot
+            # below re-proves that at next dispatch; any host-side change
+            # in between (admit, preempt, import) makes it miss and fall
+            # back to a fresh upload.
+            self._resident = {
+                "tok": fin_tok, "pos": fin_pos,
+                "snap": tuple((s.req.request_id, s.slot, s.tokens[-1],
+                               len(s.tokens) - 1) for s in self.running),
+            }
         return outs
 
     def _top_entry(self, seq: Sequence, top_ids, top_lps,
@@ -1269,9 +1317,12 @@ class Engine:
                     self.params, packed, self.kv, st_f32, st_i32, key,
                     None, b_ids, b_vals)
             if self.ecfg.decode_steps > 1:
-                *_, self.kv, _, _ = self._jit_decode_multi(
-                    self.params, packed, self.kv, st_f32, st_i32, key,
-                    None, b_ids, b_vals)
+                tok0 = jnp.zeros((Bmax,), jnp.int32)
+                pos0 = jnp.zeros((Bmax,), jnp.int32)
+                apt0 = jnp.zeros((Bmax, 1 + mp), jnp.int32)
+                (_, _, _, self.kv, _, _, _, _) = self._jit_decode_multi(
+                    self.params, tok0, pos0, apt0, self.kv, st_f32,
+                    st_i32, key, None, b_ids, b_vals)
         jax.block_until_ready(jax.tree_util.tree_leaves(self.kv)[0])
         return time.monotonic() - t0
 
@@ -1408,16 +1459,24 @@ def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None,
             stats["moe_dropped"])
 
 
-def _decode_multi_step(params, packed, kv, st_f32, st_i32, key,
-                       counts=None, bias_ids=None, bias_vals=None, *,
-                       cfg: ModelConfig, n_steps: int, num_top: int = 0):
+def _decode_multi_step(params, tokens, positions, active_pt, kv, st_f32,
+                       st_i32, key, counts=None, bias_ids=None,
+                       bias_vals=None, *, cfg: ModelConfig, n_steps: int,
+                       num_top: int = 0):
     """``n_steps`` fused greedy/sampled decode iterations: the scan body is
     traced once, tokens feed forward on-device, and only the [N, B] token/
-    logprob blocks cross back to the host — one dispatch per N tokens."""
-    tokens = packed[:, 0]
-    positions = packed[:, 1]
-    active = packed[:, 2].astype(bool)
-    page_table = packed[:, _PACK_COLS:]
+    logprob blocks cross back to the host — one dispatch per N tokens.
+
+    ``tokens``/``positions`` are separate [B] arrays (not packed columns)
+    so consecutive bursts can feed the previous burst's RETURNED final
+    token/position arrays straight back in — device-resident decode state,
+    zero host uploads when batch membership is unchanged (the tunneled
+    host round-trip is ~80 ms, docs/PERF_NOTES.md). ``active_pt`` is
+    [B, 1+MP]: column 0 the active mask, the rest the page table — kept
+    as one buffer because both change on the same events (admit/finish/
+    page growth), detected host-side by an array compare."""
+    active = active_pt[:, 0].astype(bool)
+    page_table = active_pt[:, 1:]
     st = SamplingTensors.unpack(st_f32, st_i32)
 
     def body(carry, key_i):
@@ -1439,8 +1498,11 @@ def _decode_multi_step(params, packed, kv, st_f32, st_i32, key,
                 drop + stats["moe_dropped"]), (new_tok, lp, top_ids, top_lps)
 
     keys = jax.random.split(key, n_steps)
-    (_, _, kv, counts, moe_dropped), (toks, lps, top_ids, top_lps) = \
+    (fin_tok, fin_pos, kv, counts, moe_dropped), \
+        (toks, lps, top_ids, top_lps) = \
         jax.lax.scan(body, (tokens, positions, kv, counts,
                             jnp.zeros((), jnp.int32)), keys)
+    # Final carry token/position go back to the host AS HANDLES ONLY —
+    # next burst feeds them in again without a host→device upload.
     return (_fuse_tok_lp(toks, lps), top_ids, top_lps, kv, counts,
-            moe_dropped)
+            moe_dropped, fin_tok, fin_pos)
