@@ -31,9 +31,12 @@ def small_engine_cfg() -> EngineConfig:
                         prefill_buckets=(32, 64))
 
 
-def make_pd_cluster(store, decode_to_service=False, direct=False):
+def make_pd_cluster(store, decode_to_service=False, direct=False,
+                    device_wire=False):
     # direct=False forces the HTTP KV shuttle even though both workers
-    # share this process — the wire path must stay covered.
+    # share this process — the wire path must stay covered. device_wire
+    # turns on the PJRT transfer-server path over that wire (the
+    # cross-process device-to-device data plane, runtime/kv_wire.py).
     opts = ServiceOptions(
         http_port=0, rpc_port=0, num_output_pools=4,
         load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
@@ -47,7 +50,7 @@ def make_pd_cluster(store, decode_to_service=False, direct=False):
             port=0, instance_type=itype,
             service_addr=master.rpc_address, model="tiny",
             heartbeat_interval_s=0.2, lease_ttl_s=2.0,
-            pd_direct_kv=direct)
+            pd_direct_kv=direct, pd_device_wire=device_wire)
         workers.append(Worker(wopts, store,
                               engine_cfg=small_engine_cfg()).start())
     mgr = master.scheduler.instance_mgr
@@ -165,6 +168,91 @@ class TestPdDisaggregation:
                 w.stop()
             master2.stop()
             wire_store.close()
+
+    def test_device_wire_migration_matches_host_shuttle(self, store):
+        """Cross-process data plane (runtime/kv_wire.py): the KV block
+        moves via the PJRT transfer server (pull ticket in /kv/import,
+        no bytes on the HTTP body) and greedy output matches the raw
+        host shuttle token for token."""
+        body = {"model": "tiny", "prompt": "device wire migrate",
+                "max_tokens": 6, "temperature": 0.0, "ignore_eos": True}
+        master, workers = make_pd_cluster(store, device_wire=True)
+        prefill_w, decode_w = workers
+        try:
+            status, wire_resp = http_json(
+                "POST", master.http_address, "/v1/completions",
+                dict(body), timeout=120.0)
+            assert status == 200, wire_resp
+            assert wire_resp["usage"]["completion_tokens"] == 6
+            assert prefill_w.kv_migration_device_wire == 1
+            assert prefill_w.kv_migration_bytes > 0
+            assert decode_w.primary_runtime().engine.step_count > 0
+            # The staged block was released after the decode side's ack.
+            from xllm_service_tpu.runtime.kv_wire import get_device_wire
+            assert get_device_wire().staged_count() == 0
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
+        host_store = InMemoryStore(sweep_interval_s=0.02)
+        master2, workers2 = make_pd_cluster(host_store, device_wire=False)
+        try:
+            status, host_resp = http_json(
+                "POST", master2.http_address, "/v1/completions",
+                dict(body), timeout=120.0)
+            assert status == 200, host_resp
+            assert workers2[0].kv_migration_device_wire == 0
+            assert wire_resp["choices"][0]["text"] == \
+                host_resp["choices"][0]["text"]
+        finally:
+            for w in workers2:
+                w.stop()
+            master2.stop()
+            host_store.close()
+
+    @pytest.mark.parametrize("failure,blacklists", [
+        ("unsupported", True),    # peer backend can never pull
+        ("transient", False),     # one-off mid-pull error: retry later
+    ])
+    def test_device_wire_pull_failure_falls_back_to_host(
+            self, store, monkeypatch, failure, blacklists):
+        """A decode side that cannot pull (424) must not fail the
+        request: the prefill worker downgrades to the raw-bytes shuttle.
+        Only a capability refusal (wire-unsupported) blacklists the
+        peer; a transient pull error leaves it eligible."""
+        import xllm_service_tpu.runtime.kv_wire as kv_wire
+
+        def broken_pull(tr):
+            if failure == "unsupported":
+                raise kv_wire.WireUnsupported("backend cannot pull")
+            raise RuntimeError("tcp reset mid-pull (test)")
+
+        monkeypatch.setattr(kv_wire, "pull_block", broken_pull)
+        master, workers = make_pd_cluster(store, device_wire=True)
+        prefill_w, decode_w = workers
+        try:
+            status, resp = http_json(
+                "POST", master.http_address, "/v1/completions",
+                {"model": "tiny", "prompt": "wire down, shuttle up",
+                 "max_tokens": 5, "temperature": 0.0,
+                 "ignore_eos": True}, timeout=120.0)
+            assert status == 200, resp
+            assert resp["usage"]["completion_tokens"] == 5
+            assert prefill_w.kv_migration_device_wire == 0
+            assert prefill_w.kv_migration_bytes > 0   # host shuttle ran
+            assert (decode_w.name in prefill_w._wire_refused) \
+                == blacklists
+            wire = kv_wire.get_device_wire()
+            assert wire.staged_count() == 0
+            if failure == "unsupported":
+                # Ticket never reached a pull → the staged block was
+                # drained (self-pulled), not leaked.
+                assert wire.leaked == 0
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
 
     def test_kv_migration_probe(self):
         """The transport probe reports positive bandwidth for both paths

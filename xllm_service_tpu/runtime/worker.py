@@ -88,6 +88,10 @@ class WorkerOptions:
     # shuttle and moves KV device-to-device (off to force the wire path,
     # e.g. for testing it).
     pd_direct_kv: bool = True
+    # Cross-process device-to-device KV migration over the PJRT transfer
+    # server (runtime/kv_wire.py). Auto-degrades to the host shuttle on
+    # backends that can't serve transfers; off pins the host shuttle.
+    pd_device_wire: bool = True
     seed: int = 0
     murmur_seed: int = 0
 
@@ -449,6 +453,10 @@ class Worker:
         self.kv_migration_bytes = 0
         self.kv_migration_seconds = 0.0
         self.kv_migration_direct = 0    # device-to-device (no host copy)
+        self.kv_migration_device_wire = 0  # cross-process PJRT transfer
+        # Decode peers that proved unable to pull the device wire (424):
+        # stop offering and take the host shuttle straight away.
+        self._wire_refused: set = set()
         # Admission guards the ENTRY endpoints (/v1/* generate /
         # embeddings — the ones the service re-dispatches on 503).
         # Control verbs and mid-request continuation traffic are exempt:
@@ -1186,6 +1194,8 @@ class Worker:
                      f"{self.kv_migration_seconds:.6f}")
         lines.append(f"xllm_worker_kv_migration_direct_total "
                      f"{self.kv_migration_direct}")
+        lines.append(f"xllm_worker_kv_migration_device_wire_total "
+                     f"{self.kv_migration_device_wire}")
         if self.kv_migration_seconds > 0:
             lines.append(
                 f"xllm_worker_kv_migration_gbps "
@@ -1444,11 +1454,23 @@ class Worker:
         if peer is not None and peer is not self:
             return self._migrate_direct(live, rt, srid, peer)
 
+        wire = self._kv_wire_for(decode_name)
         with self._engine_lock:
-            exported = rt.engine.export_held(srid)
+            exported = rt.engine.export_held(srid, device=wire is not None)
         if exported is None:
             return Response.error(500, "prefill KV export failed")
         tokens, k, v = exported
+        if wire is not None:
+            resp = self._migrate_device_wire(live, decode_name, srid,
+                                             tokens, k, v, wire)
+            if resp is not None:
+                return resp
+            # Wire handshake failed or the peer can't pull — downgrade
+            # the exported device block to host bytes and take the
+            # shuttle below (the held entry is already released, so a
+            # re-export is not possible).
+            k = np.asarray(jax.device_get(k))
+            v = np.asarray(jax.device_get(v))
 
         t0 = time.monotonic()
         meta = {
@@ -1477,21 +1499,117 @@ class Worker:
             return self._local_decode_fallback(live, tokens, k, v)
         self.kv_migration_bytes += len(payload)
         self.kv_migration_seconds += time.monotonic() - t0
-        if head.startswith(b"{"):
-            # JSON (ack in decode-to-service mode, or an error) — fall back
-            # to local decode on failure so the request still completes.
-            try:
-                parsed = json.loads(head.decode("utf-8"))
-            except (ValueError, UnicodeDecodeError):
-                parsed = None
-            if parsed and parsed.get("status") == "accepted":
-                return Response.json(parsed)
-            logger.warning("kv import rejected by %s (%r); decoding "
-                           "locally", decode_name, head[:120])
-            return self._local_decode_fallback(live, tokens, k, v)
-        # Relay topology: decode streams raw RequestOutput SSE frames back
-        # on this same connection; re-assemble client-facing chunks here.
-        return self._relay_decode_stream(live, head, chunks)
+        return self._finish_migration(
+            live, decode_name, tokens, head, chunks,
+            self._parse_import_head(head), lambda: (k, v))
+
+    @staticmethod
+    def _parse_import_head(head: bytes) -> Optional[Dict[str, Any]]:
+        """The decode side's /kv/import answer: a dict when the head is
+        a JSON verdict ({} when unparseable), None when it is an SSE
+        stream to relay."""
+        if not head.startswith(b"{"):
+            return None
+        try:
+            return json.loads(head.decode("utf-8")) or {}
+        except (ValueError, UnicodeDecodeError):
+            return {}
+
+    def _finish_migration(self, live: "_LiveRequest", decode_name: str,
+                          tokens: List[int], head: bytes, chunks,
+                          parsed: Optional[Dict[str, Any]],
+                          to_host) -> Response:
+        """Shared tail of both /kv/import transports: act on the decode
+        side's verdict. ``to_host()`` materializes (k, v) as host arrays
+        when a refusal (no capacity / model asleep) means decoding
+        locally; a stream head relays the decode instance's SSE."""
+        if parsed is None:
+            # Relay topology: decode streams raw RequestOutput SSE
+            # frames back on this same connection; re-assemble
+            # client-facing chunks here.
+            return self._relay_decode_stream(live, head, chunks)
+        if parsed.get("status") == "accepted":
+            return Response.json(parsed)
+        logger.warning("kv import rejected by %s (%r); decoding "
+                       "locally", decode_name, head[:120])
+        k, v = to_host()
+        return self._local_decode_fallback(live, tokens, k, v)
+
+    def _kv_wire_for(self, decode_name: str):
+        """The process's PJRT device wire, or None when gated off, the
+        local backend failed its loopback probe, or this decode peer
+        already proved unable to pull (remembered 424)."""
+        if not self.opts.pd_device_wire \
+                or decode_name in self._wire_refused:
+            return None
+        from xllm_service_tpu.runtime.kv_wire import get_device_wire
+        return get_device_wire()
+
+    def _migrate_device_wire(self, live: "_LiveRequest", decode_name: str,
+                             srid: str, tokens: List[int], k, v,
+                             wire) -> Optional[Response]:
+        """PD migration over the PJRT transfer server: stage the exported
+        device block, hand the decode side a pull ticket inside the
+        ``/kv/import`` meta (no KV bytes on the HTTP body), and relay its
+        response. Returns None to tell the caller to retry over the host
+        shuttle — the staged block stays valid as device arrays."""
+        t0 = time.monotonic()
+        try:
+            uuid = wire.stage(k, v)
+        except Exception as e:  # noqa: BLE001 — wire broke post-probe
+            logger.warning("kv device-wire staging failed (%s)", e)
+            return None
+        meta = {
+            "service_request_id": srid,
+            "model": live.model,
+            "tokens": tokens,
+            "prompt_len": len(live.req.token_ids),
+            "sampling": live.sampling.to_json(),
+            "stream": live.stream,
+            "transfer": {"addr": wire.address, "uuid": uuid,
+                         "shape": list(k.shape), "dtype": str(k.dtype)},
+        }
+        from xllm_service_tpu.service.httpd import http_stream
+        head = b""
+        chunks = iter(())
+        try:
+            chunks = http_stream(
+                "POST", decode_name, "/kv/import",
+                raw=json.dumps(stamp(meta)).encode("utf-8") + b"\n",
+                timeout=self.opts.request_timeout_s)
+            head = next(chunks, b"")
+        except Exception as e:  # noqa: BLE001 — peer unreachable
+            logger.warning("kv device-wire handshake to %s failed (%s)",
+                           decode_name, e)
+            # Connection refused = the ticket never arrived, safe to
+            # drain; anything later (e.g. a read timeout) is ambiguous —
+            # the peer may be mid-pull, so the block stays pinned.
+            refused = isinstance(e, ConnectionRefusedError)
+            wire.release(uuid, drain=refused, leaked=not refused)
+            return None
+        parsed = self._parse_import_head(head)
+        err = (parsed or {}).get("error") or {}
+        if err.get("code") == 424:
+            msg = str(err.get("message", ""))
+            if msg.startswith("wire-unsupported:"):
+                # The peer's backend can never pull device transfers
+                # (e.g. tunneled TPU): remember and stop offering.
+                self._wire_refused.add(decode_name)
+                logger.info("decode %s cannot pull device wire; host "
+                            "shuttle from now on", decode_name)
+            wire.release(uuid, drain=not msg.startswith("wire-pull:"),
+                         leaked=msg.startswith("wire-pull:"))
+            return None
+        # Any other verdict means the peer's pull completed (it pulls
+        # before adopting): the staged block was consumed.
+        wire.release(uuid)
+        self.kv_migration_bytes += 2 * int(k.nbytes)
+        self.kv_migration_seconds += time.monotonic() - t0
+        self.kv_migration_device_wire += 1
+        return self._finish_migration(
+            live, decode_name, tokens, head, chunks, parsed,
+            lambda: (np.asarray(jax.device_get(k)),
+                     np.asarray(jax.device_get(v))))
 
     def _migrate_direct(self, live: "_LiveRequest", rt: ModelRuntime,
                         srid: str, peer: "Worker") -> Response:
@@ -1731,6 +1849,13 @@ class Worker:
         live.choices[0].completion_tokens = 1   # migrated first token
 
         with self._live_lock:
+            if srid in self._live_srid:
+                # A transport ambiguity (e.g. prefill-side timeout, then
+                # host-shuttle retry) must not adopt the same sequence
+                # twice — two running slots would stream duplicate
+                # outputs for one request.
+                logger.warning("duplicate kv import for %s refused", srid)
+                return False, None, None, rt
             self._live[srid] = live
             self._live_srid[srid] = live
         first_out = RequestOutput(
@@ -1765,17 +1890,35 @@ class Worker:
         except (ValueError, UnicodeDecodeError) as e:
             return Response.error(400, f"bad meta: {e}")
         check_version(meta, "kv_import")
-        import ml_dtypes
-        dtype = (ml_dtypes.bfloat16 if meta["dtype"] == "bfloat16"
-                 else np.dtype(meta["dtype"]))
-        shape = tuple(meta["shape"])
-        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
-        blob = req.body[nl + 1:]
-        if len(blob) != 2 * nbytes:
-            return Response.error(400, f"payload size mismatch: "
-                                       f"{len(blob)} != {2 * nbytes}")
-        k = np.frombuffer(blob[:nbytes], dtype=dtype).reshape(shape)
-        v = np.frombuffer(blob[nbytes:], dtype=dtype).reshape(shape)
+        tr = meta.get("transfer")
+        if tr is not None:
+            # Device wire: the body carries a pull ticket, not bytes —
+            # fetch the staged block device-to-device from the prefill
+            # worker's transfer server. A 424 tells the prefill side to
+            # fall back to the raw-bytes shuttle; its message prefix
+            # says what to do with the staged block (see kv_wire docs).
+            from xllm_service_tpu.runtime.kv_wire import (
+                WireNoPull, WireUnsupported, pull_block)
+            try:
+                k, v = pull_block(tr)
+            except WireUnsupported as e:
+                return Response.error(424, f"wire-unsupported: {e}")
+            except WireNoPull as e:
+                return Response.error(424, f"wire-nopull: {e}")
+            except Exception as e:  # noqa: BLE001 — failed mid-pull
+                return Response.error(424, f"wire-pull: {e}")
+        else:
+            import ml_dtypes
+            dtype = (ml_dtypes.bfloat16 if meta["dtype"] == "bfloat16"
+                     else np.dtype(meta["dtype"]))
+            shape = tuple(meta["shape"])
+            nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            blob = req.body[nl + 1:]
+            if len(blob) != 2 * nbytes:
+                return Response.error(400, f"payload size mismatch: "
+                                           f"{len(blob)} != {2 * nbytes}")
+            k = np.frombuffer(blob[:nbytes], dtype=dtype).reshape(shape)
+            v = np.frombuffer(blob[nbytes:], dtype=dtype).reshape(shape)
 
         ok, live, first_out, rt = self.adopt_migrated(meta, k, v)
         if rt is None:
