@@ -151,9 +151,9 @@ def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
         o_ref[0] = (acc_fin / denom).astype(o_ref.dtype)
 
 
-def _row_kernel(ctx_ref, pt_ref, q_ref, k_hbm, v_hbm, kc_ref, vc_ref,
+def _row_kernel(ctx_ref, pt_ref, qw_ref, k_hbm, v_hbm, kc_ref, vc_ref,
                 o_ref, k_buf, v_buf, sems, *, page_size: int,
-                num_kv_heads: int, has_current: bool):
+                has_current: bool):
     """One grid cell = one batch row's whole page walk.
 
     K/V pools stay in HBM (memory_space=HBM, no automatic pipeline);
@@ -161,16 +161,24 @@ def _row_kernel(ctx_ref, pt_ref, q_ref, k_hbm, v_hbm, kc_ref, vc_ref,
     page p folds into the online-softmax accumulator. The loop runs
     ceil(ctx/ps) iterations — a short sequence in a wide table does not
     visit dead pages. Accumulators are fori_loop carries (f32 values,
-    not scratch refs)."""
+    not scratch refs).
+
+    GQA is expressed BLOCK-DIAGONALLY: the caller pre-expands the query
+    to ``q_wide [Hq, Hkv*D]`` (zeros outside each row's own kv-head
+    slice) and the pools arrive flattened to ``[P, ps, Hkv*D]``, so both
+    dots are plain 2D matmuls and the output is ``o_wide [Hq, Hkv*D]``
+    (each row's result lives in its kv-head's lane slice, selected
+    outside). This wastes Hkv× MXU flops on zero blocks — irrelevant
+    next to decode's weight reads — and is what v5e Mosaic actually
+    lowers: per-head shapes need D=64-aligned HBM slices ("must be
+    aligned to tiling (128)") or vector reshapes like (ps, 512)->(ps,
+    8, 64) ("Not Implemented: tpu.reshape"), both of which fail."""
     b = pl.program_id(0)
     ctx = ctx_ref[b]
     npages = (ctx + page_size - 1) // page_size
 
-    hq, d = q_ref.shape[1], q_ref.shape[2]
-    g = hq // num_kv_heads
-    q = q_ref[0].astype(jnp.float32)                         # [Hq, D]
-    qg = q.reshape(num_kv_heads, g, d)                       # [Hkv, G, D]
-    scale = 1.0 / (d ** 0.5)
+    hq, w = qw_ref.shape[1], qw_ref.shape[2]
+    qw = qw_ref[0].astype(jnp.float32)                       # [Hq, W]
 
     def k_dma(slot, p):
         return pltpu.make_async_copy(k_hbm.at[pt_ref[b, p]],
@@ -197,14 +205,13 @@ def _row_kernel(ctx_ref, pt_ref, q_ref, k_hbm, v_hbm, kc_ref, vc_ref,
 
         k_dma(slot, p).wait()
         v_dma(slot, p).wait()
-        k = k_buf[slot].astype(jnp.float32)                  # [ps, Hkv, D]
+        k = k_buf[slot].astype(jnp.float32)                  # [ps, W]
         v = v_buf[slot].astype(jnp.float32)
-        # Contract in native [ps, Hkv, D] layout (transpose-free fold):
-        # [Hkv, G, D] x [ps, Hkv, D] -> [Hkv, G, ps]
+        # [Hq, W] x [ps, W] -> [Hq, ps]; block-diagonal zeros in qw keep
+        # each query head inside its own kv head's D-slice.
         logits = jax.lax.dot_general(
-            qg, k, (((2,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32) * scale
-        logits = logits.reshape(hq, page_size)
+            qw, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         pos = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
         mask = pos < ctx
@@ -214,33 +221,33 @@ def _row_kernel(ctx_ref, pt_ref, q_ref, k_hbm, v_hbm, kc_ref, vc_ref,
         prob = jnp.where(mask, jnp.exp(logits - m_new), 0.0)  # [Hq, ps]
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(prob, axis=-1, keepdims=True)
-        # [Hkv, G, ps] x [ps, Hkv, D] -> [Hkv, G, D]
+        # [Hq, ps] x [ps, W] -> [Hq, W]; row hq's useful lanes are its
+        # kv head's slice, the rest carry other heads' values and are
+        # dropped by the caller's diagonal selection.
         pv = jax.lax.dot_general(
-            prob.reshape(num_kv_heads, g, page_size), v,
-            (((2,), (0,)), ((0,), (1,))),
+            prob, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc * corr + pv.reshape(hq, d)
+        return m_new, l_new, acc * corr + pv
 
     m0 = jnp.full((hq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((hq, 1), jnp.float32)
-    acc0 = jnp.zeros((hq, d), jnp.float32)
+    acc0 = jnp.zeros((hq, w), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, npages, fold, (m0, l0, acc0))
 
     if has_current:
         # The current token's K/V (in-registers, not yet in the pool) as
         # a final always-valid single-position block.
-        kc = kc_ref[0].astype(jnp.float32)                   # [Hkv, D]
+        kc = kc_ref[0].astype(jnp.float32)                   # [1, W]
         vc = vc_ref[0].astype(jnp.float32)
-        lc = jnp.sum(qg * kc[:, None, :], axis=-1) * scale   # [Hkv, G]
-        lc = lc.reshape(hq, 1)
+        lc = jax.lax.dot_general(
+            qw, kc, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [Hq, 1]
         m_new = jnp.maximum(m, lc)
         corr = jnp.exp(m - m_new)
         pc = jnp.exp(lc - m_new)
         l = l * corr + pc
-        vc_full = jnp.broadcast_to(
-            vc[:, None, :], (num_kv_heads, g, d)).reshape(hq, d)
-        acc = acc * corr + pc * vc_full
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        acc = acc * corr + pc * vc
+    o_ref[0] = acc / jnp.maximum(l, 1e-30)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -253,37 +260,56 @@ def _paged_decode_attention_row_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
                                      interpret: bool = False) -> jnp.ndarray:
     B, Hq, D = q.shape
     _, page_size, Hkv, _ = k_pages.shape
+    G = Hq // Hkv
+    W = Hkv * D
     has_current = k_cur is not None
     if not has_current:
         k_cur = jnp.zeros((B, Hkv, D), q.dtype)
         v_cur = jnp.zeros((B, Hkv, D), q.dtype)
 
+    # Pre-scale ONCE here instead of scaling page logits in the kernel.
+    scale = 1.0 / (D ** 0.5)
+    eye = jnp.eye(Hkv, dtype=q.dtype)                        # [Hkv, Hkv]
+    # q [B, Hkv, G, D] -> block-diagonal q_wide [B, Hq, Hkv*D].
+    q_wide = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    q_wide = (q_wide.reshape(B, Hkv, G, 1, D)
+              * eye[:, None, :, None]).reshape(B, Hq, W)
+    k_flat = k_pages.reshape(-1, page_size, W)
+    v_flat = v_pages.reshape(-1, page_size, W)
+    kc_flat = k_cur.reshape(B, 1, W)
+    vc_flat = v_cur.reshape(B, 1, W)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,               # context_lens, page_table
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, Hq, D), lambda b, ctx, pt: (b, 0, 0)),
+            pl.BlockSpec((1, Hq, W), lambda b, ctx, pt: (b, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.HBM),    # whole K pool
             pl.BlockSpec(memory_space=pltpu.HBM),    # whole V pool
-            pl.BlockSpec((1, Hkv, D), lambda b, ctx, pt: (b, 0, 0)),
-            pl.BlockSpec((1, Hkv, D), lambda b, ctx, pt: (b, 0, 0)),
+            pl.BlockSpec((1, 1, W), lambda b, ctx, pt: (b, 0, 0)),
+            pl.BlockSpec((1, 1, W), lambda b, ctx, pt: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, Hq, D), lambda b, ctx, pt: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, Hq, W), lambda b, ctx, pt: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, page_size, Hkv, D), k_pages.dtype),
-            pltpu.VMEM((2, page_size, Hkv, D), v_pages.dtype),
+            pltpu.VMEM((2, page_size, W), k_pages.dtype),
+            pltpu.VMEM((2, page_size, W), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
-    return pl.pallas_call(
+    o_wide = pl.pallas_call(
         functools.partial(_row_kernel, page_size=page_size,
-                          num_kv_heads=Hkv, has_current=has_current),
-        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+                          has_current=has_current),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, W), jnp.float32),
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
-    )(context_lens, page_table, q, k_pages, v_pages, k_cur, v_cur)
+    )(context_lens, page_table, q_wide, k_flat, v_flat, kc_flat, vc_flat)
+    # Diagonal selection: row hq keeps its own kv head's D-slice.
+    o = jnp.einsum("bhgkd,hk->bhgd",
+                   o_wide.reshape(B, Hkv, G, Hkv, D),
+                   eye.astype(jnp.float32))
+    return o.reshape(B, Hq, D).astype(q.dtype)
 
 
 def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,

@@ -390,6 +390,16 @@ class Engine:
         if seq.req.mm_embeds is None and not seq.req.prompt_logprobs:
             cached_pages, cached_tokens = \
                 self.prefix_cache.match_prefix(seq.req.token_ids)
+            if cached_tokens and self._ring_preferred(seq, cached_tokens):
+                # A cached prefix forces the chunked-window path (ring
+                # global positions start at 0). For a ring-eligible long
+                # prompt it is cheaper to recompute the prefix inside
+                # the one sp-sharded step than to walk (len - cached)
+                # tokens of sequential windows — forgo the hit then.
+                # This is also the readmission path of a preempted long
+                # prompt, whose own pages re-match as a prefix.
+                self.prefix_cache.release_pages(cached_pages)
+                cached_pages, cached_tokens = [], 0
         else:
             # Multimodal KV depends on image content, not just token ids
             # (placeholder spans are identical across images) — such
@@ -448,6 +458,15 @@ class Engine:
                 and len(seq.tokens) > self.ecfg.prefill_buckets[-1]
                 and len(seq.tokens) <=
                 self.ecfg.prefill_buckets[-1] * self._sp)
+
+    def _ring_preferred(self, seq: Sequence, cached_tokens: int) -> bool:
+        """Forgoing a cached prefix to ring the whole prompt wins when
+        the ring step's per-device work (len/sp) is smaller than the
+        chunked path's remaining sequential work (len - cached), i.e.
+        while the prefix covers less than (1 - 1/sp) of the prompt."""
+        n = len(seq.tokens)
+        return (self._ring_eligible(seq, 0)
+                and n / max(self._sp, 1) < n - cached_tokens)
 
     def _preempt_seq(self, seq: Sequence) -> None:
         """Recompute-style preemption: free pages, requeue (generated
