@@ -55,6 +55,156 @@ def _scan_slope(build_fn, n_lo: int, n_hi: int) -> float:
     return 1e3 * (times[n_hi] - times[n_lo]) / (n_hi - n_lo)
 
 
+def _prefill_budget(args, rng) -> dict:
+    """Decompose one prefill call at the headline bench shape (B=32
+    prompts x T=128 tokens; llama3-1b geometry): the full jitted program
+    vs its parts — per-layer attention (XLA gather+overlay vs the gated
+    Pallas kernel), the post-scan all-layer scatter, and a pure matmul
+    tower as the MXU reference. Whatever the parts don't explain is
+    glue (rope, norms, ys stacking, lm_head tail)."""
+    from xllm_service_tpu.config import EngineConfig, ModelConfig
+    from xllm_service_tpu.models import transformer
+    from xllm_service_tpu.ops import attention as att
+    from xllm_service_tpu.ops import pallas as pallas_mod
+    from xllm_service_tpu.ops.pallas.prefill_attention import _impl \
+        as prefill_kernel_impl
+    from xllm_service_tpu.runtime.engine import Engine
+
+    import dataclasses as dc
+    if args.small:
+        cfg = dc.replace(ModelConfig.tiny(), dtype="float32")
+        ecfg = EngineConfig(page_size=8, num_pages=64, max_model_len=64,
+                            max_batch_size=4, max_prefill_tokens=64,
+                            prefill_buckets=(16,))
+        B, T = 2, 16
+    else:
+        cfg = ModelConfig.llama3_1b()
+        ecfg = EngineConfig(page_size=64, num_pages=1024,
+                            max_model_len=2048, max_batch_size=64,
+                            max_prefill_tokens=4096,
+                            prefill_buckets=(128,))
+        B, T = 32, 128
+    eng = Engine(cfg, ecfg, seed=0)
+    params, kv0 = eng.params, eng.kv
+    ps = ecfg.page_size
+    P = ecfg.num_pages
+    L, Hq, Hkv = cfg.num_layers, cfg.num_heads, cfg.num_kv_heads
+    D = cfg.head_dim
+    need = -(-(T + 1) // ps)
+    MP = 1 << max(need - 1, 0).bit_length()
+    pt = np.zeros((B, MP), np.int32)
+    for b in range(B):
+        pt[b, :need] = 1 + ((np.arange(need) + b * need) % (P - 1))
+    pt = jnp.asarray(pt)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(B, T)), jnp.int32)
+    start = jnp.zeros((B,), jnp.int32)
+    lens = jnp.full((B,), T, jnp.int32)
+    dt = jnp.dtype(cfg.dtype)
+    out = {"shape": {"B": B, "T": T, "table_width": MP}}
+
+    def full_build(n):
+        @jax.jit
+        def run():
+            def body(kv, _):
+                last, _, kv2 = transformer.forward_prefill(
+                    params, cfg, tokens, start, lens, kv, pt)
+                return kv2, last[0, 0]
+            kv_fin, lasts = jax.lax.scan(body, kv0, None, length=n)
+            return lasts[-1] + kv_fin[0][0, 1, 0, 0, 0].astype(jnp.float32)
+        return run
+
+    out["full_step_ms"] = round(
+        _scan_slope(full_build, 1, max(args.n_lo, 3)), 2)
+
+    # One layer's attention, both paths, q/k/v random at layer shapes.
+    q = jnp.asarray(rng.normal(size=(B, T, Hq, D)), dt)
+    kf = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), dt)
+    vf = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), dt)
+    kp, vp = kv0[0][0], kv0[1][0]
+    kv_lens = start + lens
+
+    def gather_attn(qi):
+        k_all = att.overlay_fresh_kv(att.gather_pages(kp, pt), kf, start)
+        v_all = att.overlay_fresh_kv(att.gather_pages(vp, pt), vf, start)
+        return att.mha_prefill_auto(qi, k_all, v_all, kv_lens, start)
+
+    def kernel_attn(qi):
+        return prefill_kernel_impl(
+            qi, kf, vf, kp, vp, pt, start, lens, q_block=128,
+            interpret=pallas_mod.default_interpret())
+
+    for name, fn in (("attn_xla_gather", gather_attn),
+                     ("attn_pallas_kernel", kernel_attn)):
+        def build(n, fn=fn):
+            @jax.jit
+            def run():
+                def body(qi, _):
+                    return fn(qi).astype(qi.dtype), ()
+                q_fin, _ = jax.lax.scan(body, q, None, length=n)
+                return q_fin[0, 0, 0]
+            return run
+        try:
+            out[name + "_layer_ms"] = round(
+                _scan_slope(build, args.n_lo, args.n_hi), 3)
+        except Exception as exc:  # noqa: BLE001
+            out[name + "_layer_ms"] = \
+                f"error: {type(exc).__name__}: {exc}"
+
+    # Post-scan all-layer scatter of the fresh ys.
+    k_new = jnp.asarray(rng.normal(size=(L, B, T, Hkv, D)), dt)
+    v_new = jnp.asarray(rng.normal(size=(L, B, T, Hkv, D)), dt)
+
+    def scat_build(n):
+        @jax.jit
+        def run():
+            def body(kv, _):
+                return att.write_prefill_kv_all_layers(
+                    kv[0], kv[1], k_new, v_new, pt, start, lens), ()
+            kv_fin, _ = jax.lax.scan(body, kv0, None, length=n)
+            return kv_fin[0][0, 1, 0, 0, 0]
+        return run
+
+    out["kv_scatter_ms"] = round(
+        _scan_slope(scat_build, args.n_lo, args.n_hi), 3)
+
+    # MXU reference: the layer's matmul tower (qkv + o + mlp) x L, no
+    # attention math — what the step would cost if matmul-bound.
+    H = cfg.hidden_size
+    x0 = jnp.asarray(rng.normal(size=(B, T, H)), dt)
+    wq = jnp.asarray(rng.normal(size=(H, Hq * D)), dt)
+    wkv = jnp.asarray(rng.normal(size=(H, 2 * Hkv * D)), dt)
+    wo = jnp.asarray(rng.normal(size=(Hq * D, H)), dt)
+    w1 = jnp.asarray(rng.normal(size=(H, 2 * cfg.intermediate_size)), dt)
+    w2 = jnp.asarray(rng.normal(size=(cfg.intermediate_size, H)), dt)
+
+    def tower_build(n):
+        @jax.jit
+        def run():
+            def body(x, _):
+                def layer(xc, _):
+                    a = xc @ wq
+                    kvp = xc @ wkv
+                    # kvp consumed cheaply so the kv projections aren't
+                    # dead-code-eliminated out of the tower.
+                    xc = xc + a @ wo \
+                        + (kvp.sum(-1, keepdims=True) * 1e-9).astype(
+                            xc.dtype)
+                    u = xc @ w1
+                    g = jax.nn.silu(u[..., :cfg.intermediate_size]) \
+                        * u[..., cfg.intermediate_size:]
+                    return (xc + g @ w2).astype(x.dtype), ()
+                x2, _ = jax.lax.scan(layer, x, None, length=L)
+                return x2, ()
+            x_fin, _ = jax.lax.scan(body, x0, None, length=n)
+            return x_fin[0, 0, 0]
+        return run
+
+    out["matmul_tower_ms"] = round(
+        _scan_slope(tower_build, args.n_lo, args.n_hi), 3)
+    return out
+
+
 def main() -> None:
     import os
     if os.environ.get("JAX_PLATFORMS"):
@@ -74,6 +224,12 @@ def main() -> None:
     ap.add_argument("--n-hi", type=int, default=16)
     ap.add_argument("--small", action="store_true",
                     help="tiny shapes for harness tests off-hardware")
+    ap.add_argument("--prefill", action="store_true",
+                    help="also decompose the prefill step (round-3: "
+                         "prefill MFU measured ~0.007 on the chip — "
+                         "find out where the seconds go)")
+    ap.add_argument("--no-decode", action="store_true",
+                    help="skip the decode components (prefill-only run)")
     args = ap.parse_args()
 
     from xllm_service_tpu.ops import attention as att
@@ -141,6 +297,8 @@ def main() -> None:
               "platform": jax.devices()[0].platform,
               "note": "ms per single layer-call (multiply by layers for "
                       "per-step attention cost); scan-slope timing"}
+    if args.no_decode:
+        variants = {}
     for name, fn in variants.items():
         try:
             detail[name + "_ms"] = round(
@@ -172,8 +330,9 @@ def main() -> None:
             return kp2[0, 1, 0, 0, 0]
         return run
 
-    detail["kv_scatter_all_layers_ms"] = round(
-        _scan_slope(scatter_build, args.n_lo, args.n_hi), 4)
+    if not args.no_decode:
+        detail["kv_scatter_all_layers_ms"] = round(
+            _scan_slope(scatter_build, args.n_lo, args.n_hi), 4)
 
     # lm_head + greedy argmax tail.
     h0 = jnp.asarray(rng.normal(size=(B, D * Hq)), dt)
@@ -191,8 +350,12 @@ def main() -> None:
             return h_fin[0, 0]
         return run
 
-    detail["lm_head_greedy_ms"] = round(
-        _scan_slope(head_build, args.n_lo, args.n_hi), 4)
+    if not args.no_decode:
+        detail["lm_head_greedy_ms"] = round(
+            _scan_slope(head_build, args.n_lo, args.n_hi), 4)
+
+    if args.prefill:
+        detail["prefill"] = _prefill_budget(args, rng)
 
     # Weight-read floor for context: params bytes / HBM bandwidth.
     params_b = 1.24e9 * 2 if not args.small else 0
