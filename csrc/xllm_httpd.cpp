@@ -387,15 +387,21 @@ void apply_op(Server* s, Op& op) {
             break;
         }
         case OpKind::StreamAbort: {
-            // Producer failed mid-stream: close WITHOUT the terminal
-            // 0-chunk so the client's chunked decoder sees a truncated
-            // (failed) response — a clean terminator would make it
-            // silently accept a partial answer as complete.
+            // Producer failed mid-stream: drain whatever was already
+            // queued (the status line + first chunks may still sit in
+            // wq — the abort often lands in the SAME op batch as
+            // StreamBegin when the producer dies on its first pull),
+            // then close WITHOUT the terminal 0-chunk so the client's
+            // chunked decoder sees a truncated (failed) response. A
+            // clean terminator would make a partial answer look
+            // complete; clearing the queue (the old behavior) turned a
+            // visible truncation into an empty reply with no status
+            // line at all.
             if (!c->streaming) break;
-            c->wq.clear();
-            c->wq_bytes = 0;
+            c->close_after = true;
             finish_response(s, slot, c);
-            close_conn(s, slot);
+            if (c->wq.empty()) close_conn(s, slot);
+            else arm_write(s, slot, c);
             break;
         }
     }
