@@ -222,7 +222,14 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
         # tunneled backend, so fewer+bigger calls is both the honest
         # serving configuration and the faster one. Override to A/B:
         # BENCH_PREFILL_TOKENS=4096 restores the two-call split.
-        ecfg = EngineConfig(page_size=64, num_pages=1024,
+        # page_size 128 = the reference's own block-size default
+        # (global_gflags.cpp:87-89) and HALVES the decode-attention
+        # pallas grid (B x pages x layers cells/step) vs 64 — per-cell
+        # overhead is a first-order term at B=64. Same pool bytes.
+        ecfg = EngineConfig(page_size=int(os.environ.get(
+                                "BENCH_PAGE_SIZE", "128")),
+                            num_pages=int(os.environ.get(
+                                "BENCH_NUM_PAGES", "512")),
                             max_model_len=1024, max_batch_size=batch,
                             max_prefill_tokens=int(os.environ.get(
                                 "BENCH_PREFILL_TOKENS", "8192")),
