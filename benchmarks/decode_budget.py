@@ -234,7 +234,8 @@ def main() -> None:
 
     from xllm_service_tpu.ops import attention as att
     from xllm_service_tpu.ops.pallas.paged_attention import (
-        _paged_decode_attention_impl, _paged_decode_attention_row_impl)
+        _paged_decode_attention_impl, _paged_decode_attention_mr_impl,
+        _paged_decode_attention_row_impl)
     from xllm_service_tpu.ops import pallas as pallas_mod
 
     if args.small:
@@ -289,6 +290,12 @@ def main() -> None:
             transpose_free=True),
         "attn_pallas_row_v3": functools.partial(
             _paged_decode_attention_row_impl, interpret=interpret),
+        "attn_pallas_multirow_v4x8": functools.partial(
+            _paged_decode_attention_mr_impl, rows=8,
+            interpret=interpret),
+        "attn_pallas_multirow_v4x16": functools.partial(
+            _paged_decode_attention_mr_impl, rows=16,
+            interpret=interpret),
     }
 
     detail = {"shape": {"B": B, "Hq": Hq, "Hkv": Hkv, "D": D,

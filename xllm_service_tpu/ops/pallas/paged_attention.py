@@ -312,6 +312,169 @@ def _paged_decode_attention_row_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
     return o.reshape(B, Hq, D).astype(q.dtype)
 
 
+def _multirow_default() -> int:
+    """Rows per grid cell for the multi-row kernel (0 = off). The
+    (B, pages) kernel's cost at decode is dominated by CELL COUNT
+    (B x MP x layers tiny invocations per step — ~8k at the bench
+    shape), not attention FLOPs; V3 cut cells to B but serialized the
+    page walk behind manual DMAs and lost. V4 keeps the AUTOMATIC
+    BlockSpec pipeline (the only page-fetch form Mosaic accepts for
+    D=64 pools — manual DMA needs 128-lane-aligned slices) and simply
+    processes XLLM_PALLAS_DECODE_V4 rows per cell: the pool is passed
+    that many times with per-row page-table index maps, so the pipeline
+    still overlaps all fetches while the cell count drops RB-fold."""
+    try:
+        return int(os.environ.get("XLLM_PALLAS_DECODE_V4", "0"))
+    except ValueError:
+        return 0
+
+
+def _mr_kernel(ctx_ref, pt_ref, q_ref, *refs, page_size: int,
+               num_kv_heads: int, rows: int, pages_per_seq: int,
+               has_current: bool):
+    k_refs = refs[:rows]
+    v_refs = refs[rows:2 * rows]
+    kc_ref, vc_ref, o_ref, m_ref, l_ref, acc_ref = refs[2 * rows:]
+    i = pl.program_id(0)
+    p = pl.program_id(1)
+    hq, d = q_ref.shape[1], q_ref.shape[2]
+    g = hq // num_kv_heads
+    row0 = i * rows
+    ctxs = jnp.stack([ctx_ref[row0 + r] for r in range(rows)])   # [RB]
+    scale = 1.0 / (d ** 0.5)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    page_start = p * page_size
+
+    @pl.when(page_start < jnp.max(ctxs))
+    def _fold():
+        q = q_ref[...].astype(jnp.float32)                # [RB, Hq, D]
+        qg = q.reshape(rows * num_kv_heads, g, d)
+        k = jnp.concatenate([r[...] for r in k_refs], 0)  # [RB, ps, Hkv, D]
+        v = jnp.concatenate([r[...] for r in v_refs], 0)
+        kt = jnp.transpose(k.astype(jnp.float32), (0, 2, 1, 3)) \
+            .reshape(rows * num_kv_heads, page_size, d)
+        vt = jnp.transpose(v.astype(jnp.float32), (0, 2, 1, 3)) \
+            .reshape(rows * num_kv_heads, page_size, d)
+        # [RB*Hkv, G, D] x [RB*Hkv, ps, D] -> [RB*Hkv, G, ps]; batch dim
+        # at index 0 on both sides (the only form v5e Mosaic lowers).
+        logits = jax.lax.dot_general(
+            qg, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        logits = logits.reshape(rows, hq, page_size)
+        pos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page_size), 2)
+        mask = pos < ctxs[:, None, None]                  # [RB, 1, ps]
+        logits = jnp.where(mask, logits, _NEG_INF)
+        m_prev = m_ref[...]                               # [RB, Hq, 1]
+        blk_max = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, blk_max)
+        prob = jnp.exp(logits - m_new)
+        prob = jnp.where(mask, prob, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(prob, axis=-1,
+                                                 keepdims=True)
+        pv = jax.lax.dot_general(
+            prob.reshape(rows * num_kv_heads, g, page_size), vt,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * corr \
+            + pv.reshape(rows, hq, d)
+        m_ref[...] = m_new
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finalize():
+        m_fin = m_ref[...]
+        l_fin = l_ref[...]
+        acc_fin = acc_ref[...]
+        if has_current:
+            q = q_ref[...].astype(jnp.float32)
+            qg4 = q.reshape(rows, num_kv_heads, g, d)
+            kc = kc_ref[...].astype(jnp.float32)          # [RB, Hkv, D]
+            vc = vc_ref[...].astype(jnp.float32)
+            lc = jnp.sum(qg4 * kc[:, :, None, :], -1) * scale
+            lc = lc.reshape(rows, hq, 1)
+            m_new = jnp.maximum(m_fin, lc)
+            corr = jnp.exp(m_fin - m_new)
+            pc = jnp.exp(lc - m_new)
+            l_fin = l_fin * corr + pc
+            vc_full = jnp.broadcast_to(
+                vc[:, :, None, :],
+                (rows, num_kv_heads, g, d)).reshape(rows, hq, d)
+            acc_fin = acc_fin * corr + pc * vc_full
+        denom = jnp.maximum(l_fin, 1e-30)
+        o_ref[...] = (acc_fin / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("rows", "interpret"))
+def _paged_decode_attention_mr_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
+                                    v_pages: jnp.ndarray,
+                                    page_table: jnp.ndarray,
+                                    context_lens: jnp.ndarray,
+                                    k_cur: jnp.ndarray = None,
+                                    v_cur: jnp.ndarray = None,
+                                    rows: int = 8,
+                                    interpret: bool = False
+                                    ) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    _, page_size, Hkv, _ = k_pages.shape
+    MP = page_table.shape[1]
+    has_current = k_cur is not None
+    if not has_current:
+        k_cur = jnp.zeros((B, Hkv, D), q.dtype)
+        v_cur = jnp.zeros((B, Hkv, D), q.dtype)
+    RB = max(1, min(rows, B))
+    pad = (-B) % RB
+    if pad:
+        q = jnp.pad(q, ((0, pad), (0, 0), (0, 0)))
+        k_cur = jnp.pad(k_cur, ((0, pad), (0, 0), (0, 0)))
+        v_cur = jnp.pad(v_cur, ((0, pad), (0, 0), (0, 0)))
+        page_table = jnp.pad(page_table, ((0, pad), (0, 0)))
+        context_lens = jnp.pad(context_lens, (0, pad))
+    Bp = B + pad
+
+    def k_idx(r):
+        return lambda i, p, ctx, pt: (pt[i * RB + r, p], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,               # context_lens, page_table
+        grid=(Bp // RB, MP),
+        in_specs=[
+            pl.BlockSpec((RB, Hq, D), lambda i, p, ctx, pt: (i, 0, 0)),
+            *[pl.BlockSpec((1, page_size, Hkv, D), k_idx(r))
+              for r in range(RB)],
+            *[pl.BlockSpec((1, page_size, Hkv, D), k_idx(r))
+              for r in range(RB)],
+            pl.BlockSpec((RB, Hkv, D), lambda i, p, ctx, pt: (i, 0, 0)),
+            pl.BlockSpec((RB, Hkv, D), lambda i, p, ctx, pt: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((RB, Hq, D),
+                               lambda i, p, ctx, pt: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((RB, Hq, 1), jnp.float32),
+            pltpu.VMEM((RB, Hq, 1), jnp.float32),
+            pltpu.VMEM((RB, Hq, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_mr_kernel, page_size=page_size,
+                          num_kv_heads=Hkv, rows=RB, pages_per_seq=MP,
+                          has_current=has_current),
+        out_shape=jax.ShapeDtypeStruct((Bp, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(context_lens, page_table, q,
+      *([k_pages] * RB), *([v_pages] * RB), k_cur, v_cur)
+    return out[:B]
+
+
 def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
                                   v_pages: jnp.ndarray,
                                   page_table: jnp.ndarray,
@@ -337,6 +500,11 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
     if interpret is None:
         from xllm_service_tpu.ops import pallas
         interpret = pallas.default_interpret()
+    mr = _multirow_default()
+    if mr > 1:
+        return _paged_decode_attention_mr_impl(
+            q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur,
+            rows=mr, interpret=interpret)
     if _row_kernel_default():
         return _paged_decode_attention_row_impl(
             q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur,
