@@ -426,3 +426,41 @@ class TestEngineDecodeRowKernelPath:
         assert set(xla) == set(pallas)
         for rid in xla:
             assert xla[rid] == pallas[rid], rid
+
+    def test_generations_identical_multirow(self, monkeypatch):
+        """Same engine-level equivalence for the multi-row kernel
+        (XLLM_PALLAS_DECODE_V4=3 → row groups of 3 over a 4-slot
+        batch, exercising the pad path inside serving)."""
+        from xllm_service_tpu.config import EngineConfig, ModelConfig
+        from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+        from xllm_service_tpu.utils.types import SamplingParams
+
+        cfg = ModelConfig.tiny(vocab_size=256)
+        ecfg = EngineConfig(page_size=16, num_pages=64, max_model_len=256,
+                            max_batch_size=4, max_prefill_tokens=128,
+                            prefill_buckets=(16, 32, 64), decode_steps=4)
+        prompts = [list(range(1, 33)), list(range(1, 17)),
+                   [7, 9, 11] * 8]
+        sp = SamplingParams(max_tokens=12, temperature=0.0,
+                            ignore_eos=True)
+
+        def run(kernel: bool):
+            monkeypatch.setenv("XLLM_PALLAS", "1" if kernel else "0")
+            monkeypatch.setenv("XLLM_PALLAS_DECODE_V4",
+                               "3" if kernel else "0")
+            eng = Engine(cfg, ecfg, seed=0)
+            outs = {}
+            for i, p in enumerate(prompts):
+                eng.add_request(EngineRequest(
+                    request_id=f"r{i}", token_ids=list(p), sampling=sp))
+            while eng.has_work():
+                for o in eng.step():
+                    outs.setdefault(o.request_id, []).extend(
+                        o.new_token_ids)
+            return outs
+
+        xla = run(kernel=False)
+        pallas = run(kernel=True)
+        assert set(xla) == set(pallas)
+        for rid in xla:
+            assert xla[rid] == pallas[rid], rid
