@@ -2093,9 +2093,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--max-model-len", type=int, default=2048)
     parser.add_argument("--max-batch-size", type=int, default=8)
     parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument("--sp", type=int, default=1)
+    parser.add_argument("--ep", type=int, default=1)
+    # Multi-host SPMD: one worker PROCESS per host of a multi-host TPU
+    # slice, all running this same command. jax.distributed.initialize
+    # wires the hosts into one runtime; the mesh below then spans every
+    # chip of the slice and pjit/shard_map insert ICI/DCN collectives
+    # (SURVEY.md §2.3 consequence; the reference's NCCL/MPI analog).
+    parser.add_argument("--dist-coordinator", default="",
+                        help="host:port of process 0 "
+                             "(multi-host slice; '' = single host)")
+    parser.add_argument("--dist-num-processes", type=int, default=0)
+    parser.add_argument("--dist-process-id", type=int, default=-1)
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    if args.dist_coordinator:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=args.dist_coordinator,
+            num_processes=(args.dist_num_processes or None),
+            process_id=(args.dist_process_id
+                        if args.dist_process_id >= 0 else None))
+        logger.info("joined distributed runtime: process %d/%d, "
+                    "%d local / %d global devices",
+                    jax.process_index(), jax.process_count(),
+                    jax.local_device_count(), jax.device_count())
     from xllm_service_tpu.service.coordination_net import connect_store
     store = connect_store(args.store_addr)
     engine_cfg = EngineConfig(
@@ -2103,9 +2127,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         max_model_len=args.max_model_len,
         max_batch_size=args.max_batch_size, tp=args.tp)
     mesh = None
-    if args.tp > 1:
+    if args.tp * args.dp * args.sp * args.ep > 1:
         from xllm_service_tpu.parallel.mesh import MeshSpec, make_mesh
-        mesh = make_mesh(MeshSpec(tp=args.tp))
+        mesh = make_mesh(MeshSpec(dp=args.dp, ep=args.ep, sp=args.sp,
+                                  tp=args.tp))
     opts = WorkerOptions(
         host=args.host, port=args.port,
         instance_type=InstanceType(args.instance_type),
