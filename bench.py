@@ -320,7 +320,8 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             # Which gated kernels this run used (A/B bookkeeping).
             "kernel_flags": {
                 k: os.environ.get(k, "0") for k in
-                ("XLLM_PALLAS_DECODE_V2", "XLLM_PALLAS_DECODE_V3",
+                ("XLLM_PALLAS", "XLLM_PALLAS_DECODE_V2",
+                 "XLLM_PALLAS_DECODE_V3", "XLLM_PALLAS_DECODE_V4",
                  "XLLM_PALLAS_PREFILL")},
             "batch": batch, "prompt_len": prompt_len, "gen_len": gen_len,
             "warmup_s": round(warmup_s, 1),

@@ -409,7 +409,12 @@ void apply_op(Server* s, Op& op) {
 
 // Returns false on fatal parse error (connection should close).
 bool try_parse(Server* s, int slot, Conn* c) {
-    while (!c->busy && !c->awaiting_admit && !c->shed_discard) {
+    // close_after: the connection is draining its final (possibly
+    // truncated — StreamAbort) response; parsing a pipelined request now
+    // would queue a fresh status line after an unterminated chunked body
+    // and corrupt the client's framing.
+    while (!c->busy && !c->awaiting_admit && !c->shed_discard &&
+           !c->close_after) {
         if (!c->have_head) {
             size_t he = c->rbuf.find("\r\n\r\n");
             if (he == std::string::npos) {

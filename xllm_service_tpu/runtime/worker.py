@@ -2125,7 +2125,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     engine_cfg = EngineConfig(
         page_size=args.page_size, num_pages=args.num_pages,
         max_model_len=args.max_model_len,
-        max_batch_size=args.max_batch_size, tp=args.tp)
+        max_batch_size=args.max_batch_size, tp=args.tp, dp=args.dp,
+        sp=args.sp)
     mesh = None
     if args.tp * args.dp * args.sp * args.ep > 1:
         from xllm_service_tpu.parallel.mesh import MeshSpec, make_mesh
