@@ -304,13 +304,25 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def mha_prefill_auto(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      kv_lengths: jnp.ndarray, q_start: jnp.ndarray,
                      logits_soft_cap: float = 0.0) -> jnp.ndarray:
-    """Trace-time dispatch for prefill attention: the dense path is
-    cheapest while the full score tensor is small; beyond that the chunked
-    online-softmax path bounds memory."""
+    """Trace-time dispatch for prefill attention, by SCORE-TENSOR BYTES
+    (4·B·Hq·T·S), not sequence length alone: at the batched-prefill
+    bench shape (B=64, T=128, S=512) an S-only cutoff picked the dense
+    path whose [B, Hkv, G, T, S] fp32 scores are ~0.5 GB *per layer* —
+    ~52 GB of HBM traffic per prefill call (measured via XLA
+    cost_analysis, round 3). Past 64 MB of scores the chunked
+    online-softmax path runs, with the chunk sized so one fold's score
+    block stays ~VMEM-friendly while never dropping below 128
+    positions (the fp32 lane tile)."""
+    B, T, Hq = q.shape[0], q.shape[1], q.shape[2]
     S = k.shape[1]
-    if S <= 1024:
+    score_bytes = 4 * B * Hq * T * S
+    if score_bytes <= 64 * 1024 * 1024:
         return mha_prefill(q, k, v, kv_lengths, q_start, logits_soft_cap)
-    return mha_prefill_chunked(q, k, v, kv_lengths, q_start, logits_soft_cap)
+    per_pos = 4 * B * Hq * T                 # score bytes per kv position
+    chunk = (32 * 1024 * 1024) // max(per_pos, 1)
+    chunk = max(128, min(1024, (chunk // 128) * 128))
+    return mha_prefill_chunked(q, k, v, kv_lengths, q_start,
+                               logits_soft_cap, chunk_size=chunk)
 
 
 def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
