@@ -269,6 +269,49 @@ class TestEarlyShed:
         finally:
             srv.stop()
 
+    def test_no_pipelined_response_after_stream_abort(self):
+        """A request pipelined behind an aborted stream must NOT be
+        answered on that connection: its status line would land after an
+        unterminated chunked body and corrupt the client's framing. The
+        draining connection just closes."""
+        import socket
+
+        router = Router()
+
+        def gen():
+            yield b"data: one\n\n"
+            raise RuntimeError("producer died")
+
+        hits = []
+        router.route("GET", "/s", lambda r: Response.sse(gen()))
+        router.route("GET", "/after",
+                     lambda r: (hits.append(1), Response.json({}))[1])
+        srv = _mk(router)
+        try:
+            host, port = srv.address.rsplit(":", 1)
+            sk = socket.create_connection((host, int(port)), timeout=5)
+            sk.sendall(b"GET /s HTTP/1.1\r\nHost: x\r\n\r\n"
+                       b"GET /after HTTP/1.1\r\nHost: x\r\n\r\n")
+            sk.settimeout(5)
+            blob = b""
+            while True:
+                try:
+                    part = sk.recv(65536)
+                except socket.timeout:
+                    break
+                if not part:
+                    break
+                blob += part
+            sk.close()
+            # Exactly one status line: the aborted stream's. The
+            # pipelined /after was neither parsed nor answered.
+            assert blob.count(b"HTTP/1.1") == 1, blob[:200]
+            assert b"data: one" in blob
+            assert not blob.rstrip().endswith(b"0\r\n\r\n".rstrip())
+            assert hits == []
+        finally:
+            srv.stop()
+
 
 class TestFactoryFallback:
     def test_env_gate_forces_python_server(self, monkeypatch):
