@@ -1196,6 +1196,12 @@ class Worker:
                      f"{self.kv_migration_direct}")
         lines.append(f"xllm_worker_kv_migration_device_wire_total "
                      f"{self.kv_migration_device_wire}")
+        from xllm_service_tpu.runtime import kv_wire as _kv_wire
+        if _kv_wire._wire is not None:     # no probe side effects here
+            lines.append(f"xllm_worker_kv_wire_staged "
+                         f"{_kv_wire._wire.staged_count()}")
+            lines.append(f"xllm_worker_kv_wire_leaked_total "
+                         f"{_kv_wire._wire.leaked}")
         if self.kv_migration_seconds > 0:
             lines.append(
                 f"xllm_worker_kv_migration_gbps "
