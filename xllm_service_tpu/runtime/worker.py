@@ -2094,8 +2094,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--model-dir", default="")
     parser.add_argument("--heartbeat-interval-s", type=float, default=3.0)
     parser.add_argument("--enable-profiling", action="store_true")
-    parser.add_argument("--page-size", type=int, default=64)
-    parser.add_argument("--num-pages", type=int, default=512)
+    # 128 = the reference's block-size default AND half the decode-
+    # attention grid cells of 64 (per-cell overhead is first-order at
+    # large batch — docs/PERF_NOTES.md round 3).
+    parser.add_argument("--page-size", type=int, default=128)
+    parser.add_argument("--num-pages", type=int, default=256)
     parser.add_argument("--max-model-len", type=int, default=2048)
     parser.add_argument("--max-batch-size", type=int, default=8)
     parser.add_argument("--tp", type=int, default=1)
