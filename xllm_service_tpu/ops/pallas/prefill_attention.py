@@ -56,15 +56,19 @@ def prefill_kernel_enabled() -> bool:
 
 
 def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
-            vf_ref, o_ref, qt_ref, m_ref, l_ref, acc_ref, *,
+            vf_ref, o_ref, m_ref, l_ref, acc_ref, *,
             page_size: int, q_block: int, num_pool_steps: int,
             num_kv_steps: int, num_kv_heads: int):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     s = pl.program_id(2)
 
-    hq, d = q_ref.shape[3], q_ref.shape[4]
-    g = hq // num_kv_heads
+    # q arrives PRE-relaid as [Hkv, QB*G, D] (the caller does the 4D
+    # transpose in XLA where it is free): in-kernel 4D transposes are a
+    # known Mosaic lowering hazard on v5e (the V3 decode kernel died on
+    # exactly this class — docs/PERF_NOTES.md round 3).
+    d = q_ref.shape[4]
+    g = q_ref.shape[3] // q_block
     q_start = qstart_ref[b]
     length = lens_ref[b]
 
@@ -73,12 +77,6 @@ def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
-        # One MXU-friendly relayout of the query block per (b, qi):
-        # [QB, Hq, D] -> [Hkv, QB*G, D], reused by every kv fold.
-        q = q_ref[0, 0].astype(jnp.float32)                  # [QB, Hq, D]
-        qg = q.reshape(q_block, num_kv_heads, g, d)
-        qt_ref[:] = jnp.transpose(qg, (1, 0, 2, 3)).reshape(
-            num_kv_heads, q_block * g, d)
 
     is_pool = s < num_pool_steps
     # Global position of this block's first kv token.
@@ -105,7 +103,7 @@ def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
         vb = jnp.where(is_pool, vp_ref[0].astype(jnp.float32),
                        vf_ref[0, 0].astype(jnp.float32))
         scale = 1.0 / (d ** 0.5)
-        qt = qt_ref[:]                                       # [Hkv, QB*G, D]
+        qt = q_ref[0, 0].astype(jnp.float32)                 # [Hkv, QB*G, D]
         kt = jnp.transpose(kb, (1, 0, 2))                    # [Hkv, ps, D]
         vt = jnp.transpose(vb, (1, 0, 2))
         # [Hkv, QB*G, D] x [Hkv, ps, D] -> [Hkv, QB*G, ps]
@@ -144,10 +142,10 @@ def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
     @pl.when(s == num_kv_steps - 1)
     def _finalize():
         denom = jnp.maximum(l_ref[:], 1e-30)
-        out = acc_ref[:] / denom                             # [Hkv, QB*G, D]
-        out = out.reshape(num_kv_heads, q_block, g, d)
-        out = jnp.transpose(out, (1, 0, 2, 3)).reshape(q_block, hq, d)
-        o_ref[0, 0] = out.astype(o_ref.dtype)
+        # Written in the kernel's native [Hkv, QB*G, D] layout; the
+        # caller transposes back in XLA (same hazard-avoidance as the
+        # pre-relaid q input).
+        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
 def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
@@ -203,7 +201,7 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
         num_scalar_prefetch=3,              # q_start, lengths, page_table
         grid=(B, nQ, n_kv),
         in_specs=[
-            pl.BlockSpec((1, 1, QB, Hq, D),
+            pl.BlockSpec((1, 1, Hkv, QB * G, D),
                          lambda b, qi, s, qstart, lens, pt:
                          (b, qi, 0, 0, 0)),
             pl.BlockSpec((1, page_size, Hkv, D), pool_idx),
@@ -212,29 +210,32 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
             pl.BlockSpec((1, 1, page_size, Hkv, D), fresh_idx),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, QB, Hq, D),
+            (1, 1, Hkv, QB * G, D),
             lambda b, qi, s, qstart, lens, pt: (b, qi, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((Hkv, QB * G, D), jnp.float32),   # relaid-out q
             pltpu.VMEM((Hkv, QB * G, 1), jnp.float32),   # running max
             pltpu.VMEM((Hkv, QB * G, 1), jnp.float32),   # running denom
             pltpu.VMEM((Hkv, QB * G, D), jnp.float32),   # accumulator
         ],
     )
-    # 4D blocks with two leading singleton/block dims: reshape q to
-    # [B, nQ, QB, Hq, D] so the (b, qi) block indexing is direct.
-    q5 = q.reshape(B, nQ, QB, Hq, D)
+    # q is PRE-relaid to the kernel's [Hkv, QB*G, D] block layout (and
+    # the output un-relaid below) in XLA, where these transposes are
+    # fused and free — in-kernel 4D transposes are a Mosaic lowering
+    # hazard on v5e (see the V3 decode kernel history).
+    q6 = q.reshape(B, nQ, QB, Hkv, G, D).transpose(0, 1, 3, 2, 4, 5) \
+        .reshape(B, nQ, Hkv, QB * G, D)
     kf5 = k_fresh.reshape(B, nF, page_size, Hkv, D)
     vf5 = v_fresh.reshape(B, nF, page_size, Hkv, D)
     out = pl.pallas_call(
         functools.partial(_kernel, page_size=page_size, q_block=QB,
                           num_pool_steps=MP, num_kv_steps=n_kv,
                           num_kv_heads=Hkv),
-        out_shape=jax.ShapeDtypeStruct((B, nQ, QB, Hq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, nQ, Hkv, QB * G, D), q.dtype),
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(q_start.astype(jnp.int32), lengths.astype(jnp.int32),
-      page_table, q5, k_pages, v_pages, kf5, vf5)
+      page_table, q6, k_pages, v_pages, kf5, vf5)
+    out = out.reshape(B, nQ, Hkv, QB, G, D).transpose(0, 1, 3, 2, 4, 5)
     return out.reshape(B, T, Hq, D)
