@@ -235,7 +235,8 @@ def main() -> None:
     from xllm_service_tpu.ops import attention as att
     from xllm_service_tpu.ops.pallas.paged_attention import (
         _paged_decode_attention_impl, _paged_decode_attention_mr_impl,
-        _paged_decode_attention_row_impl)
+        _paged_decode_attention_row_impl,
+        _paged_decode_attention_wide_impl)
     from xllm_service_tpu.ops import pallas as pallas_mod
 
     if args.small:
@@ -296,6 +297,8 @@ def main() -> None:
         "attn_pallas_multirow_v4x16": functools.partial(
             _paged_decode_attention_mr_impl, rows=16,
             interpret=interpret),
+        "attn_pallas_wide_v5": functools.partial(
+            _paged_decode_attention_wide_impl, interpret=interpret),
     }
 
     detail = {"shape": {"B": B, "Hq": Hq, "Hkv": Hkv, "D": D,

@@ -327,6 +327,40 @@ class TestPallasPagedAttention:
             assert jnp.allclose(ref_c, out_c, atol=1e-5), \
                 (rows, float(jnp.max(jnp.abs(ref_c - out_c))))
 
+    def test_wide_kernel_matches_reference(self):
+        """Wide block-diagonal (B, pages) kernel (XLLM_PALLAS_DECODE_V5)
+        vs the XLA reference: zero in-cell relayouts, flat pools,
+        diagonal selection outside."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from xllm_service_tpu.ops.attention import (
+            paged_decode_attention, paged_decode_attention_current)
+        from xllm_service_tpu.ops.pallas.paged_attention import (
+            _paged_decode_attention_wide_impl)
+
+        rng = np.random.default_rng(23)
+        B, Hq, Hkv, D, P, ps, MP = 3, 8, 2, 32, 16, 8, 6
+        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        pt = np.asarray(rng.integers(1, P, size=(B, MP)), np.int32)
+        pt[1, 1:] = 0
+        pt = jnp.asarray(pt)
+        ctx = jnp.asarray([13, 5, MP * ps], jnp.int32)
+        kc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+        ref = paged_decode_attention(q, k, v, pt, ctx)
+        out = _paged_decode_attention_wide_impl(q, k, v, pt, ctx,
+                                                interpret=True)
+        assert jnp.allclose(ref, out, atol=1e-5), \
+            float(jnp.max(jnp.abs(ref - out)))
+        ref_c = paged_decode_attention_current(q, k, v, pt, ctx, kc, vc)
+        out_c = _paged_decode_attention_wide_impl(q, k, v, pt, ctx,
+                                                  kc, vc, interpret=True)
+        assert jnp.allclose(ref_c, out_c, atol=1e-5), \
+            float(jnp.max(jnp.abs(ref_c - out_c)))
+
     def test_row_kernel_matches_reference(self):
         """Grid-(B,) double-buffered row kernel (XLLM_PALLAS_DECODE_V3)
         vs the XLA reference, with and without the current-token fold,

@@ -312,6 +312,162 @@ def _paged_decode_attention_row_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
     return o.reshape(B, Hq, D).astype(q.dtype)
 
 
+def _wide_default() -> bool:
+    """Wide block-diagonal variant of the (B, pages) kernel
+    (XLLM_PALLAS_DECODE_V5): same grid, but queries arrive pre-expanded
+    to [Hq, Hkv*D] (zeros outside each row's kv-head slice) against
+    FLAT [P, ps, Hkv*D] pools, so both dots are plain 2D and the cell
+    body has ZERO relayouts — no per-cell [ps, Hkv, D] -> [Hkv, ps, D]
+    transpose (a VMEM relayout paid B*MP*layers times per step). Wastes
+    Hkv x MXU flops on zero blocks, irrelevant at decode. The same
+    trick that made V3 lowerable; here it attacks per-cell cost
+    instead of cell count (V4's axis)."""
+    return os.environ.get("XLLM_PALLAS_DECODE_V5", "0") == "1"
+
+
+def _widen_q(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
+    """[B, Hq, D] -> block-diagonal [B, Hq, Hkv*D] (pre-scaled by the
+    caller if desired): row hq's kv-head slice holds its query vector,
+    all other lanes zero."""
+    B, Hq, D = q.shape
+    G = Hq // num_kv_heads
+    eye = jnp.eye(num_kv_heads, dtype=q.dtype)
+    return (q.reshape(B, num_kv_heads, G, 1, D)
+            * eye[:, None, :, None]).reshape(B, Hq, num_kv_heads * D)
+
+
+def _select_diag(o_wide: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
+    """[B, Hq, Hkv*D] f32 -> [B, Hq, D]: row hq keeps its own kv head's
+    D-slice."""
+    B, Hq, W = o_wide.shape
+    G = Hq // num_kv_heads
+    D = W // num_kv_heads
+    eye = jnp.eye(num_kv_heads, dtype=jnp.float32)
+    return jnp.einsum(
+        "bhgkd,hk->bhgd",
+        o_wide.reshape(B, num_kv_heads, G, num_kv_heads, D),
+        eye).reshape(B, Hq, D)
+
+
+def _wide_kernel(ctx_ref, pt_ref, qw_ref, k_ref, v_ref, kc_ref, vc_ref,
+                 o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
+                 pages_per_seq: int, has_current: bool):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_ref[b]
+    page_start = p * page_size
+
+    @pl.when(page_start < ctx)
+    def _fold():
+        qw = qw_ref[0].astype(jnp.float32)                   # [Hq, W]
+        k = k_ref[0].astype(jnp.float32)                     # [ps, W]
+        v = v_ref[0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            qw, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [Hq, ps]
+        pos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        mask = pos < ctx
+        logits = jnp.where(mask, logits, _NEG_INF)
+        m_prev = m_ref[:]
+        blk_max = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, blk_max)
+        prob = jnp.where(mask, jnp.exp(logits - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(prob, axis=-1,
+                                             keepdims=True)
+        pv = jax.lax.dot_general(
+            prob, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [Hq, W]
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = m_new
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finalize():
+        m_fin = m_ref[:]
+        l_fin = l_ref[:]
+        acc_fin = acc_ref[:]
+        if has_current:
+            qw = qw_ref[0].astype(jnp.float32)
+            kc = kc_ref[0].astype(jnp.float32)               # [1, W]
+            vc = vc_ref[0].astype(jnp.float32)
+            lc = jax.lax.dot_general(
+                qw, kc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [Hq, 1]
+            m_new = jnp.maximum(m_fin, lc)
+            corr = jnp.exp(m_fin - m_new)
+            pc = jnp.exp(lc - m_new)
+            l_fin = l_fin * corr + pc
+            acc_fin = acc_fin * corr + pc * vc
+        o_ref[0] = acc_fin / jnp.maximum(l_fin, 1e-30)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_decode_attention_wide_impl(q: jnp.ndarray,
+                                      k_pages: jnp.ndarray,
+                                      v_pages: jnp.ndarray,
+                                      page_table: jnp.ndarray,
+                                      context_lens: jnp.ndarray,
+                                      k_cur: jnp.ndarray = None,
+                                      v_cur: jnp.ndarray = None,
+                                      interpret: bool = False
+                                      ) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    _, page_size, Hkv, _ = k_pages.shape
+    MP = page_table.shape[1]
+    W = Hkv * D
+    has_current = k_cur is not None
+    if not has_current:
+        k_cur = jnp.zeros((B, Hkv, D), q.dtype)
+        v_cur = jnp.zeros((B, Hkv, D), q.dtype)
+    scale = 1.0 / (D ** 0.5)
+    q_wide = _widen_q((q.astype(jnp.float32) * scale).astype(q.dtype),
+                      Hkv)
+    k_flat = k_pages.reshape(-1, page_size, W)
+    v_flat = v_pages.reshape(-1, page_size, W)
+    kc_flat = k_cur.reshape(B, 1, W)
+    vc_flat = v_cur.reshape(B, 1, W)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, MP),
+        in_specs=[
+            pl.BlockSpec((1, Hq, W), lambda b, p, ctx, pt: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, W),
+                         lambda b, p, ctx, pt: (pt[b, p], 0, 0)),
+            pl.BlockSpec((1, page_size, W),
+                         lambda b, p, ctx, pt: (pt[b, p], 0, 0)),
+            pl.BlockSpec((1, 1, W), lambda b, p, ctx, pt: (b, 0, 0)),
+            pl.BlockSpec((1, 1, W), lambda b, p, ctx, pt: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, W),
+                               lambda b, p, ctx, pt: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hq, 1), jnp.float32),
+            pltpu.VMEM((Hq, 1), jnp.float32),
+            pltpu.VMEM((Hq, W), jnp.float32),
+        ],
+    )
+    o_wide = pl.pallas_call(
+        functools.partial(_wide_kernel, page_size=page_size,
+                          pages_per_seq=MP, has_current=has_current),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, W), jnp.float32),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(context_lens, page_table, q_wide, k_flat, v_flat, kc_flat,
+      vc_flat)
+    return _select_diag(o_wide, Hkv).astype(q.dtype)
+
+
 def _multirow_default() -> int:
     """Rows per grid cell for the multi-row kernel (0 = off). The
     (B, pages) kernel's cost at decode is dominated by CELL COUNT
@@ -500,6 +656,10 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
     if interpret is None:
         from xllm_service_tpu.ops import pallas
         interpret = pallas.default_interpret()
+    if _wide_default():
+        return _paged_decode_attention_wide_impl(
+            q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur,
+            interpret=interpret)
     mr = _multirow_default()
     if mr > 1:
         return _paged_decode_attention_mr_impl(
