@@ -109,6 +109,9 @@ class ModelConfig:
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
     attention_bias: bool = False              # True for Qwen2 QKV
+    # Per-head RMSNorm on q/k before rope (Qwen3's replacement for the
+    # Qwen2 QKV bias).
+    qk_norm: bool = False
     # MoE (0 experts → dense MLP).
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -160,6 +163,15 @@ class ModelConfig:
         return dataclasses.replace(cls.qwen2_7b(), name="qwen2.5-7b")
 
     @classmethod
+    def qwen3_8b(cls) -> "ModelConfig":
+        # Qwen3-8B: qk-norm generation (no attention bias).
+        return cls(name="qwen3-8b", vocab_size=151936, hidden_size=4096,
+                   intermediate_size=12288, num_layers=36, num_heads=32,
+                   num_kv_heads=8, head_dim=128, rope_theta=1000000.0,
+                   rms_norm_eps=1e-6, max_position_embeddings=40960,
+                   qk_norm=True)
+
+    @classmethod
     def mixtral_8x7b(cls) -> "ModelConfig":
         # Mixtral-8x7B: the expert-parallel flagship (parallel/expert.py
         # top-k dispatch; experts shard over the mesh's ep axis).
@@ -195,6 +207,7 @@ class ModelConfig:
             tie_word_embeddings=d.get("tie_word_embeddings", False),
             attention_bias=d.get("attention_bias",
                                  d.get("model_type") == "qwen2"),
+            qk_norm=d.get("model_type") == "qwen3",
             num_experts=d.get("num_local_experts", 0),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             rope_scaling=cls._parse_rope_scaling(d.get("rope_scaling")),

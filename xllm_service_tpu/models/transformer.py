@@ -73,6 +73,9 @@ def init_params(cfg: ModelConfig, key: jax.Array,
         layers["q_bias"] = jnp.zeros((L, Hq * Dh), dtype)
         layers["k_bias"] = jnp.zeros((L, Hkv * Dh), dtype)
         layers["v_bias"] = jnp.zeros((L, Hkv * Dh), dtype)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, Dh), dtype)
+        layers["k_norm"] = jnp.ones((L, Dh), dtype)
     if cfg.is_moe:
         E = cfg.num_experts
         layers["router"] = w((L, D, E), D)
@@ -133,6 +136,10 @@ def _qkv(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray):
     q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
     k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    if "q_norm" in lp:
+        # Qwen3: per-head RMSNorm on q/k before rope.
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     return q, k, v
 
 

@@ -118,6 +118,9 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
         layers["q_bias"] = stack(A + "q_proj.bias")
         layers["k_bias"] = stack(A + "k_proj.bias")
         layers["v_bias"] = stack(A + "v_proj.bias")
+    if cfg.qk_norm:
+        layers["q_norm"] = stack(A + "q_norm.weight")
+        layers["k_norm"] = stack(A + "k_norm.weight")
     if cfg.is_moe:
         E = cfg.num_experts
         X = "model.layers.{i}.block_sparse_moe."
@@ -193,6 +196,9 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
             if nm != "o_proj" and nm.replace("proj", "bias") in lp:
                 out[A + nm + ".bias"] = get(
                     lp[nm.replace("proj", "bias")][i])
+        if "q_norm" in lp:
+            out[A + "q_norm.weight"] = get(lp["q_norm"][i])
+            out[A + "k_norm.weight"] = get(lp["k_norm"][i])
         if cfg.is_moe:
             X = f"model.layers.{i}.block_sparse_moe."
             out[X + "gate.weight"] = np.ascontiguousarray(
@@ -222,7 +228,8 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
         "tie_word_embeddings": cfg.tie_word_embeddings,
         "attention_bias": cfg.attention_bias,
         "torch_dtype": cfg.dtype,
-        "model_type": "qwen2" if cfg.attention_bias else "llama",
+        "model_type": ("qwen3" if cfg.qk_norm
+                       else "qwen2" if cfg.attention_bias else "llama"),
     }
     if cfg.rope_scaling is not None:
         kind = cfg.rope_scaling[0]

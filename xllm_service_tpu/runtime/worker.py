@@ -103,6 +103,7 @@ _MODEL_REGISTRY = {
     "llama3-8b": ModelConfig.llama3_8b,
     "qwen2-7b": ModelConfig.qwen2_7b,
     "qwen2.5-7b": ModelConfig.qwen25_7b,
+    "qwen3-8b": ModelConfig.qwen3_8b,
     "mixtral-8x7b": ModelConfig.mixtral_8x7b,
     "tiny-moe": lambda: ModelConfig.tiny(num_experts=4),
 }
