@@ -85,7 +85,7 @@ class ServiceOptions:
 
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
-    """Architecture config covering Llama-2/3, Qwen2(.5), TinyLlama, and the
+    """Architecture config covering Llama-2/3, Qwen2(.5), Qwen3, TinyLlama, and the
     MoE (Mixtral-style) variant used for expert parallelism.
 
     Frozen (hashable) so it can be a static jit argument — one compiled
