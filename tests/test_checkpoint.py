@@ -33,11 +33,12 @@ def _assert_trees_equal(a, b):
 
 
 @pytest.mark.parametrize("variant", ["dense", "qwen_bias", "qwen3_qk",
-                                     "moe"])
+                                     "phi3_fused", "moe"])
 def test_save_load_roundtrip(tmp_path, variant):
     cfg = {"dense": _cfg(),
            "qwen_bias": _cfg(attention_bias=True),
            "qwen3_qk": _cfg(qk_norm=True),
+           "phi3_fused": _cfg(fused_proj=True),
            "moe": _cfg(num_experts=4)}[variant]
     params = init_params(cfg, jax.random.PRNGKey(0))
     save_checkpoint(params, cfg, str(tmp_path))

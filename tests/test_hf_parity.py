@@ -43,7 +43,7 @@ _DIMS = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
 def _make_hf_model(kind: str):
     """A randomly-initialized transformers model of the given flavor."""
     torch.manual_seed({"llama3": 0, "qwen2": 1, "mixtral": 2,
-                       "llama_sharded": 3, "qwen3": 4}[kind])
+                       "llama_sharded": 3, "qwen3": 4, "phi3": 5}[kind])
     if kind in ("llama3", "llama_sharded"):
         cfg = transformers.LlamaConfig(
             **_DIMS, rope_theta=500000.0, tie_word_embeddings=True,
@@ -60,6 +60,11 @@ def _make_hf_model(kind: str):
         cfg = transformers.Qwen3Config(**_DIMS, head_dim=16,
                                        rope_theta=1000000.0)
         model = transformers.Qwen3ForCausalLM(cfg)
+    elif kind == "phi3":
+        # Phi-3: fused qkv_proj / gate_up_proj checkpoint rows.
+        cfg = transformers.Phi3Config(**_DIMS, rope_theta=10000.0,
+                                      pad_token_id=0)
+        model = transformers.Phi3ForCausalLM(cfg)
     elif kind == "mixtral":
         cfg = transformers.MixtralConfig(
             **_DIMS, num_local_experts=4, num_experts_per_tok=2,
@@ -94,7 +99,8 @@ def _our_all_logits(cfg, params, prompt):
     return np.asarray(last), np.asarray(all_logits)[0]
 
 
-@pytest.mark.parametrize("kind", ["llama3", "qwen2", "qwen3", "mixtral"])
+@pytest.mark.parametrize("kind", ["llama3", "qwen2", "qwen3", "phi3",
+                                  "mixtral"])
 def test_logits_match_torch_oracle(tmp_path, kind):
     """Every prompt position's logits match the torch forward of the same
     HF-written weights (fp32, tight tolerance, argmax everywhere)."""

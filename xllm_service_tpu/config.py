@@ -112,6 +112,10 @@ class ModelConfig:
     # Per-head RMSNorm on q/k before rope (Qwen3's replacement for the
     # Qwen2 QKV bias).
     qk_norm: bool = False
+    # Checkpoint stores fused qkv_proj / gate_up_proj rows (Phi-3).
+    # Pure load/save-mapping concern: the in-memory tree keeps separate
+    # projections, so compute paths are untouched.
+    fused_proj: bool = False
     # MoE (0 experts → dense MLP).
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -172,6 +176,14 @@ class ModelConfig:
                    qk_norm=True)
 
     @classmethod
+    def phi3_mini(cls) -> "ModelConfig":
+        # Phi-3-mini-4k: llama-shaped compute, fused-projection files.
+        return cls(name="phi3-mini", vocab_size=32064, hidden_size=3072,
+                   intermediate_size=8192, num_layers=32, num_heads=32,
+                   num_kv_heads=32, rope_theta=10000.0,
+                   max_position_embeddings=4096, fused_proj=True)
+
+    @classmethod
     def mixtral_8x7b(cls) -> "ModelConfig":
         # Mixtral-8x7B: the expert-parallel flagship (parallel/expert.py
         # top-k dispatch; experts shard over the mesh's ep axis).
@@ -208,6 +220,7 @@ class ModelConfig:
             attention_bias=d.get("attention_bias",
                                  d.get("model_type") == "qwen2"),
             qk_norm=d.get("model_type") == "qwen3",
+            fused_proj=d.get("model_type") == "phi3",
             num_experts=d.get("num_local_experts", 0),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             rope_scaling=cls._parse_rope_scaling(d.get("rope_scaling")),

@@ -109,11 +109,31 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
         "input_norm": stack("model.layers.{i}.input_layernorm.weight"),
         "post_norm": stack(
             "model.layers.{i}.post_attention_layernorm.weight"),
-        "q_proj": stack(A + "q_proj.weight", transpose=True),
-        "k_proj": stack(A + "k_proj.weight", transpose=True),
-        "v_proj": stack(A + "v_proj.weight", transpose=True),
         "o_proj": stack(A + "o_proj.weight", transpose=True),
     }
+    if cfg.fused_proj:
+        # Phi-3 layout: qkv_proj rows = [q | k | v], gate_up rows =
+        # [gate | up]. Split into the separate projections the compute
+        # paths use everywhere.
+        nq = cfg.num_heads * cfg.head_dim
+        nkv = cfg.num_kv_heads * cfg.head_dim
+
+        def split_stack(fmt: str, bounds) -> List[np.ndarray]:
+            outs = [[] for _ in bounds]
+            for i in range(L):
+                t = r.get(fmt.format(i=i))
+                lo = 0
+                for j, n in enumerate(bounds):
+                    outs[j].append(np.ascontiguousarray(t[lo:lo + n].T))
+                    lo += n
+            return [np.stack(o).astype(dtype) for o in outs]
+
+        layers["q_proj"], layers["k_proj"], layers["v_proj"] = \
+            split_stack(A + "qkv_proj.weight", (nq, nkv, nkv))
+    else:
+        layers["q_proj"] = stack(A + "q_proj.weight", transpose=True)
+        layers["k_proj"] = stack(A + "k_proj.weight", transpose=True)
+        layers["v_proj"] = stack(A + "v_proj.weight", transpose=True)
     if cfg.attention_bias:
         layers["q_bias"] = stack(A + "q_proj.bias")
         layers["k_bias"] = stack(A + "k_proj.bias")
@@ -140,6 +160,11 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
         layers["gate_proj"] = stack_experts("w1", transpose=True)
         layers["up_proj"] = stack_experts("w3", transpose=True)
         layers["down_proj"] = stack_experts("w2", transpose=True)
+    elif cfg.fused_proj:
+        layers["gate_proj"], layers["up_proj"] = split_stack(
+            M + "gate_up_proj.weight",
+            (cfg.intermediate_size, cfg.intermediate_size))
+        layers["down_proj"] = stack(M + "down_proj.weight", transpose=True)
     else:
         layers["gate_proj"] = stack(M + "gate_proj.weight", transpose=True)
         layers["up_proj"] = stack(M + "up_proj.weight", transpose=True)
@@ -190,12 +215,19 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
             get(lp["input_norm"][i])
         out[f"model.layers.{i}.post_attention_layernorm.weight"] = \
             get(lp["post_norm"][i])
-        for nm in ("q_proj", "k_proj", "v_proj", "o_proj"):
-            out[A + nm + ".weight"] = np.ascontiguousarray(
-                get(lp[nm][i]).T)
-            if nm != "o_proj" and nm.replace("proj", "bias") in lp:
-                out[A + nm + ".bias"] = get(
-                    lp[nm.replace("proj", "bias")][i])
+        if cfg.fused_proj:
+            out[A + "qkv_proj.weight"] = np.ascontiguousarray(
+                np.concatenate([get(lp[nm][i]).T for nm in
+                                ("q_proj", "k_proj", "v_proj")], axis=0))
+            out[A + "o_proj.weight"] = np.ascontiguousarray(
+                get(lp["o_proj"][i]).T)
+        else:
+            for nm in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                out[A + nm + ".weight"] = np.ascontiguousarray(
+                    get(lp[nm][i]).T)
+                if nm != "o_proj" and nm.replace("proj", "bias") in lp:
+                    out[A + nm + ".bias"] = get(
+                        lp[nm.replace("proj", "bias")][i])
         if "q_norm" in lp:
             out[A + "q_norm.weight"] = get(lp["q_norm"][i])
             out[A + "k_norm.weight"] = get(lp["k_norm"][i])
@@ -208,6 +240,13 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
                                  ("w2", "down_proj")):
                     out[X + f"experts.{e}.{hf}.weight"] = \
                         np.ascontiguousarray(get(lp[ours][i][e]).T)
+        elif cfg.fused_proj:
+            M = f"model.layers.{i}.mlp."
+            out[M + "gate_up_proj.weight"] = np.ascontiguousarray(
+                np.concatenate([get(lp["gate_proj"][i]).T,
+                                get(lp["up_proj"][i]).T], axis=0))
+            out[M + "down_proj.weight"] = np.ascontiguousarray(
+                get(lp["down_proj"][i]).T)
         else:
             M = f"model.layers.{i}.mlp."
             for hf in ("gate_proj", "up_proj", "down_proj"):
@@ -229,6 +268,7 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
         "attention_bias": cfg.attention_bias,
         "torch_dtype": cfg.dtype,
         "model_type": ("qwen3" if cfg.qk_norm
+                       else "phi3" if cfg.fused_proj
                        else "qwen2" if cfg.attention_bias else "llama"),
     }
     if cfg.rope_scaling is not None:
