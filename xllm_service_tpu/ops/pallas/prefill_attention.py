@@ -58,7 +58,7 @@ def prefill_kernel_enabled() -> bool:
 def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
             vf_ref, o_ref, m_ref, l_ref, acc_ref, *,
             page_size: int, q_block: int, num_pool_steps: int,
-            num_kv_steps: int, num_kv_heads: int):
+            num_kv_steps: int):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     s = pl.program_id(2)
@@ -228,8 +228,7 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
     vf5 = v_fresh.reshape(B, nF, page_size, Hkv, D)
     out = pl.pallas_call(
         functools.partial(_kernel, page_size=page_size, q_block=QB,
-                          num_pool_steps=MP, num_kv_steps=n_kv,
-                          num_kv_heads=Hkv),
+                          num_pool_steps=MP, num_kv_steps=n_kv),
         out_shape=jax.ShapeDtypeStruct((B, nQ, Hkv, QB * G, D), q.dtype),
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(

@@ -171,6 +171,12 @@ def _pull_via(server: Any, tr: Dict[str, Any]) -> Tuple[Any, Any]:
     return k, v
 
 
+def peek_device_wire() -> Optional["DeviceWire"]:
+    """The wire if it already exists — NO probe/creation side effects
+    (metrics scrapes must never initialize a transfer server)."""
+    return _wire
+
+
 def pull_block(tr: Dict[str, Any]) -> Tuple[Any, Any]:
     """Decode-side: pull a staged (k, v) pair described by the
     ``transfer`` handshake dict. The exception type tells the offering

@@ -1198,12 +1198,13 @@ class Worker:
                      f"{self.kv_migration_direct}")
         lines.append(f"xllm_worker_kv_migration_device_wire_total "
                      f"{self.kv_migration_device_wire}")
-        from xllm_service_tpu.runtime import kv_wire as _kv_wire
-        if _kv_wire._wire is not None:     # no probe side effects here
+        from xllm_service_tpu.runtime.kv_wire import peek_device_wire
+        wire = peek_device_wire()
+        if wire is not None:
             lines.append(f"xllm_worker_kv_wire_staged "
-                         f"{_kv_wire._wire.staged_count()}")
+                         f"{wire.staged_count()}")
             lines.append(f"xllm_worker_kv_wire_leaked_total "
-                         f"{_kv_wire._wire.leaked}")
+                         f"{wire.leaked}")
         if self.kv_migration_seconds > 0:
             lines.append(
                 f"xllm_worker_kv_migration_gbps "
