@@ -43,7 +43,8 @@ _DIMS = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
 def _make_hf_model(kind: str):
     """A randomly-initialized transformers model of the given flavor."""
     torch.manual_seed({"llama3": 0, "qwen2": 1, "mixtral": 2,
-                       "llama_sharded": 3, "qwen3": 4, "phi3": 5}[kind])
+                       "llama_sharded": 3, "qwen3": 4, "phi3": 5,
+                       "mistral": 6}[kind])
     if kind in ("llama3", "llama_sharded"):
         cfg = transformers.LlamaConfig(
             **_DIMS, rope_theta=500000.0, tie_word_embeddings=True,
@@ -65,6 +66,12 @@ def _make_hf_model(kind: str):
         cfg = transformers.Phi3Config(**_DIMS, rope_theta=10000.0,
                                       pad_token_id=0)
         model = transformers.Phi3ForCausalLM(cfg)
+    elif kind == "mistral":
+        # Mistral v0.2+: llama-shaped, full attention (no sliding
+        # window) — the generic load path must cover it untouched.
+        cfg = transformers.MistralConfig(**_DIMS, rope_theta=1000000.0,
+                                         sliding_window=None)
+        model = transformers.MistralForCausalLM(cfg)
     elif kind == "mixtral":
         cfg = transformers.MixtralConfig(
             **_DIMS, num_local_experts=4, num_experts_per_tok=2,
@@ -100,7 +107,7 @@ def _our_all_logits(cfg, params, prompt):
 
 
 @pytest.mark.parametrize("kind", ["llama3", "qwen2", "qwen3", "phi3",
-                                  "mixtral"])
+                                  "mistral", "mixtral"])
 def test_logits_match_torch_oracle(tmp_path, kind):
     """Every prompt position's logits match the torch forward of the same
     HF-written weights (fp32, tight tolerance, argmax everywhere)."""

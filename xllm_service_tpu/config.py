@@ -176,6 +176,15 @@ class ModelConfig:
                    qk_norm=True)
 
     @classmethod
+    def mistral_7b(cls) -> "ModelConfig":
+        # Mistral-7B v0.3: llama wiring, full attention (v0.2+ dropped
+        # the sliding window).
+        return cls(name="mistral-7b", vocab_size=32768, hidden_size=4096,
+                   intermediate_size=14336, num_layers=32, num_heads=32,
+                   num_kv_heads=8, rope_theta=1000000.0,
+                   max_position_embeddings=32768)
+
+    @classmethod
     def phi3_mini(cls) -> "ModelConfig":
         # Phi-3-mini-4k: llama-shaped compute, fused-projection files.
         return cls(name="phi3-mini", vocab_size=32064, hidden_size=3072,

@@ -105,6 +105,7 @@ _MODEL_REGISTRY = {
     "qwen2.5-7b": ModelConfig.qwen25_7b,
     "qwen3-8b": ModelConfig.qwen3_8b,
     "phi3-mini": ModelConfig.phi3_mini,
+    "mistral-7b": ModelConfig.mistral_7b,
     "mixtral-8x7b": ModelConfig.mixtral_8x7b,
     "tiny-moe": lambda: ModelConfig.tiny(num_experts=4),
 }
