@@ -178,6 +178,20 @@ def test_rope_scaling_respected(tmp_path):
     np.testing.assert_allclose(with_scaling, ref, rtol=2e-4, atol=5e-4)
 
 
+def test_unsupported_architectures_refused():
+    """A config this transformer cannot faithfully run must fail at
+    load (gemma2 layer-body deltas; Mistral v0.1 sliding window) —
+    never silently emit wrong tokens."""
+    base = dict(_DIMS, model_type="gemma2")
+    with pytest.raises(ValueError, match="unsupported model_type"):
+        ModelConfig.from_hf_config(base)
+    v01 = dict(_DIMS, model_type="mistral", sliding_window=4096)
+    with pytest.raises(ValueError, match="sliding-window"):
+        ModelConfig.from_hf_config(v01)
+    ok = dict(_DIMS, model_type="mistral", sliding_window=None)
+    assert ModelConfig.from_hf_config(ok).num_layers == 2
+
+
 def test_unknown_rope_scaling_refused():
     with pytest.raises(NotImplementedError):
         ModelConfig.from_hf_config(

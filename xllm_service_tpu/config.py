@@ -212,7 +212,23 @@ class ModelConfig:
 
     @classmethod
     def from_hf_config(cls, d: Dict[str, Any], name: str = "hf") -> "ModelConfig":
-        """Build from a HuggingFace ``config.json`` dict (LlamaConfig/Qwen2Config)."""
+        """Build from a HuggingFace ``config.json`` dict (LlamaConfig/Qwen2Config).
+
+        Unsupported architectures are REFUSED here, not approximated: a
+        model that needs sliding-window masks or layer-body deltas this
+        transformer does not implement must fail at load, never emit
+        silently-wrong tokens."""
+        mt = d.get("model_type", "llama")
+        supported = ("llama", "mistral", "qwen2", "qwen3", "phi3",
+                     "mixtral")
+        if mt not in supported:
+            raise ValueError(
+                f"unsupported model_type {mt!r} (supported: "
+                f"{', '.join(supported)})")
+        if mt == "mistral" and d.get("sliding_window"):
+            raise ValueError(
+                "sliding-window attention is not implemented; Mistral "
+                "v0.2+ checkpoints (sliding_window: null) only")
         return cls(
             name=name,
             vocab_size=d["vocab_size"],
