@@ -1,0 +1,37 @@
+#!/bin/bash
+# Poll the TPU; the moment it answers, run the queued hardware ladder
+# sequentially — NO timeout wrappers around chip-holding processes
+# (a TERM/KILL mid-compile wedges the chip; see docs/PERF_NOTES.md).
+cd /root/repo
+probe() {
+  timeout 90 python - <<'EOF' 2>/dev/null
+import subprocess, sys
+try:
+    p = subprocess.run([sys.executable, '-c',
+                        'import jax; print(jax.devices()[0].device_kind)'],
+                       capture_output=True, text=True, timeout=80)
+    print((p.stdout or '').strip())
+except Exception:
+    pass
+EOF
+}
+for i in $(seq 1 72); do
+  out=$(probe)
+  echo "$(date -u +%H:%M:%S) probe $i: $out" >> /root/repo/ladder.log
+  if echo "$out" | grep -q "TPU"; then
+    echo "$(date -u +%H:%M:%S) chip back - running ladder" >> /root/repo/ladder.log
+    python -m benchmarks.decode_budget --batch 64 --ctx 384 --prefill \
+        > /root/repo/decode_budget_r3b.log 2>&1
+    echo "$(date -u +%H:%M:%S) budget done rc=$?" >> /root/repo/ladder.log
+    python tools/kernel_compile_probes.py > /root/repo/kernel_probes.log 2>&1
+    echo "$(date -u +%H:%M:%S) v2/v4/v5 probes done rc=$?" >> /root/repo/ladder.log
+    python tools/prefill_kernel_probe.py >> /root/repo/kernel_probes.log 2>&1
+    echo "$(date -u +%H:%M:%S) prefill probe done rc=$?" >> /root/repo/ladder.log
+    python tools/donation_probe.py > /root/repo/donation_probe.log 2>&1
+    echo "$(date -u +%H:%M:%S) donation probe done rc=$?" >> /root/repo/ladder.log
+    echo "$(date -u +%H:%M:%S) LADDER DATA READY" >> /root/repo/ladder.log
+    exit 0
+  fi
+  sleep 300
+done
+echo "$(date -u +%H:%M:%S) gave up after 72 probes" >> /root/repo/ladder.log

@@ -1,0 +1,39 @@
+"""Does the axon TPU backend honor buffer donation (input/output
+aliasing) for the engine's prefill/decode programs?
+
+Compile-only on a tiny model; run when no bench holds the chip.
+If alias bytes ~= 0 while the CPU build aliases the pools, every engine
+step on the tunnel COPIES the KV pool — which at llama3-1b scale is
+2 GB/call and would explain prefill's 5.6 s/call."""
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+import dataclasses as dc
+
+from xllm_service_tpu.config import EngineConfig, ModelConfig
+from xllm_service_tpu.runtime.engine import Engine
+
+cfg = dc.replace(ModelConfig.tiny(), dtype="bfloat16")
+ecfg = EngineConfig(page_size=8, num_pages=64, max_model_len=64,
+                    max_batch_size=4, max_prefill_tokens=64,
+                    prefill_buckets=(16,))
+eng = Engine(cfg, ecfg, seed=0)
+packed = jnp.zeros((2, 2 + 16 + 4), jnp.int32)
+st_f = jnp.zeros((2, 4), jnp.float32)
+st_i = jnp.zeros((2, 2), jnp.int32)
+key = jax.random.PRNGKey(0)
+
+low = eng._jit_prefill.lower(eng.params, packed, eng.kv, st_f, st_i,
+                             key, None, None, None, None, None, t_len=16)
+comp = low.compile()
+ma = comp.memory_analysis()
+print("PREFILL alias bytes:", ma.alias_size_in_bytes,
+      "out bytes:", ma.output_size_in_bytes,
+      "temp bytes:", ma.temp_size_in_bytes)
+pool_bytes = 2 * eng.kv[0].size * eng.kv[0].dtype.itemsize
+print("pool bytes (k+v):", pool_bytes)
+print("DONATION", "HONORED" if ma.alias_size_in_bytes >= pool_bytes
+      else "NOT HONORED — pools copied every call")

@@ -1,0 +1,40 @@
+"""AOT compile-check: does the V2 transpose-free fold lower on v5e?
+
+Expected to FAIL with "batch dims must be equal" (same dot form that
+killed V3's first version). Run only when no bench holds the chip."""
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+from xllm_service_tpu.ops.pallas.paged_attention import (
+    _paged_decode_attention_impl, _paged_decode_attention_mr_impl,
+    _paged_decode_attention_wide_impl)
+
+B, Hq, Hkv, D, P, ps, MP = 64, 32, 8, 64, 64, 128, 4
+q = jnp.zeros((B, Hq, D), jnp.bfloat16)
+k = jnp.zeros((P, ps, Hkv, D), jnp.bfloat16)
+pt = jnp.zeros((B, MP), jnp.int32)
+ctx = jnp.full((B,), 100, jnp.int32)
+kc = jnp.zeros((B, Hkv, D), jnp.bfloat16)
+
+for name, fn, kw in (
+        ("V2 transpose-free", _paged_decode_attention_impl,
+         dict(interpret=False, transpose_free=True)),
+        ("V4 multirow x8", _paged_decode_attention_mr_impl,
+         dict(interpret=False, rows=8)),
+        ("V4 multirow x16", _paged_decode_attention_mr_impl,
+         dict(interpret=False, rows=16)),
+        ("V5 wide", _paged_decode_attention_wide_impl,
+         dict(interpret=False)),
+):
+    try:
+        jax.jit(lambda *a, fn=fn, kw=kw: fn(*a, **kw)).lower(
+            q, k, k, pt, ctx, kc, kc).compile()
+        print(f"{name}: COMPILE OK")
+    except Exception as e:
+        msg = str(e)
+        i = msg.find("Mosaic")
+        print(f"{name}: FAIL:",
+              (msg[i:i + 400] if i >= 0 else msg[:400]).replace("\n", " "))

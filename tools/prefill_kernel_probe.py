@@ -1,0 +1,30 @@
+"""AOT compile-check of the gated Pallas prefill kernel for v5e.
+
+Compile-only (no execution); run ONLY when no bench holds the chip."""
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+from xllm_service_tpu.ops.pallas.prefill_attention import _impl
+
+B, T, Hq, Hkv, D = 2, 256, 32, 8, 64
+P, PS, MP = 64, 64, 8
+
+q = jnp.zeros((B, T, Hq, D), jnp.bfloat16)
+kf = jnp.zeros((B, T, Hkv, D), jnp.bfloat16)
+kp = jnp.zeros((P, PS, Hkv, D), jnp.bfloat16)
+pt = jnp.zeros((B, MP), jnp.int32)
+qs = jnp.zeros((B,), jnp.int32)
+ln = jnp.full((B,), T, jnp.int32)
+
+try:
+    jax.jit(lambda *a: _impl(*a, q_block=128, interpret=False)).lower(
+        q, kf, kf, kp, kp, pt, qs, ln).compile()
+    print("PREFILL KERNEL: COMPILE OK")
+except Exception as e:
+    msg = str(e)
+    i = msg.find("Mosaic")
+    print("PREFILL KERNEL FAIL:",
+          msg[i:i + 1200] if i >= 0 else msg[:1200])
