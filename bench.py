@@ -322,7 +322,7 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
                 k: os.environ.get(k, "0") for k in
                 ("XLLM_PALLAS", "XLLM_PALLAS_DECODE_V2",
                  "XLLM_PALLAS_DECODE_V3", "XLLM_PALLAS_DECODE_V4",
-                 "XLLM_PALLAS_PREFILL")},
+                 "XLLM_PALLAS_DECODE_V5", "XLLM_PALLAS_PREFILL")},
             "batch": batch, "prompt_len": prompt_len, "gen_len": gen_len,
             "warmup_s": round(warmup_s, 1),
             "tpot_ms": round(tpot_ms, 3),

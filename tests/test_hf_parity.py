@@ -44,7 +44,7 @@ def _make_hf_model(kind: str):
     """A randomly-initialized transformers model of the given flavor."""
     torch.manual_seed({"llama3": 0, "qwen2": 1, "mixtral": 2,
                        "llama_sharded": 3, "qwen3": 4, "phi3": 5,
-                       "mistral": 6}[kind])
+                       "mistral": 6, "mistral_v01": 7, "phi3_swa": 8}[kind])
     if kind in ("llama3", "llama_sharded"):
         cfg = transformers.LlamaConfig(
             **_DIMS, rope_theta=500000.0, tie_word_embeddings=True,
@@ -72,6 +72,21 @@ def _make_hf_model(kind: str):
         cfg = transformers.MistralConfig(**_DIMS, rope_theta=1000000.0,
                                          sliding_window=None)
         model = transformers.MistralForCausalLM(cfg)
+    elif kind == "mistral_v01":
+        # Mistral v0.1 shape: sliding-window attention, window much
+        # smaller than the prompt so the mask is actually exercised.
+        cfg = transformers.MistralConfig(
+            **_DIMS, rope_theta=10000.0, sliding_window=4,
+            attn_implementation="eager")
+        model = transformers.MistralForCausalLM(cfg)
+    elif kind == "phi3_swa":
+        # Real Phi-3 checkpoints declare sliding_window too (mini-4k
+        # ships 2047) — round-3 advisor finding: the window must be
+        # honored for phi3, not just mistral.
+        cfg = transformers.Phi3Config(
+            **_DIMS, rope_theta=10000.0, pad_token_id=0, sliding_window=5,
+            attn_implementation="eager")
+        model = transformers.Phi3ForCausalLM(cfg)
     elif kind == "mixtral":
         cfg = transformers.MixtralConfig(
             **_DIMS, num_local_experts=4, num_experts_per_tok=2,
@@ -107,7 +122,8 @@ def _our_all_logits(cfg, params, prompt):
 
 
 @pytest.mark.parametrize("kind", ["llama3", "qwen2", "qwen3", "phi3",
-                                  "mistral", "mixtral"])
+                                  "mistral", "mistral_v01", "phi3_swa",
+                                  "mixtral"])
 def test_logits_match_torch_oracle(tmp_path, kind):
     """Every prompt position's logits match the torch forward of the same
     HF-written weights (fp32, tight tolerance, argmax everywhere)."""
@@ -180,16 +196,52 @@ def test_rope_scaling_respected(tmp_path):
 
 def test_unsupported_architectures_refused():
     """A config this transformer cannot faithfully run must fail at
-    load (gemma2 layer-body deltas; Mistral v0.1 sliding window) —
-    never silently emit wrong tokens."""
+    load (gemma2 layer-body deltas: alternating local/global layers,
+    soft-capping, extra norms) — never silently emit wrong tokens."""
     base = dict(_DIMS, model_type="gemma2")
     with pytest.raises(ValueError, match="unsupported model_type"):
         ModelConfig.from_hf_config(base)
-    v01 = dict(_DIMS, model_type="mistral", sliding_window=4096)
-    with pytest.raises(ValueError, match="sliding-window"):
-        ModelConfig.from_hf_config(v01)
-    ok = dict(_DIMS, model_type="mistral", sliding_window=None)
-    assert ModelConfig.from_hf_config(ok).num_layers == 2
+
+
+def test_sliding_window_parsed_any_family():
+    """sliding_window is honored for every supported family (real Phi-3
+    files declare it, not just Mistral v0.1), and a window covering the
+    whole position range is normalized to None (inert)."""
+    v01 = dict(_DIMS, model_type="mistral", sliding_window=4096,
+               max_position_embeddings=32768)
+    assert ModelConfig.from_hf_config(v01).sliding_window == 4096
+    phi = dict(_DIMS, model_type="phi3", sliding_window=2047,
+               max_position_embeddings=4096)
+    assert ModelConfig.from_hf_config(phi).sliding_window == 2047
+    full = dict(_DIMS, model_type="mistral", sliding_window=None)
+    assert ModelConfig.from_hf_config(full).sliding_window is None
+    inert = dict(_DIMS, model_type="qwen2", sliding_window=512,
+                 max_position_embeddings=512)
+    assert ModelConfig.from_hf_config(inert).sliding_window is None
+
+
+def test_sliding_window_qwen2_gating():
+    """Qwen2-family semantics: the window is live only when
+    use_sliding_window is true (HF defaults it to FALSE and normalizes
+    the declared window away — e.g. Qwen2.5-7B-Instruct-1M ships
+    sliding_window 32768 with use_sliding_window false); a genuine
+    per-layer mix (0 < max_window_layers < L) must refuse."""
+    base = dict(_DIMS, model_type="qwen2", sliding_window=64,
+                max_position_embeddings=1024)
+    # Declared but disabled (explicitly, and by HF's False default).
+    off = dict(base, use_sliding_window=False)
+    assert ModelConfig.from_hf_config(off).sliding_window is None
+    assert ModelConfig.from_hf_config(base).sliding_window is None
+    # Enabled, uniform (all layers SWA).
+    on = dict(base, use_sliding_window=True, max_window_layers=0)
+    assert ModelConfig.from_hf_config(on).sliding_window == 64
+    # Enabled but every layer full attention — inert.
+    allfull = dict(base, use_sliding_window=True, max_window_layers=2)
+    assert ModelConfig.from_hf_config(allfull).sliding_window is None
+    # Genuine mixed layers: refuse, never approximate.
+    mixed = dict(base, use_sliding_window=True, max_window_layers=1)
+    with pytest.raises(ValueError, match="max_window_layers"):
+        ModelConfig.from_hf_config(mixed)
 
 
 def test_unknown_rope_scaling_refused():
@@ -220,6 +272,39 @@ def test_engine_greedy_matches_hf_greedy(tmp_path):
         max_prefill_tokens=64, prefill_buckets=(8, 16, 32, 64)), params=params)
     eng.add_request(EngineRequest(
         request_id="hf", token_ids=list(prompt),
+        sampling=SamplingParams(max_tokens=steps, temperature=0.0)))
+    got = []
+    for _ in range(200):
+        if not eng.has_work():
+            break
+        for out in eng.step():
+            got.extend(out.new_token_ids)
+    assert got == ref
+
+
+def test_engine_greedy_matches_hf_greedy_sliding_window(tmp_path):
+    """Engine decode over the paged cache applies the sliding-window mask
+    exactly as torch does: greedy continuations match while the context
+    grows well past the window (prompt 6 + 12 steps, W=4)."""
+    model = _make_hf_model("mistral_v01")
+    _save(model, str(tmp_path))
+    cfg, params = _load_ours(str(tmp_path))
+    assert cfg.sliding_window == 4
+
+    prompt = [12, 250, 3, 77, 8, 1]
+    steps = 12
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        for _ in range(steps):
+            nxt = model(ids).logits[0, -1].argmax()
+            ids = torch.cat([ids, nxt.view(1, 1)], dim=1)
+    ref = ids[0, len(prompt):].tolist()
+
+    eng = Engine(cfg, EngineConfig(
+        page_size=4, num_pages=64, max_model_len=128, max_batch_size=2,
+        max_prefill_tokens=64, prefill_buckets=(8, 16, 32, 64)), params=params)
+    eng.add_request(EngineRequest(
+        request_id="swa", token_ids=list(prompt),
         sampling=SamplingParams(max_tokens=steps, temperature=0.0)))
     got = []
     for _ in range(200):

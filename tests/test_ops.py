@@ -118,6 +118,91 @@ def test_mha_prefill_chunked_soft_cap():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_sliding_window_prefill_chunked_matches_dense():
+    """SWA: dense mask ≡ a hand mask, and the chunked flash path (with its
+    below-window chunk skipping) ≡ dense across chunk sizes, cached
+    prefixes, and padding rows."""
+    from xllm_service_tpu.ops.attention import mha_prefill_chunked
+
+    rng = np.random.default_rng(11)
+    B, T, S, Hq, Hkv, D, W = 2, 8, 37, 4, 2, 8, 5
+    q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, Hkv, D)), jnp.float32)
+    q_start = jnp.asarray([20, 0], jnp.int32)
+    kv_len = jnp.asarray([26, 5], jnp.int32)
+    ref = mha_prefill(q, k, v, kv_len, q_start, sliding_window=W)
+    # The window changes the answer vs full attention (mask is live).
+    full = mha_prefill(q, k, v, kv_len, q_start)
+    assert not np.allclose(np.asarray(ref), np.asarray(full))
+    # Hand-rolled check on one (b, t): only the last W positions attend.
+    b, t = 0, 3
+    qp = int(q_start[b]) + t
+    lo = qp - W + 1
+    scores = (np.asarray(q)[b, t].reshape(Hkv, Hq // Hkv, D) @
+              np.asarray(k)[b].transpose(1, 2, 0)) / np.sqrt(D)
+    allowed = (np.arange(S) >= lo) & (np.arange(S) <= qp) & \
+        (np.arange(S) < int(kv_len[b]))
+    scores = np.where(allowed[None, None, :], scores, -1e30)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    hand = (p @ np.asarray(v)[b].transpose(1, 0, 2)).reshape(Hq, D)
+    np.testing.assert_allclose(np.asarray(ref)[b, t], hand,
+                               rtol=1e-4, atol=1e-5)
+    for chunk in (4, 7, 16, 64):
+        got = mha_prefill_chunked(q, k, v, kv_len, q_start,
+                                  chunk_size=chunk, sliding_window=W)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_sliding_window_decode_paths():
+    """Both paged decode variants honor the window: equivalent to dense
+    prefill attention restricted to the last W positions."""
+    from xllm_service_tpu.ops.attention import (
+        paged_decode_attention, paged_decode_attention_current)
+
+    rng = np.random.default_rng(12)
+    P, ps, Hkv, D, Hq, B, W = 8, 4, 2, 8, 4, 2, 3
+    k_pages = jnp.asarray(rng.standard_normal((P, ps, Hkv, D)), jnp.float32)
+    v_pages = jnp.asarray(rng.standard_normal((P, ps, Hkv, D)), jnp.float32)
+    pt = jnp.asarray([[1, 2, 3, 0], [4, 5, 0, 0]], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.float32)
+    ctx = jnp.asarray([10, 6], jnp.int32)       # includes current token
+    got = np.asarray(paged_decode_attention(
+        q, k_pages, v_pages, pt, ctx, sliding_window=W))
+    from xllm_service_tpu.ops.attention import gather_pages
+    k_all = np.asarray(gather_pages(k_pages, pt))
+    v_all = np.asarray(gather_pages(v_pages, pt))
+    for b in range(B):
+        qp = int(ctx[b]) - 1
+        allowed = (np.arange(k_all.shape[1]) > qp - W) & \
+            (np.arange(k_all.shape[1]) <= qp)
+        scores = (np.asarray(q)[b].reshape(Hkv, Hq // Hkv, D) @
+                  k_all[b].transpose(1, 2, 0)) / np.sqrt(D)
+        scores = np.where(allowed[None, None, :], scores, -1e30)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = (p @ v_all[b].transpose(1, 0, 2)).reshape(Hq, D)
+        np.testing.assert_allclose(got[b], ref, rtol=1e-4, atol=1e-5)
+
+    # current-token variant: cache_lens EXcludes the current token whose
+    # K/V ride separately; result must equal the full variant after the
+    # write. Build the written pool then compare.
+    k_cur = jnp.asarray(rng.standard_normal((B, Hkv, D)), jnp.float32)
+    v_cur = jnp.asarray(rng.standard_normal((B, Hkv, D)), jnp.float32)
+    cache_lens = ctx - 1
+    from xllm_service_tpu.ops.attention import write_decode_kv
+    k_w, v_w = write_decode_kv(k_pages, v_pages, k_cur, v_cur, pt,
+                               cache_lens, jnp.ones((B,), bool))
+    want = np.asarray(paged_decode_attention(
+        q, k_w, v_w, pt, ctx, sliding_window=W))
+    got_cur = np.asarray(paged_decode_attention_current(
+        q, k_pages, v_pages, pt, cache_lens, k_cur, v_cur,
+        sliding_window=W))
+    np.testing.assert_allclose(got_cur, want, rtol=1e-4, atol=1e-5)
+
+
 def test_paged_kv_roundtrip_and_decode_attention():
     rng = np.random.default_rng(4)
     P, ps, Hkv, D, Hq = 8, 4, 2, 8, 4

@@ -116,6 +116,13 @@ class ModelConfig:
     # Pure load/save-mapping concern: the in-memory tree keeps separate
     # projections, so compute paths are untouched.
     fused_proj: bool = False
+    # Sliding-window attention width W (Mistral v0.1's 4096, Phi-3-mini's
+    # 2047): each token attends only to the last W positions including
+    # itself. None/0 = full causal attention. Threaded as a static mask
+    # parameter through every attention path (ops/attention.py), so one
+    # transformer body serves both regimes; the Pallas fast paths are
+    # bypassed at trace time when a window is set.
+    sliding_window: Optional[int] = None
     # MoE (0 experts → dense MLP).
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -185,12 +192,24 @@ class ModelConfig:
                    max_position_embeddings=32768)
 
     @classmethod
+    def mistral_7b_v01(cls) -> "ModelConfig":
+        # Mistral-7B v0.1: the original sliding-window checkpoint
+        # (W=4096 over a 32k position range).
+        return cls(name="mistral-7b-v01", vocab_size=32000,
+                   hidden_size=4096, intermediate_size=14336,
+                   num_layers=32, num_heads=32, num_kv_heads=8,
+                   rope_theta=10000.0, max_position_embeddings=32768,
+                   sliding_window=4096)
+
+    @classmethod
     def phi3_mini(cls) -> "ModelConfig":
-        # Phi-3-mini-4k: llama-shaped compute, fused-projection files.
+        # Phi-3-mini-4k: llama-shaped compute, fused-projection files,
+        # sliding window 2047 (as the real config.json declares).
         return cls(name="phi3-mini", vocab_size=32064, hidden_size=3072,
                    intermediate_size=8192, num_layers=32, num_heads=32,
                    num_kv_heads=32, rope_theta=10000.0,
-                   max_position_embeddings=4096, fused_proj=True)
+                   max_position_embeddings=4096, fused_proj=True,
+                   sliding_window=2047)
 
     @classmethod
     def mixtral_8x7b(cls) -> "ModelConfig":
@@ -225,10 +244,36 @@ class ModelConfig:
             raise ValueError(
                 f"unsupported model_type {mt!r} (supported: "
                 f"{', '.join(supported)})")
-        if mt == "mistral" and d.get("sliding_window"):
-            raise ValueError(
-                "sliding-window attention is not implemented; Mistral "
-                "v0.2+ checkpoints (sliding_window: null) only")
+        # sliding_window is honored for ANY supported model_type — real
+        # Phi-3 checkpoints declare it too (Phi-3-mini-4k ships 2047), not
+        # just Mistral v0.1 (round-3 advisor finding). A declared window
+        # at least max_position_embeddings is inert and normalized away so
+        # the full-attention fast paths stay eligible.
+        sw = d.get("sliding_window") or None
+        if sw is not None and mt in ("qwen2", "qwen3") \
+                and not d.get("use_sliding_window", False):
+            # Qwen2-family raw config.json declares-but-disables the
+            # window (e.g. Qwen2.5-7B-Instruct-1M: sliding_window 32768,
+            # use_sliding_window false — and HF's default for the gate is
+            # False, so an omitted key also means full attention): HF
+            # torch normalizes it to None; so must we. Mistral/Phi-3
+            # have no gate — a set window is always live there.
+            sw = None
+        if sw is not None and sw >= d.get("max_position_embeddings", 4096):
+            sw = None
+        if sw is not None:
+            # Qwen2-family per-layer windows: the first max_window_layers
+            # layers run FULL attention, the rest SWA. A uniform window
+            # can express the all-SWA (0) and all-full (>= L) extremes
+            # only; a genuine mix must refuse, not approximate.
+            mwl = d.get("max_window_layers")
+            L = d["num_hidden_layers"]
+            if mwl is not None and 0 < mwl < L:
+                raise ValueError(
+                    f"per-layer sliding window (max_window_layers={mwl} "
+                    f"of {L}) is not implemented")
+            if mwl is not None and mwl >= L:
+                sw = None           # every layer full attention — inert
         return cls(
             name=name,
             vocab_size=d["vocab_size"],
@@ -246,6 +291,7 @@ class ModelConfig:
                                  d.get("model_type") == "qwen2"),
             qk_norm=d.get("model_type") == "qwen3",
             fused_proj=d.get("model_type") == "phi3",
+            sliding_window=sw,
             num_experts=d.get("num_local_experts", 0),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             rope_scaling=cls._parse_rope_scaling(d.get("rope_scaling")),

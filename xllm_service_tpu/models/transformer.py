@@ -244,7 +244,7 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         # streams pool pages + fresh blocks directly (no gathered-view
         # materialization); the XLA reference gathers then overlays.
         B, T = tokens.shape
-        if _use_prefill_kernel(T, kp.shape[1]):
+        if not cfg.sliding_window and _use_prefill_kernel(T, kp.shape[1]):
             from xllm_service_tpu.ops.pallas import (
                 paged_prefill_attention_pallas)
             attn = paged_prefill_attention_pallas(
@@ -254,7 +254,8 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                      start_pos)
             v_all = overlay_fresh_kv(gather_pages(vp, page_table), v,
                                      start_pos)
-            attn = mha_prefill_auto(q, k_all, v_all, kv_lengths, start_pos)
+            attn = mha_prefill_auto(q, k_all, v_all, kv_lengths, start_pos,
+                                    sliding_window=cfg.sliding_window or 0)
         x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
         h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
         m, dropped = _mlp(lp, cfg, h, valid=tok_valid)
@@ -330,6 +331,13 @@ def forward_prefill_ring(params: Params, cfg: ModelConfig,
     from xllm_service_tpu.parallel.mesh import AXIS_TP
     from xllm_service_tpu.parallel.ring import ring_attention_sharded
 
+    if cfg.sliding_window:
+        # Ring rotation assumes full causal reach; SWA long prompts take
+        # the chunked-window path (whose flash fold skips out-of-window
+        # chunks, so the work is O(T·W) there anyway).
+        raise NotImplementedError(
+            "ring prefill does not implement sliding-window masks")
+
     k_pages, v_pages = kv
     B, T = tokens.shape
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))     # [B, T, D]
@@ -401,7 +409,8 @@ def forward_embedding(params: Params, cfg: ModelConfig,
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn = mha_prefill(q, k, v, lengths,
-                           jnp.zeros((B,), jnp.int32))
+                           jnp.zeros((B,), jnp.int32),
+                           sliding_window=cfg.sliding_window or 0)
         x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
         h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
         x = x + _mlp(lp, cfg, h, valid=tok_valid)[0]
@@ -447,7 +456,8 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         # the pool as scan ys would rewrite the whole pool per step).
         attn = paged_decode_attention_current_auto(
             q[:, 0], kp, vp, page_table, cache_lens,
-            k[:, 0], v[:, 0])                                    # [B,Hq,Dh]
+            k[:, 0], v[:, 0],
+            sliding_window=cfg.sliding_window or 0)              # [B,Hq,Dh]
         B = tokens.shape[0]
         x = x + (attn.reshape(B, 1, -1) @ lp["o_proj"])
         h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)

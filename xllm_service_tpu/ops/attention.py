@@ -171,13 +171,17 @@ def _group_heads(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
 
 def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 kv_lengths: jnp.ndarray, q_start: jnp.ndarray,
-                logits_soft_cap: float = 0.0) -> jnp.ndarray:
+                logits_soft_cap: float = 0.0,
+                sliding_window: int = 0) -> jnp.ndarray:
     """Causal GQA attention for prefill.
 
     q: [B, T, Hq, D] — the new tokens, at global positions q_start[b] + t.
     k/v: [B, S, Hkv, D] with S >= T — cached prefix (prefix-cache hit)
       concatenated with the fresh tokens; kv position j is global position j.
     kv_lengths: [B] — valid kv length per sequence (= q_start + true T).
+    ``sliding_window`` W > 0 (static) restricts each query to the last W
+    key positions including itself (HF semantics: kv_pos > q_pos − W), the
+    Mistral-v0.1 / Phi-3 mask.
     Returns [B, T, Hq, D].
     """
     B, T, Hq, D = q.shape
@@ -194,6 +198,8 @@ def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     causal = kv_pos[:, None, :] <= q_pos[:, :, None]                    # [B, T, S]
     in_range = kv_pos < kv_lengths[:, None]                             # [B, S]
     mask = causal & in_range[:, None, :]                                # [B, T, S]
+    if sliding_window > 0:
+        mask &= kv_pos[:, None, :] > q_pos[:, :, None] - sliding_window
     logits = jnp.where(mask[:, None, None, :, :], logits, _NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhgts,bshd->bthgd", p.astype(v.dtype), v)
@@ -236,7 +242,8 @@ def flash_finalize(o: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:
 def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         kv_lengths: jnp.ndarray, q_start: jnp.ndarray,
                         logits_soft_cap: float = 0.0,
-                        chunk_size: int = 512) -> jnp.ndarray:
+                        chunk_size: int = 512,
+                        sliding_window: int = 0) -> jnp.ndarray:
     """Flash-style causal GQA prefill: O(T · chunk) logits memory.
 
     Same contract as ``mha_prefill`` but instead of materializing the full
@@ -254,7 +261,8 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     S, Hkv = k.shape[1], k.shape[2]
     G = Hq // Hkv
     if S <= chunk_size:
-        return mha_prefill(q, k, v, kv_lengths, q_start, logits_soft_cap)
+        return mha_prefill(q, k, v, kv_lengths, q_start, logits_soft_cap,
+                           sliding_window)
 
     nC = (S + chunk_size - 1) // chunk_size
     pad = nC * chunk_size - S
@@ -271,8 +279,12 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
     q_pos = q_start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B,T]
     # Highest query position in the batch: chunks starting beyond it are
-    # fully masked for every row and can skip their compute.
+    # fully masked for every row and can skip their compute. With a
+    # sliding window, chunks entirely below every row's window (kv_pos <=
+    # min(q_start) − W for all slots) skip likewise — long-context SWA
+    # prefill then does O(T·W) attention work, not O(T·S).
     max_q_pos = jnp.max(q_pos)
+    min_q_pos = jnp.min(q_pos[:, 0])
 
     o0 = jnp.zeros((B, T, Hkv, G, D), jnp.float32)
     m0 = jnp.full((B, T, Hkv, G), _NEG_INF, jnp.float32)
@@ -287,11 +299,18 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             k_pos = base + jnp.arange(chunk_size, dtype=jnp.int32)  # [C]
             causal = k_pos[None, None, :] <= q_pos[:, :, None]      # [B,T,C]
             in_range = k_pos[None, :] < kv_lengths[:, None]         # [B,C]
-            mask = (causal & in_range[:, None, :])[:, :, None, None, :]
+            btc = causal & in_range[:, None, :]
+            if sliding_window > 0:
+                btc &= k_pos[None, None, :] > (q_pos[:, :, None]
+                                               - sliding_window)
+            mask = btc[:, :, None, None, :]
             return flash_fold(o, m, l, qg, kb, vb, mask, scale,
                               logits_soft_cap)
 
-        o, m, l = jax.lax.cond(base <= max_q_pos, compute,
+        relevant = base <= max_q_pos
+        if sliding_window > 0:
+            relevant &= base + chunk_size - 1 > min_q_pos - sliding_window
+        o, m, l = jax.lax.cond(relevant, compute,
                                lambda _: (o, m, l), None)
         return (o, m, l), None
 
@@ -303,7 +322,8 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def mha_prefill_auto(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      kv_lengths: jnp.ndarray, q_start: jnp.ndarray,
-                     logits_soft_cap: float = 0.0) -> jnp.ndarray:
+                     logits_soft_cap: float = 0.0,
+                     sliding_window: int = 0) -> jnp.ndarray:
     """Trace-time dispatch for prefill attention, by SCORE-TENSOR BYTES
     (4·B·Hq·T·S), not sequence length alone: at the batched-prefill
     bench shape (B=64, T=128, S=512) an S-only cutoff picked the dense
@@ -317,12 +337,14 @@ def mha_prefill_auto(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     S = k.shape[1]
     score_bytes = 4 * B * Hq * T * S
     if score_bytes <= 64 * 1024 * 1024:
-        return mha_prefill(q, k, v, kv_lengths, q_start, logits_soft_cap)
+        return mha_prefill(q, k, v, kv_lengths, q_start, logits_soft_cap,
+                           sliding_window)
     per_pos = 4 * B * Hq * T                 # score bytes per kv position
     chunk = (32 * 1024 * 1024) // max(per_pos, 1)
     chunk = max(128, min(1024, (chunk // 128) * 128))
     return mha_prefill_chunked(q, k, v, kv_lengths, q_start,
-                               logits_soft_cap, chunk_size=chunk)
+                               logits_soft_cap, chunk_size=chunk,
+                               sliding_window=sliding_window)
 
 
 def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
@@ -330,7 +352,8 @@ def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
                                    page_table: jnp.ndarray,
                                    cache_lens: jnp.ndarray,
                                    k_cur: jnp.ndarray, v_cur: jnp.ndarray,
-                                   logits_soft_cap: float = 0.0
+                                   logits_soft_cap: float = 0.0,
+                                   sliding_window: int = 0
                                    ) -> jnp.ndarray:
     """Decode attention over the cache PLUS the current token's K/V held
     in-registers (XLA reference path).
@@ -360,8 +383,13 @@ def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
     S1 = k.shape[1]
     pos = jnp.arange(S1, dtype=jnp.int32)[None, :]
     # Cache positions < cache_lens valid; the appended slot (index S1-1)
-    # is the current token, always valid.
-    mask = (pos < cache_lens[:, None]) | (pos == S1 - 1)
+    # is the current token, always valid (with W > 0 it sits at logical
+    # position cache_lens, trivially inside its own window). Cache slot j
+    # holds logical position j, so the window keeps j > cache_lens − W.
+    in_cache = pos < cache_lens[:, None]
+    if sliding_window > 0:
+        in_cache &= pos > cache_lens[:, None] - sliding_window
+    mask = in_cache | (pos == S1 - 1)
     logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhgs,bshd->bhgd", p.astype(v.dtype), v)
@@ -370,9 +398,12 @@ def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
 
 def paged_decode_attention_current_auto(q, k_pages, v_pages, page_table,
                                         cache_lens, k_cur, v_cur,
-                                        logits_soft_cap: float = 0.0):
-    """Trace-time dispatch for the current-token variant."""
-    if logits_soft_cap == 0.0:
+                                        logits_soft_cap: float = 0.0,
+                                        sliding_window: int = 0):
+    """Trace-time dispatch for the current-token variant. The Pallas
+    kernels implement neither soft-cap nor windowed masks, so either
+    feature routes to the XLA reference path."""
+    if logits_soft_cap == 0.0 and sliding_window == 0:
         from xllm_service_tpu.ops import pallas
         if pallas.enabled():
             return pallas.paged_decode_attention_pallas(
@@ -380,30 +411,33 @@ def paged_decode_attention_current_auto(q, k_pages, v_pages, page_table,
                 k_cur=k_cur, v_cur=v_cur)
     return paged_decode_attention_current(
         q, k_pages, v_pages, page_table, cache_lens, k_cur, v_cur,
-        logits_soft_cap)
+        logits_soft_cap, sliding_window)
 
 
 def paged_decode_attention_auto(q: jnp.ndarray, k_pages: jnp.ndarray,
                                 v_pages: jnp.ndarray,
                                 page_table: jnp.ndarray,
                                 context_lens: jnp.ndarray,
-                                logits_soft_cap: float = 0.0
+                                logits_soft_cap: float = 0.0,
+                                sliding_window: int = 0
                                 ) -> jnp.ndarray:
     """Trace-time dispatch: fused Pallas kernel on TPU (XLLM_PALLAS
     overrides), XLA gather-then-attend reference elsewhere."""
-    if logits_soft_cap == 0.0:
+    if logits_soft_cap == 0.0 and sliding_window == 0:
         from xllm_service_tpu.ops import pallas
         if pallas.enabled():
             return pallas.paged_decode_attention_pallas(
                 q, k_pages, v_pages, page_table, context_lens)
     return paged_decode_attention(q, k_pages, v_pages, page_table,
-                                  context_lens, logits_soft_cap)
+                                  context_lens, logits_soft_cap,
+                                  sliding_window)
 
 
 def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
                            v_pages: jnp.ndarray, page_table: jnp.ndarray,
                            context_lens: jnp.ndarray,
-                           logits_soft_cap: float = 0.0) -> jnp.ndarray:
+                           logits_soft_cap: float = 0.0,
+                           sliding_window: int = 0) -> jnp.ndarray:
     """Single-token GQA attention against the paged cache (XLA reference path).
 
     q: [B, Hq, D]; page_table: [B, max_pages]; context_lens: [B] (number of
@@ -420,7 +454,12 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     if logits_soft_cap > 0.0:
         logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
     S = k.shape[1]
-    mask = jnp.arange(S, dtype=jnp.int32)[None, :] < context_lens[:, None]
+    pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+    mask = pos < context_lens[:, None]
+    if sliding_window > 0:
+        # context_lens INcludes the current token (query position is
+        # context_lens − 1): keep j > (context_lens − 1) − W.
+        mask &= pos > context_lens[:, None] - 1 - sliding_window
     logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhgs,bshd->bhgd", p.astype(v.dtype), v)

@@ -106,6 +106,7 @@ _MODEL_REGISTRY = {
     "qwen3-8b": ModelConfig.qwen3_8b,
     "phi3-mini": ModelConfig.phi3_mini,
     "mistral-7b": ModelConfig.mistral_7b,
+    "mistral-7b-v01": ModelConfig.mistral_7b_v01,
     "mixtral-8x7b": ModelConfig.mixtral_8x7b,
     "tiny-moe": lambda: ModelConfig.tiny(num_experts=4),
 }
@@ -1610,12 +1611,26 @@ class Worker:
             wire.release(uuid, drain=not msg.startswith("wire-pull:"),
                          leaked=msg.startswith("wire-pull:"))
             return None
-        # Any other verdict means the peer's pull completed (it pulls
-        # before adopting): the staged block was consumed.
-        wire.release(uuid)
-        self.kv_migration_bytes += 2 * int(k.nbytes)
-        self.kv_migration_seconds += time.monotonic() - t0
-        self.kv_migration_device_wire += 1
+        code = err.get("code")
+        if code == 400:
+            # Meta rejected before pull_block ever ran (bad/missing meta
+            # line): the staged block is provably untouched — drain it.
+            # A plain release here would leave it pinned server-side and
+            # uncounted (round-3 advisor finding).
+            wire.release(uuid, drain=True)
+        elif code is None or code == 503:
+            # Success (accepted / SSE stream) or post-pull refusal (503
+            # no-capacity / model-asleep happens after the peer's pull
+            # completed): the staged block was consumed.
+            wire.release(uuid)
+        else:
+            # Unknown failure (e.g. a 500 mid-handler): pull state is
+            # ambiguous — keep the pinned-block metric truthful.
+            wire.release(uuid, leaked=True)
+        if code is None:
+            self.kv_migration_bytes += 2 * int(k.nbytes)
+            self.kv_migration_seconds += time.monotonic() - t0
+            self.kv_migration_device_wire += 1
         return self._finish_migration(
             live, decode_name, tokens, head, chunks, parsed,
             lambda: (np.asarray(jax.device_get(k)),
@@ -1643,6 +1658,11 @@ class Worker:
         }
         ok, dlive, first_out, drt = peer.adopt_migrated(meta, k, v)
         if not ok:
+            if dlive is not None and dlive.stream_to_service:
+                # Idempotent duplicate: the earlier adoption is live and
+                # streaming to the service already.
+                return Response.json({"status": "accepted",
+                                      "service_request_id": srid})
             # Nothing actually transferred — don't pollute the gbps gauge.
             logger.warning("direct kv migration to %s refused; decoding "
                            "locally", peer.name)
@@ -1863,9 +1883,12 @@ class Worker:
                 # A transport ambiguity (e.g. prefill-side timeout, then
                 # host-shuttle retry) must not adopt the same sequence
                 # twice — two running slots would stream duplicate
-                # outputs for one request.
+                # outputs for one request. The existing live is returned
+                # so callers can answer idempotently when it is already
+                # streaming to the service (a 503 would push the prefill
+                # side into a competing local decode).
                 logger.warning("duplicate kv import for %s refused", srid)
-                return False, None, None, rt
+                return False, self._live_srid[srid], None, rt
             self._live[srid] = live
             self._live_srid[srid] = live
         first_out = RequestOutput(
@@ -1935,6 +1958,15 @@ class Worker:
             return Response.error(503,
                                   f"model {meta.get('model')!r} asleep")
         if not ok:
+            if live is not None and live.stream_to_service:
+                # Duplicate import whose original adoption is live and
+                # already streaming to the service: idempotent accept —
+                # that adoption serves the request (round-3 advisor
+                # finding: a 503 here spawned a competing local decode,
+                # one request → two output streams).
+                return Response.json({
+                    "status": "accepted",
+                    "service_request_id": meta["service_request_id"]})
             return Response.error(503, "no capacity on decode instance")
         srid = meta["service_request_id"]
         if live.stream_to_service:
