@@ -1,0 +1,888 @@
+// xllm_etcd — a standalone etcd-v3-JSON-gateway-compatible coordination
+// server, so the coordination plane can be deployed (and contract-tested)
+// without an external etcd install.
+//
+// The reference hard-requires a real etcd cluster and FATALs without one
+// (reference: xllm_service/scheduler/etcd_client/etcd_client.cpp:24-33).
+// This binary serves the subset of etcd's v3 gRPC-gateway JSON API that
+// the rebuild's EtcdStore client speaks (service/etcd_store.py):
+//
+//   POST /v3/kv/put           {key, value, lease?}            (b64 keys)
+//   POST /v3/kv/range         {key, range_end?}
+//   POST /v3/kv/deleterange   {key, range_end?}
+//   POST /v3/kv/txn           create-if-absent election txn
+//   POST /v3/lease/grant      {TTL}
+//   POST /v3/lease/keepalive  {ID}
+//   POST /v3/kv/lease/revoke  {ID}   (and /v3/lease/revoke)
+//   POST /v3/watch            streaming: created line, event batches,
+//                             progress keepalives, compaction cancel
+//
+// Semantics implemented independently from the Python client/mock (this
+// is the point: the client must not be validated only against a mock
+// sharing its author's assumptions): a global revision counter bumped
+// per mutation, per-key create/mod revisions, TTL leases whose expiry
+// deletes attached keys with watchable DELETE events, a bounded event
+// history whose overflow surfaces as etcd's compact_revision watch
+// cancel (exercising the client's resync path).
+//
+// Build: g++ -O2 -std=c++17 -pthread csrc/xllm_etcd.cpp -o xllm_etcd
+// Run:   xllm_etcd [port]   — prints "LISTENING <port>" on stdout.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+// ---------------------------------------------------------------------------
+// base64
+// ---------------------------------------------------------------------------
+
+const char kB64[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+std::string b64_encode(const std::string& in) {
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    uint32_t n = (uint8_t(in[i]) << 16) | (uint8_t(in[i + 1]) << 8) |
+                 uint8_t(in[i + 2]);
+    out += kB64[(n >> 18) & 63];
+    out += kB64[(n >> 12) & 63];
+    out += kB64[(n >> 6) & 63];
+    out += kB64[n & 63];
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    uint32_t n = uint8_t(in[i]) << 16;
+    out += kB64[(n >> 18) & 63];
+    out += kB64[(n >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    uint32_t n = (uint8_t(in[i]) << 16) | (uint8_t(in[i + 1]) << 8);
+    out += kB64[(n >> 18) & 63];
+    out += kB64[(n >> 12) & 63];
+    out += kB64[(n >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+
+int b64_val(char c) {
+  if (c >= 'A' && c <= 'Z') return c - 'A';
+  if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+  if (c >= '0' && c <= '9') return c - '0' + 52;
+  if (c == '+') return 62;
+  if (c == '/') return 63;
+  return -1;
+}
+
+std::string b64_decode(const std::string& in) {
+  std::string out;
+  int buf = 0, bits = 0;
+  for (char c : in) {
+    if (c == '=' || c == '\n' || c == '\r') continue;
+    int v = b64_val(c);
+    if (v < 0) continue;
+    buf = (buf << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out += char((buf >> bits) & 0xFF);
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Minimal JSON (parse the request subset; emit via escape helpers)
+// ---------------------------------------------------------------------------
+
+struct Json {
+  enum Type { kNull, kBool, kNum, kStr, kArr, kObj } type = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  const Json* find(const std::string& k) const {
+    if (type != kObj) return nullptr;
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+  // etcd's gateway carries int64s as JSON strings; accept both forms.
+  int64_t as_i64() const {
+    if (type == kStr) return strtoll(str.c_str(), nullptr, 10);
+    if (type == kNum) return int64_t(num);
+    return 0;
+  }
+  std::string s_or(const std::string& d = "") const {
+    return type == kStr ? str : d;
+  }
+};
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JsonParser(const std::string& s)
+      : p(s.data()), end(s.data() + s.size()) {}
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool lit(const char* s) {
+    size_t n = strlen(s);
+    if (size_t(end - p) >= n && memcmp(p, s, n) == 0) {
+      p += n;
+      return true;
+    }
+    ok = false;
+    return false;
+  }
+  Json parse() {
+    skip_ws();
+    Json j;
+    if (p >= end) {
+      ok = false;
+      return j;
+    }
+    switch (*p) {
+      case '{': {
+        j.type = Json::kObj;
+        ++p;
+        skip_ws();
+        if (p < end && *p == '}') {
+          ++p;
+          return j;
+        }
+        while (ok && p < end) {
+          skip_ws();
+          if (p >= end || *p != '"') {
+            ok = false;
+            break;
+          }
+          std::string key = parse_string();
+          skip_ws();
+          if (p >= end || *p != ':') {
+            ok = false;
+            break;
+          }
+          ++p;
+          j.obj[key] = parse();
+          skip_ws();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          if (p < end && *p == '}') {
+            ++p;
+            break;
+          }
+          ok = false;
+          break;
+        }
+        return j;
+      }
+      case '[': {
+        j.type = Json::kArr;
+        ++p;
+        skip_ws();
+        if (p < end && *p == ']') {
+          ++p;
+          return j;
+        }
+        while (ok && p < end) {
+          j.arr.push_back(parse());
+          skip_ws();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          if (p < end && *p == ']') {
+            ++p;
+            break;
+          }
+          ok = false;
+          break;
+        }
+        return j;
+      }
+      case '"':
+        j.type = Json::kStr;
+        j.str = parse_string();
+        return j;
+      case 't':
+        j.type = Json::kBool;
+        j.b = true;
+        lit("true");
+        return j;
+      case 'f':
+        j.type = Json::kBool;
+        j.b = false;
+        lit("false");
+        return j;
+      case 'n':
+        lit("null");
+        return j;
+      default: {
+        j.type = Json::kNum;
+        char* q = nullptr;
+        j.num = strtod(p, &q);
+        if (q == p)
+          ok = false;
+        else
+          p = q;
+        return j;
+      }
+    }
+  }
+  std::string parse_string() {
+    std::string out;
+    if (p >= end || *p != '"') {
+      ok = false;
+      return out;
+    }
+    ++p;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            if (p + 4 < end) {
+              unsigned code = 0;
+              sscanf(p + 1, "%4x", &code);
+              p += 4;
+              // UTF-8 encode the BMP code point (keys/values are b64, so
+              // non-ASCII only appears in foreign clients' whitespace).
+              if (code < 0x80) {
+                out += char(code);
+              } else if (code < 0x800) {
+                out += char(0xC0 | (code >> 6));
+                out += char(0x80 | (code & 0x3F));
+              } else {
+                out += char(0xE0 | (code >> 12));
+                out += char(0x80 | ((code >> 6) & 0x3F));
+                out += char(0x80 | (code & 0x3F));
+              }
+            }
+            break;
+          }
+          default: out += *p;
+        }
+        ++p;
+      } else {
+        out += *p++;
+      }
+    }
+    if (p < end) ++p;  // closing quote
+    else ok = false;
+    return out;
+  }
+};
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (uint8_t(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+std::string qs(const std::string& s) { return "\"" + json_escape(s) + "\""; }
+std::string qi(int64_t v) { return "\"" + std::to_string(v) + "\""; }
+
+// ---------------------------------------------------------------------------
+// Store
+// ---------------------------------------------------------------------------
+
+struct KVEntry {
+  std::string value;
+  int64_t create_rev = 0;
+  int64_t mod_rev = 0;
+  int64_t lease = 0;
+};
+
+struct Event {
+  int64_t rev;
+  bool is_delete;
+  std::string key;
+  std::string value;
+};
+
+struct Lease {
+  double ttl_s = 0;
+  Clock::time_point expires;
+  std::set<std::string> keys;
+};
+
+class Store {
+ public:
+  explicit Store(size_t history_cap) : history_cap_(history_cap) {}
+
+  std::mutex mu;
+  std::condition_variable cv;
+
+  int64_t put(const std::string& key, const std::string& value,
+              int64_t lease_id) {
+    std::lock_guard<std::mutex> g(mu);
+    return put_locked(key, value, lease_id);
+  }
+
+  int64_t put_locked(const std::string& key, const std::string& value,
+                     int64_t lease_id) {
+    ++revision_;
+    auto& e = kvs_[key];
+    if (e.create_rev == 0) e.create_rev = revision_;
+    e.value = value;
+    e.mod_rev = revision_;
+    if (e.lease && e.lease != lease_id) {
+      auto it = leases_.find(e.lease);
+      if (it != leases_.end()) it->second.keys.erase(key);
+    }
+    e.lease = lease_id;
+    if (lease_id) {
+      auto it = leases_.find(lease_id);
+      if (it != leases_.end()) it->second.keys.insert(key);
+    }
+    push_event({revision_, false, key, value});
+    return revision_;
+  }
+
+  // [key, range_end) scan; empty range_end = exact key; "\0" = unbounded.
+  std::vector<std::pair<std::string, KVEntry>> range(
+      const std::string& key, const std::string& range_end, bool has_end) {
+    std::lock_guard<std::mutex> g(mu);
+    std::vector<std::pair<std::string, KVEntry>> out;
+    if (!has_end) {
+      auto it = kvs_.find(key);
+      if (it != kvs_.end()) out.emplace_back(*it);
+      return out;
+    }
+    bool unbounded = range_end == std::string(1, '\0');
+    for (auto it = kvs_.lower_bound(key); it != kvs_.end(); ++it) {
+      if (!unbounded && it->first >= range_end) break;
+      out.emplace_back(*it);
+    }
+    return out;
+  }
+
+  int64_t delete_range(const std::string& key, const std::string& range_end,
+                       bool has_end) {
+    std::lock_guard<std::mutex> g(mu);
+    std::vector<std::string> doomed;
+    if (!has_end) {
+      if (kvs_.count(key)) doomed.push_back(key);
+    } else {
+      bool unbounded = range_end == std::string(1, '\0');
+      for (auto it = kvs_.lower_bound(key); it != kvs_.end(); ++it) {
+        if (!unbounded && it->first >= range_end) break;
+        doomed.push_back(it->first);
+      }
+    }
+    for (const auto& k : doomed) erase_key_locked(k);
+    return int64_t(doomed.size());
+  }
+
+  bool compare_create(const std::string& key, const std::string& value,
+                      int64_t lease_id) {
+    // Atomic under ONE lock hold — this is the leader-election txn; a
+    // check/put gap would let two campaigns both win.
+    std::lock_guard<std::mutex> g(mu);
+    if (kvs_.count(key)) return false;
+    put_locked(key, value, lease_id);
+    return true;
+  }
+
+  int64_t lease_grant(int64_t ttl_s) {
+    std::lock_guard<std::mutex> g(mu);
+    int64_t id = next_lease_++;
+    Lease l;
+    l.ttl_s = double(ttl_s);
+    l.expires = Clock::now() + std::chrono::milliseconds(ttl_s * 1000);
+    leases_[id] = l;
+    return id;
+  }
+
+  bool lease_keepalive(int64_t id, int64_t* ttl_out) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = leases_.find(id);
+    if (it == leases_.end()) return false;
+    it->second.expires =
+        Clock::now() +
+        std::chrono::milliseconds(int64_t(it->second.ttl_s * 1000));
+    *ttl_out = int64_t(it->second.ttl_s);
+    return true;
+  }
+
+  void lease_revoke(int64_t id) {
+    std::lock_guard<std::mutex> g(mu);
+    revoke_locked(id);
+  }
+
+  void sweep_expired() {
+    std::lock_guard<std::mutex> g(mu);
+    auto now = Clock::now();
+    std::vector<int64_t> doomed;
+    for (auto& [id, l] : leases_)
+      if (l.expires <= now) doomed.push_back(id);
+    for (int64_t id : doomed) revoke_locked(id);
+  }
+
+  int64_t revision() {
+    std::lock_guard<std::mutex> g(mu);
+    return revision_;
+  }
+
+  // Events with rev >= from_rev under [key, range_end). Returns false and
+  // sets *compact_rev when from_rev predates retained history.
+  bool events_from(int64_t from_rev, const std::string& key,
+                   const std::string& range_end, std::vector<Event>* out,
+                   int64_t* compact_rev, int64_t* current_rev) {
+    // mu must be held by caller (watch loop waits on cv with it).
+    *current_rev = revision_;
+    if (from_rev && !events_.empty() && from_rev < events_.front().rev &&
+        from_rev <= compacted_rev_) {
+      *compact_rev = compacted_rev_;
+      return false;
+    }
+    if (from_rev && events_.empty() && from_rev <= compacted_rev_) {
+      *compact_rev = compacted_rev_;
+      return false;
+    }
+    bool unbounded = range_end == std::string(1, '\0');
+    for (const auto& e : events_) {
+      if (e.rev < from_rev) continue;
+      if (e.key < key) continue;
+      if (!unbounded && !range_end.empty() && e.key >= range_end) continue;
+      if (range_end.empty() && e.key != key) continue;
+      out->push_back(e);
+    }
+    return true;
+  }
+
+ private:
+  void erase_key_locked(const std::string& key) {
+    auto it = kvs_.find(key);
+    if (it == kvs_.end()) return;
+    if (it->second.lease) {
+      auto lit = leases_.find(it->second.lease);
+      if (lit != leases_.end()) lit->second.keys.erase(key);
+    }
+    kvs_.erase(it);
+    ++revision_;
+    push_event({revision_, true, key, ""});
+  }
+
+  void revoke_locked(int64_t id) {
+    auto it = leases_.find(id);
+    if (it == leases_.end()) return;
+    std::set<std::string> keys = it->second.keys;
+    leases_.erase(it);
+    for (const auto& k : keys) erase_key_locked(k);
+  }
+
+  void push_event(Event e) {
+    events_.push_back(std::move(e));
+    while (events_.size() > history_cap_) {
+      compacted_rev_ = events_.front().rev;
+      events_.pop_front();
+    }
+    cv.notify_all();
+  }
+
+  std::map<std::string, KVEntry> kvs_;
+  std::map<int64_t, Lease> leases_;
+  std::deque<Event> events_;
+  size_t history_cap_;
+  int64_t compacted_rev_ = 0;
+  int64_t revision_ = 0;
+  int64_t next_lease_ = 7000;
+};
+
+// ---------------------------------------------------------------------------
+// HTTP plumbing
+// ---------------------------------------------------------------------------
+
+bool send_all(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += size_t(n);
+  }
+  return true;
+}
+
+bool send_response(int fd, int status, const std::string& body) {
+  const char* reason = status == 200 ? "OK"
+                       : status == 404 ? "Not Found"
+                                       : "Bad Request";
+  std::string head = "HTTP/1.1 " + std::to_string(status) + " " + reason +
+                     "\r\nContent-Type: application/json\r\nContent-Length: " +
+                     std::to_string(body.size()) +
+                     "\r\nConnection: keep-alive\r\n\r\n";
+  return send_all(fd, head + body);
+}
+
+bool send_chunk(int fd, const std::string& data) {
+  char len[32];
+  snprintf(len, sizeof len, "%zx\r\n", data.size());
+  return send_all(fd, std::string(len) + data + "\r\n");
+}
+
+struct Request {
+  std::string method;
+  std::string path;
+  std::string body;
+};
+
+// Reads one HTTP/1.1 request (headers + Content-Length body) from fd.
+bool read_request(int fd, std::string* buf, Request* out) {
+  size_t hdr_end;
+  char tmp[8192];
+  while ((hdr_end = buf->find("\r\n\r\n")) == std::string::npos) {
+    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+    if (n <= 0) return false;
+    buf->append(tmp, size_t(n));
+    if (buf->size() > (64u << 20)) return false;
+  }
+  std::string head = buf->substr(0, hdr_end);
+  size_t line_end = head.find("\r\n");
+  std::string req_line = head.substr(0, line_end);
+  size_t sp1 = req_line.find(' ');
+  size_t sp2 = req_line.find(' ', sp1 + 1);
+  if (sp1 == std::string::npos || sp2 == std::string::npos) return false;
+  out->method = req_line.substr(0, sp1);
+  out->path = req_line.substr(sp1 + 1, sp2 - sp1 - 1);
+
+  size_t content_len = 0;
+  size_t pos = line_end + 2;
+  while (pos < head.size()) {
+    size_t eol = head.find("\r\n", pos);
+    if (eol == std::string::npos) eol = head.size();
+    std::string line = head.substr(pos, eol - pos);
+    size_t colon = line.find(':');
+    if (colon != std::string::npos) {
+      std::string name = line.substr(0, colon);
+      for (auto& c : name) c = char(tolower(c));
+      if (name == "content-length")
+        content_len = strtoul(line.c_str() + colon + 1, nullptr, 10);
+    }
+    pos = eol + 2;
+  }
+  size_t total = hdr_end + 4 + content_len;
+  while (buf->size() < total) {
+    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+    if (n <= 0) return false;
+    buf->append(tmp, size_t(n));
+  }
+  out->body = buf->substr(hdr_end + 4, content_len);
+  buf->erase(0, total);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Handlers
+// ---------------------------------------------------------------------------
+
+Store* g_store = nullptr;
+std::atomic<bool> g_stop{false};
+
+std::string kvs_json(const std::vector<std::pair<std::string, KVEntry>>& kvs) {
+  std::string out = "[";
+  bool first = true;
+  for (const auto& [k, e] : kvs) {
+    if (!first) out += ",";
+    first = false;
+    out += "{\"key\":" + qs(b64_encode(k)) +
+           ",\"value\":" + qs(b64_encode(e.value)) +
+           ",\"create_revision\":" + qi(e.create_rev) +
+           ",\"mod_revision\":" + qi(e.mod_rev);
+    if (e.lease) out += ",\"lease\":" + qi(e.lease);
+    out += "}";
+  }
+  return out + "]";
+}
+
+std::string header_json() {
+  return "{\"revision\":" + qi(g_store->revision()) + "}";
+}
+
+void handle_watch(int fd, const Json& req) {
+  const Json* cr = req.find("create_request");
+  if (!cr) {
+    send_response(fd, 400, "{\"error\":\"missing create_request\"}");
+    return;
+  }
+  std::string key = b64_decode(cr->find("key") ? cr->find("key")->str : "");
+  const Json* re = cr->find("range_end");
+  std::string range_end = re ? b64_decode(re->str) : "";
+  int64_t start_rev =
+      cr->find("start_revision") ? cr->find("start_revision")->as_i64() : 0;
+
+  if (!send_all(fd,
+                "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                "Transfer-Encoding: chunked\r\n\r\n"))
+    return;
+  {
+    std::string line = "{\"result\":{\"created\":true,\"header\":" +
+                       header_json() + "}}\n";
+    if (!send_chunk(fd, line)) return;
+  }
+  int64_t from_rev = start_rev ? start_rev : g_store->revision() + 1;
+
+  while (!g_stop.load()) {
+    std::vector<Event> events;
+    int64_t compact_rev = 0, current_rev = 0;
+    bool live;
+    {
+      std::unique_lock<std::mutex> lk(g_store->mu);
+      live = g_store->events_from(from_rev, key, range_end, &events,
+                                  &compact_rev, &current_rev);
+      if (live && events.empty()) {
+        g_store->cv.wait_for(lk, std::chrono::seconds(5));
+        events.clear();
+        live = g_store->events_from(from_rev, key, range_end, &events,
+                                    &compact_rev, &current_rev);
+      }
+    }
+    if (!live) {
+      std::string line = "{\"result\":{\"canceled\":true,\"compact_revision\":" +
+                         qi(compact_rev) + ",\"header\":{\"revision\":" +
+                         qi(current_rev) + "}}}\n";
+      send_chunk(fd, line);
+      send_all(fd, "0\r\n\r\n");
+      return;
+    }
+    // The locked scan covered everything up to current_rev (matching
+    // events returned, the rest skippable) — advance past it so a quiet
+    // prefix never trips the compaction check as global history wraps.
+    int64_t resume = current_rev + 1;
+    if (events.empty()) {
+      // Progress keepalive (etcd sends these; also detects dead peers).
+      std::string line = "{\"result\":{\"header\":{\"revision\":" +
+                         qi(current_rev) + "}}}\n";
+      if (!send_chunk(fd, line)) return;
+      from_rev = resume;
+      continue;
+    }
+    int64_t max_rev = from_rev;
+    std::string evs = "[";
+    bool first = true;
+    for (const auto& e : events) {
+      if (!first) evs += ",";
+      first = false;
+      if (e.is_delete)
+        evs += "{\"type\":\"DELETE\",\"kv\":{\"key\":" +
+               qs(b64_encode(e.key)) + ",\"mod_revision\":" + qi(e.rev) +
+               "}}";
+      else
+        evs += "{\"kv\":{\"key\":" + qs(b64_encode(e.key)) +
+               ",\"value\":" + qs(b64_encode(e.value)) +
+               ",\"mod_revision\":" + qi(e.rev) + "}}";
+      if (e.rev > max_rev) max_rev = e.rev;
+    }
+    evs += "]";
+    std::string line = "{\"result\":{\"header\":{\"revision\":" +
+                       qi(max_rev) + "},\"events\":" + evs + "}}\n";
+    if (!send_chunk(fd, line)) return;
+    from_rev = resume;
+  }
+}
+
+void handle_request(int fd, const Request& req) {
+  JsonParser parser(req.body);
+  Json body = req.body.empty() ? Json{} : parser.parse();
+  const std::string& p = req.path;
+
+  auto get_key = [&](const char* field) {
+    const Json* j = body.find(field);
+    return j ? b64_decode(j->str) : std::string();
+  };
+
+  if (p == "/v3/watch") {
+    handle_watch(fd, body);
+    // The watch stream owns the rest of this connection's lifetime.
+    shutdown(fd, SHUT_RDWR);
+    return;
+  }
+  if (p == "/v3/kv/put") {
+    const Json* lease = body.find("lease");
+    int64_t rev = g_store->put(get_key("key"), get_key("value"),
+                               lease ? lease->as_i64() : 0);
+    send_response(fd, 200,
+                  "{\"header\":{\"revision\":" + qi(rev) + "}}");
+    return;
+  }
+  if (p == "/v3/kv/range") {
+    const Json* re = body.find("range_end");
+    auto kvs = g_store->range(get_key("key"),
+                              re ? b64_decode(re->str) : "", re != nullptr);
+    send_response(fd, 200,
+                  "{\"header\":" + header_json() + ",\"kvs\":" +
+                      kvs_json(kvs) + ",\"count\":" +
+                      qi(int64_t(kvs.size())) + "}");
+    return;
+  }
+  if (p == "/v3/kv/deleterange") {
+    const Json* re = body.find("range_end");
+    int64_t n = g_store->delete_range(
+        get_key("key"), re ? b64_decode(re->str) : "", re != nullptr);
+    send_response(fd, 200,
+                  "{\"header\":" + header_json() + ",\"deleted\":" + qi(n) +
+                      "}");
+    return;
+  }
+  if (p == "/v3/kv/txn") {
+    // The election txn: create-iff-never-written (compare CREATE == 0).
+    const Json* cmp = body.find("compare");
+    const Json* succ = body.find("success");
+    bool ok = false;
+    if (cmp && cmp->type == Json::kArr && !cmp->arr.empty() && succ &&
+        succ->type == Json::kArr && !succ->arr.empty()) {
+      const Json& c0 = cmp->arr[0];
+      const Json* put_op = succ->arr[0].find("request_put");
+      if (c0.find("target") && c0.find("target")->str == "CREATE" &&
+          put_op) {
+        const Json* lease = put_op->find("lease");
+        ok = g_store->compare_create(
+            b64_decode(put_op->find("key")->str),
+            b64_decode(put_op->find("value") ? put_op->find("value")->str
+                                             : ""),
+            lease ? lease->as_i64() : 0);
+      }
+    }
+    send_response(fd, 200,
+                  std::string("{\"header\":") + header_json() +
+                      ",\"succeeded\":" + (ok ? "true" : "false") + "}");
+    return;
+  }
+  if (p == "/v3/lease/grant") {
+    const Json* ttl = body.find("TTL");
+    int64_t t = ttl ? ttl->as_i64() : 5;
+    if (t < 1) t = 1;
+    int64_t id = g_store->lease_grant(t);
+    send_response(fd, 200,
+                  "{\"header\":" + header_json() + ",\"ID\":" + qi(id) +
+                      ",\"TTL\":" + qi(t) + "}");
+    return;
+  }
+  if (p == "/v3/lease/keepalive") {
+    const Json* idj = body.find("ID");
+    int64_t ttl = 0;
+    bool ok = idj && g_store->lease_keepalive(idj->as_i64(), &ttl);
+    send_response(fd, 200,
+                  "{\"result\":{\"header\":" + header_json() +
+                      ",\"ID\":" + qi(idj ? idj->as_i64() : 0) +
+                      ",\"TTL\":" + qi(ok ? ttl : 0) + "}}");
+    return;
+  }
+  if (p == "/v3/kv/lease/revoke" || p == "/v3/lease/revoke") {
+    const Json* idj = body.find("ID");
+    if (idj) g_store->lease_revoke(idj->as_i64());
+    send_response(fd, 200, "{\"header\":" + header_json() + "}");
+    return;
+  }
+  send_response(fd, 404, "{\"error\":\"unknown path\"}");
+}
+
+void serve_connection(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  std::string buf;
+  Request req;
+  while (!g_stop.load() && read_request(fd, &buf, &req)) {
+    handle_request(fd, req);
+    if (req.path == "/v3/watch") break;  // stream consumed the socket
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  signal(SIGPIPE, SIG_IGN);
+  int port = argc > 1 ? atoi(argv[1]) : 0;
+  size_t history_cap = 100000;
+  if (const char* cap = getenv("XLLM_ETCD_HISTORY_CAP"))
+    history_cap = size_t(strtoul(cap, nullptr, 10));
+  Store store(history_cap);
+  g_store = &store;
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(uint16_t(port));
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+      listen(lfd, 128) != 0) {
+    perror("xllm_etcd bind/listen");
+    return 1;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  printf("LISTENING %d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  std::thread sweeper([&store] {
+    while (!g_stop.load()) {
+      store.sweep_expired();
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  });
+  sweeper.detach();
+
+  while (!g_stop.load()) {
+    int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    std::thread(serve_connection, cfd).detach();
+  }
+  close(lfd);
+  return 0;
+}

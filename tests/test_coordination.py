@@ -117,10 +117,13 @@ class TestRemoteStore:
 
 class TestEtcdStore:
     """EtcdStore contract against the etcd v3 JSON-gateway wire — served
-    by MockEtcdServer always, and by a real etcd when XLLM_ETCD_ADDR is
-    set (same assertions either way)."""
+    three ways with the same assertions: the in-process Python mock, the
+    independently-written native C++ server (csrc/xllm_etcd.cpp — a real
+    separate OS process over real sockets, ALWAYS on, so the client is
+    never validated only against its author's own mock), and a stock
+    etcd when XLLM_ETCD_ADDR is set."""
 
-    @pytest.fixture(params=["mock", "real"])
+    @pytest.fixture(params=["mock", "native", "real"])
     def etcd(self, request):
         import os
         from xllm_service_tpu.service.etcd_store import (
@@ -134,6 +137,16 @@ class TestEtcdStore:
             yield client
             client.delete_prefix("XLLMTEST:")
             client.close()
+        elif request.param == "native":
+            from xllm_service_tpu.service.etcd_native import (
+                NativeEtcdServer, build_binary)
+            if build_binary() is None:
+                pytest.skip("no C++ toolchain for xllm_etcd")
+            server = NativeEtcdServer().start()
+            client = EtcdStore(server.address)
+            yield client
+            client.close()
+            server.stop()
         else:
             server = MockEtcdServer().start()
             client = EtcdStore(server.address)
@@ -195,3 +208,87 @@ class TestEtcdStore:
         assert base64.b64decode(range_end_for_prefix("A:")) == b"A;"
         assert base64.b64decode(range_end_for_prefix("XLLM:")) == b"XLLM;"
         assert base64.b64decode(range_end_for_prefix("")) == b"\0"
+
+
+class TestNativeEtcdServer:
+    """Behaviors specific to the C++ coordination server
+    (csrc/xllm_etcd.cpp) beyond the shared EtcdStore contract."""
+
+    @pytest.fixture()
+    def native(self):
+        from xllm_service_tpu.service.etcd_native import (
+            NativeEtcdServer, build_binary)
+        if build_binary() is None:
+            pytest.skip("no C++ toolchain for xllm_etcd")
+        server = NativeEtcdServer().start()
+        yield server
+        server.stop()
+
+    def test_lease_expiry_deletes_and_notifies(self, native):
+        """An un-refreshed lease expires server-side: attached keys are
+        deleted and the watch stream carries the DELETE — the exact
+        mechanism instance liveness rides on (reference: etcd lease
+        expiry → DELETE watch event → instance removal)."""
+        from xllm_service_tpu.service.etcd_store import EtcdStore
+        client = EtcdStore(native.address)
+        got = []
+        client.add_watch("XLLM:PREFILL:", lambda ev: got.append(ev))
+        time.sleep(0.3)
+        lid = client.lease_grant(1.0)
+        client.put("XLLM:PREFILL:w", "meta", lid)
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline \
+                and ("DELETE", "XLLM:PREFILL:w", None) not in got:
+            time.sleep(0.05)
+        client.close()
+        assert ("PUT", "XLLM:PREFILL:w", "meta") in got
+        assert ("DELETE", "XLLM:PREFILL:w", None) in got
+        assert client.get("XLLM:PREFILL:w") is None
+
+    def test_compacted_watch_resume_is_canceled(self):
+        """A watch resuming from a revision older than retained history
+        gets etcd's canceled+compact_revision answer (the signal
+        EtcdStore's resync path consumes), not silent event loss."""
+        import base64
+        import http.client
+        import json as jsonlib
+        import os
+        from xllm_service_tpu.service.etcd_native import (
+            NativeEtcdServer, build_binary)
+        from xllm_service_tpu.service.etcd_store import (
+            EtcdStore, range_end_for_prefix)
+        if build_binary() is None:
+            pytest.skip("no C++ toolchain for xllm_etcd")
+        os.environ["XLLM_ETCD_HISTORY_CAP"] = "4"
+        try:
+            server = NativeEtcdServer().start()
+        finally:
+            del os.environ["XLLM_ETCD_HISTORY_CAP"]
+        try:
+            client = EtcdStore(server.address)
+            for i in range(10):     # blow past the 4-event history cap
+                client.put(f"C:{i}", str(i))
+            host, _, port = server.address.partition(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=5)
+            conn.request("POST", "/v3/watch", jsonlib.dumps({
+                "create_request": {
+                    "key": base64.b64encode(b"C:").decode(),
+                    "range_end": range_end_for_prefix("C:"),
+                    "start_revision": "1"}}),
+                {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            canceled = None
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue
+                msg = jsonlib.loads(line)["result"]
+                if msg.get("canceled"):
+                    canceled = msg
+                    break
+            conn.close()
+            client.close()
+            assert canceled is not None
+            assert int(canceled["compact_revision"]) > 0
+        finally:
+            server.stop()
