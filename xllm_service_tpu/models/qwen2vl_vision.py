@@ -1,0 +1,314 @@
+"""Qwen2-VL vision tower — the real encoder for the EPD multimodal
+pipeline, faithful to the HF architecture so genuine Qwen2-VL checkpoints
+load and match the torch oracle (tests/test_qwen2vl_vision.py).
+
+Reference claims EPD multimodal disaggregation as a headline feature but
+keeps the encode stage out of repo (README.md:44); rounds 1-3 stood in a
+synthetic ViT (models/vision.py, retained for registry models without
+checkpoint dirs). This module is the checkpoint-bearing replacement:
+
+- **Conv3D patch embed as one matmul**: the (tp, P, P)-kernel conv with
+  stride == kernel over pre-flattened patches IS a linear layer on
+  [C·tp·P·P] rows — the MXU-native form; no conv op needed.
+- **2D rotary position embeddings**: per-patch (h, w) ids in the
+  merge-block-major sequence order the HF image processor emits; half the
+  head rotates by h-frequencies, half by w (HF rot_pos_emb semantics).
+- **LayerNorm blocks, qkv+proj with bias, QuickGELU MLP** (the vision
+  tower's norm/activation family differs from the RMS/SiLU text stack).
+- **Per-image full attention** via segment masking (HF splits the packed
+  sequence at cu_seqlens; a segment-id equality mask is the same math in
+  one batched einsum — no Python loop over images).
+- **PatchMerger**: ln_q, group spatial_merge_size² consecutive patches,
+  2-layer GELU MLP into the language model's hidden size.
+- Stacked layers + ``lax.scan``; fp32 softmax/norm/rope.
+
+Grid geometry (``grid_thw``) is static at trace time — one compiled
+program per image shape; the serving path resizes to a fixed grid so
+there is exactly one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xllm_service_tpu.ops.norm import layer_norm
+
+Qwen2VLVisionParams = Dict[str, Any]
+
+# HF Qwen2VLImageProcessor normalization constants (OPENAI_CLIP_MEAN/STD).
+CLIP_MEAN = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
+CLIP_STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class Qwen2VLVisionConfig:
+    """vision_config of a Qwen2-VL config.json, plus the serving-side
+    fixed resize target (``image_size``) that pins one compiled grid."""
+
+    depth: int = 32
+    embed_dim: int = 1280
+    num_heads: int = 16
+    patch_size: int = 14
+    temporal_patch_size: int = 2
+    spatial_merge_size: int = 2
+    mlp_ratio: float = 4.0
+    in_channels: int = 3
+    hidden_size: int = 3584          # language model hidden (output)
+    image_size: int = 224            # host-side resize target
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return (self.in_channels * self.temporal_patch_size
+                * self.patch_size ** 2)
+
+    @property
+    def grid_side(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def tokens_per_image(self) -> int:
+        """Merged (post-PatchMerger) tokens one fixed-grid image yields —
+        what placeholder expansion splices into the prompt."""
+        return self.grid_side ** 2 // self.spatial_merge_size ** 2
+
+    @classmethod
+    def from_hf_config(cls, d: Dict[str, Any],
+                       image_size: int = 224) -> "Qwen2VLVisionConfig":
+        """``d`` = config.json["vision_config"] of a qwen2_vl checkpoint.
+        ``hidden_size`` in that block is already the LLM hidden. The
+        serve-time resize target must tile exactly into merged patches —
+        refuse a bad one here, at load, not as a numpy reshape error
+        inside the first encode request."""
+        unit = d.get("patch_size", 14) * d.get("spatial_merge_size", 2)
+        if image_size <= 0 or image_size % unit != 0:
+            raise ValueError(
+                f"vision image_size {image_size} must be a positive "
+                f"multiple of patch_size*spatial_merge_size ({unit})")
+        return cls(
+            depth=d.get("depth", 32),
+            embed_dim=d.get("embed_dim", 1280),
+            num_heads=d.get("num_heads", 16),
+            patch_size=d.get("patch_size", 14),
+            temporal_patch_size=d.get("temporal_patch_size", 2),
+            spatial_merge_size=d.get("spatial_merge_size", 2),
+            mlp_ratio=d.get("mlp_ratio", 4.0),
+            in_channels=d.get("in_channels", 3),
+            hidden_size=d.get("hidden_size", 3584),
+            image_size=image_size,
+        )
+
+    @classmethod
+    def tiny(cls, hidden_size: int = 48) -> "Qwen2VLVisionConfig":
+        return cls(depth=2, embed_dim=64, num_heads=4, patch_size=4,
+                   mlp_ratio=2.0, hidden_size=hidden_size, image_size=16)
+
+
+def init_vision_params(cfg: Qwen2VLVisionConfig,
+                       key: jax.Array) -> Qwen2VLVisionParams:
+    """Random init in the exact tree shape ``load_checkpoint`` produces."""
+    dtype = jnp.dtype(cfg.dtype)
+    D, L = cfg.embed_dim, cfg.depth
+    F = int(cfg.embed_dim * cfg.mlp_ratio)
+    M = D * cfg.spatial_merge_size ** 2
+    keys = iter(jax.random.split(key, 16))
+
+    def w(shape, fan_in):
+        return (jax.random.normal(next(keys), shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(dtype)
+
+    return {
+        "patch_embed": w((cfg.patch_dim, D), cfg.patch_dim),
+        "blocks": {
+            "norm1_w": jnp.ones((L, D), dtype),
+            "norm1_b": jnp.zeros((L, D), dtype),
+            "qkv_w": w((L, D, 3 * D), D),
+            "qkv_b": jnp.zeros((L, 3 * D), dtype),
+            "proj_w": w((L, D, D), D),
+            "proj_b": jnp.zeros((L, D), dtype),
+            "norm2_w": jnp.ones((L, D), dtype),
+            "norm2_b": jnp.zeros((L, D), dtype),
+            "fc1_w": w((L, D, F), D),
+            "fc1_b": jnp.zeros((L, F), dtype),
+            "fc2_w": w((L, F, D), F),
+            "fc2_b": jnp.zeros((L, D), dtype),
+        },
+        "merger": {
+            "ln_q_w": jnp.ones((D,), dtype),
+            "ln_q_b": jnp.zeros((D,), dtype),
+            "mlp0_w": w((M, M), M),
+            "mlp0_b": jnp.zeros((M,), dtype),
+            "mlp2_w": w((M, cfg.hidden_size), M),
+            "mlp2_b": jnp.zeros((cfg.hidden_size,), dtype),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Host-side geometry (numpy, static per grid)
+# ---------------------------------------------------------------------------
+
+def rot_pos_ids(grid_thw: Sequence[Tuple[int, int, int]],
+                merge: int) -> np.ndarray:
+    """Per-patch (h, w) position ids in the merge-block-major order the
+    image processor flattens patches in (HF rot_pos_emb,
+    modeling_qwen2_vl.py) → [S, 2] int32."""
+    out: List[np.ndarray] = []
+    for t, h, w in grid_thw:
+        hp = np.broadcast_to(np.arange(h, dtype=np.int32)[:, None], (h, w))
+        hp = hp.reshape(h // merge, merge, w // merge, merge) \
+            .transpose(0, 2, 1, 3).reshape(-1)
+        wp = np.broadcast_to(np.arange(w, dtype=np.int32)[None, :], (h, w))
+        wp = wp.reshape(h // merge, merge, w // merge, merge) \
+            .transpose(0, 2, 1, 3).reshape(-1)
+        out.append(np.tile(np.stack([hp, wp], axis=-1), (t, 1)))
+    return np.concatenate(out, axis=0)
+
+
+def segment_ids(grid_thw: Sequence[Tuple[int, int, int]]) -> np.ndarray:
+    """[S] int32 attention-segment id per patch. HF's cu_seqlens are
+    ``repeat_interleave(h·w, t)`` — each temporal FRAME is its own full
+    attention segment, not the whole image."""
+    segs: List[np.ndarray] = []
+    n = 0
+    for t, h, w in grid_thw:
+        for _ in range(t):
+            segs.append(np.full(h * w, n, np.int32))
+            n += 1
+    return np.concatenate(segs)
+
+
+def rotary_cos_sin(cfg: Qwen2VLVisionConfig,
+                   grid_thw: Sequence[Tuple[int, int, int]]
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """cos/sin [S, head_dim] fp32: the first half of the rotary angles
+    comes from the h position, the second from w; then duplicated
+    (rotate_half layout), matching HF's cat((freqs, freqs))."""
+    dim = cfg.head_dim // 2          # angles per position component pair
+    inv_freq = 1.0 / (10000.0 ** (np.arange(0, dim, 2, dtype=np.float32)
+                                  / dim))
+    ids = rot_pos_ids(grid_thw, cfg.spatial_merge_size)       # [S, 2]
+    freqs = ids[:, :, None].astype(np.float32) * inv_freq[None, None, :]
+    emb = freqs.reshape(ids.shape[0], -1)                     # [S, hd/2]
+    emb = np.concatenate([emb, emb], axis=-1)                 # [S, hd]
+    return np.cos(emb), np.sin(emb)
+
+
+def flatten_image(pixels: np.ndarray, cfg: Qwen2VLVisionConfig,
+                  normalize: bool = True
+                  ) -> Tuple[np.ndarray, Tuple[int, int, int]]:
+    """[H, W, 3] (or [T, H, W, 3]) float in [0, 1] → the flattened-patch
+    rows the tower consumes, in the HF image processor's exact ordering
+    (image_processing_qwen2_vl.py:281-295: reshape to
+    (t, tp, C, h/m, m, P, w/m, m, P), transpose (0,3,6,4,7,2,1,5,8)).
+    A lone frame is repeated to fill temporal_patch_size, as the
+    processor does."""
+    if pixels.ndim == 3:
+        pixels = pixels[None]
+    T, H, W, C = pixels.shape
+    P, tp, m = cfg.patch_size, cfg.temporal_patch_size, cfg.spatial_merge_size
+    if normalize:
+        pixels = (pixels.astype(np.float32) - CLIP_MEAN) / CLIP_STD
+    x = pixels.transpose(0, 3, 1, 2)                          # [T, C, H, W]
+    if T % tp:
+        x = np.concatenate([x] + [x[-1:]] * (tp - T % tp), axis=0)
+        T = x.shape[0]
+    gt, gh, gw = T // tp, H // P, W // P
+    x = x.reshape(gt, tp, C, gh // m, m, P, gw // m, m, P)
+    x = x.transpose(0, 3, 6, 4, 7, 2, 1, 5, 8)
+    return (x.reshape(gt * gh * gw, C * tp * P * P).astype(np.float32),
+            (gt, gh, gw))
+
+
+# ---------------------------------------------------------------------------
+# The tower (jit-safe; grid geometry baked in as constants)
+# ---------------------------------------------------------------------------
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def _quick_gelu(x: jnp.ndarray) -> jnp.ndarray:
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def encode_patches(params: Qwen2VLVisionParams, cfg: Qwen2VLVisionConfig,
+                   patches: jnp.ndarray, cos: jnp.ndarray,
+                   sin: jnp.ndarray, seg: jnp.ndarray) -> jnp.ndarray:
+    """patches [S, C·tp·P·P] → merged embeddings [S/m², hidden_size].
+
+    cos/sin [S, head_dim] and seg [S] come from ``rotary_cos_sin`` /
+    ``segment_ids`` for the (static) grid; S must be a multiple of
+    spatial_merge_size² with merge blocks consecutive in sequence order
+    (guaranteed by ``flatten_image``)."""
+    S = patches.shape[0]
+    H, Dh = cfg.num_heads, cfg.head_dim
+    dtype = jnp.dtype(cfg.dtype)
+    x = patches.astype(dtype) @ params["patch_embed"]          # [S, D]
+    mask = (seg[:, None] == seg[None, :])                      # [S, S]
+    cos_h = cos[:, None, :]                                    # [S, 1, hd]
+    sin_h = sin[:, None, :]
+
+    def block(x, lp):
+        h = layer_norm(x, lp["norm1_w"], lp["norm1_b"])
+        qkv = (h @ lp["qkv_w"] + lp["qkv_b"]).reshape(S, 3, H, Dh)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]              # [S, H, Dh]
+        q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
+        q = ((q32 * cos_h) + (_rotate_half(q32) * sin_h)).astype(q.dtype)
+        k = ((k32 * cos_h) + (_rotate_half(k32) * sin_h)).astype(k.dtype)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+        logits = jnp.einsum("shd,thd->hst", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(mask[None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("hst,thd->shd", p.astype(v.dtype), v)
+        x = x + attn.reshape(S, -1) @ lp["proj_w"] + lp["proj_b"]
+        h = layer_norm(x, lp["norm2_w"], lp["norm2_b"])
+        h = _quick_gelu(h @ lp["fc1_w"] + lp["fc1_b"])
+        x = x + (h @ lp["fc2_w"] + lp["fc2_b"])
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    mg = params["merger"]
+    x = layer_norm(x, mg["ln_q_w"], mg["ln_q_b"])
+    x = x.reshape(S // cfg.spatial_merge_size ** 2, -1)        # [S/m², m²D]
+    x = jax.nn.gelu(x @ mg["mlp0_w"] + mg["mlp0_b"], approximate=False)
+    return x @ mg["mlp2_w"] + mg["mlp2_b"]                     # [S/m², out]
+
+
+def encode_images_fixed_grid(params: Qwen2VLVisionParams,
+                             cfg: Qwen2VLVisionConfig,
+                             pixel_batch: np.ndarray,
+                             jit_fn=None) -> np.ndarray:
+    """Serving entry: [N, image_size, image_size, 3] in [0, 1] → merged
+    embeddings [N, tokens_per_image, hidden].
+
+    One tower call PER IMAGE, all on the single fixed-grid shape: the
+    compiled program is independent of how many images a request carries
+    (no recompile per distinct N), and attention stays [S, S] per image
+    rather than a mostly-masked [N·S, N·S] block."""
+    fn = jit_fn if jit_fn is not None else encode_patches
+    grid0 = None
+    cos = sin = seg = None
+    outs = []
+    for img in pixel_batch:
+        patches, grid = flatten_image(img, cfg)
+        if grid != grid0:           # same for every image; compute once
+            cos, sin = rotary_cos_sin(cfg, [grid])
+            seg = segment_ids([grid])
+            grid0 = grid
+        outs.append(np.asarray(fn(
+            params, cfg, jnp.asarray(patches), jnp.asarray(cos),
+            jnp.asarray(sin), jnp.asarray(seg)), np.float32))
+    return np.stack(outs)

@@ -191,6 +191,85 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
     return jax.tree_util.tree_map(jax.device_put, params)
 
 
+def load_qwen2vl_vision(model_dir: str, vcfg=None,
+                        image_size: int = 224):
+    """Load a Qwen2-VL checkpoint's vision tower (``visual.*`` keys; the
+    current transformers writer prefixes ``model.visual.*``) into the
+    ``models/qwen2vl_vision.py`` pytree. Returns (vcfg, params), or None
+    when the directory has no vision tower (plain text checkpoints).
+
+    The reference keeps the EPD encode stage engine-side and shapeless
+    (README.md:44); here the tower is a first-class loadable component
+    with torch-oracle parity (tests/test_qwen2vl_vision.py)."""
+    from xllm_service_tpu.models.qwen2vl_vision import (
+        Qwen2VLVisionConfig, init_vision_params)  # noqa: F401 (tree shape)
+
+    cfg_path = os.path.join(model_dir, "config.json")
+    if vcfg is None:
+        if not os.path.exists(cfg_path):
+            return None
+        with open(cfg_path, "r", encoding="utf-8") as f:
+            d = json.load(f)
+        if "vision_config" not in d:
+            return None
+        vcfg = Qwen2VLVisionConfig.from_hf_config(
+            d["vision_config"], image_size=image_size)
+
+    r = _ShardedReader(model_dir)
+    prefix = "visual." if "visual.patch_embed.proj.weight" in r \
+        else "model.visual."
+    if prefix + "patch_embed.proj.weight" not in r:
+        r.close()
+        return None
+    dtype = _np_dtype(vcfg.dtype)
+    L = vcfg.depth
+
+    def g(name: str) -> np.ndarray:
+        return r.get(prefix + name)
+
+    def stack(fmt: str, transpose: bool = False) -> np.ndarray:
+        rows = []
+        for i in range(L):
+            t = g(fmt.format(i=i))
+            rows.append(np.ascontiguousarray(t.T) if transpose else t)
+        return np.stack(rows).astype(dtype)
+
+    B = "blocks.{i}."
+    conv = g("patch_embed.proj.weight")            # [D, C, tp, P, P]
+    params = {
+        # Conv3d with stride == kernel over pre-flattened patch rows is a
+        # plain matmul: flatten the kernel, transpose to [C·tp·P·P, D].
+        "patch_embed": np.ascontiguousarray(
+            conv.reshape(conv.shape[0], -1).T).astype(dtype),
+        "blocks": {
+            "norm1_w": stack(B + "norm1.weight"),
+            "norm1_b": stack(B + "norm1.bias"),
+            "qkv_w": stack(B + "attn.qkv.weight", transpose=True),
+            "qkv_b": stack(B + "attn.qkv.bias"),
+            "proj_w": stack(B + "attn.proj.weight", transpose=True),
+            "proj_b": stack(B + "attn.proj.bias"),
+            "norm2_w": stack(B + "norm2.weight"),
+            "norm2_b": stack(B + "norm2.bias"),
+            "fc1_w": stack(B + "mlp.fc1.weight", transpose=True),
+            "fc1_b": stack(B + "mlp.fc1.bias"),
+            "fc2_w": stack(B + "mlp.fc2.weight", transpose=True),
+            "fc2_b": stack(B + "mlp.fc2.bias"),
+        },
+        "merger": {
+            "ln_q_w": g("merger.ln_q.weight").astype(dtype),
+            "ln_q_b": g("merger.ln_q.bias").astype(dtype),
+            "mlp0_w": np.ascontiguousarray(
+                g("merger.mlp.0.weight").T).astype(dtype),
+            "mlp0_b": g("merger.mlp.0.bias").astype(dtype),
+            "mlp2_w": np.ascontiguousarray(
+                g("merger.mlp.2.weight").T).astype(dtype),
+            "mlp2_b": g("merger.mlp.2.bias").astype(dtype),
+        },
+    }
+    r.close()
+    return vcfg, jax.tree_util.tree_map(jax.device_put, params)
+
+
 def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
                     model_dir: str) -> None:
     """Write ``params`` back out as a single-file HF-layout checkpoint +

@@ -453,6 +453,10 @@ class Worker:
         self._vision_lock = make_lock("worker.vision", 90)
         if opts.instance_type == InstanceType.ENCODE:
             self._get_vision()
+        # EPD encode-stage timing book (BASELINE.md row 5).
+        self.encode_seconds = 0.0
+        self.encode_calls = 0
+        self.encode_images_total = 0
         # KV-migration throughput book (BASELINE.md north-star metric).
         self.kv_migration_bytes = 0
         self.kv_migration_seconds = 0.0
@@ -1192,6 +1196,11 @@ class Worker:
                     lines.append(
                         f'xllm_worker_recompiles_total'
                         f'{{model="{m}",program="{program}"}} {entry}')
+        lines.append(f"xllm_worker_encode_seconds_total "
+                     f"{self.encode_seconds:.6f}")
+        lines.append(f"xllm_worker_encode_calls_total {self.encode_calls}")
+        lines.append(f"xllm_worker_encode_images_total "
+                     f"{self.encode_images_total}")
         lines.append(f"xllm_worker_kv_migration_bytes_total "
                      f"{self.kv_migration_bytes}")
         lines.append(f"xllm_worker_kv_migration_seconds_total "
@@ -1355,7 +1364,33 @@ class Worker:
             if self._vision is None:
                 import functools as _ft
 
-                import jax.numpy as _jnp
+                # Real Qwen2-VL tower when the checkpoint carries one
+                # (visual.* weights + vision_config, torch-oracle parity
+                # in tests/test_qwen2vl_vision.py); synthetic ViT
+                # fallback for registry models without a directory.
+                if self.opts.model_dir:
+                    from xllm_service_tpu.runtime.checkpoint import (
+                        load_qwen2vl_vision)
+                    # Fixed serve-time grid (one compiled tower shape);
+                    # must be a multiple of patch_size·spatial_merge_size.
+                    img_size = int(os.environ.get(
+                        "XLLM_VISION_IMAGE_SIZE", "224"))
+                    loaded = load_qwen2vl_vision(self.opts.model_dir,
+                                                 image_size=img_size)
+                    if loaded is not None:
+                        vcfg, params = loaded
+                        from xllm_service_tpu.models import (
+                            qwen2vl_vision as _qv)
+                        # params as a traced argument, NOT a closure —
+                        # closed-over weights get baked into the program
+                        # as constants (gigabytes at real tower sizes).
+                        fn = jax.jit(
+                            lambda p, patches, cos, sin, seg:
+                            _qv.encode_patches(p, vcfg, patches, cos,
+                                               sin, seg))
+                        self._vision = ("qwen2vl", vcfg,
+                                        (_qv, params, fn))
+                        return self._vision
 
                 from xllm_service_tpu.models import vision as _vision
                 cfg = self.primary_runtime().model_cfg
@@ -1366,17 +1401,28 @@ class Worker:
                     vcfg, jax.random.PRNGKey(0))
                 fn = jax.jit(_ft.partial(_vision.encode_image, params,
                                          vcfg))
-                self._vision = (vcfg, fn)
+                self._vision = ("synthetic", vcfg, fn)
             return self._vision
 
     def encode_images(self, mm_inputs: List[Any]) -> np.ndarray:
         """Run the vision encoder on this worker → [N, tokens_per_image,
         hidden] float32."""
         from xllm_service_tpu.runtime.multimodal import load_image
-        vcfg, fn = self._get_vision()
+        kind, vcfg, fn = self._get_vision()
+        t0 = time.monotonic()
         pixels = np.stack([load_image(m, vcfg.image_size)
                            for m in mm_inputs])
-        return np.asarray(fn(pixels), np.float32)
+        if kind == "qwen2vl":
+            _qv, params, jit_fn = fn
+            out = _qv.encode_images_fixed_grid(
+                params, vcfg, pixels,
+                jit_fn=lambda p, c, *a: jit_fn(p, *a))
+        else:
+            out = np.asarray(fn(pixels), np.float32)
+        self.encode_seconds += time.monotonic() - t0
+        self.encode_calls += 1
+        self.encode_images_total += len(mm_inputs)
+        return out
 
     def _serve_encode(self, req: Request) -> Response:
         return self._guarded(self._serve_encode_inner, req)
