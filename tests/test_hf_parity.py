@@ -44,7 +44,8 @@ def _make_hf_model(kind: str):
     """A randomly-initialized transformers model of the given flavor."""
     torch.manual_seed({"llama3": 0, "qwen2": 1, "mixtral": 2,
                        "llama_sharded": 3, "qwen3": 4, "phi3": 5,
-                       "mistral": 6, "mistral_v01": 7, "phi3_swa": 8}[kind])
+                       "mistral": 6, "mistral_v01": 7, "phi3_swa": 8,
+                       "gemma2": 9}[kind])
     if kind in ("llama3", "llama_sharded"):
         cfg = transformers.LlamaConfig(
             **_DIMS, rope_theta=500000.0, tie_word_embeddings=True,
@@ -87,6 +88,15 @@ def _make_hf_model(kind: str):
             **_DIMS, rope_theta=10000.0, pad_token_id=0, sliding_window=5,
             attn_implementation="eager")
         model = transformers.Phi3ForCausalLM(cfg)
+    elif kind == "gemma2":
+        # Gemma-2: alternating local/global layers (W=4 exercised at
+        # this prompt length), attn/final soft-caps, four-norm blocks,
+        # GeGLU, sqrt(hidden) embed scale, (1+w) norms, and an attention
+        # scale fixed at query_pre_attn_scalar=256 (NOT head_dim).
+        cfg = transformers.Gemma2Config(
+            **_DIMS, head_dim=16, rope_theta=10000.0, sliding_window=4,
+            attn_implementation="eager")
+        model = transformers.Gemma2ForCausalLM(cfg)
     elif kind == "mixtral":
         cfg = transformers.MixtralConfig(
             **_DIMS, num_local_experts=4, num_experts_per_tok=2,
@@ -123,7 +133,7 @@ def _our_all_logits(cfg, params, prompt):
 
 @pytest.mark.parametrize("kind", ["llama3", "qwen2", "qwen3", "phi3",
                                   "mistral", "mistral_v01", "phi3_swa",
-                                  "mixtral"])
+                                  "gemma2", "mixtral"])
 def test_logits_match_torch_oracle(tmp_path, kind):
     """Every prompt position's logits match the torch forward of the same
     HF-written weights (fp32, tight tolerance, argmax everywhere)."""
@@ -196,9 +206,8 @@ def test_rope_scaling_respected(tmp_path):
 
 def test_unsupported_architectures_refused():
     """A config this transformer cannot faithfully run must fail at
-    load (gemma2 layer-body deltas: alternating local/global layers,
-    soft-capping, extra norms) — never silently emit wrong tokens."""
-    base = dict(_DIMS, model_type="gemma2")
+    load — never silently emit wrong tokens."""
+    base = dict(_DIMS, model_type="deepseek_v2")
     with pytest.raises(ValueError, match="unsupported model_type"):
         ModelConfig.from_hf_config(base)
 
@@ -218,6 +227,27 @@ def test_sliding_window_parsed_any_family():
     inert = dict(_DIMS, model_type="qwen2", sliding_window=512,
                  max_position_embeddings=512)
     assert ModelConfig.from_hf_config(inert).sliding_window is None
+
+
+def test_gemma2_config_gating():
+    """Gemma-2 load semantics: all-full layer_types neutralize a shipped
+    sliding_window; absent soft-cap keys take HF's 50/30 defaults while
+    explicit nulls disable; all-sliding layer_types collapse to the
+    uniform static window."""
+    base = dict(_DIMS, model_type="gemma2", head_dim=16, sliding_window=4)
+    allfull = dict(base, layer_types=["full_attention"] * 2)
+    c = ModelConfig.from_hf_config(allfull)
+    assert c.sliding_window is None and c.layer_sliding is None
+    defaults = ModelConfig.from_hf_config(dict(base))
+    assert defaults.attn_logit_softcapping == 50.0
+    assert defaults.final_logit_softcapping == 30.0
+    nulled = ModelConfig.from_hf_config(dict(
+        base, attn_logit_softcapping=None, final_logit_softcapping=None))
+    assert nulled.attn_logit_softcapping == 0.0
+    assert nulled.final_logit_softcapping == 0.0
+    allslide = ModelConfig.from_hf_config(dict(
+        base, layer_types=["sliding_attention"] * 2))
+    assert allslide.sliding_window == 4 and allslide.layer_sliding is None
 
 
 def test_sliding_window_qwen2_gating():
@@ -272,6 +302,40 @@ def test_engine_greedy_matches_hf_greedy(tmp_path):
         max_prefill_tokens=64, prefill_buckets=(8, 16, 32, 64)), params=params)
     eng.add_request(EngineRequest(
         request_id="hf", token_ids=list(prompt),
+        sampling=SamplingParams(max_tokens=steps, temperature=0.0)))
+    got = []
+    for _ in range(200):
+        if not eng.has_work():
+            break
+        for out in eng.step():
+            got.extend(out.new_token_ids)
+    assert got == ref
+
+
+def test_engine_greedy_matches_hf_greedy_gemma2(tmp_path):
+    """Engine decode with Gemma-2's alternating local/global layers,
+    soft-caps, and four-norm blocks matches torch greedy continuation
+    well past the W=4 window."""
+    model = _make_hf_model("gemma2")
+    _save(model, str(tmp_path))
+    cfg, params = _load_ours(str(tmp_path))
+    assert cfg.gemma and cfg.sliding_window == 4
+    assert cfg.layer_sliding == (True, False)
+
+    prompt = [12, 250, 3, 77, 8, 1]
+    steps = 12
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        for _ in range(steps):
+            nxt = model(ids).logits[0, -1].argmax()
+            ids = torch.cat([ids, nxt.view(1, 1)], dim=1)
+    ref = ids[0, len(prompt):].tolist()
+
+    eng = Engine(cfg, EngineConfig(
+        page_size=4, num_pages=64, max_model_len=128, max_batch_size=2,
+        max_prefill_tokens=64, prefill_buckets=(8, 16, 32, 64)), params=params)
+    eng.add_request(EngineRequest(
+        request_id="g2", token_ids=list(prompt),
         sampling=SamplingParams(max_tokens=steps, temperature=0.0)))
     got = []
     for _ in range(200):

@@ -123,6 +123,24 @@ class ModelConfig:
     # transformer body serves both regimes; the Pallas fast paths are
     # bypassed at trace time when a window is set.
     sliding_window: Optional[int] = None
+    # Per-layer window activation (Gemma-2's alternating local/global
+    # layers): tuple of bools, True = this layer uses sliding_window,
+    # False = full attention. None = uniform (sliding_window applies to
+    # every layer, or to none).
+    layer_sliding: Optional[Tuple[bool, ...]] = None
+    # Gemma-2 layer-body deltas (all default-off):
+    # tanh soft-cap on attention logits / final lm_head logits.
+    attn_logit_softcapping: float = 0.0
+    final_logit_softcapping: float = 0.0
+    # Attention scale = query_pre_attn_scalar**-0.5 instead of
+    # head_dim**-0.5 (Gemma-2 fixes it at 256 regardless of head_dim).
+    query_pre_attn_scalar: Optional[int] = None
+    # Gemma family conventions: sqrt(hidden) embedding scale, the
+    # four-norm block (post-attn/post-ffw norms on the SUBLAYER OUTPUT
+    # before the residual add), and tanh-GELU gating in the MLP. The
+    # (1 + weight) RMSNorm convention is normalized away at checkpoint
+    # load (runtime/checkpoint.py adds 1; save subtracts it back).
+    gemma: bool = False
     # MoE (0 experts → dense MLP).
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -212,6 +230,21 @@ class ModelConfig:
                    sliding_window=2047)
 
     @classmethod
+    def gemma2_9b(cls) -> "ModelConfig":
+        # Gemma-2-9B: alternating local/global attention (W=4096 on even
+        # layers), soft-caps, four-norm blocks, GeGLU, 256-dim heads.
+        return cls(name="gemma2-9b", vocab_size=256000, hidden_size=3584,
+                   intermediate_size=14336, num_layers=42, num_heads=16,
+                   num_kv_heads=8, head_dim=256, rope_theta=10000.0,
+                   rms_norm_eps=1e-6, max_position_embeddings=8192,
+                   tie_word_embeddings=True, sliding_window=4096,
+                   layer_sliding=tuple((i + 1) % 2 == 1
+                                       for i in range(42)),
+                   attn_logit_softcapping=50.0,
+                   final_logit_softcapping=30.0,
+                   query_pre_attn_scalar=256, gemma=True)
+
+    @classmethod
     def mixtral_8x7b(cls) -> "ModelConfig":
         # Mixtral-8x7B: the expert-parallel flagship (parallel/expert.py
         # top-k dispatch; experts shard over the mesh's ep axis).
@@ -239,11 +272,20 @@ class ModelConfig:
         silently-wrong tokens."""
         mt = d.get("model_type", "llama")
         supported = ("llama", "mistral", "qwen2", "qwen3", "phi3",
-                     "mixtral")
+                     "mixtral", "gemma2")
         if mt not in supported:
             raise ValueError(
                 f"unsupported model_type {mt!r} (supported: "
                 f"{', '.join(supported)})")
+        layer_sliding = None
+        if mt == "gemma2":
+            # Alternating local/global layers: HF's layer_types (or its
+            # default pattern — sliding on even-indexed layers).
+            L = d["num_hidden_layers"]
+            lt = d.get("layer_types") or [
+                "sliding_attention" if (i + 1) % 2 else "full_attention"
+                for i in range(L)]
+            layer_sliding = tuple(t == "sliding_attention" for t in lt)
         # sliding_window is honored for ANY supported model_type — real
         # Phi-3 checkpoints declare it too (Phi-3-mini-4k ships 2047), not
         # just Mistral v0.1 (round-3 advisor finding). A declared window
@@ -274,6 +316,14 @@ class ModelConfig:
                     f"of {L}) is not implemented")
             if mwl is not None and mwl >= L:
                 sw = None           # every layer full attention — inert
+        if layer_sliding is not None and not any(layer_sliding):
+            # Every layer declared full attention: a shipped
+            # sliding_window value is inert (HF ignores it too).
+            sw = None
+        if sw is None:
+            layer_sliding = None
+        elif layer_sliding is not None and all(layer_sliding):
+            layer_sliding = None        # uniform window, static fast path
         return cls(
             name=name,
             vocab_size=d["vocab_size"],
@@ -286,12 +336,28 @@ class ModelConfig:
             rope_theta=d.get("rope_theta", 10000.0),
             rms_norm_eps=d.get("rms_norm_eps", 1e-5),
             max_position_embeddings=d.get("max_position_embeddings", 4096),
-            tie_word_embeddings=d.get("tie_word_embeddings", False),
+            tie_word_embeddings=d.get("tie_word_embeddings",
+                                      mt == "gemma2"),
             attention_bias=d.get("attention_bias",
                                  d.get("model_type") == "qwen2"),
             qk_norm=d.get("model_type") == "qwen3",
             fused_proj=d.get("model_type") == "phi3",
             sliding_window=sw,
+            layer_sliding=layer_sliding,
+            # HF's Gemma2Config DEFAULTS the caps to 50/30 when the keys
+            # are absent; an explicit null disables them. Mirror both.
+            attn_logit_softcapping=(
+                (d["attn_logit_softcapping"] or 0.0
+                 if "attn_logit_softcapping" in d else 50.0)
+                if mt == "gemma2" else 0.0),
+            final_logit_softcapping=(
+                (d["final_logit_softcapping"] or 0.0
+                 if "final_logit_softcapping" in d else 30.0)
+                if mt == "gemma2" else 0.0),
+            query_pre_attn_scalar=(
+                d.get("query_pre_attn_scalar", 256)
+                if mt == "gemma2" else None),
+            gemma=mt == "gemma2",
             num_experts=d.get("num_local_experts", 0),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             rope_scaling=cls._parse_rope_scaling(d.get("rope_scaling")),

@@ -73,6 +73,9 @@ def init_params(cfg: ModelConfig, key: jax.Array,
         layers["q_bias"] = jnp.zeros((L, Hq * Dh), dtype)
         layers["k_bias"] = jnp.zeros((L, Hkv * Dh), dtype)
         layers["v_bias"] = jnp.zeros((L, Hkv * Dh), dtype)
+    if cfg.gemma:
+        layers["pre_ff_norm"] = jnp.ones((L, D), dtype)
+        layers["post_ff_norm"] = jnp.ones((L, D), dtype)
     if cfg.qk_norm:
         layers["q_norm"] = jnp.ones((L, Dh), dtype)
         layers["k_norm"] = jnp.ones((L, Dh), dtype)
@@ -123,6 +126,49 @@ def _use_prefill_kernel(window: int, page_size: int) -> bool:
     return prefill_kernel_enabled() and window % page_size == 0
 
 
+# Sentinel window for full-attention layers when windows ride the layer
+# scan as traced per-layer values (Gemma-2 alternation): larger than any
+# context, so the window mask is a no-op.
+_FULL_WINDOW = 1 << 30
+
+
+def _attn_extras(cfg: ModelConfig) -> Dict[str, Any]:
+    """Per-model attention kwargs beyond the tensors: Gemma-2's logit
+    soft-cap and query_pre_attn_scalar**-0.5 scale override."""
+    out: Dict[str, Any] = {"logits_soft_cap": cfg.attn_logit_softcapping}
+    if cfg.query_pre_attn_scalar is not None:
+        out["scale"] = cfg.query_pre_attn_scalar ** -0.5
+    return out
+
+
+def _layer_windows(cfg: ModelConfig) -> Optional[jnp.ndarray]:
+    """[L] int32 per-layer window xs when the model alternates
+    local/global layers; None for uniform models (static window)."""
+    if cfg.layer_sliding is None:
+        return None
+    return jnp.asarray(
+        [cfg.sliding_window if s else _FULL_WINDOW
+         for s in cfg.layer_sliding], jnp.int32)
+
+
+def _scale_embed(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Gemma scales token embeddings by sqrt(hidden) (cast to the
+    activation dtype first, as HF does)."""
+    if not cfg.gemma:
+        return x
+    return x * jnp.asarray(math.sqrt(cfg.hidden_size), x.dtype)
+
+
+def _head_logits(cfg: ModelConfig, x: jnp.ndarray,
+                 head: jnp.ndarray) -> jnp.ndarray:
+    """lm_head matmul in fp32, with Gemma-2's final tanh soft-cap."""
+    logits = (x @ head).astype(jnp.float32)
+    cap = cfg.final_logit_softcapping
+    if cap > 0.0:
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
+
+
 def _qkv(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray):
     """x: [B, T, D] → q [B, T, Hq, Dh], k/v [B, T, Hkv, Dh]."""
     B, T, _ = x.shape
@@ -157,8 +203,12 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
     silently."""
     zero = jnp.zeros((), jnp.int32)
     if not cfg.is_moe:
-        return (jax.nn.silu(x @ lp["gate_proj"]) * (x @ lp["up_proj"])) \
-            @ lp["down_proj"], zero
+        gate = x @ lp["gate_proj"]
+        # Gemma gates with tanh-GELU (gelu_pytorch_tanh); llama-family
+        # with SiLU.
+        act = jax.nn.gelu(gate, approximate=True) if cfg.gemma \
+            else jax.nn.silu(gate)
+        return (act * (x @ lp["up_proj"])) @ lp["down_proj"], zero
     if cfg.moe_capacity_factor > 0:
         # Sparse top-k dispatch into capacity buckets: per-token FLOPs are
         # k×(expert MLP), independent of E; GSPMD partitions the expert
@@ -218,7 +268,8 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     sequence hits the head — all_logits exists for prompt-logprob requests.
     """
     k_pages, v_pages = kv
-    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))     # [B, T, D]
+    x = _scale_embed(cfg, params["embed"][tokens]
+                     .astype(jnp.dtype(cfg.dtype)))              # [B, T, D]
     if mm_embeds is not None:
         x = jax.vmap(
             lambda xb, eb, pb: xb.at[pb].set(
@@ -229,9 +280,15 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     kv_lengths = start_pos + lengths                             # [B]
     tok_valid = (jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
                  < lengths[:, None])                             # [B, T]
+    extras = _attn_extras(cfg)
+    win_arr = _layer_windows(cfg)
 
     def layer(x, xs):
-        lp, kp, vp = xs
+        if win_arr is not None:
+            lp, kp, vp, w_l = xs
+        else:
+            lp, kp, vp = xs
+            w_l = cfg.sliding_window or 0
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
@@ -244,7 +301,9 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         # streams pool pages + fresh blocks directly (no gathered-view
         # materialization); the XLA reference gathers then overlays.
         B, T = tokens.shape
-        if not cfg.sliding_window and _use_prefill_kernel(T, kp.shape[1]):
+        if not cfg.sliding_window and not cfg.attn_logit_softcapping \
+                and cfg.query_pre_attn_scalar is None \
+                and _use_prefill_kernel(T, kp.shape[1]):
             from xllm_service_tpu.ops.pallas import (
                 paged_prefill_attention_pallas)
             attn = paged_prefill_attention_pallas(
@@ -255,15 +314,25 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             v_all = overlay_fresh_kv(gather_pages(vp, page_table), v,
                                      start_pos)
             attn = mha_prefill_auto(q, k_all, v_all, kv_lengths, start_pos,
-                                    sliding_window=cfg.sliding_window or 0)
-        x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
-        h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        m, dropped = _mlp(lp, cfg, h, valid=tok_valid)
-        x = x + m
+                                    sliding_window=w_l, **extras)
+        a = attn.reshape(B, T, -1) @ lp["o_proj"]
+        if cfg.gemma:
+            # Gemma four-norm block: post-norms apply to the SUBLAYER
+            # OUTPUT before the residual add.
+            x = x + rms_norm(a, lp["post_norm"], cfg.rms_norm_eps)
+            h = rms_norm(x, lp["pre_ff_norm"], cfg.rms_norm_eps)
+            m, dropped = _mlp(lp, cfg, h, valid=tok_valid)
+            x = x + rms_norm(m, lp["post_ff_norm"], cfg.rms_norm_eps)
+        else:
+            x = x + a
+            h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+            m, dropped = _mlp(lp, cfg, h, valid=tok_valid)
+            x = x + m
         return x, (k, v, dropped)
 
-    x, (k_new, v_new, dropped_l) = jax.lax.scan(
-        layer, x, (params["layers"], k_pages, v_pages))
+    xs = (params["layers"], k_pages, v_pages) if win_arr is None \
+        else (params["layers"], k_pages, v_pages, win_arr)
+    x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs)
     k_pages, v_pages = write_prefill_kv_all_layers(
         k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -272,13 +341,14 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         head = params["embed"].T
     last_idx = jnp.maximum(lengths - 1, 0)
     last_x = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
-    last_logits = (last_x @ head).astype(jnp.float32)            # [B, V]
-    all_logits = (x @ head).astype(jnp.float32) if return_all_logits else None
+    last_logits = _head_logits(cfg, last_x, head)                # [B, V]
+    all_logits = _head_logits(cfg, x, head) if return_all_logits else None
     outs = [last_logits, all_logits, (k_pages, v_pages)]
     if prompt_lp_targets is not None:
         # 4th element ONLY on the echo+logprobs path: existing callers
         # (and the driver's entry contract) unpack three.
-        outs.append(_prompt_logprobs(x, head, prompt_lp_targets))
+        outs.append(_prompt_logprobs(x, head, prompt_lp_targets,
+                                     cap=cfg.final_logit_softcapping))
     if return_stats:
         outs.append({"moe_dropped": jnp.sum(dropped_l)})
     return tuple(outs)
@@ -286,7 +356,7 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
 def _prompt_logprobs(x: jnp.ndarray, head: jnp.ndarray,
                      targets: jnp.ndarray,
-                     chunk: int = 128) -> jnp.ndarray:
+                     chunk: int = 128, cap: float = 0.0) -> jnp.ndarray:
     """logprob of ``targets[b, t]`` under the distribution predicted at
     position ``t`` — the completion API's ``echo`` + ``logprobs`` prompt
     scoring. Chunked over T so the [B, c, V] logits block (not the full
@@ -299,6 +369,8 @@ def _prompt_logprobs(x: jnp.ndarray, head: jnp.ndarray,
     def one(args):
         xb, tb = args                                  # [B, c, D], [B, c]
         logits = (xb @ head).astype(jnp.float32)       # [B, c, V]
+        if cap > 0.0:
+            logits = cap * jnp.tanh(logits / cap)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(
             logits, tb[..., None].astype(jnp.int32), axis=-1)[..., 0]
@@ -331,12 +403,14 @@ def forward_prefill_ring(params: Params, cfg: ModelConfig,
     from xllm_service_tpu.parallel.mesh import AXIS_TP
     from xllm_service_tpu.parallel.ring import ring_attention_sharded
 
-    if cfg.sliding_window:
-        # Ring rotation assumes full causal reach; SWA long prompts take
-        # the chunked-window path (whose flash fold skips out-of-window
-        # chunks, so the work is O(T·W) there anyway).
+    if cfg.sliding_window or cfg.gemma:
+        # Ring rotation assumes full causal reach and the plain llama
+        # layer body; SWA/Gemma long prompts take the chunked-window
+        # path (whose flash fold skips out-of-window chunks, so the
+        # work is O(T·W) there anyway).
         raise NotImplementedError(
-            "ring prefill does not implement sliding-window masks")
+            "ring prefill implements neither sliding-window masks nor "
+            "the gemma layer body")
 
     k_pages, v_pages = kv
     B, T = tokens.shape
@@ -397,26 +471,43 @@ def forward_embedding(params: Params, cfg: ModelConfig,
     of the final hidden states, L2-normalized. tokens [B, T] padded,
     lengths [B] → [B, hidden] float32."""
     B, T = tokens.shape
-    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    x = _scale_embed(cfg, params["embed"][tokens]
+                     .astype(jnp.dtype(cfg.dtype)))
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
 
     tok_valid = (jnp.arange(T, dtype=jnp.int32)[None, :]
                  < lengths[:, None])                             # [B, T]
+    extras = _attn_extras(cfg)
+    win_arr = _layer_windows(cfg)
 
-    def layer(x, lp):
+    def layer(x, xs):
+        if win_arr is not None:
+            lp, w_l = xs
+        else:
+            lp = xs
+            w_l = cfg.sliding_window or 0
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn = mha_prefill(q, k, v, lengths,
                            jnp.zeros((B,), jnp.int32),
-                           sliding_window=cfg.sliding_window or 0)
-        x = x + attn.reshape(B, T, -1) @ lp["o_proj"]
-        h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, cfg, h, valid=tok_valid)[0]
+                           sliding_window=w_l, **extras)
+        a = attn.reshape(B, T, -1) @ lp["o_proj"]
+        if cfg.gemma:
+            x = x + rms_norm(a, lp["post_norm"], cfg.rms_norm_eps)
+            h = rms_norm(x, lp["pre_ff_norm"], cfg.rms_norm_eps)
+            x = x + rms_norm(_mlp(lp, cfg, h, valid=tok_valid)[0],
+                             lp["post_ff_norm"], cfg.rms_norm_eps)
+        else:
+            x = x + a
+            h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(lp, cfg, h, valid=tok_valid)[0]
         return x, None
 
-    x, _ = jax.lax.scan(layer, x, params["layers"])
+    xs = params["layers"] if win_arr is None \
+        else (params["layers"], win_arr)
+    x, _ = jax.lax.scan(layer, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps).astype(
         jnp.float32)
     mask = (jnp.arange(T, dtype=jnp.int32)[None] <
@@ -441,11 +532,18 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     (logits [B, V] fp32, kv'); with ``return_stats`` (static) a trailing
     stats dict (``moe_dropped``) is appended."""
     k_pages, v_pages = kv
-    x = params["embed"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))  # [B,1,D]
+    x = _scale_embed(cfg, params["embed"][tokens[:, None]]
+                     .astype(jnp.dtype(cfg.dtype)))              # [B,1,D]
     cache_lens = jnp.where(active, positions, 0)   # tokens already written
+    extras = _attn_extras(cfg)
+    win_arr = _layer_windows(cfg)
 
     def layer(x, xs):
-        lp, kp, vp = xs
+        if win_arr is not None:
+            lp, kp, vp, w_l = xs
+        else:
+            lp, kp, vp = xs
+            w_l = cfg.sliding_window or 0
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)                               # [B,1,H,Dh]
         pos2 = positions[:, None]
@@ -457,23 +555,31 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         attn = paged_decode_attention_current_auto(
             q[:, 0], kp, vp, page_table, cache_lens,
             k[:, 0], v[:, 0],
-            sliding_window=cfg.sliding_window or 0)              # [B,Hq,Dh]
+            sliding_window=w_l, **extras)                        # [B,Hq,Dh]
         B = tokens.shape[0]
-        x = x + (attn.reshape(B, 1, -1) @ lp["o_proj"])
-        h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        m, dropped = _mlp(lp, cfg, h, valid=active[:, None])
-        x = x + m
+        a = attn.reshape(B, 1, -1) @ lp["o_proj"]
+        if cfg.gemma:
+            x = x + rms_norm(a, lp["post_norm"], cfg.rms_norm_eps)
+            h = rms_norm(x, lp["pre_ff_norm"], cfg.rms_norm_eps)
+            m, dropped = _mlp(lp, cfg, h, valid=active[:, None])
+            x = x + rms_norm(m, lp["post_ff_norm"], cfg.rms_norm_eps)
+        else:
+            x = x + a
+            h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+            m, dropped = _mlp(lp, cfg, h, valid=active[:, None])
+            x = x + m
         return x, (k[:, 0], v[:, 0], dropped)
 
-    x, (k_new, v_new, dropped_l) = jax.lax.scan(
-        layer, x, (params["layers"], k_pages, v_pages))
+    xs = (params["layers"], k_pages, v_pages) if win_arr is None \
+        else (params["layers"], k_pages, v_pages, win_arr)
+    x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs)
     k_pages, v_pages = write_decode_kv_all_layers(
         k_pages, v_pages, k_new, v_new, page_table, positions, active)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
-    logits = (x[:, 0] @ head).astype(jnp.float32)                # [B, V]
+    logits = _head_logits(cfg, x[:, 0], head)                    # [B, V]
     if return_stats:
         return logits, (k_pages, v_pages), \
             {"moe_dropped": jnp.sum(dropped_l)}

@@ -29,6 +29,23 @@ NULL_PAGE = 0
 _NEG_INF = -1e30
 
 
+def _win_off(w) -> bool:
+    """Trace-time check: is the sliding window statically disabled?
+    ``w`` is either a static python int (0 = full attention) or a traced
+    int32 scalar (per-layer windows — Gemma-2's alternating local/global
+    layers ride the layer scan as xs, with full layers carrying a
+    larger-than-any-context sentinel)."""
+    return isinstance(w, int) and w == 0
+
+
+def _attn_scale(D: int, scale) -> jnp.ndarray:
+    """Default 1/sqrt(head_dim); Gemma-2 overrides with
+    query_pre_attn_scalar**-0.5."""
+    if scale is None:
+        return 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    return jnp.asarray(scale, jnp.float32)
+
+
 def _flat_kv_index(page_table: jnp.ndarray, positions: jnp.ndarray,
                    page_size: int, num_slots: int,
                    valid: jnp.ndarray) -> jnp.ndarray:
@@ -172,7 +189,7 @@ def _group_heads(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
 def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 kv_lengths: jnp.ndarray, q_start: jnp.ndarray,
                 logits_soft_cap: float = 0.0,
-                sliding_window: int = 0) -> jnp.ndarray:
+                sliding_window=0, scale=None) -> jnp.ndarray:
     """Causal GQA attention for prefill.
 
     q: [B, T, Hq, D] — the new tokens, at global positions q_start[b] + t.
@@ -188,9 +205,9 @@ def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     Hkv = k.shape[2]
     S = k.shape[1]
     qg = _group_heads(q, Hkv)                               # [B, T, Hkv, G, D]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
     logits = jnp.einsum("bthgd,bshd->bhgts", qg, k,
-                        preferred_element_type=jnp.float32) * scale
+                        preferred_element_type=jnp.float32) \
+        * _attn_scale(D, scale)
     if logits_soft_cap > 0.0:
         logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
     q_pos = q_start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
@@ -198,7 +215,7 @@ def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     causal = kv_pos[:, None, :] <= q_pos[:, :, None]                    # [B, T, S]
     in_range = kv_pos < kv_lengths[:, None]                             # [B, S]
     mask = causal & in_range[:, None, :]                                # [B, T, S]
-    if sliding_window > 0:
+    if not _win_off(sliding_window):
         mask &= kv_pos[:, None, :] > q_pos[:, :, None] - sliding_window
     logits = jnp.where(mask[:, None, None, :, :], logits, _NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)
@@ -208,7 +225,7 @@ def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def flash_fold(o: jnp.ndarray, m: jnp.ndarray, l: jnp.ndarray,
                qg: jnp.ndarray, kb: jnp.ndarray, vb: jnp.ndarray,
-               mask: jnp.ndarray, scale: jnp.ndarray,
+               mask: jnp.ndarray, scale,
                logits_soft_cap: float = 0.0):
     """Fold one KV block into a running online-softmax accumulator.
 
@@ -243,7 +260,7 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         kv_lengths: jnp.ndarray, q_start: jnp.ndarray,
                         logits_soft_cap: float = 0.0,
                         chunk_size: int = 512,
-                        sliding_window: int = 0) -> jnp.ndarray:
+                        sliding_window=0, scale=None) -> jnp.ndarray:
     """Flash-style causal GQA prefill: O(T · chunk) logits memory.
 
     Same contract as ``mha_prefill`` but instead of materializing the full
@@ -262,7 +279,7 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     G = Hq // Hkv
     if S <= chunk_size:
         return mha_prefill(q, k, v, kv_lengths, q_start, logits_soft_cap,
-                           sliding_window)
+                           sliding_window, scale)
 
     nC = (S + chunk_size - 1) // chunk_size
     pad = nC * chunk_size - S
@@ -276,7 +293,7 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     vc = v.reshape(B, nC, chunk_size, Hkv, D).transpose(1, 0, 2, 3, 4)
 
     qg = _group_heads(q, Hkv).astype(jnp.float32)           # [B,T,Hkv,G,D]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    scale = _attn_scale(D, scale)
     q_pos = q_start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B,T]
     # Highest query position in the batch: chunks starting beyond it are
     # fully masked for every row and can skip their compute. With a
@@ -300,7 +317,7 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             causal = k_pos[None, None, :] <= q_pos[:, :, None]      # [B,T,C]
             in_range = k_pos[None, :] < kv_lengths[:, None]         # [B,C]
             btc = causal & in_range[:, None, :]
-            if sliding_window > 0:
+            if not _win_off(sliding_window):
                 btc &= k_pos[None, None, :] > (q_pos[:, :, None]
                                                - sliding_window)
             mask = btc[:, :, None, None, :]
@@ -308,7 +325,7 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                               logits_soft_cap)
 
         relevant = base <= max_q_pos
-        if sliding_window > 0:
+        if not _win_off(sliding_window):
             relevant &= base + chunk_size - 1 > min_q_pos - sliding_window
         o, m, l = jax.lax.cond(relevant, compute,
                                lambda _: (o, m, l), None)
@@ -323,7 +340,7 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def mha_prefill_auto(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      kv_lengths: jnp.ndarray, q_start: jnp.ndarray,
                      logits_soft_cap: float = 0.0,
-                     sliding_window: int = 0) -> jnp.ndarray:
+                     sliding_window=0, scale=None) -> jnp.ndarray:
     """Trace-time dispatch for prefill attention, by SCORE-TENSOR BYTES
     (4·B·Hq·T·S), not sequence length alone: at the batched-prefill
     bench shape (B=64, T=128, S=512) an S-only cutoff picked the dense
@@ -338,13 +355,13 @@ def mha_prefill_auto(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     score_bytes = 4 * B * Hq * T * S
     if score_bytes <= 64 * 1024 * 1024:
         return mha_prefill(q, k, v, kv_lengths, q_start, logits_soft_cap,
-                           sliding_window)
+                           sliding_window, scale)
     per_pos = 4 * B * Hq * T                 # score bytes per kv position
     chunk = (32 * 1024 * 1024) // max(per_pos, 1)
     chunk = max(128, min(1024, (chunk // 128) * 128))
     return mha_prefill_chunked(q, k, v, kv_lengths, q_start,
                                logits_soft_cap, chunk_size=chunk,
-                               sliding_window=sliding_window)
+                               sliding_window=sliding_window, scale=scale)
 
 
 def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
@@ -353,8 +370,8 @@ def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
                                    cache_lens: jnp.ndarray,
                                    k_cur: jnp.ndarray, v_cur: jnp.ndarray,
                                    logits_soft_cap: float = 0.0,
-                                   sliding_window: int = 0
-                                   ) -> jnp.ndarray:
+                                   sliding_window=0,
+                                   scale=None) -> jnp.ndarray:
     """Decode attention over the cache PLUS the current token's K/V held
     in-registers (XLA reference path).
 
@@ -375,7 +392,7 @@ def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
     k = jnp.concatenate([k, k_cur[:, None]], axis=1)        # [B, S+1, ...]
     v = jnp.concatenate([v, v_cur[:, None]], axis=1)
     qg = _group_heads(q, Hkv)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    scale = _attn_scale(D, scale)
     logits = jnp.einsum("bhgd,bshd->bhgs", qg, k,
                         preferred_element_type=jnp.float32) * scale
     if logits_soft_cap > 0.0:
@@ -387,7 +404,7 @@ def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
     # position cache_lens, trivially inside its own window). Cache slot j
     # holds logical position j, so the window keeps j > cache_lens − W.
     in_cache = pos < cache_lens[:, None]
-    if sliding_window > 0:
+    if not _win_off(sliding_window):
         in_cache &= pos > cache_lens[:, None] - sliding_window
     mask = in_cache | (pos == S1 - 1)
     logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
@@ -399,11 +416,12 @@ def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
 def paged_decode_attention_current_auto(q, k_pages, v_pages, page_table,
                                         cache_lens, k_cur, v_cur,
                                         logits_soft_cap: float = 0.0,
-                                        sliding_window: int = 0):
+                                        sliding_window=0, scale=None):
     """Trace-time dispatch for the current-token variant. The Pallas
-    kernels implement neither soft-cap nor windowed masks, so either
-    feature routes to the XLA reference path."""
-    if logits_soft_cap == 0.0 and sliding_window == 0:
+    kernels implement neither soft-cap, windowed masks, nor scale
+    overrides, so any of those routes to the XLA reference path."""
+    if logits_soft_cap == 0.0 and _win_off(sliding_window) \
+            and scale is None:
         from xllm_service_tpu.ops import pallas
         if pallas.enabled():
             return pallas.paged_decode_attention_pallas(
@@ -411,7 +429,7 @@ def paged_decode_attention_current_auto(q, k_pages, v_pages, page_table,
                 k_cur=k_cur, v_cur=v_cur)
     return paged_decode_attention_current(
         q, k_pages, v_pages, page_table, cache_lens, k_cur, v_cur,
-        logits_soft_cap, sliding_window)
+        logits_soft_cap, sliding_window, scale)
 
 
 def paged_decode_attention_auto(q: jnp.ndarray, k_pages: jnp.ndarray,
@@ -419,25 +437,26 @@ def paged_decode_attention_auto(q: jnp.ndarray, k_pages: jnp.ndarray,
                                 page_table: jnp.ndarray,
                                 context_lens: jnp.ndarray,
                                 logits_soft_cap: float = 0.0,
-                                sliding_window: int = 0
+                                sliding_window=0, scale=None
                                 ) -> jnp.ndarray:
     """Trace-time dispatch: fused Pallas kernel on TPU (XLLM_PALLAS
     overrides), XLA gather-then-attend reference elsewhere."""
-    if logits_soft_cap == 0.0 and sliding_window == 0:
+    if logits_soft_cap == 0.0 and _win_off(sliding_window) \
+            and scale is None:
         from xllm_service_tpu.ops import pallas
         if pallas.enabled():
             return pallas.paged_decode_attention_pallas(
                 q, k_pages, v_pages, page_table, context_lens)
     return paged_decode_attention(q, k_pages, v_pages, page_table,
                                   context_lens, logits_soft_cap,
-                                  sliding_window)
+                                  sliding_window, scale)
 
 
 def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
                            v_pages: jnp.ndarray, page_table: jnp.ndarray,
                            context_lens: jnp.ndarray,
                            logits_soft_cap: float = 0.0,
-                           sliding_window: int = 0) -> jnp.ndarray:
+                           sliding_window=0, scale=None) -> jnp.ndarray:
     """Single-token GQA attention against the paged cache (XLA reference path).
 
     q: [B, Hq, D]; page_table: [B, max_pages]; context_lens: [B] (number of
@@ -448,7 +467,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     v = gather_pages(v_pages, page_table)
     Hkv = k.shape[2]
     qg = _group_heads(q, Hkv)                               # [B, Hkv, G, D]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    scale = _attn_scale(D, scale)
     logits = jnp.einsum("bhgd,bshd->bhgs", qg, k,
                         preferred_element_type=jnp.float32) * scale
     if logits_soft_cap > 0.0:
@@ -456,7 +475,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     S = k.shape[1]
     pos = jnp.arange(S, dtype=jnp.int32)[None, :]
     mask = pos < context_lens[:, None]
-    if sliding_window > 0:
+    if not _win_off(sliding_window):
         # context_lens INcludes the current token (query position is
         # context_lens − 1): keep j > (context_lens − 1) − W.
         mask &= pos > context_lens[:, None] - 1 - sliding_window

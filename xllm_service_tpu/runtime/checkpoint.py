@@ -103,14 +103,30 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
             rows.append(np.ascontiguousarray(t.T) if transpose else t)
         return np.stack(rows).astype(dtype)
 
+    def stack_norm(fmt: str) -> np.ndarray:
+        """Norm weights; Gemma checkpoints store w with output
+        (1 + w)·x̂ — fold the +1 in here so every compute path uses the
+        one standard RMSNorm (save_checkpoint subtracts it back)."""
+        rows = [r.get(fmt.format(i=i)).astype(np.float32)
+                for i in range(L)]
+        out = np.stack(rows)
+        if cfg.gemma:
+            out = out + 1.0
+        return out.astype(dtype)
+
     A = "model.layers.{i}.self_attn."
     M = "model.layers.{i}.mlp."
     layers: Dict[str, np.ndarray] = {
-        "input_norm": stack("model.layers.{i}.input_layernorm.weight"),
-        "post_norm": stack(
+        "input_norm": stack_norm("model.layers.{i}.input_layernorm.weight"),
+        "post_norm": stack_norm(
             "model.layers.{i}.post_attention_layernorm.weight"),
         "o_proj": stack(A + "o_proj.weight", transpose=True),
     }
+    if cfg.gemma:
+        layers["pre_ff_norm"] = stack_norm(
+            "model.layers.{i}.pre_feedforward_layernorm.weight")
+        layers["post_ff_norm"] = stack_norm(
+            "model.layers.{i}.post_feedforward_layernorm.weight")
     if cfg.fused_proj:
         # Phi-3 layout: qkv_proj rows = [q | k | v], gate_up rows =
         # [gate | up]. Split into the separate projections the compute
@@ -170,10 +186,13 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
         layers["up_proj"] = stack(M + "up_proj.weight", transpose=True)
         layers["down_proj"] = stack(M + "down_proj.weight", transpose=True)
 
+    final_norm = r.get("model.norm.weight").astype(np.float32)
+    if cfg.gemma:
+        final_norm = final_norm + 1.0
     params: Dict[str, Any] = {
         "embed": r.get("model.embed_tokens.weight").astype(dtype),
         "layers": layers,
-        "final_norm": r.get("model.norm.weight").astype(dtype),
+        "final_norm": final_norm.astype(dtype),
     }
     if not cfg.tie_word_embeddings:
         if "lm_head.weight" in r:
@@ -279,10 +298,18 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
 
     os.makedirs(model_dir, exist_ok=True)
     get = lambda x: np.asarray(jax.device_get(x))  # noqa: E731
+
+    def get_norm(x) -> np.ndarray:
+        """Inverse of load's +1 folding for Gemma's (1 + w) convention."""
+        w = get(x)
+        if cfg.gemma:
+            w = (w.astype(np.float32) - 1.0).astype(w.dtype)
+        return w
+
     L = cfg.num_layers
     out: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": get(params["embed"]),
-        "model.norm.weight": get(params["final_norm"]),
+        "model.norm.weight": get_norm(params["final_norm"]),
     }
     if "lm_head" in params:
         out["lm_head.weight"] = np.ascontiguousarray(
@@ -291,9 +318,14 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
     for i in range(L):
         A = f"model.layers.{i}.self_attn."
         out[f"model.layers.{i}.input_layernorm.weight"] = \
-            get(lp["input_norm"][i])
+            get_norm(lp["input_norm"][i])
         out[f"model.layers.{i}.post_attention_layernorm.weight"] = \
-            get(lp["post_norm"][i])
+            get_norm(lp["post_norm"][i])
+        if cfg.gemma:
+            out[f"model.layers.{i}.pre_feedforward_layernorm.weight"] = \
+                get_norm(lp["pre_ff_norm"][i])
+            out[f"model.layers.{i}.post_feedforward_layernorm.weight"] = \
+                get_norm(lp["post_ff_norm"][i])
         if cfg.fused_proj:
             out[A + "qkv_proj.weight"] = np.ascontiguousarray(
                 np.concatenate([get(lp[nm][i]).T for nm in
@@ -346,10 +378,21 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
         "tie_word_embeddings": cfg.tie_word_embeddings,
         "attention_bias": cfg.attention_bias,
         "torch_dtype": cfg.dtype,
-        "model_type": ("qwen3" if cfg.qk_norm
+        "model_type": ("gemma2" if cfg.gemma
+                       else "qwen3" if cfg.qk_norm
                        else "phi3" if cfg.fused_proj
                        else "qwen2" if cfg.attention_bias else "llama"),
     }
+    if cfg.sliding_window:
+        hf_cfg["sliding_window"] = cfg.sliding_window
+        if cfg.gemma and cfg.layer_sliding is not None:
+            hf_cfg["layer_types"] = [
+                "sliding_attention" if s else "full_attention"
+                for s in cfg.layer_sliding]
+    if cfg.gemma:
+        hf_cfg["attn_logit_softcapping"] = cfg.attn_logit_softcapping
+        hf_cfg["final_logit_softcapping"] = cfg.final_logit_softcapping
+        hf_cfg["query_pre_attn_scalar"] = cfg.query_pre_attn_scalar
     if cfg.rope_scaling is not None:
         kind = cfg.rope_scaling[0]
         hf_cfg["rope_scaling"] = (

@@ -107,6 +107,7 @@ _MODEL_REGISTRY = {
     "phi3-mini": ModelConfig.phi3_mini,
     "mistral-7b": ModelConfig.mistral_7b,
     "mistral-7b-v01": ModelConfig.mistral_7b_v01,
+    "gemma2-9b": ModelConfig.gemma2_9b,
     "mixtral-8x7b": ModelConfig.mixtral_8x7b,
     "tiny-moe": lambda: ModelConfig.tiny(num_experts=4),
 }
