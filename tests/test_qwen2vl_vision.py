@@ -113,18 +113,26 @@ def test_bad_image_size_refused_at_load():
         Qwen2VLVisionConfig.from_hf_config(dict(_VC), image_size=250)
 
 
-def test_text_only_qwen2vl_config_refused():
-    """A Qwen2-VL checkpoint's TEXT stack uses mrope (3-D multimodal
-    rope sections) — config load must refuse rather than silently run
-    standard rope on it."""
+def test_qwen2vl_text_config_loads_with_mrope():
+    """A Qwen2-VL text stack loads with its mrope sections parsed (both
+    published top-level and nested text_config layouts)."""
     from xllm_service_tpu.config import ModelConfig
-    with pytest.raises((ValueError, NotImplementedError)):
-        ModelConfig.from_hf_config({
-            "model_type": "qwen2_vl", "vocab_size": 256,
-            "hidden_size": 48, "intermediate_size": 96,
-            "num_hidden_layers": 2, "num_attention_heads": 4,
-            "rope_scaling": {"type": "mrope",
-                             "mrope_section": [8, 4, 4]}})
+    cfg = ModelConfig.from_hf_config({
+        "model_type": "qwen2_vl", "vocab_size": 256,
+        "hidden_size": 48, "intermediate_size": 96,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "rope_scaling": {"type": "mrope", "mrope_section": [8, 4, 4]}})
+    assert cfg.rope_scaling == ("mrope", (8, 4, 4))
+    assert cfg.attention_bias
+    nested = ModelConfig.from_hf_config({
+        "model_type": "qwen2_vl",
+        "text_config": {
+            "vocab_size": 256, "hidden_size": 48,
+            "intermediate_size": 96, "num_hidden_layers": 2,
+            "num_attention_heads": 4,
+            "rope_scaling": {"rope_type": "default",
+                             "mrope_section": [8, 4, 4]}}})
+    assert nested.rope_scaling == ("mrope", (8, 4, 4))
 
 
 def _hybrid_vlm_dir(tmp_path) -> str:
@@ -235,6 +243,147 @@ def test_epd_e2e_real_vision_tower(tmp_path, monkeypatch):
             w.stop()
         master.stop()
         store.close()
+
+
+def _make_hf_vlm_mrope(seed: int = 0):
+    """Tiny Qwen2-VL with mrope sections and small special-token ids
+    (so a 256 vocab covers them)."""
+    cfg = transformers.Qwen2VLConfig(
+        vocab_size=256, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        vision_config=dict(_VC), max_position_embeddings=512,
+        rope_scaling={"type": "mrope", "mrope_section": [2, 2, 2]},
+        image_token_id=250, vision_start_token_id=249,
+        video_token_id=248, attn_implementation="eager")
+    torch.manual_seed(seed)
+    return transformers.Qwen2VLForConditionalGeneration(cfg).float().eval()
+
+
+def _load_text(path):
+    import dataclasses
+    from xllm_service_tpu.config import ModelConfig
+    from xllm_service_tpu.runtime.checkpoint import load_checkpoint
+    with open(os.path.join(path, "config.json"), encoding="utf-8") as f:
+        mc = ModelConfig.from_hf_config(json.load(f), name="q2vl")
+    mc = dataclasses.replace(mc, dtype="float32")
+    return mc, load_checkpoint(path, mc)
+
+
+def _encode_ours(vcfg, vparams, patches, grids):
+    cos, sin = rotary_cos_sin(vcfg, grids)
+    return np.asarray(encode_patches(
+        vparams, vcfg, jnp.asarray(patches), jnp.asarray(cos),
+        jnp.asarray(sin), jnp.asarray(segment_ids(grids))))
+
+
+def test_qwen2vl_text_logits_match_torch(tmp_path):
+    """Full Qwen2-VL text stack (mrope, qkv bias, language_model key
+    nesting) matches the torch oracle on a pure-text prompt — where
+    mrope's equal streams must reduce exactly to standard rope."""
+    model = _make_hf_vlm_mrope()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    mc, params = _load_text(str(tmp_path))
+    assert mc.rope_scaling == ("mrope", (2, 2, 2))
+
+    from xllm_service_tpu.models import forward_prefill, init_kv_cache
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    with torch.no_grad():
+        ref = model(input_ids=torch.tensor([prompt])).logits[0].numpy()
+    T = len(prompt)
+    kv = init_kv_cache(mc, 64, 4, jnp.float32)
+    pt = jnp.asarray([list(range(1, (T + 3) // 4 + 2))], jnp.int32)
+    _, ours, _ = forward_prefill(
+        params, mc, jnp.asarray([prompt], jnp.int32),
+        jnp.zeros(1, jnp.int32), jnp.asarray([T], jnp.int32), kv, pt,
+        return_all_logits=True)
+    np.testing.assert_allclose(np.asarray(ours)[0], ref,
+                               rtol=2e-4, atol=5e-4)
+
+
+def test_qwen2vl_image_logits_match_torch(tmp_path):
+    """With an image span: our tower embeddings + splice + 3-D mrope
+    positions reproduce HF's full multimodal forward per-position."""
+    model = _make_hf_vlm_mrope()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    mc, params = _load_text(str(tmp_path))
+    vcfg, vparams = load_qwen2vl_vision(str(tmp_path), image_size=16)
+
+    from xllm_service_tpu.models import forward_prefill, init_kv_cache
+    from xllm_service_tpu.runtime.multimodal import mrope_positions
+    prompt = [7, 249] + [250] * 4 + [5, 11, 2]
+    rng = np.random.default_rng(0)
+    patches = rng.standard_normal((16, vcfg.patch_dim)).astype(np.float32)
+    grids = [(1, 4, 4)]
+    with torch.no_grad():
+        ref = model(input_ids=torch.tensor([prompt]),
+                    pixel_values=torch.from_numpy(patches),
+                    image_grid_thw=torch.tensor(grids)).logits[0].numpy()
+
+    emb = _encode_ours(vcfg, vparams, patches, grids)
+    mm_pos = [i for i, t in enumerate(prompt) if t == 250]
+    rp, delta = mrope_positions(prompt, 250, grids, merge=2)
+    assert delta == -2      # 4-token image span over a 3-wide rope span
+    T = len(prompt)
+    kv = init_kv_cache(mc, 64, 4, jnp.float32)
+    pt = jnp.asarray([list(range(1, (T + 3) // 4 + 2))], jnp.int32)
+    _, ours, _ = forward_prefill(
+        params, mc, jnp.asarray([prompt], jnp.int32),
+        jnp.zeros(1, jnp.int32), jnp.asarray([T], jnp.int32), kv, pt,
+        return_all_logits=True,
+        mm_embeds=jnp.asarray(emb[None]),
+        mm_positions=jnp.asarray(mm_pos, jnp.int32)[None],
+        rope_pos=jnp.asarray(rp[None]))
+    np.testing.assert_allclose(np.asarray(ours)[0], ref,
+                               rtol=2e-4, atol=5e-4)
+
+
+def test_qwen2vl_engine_greedy_with_image_matches_hf(tmp_path):
+    """Engine-level EPD decode: paged KV, rope_delta-offset decode
+    positions, and the spliced tower embeddings reproduce HF's greedy
+    continuation of an image prompt."""
+    model = _make_hf_vlm_mrope()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    mc, params = _load_text(str(tmp_path))
+    vcfg, vparams = load_qwen2vl_vision(str(tmp_path), image_size=16)
+
+    from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+    from xllm_service_tpu.runtime.multimodal import mrope_positions
+    from xllm_service_tpu.config import EngineConfig
+    from xllm_service_tpu.utils.types import SamplingParams
+
+    prompt = [7, 249] + [250] * 4 + [5, 11, 2]
+    rng = np.random.default_rng(1)
+    patches = rng.standard_normal((16, vcfg.patch_dim)).astype(np.float32)
+    grids = [(1, 4, 4)]
+    steps = 10
+    with torch.no_grad():
+        out = model.generate(
+            input_ids=torch.tensor([prompt]),
+            pixel_values=torch.from_numpy(patches),
+            image_grid_thw=torch.tensor(grids),
+            max_new_tokens=steps, do_sample=False)
+    ref = out[0, len(prompt):].tolist()
+
+    emb = _encode_ours(vcfg, vparams, patches, grids)
+    mm_pos = [i for i, t in enumerate(prompt) if t == 250]
+    rp, delta = mrope_positions(prompt, 250, grids, merge=2)
+    eng = Engine(mc, EngineConfig(
+        page_size=4, num_pages=64, max_model_len=128, max_batch_size=2,
+        max_prefill_tokens=64, prefill_buckets=(8, 16, 32, 64)),
+        params=params)
+    eng.add_request(EngineRequest(
+        request_id="vlm", token_ids=list(prompt),
+        sampling=SamplingParams(max_tokens=steps, temperature=0.0,
+                                ignore_eos=True),
+        mm_embeds=emb, mm_positions=mm_pos,
+        mm_rope_pos=rp, rope_delta=delta))
+    got = []
+    for _ in range(200):
+        if not eng.has_work():
+            break
+        for o in eng.step():
+            got.extend(o.new_token_ids)
+    assert got == ref
 
 
 def test_load_returns_none_for_text_checkpoint(tmp_path):

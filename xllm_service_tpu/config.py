@@ -272,11 +272,16 @@ class ModelConfig:
         silently-wrong tokens."""
         mt = d.get("model_type", "llama")
         supported = ("llama", "mistral", "qwen2", "qwen3", "phi3",
-                     "mixtral", "gemma2")
+                     "mixtral", "gemma2", "qwen2_vl")
         if mt not in supported:
             raise ValueError(
                 f"unsupported model_type {mt!r} (supported: "
                 f"{', '.join(supported)})")
+        if mt == "qwen2_vl":
+            # Current transformers nests the text stack under
+            # text_config (published checkpoints keep it top-level) —
+            # flatten, keeping the outer model_type.
+            d = {**d, **d.get("text_config", {}), "model_type": mt}
         layer_sliding = None
         if mt == "gemma2":
             # Alternating local/global layers: HF's layer_types (or its
@@ -292,7 +297,7 @@ class ModelConfig:
         # at least max_position_embeddings is inert and normalized away so
         # the full-attention fast paths stay eligible.
         sw = d.get("sliding_window") or None
-        if sw is not None and mt in ("qwen2", "qwen3") \
+        if sw is not None and mt in ("qwen2", "qwen3", "qwen2_vl") \
                 and not d.get("use_sliding_window", False):
             # Qwen2-family raw config.json declares-but-disables the
             # window (e.g. Qwen2.5-7B-Instruct-1M: sliding_window 32768,
@@ -339,7 +344,8 @@ class ModelConfig:
             tie_word_embeddings=d.get("tie_word_embeddings",
                                       mt == "gemma2"),
             attention_bias=d.get("attention_bias",
-                                 d.get("model_type") == "qwen2"),
+                                 d.get("model_type")
+                                 in ("qwen2", "qwen2_vl")),
             qk_norm=d.get("model_type") == "qwen3",
             fused_proj=d.get("model_type") == "phi3",
             sliding_window=sw,
@@ -372,6 +378,12 @@ class ModelConfig:
         if not rs:
             return None
         kind = rs.get("rope_type", rs.get("type"))
+        if rs.get("mrope_section") and kind in (None, "default", "mrope"):
+            # Qwen2-VL 3-D multimodal rope: (t, h, w) frequency-band
+            # sections (ops/rope.py apply_mrope). Published checkpoints
+            # say type "mrope"; transformers re-serializes it as
+            # "default" + mrope_section.
+            return ("mrope", tuple(int(s) for s in rs["mrope_section"]))
         if kind in (None, "default"):
             return None
         if kind == "llama3":

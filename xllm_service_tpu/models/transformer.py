@@ -30,7 +30,7 @@ import jax.numpy as jnp
 
 from xllm_service_tpu.config import ModelConfig
 from xllm_service_tpu.ops.norm import rms_norm
-from xllm_service_tpu.ops.rope import apply_rope
+from xllm_service_tpu.ops.rope import apply_rope, rope_for
 from xllm_service_tpu.ops.attention import (
     mha_prefill,
     mha_prefill_auto,
@@ -246,6 +246,7 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                     mm_positions: Optional[jnp.ndarray] = None,
                     prompt_lp_targets: Optional[jnp.ndarray] = None,
                     return_stats: bool = False,
+                    rope_pos: Optional[jnp.ndarray] = None,
                     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], KVCache]:
     """Prefill ``tokens`` [B, T] (padded; true new-token counts in
     ``lengths``; nonzero ``start_pos`` = prefix-cache hit, those tokens are
@@ -260,6 +261,11 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     (vision-encoder) embeddings over the token embeddings at the given
     window-relative positions (EPD prefill stage; pad positions ≥ T are
     dropped).
+
+    ``rope_pos`` [B, 3, T] — explicit 3-D rope positions for mrope
+    models (Qwen2-VL: image tokens rotate by (t, h, w) grid ids,
+    decoupled from KV storage positions). None → streams broadcast from
+    the storage positions (pure-text requests; equals standard rope).
 
     Returns (last_logits [B, V] fp32, all_logits [B, T, V] fp32 or None,
     kv'). ``return_all_logits`` (static) gates the full-prompt lm_head: at
@@ -291,8 +297,10 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             w_l = cfg.sliding_window or 0
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)
-        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        q = rope_for(cfg.rope_scaling, q, positions, cfg.rope_theta,
+                     positions3=rope_pos)
+        k = rope_for(cfg.rope_scaling, k, positions, cfg.rope_theta,
+                     positions3=rope_pos)
         # Attend against cache (prefix-cache hits) + this step's fresh K/V.
         # The pool itself is NOT written here: emitting updated pools as
         # scan ys would rewrite the whole pool per call — the fresh rows
@@ -488,8 +496,8 @@ def forward_embedding(params: Params, cfg: ModelConfig,
             w_l = cfg.sliding_window or 0
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)
-        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        q = rope_for(cfg.rope_scaling, q, positions, cfg.rope_theta)
+        k = rope_for(cfg.rope_scaling, k, positions, cfg.rope_theta)
         attn = mha_prefill(q, k, v, lengths,
                            jnp.zeros((B,), jnp.int32),
                            sliding_window=w_l, **extras)
@@ -526,11 +534,17 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                    positions: jnp.ndarray, active: jnp.ndarray,
                    kv: KVCache, page_table: jnp.ndarray,
                    return_stats: bool = False,
+                   rope_delta: Optional[jnp.ndarray] = None,
                    ) -> Tuple[jnp.ndarray, KVCache]:
     """One decode step for ``tokens`` [B] at ``positions`` [B]
     (``active`` [B] bool masks empty batch slots). Returns
     (logits [B, V] fp32, kv'); with ``return_stats`` (static) a trailing
-    stats dict (``moe_dropped``) is appended."""
+    stats dict (``moe_dropped``) is appended.
+
+    ``rope_delta`` [B] — mrope models only: per-sequence offset between
+    the rope position of a generated token and its KV storage position
+    (images compress T·H·W patch tokens into a max(t,h,w)-sized rope
+    span, so post-image rope positions trail storage positions)."""
     k_pages, v_pages = kv
     x = _scale_embed(cfg, params["embed"][tokens[:, None]]
                      .astype(jnp.dtype(cfg.dtype)))              # [B,1,D]
@@ -547,8 +561,15 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)                               # [B,1,H,Dh]
         pos2 = positions[:, None]
-        q = apply_rope(q, pos2, cfg.rope_theta, cfg.rope_scaling)
-        k = apply_rope(k, pos2, cfg.rope_theta, cfg.rope_scaling)
+        rp3 = None
+        if rope_delta is not None:
+            rp3 = jnp.broadcast_to(
+                (positions + rope_delta)[:, None, None],
+                (positions.shape[0], 3, 1))
+        q = rope_for(cfg.rope_scaling, q, pos2, cfg.rope_theta,
+                     positions3=rp3)
+        k = rope_for(cfg.rope_scaling, k, pos2, cfg.rope_theta,
+                     positions3=rp3)
         # The current token's K/V stays in-registers for attention; the
         # pool write happens once for all layers after the scan (carrying
         # the pool as scan ys would rewrite the whole pool per step).

@@ -20,7 +20,8 @@ from typing import Optional, Tuple
 
 import jax.numpy as jnp
 
-RopeScaling = Tuple[str, float, float, float, int]
+RopeScaling = Tuple  # ("llama3", f, lo, hi, orig) | ("linear", f, 0, 0, 0)
+#                      | ("mrope", (s_t, s_h, s_w))
 
 
 def rope_inv_freq(head_dim: int, theta: float,
@@ -46,6 +47,8 @@ def rope_inv_freq(head_dim: int, theta: float,
                          jnp.where(wavelen < orig / high_f, inv, scaled))
     if kind == "linear":
         return inv / float(scaling[1])
+    if kind == "mrope":
+        return inv          # sections select streams; bands unscaled
     raise NotImplementedError(
         f"rope_scaling type {kind!r} not supported — refusing to load a "
         f"checkpoint whose positions would be silently mis-rotated")
@@ -68,9 +71,53 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float,
     the first half of head_dim pairs with the second half."""
     head_dim = x.shape[-1]
     cos, sin = rope_cos_sin(positions, head_dim, theta, scaling=scaling)
+    return _rotate(x, cos, sin)
+
+
+def _rotate(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
+    head_dim = x.shape[-1]
     cos = cos[..., None, :]  # broadcast over heads: [..., seq, 1, half]
     sin = sin[..., None, :]
     x1 = x[..., : head_dim // 2].astype(jnp.float32)
     x2 = x[..., head_dim // 2:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
+
+
+def apply_mrope(x: jnp.ndarray, positions3: jnp.ndarray, theta: float,
+                sections: Tuple[int, ...]) -> jnp.ndarray:
+    """Qwen2-VL multimodal rope: three position streams (temporal /
+    height / width), each owning a contiguous SECTION of the frequency
+    bands. ``positions3`` is [..., 3, seq]; ``sections`` (s_t, s_h, s_w)
+    sums to head_dim // 2. Text tokens carry equal streams, which makes
+    this exactly standard rope for them (HF apply_multimodal_rotary_
+    pos_emb semantics — the duplicated-emb split with i % 3 selection
+    reduces to a per-section stream choice on the half axis)."""
+    head_dim = x.shape[-1]
+    freq = rope_inv_freq(head_dim, theta)               # [half]
+    ang = positions3.astype(jnp.float32)[..., None] * freq  # [..,3,seq,half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    parts_c, parts_s = [], []
+    off = 0
+    for i, s in enumerate(sections):
+        parts_c.append(cos[..., i, :, off:off + s])
+        parts_s.append(sin[..., i, :, off:off + s])
+        off += s
+    return _rotate(x, jnp.concatenate(parts_c, -1),
+                   jnp.concatenate(parts_s, -1))
+
+
+def rope_for(cfg_scaling, x: jnp.ndarray, positions: jnp.ndarray,
+             theta: float, positions3: Optional[jnp.ndarray] = None
+             ) -> jnp.ndarray:
+    """Model-level dispatch: standard/scaled rope for 1-D positions,
+    mrope when the config carries sections. With mrope but no explicit
+    3-D positions (pure-text requests), streams are the broadcast 1-D
+    positions — identical to standard rope by construction."""
+    if cfg_scaling is not None and cfg_scaling[0] == "mrope":
+        if positions3 is None:
+            positions3 = jnp.broadcast_to(
+                positions[..., None, :],
+                positions.shape[:-1] + (3, positions.shape[-1]))
+        return apply_mrope(x, positions3, theta, cfg_scaling[1])
+    return apply_rope(x, positions, theta, cfg_scaling)

@@ -87,6 +87,27 @@ class _ShardedReader:
         self._handles.clear()
 
 
+class _PrefixRemap:
+    """Key-prefix indirection over a _ShardedReader (text stacks nested
+    under model.language_model.* in VLM checkpoints)."""
+
+    def __init__(self, inner, old: str, new: str) -> None:
+        self._inner, self._old, self._new = inner, old, new
+
+    def _map(self, name: str) -> str:
+        return self._new + name[len(self._old):] \
+            if name.startswith(self._old) else name
+
+    def get(self, name: str) -> np.ndarray:
+        return self._inner.get(self._map(name))
+
+    def __contains__(self, name: str) -> bool:
+        return self._map(name) in self._inner
+
+    def close(self) -> None:
+        self._inner.close()
+
+
 def load_checkpoint(model_dir: str, cfg: ModelConfig,
                     mesh=None) -> Dict[str, Any]:
     """Load a HF checkpoint directory into the transformer's pytree,
@@ -95,6 +116,11 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
     r = _ShardedReader(model_dir)
     dtype = _np_dtype(cfg.dtype)
     L = cfg.num_layers
+    # VLM checkpoints may nest the text stack (current transformers
+    # writes model.language_model.*; published Qwen2-VL keeps model.*).
+    if "model.embed_tokens.weight" not in r \
+            and "model.language_model.embed_tokens.weight" in r:
+        r = _PrefixRemap(r, "model.", "model.language_model.")
 
     def stack(fmt: str, transpose: bool = False) -> np.ndarray:
         rows: List[np.ndarray] = []

@@ -55,7 +55,7 @@ logger = logging.getLogger(__name__)
 # decode rows are [token, position, active, page_table...]; prefill rows
 # are [start, length, tokens..., page_table...]; ring-prefill rows are
 # [length, tokens..., page_table...].
-_PACK_COLS = 3          # decode header columns
+_PACK_COLS = 4          # decode header columns (tok, pos, active, rope_delta)
 _PREFILL_HDR = 2        # prefill header columns
 _RING_HDR = 1           # ring-prefill header columns
 _BIAS_K = 8             # default sparse logit-bias columns (pow2-bucketed)
@@ -82,6 +82,12 @@ class EngineRequest:
     # positions they splice into (image-placeholder token spans).
     mm_embeds: Optional[np.ndarray] = None
     mm_positions: Optional[List[int]] = None
+    # mrope models (Qwen2-VL): [3, prompt_len] rope position streams for
+    # the prompt (runtime/multimodal.mrope_positions) and the constant
+    # rope−storage offset for every generated token. None/0 = pure text
+    # (streams equal storage positions).
+    mm_rope_pos: Optional[np.ndarray] = None
+    rope_delta: int = 0
     # Completion-API echo+logprobs: score every prompt token (the first
     # is None — nothing to condition on). Such sequences prefill in
     # singleton batches through a separate jitted program and skip
@@ -192,7 +198,12 @@ class Engine:
         self._slot_last_token = self._slot_packed[:, 0]
         self._slot_pos = self._slot_packed[:, 1]
         self._slot_active = self._slot_packed[:, 2]
+        self._slot_rope_delta = self._slot_packed[:, 3]
         self._slot_pt = self._slot_packed[:, _PACK_COLS:]
+        # mrope models ship explicit 3-D rope positions at prefill and a
+        # per-slot rope delta at decode (trace-time switch; cfg static).
+        self._mrope = (model_cfg.rope_scaling is not None
+                       and model_cfg.rope_scaling[0] == "mrope")
         # Per-slot sampling params change only on admit/finish; the packed
         # device pair is rebuilt lazily instead of per decode step.
         self._slot_sampling: List[SamplingParams] = [SamplingParams()] * B
@@ -688,6 +699,12 @@ class Engine:
                     if g < seq0.num_prompt_tokens:
                         tgt[0, t] = seq0.tokens[g]
                 plp_targets = jnp.asarray(tgt)
+            rope_pos = None
+            if self._mrope:
+                rope_np = np.zeros((B, 3, T), np.int32)
+                for i, seq in enumerate(batch):
+                    rope_np[i] = self._rope_window(seq, seq.num_computed, T)
+                rope_pos = jnp.asarray(rope_np)
             mm_e = mm_p = None
             if any(s.req.mm_embeds is not None for s in batch):
                 # Pad the multimodal splice to a pow2 bucket; positions are
@@ -715,13 +732,14 @@ class Engine:
                 fused, top_ids, top_lps, self.kv, plp, mdrop = \
                     jitted(self.params, jnp.asarray(packed), self.kv,
                            st_f32, st_i32, key, mm_e, mm_p,
-                           plp_targets, bias_ids, bias_vals, t_len=T)
+                           plp_targets, bias_ids, bias_vals, rope_pos,
+                           t_len=T)
             else:
                 plp = None
                 fused, top_ids, top_lps, self.kv, mdrop = \
                     jitted(self.params, jnp.asarray(packed), self.kv,
                            st_f32, st_i32, key, mm_e, mm_p, None,
-                           bias_ids, bias_vals, t_len=T)
+                           bias_ids, bias_vals, rope_pos, t_len=T)
         self._note_recompile("prefill_plp" if plp_mode else "prefill",
                              jitted, cache_before)
         with self._phase("prefill.readback"):
@@ -1123,10 +1141,24 @@ class Engine:
             return FinishReason.LENGTH
         return FinishReason.NONE
 
+    def _rope_window(self, seq: Sequence, start: int, T: int) -> np.ndarray:
+        """[3, T] mrope ids for window [start, start+T): prompt indices
+        take the request's precomputed streams; generated/pad indices are
+        storage + delta (all streams equal — plain text by then)."""
+        g = np.arange(start, start + T, dtype=np.int32)
+        out = np.broadcast_to(g + seq.req.rope_delta, (3, T)).copy()
+        rp = seq.req.mm_rope_pos
+        if rp is not None:
+            n = max(0, min(rp.shape[1] - start, T))
+            if n > 0:
+                out[:, :n] = rp[:, start:start + n]
+        return out
+
     def _sync_slot(self, seq: Sequence) -> None:
         if seq.slot < 0:
             return
         i = seq.slot
+        self._slot_rope_delta[i] = seq.req.rope_delta
         self._slot_pt[i] = 0
         self._slot_pt[i, :len(seq.pages)] = seq.pages
 
@@ -1302,11 +1334,13 @@ class Engine:
         for B, T, mp in prefill_shapes:
             st_f32, st_i32 = self._sampling_tensors([], B)
             b_ids, b_vals = self._batch_bias([], B, self.cfg.vocab_size)
+            warm_rp = (jnp.zeros((B, 3, T), jnp.int32)
+                       if self._mrope else None)
             _, _, _, self.kv, _ = self._jit_prefill(
                 self.params,
                 jnp.zeros((B, _PREFILL_HDR + T + mp), jnp.int32),
                 self.kv, st_f32, st_i32, key, None, None, None,
-                b_ids, b_vals, t_len=T)
+                b_ids, b_vals, warm_rp, t_len=T)
 
         # Decode (single + fused multi): every pow2 table width. Inactive
         # slots + NULL pages make the KV writes no-ops.
@@ -1339,7 +1373,7 @@ class Engine:
             if self.ecfg.decode_steps > 1:
                 tok0 = jnp.zeros((Bmax,), jnp.int32)
                 pos0 = jnp.zeros((Bmax,), jnp.int32)
-                apt0 = jnp.zeros((Bmax, 1 + mp), jnp.int32)
+                apt0 = jnp.zeros((Bmax, 2 + mp), jnp.int32)
                 (_, _, _, self.kv, _, _, _, _) = self._jit_decode_multi(
                     self.params, tok0, pos0, apt0, self.kv, st_f32,
                     st_i32, key, None, b_ids, b_vals)
@@ -1407,8 +1441,9 @@ def _split_tok_lp(fused: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
 
 def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
                   mm_positions=None, plp_targets=None, bias_ids=None,
-                  bias_vals=None, *, cfg: ModelConfig, num_top: int = 0,
-                  t_len: int = 0, with_prompt_lps: bool = False):
+                  bias_vals=None, rope_pos=None, *, cfg: ModelConfig,
+                  num_top: int = 0, t_len: int = 0,
+                  with_prompt_lps: bool = False):
     start_pos = packed[:, 0]
     lengths = packed[:, 1]
     tokens = packed[:, _PREFILL_HDR:_PREFILL_HDR + t_len]
@@ -1418,7 +1453,7 @@ def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
         params, cfg, tokens, start_pos, lengths, kv, page_table,
         mm_embeds=mm_embeds, mm_positions=mm_positions,
         prompt_lp_targets=plp_targets if with_prompt_lps else None,
-        return_stats=True)
+        return_stats=True, rope_pos=rope_pos)
     if with_prompt_lps:
         last_logits, _, kv, plp, stats = res
     else:
@@ -1462,11 +1497,14 @@ def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None,
     tokens = packed[:, 0]
     positions = packed[:, 1]
     active = packed[:, 2].astype(bool)
+    is_mrope = (cfg.rope_scaling is not None
+                and cfg.rope_scaling[0] == "mrope")
+    rope_delta = packed[:, 3] if is_mrope else None
     page_table = packed[:, _PACK_COLS:]
     st = SamplingTensors.unpack(st_f32, st_i32)
     logits, kv, stats = transformer.forward_decode(
         params, cfg, tokens, positions, active, kv, page_table,
-        return_stats=True)
+        return_stats=True, rope_delta=rope_delta)
     tok = sample_tokens(logits, st, key, positions=positions, counts=counts,
                         bias_ids=bias_ids, bias_vals=bias_vals)
     lp = compute_logprobs(logits, tok)
@@ -1492,18 +1530,22 @@ def _decode_multi_step(params, tokens, positions, active_pt, kv, st_f32,
     token/position arrays straight back in — device-resident decode state,
     zero host uploads when batch membership is unchanged (the tunneled
     host round-trip is ~80 ms, docs/PERF_NOTES.md). ``active_pt`` is
-    [B, 1+MP]: column 0 the active mask, the rest the page table — kept
-    as one buffer because both change on the same events (admit/finish/
-    page growth), detected host-side by an array compare."""
+    [B, 2+MP]: column 0 the active mask, column 1 the per-slot mrope
+    rope delta (0 for standard-rope models), the rest the page table —
+    kept as one buffer because all change on the same events (admit/
+    finish/page growth), detected host-side by an array compare."""
     active = active_pt[:, 0].astype(bool)
-    page_table = active_pt[:, 1:]
+    is_mrope = (cfg.rope_scaling is not None
+                and cfg.rope_scaling[0] == "mrope")
+    rope_delta = active_pt[:, 1] if is_mrope else None
+    page_table = active_pt[:, 2:]
     st = SamplingTensors.unpack(st_f32, st_i32)
 
     def body(carry, key_i):
         tok, pos, kv, cnt, drop = carry
         logits, kv, stats = transformer.forward_decode(
             params, cfg, tok, pos, active, kv, page_table,
-            return_stats=True)
+            return_stats=True, rope_delta=rope_delta)
         new_tok = sample_tokens(logits, st, key_i, positions=pos,
                                 counts=cnt, bias_ids=bias_ids,
                                 bias_vals=bias_vals)

@@ -94,6 +94,50 @@ def expand_image_placeholders(token_ids: Sequence[int],
     return out, positions
 
 
+def mrope_positions(token_ids: Sequence[int], image_token: int,
+                    grids: Sequence[Tuple[int, int, int]], merge: int
+                    ) -> Tuple[np.ndarray, int]:
+    """Qwen2-VL 3-D rope positions for one prompt (HF get_rope_index,
+    modeling_qwen2_vl.py:925): text tokens advance all three streams
+    together; each run of ``image_token`` consumes the next grid (t, h,
+    w) and rotates by grid ids offset at the current base; the base then
+    advances by max(t, h/merge, w/merge) — rope positions COMPRESS
+    relative to storage positions past an image.
+
+    Returns ([3, T] int32 rope ids, delta) where ``delta`` is the
+    constant rope−storage offset for every later (generated) token."""
+    T = len(token_ids)
+    out = np.zeros((3, T), np.int32)
+    pos = 0
+    gi = 0
+    base = 0
+    ids = list(token_ids)
+    while pos < T:
+        if ids[pos] == image_token:
+            if gi >= len(grids):
+                raise ValueError("more image-token runs than grids")
+            t, h, w = grids[gi]
+            gi += 1
+            lh, lw = h // merge, w // merge
+            n = t * lh * lw
+            if pos + n > T or any(tok != image_token
+                                  for tok in ids[pos:pos + n]):
+                raise ValueError("image-token run shorter than its grid")
+            out[0, pos:pos + n] = base + np.repeat(
+                np.arange(t, dtype=np.int32), lh * lw)
+            out[1, pos:pos + n] = base + np.tile(np.repeat(
+                np.arange(lh, dtype=np.int32), lw), t)
+            out[2, pos:pos + n] = base + np.tile(
+                np.arange(lw, dtype=np.int32), t * lh)
+            base += max(t, lh, lw)
+            pos += n
+        else:
+            out[:, pos] = base
+            base += 1
+            pos += 1
+    return out, base - T
+
+
 def embeds_to_wire(embeds: np.ndarray) -> Dict[str, Any]:
     arr = np.ascontiguousarray(embeds, dtype=np.float32)
     return {"embeds_b64": base64.b64encode(arr.tobytes()).decode("ascii"),

@@ -96,6 +96,22 @@ class WorkerOptions:
     murmur_seed: int = 0
 
 
+def _mm_meta(req) -> Optional[Dict[str, Any]]:
+    """Multimodal state for a migration meta line (None for text): the
+    vision embeddings, splice positions, and mrope prompt streams the
+    decode side needs to re-prefill after preemption and to keep the
+    sequence out of the content-addressed prefix cache."""
+    if req.mm_embeds is None:
+        return None
+    from xllm_service_tpu.runtime.multimodal import embeds_to_wire
+    return {
+        "embeds": embeds_to_wire(req.mm_embeds),
+        "positions": list(req.mm_positions or []),
+        "rope_pos": (req.mm_rope_pos.tolist()
+                     if req.mm_rope_pos is not None else None),
+    }
+
+
 _MODEL_REGISTRY = {
     # vocab 512 ≥ ByteTokenizer's id range (256 bytes + specials).
     "tiny": lambda: ModelConfig.tiny(vocab_size=512),
@@ -935,7 +951,8 @@ class Worker:
             import dataclasses as _dc
             engine_sampling = _dc.replace(sampling, max_tokens=1,
                                           ignore_eos=False)
-        mm_embeds = mm_positions = None
+        mm_embeds = mm_positions = mm_rope_pos = None
+        rope_delta = 0
         mm_inputs = body.get("mm_inputs") or []
         if mm_inputs:
             from xllm_service_tpu.nlp.chat_template import IMAGE_PLACEHOLDER
@@ -945,10 +962,31 @@ class Worker:
             embeds = self._resolve_mm_embeds(
                 mm_inputs, routing.get("encode_name", ""))
             n_img, tpi, _ = embeds.shape
+            img_tok = image_token_id(rt.model_cfg.vocab_size)
             token_ids, mm_positions = expand_image_placeholders(
                 list(token_ids), rt.tokenizer.encode(IMAGE_PLACEHOLDER),
-                n_img, tpi, image_token_id(rt.model_cfg.vocab_size))
+                n_img, tpi, img_tok)
             mm_embeds = embeds.reshape(n_img * tpi, -1)
+            if rt.model_cfg.rope_scaling is not None \
+                    and rt.model_cfg.rope_scaling[0] == "mrope":
+                # Qwen2-VL 3-D rope over the image spans. The merged
+                # grid side comes from the EMBEDS the encode stage
+                # produced (sqrt of tokens-per-image) — the only source
+                # that stays correct when a remote ENCODE worker ran a
+                # different resize target, and it needs no tower load
+                # on a text-serving worker. mrope ids depend only on the
+                # merged side, so the pre-merge (h, w, merge) pair below
+                # is an arbitrary consistent factorization.
+                from xllm_service_tpu.runtime.multimodal import (
+                    mrope_positions)
+                side = int(round(tpi ** 0.5))
+                if side * side != tpi:
+                    raise ValueError(
+                        f"non-square image token count {tpi}; cannot "
+                        f"derive the mrope grid")
+                mm_rope_pos, rope_delta = mrope_positions(
+                    token_ids, img_tok, [(1, 2 * side, 2 * side)] * n_img,
+                    2)
         stream = bool(body.get("stream", False))
         validate_sampling(engine_sampling, stream)
         if engine_sampling.logit_bias:
@@ -977,6 +1015,8 @@ class Worker:
             hold_after_finish=pd_prefill,
             mm_embeds=mm_embeds,
             mm_positions=mm_positions,
+            mm_rope_pos=mm_rope_pos,
+            rope_delta=rope_delta,
             prompt_logprobs=(sampling.echo and sampling.logprobs
                              and not is_chat and not pd_prefill))
         live = _LiveRequest(
@@ -1536,6 +1576,8 @@ class Worker:
             "model": live.model,
             "tokens": tokens,
             "prompt_len": len(live.req.token_ids),
+            "rope_delta": live.req.rope_delta,
+            "mm": _mm_meta(live.req),
             "sampling": live.sampling.to_json(),
             "shape": list(k.shape),
             "dtype": str(k.dtype),
@@ -1622,6 +1664,8 @@ class Worker:
             "model": live.model,
             "tokens": tokens,
             "prompt_len": len(live.req.token_ids),
+            "rope_delta": live.req.rope_delta,
+            "mm": _mm_meta(live.req),
             "sampling": live.sampling.to_json(),
             "stream": live.stream,
             "transfer": {"addr": wire.address, "uuid": uuid,
@@ -1700,6 +1744,8 @@ class Worker:
             "model": live.model,
             "tokens": tokens,
             "prompt_len": len(live.req.token_ids),
+            "rope_delta": live.req.rope_delta,
+            "mm": _mm_meta(live.req),
             "sampling": live.sampling.to_json(),
             "stream": live.stream,
         }
@@ -1911,9 +1957,26 @@ class Worker:
         srid = meta["service_request_id"]
         sampling = SamplingParams.from_json(meta.get("sampling"))
         prompt = tokens[:int(meta.get("prompt_len", len(tokens) - 1))]
+        mm = meta.get("mm") or None
+        mm_embeds = mm_positions = mm_rope_pos = None
+        if mm:
+            # Multimodal state must survive migration: preemption on THIS
+            # worker re-prefills from it (wrong rope ids / placeholder
+            # embeddings otherwise), and its presence keeps the migrated
+            # sequence out of the content-addressed prefix cache (same
+            # text + different image must never share KV).
+            from xllm_service_tpu.runtime.multimodal import (
+                embeds_from_wire)
+            mm_embeds = embeds_from_wire(mm["embeds"])
+            mm_positions = list(mm.get("positions") or [])
+            if mm.get("rope_pos") is not None:
+                mm_rope_pos = np.asarray(mm["rope_pos"], np.int32)
         ereq = EngineRequest(
             request_id=srid, token_ids=prompt, sampling=sampling,
-            eos_token_ids=rt.tokenizer.eos_token_ids)
+            eos_token_ids=rt.tokenizer.eos_token_ids,
+            mm_embeds=mm_embeds, mm_positions=mm_positions,
+            mm_rope_pos=mm_rope_pos,
+            rope_delta=int(meta.get("rope_delta", 0)))
         live = _LiveRequest(
             ereq, rt.tokenizer, srid, model,
             is_chat=False, stream=bool(meta.get("stream")),
