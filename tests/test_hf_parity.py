@@ -312,6 +312,47 @@ def test_engine_greedy_matches_hf_greedy(tmp_path):
     assert got == ref
 
 
+def test_swa_page_trim_keeps_parity_and_bounds_memory(tmp_path):
+    """Uniform-SWA models free KV pages that fall below every future
+    attention window (engine._swa_trim): a long greedy generation still
+    matches torch exactly while per-sequence resident pages stay O(W)."""
+    model = _make_hf_model("mistral_v01")
+    _save(model, str(tmp_path))
+    cfg, params = _load_ours(str(tmp_path))
+    assert cfg.sliding_window == 4
+
+    prompt = [12, 250, 3, 77, 8, 1]
+    steps = 40
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        for _ in range(steps):
+            nxt = model(ids).logits[0, -1].argmax()
+            ids = torch.cat([ids, nxt.view(1, 1)], dim=1)
+    ref = ids[0, len(prompt):].tolist()
+
+    eng = Engine(cfg, EngineConfig(
+        page_size=4, num_pages=32, max_model_len=128, max_batch_size=2,
+        max_prefill_tokens=64, prefill_buckets=(8, 16, 32, 64)), params=params)
+    eng.add_request(EngineRequest(
+        request_id="trim", token_ids=list(prompt),
+        sampling=SamplingParams(max_tokens=steps, temperature=0.0,
+                                ignore_eos=True)))
+    got = []
+    seq = eng._by_id["trim"]
+    max_live = 0
+    for _ in range(300):
+        if not eng.has_work():
+            break
+        for out in eng.step():
+            got.extend(out.new_token_ids)
+        max_live = max(max_live, sum(1 for p in seq.pages if p))
+    assert got == ref
+    assert seq.num_trimmed > 0, "trim never fired"
+    # Window 4 over page_size 4: live pages bounded by ~W/ps + 2 slack,
+    # far below the untrimmed 46-token footprint (12 pages).
+    assert max_live <= 4, max_live
+
+
 def test_engine_greedy_matches_hf_greedy_gemma2(tmp_path):
     """Engine decode with Gemma-2's alternating local/global layers,
     soft-caps, and four-norm blocks matches torch greedy continuation

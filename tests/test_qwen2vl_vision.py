@@ -386,6 +386,40 @@ def test_qwen2vl_engine_greedy_with_image_matches_hf(tmp_path):
     assert got == ref
 
 
+def test_qwen2vl_text_save_roundtrip(tmp_path):
+    """save_checkpoint preserves the mrope rope_scaling and qwen2_vl
+    model_type: a written text stack reloads to identical logits."""
+    import dataclasses
+    from xllm_service_tpu.config import ModelConfig
+    from xllm_service_tpu.models import forward_prefill, init_kv_cache
+    from xllm_service_tpu.runtime.checkpoint import (
+        load_checkpoint, save_checkpoint)
+
+    model = _make_hf_vlm_mrope(seed=6)
+    src = os.path.join(str(tmp_path), "src")
+    dst = os.path.join(str(tmp_path), "dst")
+    model.save_pretrained(src, safe_serialization=True)
+    mc, params = _load_text(src)
+    save_checkpoint(params, mc, dst)
+    with open(os.path.join(dst, "config.json"), encoding="utf-8") as f:
+        mc2 = ModelConfig.from_hf_config(json.load(f), name="rt")
+    mc2 = dataclasses.replace(mc2, dtype="float32")
+    assert mc2.rope_scaling == ("mrope", (2, 2, 2))
+    assert mc2.attention_bias
+    params2 = load_checkpoint(dst, mc2)
+
+    prompt = [5, 2, 9, 1, 7]
+    def logits(c, p):
+        kv = init_kv_cache(c, 16, 4, jnp.float32)
+        pt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        last, _, _ = forward_prefill(
+            p, c, jnp.asarray([prompt], jnp.int32),
+            jnp.zeros(1, jnp.int32), jnp.asarray([len(prompt)], jnp.int32),
+            kv, pt)
+        return np.asarray(last)
+    np.testing.assert_array_equal(logits(mc, params), logits(mc2, params2))
+
+
 def test_load_returns_none_for_text_checkpoint(tmp_path):
     """Plain text checkpoints (no vision_config / visual.* keys) yield
     None, so the worker keeps its synthetic-encoder fallback."""

@@ -163,6 +163,12 @@ class ModelConfig:
     def is_moe(self) -> bool:
         return self.num_experts > 0
 
+    @property
+    def is_mrope(self) -> bool:
+        """Qwen2-VL-style 3-D multimodal rope (ops/rope.apply_mrope)."""
+        return (self.rope_scaling is not None
+                and self.rope_scaling[0] == "mrope")
+
     @classmethod
     def llama3_8b(cls) -> "ModelConfig":
         return cls(name="llama3-8b", vocab_size=128256, hidden_size=4096,

@@ -404,7 +404,8 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
         "tie_word_embeddings": cfg.tie_word_embeddings,
         "attention_bias": cfg.attention_bias,
         "torch_dtype": cfg.dtype,
-        "model_type": ("gemma2" if cfg.gemma
+        "model_type": ("qwen2_vl" if cfg.is_mrope
+                       else "gemma2" if cfg.gemma
                        else "qwen3" if cfg.qk_norm
                        else "phi3" if cfg.fused_proj
                        else "qwen2" if cfg.attention_bias else "llama"),
@@ -421,13 +422,21 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
         hf_cfg["query_pre_attn_scalar"] = cfg.query_pre_attn_scalar
     if cfg.rope_scaling is not None:
         kind = cfg.rope_scaling[0]
-        hf_cfg["rope_scaling"] = (
-            {"rope_type": "llama3", "factor": cfg.rope_scaling[1],
-             "low_freq_factor": cfg.rope_scaling[2],
-             "high_freq_factor": cfg.rope_scaling[3],
-             "original_max_position_embeddings": cfg.rope_scaling[4]}
-            if kind == "llama3" else
-            {"rope_type": "linear", "factor": cfg.rope_scaling[1]})
+        if kind == "llama3":
+            hf_cfg["rope_scaling"] = {
+                "rope_type": "llama3", "factor": cfg.rope_scaling[1],
+                "low_freq_factor": cfg.rope_scaling[2],
+                "high_freq_factor": cfg.rope_scaling[3],
+                "original_max_position_embeddings": cfg.rope_scaling[4]}
+        elif kind == "mrope":
+            # Published Qwen2-VL serialization; reload-parses back to
+            # ("mrope", sections).
+            hf_cfg["rope_scaling"] = {
+                "type": "mrope",
+                "mrope_section": list(cfg.rope_scaling[1])}
+        else:
+            hf_cfg["rope_scaling"] = {
+                "rope_type": "linear", "factor": cfg.rope_scaling[1]}
     if cfg.is_moe:
         hf_cfg["num_local_experts"] = cfg.num_experts
         hf_cfg["num_experts_per_tok"] = cfg.num_experts_per_tok

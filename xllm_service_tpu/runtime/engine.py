@@ -115,6 +115,9 @@ class Sequence:
     # echo+logprobs: per-prompt-token logprobs, filled window by window
     # (index 0 stays None), emitted with the prompt-completion output.
     prompt_lps: Optional[List[Optional[float]]] = None
+    # Sliding-window models: count of leading pages already freed (their
+    # positions fell fully below every future attention window).
+    num_trimmed: int = 0
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -202,8 +205,7 @@ class Engine:
         self._slot_pt = self._slot_packed[:, _PACK_COLS:]
         # mrope models ship explicit 3-D rope positions at prefill and a
         # per-slot rope delta at decode (trace-time switch; cfg static).
-        self._mrope = (model_cfg.rope_scaling is not None
-                       and model_cfg.rope_scaling[0] == "mrope")
+        self._mrope = model_cfg.is_mrope
         # Per-slot sampling params change only on admit/finish; the packed
         # device pair is rebuilt lazily instead of per decode step.
         self._slot_sampling: List[SamplingParams] = [SamplingParams()] * B
@@ -480,6 +482,32 @@ class Engine:
         return (self._ring_eligible(seq, 0)
                 and n / max(self._sp, 1) < n - cached_tokens)
 
+    def _swa_trim(self, seq: Sequence) -> None:
+        """Uniform-sliding-window models: free leading pages whose every
+        position sits below all future attention windows (positions <
+        num_computed − W can never be attended again — the window mask
+        discards them, so HBM need not hold them). Bounds per-sequence KV
+        to O(W) regardless of generated length. Freed table entries
+        become NULL pages; stale device-side reads of a recycled page are
+        confined to window-masked lanes. Skipped for per-layer window
+        mixes (full-attention layers still need the whole history) and
+        for PD-held prefills (export ships the full prefix)."""
+        W = self.cfg.sliding_window
+        if not W or self.cfg.layer_sliding is not None \
+                or seq.req.hold_after_finish:
+            return
+        bound = min((seq.num_computed - W) // self.ecfg.page_size,
+                    len(seq.pages))
+        if bound <= seq.num_trimmed:
+            return
+        for i in range(seq.num_trimmed, bound):
+            pid = seq.pages[i]
+            if pid:
+                self.prefix_cache.release_pages([pid])
+                seq.pages[i] = 0
+        seq.num_trimmed = bound
+        self._sync_slot(seq)
+
     def _preempt_seq(self, seq: Sequence) -> None:
         """Recompute-style preemption: free pages, requeue (generated
         tokens are kept and re-prefilled on readmission)."""
@@ -487,8 +515,9 @@ class Engine:
         if seq.req.mm_embeds is None:
             self.prefix_cache.register_full_pages(
                 seq.tokens[:seq.num_computed], seq.pages)
-        self.prefix_cache.release_pages(seq.pages)
+        self.prefix_cache.release_pages([p for p in seq.pages if p])
         seq.pages = []
+        seq.num_trimmed = 0
         seq.num_computed = 0
         seq.status = SeqStatus.WAITING
         seq.prompt_lps = None          # re-scored on re-prefill
@@ -558,7 +587,7 @@ class Engine:
             # PD handoff: pages stay refcounted until export_held().
             self._held[seq.req.request_id] = seq
         else:
-            self.prefix_cache.release_pages(seq.pages)
+            self.prefix_cache.release_pages([p for p in seq.pages if p])
             seq.pages = []
         self._by_id.pop(seq.req.request_id, None)
         self._cancelled.discard(seq.req.request_id)
@@ -775,6 +804,7 @@ class Engine:
                     # and requeue for the next window (slot + pages stay
                     # reserved).
                     seq.num_computed += windows[i]
+                    self._swa_trim(seq)
                     self._sync_slot(seq)
                     if seq not in self.waiting:
                         self.waiting.append(seq)
@@ -1025,10 +1055,11 @@ class Engine:
                 outs.append(out)
                 if reason != FinishReason.NONE:
                     self._finish_seq(seq, reason)
-                elif seq.status == SeqStatus.RUNNING \
-                        and seq.req.mm_embeds is None:
-                    self.prefix_cache.register_full_pages(
-                        seq.tokens[:seq.num_computed], seq.pages)
+                elif seq.status == SeqStatus.RUNNING:
+                    if seq.req.mm_embeds is None:
+                        self.prefix_cache.register_full_pages(
+                            seq.tokens[:seq.num_computed], seq.pages)
+                    self._swa_trim(seq)
             # Keep the scan's final (tokens, positions) as device-resident
             # state for the next burst. Every still-RUNNING sequence
             # accepted the full N tokens (early finish leaves running), so
@@ -1127,6 +1158,7 @@ class Engine:
             if seq.req.mm_embeds is None:
                 self.prefix_cache.register_full_pages(
                     seq.tokens[:seq.num_computed], seq.pages)
+            self._swa_trim(seq)
             self._grow_pages(seq)
         return out
 
@@ -1497,9 +1529,7 @@ def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None,
     tokens = packed[:, 0]
     positions = packed[:, 1]
     active = packed[:, 2].astype(bool)
-    is_mrope = (cfg.rope_scaling is not None
-                and cfg.rope_scaling[0] == "mrope")
-    rope_delta = packed[:, 3] if is_mrope else None
+    rope_delta = packed[:, 3] if cfg.is_mrope else None
     page_table = packed[:, _PACK_COLS:]
     st = SamplingTensors.unpack(st_f32, st_i32)
     logits, kv, stats = transformer.forward_decode(
@@ -1535,9 +1565,7 @@ def _decode_multi_step(params, tokens, positions, active_pt, kv, st_f32,
     kept as one buffer because all change on the same events (admit/
     finish/page growth), detected host-side by an array compare."""
     active = active_pt[:, 0].astype(bool)
-    is_mrope = (cfg.rope_scaling is not None
-                and cfg.rope_scaling[0] == "mrope")
-    rope_delta = active_pt[:, 1] if is_mrope else None
+    rope_delta = active_pt[:, 1] if cfg.is_mrope else None
     page_table = active_pt[:, 2:]
     st = SamplingTensors.unpack(st_f32, st_i32)
 

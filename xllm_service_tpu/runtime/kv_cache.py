@@ -133,6 +133,11 @@ class PrefixCacheIndex:
             if i >= len(pages):
                 break
             pid = pages[i]
+            if not pid:
+                # NULL placeholder: a sliding-window-trimmed page
+                # (engine._swa_trim) — its content is gone, nothing to
+                # content-address.
+                continue
             if self._hash_of.get(pid) == h:
                 continue
             if h in self._by_hash:

@@ -967,8 +967,7 @@ class Worker:
                 list(token_ids), rt.tokenizer.encode(IMAGE_PLACEHOLDER),
                 n_img, tpi, img_tok)
             mm_embeds = embeds.reshape(n_img * tpi, -1)
-            if rt.model_cfg.rope_scaling is not None \
-                    and rt.model_cfg.rope_scaling[0] == "mrope":
+            if rt.model_cfg.is_mrope:
                 # Qwen2-VL 3-D rope over the image spans. The merged
                 # grid side comes from the EMBEDS the encode stage
                 # produced (sqrt of tokens-per-image) — the only source
