@@ -135,9 +135,12 @@ class PrefixCacheIndex:
             pid = pages[i]
             if not pid:
                 # NULL placeholder: a sliding-window-trimmed page
-                # (engine._swa_trim) — its content is gone, nothing to
-                # content-address.
-                continue
+                # (engine._swa_trim). Its content is gone — and blocks
+                # ABOVE the gap are unreachable too (match_prefix walks
+                # the chained hashes from block 0), so registering them
+                # would advertise digests the cluster's cache-aware
+                # routing could never actually hit.
+                break
             if self._hash_of.get(pid) == h:
                 continue
             if h in self._by_hash:

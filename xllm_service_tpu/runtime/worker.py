@@ -2224,6 +2224,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     import signal
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # Truly pin CPU: the env var alone is insufficient on hosts
+        # whose sitecustomize registers a TPU plugin and rewrites
+        # jax_platforms at interpreter start — without this a "CPU"
+        # worker still probes (and can hang on) the TPU tunnel.
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+
     parser = argparse.ArgumentParser(
         description="xllm-service-tpu worker (TPU engine instance)")
     parser.add_argument("--host", default="127.0.0.1")
