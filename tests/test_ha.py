@@ -33,7 +33,8 @@ import pytest
 from xllm_service_tpu.config import EngineConfig, InstanceType
 from xllm_service_tpu.runtime.worker import Worker, WorkerOptions
 from xllm_service_tpu.service.coordination import KEY_MASTER, KEY_MASTER_ADDR
-from xllm_service_tpu.service.coordination_net import RemoteStore, StoreServer
+from xllm_service_tpu.service.coordination_net import (
+    StoreServer, connect_store)
 from xllm_service_tpu.service.httpd import http_json, http_stream
 
 
@@ -105,21 +106,44 @@ def _is_master(http_addr: str) -> bool:
         return False
 
 
-def test_sigkill_master_replica_takes_over_and_serves():
-    store_srv = StoreServer().start()
+@pytest.fixture(params=["remote", "etcd"])
+def ha_store(request):
+    """The coordination plane under test: the RemoteStore server, and the
+    native C++ etcd-gateway server (csrc/xllm_etcd.cpp) — election, TTL
+    lease expiry, and watch takeover must hold on BOTH. Yields
+    (store_addr for --etcd-addr, reader client with .get)."""
+    if request.param == "remote":
+        srv = StoreServer().start()
+        yield srv.address, srv.store
+        srv.stop()
+    else:
+        from xllm_service_tpu.service.etcd_native import (
+            NativeEtcdServer, build_binary)
+        from xllm_service_tpu.service.etcd_store import EtcdStore
+        if build_binary() is None:
+            pytest.skip("no C++ toolchain for xllm_etcd")
+        srv = NativeEtcdServer().start()
+        client = EtcdStore(srv.address)
+        yield "etcd://" + srv.address, client
+        client.close()
+        srv.stop()
+
+
+def test_sigkill_master_replica_takes_over_and_serves(ha_store):
+    store_addr, store_reader = ha_store
     procs = []
     worker = None
     wstore = None
     try:
-        proc_a, http_a, rpc_a, is_master_a = _spawn_master(store_srv.address)
+        proc_a, http_a, rpc_a, is_master_a = _spawn_master(store_addr)
         procs.append(proc_a)
-        proc_b, http_b, rpc_b, is_master_b = _spawn_master(store_srv.address)
+        proc_b, http_b, rpc_b, is_master_b = _spawn_master(store_addr)
         procs.append(proc_b)
         assert is_master_a and not is_master_b
-        assert store_srv.store.get(KEY_MASTER) is not None
+        assert store_reader.get(KEY_MASTER) is not None
 
         # Worker joins through the coordination plane, heartbeats A.
-        wstore = RemoteStore(store_srv.address)
+        wstore = connect_store(store_addr)
         worker = Worker(
             WorkerOptions(port=0, instance_type=InstanceType.DEFAULT,
                           service_addr=rpc_a, model="tiny",
@@ -173,7 +197,7 @@ def test_sigkill_master_replica_takes_over_and_serves():
         # re-advertises its own addresses.
         assert wait_until(lambda: _is_master(http_b), timeout=60.0), \
             "replica never took over"
-        info = store_srv.store.get(KEY_MASTER_ADDR)
+        info = store_reader.get(KEY_MASTER_ADDR)
         assert info is not None and rpc_b in info
 
         # The worker followed the advertisement (no restart, no reconfig).
@@ -203,7 +227,7 @@ def test_sigkill_master_replica_takes_over_and_serves():
 
         # A second kill is not survivable (no third replica) — but B must
         # still be the advertised master and keep serving meanwhile.
-        assert store_srv.store.get(KEY_MASTER) is not None
+        assert store_reader.get(KEY_MASTER) is not None
     finally:
         if worker is not None:
             worker.stop()
@@ -216,4 +240,3 @@ def test_sigkill_master_replica_takes_over_and_serves():
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
-        store_srv.stop()

@@ -20,7 +20,17 @@ PLEN="${5:-128}"
 OUT="${LOADGEN_OUT:-loadgen_last.json}"
 
 cleanup() {
-  [ -n "${WPID:-}" ] && kill "$WPID" 2>/dev/null
+  # Chip discipline: NEVER signal a worker that may be mid-TPU-compile
+  # (TERM/KILL there wedges the chip). Only kill it once it finished
+  # registering (idle after the run) or when pinned to CPU.
+  if [ -n "${WPID:-}" ]; then
+    if [ -n "${READY:-}" ] || [ "${JAX_PLATFORMS:-}" = "cpu" ]; then
+      kill "$WPID" 2>/dev/null
+    else
+      echo "NOT killing possibly-compiling TPU worker pid $WPID —" \
+           "let it finish, then stop it manually" >&2
+    fi
+  fi
   [ -n "${MPID:-}" ] && kill "$MPID" 2>/dev/null
   [ -n "${EPID:-}" ] && kill "$EPID" 2>/dev/null
   wait 2>/dev/null
@@ -46,10 +56,13 @@ python -m xllm_service_tpu.service.master \
     --host 127.0.0.1 --http-port "$HTTP_PORT" --rpc-port "$RPC_PORT" \
     --etcd-addr "etcd://$ETCD_ADDR" > /tmp/loadgen_master.log 2>&1 &
 MPID=$!
+MOK=""
 for i in $(seq 1 30); do
-  grep -q XLLM_SERVICE_UP /tmp/loadgen_master.log 2>/dev/null && break
+  grep -q XLLM_SERVICE_UP /tmp/loadgen_master.log 2>/dev/null && { MOK=1; break; }
+  kill -0 "$MPID" 2>/dev/null || break
   sleep 1
 done
+[ -n "$MOK" ] || { echo "master failed to boot (see /tmp/loadgen_master.log)" >&2; exit 1; }
 
 # 3. One real worker (owns the chip when a TPU is reachable).
 python -m xllm_service_tpu.runtime.worker \
@@ -69,7 +82,9 @@ for i in $(seq 1 "${REGISTER_TRIES:-120}"); do
 done
 [ -n "$READY" ] || { echo "worker never registered" >&2; exit 1; }
 
-# 5. The measured run.
+# 5. The measured run (pipefail: a crashed loadgen must not exit 0
+# through tee).
+set -o pipefail
 python -m benchmarks.loadgen --target "127.0.0.1:$HTTP_PORT" \
     --model "$MODEL" --num-requests "$NREQ" --max-tokens "$MAXTOK" \
     --request-rate "$RATE" --mean-prompt-len "$PLEN" | tee "$OUT"

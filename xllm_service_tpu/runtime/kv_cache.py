@@ -128,6 +128,12 @@ class PrefixCacheIndex:
         as a sequence grows."""
         if not self.enable:
             return
+        if pages and not pages[0]:
+            # Leading page already sliding-window-trimmed: nothing below
+            # is registrable (see the break below) — skip the O(len)
+            # chained hash this would compute and discard every decode
+            # step of a long SWA sequence.
+            return
         hashes = self.block_hashes(tokens)
         for i, h in enumerate(hashes):
             if i >= len(pages):
