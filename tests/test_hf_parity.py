@@ -45,7 +45,8 @@ def _make_hf_model(kind: str):
     torch.manual_seed({"llama3": 0, "qwen2": 1, "mixtral": 2,
                        "llama_sharded": 3, "qwen3": 4, "phi3": 5,
                        "mistral": 6, "mistral_v01": 7, "phi3_swa": 8,
-                       "gemma2": 9}[kind])
+                       "gemma2": 9, "qwen3_moe": 10,
+                       "qwen3_moe_raw": 11}[kind])
     if kind in ("llama3", "llama_sharded"):
         cfg = transformers.LlamaConfig(
             **_DIMS, rope_theta=500000.0, tie_word_embeddings=True,
@@ -102,6 +103,15 @@ def _make_hf_model(kind: str):
             **_DIMS, num_local_experts=4, num_experts_per_tok=2,
             rope_theta=10000.0)
         model = transformers.MixtralForCausalLM(cfg)
+    elif kind in ("qwen3_moe", "qwen3_moe_raw"):
+        # Qwen3-MoE: qk-norm attention + mlp.experts.N key dialect +
+        # narrow expert MLPs; the _raw variant uses un-normalized top-k
+        # routing weights (norm_topk_prob false, the HF default).
+        cfg = transformers.Qwen3MoeConfig(
+            **_DIMS, head_dim=16, moe_intermediate_size=96,
+            num_experts=4, num_experts_per_tok=2, rope_theta=1000000.0,
+            norm_topk_prob=(kind == "qwen3_moe"))
+        model = transformers.Qwen3MoeForCausalLM(cfg)
     else:  # pragma: no cover
         raise ValueError(kind)
     return model.float().eval()
@@ -133,7 +143,8 @@ def _our_all_logits(cfg, params, prompt):
 
 @pytest.mark.parametrize("kind", ["llama3", "qwen2", "qwen3", "phi3",
                                   "mistral", "mistral_v01", "phi3_swa",
-                                  "gemma2", "mixtral"])
+                                  "gemma2", "mixtral", "qwen3_moe",
+                                  "qwen3_moe_raw"])
 def test_logits_match_torch_oracle(tmp_path, kind):
     """Every prompt position's logits match the torch forward of the same
     HF-written weights (fp32, tight tolerance, argmax everywhere)."""

@@ -144,6 +144,17 @@ class ModelConfig:
     # MoE (0 experts → dense MLP).
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Expert MLP width when it differs from the dense intermediate size
+    # (Qwen3-MoE's moe_intermediate_size). None → intermediate_size.
+    moe_intermediate_size: Optional[int] = None
+    # Divide the selected experts' routing weights by their sum (Mixtral
+    # semantics; Qwen3-MoE checkpoints declare it via norm_topk_prob —
+    # False uses the raw softmax values).
+    norm_topk_prob: bool = True
+    # Checkpoint expert-key dialect: mlp.experts.N.{gate,up,down}_proj +
+    # mlp.gate (Qwen3-MoE) vs block_sparse_moe.experts.N.w1/w3/w2 +
+    # block_sparse_moe.gate (Mixtral).
+    qwen_moe: bool = False
     # Sparse dispatch capacity factor (parallel/expert.py): each expert
     # takes ≤ ceil(k·G·cf/E) tokens per group. ≥ E/k guarantees no drops;
     # 0 selects the dense-compute oracle (every expert on every token).
@@ -236,6 +247,18 @@ class ModelConfig:
                    sliding_window=2047)
 
     @classmethod
+    def qwen3_30b_a3b(cls) -> "ModelConfig":
+        # Qwen3-30B-A3B: 128-expert top-8 MoE with qk-norm attention and
+        # narrow expert MLPs (3B active of 30B total).
+        return cls(name="qwen3-30b-a3b", vocab_size=151936,
+                   hidden_size=2048, intermediate_size=6144,
+                   moe_intermediate_size=768, num_layers=48, num_heads=32,
+                   num_kv_heads=4, head_dim=128, rope_theta=1000000.0,
+                   rms_norm_eps=1e-6, max_position_embeddings=40960,
+                   qk_norm=True, num_experts=128, num_experts_per_tok=8,
+                   norm_topk_prob=True, qwen_moe=True)
+
+    @classmethod
     def gemma2_9b(cls) -> "ModelConfig":
         # Gemma-2-9B: alternating local/global attention (W=4096 on even
         # layers), soft-caps, four-norm blocks, GeGLU, 256-dim heads.
@@ -278,7 +301,15 @@ class ModelConfig:
         silently-wrong tokens."""
         mt = d.get("model_type", "llama")
         supported = ("llama", "mistral", "qwen2", "qwen3", "phi3",
-                     "mixtral", "gemma2", "qwen2_vl")
+                     "mixtral", "gemma2", "qwen2_vl", "qwen3_moe")
+        if mt == "qwen3_moe":
+            # Mixed sparse/dense layer schedules can't share the one
+            # scanned layer body — refuse, never approximate.
+            if d.get("decoder_sparse_step", 1) != 1 \
+                    or d.get("mlp_only_layers"):
+                raise ValueError(
+                    "qwen3_moe with dense layers (decoder_sparse_step "
+                    "!= 1 or mlp_only_layers) is not implemented")
         if mt not in supported:
             raise ValueError(
                 f"unsupported model_type {mt!r} (supported: "
@@ -303,7 +334,8 @@ class ModelConfig:
         # at least max_position_embeddings is inert and normalized away so
         # the full-attention fast paths stay eligible.
         sw = d.get("sliding_window") or None
-        if sw is not None and mt in ("qwen2", "qwen3", "qwen2_vl") \
+        if sw is not None \
+                and mt in ("qwen2", "qwen3", "qwen2_vl", "qwen3_moe") \
                 and not d.get("use_sliding_window", False):
             # Qwen2-family raw config.json declares-but-disables the
             # window (e.g. Qwen2.5-7B-Instruct-1M: sliding_window 32768,
@@ -352,7 +384,7 @@ class ModelConfig:
             attention_bias=d.get("attention_bias",
                                  d.get("model_type")
                                  in ("qwen2", "qwen2_vl")),
-            qk_norm=d.get("model_type") == "qwen3",
+            qk_norm=d.get("model_type") in ("qwen3", "qwen3_moe"),
             fused_proj=d.get("model_type") == "phi3",
             sliding_window=sw,
             layer_sliding=layer_sliding,
@@ -370,8 +402,16 @@ class ModelConfig:
                 d.get("query_pre_attn_scalar", 256)
                 if mt == "gemma2" else None),
             gemma=mt == "gemma2",
-            num_experts=d.get("num_local_experts", 0),
+            num_experts=(d.get("num_experts", 0) if mt == "qwen3_moe"
+                         else d.get("num_local_experts", 0)),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
+            moe_intermediate_size=d.get("moe_intermediate_size"),
+            # HF defaults: Mixtral always normalizes top-k weights;
+            # Qwen3MoeConfig defaults norm_topk_prob to FALSE when the
+            # key is absent.
+            norm_topk_prob=bool(d.get("norm_topk_prob",
+                                      mt != "qwen3_moe")),
+            qwen_moe=mt == "qwen3_moe",
             rope_scaling=cls._parse_rope_scaling(d.get("rope_scaling")),
         )
 

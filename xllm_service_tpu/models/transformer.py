@@ -81,10 +81,11 @@ def init_params(cfg: ModelConfig, key: jax.Array,
         layers["k_norm"] = jnp.ones((L, Dh), dtype)
     if cfg.is_moe:
         E = cfg.num_experts
+        Fe = cfg.moe_intermediate_size or F
         layers["router"] = w((L, D, E), D)
-        layers["gate_proj"] = w((L, E, D, F), D)
-        layers["up_proj"] = w((L, E, D, F), D)
-        layers["down_proj"] = w((L, E, F, D), F)
+        layers["gate_proj"] = w((L, E, D, Fe), D)
+        layers["up_proj"] = w((L, E, D, Fe), D)
+        layers["down_proj"] = w((L, E, Fe, D), Fe)
     else:
         layers["gate_proj"] = w((L, D, F), D)
         layers["up_proj"] = w((L, D, F), D)
@@ -217,12 +218,14 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
         return moe_mlp(x, lp["router"], lp["gate_proj"], lp["up_proj"],
                        lp["down_proj"], cfg.num_experts_per_tok,
                        cfg.moe_capacity_factor, valid=valid,
-                       group_size=cfg.moe_group_size)
+                       group_size=cfg.moe_group_size,
+                       norm_topk=cfg.norm_topk_prob)
     # Dense oracle (moe_capacity_factor == 0): every expert on every token,
     # mixed by routing weight — the test reference for the sparse path.
     gates = jax.nn.softmax((x @ lp["router"]).astype(jnp.float32), axis=-1)
     topv, topi = jax.lax.top_k(gates, cfg.num_experts_per_tok)   # [B,T,K]
-    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    if cfg.norm_topk_prob:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
     weights = jnp.zeros_like(gates).at[
         jnp.arange(gates.shape[0])[:, None, None],
         jnp.arange(gates.shape[1])[None, :, None],

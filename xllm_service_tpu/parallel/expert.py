@@ -48,7 +48,8 @@ def capacity(group_tokens: int, num_experts: int, k: int,
 
 
 def topk_dispatch(gates: jnp.ndarray, k: int, cap: int,
-                  valid: jnp.ndarray = None
+                  valid: jnp.ndarray = None,
+                  norm_topk: bool = True
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Route each of one group's tokens to its top-``k`` experts with
     capacity ``cap``.
@@ -64,7 +65,8 @@ def topk_dispatch(gates: jnp.ndarray, k: int, cap: int,
     """
     N, E = gates.shape
     topv, topi = jax.lax.top_k(gates, k)                     # [N, k]
-    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    if norm_topk:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
     counts = jnp.zeros((E,), jnp.int32)
     dispatch = jnp.zeros((N, E, cap), jnp.float32)
     combine = jnp.zeros((N, E, cap), jnp.float32)
@@ -81,10 +83,15 @@ def topk_dispatch(gates: jnp.ndarray, k: int, cap: int,
         d_j = oh.astype(jnp.float32)[:, :, None] * slot[:, None, :]
         dispatch = dispatch + d_j
         combine = combine + topv[:, j][:, None, None] * d_j
-    # Renormalize over surviving experts so a token that lost one expert
-    # to capacity doesn't shrink toward zero.
-    w = jnp.sum(combine, axis=(1, 2), keepdims=True)         # [N, 1, 1]
-    combine = jnp.where(w > 0, combine / jnp.maximum(w, 1e-9), combine)
+    if norm_topk:
+        # Renormalize over surviving experts so a token that lost one
+        # expert to capacity doesn't shrink toward zero. (Un-normalized
+        # routing — Qwen3-MoE norm_topk_prob=false — keeps raw softmax
+        # weights; a capacity drop just loses that contribution, since
+        # dividing by the survivor sum would force normalization.)
+        w = jnp.sum(combine, axis=(1, 2), keepdims=True)     # [N, 1, 1]
+        combine = jnp.where(w > 0, combine / jnp.maximum(w, 1e-9),
+                            combine)
     return dispatch, combine
 
 
@@ -92,7 +99,8 @@ def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, gate_w: jnp.ndarray,
             up_w: jnp.ndarray, down_w: jnp.ndarray, k: int,
             capacity_factor: float = 2.0,
             valid: jnp.ndarray = None,
-            group_size: int = 512) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            group_size: int = 512,
+            norm_topk: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sparse SwiGLU MoE layer, group-chunked.
 
     x: [B, T, D]; router_w [D, E]; gate/up [E, D, F]; down [E, F, D];
@@ -121,7 +129,7 @@ def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, gate_w: jnp.ndarray,
     gates = jax.nn.softmax((xg @ router_w).astype(jnp.float32), axis=-1)
     cap = capacity(G, E, k, capacity_factor)
     dispatch, combine = jax.vmap(
-        lambda g, v: topk_dispatch(g, k, cap, v))(gates, vg)
+        lambda g, v: topk_dispatch(g, k, cap, v, norm_topk))(gates, vg)
     de = dispatch.astype(x.dtype)                        # [g, G, E, C]
     x_e = jnp.einsum("gnd,gnec->gecd", xg, de)           # [g, E, C, D]
     h = jax.nn.silu(jnp.einsum("gecd,edf->gecf", x_e, gate_w)) \

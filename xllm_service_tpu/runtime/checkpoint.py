@@ -185,7 +185,11 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
         layers["k_norm"] = stack(A + "k_norm.weight")
     if cfg.is_moe:
         E = cfg.num_experts
-        X = "model.layers.{i}.block_sparse_moe."
+        # Two expert-key dialects: Qwen3-MoE (mlp.experts.N.*_proj +
+        # mlp.gate) vs Mixtral (block_sparse_moe.experts.N.w1/w3/w2 +
+        # block_sparse_moe.gate).
+        X = "model.layers.{i}.mlp." if cfg.qwen_moe \
+            else "model.layers.{i}.block_sparse_moe."
         layers["router"] = stack(X + "gate.weight", transpose=True)
 
         def stack_experts(w: str, transpose: bool) -> np.ndarray:
@@ -199,9 +203,14 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
                 out.append(np.stack(experts))
             return np.stack(out).astype(dtype)      # [L, E, ...]
 
-        layers["gate_proj"] = stack_experts("w1", transpose=True)
-        layers["up_proj"] = stack_experts("w3", transpose=True)
-        layers["down_proj"] = stack_experts("w2", transpose=True)
+        if cfg.qwen_moe:
+            layers["gate_proj"] = stack_experts("gate_proj", True)
+            layers["up_proj"] = stack_experts("up_proj", True)
+            layers["down_proj"] = stack_experts("down_proj", True)
+        else:
+            layers["gate_proj"] = stack_experts("w1", transpose=True)
+            layers["up_proj"] = stack_experts("w3", transpose=True)
+            layers["down_proj"] = stack_experts("w2", transpose=True)
     elif cfg.fused_proj:
         layers["gate_proj"], layers["up_proj"] = split_stack(
             M + "gate_up_proj.weight",
@@ -369,12 +378,17 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
             out[A + "q_norm.weight"] = get(lp["q_norm"][i])
             out[A + "k_norm.weight"] = get(lp["k_norm"][i])
         if cfg.is_moe:
-            X = f"model.layers.{i}.block_sparse_moe."
+            X = (f"model.layers.{i}.mlp." if cfg.qwen_moe
+                 else f"model.layers.{i}.block_sparse_moe.")
             out[X + "gate.weight"] = np.ascontiguousarray(
                 get(lp["router"][i]).T)
+            name_map = ((("gate_proj", "gate_proj"),
+                         ("up_proj", "up_proj"),
+                         ("down_proj", "down_proj")) if cfg.qwen_moe
+                        else (("w1", "gate_proj"), ("w3", "up_proj"),
+                              ("w2", "down_proj")))
             for e in range(cfg.num_experts):
-                for hf, ours in (("w1", "gate_proj"), ("w3", "up_proj"),
-                                 ("w2", "down_proj")):
+                for hf, ours in name_map:
                     out[X + f"experts.{e}.{hf}.weight"] = \
                         np.ascontiguousarray(get(lp[ours][i][e]).T)
         elif cfg.fused_proj:
@@ -438,9 +452,16 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
             hf_cfg["rope_scaling"] = {
                 "rope_type": "linear", "factor": cfg.rope_scaling[1]}
     if cfg.is_moe:
-        hf_cfg["num_local_experts"] = cfg.num_experts
         hf_cfg["num_experts_per_tok"] = cfg.num_experts_per_tok
-        hf_cfg["model_type"] = "mixtral"
+        if cfg.qwen_moe:
+            hf_cfg["num_experts"] = cfg.num_experts
+            hf_cfg["moe_intermediate_size"] = \
+                cfg.moe_intermediate_size or cfg.intermediate_size
+            hf_cfg["norm_topk_prob"] = cfg.norm_topk_prob
+            hf_cfg["model_type"] = "qwen3_moe"
+        else:
+            hf_cfg["num_local_experts"] = cfg.num_experts
+            hf_cfg["model_type"] = "mixtral"
     with open(os.path.join(model_dir, "config.json"), "w",
               encoding="utf-8") as f:
         json.dump(hf_cfg, f, indent=1)

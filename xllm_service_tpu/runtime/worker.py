@@ -120,6 +120,7 @@ _MODEL_REGISTRY = {
     "qwen2-7b": ModelConfig.qwen2_7b,
     "qwen2.5-7b": ModelConfig.qwen25_7b,
     "qwen3-8b": ModelConfig.qwen3_8b,
+    "qwen3-30b-a3b": ModelConfig.qwen3_30b_a3b,
     "phi3-mini": ModelConfig.phi3_mini,
     "mistral-7b": ModelConfig.mistral_7b,
     "mistral-7b-v01": ModelConfig.mistral_7b_v01,
@@ -218,10 +219,19 @@ class ModelRuntime:
     def memory_gb(self) -> float:
         """Rough HBM footprint for the serverless allocator."""
         cfg = self.model_cfg
+        if cfg.is_moe:
+            # Every expert's weights are resident (Mixtral: E dense-width
+            # MLPs; Qwen3-MoE: E narrow moe_intermediate_size MLPs) —
+            # counting one dense MLP under-places a 30B MoE by ~8x.
+            f = cfg.moe_intermediate_size or cfg.intermediate_size
+            mlp = cfg.num_experts * 3 * cfg.hidden_size * f \
+                + cfg.hidden_size * cfg.num_experts      # router
+        else:
+            mlp = 3 * cfg.hidden_size * cfg.intermediate_size
         n_params = (cfg.vocab_size * cfg.hidden_size * 2
                     + cfg.num_layers * (
                         4 * cfg.hidden_size * cfg.num_heads * cfg.head_dim
-                        + 3 * cfg.hidden_size * cfg.intermediate_size))
+                        + mlp))
         return 2.0 * n_params / 1e9
 
 
