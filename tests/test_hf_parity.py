@@ -288,8 +288,38 @@ def test_sliding_window_qwen2_gating():
 def test_unknown_rope_scaling_refused():
     with pytest.raises(NotImplementedError):
         ModelConfig.from_hf_config(
-            dict(_DIMS, rope_scaling={"rope_type": "yarn", "factor": 4.0},
+            dict(_DIMS, rope_scaling={"rope_type": "longrope",
+                                      "factor": 4.0},
                  vocab_size=256, hidden_size=64, intermediate_size=128))
+
+
+def test_yarn_rope_matches_torch_oracle(tmp_path):
+    """YaRN long-context scaling (NTK-by-parts frequency blend + the
+    cos/sin attention factor) matches the torch forward of the same
+    HF-written llama weights at positions past the original context."""
+    torch.manual_seed(12)
+    cfg = transformers.LlamaConfig(
+        **_DIMS, rope_theta=10000.0,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 64},
+        attention_bias=False)
+    model = transformers.LlamaForCausalLM(cfg).float().eval()
+    _save(model, str(tmp_path))
+    our_cfg, params = _load_ours(str(tmp_path))
+    assert our_cfg.rope_scaling[0] == "yarn"
+    assert our_cfg.rope_scaling[4] == 64      # original ctx window
+    # Prompt reaching past the original 64-token context so interpolated
+    # bands are actually exercised.
+    prompt = list(np.random.RandomState(5).randint(1, 255, size=100))
+    with torch.no_grad():
+        ref = model(torch.tensor([prompt])).logits[0].numpy()
+    _, ours = _our_all_logits(our_cfg, params, prompt)
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=5e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+    # The scaling is live: removing it must change the logits.
+    unscaled = dataclasses.replace(our_cfg, rope_scaling=None)
+    _, without = _our_all_logits(unscaled, params, prompt)
+    assert not np.allclose(ours, without)
 
 
 def test_engine_greedy_matches_hf_greedy(tmp_path):

@@ -412,11 +412,14 @@ class ModelConfig:
             norm_topk_prob=bool(d.get("norm_topk_prob",
                                       mt != "qwen3_moe")),
             qwen_moe=mt == "qwen3_moe",
-            rope_scaling=cls._parse_rope_scaling(d.get("rope_scaling")),
+            rope_scaling=cls._parse_rope_scaling(
+                d.get("rope_scaling"),
+                d.get("max_position_embeddings", 4096)),
         )
 
     @staticmethod
-    def _parse_rope_scaling(rs: Optional[Dict[str, Any]]
+    def _parse_rope_scaling(rs: Optional[Dict[str, Any]],
+                            max_position_embeddings: int = 4096
                             ) -> Optional[Tuple[Any, ...]]:
         """config.json:rope_scaling dict → the hashable tuple ops/rope.py
         takes. Unknown types raise at load time rather than silently
@@ -439,6 +442,31 @@ class ModelConfig:
                     int(rs["original_max_position_embeddings"]))
         if kind == "linear":
             return ("linear", float(rs["factor"]), 0.0, 0.0, 0)
+        if kind == "yarn":
+            # NTK-by-parts (YaRN, 2309.00071): low-frequency bands
+            # interpolate by `factor`, high-frequency extrapolate, a
+            # linear ramp blends between; cos/sin scale by the attention
+            # factor (inferred from factor/mscale when not explicit —
+            # HF modeling_rope_utils._compute_yarn_parameters).
+            import math as _m
+            factor = float(rs["factor"])
+            attn = rs.get("attention_factor")
+            if attn is None:
+                ms, msa = rs.get("mscale"), rs.get("mscale_all_dim")
+
+                def _mscale(scale, m=1.0):
+                    return (0.1 * m * _m.log(scale) + 1.0) if scale > 1 \
+                        else 1.0
+
+                attn = (_mscale(factor, ms) / _mscale(factor, msa)
+                        if ms and msa else _mscale(factor))
+            orig = int(rs.get("original_max_position_embeddings")
+                       or max_position_embeddings)
+            return ("yarn", factor,
+                    float(rs.get("beta_fast") or 32.0),
+                    float(rs.get("beta_slow") or 1.0),
+                    orig, float(attn),
+                    bool(rs.get("truncate", True)))
         raise NotImplementedError(
             f"rope_scaling type {kind!r} not supported")
 

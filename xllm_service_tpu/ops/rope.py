@@ -21,7 +21,8 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 
 RopeScaling = Tuple  # ("llama3", f, lo, hi, orig) | ("linear", f, 0, 0, 0)
-#                      | ("mrope", (s_t, s_h, s_w))
+#   | ("mrope", (s_t, s_h, s_w))
+#   | ("yarn", factor, beta_fast, beta_slow, orig, attn_factor, truncate)
 
 
 def rope_inv_freq(head_dim: int, theta: float,
@@ -49,6 +50,33 @@ def rope_inv_freq(head_dim: int, theta: float,
         return inv / float(scaling[1])
     if kind == "mrope":
         return inv          # sections select streams; bands unscaled
+    if kind == "yarn":
+        # NTK-by-parts blend (YaRN §3.2): band b's "rotations" over the
+        # original context = orig / wavelength; bands doing more than
+        # beta_fast rotations keep the raw frequency (extrapolation),
+        # fewer than beta_slow interpolate by 1/factor, a linear ramp
+        # mixes in between. The cos/sin attention factor is applied in
+        # rope_cos_sin (this function returns frequencies only).
+        import math as _m
+        _, factor, beta_fast, beta_slow, orig, _attn, truncate = scaling
+
+        def correction_dim(rot):
+            return (head_dim * _m.log(orig / (rot * 2 * _m.pi))
+                    / (2 * _m.log(theta)))
+
+        low = correction_dim(beta_fast)
+        high = correction_dim(beta_slow)
+        if truncate:
+            low, high = _m.floor(low), _m.ceil(high)
+        low = max(low, 0.0)
+        high = min(high, head_dim - 1.0)
+        if low == high:
+            high += 0.001
+        ramp = jnp.clip(
+            (jnp.arange(half, dtype=jnp.float32) - low) / (high - low),
+            0.0, 1.0)
+        extrap_w = 1.0 - ramp
+        return inv / factor * (1.0 - extrap_w) + inv * extrap_w
     raise NotImplementedError(
         f"rope_scaling type {kind!r} not supported — refusing to load a "
         f"checkpoint whose positions would be silently mis-rotated")
@@ -61,7 +89,13 @@ def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float,
     ``head_dim/2`` axis, always in float32 for accuracy at long context."""
     freq = rope_inv_freq(head_dim, theta, scaling)
     angles = positions.astype(jnp.float32)[..., None] * freq  # [..., half]
-    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    if scaling is not None and scaling[0] == "yarn":
+        # YaRN's attention-temperature factor rides the cos/sin tables
+        # (HF: cos = emb.cos() * attention_scaling).
+        attn = scaling[5]
+        cos, sin = cos * attn, sin * attn
+    return cos.astype(dtype), sin.astype(dtype)
 
 
 def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float,

@@ -448,6 +448,13 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
             hf_cfg["rope_scaling"] = {
                 "type": "mrope",
                 "mrope_section": list(cfg.rope_scaling[1])}
+        elif kind == "yarn":
+            _, factor, bf, bs, orig, attn, trunc = cfg.rope_scaling
+            hf_cfg["rope_scaling"] = {
+                "rope_type": "yarn", "factor": factor,
+                "beta_fast": bf, "beta_slow": bs,
+                "original_max_position_embeddings": orig,
+                "attention_factor": attn, "truncate": trunc}
         else:
             hf_cfg["rope_scaling"] = {
                 "rope_type": "linear", "factor": cfg.rope_scaling[1]}
