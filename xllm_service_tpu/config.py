@@ -448,14 +448,14 @@ class ModelConfig:
             # linear ramp blends between; cos/sin scale by the attention
             # factor (inferred from factor/mscale when not explicit —
             # HF modeling_rope_utils._compute_yarn_parameters).
-            import math as _m
             factor = float(rs["factor"])
             attn = rs.get("attention_factor")
             if attn is None:
                 ms, msa = rs.get("mscale"), rs.get("mscale_all_dim")
 
                 def _mscale(scale, m=1.0):
-                    return (0.1 * m * _m.log(scale) + 1.0) if scale > 1 \
+                    import math
+                    return (0.1 * m * math.log(scale) + 1.0) if scale > 1 \
                         else 1.0
 
                 attn = (_mscale(factor, ms) / _mscale(factor, msa)

@@ -16,6 +16,7 @@ under jit, so the branch below is trace-time).
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
@@ -57,17 +58,16 @@ def rope_inv_freq(head_dim: int, theta: float,
         # fewer than beta_slow interpolate by 1/factor, a linear ramp
         # mixes in between. The cos/sin attention factor is applied in
         # rope_cos_sin (this function returns frequencies only).
-        import math as _m
         _, factor, beta_fast, beta_slow, orig, _attn, truncate = scaling
 
         def correction_dim(rot):
-            return (head_dim * _m.log(orig / (rot * 2 * _m.pi))
-                    / (2 * _m.log(theta)))
+            return (head_dim * math.log(orig / (rot * 2 * math.pi))
+                    / (2 * math.log(theta)))
 
         low = correction_dim(beta_fast)
         high = correction_dim(beta_slow)
         if truncate:
-            low, high = _m.floor(low), _m.ceil(high)
+            low, high = math.floor(low), math.ceil(high)
         low = max(low, 0.0)
         high = min(high, head_dim - 1.0)
         if low == high:
