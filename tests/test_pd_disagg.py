@@ -32,7 +32,7 @@ def small_engine_cfg() -> EngineConfig:
 
 
 def make_pd_cluster(store, decode_to_service=False, direct=False,
-                    device_wire=False):
+                    device_wire=False, model="tiny", model_dir=""):
     # direct=False forces the HTTP KV shuttle even though both workers
     # share this process — the wire path must stay covered. device_wire
     # turns on the PJRT transfer-server path over that wire (the
@@ -48,7 +48,8 @@ def make_pd_cluster(store, decode_to_service=False, direct=False,
     for itype in (InstanceType.PREFILL, InstanceType.DECODE):
         wopts = WorkerOptions(
             port=0, instance_type=itype,
-            service_addr=master.rpc_address, model="tiny",
+            service_addr=master.rpc_address, model=model,
+            model_dir=model_dir,
             heartbeat_interval_s=0.2, lease_ttl_s=2.0,
             pd_direct_kv=direct, pd_device_wire=device_wire)
         workers.append(Worker(wopts, store,
@@ -304,3 +305,109 @@ class TestPdDisaggregation:
                 w.stop()
             master2.stop()
             solo_store.close()
+
+    def test_vlm_migration_carries_mm_state(self, store, tmp_path,
+                                            monkeypatch):
+        """A Qwen2-VL image request migrated prefill→decode produces the
+        SAME greedy continuation as a monolithic single-worker run of the
+        same checkpoint — mrope rope deltas and the multimodal state ride
+        the /kv/import meta (round-4 review fix), so the decode side's
+        positions and any later re-prefill stay correct."""
+        import os
+
+        import torch
+        import transformers
+
+        from tests.test_qwen2vl_vision import _VC
+
+        torch.manual_seed(3)
+        hf_cfg = transformers.Qwen2VLConfig(
+            vocab_size=512, hidden_size=48, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=512,
+            vision_config=dict(_VC),
+            rope_scaling={"type": "mrope", "mrope_section": [2, 2, 2]},
+            image_token_id=505, vision_start_token_id=504,
+            video_token_id=503)
+        transformers.Qwen2VLForConditionalGeneration(hf_cfg).float().eval() \
+            .save_pretrained(str(tmp_path), safe_serialization=True)
+        monkeypatch.setenv("XLLM_VISION_IMAGE_SIZE", "16")
+        if True:
+            body = {"model": "vlm", "messages": [{
+                        "role": "user", "content": [
+                            {"type": "text", "text": "Look: "},
+                            {"type": "image_url",
+                             "image_url": {"url": "random:5"}}]}],
+                    "max_tokens": 6, "temperature": 0.0,
+                    "ignore_eos": True}
+
+            # Monolithic oracle: one DEFAULT worker.
+            mono_store = InMemoryStore(sweep_interval_s=0.02)
+            mono_master = Master(ServiceOptions(
+                http_port=0, rpc_port=0, num_output_pools=4,
+                load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
+                block_size=16, heartbeat_interval_s=0.2,
+                master_upload_interval_s=0.2), store=mono_store).start()
+            mono_w = Worker(WorkerOptions(
+                port=0, instance_type=InstanceType.DEFAULT,
+                service_addr=mono_master.rpc_address, model="vlm",
+                model_dir=str(tmp_path), heartbeat_interval_s=0.2,
+                lease_ttl_s=2.0), mono_store,
+                engine_cfg=small_engine_cfg()).start()
+            try:
+                mgr = mono_master.scheduler.instance_mgr
+                assert wait_until(
+                    lambda: len(mgr.prefill_instances()) == 1)
+                status, mono = http_json(
+                    "POST", mono_master.http_address,
+                    "/v1/chat/completions", dict(body), timeout=120.0)
+                assert status == 200, mono
+            finally:
+                mono_w.stop()
+                mono_master.stop()
+                mono_store.close()
+
+            # PD cluster over the SAME checkpoint.
+            master, workers = make_pd_cluster(
+                store, model="vlm", model_dir=str(tmp_path))
+            try:
+                status, pd = http_json(
+                    "POST", master.http_address, "/v1/chat/completions",
+                    dict(body), timeout=120.0)
+                assert status == 200, pd
+                prefill_w = workers[0]
+                assert prefill_w.kv_migration_bytes > 0, \
+                    "KV never migrated — test lost its point"
+                assert pd["choices"][0]["message"]["content"] == \
+                    mono["choices"][0]["message"]["content"]
+                assert pd["usage"]["completion_tokens"] == 6
+            finally:
+                for w in workers:
+                    w.stop()
+                master.stop()
+
+
+def test_mm_meta_wire_roundtrip():
+    """_mm_meta → JSON → adoption-side reconstruction preserves the
+    embeds / splice positions / rope streams exactly. (rope_delta is NOT
+    in this payload — it rides the migration meta's top level; the e2e
+    test above covers it.)"""
+    import json as jsonlib
+
+    import numpy as np
+
+    from xllm_service_tpu.runtime.engine import EngineRequest
+    from xllm_service_tpu.runtime.multimodal import embeds_from_wire
+    from xllm_service_tpu.runtime.worker import _mm_meta
+
+    emb = np.arange(12, dtype=np.float32).reshape(2, 6)
+    rp = np.arange(9, dtype=np.int32).reshape(3, 3)
+    req = EngineRequest(request_id="x", token_ids=[1, 2, 3],
+                        mm_embeds=emb, mm_positions=[1, 2],
+                        mm_rope_pos=rp)
+    meta = jsonlib.loads(jsonlib.dumps(_mm_meta(req)))
+    np.testing.assert_array_equal(embeds_from_wire(meta["embeds"]), emb)
+    assert meta["positions"] == [1, 2]
+    np.testing.assert_array_equal(
+        np.asarray(meta["rope_pos"], np.int32), rp)
+    assert _mm_meta(EngineRequest(request_id="t", token_ids=[1])) is None
