@@ -1,0 +1,146 @@
+"""DeepSeek-V2 (multi-head latent attention) fidelity vs the torch
+oracle — the same HF-written-files shape as tests/test_hf_parity.py.
+
+The engine serves MLA from a LATENT paged pool (one KV "head" of
+kv_lora_rank + qk_rope_head_dim per token) with the kv_b up-projections
+absorbed into the query/output sides; these tests pin that this is
+bit-for-bit the same math HF computes per-head (associativity), across
+the V2-Lite shape (no q compression, greedy routing), the full-V2 shape
+(q_lora + group-limited routing), dense-prefix layers, shared experts,
+and paged decode.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from xllm_service_tpu.config import EngineConfig, ModelConfig
+from xllm_service_tpu.models import forward_prefill, init_kv_cache
+from xllm_service_tpu.runtime.checkpoint import load_checkpoint
+from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+from xllm_service_tpu.utils.types import SamplingParams
+
+_BASE = dict(
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    moe_intermediate_size=48, num_hidden_layers=3,
+    num_attention_heads=4, num_key_value_heads=4,
+    kv_lora_rank=32, qk_nope_head_dim=16, qk_rope_head_dim=8,
+    v_head_dim=16, head_dim=8,          # head_dim == qk_rope (rope dims)
+    n_routed_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+    first_k_dense_replace=1, routed_scaling_factor=1.5,
+    max_position_embeddings=512, rope_theta=10000.0,
+    attn_implementation="eager")
+
+
+def _make_hf(kind: str):
+    torch.manual_seed({"lite": 0, "full": 1}[kind])
+    if kind == "lite":
+        # V2-Lite shape: no q compression, greedy top-k routing.
+        cfg = transformers.DeepseekV2Config(**_BASE, q_lora_rank=None,
+                                            topk_method="greedy")
+    else:
+        # Full V2 shape: q_lora + device-limited (grouped) routing.
+        cfg = transformers.DeepseekV2Config(
+            **_BASE, q_lora_rank=24, topk_method="group_limited_greedy",
+            n_group=2, topk_group=1)
+    return transformers.DeepseekV2ForCausalLM(cfg).float().eval()
+
+
+def _load_ours(path):
+    with open(os.path.join(path, "config.json"), encoding="utf-8") as f:
+        cfg = ModelConfig.from_hf_config(json.load(f), name="dsv2")
+    return dataclasses.replace(cfg, dtype="float32"), \
+        load_checkpoint(path, dataclasses.replace(cfg, dtype="float32"))
+
+
+def _our_all_logits(cfg, params, prompt):
+    T = len(prompt)
+    pages = (T + 3) // 4 + 1
+    kv = init_kv_cache(cfg, 64, 4, jnp.float32)
+    pt = jnp.asarray([list(range(1, pages + 1))], jnp.int32)
+    _, all_logits, _ = forward_prefill(
+        params, cfg, jnp.asarray([prompt], jnp.int32),
+        jnp.zeros(1, jnp.int32), jnp.asarray([T], jnp.int32), kv, pt,
+        return_all_logits=True)
+    return np.asarray(all_logits)[0]
+
+
+@pytest.mark.parametrize("kind", ["lite", "full"])
+def test_mla_logits_match_torch_oracle(tmp_path, kind):
+    model = _make_hf(kind)
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    cfg, params = _load_ours(str(tmp_path))
+    assert cfg.mla and cfg.kv_cache_heads == 1
+    assert cfg.kv_cache_dim == 32 + 8
+    assert cfg.first_k_dense_replace == 1 and cfg.n_shared_experts == 1
+    if kind == "full":
+        assert cfg.q_lora_rank == 24
+        assert cfg.topk_method == "group_limited_greedy"
+
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+    with torch.no_grad():
+        ref = model(torch.tensor([prompt])).logits[0].numpy()
+    ours = _our_all_logits(cfg, params, prompt)
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=5e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
+def test_mla_no_dense_prefix_loads(tmp_path):
+    """first_k_dense_replace=0 (the HF default): every layer is MoE, the
+    dense prefix stack is empty — load + forward still match torch."""
+    torch.manual_seed(2)
+    cfg = transformers.DeepseekV2Config(
+        **{**_BASE, "first_k_dense_replace": 0}, q_lora_rank=None,
+        topk_method="greedy")
+    model = transformers.DeepseekV2ForCausalLM(cfg).float().eval()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    our_cfg, params = _load_ours(str(tmp_path))
+    assert our_cfg.first_k_dense_replace == 0
+    assert params["layers"]["input_norm"].shape[0] == 0
+    prompt = [9, 8, 7, 6, 5]
+    with torch.no_grad():
+        ref = model(torch.tensor([prompt])).logits[0].numpy()
+    ours = _our_all_logits(our_cfg, params, prompt)
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=5e-4)
+
+
+def test_mla_engine_greedy_matches_hf(tmp_path):
+    """Full engine path: latent paged pool, continuous batching, decode
+    via the absorbed single-kv-head attention — greedy continuation
+    matches torch exactly."""
+    model = _make_hf("lite")
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    cfg, params = _load_ours(str(tmp_path))
+
+    prompt = [12, 250, 3, 77, 8, 1]
+    steps = 10
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        for _ in range(steps):
+            nxt = model(ids).logits[0, -1].argmax()
+            ids = torch.cat([ids, nxt.view(1, 1)], dim=1)
+    ref = ids[0, len(prompt):].tolist()
+
+    eng = Engine(cfg, EngineConfig(
+        page_size=4, num_pages=64, max_model_len=128, max_batch_size=2,
+        max_prefill_tokens=64, prefill_buckets=(8, 16, 32, 64)),
+        params=params)
+    eng.add_request(EngineRequest(
+        request_id="mla", token_ids=list(prompt),
+        sampling=SamplingParams(max_tokens=steps, temperature=0.0,
+                                ignore_eos=True)))
+    got = []
+    for _ in range(200):
+        if not eng.has_work():
+            break
+        for out in eng.step():
+            got.extend(out.new_token_ids)
+    assert got == ref

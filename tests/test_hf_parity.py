@@ -218,7 +218,7 @@ def test_rope_scaling_respected(tmp_path):
 def test_unsupported_architectures_refused():
     """A config this transformer cannot faithfully run must fail at
     load — never silently emit wrong tokens."""
-    base = dict(_DIMS, model_type="deepseek_v2")
+    base = dict(_DIMS, model_type="mamba")
     with pytest.raises(ValueError, match="unsupported model_type"):
         ModelConfig.from_hf_config(base)
 

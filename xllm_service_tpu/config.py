@@ -155,6 +155,28 @@ class ModelConfig:
     # mlp.gate (Qwen3-MoE) vs block_sparse_moe.experts.N.w1/w3/w2 +
     # block_sparse_moe.gate (Mixtral).
     qwen_moe: bool = False
+    # --- DeepSeek-V2 multi-head latent attention (MLA) ---
+    # kv_lora_rank > 0 enables MLA: per token the cache holds ONE latent
+    # row [kv_lora_rank + qk_rope_head_dim] instead of per-head K/V; the
+    # up-projections are absorbed into the query/output sides so the
+    # standard paged-attention machinery serves the latent pool with a
+    # single KV "head" (models/transformer.py MLA branch).
+    kv_lora_rank: int = 0
+    q_lora_rank: Optional[int] = None   # None = direct q_proj (V2-Lite)
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
+    # DeepSeek MoE shape: shared experts run densely beside the routed
+    # ones; routed weights scale by routed_scaling_factor; device-limited
+    # routing restricts the top-k to topk_group of n_group expert groups.
+    n_shared_experts: int = 0
+    routed_scaling_factor: float = 1.0
+    topk_method: str = "greedy"         # or "group_limited_greedy"
+    n_group: Optional[int] = None
+    topk_group: Optional[int] = None
+    # First k layers use a dense MLP (DeepSeek's first_k_dense_replace);
+    # the layer stack splits into a dense prefix + MoE suffix scan.
+    first_k_dense_replace: int = 0
     # Sparse dispatch capacity factor (parallel/expert.py): each expert
     # takes ≤ ceil(k·G·cf/E) tokens per group. ≥ E/k guarantees no drops;
     # 0 selects the dense-compute oracle (every expert on every token).
@@ -179,6 +201,26 @@ class ModelConfig:
         """Qwen2-VL-style 3-D multimodal rope (ops/rope.apply_mrope)."""
         return (self.rope_scaling is not None
                 and self.rope_scaling[0] == "mrope")
+
+    @property
+    def mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    @property
+    def qk_head_dim(self) -> int:
+        """Per-head query/key width under MLA (nope + rope parts)."""
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def kv_cache_heads(self) -> int:
+        """KV-pool head count: 1 latent "head" under MLA."""
+        return 1 if self.mla else self.num_kv_heads
+
+    @property
+    def kv_cache_dim(self) -> int:
+        """KV-pool per-head width: the latent row under MLA."""
+        return (self.kv_lora_rank + self.qk_rope_head_dim if self.mla
+                else self.head_dim)
 
     @classmethod
     def llama3_8b(cls) -> "ModelConfig":
@@ -259,6 +301,27 @@ class ModelConfig:
                    norm_topk_prob=True, qwen_moe=True)
 
     @classmethod
+    def deepseek_v2_lite(cls) -> "ModelConfig":
+        # DeepSeek-V2-Lite: MLA (latent KV rank 512 + 64 rope dims → the
+        # paged cache holds 576 values/token instead of 16·384), 64
+        # routed + 2 shared experts, greedy top-6, one dense first layer.
+        # Real checkpoints add yarn scaling (factor 40, mscale 0.707 both
+        # ways → attention factor cancels to 1.0).
+        return cls(name="deepseek-v2-lite", vocab_size=102400,
+                   hidden_size=2048, intermediate_size=10944,
+                   moe_intermediate_size=1408, num_layers=27,
+                   num_heads=16, num_kv_heads=16, head_dim=64,
+                   rope_theta=10000.0, rms_norm_eps=1e-6,
+                   max_position_embeddings=163840,
+                   rope_scaling=("yarn", 40.0, 32.0, 1.0, 4096, 1.0,
+                                 True),
+                   kv_lora_rank=512, qk_nope_head_dim=128,
+                   qk_rope_head_dim=64, v_head_dim=128,
+                   num_experts=64, num_experts_per_tok=6,
+                   n_shared_experts=2, first_k_dense_replace=1,
+                   routed_scaling_factor=1.0, norm_topk_prob=False)
+
+    @classmethod
     def gemma2_9b(cls) -> "ModelConfig":
         # Gemma-2-9B: alternating local/global attention (W=4096 on even
         # layers), soft-caps, four-norm blocks, GeGLU, 256-dim heads.
@@ -301,7 +364,13 @@ class ModelConfig:
         silently-wrong tokens."""
         mt = d.get("model_type", "llama")
         supported = ("llama", "mistral", "qwen2", "qwen3", "phi3",
-                     "mixtral", "gemma2", "qwen2_vl", "qwen3_moe")
+                     "mixtral", "gemma2", "qwen2_vl", "qwen3_moe",
+                     "deepseek_v2")
+        if mt == "deepseek_v2" and d.get("topk_method") not in (
+                None, "greedy", "group_limited_greedy"):
+            raise ValueError(
+                f"deepseek topk_method {d.get('topk_method')!r} "
+                f"is not implemented")
         if mt == "qwen3_moe":
             # Mixed sparse/dense layer schedules can't share the one
             # scanned layer body — refuse, never approximate.
@@ -403,14 +472,35 @@ class ModelConfig:
                 if mt == "gemma2" else None),
             gemma=mt == "gemma2",
             num_experts=(d.get("num_experts", 0) if mt == "qwen3_moe"
+                         else d.get("n_routed_experts", 0)
+                         if mt == "deepseek_v2"
                          else d.get("num_local_experts", 0)),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             moe_intermediate_size=d.get("moe_intermediate_size"),
+            kv_lora_rank=(d.get("kv_lora_rank") or 0
+                          if mt == "deepseek_v2" else 0),
+            q_lora_rank=(d.get("q_lora_rank")
+                         if mt == "deepseek_v2" else None),
+            qk_nope_head_dim=d.get("qk_nope_head_dim", 0)
+            if mt == "deepseek_v2" else 0,
+            qk_rope_head_dim=d.get("qk_rope_head_dim", 0)
+            if mt == "deepseek_v2" else 0,
+            v_head_dim=d.get("v_head_dim", 0)
+            if mt == "deepseek_v2" else 0,
+            n_shared_experts=(d.get("n_shared_experts") or 0
+                              if mt == "deepseek_v2" else 0),
+            routed_scaling_factor=d.get("routed_scaling_factor", 1.0),
+            topk_method=d.get("topk_method", "greedy"),
+            n_group=d.get("n_group"),
+            topk_group=d.get("topk_group"),
+            first_k_dense_replace=(d.get("first_k_dense_replace", 0)
+                                   if mt == "deepseek_v2" else 0),
             # HF defaults: Mixtral always normalizes top-k weights;
             # Qwen3MoeConfig defaults norm_topk_prob to FALSE when the
-            # key is absent.
+            # key is absent; the DeepSeek-V2 gate never normalizes.
             norm_topk_prob=bool(d.get("norm_topk_prob",
-                                      mt != "qwen3_moe")),
+                                      mt != "qwen3_moe"))
+            and mt != "deepseek_v2",
             qwen_moe=mt == "qwen3_moe",
             rope_scaling=cls._parse_rope_scaling(
                 d.get("rope_scaling"),

@@ -52,6 +52,8 @@ KVCache = Tuple[jnp.ndarray, jnp.ndarray]  # k_pages, v_pages: [L, P, ps, Hkv, D
 def init_params(cfg: ModelConfig, key: jax.Array,
                 dtype: Optional[jnp.dtype] = None) -> Params:
     """Random-init a parameter pytree with the stacked-layer layout."""
+    if cfg.mla:
+        return _init_mla_params(cfg, key, dtype)
     dtype = dtype or jnp.dtype(cfg.dtype)
     L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
     Hq, Hkv, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -108,8 +110,10 @@ def num_params(params: Params) -> int:
 def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
                   dtype: Optional[jnp.dtype] = None) -> KVCache:
     dtype = dtype or jnp.dtype(cfg.dtype)
-    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
-             cfg.head_dim)
+    # MLA: one latent "head" of width kv_lora_rank + qk_rope_head_dim per
+    # token instead of per-head K/V (cfg.kv_cache_{heads,dim}).
+    shape = (cfg.num_layers, num_pages, page_size, cfg.kv_cache_heads,
+             cfg.kv_cache_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -270,12 +274,23 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     decoupled from KV storage positions). None → streams broadcast from
     the storage positions (pure-text requests; equals standard rope).
 
+    MLA models (DeepSeek-V2) take a dedicated path over the latent
+    pool (``_mla_forward_prefill``); multimodal splice is not defined
+    for them.
+
     Returns (last_logits [B, V] fp32, all_logits [B, T, V] fp32 or None,
     kv'). ``return_all_logits`` (static) gates the full-prompt lm_head: at
     serving shapes a [B, T, V] fp32 tensor is gigabytes of HBM and a T×
     larger matmul, so by default only the last valid hidden state per
     sequence hits the head — all_logits exists for prompt-logprob requests.
     """
+    if cfg.mla:
+        assert mm_embeds is None, "MLA models have no multimodal splice"
+        return _mla_forward_prefill(
+            params, cfg, tokens, start_pos, lengths, kv, page_table,
+            return_all_logits=return_all_logits,
+            prompt_lp_targets=prompt_lp_targets,
+            return_stats=return_stats)
     k_pages, v_pages = kv
     x = _scale_embed(cfg, params["embed"][tokens]
                      .astype(jnp.dtype(cfg.dtype)))              # [B, T, D]
@@ -414,14 +429,14 @@ def forward_prefill_ring(params: Params, cfg: ModelConfig,
     from xllm_service_tpu.parallel.mesh import AXIS_TP
     from xllm_service_tpu.parallel.ring import ring_attention_sharded
 
-    if cfg.sliding_window or cfg.gemma:
+    if cfg.sliding_window or cfg.gemma or cfg.mla:
         # Ring rotation assumes full causal reach and the plain llama
-        # layer body; SWA/Gemma long prompts take the chunked-window
+        # layer body; SWA/Gemma/MLA long prompts take the chunked-window
         # path (whose flash fold skips out-of-window chunks, so the
         # work is O(T·W) there anyway).
         raise NotImplementedError(
-            "ring prefill implements neither sliding-window masks nor "
-            "the gemma layer body")
+            "ring prefill implements neither sliding-window masks, the "
+            "gemma layer body, nor latent attention")
 
     k_pages, v_pages = kv
     B, T = tokens.shape
@@ -481,6 +496,9 @@ def forward_embedding(params: Params, cfg: ModelConfig,
     """Sequence embeddings: causal forward (no KV cache), masked mean-pool
     of the final hidden states, L2-normalized. tokens [B, T] padded,
     lengths [B] → [B, hidden] float32."""
+    if cfg.mla:
+        raise NotImplementedError(
+            "/v1/embeddings is not implemented for MLA models")
     B, T = tokens.shape
     x = _scale_embed(cfg, params["embed"][tokens]
                      .astype(jnp.dtype(cfg.dtype)))
@@ -548,6 +566,10 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     the rope position of a generated token and its KV storage position
     (images compress T·H·W patch tokens into a max(t,h,w)-sized rope
     span, so post-image rope positions trail storage positions)."""
+    if cfg.mla:
+        return _mla_forward_decode(params, cfg, tokens, positions,
+                                   active, kv, page_table,
+                                   return_stats=return_stats)
     k_pages, v_pages = kv
     x = _scale_embed(cfg, params["embed"][tokens[:, None]]
                      .astype(jnp.dtype(cfg.dtype)))              # [B,1,D]
@@ -607,4 +629,301 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     if return_stats:
         return logits, (k_pages, v_pages), \
             {"moe_dropped": jnp.sum(dropped_l)}
+    return logits, (k_pages, v_pages)
+
+
+# ---------------------------------------------------------------------------
+# DeepSeek-V2 multi-head latent attention (MLA)
+#
+# The cache stores one LATENT row per token — [kv_lora_rank (post
+# kv_a_layernorm) ‖ rotated k_pe] — in the standard paged pool with a
+# single KV "head" (cfg.kv_cache_{heads,dim}), so every page-table,
+# migration, and trimming mechanism applies unchanged. The kv_b
+# up-projections are ABSORBED: scores = (W_bk^T q_nope)·c + q_pe·k_pe and
+# out_h = W_bv (Σ p·c), which is exactly HF's per-head math by
+# associativity but reads r+rope bytes per token instead of
+# Hq·(qk_head+v_head). DeepSeek's rope sub-head uses the adjacent-pair
+# (complex) rotation — ops/rope.apply_rope_interleaved.
+# (HF oracle: transformers deepseek_v2 — DeepseekV2Attention,
+# DeepseekV2MoEGate greedy/group_limited_greedy, shared experts.)
+# ---------------------------------------------------------------------------
+
+def _init_mla_params(cfg: ModelConfig, key: jax.Array,
+                     dtype: Optional[jnp.dtype]) -> Params:
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    D, Hq = cfg.hidden_size, cfg.num_heads
+    r, rope = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    nope, vd = cfg.qk_nope_head_dim, cfg.v_head_dim
+    keys = iter(jax.random.split(key, 64))
+
+    def w(shape, fan_in):
+        return (jax.random.normal(next(keys), shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(dtype)
+
+    def attn_block(L):
+        blk = {
+            "input_norm": jnp.ones((L, D), dtype),
+            "kv_a": w((L, D, r + rope), D),
+            "kv_a_norm": jnp.ones((L, r), dtype),
+            "kv_b_k": w((L, Hq, nope, r), r),
+            "kv_b_v": w((L, Hq, vd, r), r),
+            "o_proj": w((L, Hq * vd, D), Hq * vd),
+            "post_norm": jnp.ones((L, D), dtype),
+        }
+        if cfg.q_lora_rank:
+            blk["q_a"] = w((L, D, cfg.q_lora_rank), D)
+            blk["q_a_norm"] = jnp.ones((L, cfg.q_lora_rank), dtype)
+            blk["q_b"] = w((L, cfg.q_lora_rank, Hq * cfg.qk_head_dim),
+                           cfg.q_lora_rank)
+        else:
+            blk["q_proj"] = w((L, D, Hq * cfg.qk_head_dim), D)
+        return blk
+
+    k_dense = cfg.first_k_dense_replace if cfg.is_moe else cfg.num_layers
+    n_moe = cfg.num_layers - k_dense
+    dense = attn_block(k_dense)
+    dense["gate_proj"] = w((k_dense, D, cfg.intermediate_size), D)
+    dense["up_proj"] = w((k_dense, D, cfg.intermediate_size), D)
+    dense["down_proj"] = w((k_dense, cfg.intermediate_size, D),
+                           cfg.intermediate_size)
+    params: Params = {
+        "embed": w((cfg.vocab_size, D), D),
+        "layers": dense,
+        "final_norm": jnp.ones((D,), dtype),
+    }
+    if n_moe:
+        Fe = cfg.moe_intermediate_size or cfg.intermediate_size
+        E = cfg.num_experts
+        moe = attn_block(n_moe)
+        moe["router"] = w((n_moe, D, E), D)
+        moe["gate_proj"] = w((n_moe, E, D, Fe), D)
+        moe["up_proj"] = w((n_moe, E, D, Fe), D)
+        moe["down_proj"] = w((n_moe, E, Fe, D), Fe)
+        if cfg.n_shared_experts:
+            Fs = Fe * cfg.n_shared_experts
+            moe["shared_gate"] = w((n_moe, D, Fs), D)
+            moe["shared_up"] = w((n_moe, D, Fs), D)
+            moe["shared_down"] = w((n_moe, Fs, D), Fs)
+        params["layers_moe"] = moe
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w((D, cfg.vocab_size), D)
+    return params
+
+
+def _deepseek_gate(cfg: ModelConfig, x: jnp.ndarray,
+                   router_w: jnp.ndarray) -> jnp.ndarray:
+    """Routing scores AFTER DeepSeek's selection rules, as a dense [.., E]
+    weight map: softmax over fp32 logits; group-limited routing zeroes
+    every expert outside the top ``topk_group`` of ``n_group`` groups
+    (group score = max member score); top-k selected weights scale by
+    routed_scaling_factor, everything else 0. No normalization (the HF
+    gate never divides by the top-k sum)."""
+    scores = jax.nn.softmax((x @ router_w).astype(jnp.float32), axis=-1)
+    E = scores.shape[-1]
+    if cfg.topk_method == "group_limited_greedy":
+        G = cfg.n_group
+        gs = scores.reshape(*scores.shape[:-1], G, E // G).max(axis=-1)
+        _, gidx = jax.lax.top_k(gs, cfg.topk_group)          # [.., tg]
+        gmask = jnp.sum(jax.nn.one_hot(gidx, G, dtype=scores.dtype),
+                        axis=-2)                             # [.., G]
+        scores = scores * jnp.repeat(gmask, E // G, axis=-1)
+    topv, topi = jax.lax.top_k(scores, cfg.num_experts_per_tok)
+    weights = jnp.zeros_like(scores)
+    for j in range(cfg.num_experts_per_tok):   # k is tiny/static
+        weights = weights + topv[..., j:j + 1] * jax.nn.one_hot(
+            topi[..., j], E, dtype=scores.dtype)
+    return weights * cfg.routed_scaling_factor
+
+
+def _mla_moe_mlp(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
+                 x: jnp.ndarray,
+                 valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Routed experts + the always-on shared experts. The DeepSeek gate
+    (group limits, scaling, no normalization) produces a dense weight
+    map; with a capacity factor the map feeds the group-chunked sparse
+    dispatch (top-k FLOPs, ep-shardable) — only cf == 0 runs the dense
+    every-expert oracle (the test reference)."""
+    weights = _deepseek_gate(cfg, x, lp["router"])           # [B, T, E]
+    if cfg.moe_capacity_factor > 0:
+        from xllm_service_tpu.parallel.expert import moe_mlp
+        routed, _ = moe_mlp(
+            x, lp["router"], lp["gate_proj"], lp["up_proj"],
+            lp["down_proj"], cfg.num_experts_per_tok,
+            cfg.moe_capacity_factor, valid=valid,
+            group_size=cfg.moe_group_size, norm_topk=False,
+            gates=weights)
+    else:
+        h = jax.nn.silu(jnp.einsum("btd,edf->btef", x, lp["gate_proj"])) \
+            * jnp.einsum("btd,edf->btef", x, lp["up_proj"])
+        out = jnp.einsum("btef,efd->bted", h, lp["down_proj"])
+        routed = jnp.einsum("bted,bte->btd", out, weights.astype(x.dtype))
+    shared = (jax.nn.silu(x @ lp["shared_gate"]) * (x @ lp["shared_up"])) \
+        @ lp["shared_down"] if "shared_gate" in lp else 0.0
+    return routed + shared
+
+
+def _mla_qkv(cfg: ModelConfig, lp, h, positions):
+    """Absorbed-query and latent-row computation for one layer.
+
+    Returns (q_tilde [B, T, Hq, r+rope], latent [B, T, 1, r+rope]):
+    q_tilde = [W_bk^T q_nope ‖ rope(q_pe)], latent = [c_hat ‖ rope(k_pe)].
+    """
+    from xllm_service_tpu.ops.rope import apply_rope_interleaved
+
+    B, T, _ = h.shape
+    Hq = cfg.num_heads
+    r, rope = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    nope = cfg.qk_nope_head_dim
+    if cfg.q_lora_rank:
+        q = rms_norm(h @ lp["q_a"], lp["q_a_norm"], cfg.rms_norm_eps) \
+            @ lp["q_b"]
+    else:
+        q = h @ lp["q_proj"]
+    q = q.reshape(B, T, Hq, cfg.qk_head_dim)
+    q_nope, q_pe = q[..., :nope], q[..., nope:]
+    q_pe = apply_rope_interleaved(q_pe, positions, cfg.rope_theta,
+                                  cfg.rope_scaling)
+    # Absorb the key up-projection into the query side.
+    q_eff = jnp.einsum("bthn,hnr->bthr", q_nope, lp["kv_b_k"])
+    q_tilde = jnp.concatenate([q_eff, q_pe], axis=-1)        # [B,T,Hq,r+rope]
+
+    ckv = h @ lp["kv_a"]                                     # [B,T,r+rope]
+    c_hat = rms_norm(ckv[..., :r], lp["kv_a_norm"], cfg.rms_norm_eps)
+    k_pe = apply_rope_interleaved(ckv[..., r:], positions, cfg.rope_theta,
+                                  cfg.rope_scaling)
+    latent = jnp.concatenate([c_hat, k_pe], axis=-1)[:, :, None, :]
+    return q_tilde, latent
+
+
+def _mla_out(cfg: ModelConfig, lp, attn: jnp.ndarray) -> jnp.ndarray:
+    """attn [..., Hq, r+rope] → absorbed value up-projection → o_proj."""
+    o_lat = attn[..., :cfg.kv_lora_rank]                     # [...,Hq,r]
+    o = jnp.einsum("...hr,hvr->...hv", o_lat, lp["kv_b_v"])
+    return o.reshape(*o.shape[:-2], -1) @ lp["o_proj"]
+
+
+def _mla_scale(cfg: ModelConfig) -> float:
+    return cfg.qk_head_dim ** -0.5
+
+
+def _mla_forward_prefill(params: Params, cfg: ModelConfig,
+                         tokens: jnp.ndarray, start_pos: jnp.ndarray,
+                         lengths: jnp.ndarray, kv: KVCache,
+                         page_table: jnp.ndarray,
+                         return_all_logits: bool = False,
+                         prompt_lp_targets: Optional[jnp.ndarray] = None,
+                         return_stats: bool = False):
+    k_pages, v_pages = kv
+    L_dense = params["layers"]["input_norm"].shape[0]
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    positions = start_pos[:, None] + jnp.arange(tokens.shape[1],
+                                                dtype=jnp.int32)[None, :]
+    kv_lengths = start_pos + lengths
+    B, T = tokens.shape
+    tok_valid = (jnp.arange(T, dtype=jnp.int32)[None, :]
+                 < lengths[:, None])                             # [B, T]
+
+    def body(moe: bool):
+        def layer(x, xs):
+            lp, kp, vp = xs
+            h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+            q_t, latent = _mla_qkv(cfg, lp, h, positions)
+            lat_all = overlay_fresh_kv(gather_pages(kp, page_table),
+                                       latent, start_pos)
+            attn = mha_prefill_auto(q_t, lat_all, lat_all, kv_lengths,
+                                    start_pos, scale=_mla_scale(cfg))
+            x = x + _mla_out(cfg, lp, attn)
+            h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+            if moe:
+                x = x + _mla_moe_mlp(cfg, lp, h, valid=tok_valid)
+            else:
+                x = x + (jax.nn.silu(h @ lp["gate_proj"])
+                         * (h @ lp["up_proj"])) @ lp["down_proj"]
+            return x, (latent, latent)
+        return layer
+
+    x, (k_d, v_d) = jax.lax.scan(
+        body(False), x,
+        (params["layers"], k_pages[:L_dense], v_pages[:L_dense]))
+    if "layers_moe" in params:
+        x, (k_m, v_m) = jax.lax.scan(
+            body(True), x,
+            (params["layers_moe"], k_pages[L_dense:], v_pages[L_dense:]))
+        k_new = jnp.concatenate([k_d, k_m], axis=0)
+        v_new = jnp.concatenate([v_d, v_m], axis=0)
+    else:
+        k_new, v_new = k_d, v_d
+    k_pages, v_pages = write_prefill_kv_all_layers(
+        k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_x = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
+    outs = [_head_logits(cfg, last_x, head),
+            _head_logits(cfg, x, head) if return_all_logits else None,
+            (k_pages, v_pages)]
+    if prompt_lp_targets is not None:
+        outs.append(_prompt_logprobs(x, head, prompt_lp_targets))
+    if return_stats:
+        outs.append({"moe_dropped": jnp.zeros((), jnp.int32)})
+    return tuple(outs)
+
+
+def _mla_forward_decode(params: Params, cfg: ModelConfig,
+                        tokens: jnp.ndarray, positions: jnp.ndarray,
+                        active: jnp.ndarray, kv: KVCache,
+                        page_table: jnp.ndarray,
+                        return_stats: bool = False):
+    k_pages, v_pages = kv
+    L_dense = params["layers"]["input_norm"].shape[0]
+    x = params["embed"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))
+    cache_lens = jnp.where(active, positions, 0)
+    B = tokens.shape[0]
+
+    def body(moe: bool):
+        def layer(x, xs):
+            lp, kp, vp = xs
+            h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+            q_t, latent = _mla_qkv(cfg, lp, h, positions[:, None])
+            # Both "k" and "v" reads come from the SAME latent pool (kp
+            # twice — XLA CSEs the duplicate gather into one HBM read);
+            # the duplicate v_pages pool is write-only under MLA, a
+            # known 2x-storage cost of keeping the engine's uniform
+            # (k, v) pool plumbing (single-pool layout is a follow-up).
+            attn = paged_decode_attention_current_auto(
+                q_t[:, 0], kp, kp, page_table, cache_lens,
+                latent[:, 0], latent[:, 0], scale=_mla_scale(cfg))
+            x = x + _mla_out(cfg, lp, attn)[:, None, :]
+            h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+            if moe:
+                x = x + _mla_moe_mlp(cfg, lp, h, valid=active[:, None])
+            else:
+                x = x + (jax.nn.silu(h @ lp["gate_proj"])
+                         * (h @ lp["up_proj"])) @ lp["down_proj"]
+            return x, (latent[:, 0], latent[:, 0])
+        return layer
+
+    x, (k_d, v_d) = jax.lax.scan(
+        body(False), x,
+        (params["layers"], k_pages[:L_dense], v_pages[:L_dense]))
+    if "layers_moe" in params:
+        x, (k_m, v_m) = jax.lax.scan(
+            body(True), x,
+            (params["layers_moe"], k_pages[L_dense:], v_pages[L_dense:]))
+        k_new = jnp.concatenate([k_d, k_m], axis=0)
+        v_new = jnp.concatenate([v_d, v_m], axis=0)
+    else:
+        k_new, v_new = k_d, v_d
+    k_pages, v_pages = write_decode_kv_all_layers(
+        k_pages, v_pages, k_new, v_new, page_table, positions, active)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = _head_logits(cfg, x[:, 0], head)
+    if return_stats:
+        return logits, (k_pages, v_pages), \
+            {"moe_dropped": jnp.zeros((), jnp.int32)}
     return logits, (k_pages, v_pages)

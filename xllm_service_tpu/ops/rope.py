@@ -118,6 +118,26 @@ def _rotate(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
     return out.astype(x.dtype)
 
 
+def apply_rope_interleaved(x: jnp.ndarray, positions: jnp.ndarray,
+                           theta: float,
+                           scaling: Optional[RopeScaling] = None
+                           ) -> jnp.ndarray:
+    """Adjacent-pair ("GPT-J" / complex) rotation: pairs (x[2i], x[2i+1])
+    rotate by angle_i — DeepSeek-V2's convention for its rope sub-head
+    (modeling_deepseek_v2.apply_rotary_emb views the last dim as complex
+    pairs), vs the half-rotation layout everywhere else. ``x`` is
+    [..., seq, dim] or [..., seq, heads, dim]; ``positions`` [..., seq]."""
+    dim = x.shape[-1]
+    cos, sin = rope_cos_sin(positions, dim, theta, scaling=scaling)
+    if x.ndim == cos.ndim + 1:            # head axis present
+        cos = cos[..., None, :]
+        sin = sin[..., None, :]
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
 def apply_mrope(x: jnp.ndarray, positions3: jnp.ndarray, theta: float,
                 sections: Tuple[int, ...]) -> jnp.ndarray:
     """Qwen2-VL multimodal rope: three position streams (temporal /

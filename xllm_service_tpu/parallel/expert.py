@@ -100,7 +100,8 @@ def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, gate_w: jnp.ndarray,
             capacity_factor: float = 2.0,
             valid: jnp.ndarray = None,
             group_size: int = 512,
-            norm_topk: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            norm_topk: bool = True,
+            gates: jnp.ndarray = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sparse SwiGLU MoE layer, group-chunked.
 
     x: [B, T, D]; router_w [D, E]; gate/up [E, D, F]; down [E, F, D];
@@ -126,7 +127,18 @@ def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, gate_w: jnp.ndarray,
     n_g = (N + pad) // G
     xg = xf.reshape(n_g, G, D)
     vg = vf.reshape(n_g, G)
-    gates = jax.nn.softmax((xg @ router_w).astype(jnp.float32), axis=-1)
+    if gates is None:
+        gates = jax.nn.softmax((xg @ router_w).astype(jnp.float32),
+                               axis=-1)
+    else:
+        # Caller-selected routing map [B, T, E] (DeepSeek's grouped gate
+        # with its scaling already applied): exactly k experts carry
+        # nonzero weight per token, so top_k re-selects them and the
+        # weights ride into combine unchanged (norm_topk must be False).
+        gf = gates.reshape(N, -1).astype(jnp.float32)
+        if pad:
+            gf = jnp.pad(gf, ((0, pad), (0, 0)))
+        gates = gf.reshape(n_g, G, -1)
     cap = capacity(G, E, k, capacity_factor)
     dispatch, combine = jax.vmap(
         lambda g, v: topk_dispatch(g, k, cap, v, norm_topk))(gates, vg)

@@ -79,7 +79,8 @@ def kv_cache_pspec(cfg: ModelConfig, tp_size: int = 1) -> P:
     in the decode hot loop. When Hkv doesn't divide tp (MQA / small models on
     wide meshes) the cache is replicated instead, mirroring how GQA KV heads
     are duplicated across tp subgroups."""
-    if tp_size > 1 and cfg.num_kv_heads % tp_size == 0:
+    if tp_size > 1 and cfg.kv_cache_heads % tp_size == 0:
+        # (MLA's single latent "head" never divides tp>1 → replicated.)
         return P(None, None, None, AXIS_TP, None)
     return P(None, None, None, None, None)
 
@@ -96,10 +97,32 @@ def seq_pspec() -> P:
 
 def shard_params(params: Dict[str, Any], mesh: Mesh,
                  cfg: ModelConfig) -> Dict[str, Any]:
-    """device_put every leaf with its NamedSharding (keeps tree structure)."""
-    specs = param_pspecs(cfg)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+    """device_put every leaf with its NamedSharding. Specs are derived
+    from the ACTUAL tree structure: rule tables by leaf name (picking the
+    rule whose rank matches — MoE expert stacks vs dense MLPs share
+    names), replicated default for everything unlisted (per-head norms,
+    gemma's extra block norms, the MLA q_a/q_b/kv_a/kv_b_*/shared_*
+    tree). MLA leaves whose name AND rank match a llama rule (q_proj,
+    o_proj — both column/row-parallel on their feature axis) take that
+    rule, which is dimensionally sound for them too."""
+
+    def spec_for(path, leaf) -> P:
+        name = next((p.key for p in reversed(path)
+                     if hasattr(p, "key")), "")
+        if name == "embed":
+            return P(AXIS_TP, None)
+        if name == "lm_head":
+            return P(None, AXIS_TP)
+        for rules in ((_MOE_LAYER_RULES, _LAYER_RULES) if cfg.is_moe
+                      else (_LAYER_RULES,)):
+            spec = rules.get(name)
+            if spec is not None and len(spec) == leaf.ndim:
+                return spec
+        return P(*([None] * leaf.ndim))
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: jax.device_put(
+            x, NamedSharding(mesh, spec_for(path, x))), params)
 
 
 def shard_kv_cache(kv, mesh: Mesh, cfg: ModelConfig):

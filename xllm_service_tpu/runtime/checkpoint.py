@@ -121,6 +121,8 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
     if "model.embed_tokens.weight" not in r \
             and "model.language_model.embed_tokens.weight" in r:
         r = _PrefixRemap(r, "model.", "model.language_model.")
+    if cfg.mla:
+        return _load_mla_checkpoint(r, cfg, dtype, mesh)
 
     def stack(fmt: str, transpose: bool = False) -> np.ndarray:
         rows: List[np.ndarray] = []
@@ -245,6 +247,120 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
     return jax.tree_util.tree_map(jax.device_put, params)
 
 
+def _load_mla_checkpoint(r, cfg: ModelConfig, dtype, mesh):
+    """DeepSeek-V2 tree: MLA attention blocks split into a dense-MLP
+    prefix stack (first_k_dense_replace layers) and a MoE suffix stack
+    (routed + shared experts), mirroring models/transformer.py's
+    _init_mla_params layout. kv_b_proj splits into the absorbed-form
+    kv_b_k [Hq, nope, r] / kv_b_v [Hq, v, r] halves at load."""
+    Hq = cfg.num_heads
+    nope, vd = cfg.qk_nope_head_dim, cfg.v_head_dim
+    lora = cfg.kv_lora_rank
+    k_dense = cfg.first_k_dense_replace if cfg.is_moe else cfg.num_layers
+    A = "model.layers.{i}.self_attn."
+    M = "model.layers.{i}.mlp."
+
+    def stack(rows_fmt, idxs, transpose=False):
+        rows = []
+        for i in idxs:
+            t = r.get(rows_fmt.format(i=i))
+            rows.append(np.ascontiguousarray(t.T) if transpose else t)
+        return np.stack(rows).astype(dtype)
+
+    def attn_block(idxs):
+        blk = {
+            "input_norm": stack(
+                "model.layers.{i}.input_layernorm.weight", idxs),
+            "post_norm": stack(
+                "model.layers.{i}.post_attention_layernorm.weight", idxs),
+            "kv_a": stack(A + "kv_a_proj_with_mqa.weight", idxs, True),
+            "kv_a_norm": stack(A + "kv_a_layernorm.weight", idxs),
+            "o_proj": stack(A + "o_proj.weight", idxs, True),
+        }
+        kb_k, kb_v = [], []
+        for i in idxs:
+            w = r.get(A.format(i=i) + "kv_b_proj.weight")  # [Hq*(n+v), r]
+            w = w.reshape(Hq, nope + vd, lora)
+            kb_k.append(np.ascontiguousarray(w[:, :nope, :]))
+            kb_v.append(np.ascontiguousarray(w[:, nope:, :]))
+        blk["kv_b_k"] = np.stack(kb_k).astype(dtype)
+        blk["kv_b_v"] = np.stack(kb_v).astype(dtype)
+        if cfg.q_lora_rank:
+            blk["q_a"] = stack(A + "q_a_proj.weight", idxs, True)
+            blk["q_a_norm"] = stack(A + "q_a_layernorm.weight", idxs)
+            blk["q_b"] = stack(A + "q_b_proj.weight", idxs, True)
+        else:
+            blk["q_proj"] = stack(A + "q_proj.weight", idxs, True)
+        return blk
+
+    dense_idx = list(range(k_dense))
+    if dense_idx:
+        dense = attn_block(dense_idx)
+        for nm in ("gate_proj", "up_proj", "down_proj"):
+            dense[nm] = stack(M + nm + ".weight", dense_idx, True)
+    else:
+        # first_k_dense_replace == 0 (a valid HF default): the dense
+        # prefix stack is EMPTY — zero-length arrays with the right
+        # trailing shapes so the jax.lax.scan over it is a no-op.
+        D, Hq = cfg.hidden_size, cfg.num_heads
+        F = cfg.intermediate_size
+
+        def e(*trail):
+            return np.zeros((0,) + trail, dtype)
+
+        dense = {
+            "input_norm": e(D), "post_norm": e(D),
+            "kv_a": e(D, lora + cfg.qk_rope_head_dim),
+            "kv_a_norm": e(lora),
+            "kv_b_k": e(Hq, nope, lora), "kv_b_v": e(Hq, vd, lora),
+            "o_proj": e(Hq * vd, D),
+            "gate_proj": e(D, F), "up_proj": e(D, F),
+            "down_proj": e(F, D),
+        }
+        if cfg.q_lora_rank:
+            dense["q_a"] = e(D, cfg.q_lora_rank)
+            dense["q_a_norm"] = e(cfg.q_lora_rank)
+            dense["q_b"] = e(cfg.q_lora_rank, Hq * cfg.qk_head_dim)
+        else:
+            dense["q_proj"] = e(D, Hq * cfg.qk_head_dim)
+    params: Dict[str, Any] = {
+        "embed": r.get("model.embed_tokens.weight").astype(dtype),
+        "layers": dense,
+        "final_norm": r.get("model.norm.weight").astype(dtype),
+    }
+    moe_idx = list(range(k_dense, cfg.num_layers))
+    if moe_idx:
+        moe = attn_block(moe_idx)
+        moe["router"] = stack(M + "gate.weight", moe_idx, True)
+        for nm in ("gate_proj", "up_proj", "down_proj"):
+            rows = []
+            for i in moe_idx:
+                rows.append(np.stack([
+                    np.ascontiguousarray(r.get(
+                        M.format(i=i) + f"experts.{e}.{nm}.weight").T)
+                    for e in range(cfg.num_experts)]))
+            moe[nm] = np.stack(rows).astype(dtype)
+        if cfg.n_shared_experts:
+            moe["shared_gate"] = stack(
+                M + "shared_experts.gate_proj.weight", moe_idx, True)
+            moe["shared_up"] = stack(
+                M + "shared_experts.up_proj.weight", moe_idx, True)
+            moe["shared_down"] = stack(
+                M + "shared_experts.down_proj.weight", moe_idx, True)
+        params["layers_moe"] = moe
+    if not cfg.tie_word_embeddings:
+        if "lm_head.weight" in r:
+            params["lm_head"] = np.ascontiguousarray(
+                r.get("lm_head.weight").T).astype(dtype)
+        else:
+            params["lm_head"] = np.ascontiguousarray(params["embed"].T)
+    r.close()
+    if mesh is not None:
+        from xllm_service_tpu.parallel.sharding import shard_params
+        return shard_params(params, mesh, cfg)
+    return jax.tree_util.tree_map(jax.device_put, params)
+
+
 def load_qwen2vl_vision(model_dir: str, vcfg=None,
                         image_size: int = 224):
     """Load a Qwen2-VL checkpoint's vision tower (``visual.*`` keys; the
@@ -330,6 +446,11 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
     ``config.json`` (tests' round-trip source; export path for tuned
     weights)."""
     from safetensors.numpy import save_file
+
+    if cfg.mla:
+        raise NotImplementedError(
+            "save_checkpoint for MLA (DeepSeek-V2) trees is not "
+            "implemented — the absorbed kv_b split is one-way for now")
 
     os.makedirs(model_dir, exist_ok=True)
     get = lambda x: np.asarray(jax.device_get(x))  # noqa: E731
