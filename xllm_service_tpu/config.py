@@ -177,6 +177,14 @@ class ModelConfig:
     # First k layers use a dense MLP (DeepSeek's first_k_dense_replace);
     # the layer stack splits into a dense prefix + MoE suffix scan.
     first_k_dense_replace: int = 0
+    # DeepSeek-V3 deltas over V2: sigmoid routing with a learned
+    # per-expert selection bias (e_score_correction_bias — biases the
+    # CHOICE, not the weights) and top-2-sum group scores; a flag for
+    # the rope sub-head pair layout; and yarn's mscale² folded into the
+    # softmax scale (V3 attention does this, the V2 port does not).
+    moe_scoring: str = "softmax"        # or "sigmoid" (V3)
+    rope_interleave: bool = True
+    mla_yarn_mscale: bool = False
     # Sparse dispatch capacity factor (parallel/expert.py): each expert
     # takes ≤ ceil(k·G·cf/E) tokens per group. ≥ E/k guarantees no drops;
     # 0 selects the dense-compute oracle (every expert on every token).
@@ -314,12 +322,35 @@ class ModelConfig:
                    rope_theta=10000.0, rms_norm_eps=1e-6,
                    max_position_embeddings=163840,
                    rope_scaling=("yarn", 40.0, 32.0, 1.0, 4096, 1.0,
-                                 True),
+                                 True, 0.707),
                    kv_lora_rank=512, qk_nope_head_dim=128,
                    qk_rope_head_dim=64, v_head_dim=128,
                    num_experts=64, num_experts_per_tok=6,
                    n_shared_experts=2, first_k_dense_replace=1,
                    routed_scaling_factor=1.0, norm_topk_prob=False)
+
+    @classmethod
+    def deepseek_v3(cls) -> "ModelConfig":
+        # DeepSeek-V3/R1 shape: 256-expert top-8 with sigmoid scoring +
+        # learned selection bias, 8-group device-limited routing, MLA
+        # with q compression, 3 dense prefix layers, yarn long context
+        # (mscale folded into the softmax scale).
+        return cls(name="deepseek-v3", vocab_size=129280,
+                   hidden_size=7168, intermediate_size=18432,
+                   moe_intermediate_size=2048, num_layers=61,
+                   num_heads=128, num_kv_heads=128, head_dim=64,
+                   rope_theta=10000.0, rms_norm_eps=1e-6,
+                   max_position_embeddings=163840,
+                   rope_scaling=("yarn", 40.0, 32.0, 1.0, 4096, 1.0,
+                                 True, 1.0),
+                   kv_lora_rank=512, q_lora_rank=1536,
+                   qk_nope_head_dim=128, qk_rope_head_dim=64,
+                   v_head_dim=128, num_experts=256,
+                   num_experts_per_tok=8, n_shared_experts=1,
+                   first_k_dense_replace=3, n_group=8, topk_group=4,
+                   routed_scaling_factor=2.5, norm_topk_prob=True,
+                   topk_method="group_limited_greedy",
+                   moe_scoring="sigmoid", mla_yarn_mscale=True)
 
     @classmethod
     def gemma2_9b(cls) -> "ModelConfig":
@@ -365,12 +396,25 @@ class ModelConfig:
         mt = d.get("model_type", "llama")
         supported = ("llama", "mistral", "qwen2", "qwen3", "phi3",
                      "mixtral", "gemma2", "qwen2_vl", "qwen3_moe",
-                     "deepseek_v2")
-        if mt == "deepseek_v2" and d.get("topk_method") not in (
-                None, "greedy", "group_limited_greedy"):
-            raise ValueError(
-                f"deepseek topk_method {d.get('topk_method')!r} "
-                f"is not implemented")
+                     "deepseek_v2", "deepseek_v3")
+        _dsk = mt in ("deepseek_v2", "deepseek_v3")
+        if _dsk:
+            tkm = d.get("topk_method")
+            ok = ((None, "greedy", "group_limited_greedy")
+                  if mt == "deepseek_v2"
+                  # V3/R1 checkpoints say "noaux_tc" — the aux-loss-free
+                  # biased sigmoid selection with grouped top-k, exactly
+                  # the sigmoid gate implemented here.
+                  else (None, "noaux_tc", "group_limited_greedy"))
+            if tkm not in ok:
+                raise ValueError(
+                    f"deepseek topk_method {tkm!r} is not implemented")
+            sf = d.get("scoring_func")
+            want_sf = "sigmoid" if mt == "deepseek_v3" else "softmax"
+            if sf is not None and sf != want_sf:
+                raise ValueError(
+                    f"{mt} with scoring_func {sf!r} is not implemented "
+                    f"(expected {want_sf!r})")
         if mt == "qwen3_moe":
             # Mixed sparse/dense layer schedules can't share the one
             # scanned layer body — refuse, never approximate.
@@ -472,29 +516,28 @@ class ModelConfig:
                 if mt == "gemma2" else None),
             gemma=mt == "gemma2",
             num_experts=(d.get("num_experts", 0) if mt == "qwen3_moe"
-                         else d.get("n_routed_experts", 0)
-                         if mt == "deepseek_v2"
+                         else d.get("n_routed_experts", 0) if _dsk
                          else d.get("num_local_experts", 0)),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             moe_intermediate_size=d.get("moe_intermediate_size"),
-            kv_lora_rank=(d.get("kv_lora_rank") or 0
-                          if mt == "deepseek_v2" else 0),
-            q_lora_rank=(d.get("q_lora_rank")
-                         if mt == "deepseek_v2" else None),
-            qk_nope_head_dim=d.get("qk_nope_head_dim", 0)
-            if mt == "deepseek_v2" else 0,
-            qk_rope_head_dim=d.get("qk_rope_head_dim", 0)
-            if mt == "deepseek_v2" else 0,
-            v_head_dim=d.get("v_head_dim", 0)
-            if mt == "deepseek_v2" else 0,
-            n_shared_experts=(d.get("n_shared_experts") or 0
-                              if mt == "deepseek_v2" else 0),
+            kv_lora_rank=(d.get("kv_lora_rank") or 0) if _dsk else 0,
+            q_lora_rank=d.get("q_lora_rank") if _dsk else None,
+            qk_nope_head_dim=d.get("qk_nope_head_dim", 0) if _dsk else 0,
+            qk_rope_head_dim=d.get("qk_rope_head_dim", 0) if _dsk else 0,
+            v_head_dim=d.get("v_head_dim", 0) if _dsk else 0,
+            n_shared_experts=(d.get("n_shared_experts") or 0) if _dsk
+            else 0,
             routed_scaling_factor=d.get("routed_scaling_factor", 1.0),
-            topk_method=d.get("topk_method", "greedy"),
+            # V3's "noaux_tc" IS grouped selection under sigmoid scoring.
+            topk_method=("group_limited_greedy" if mt == "deepseek_v3"
+                         else d.get("topk_method", "greedy")),
             n_group=d.get("n_group"),
             topk_group=d.get("topk_group"),
             first_k_dense_replace=(d.get("first_k_dense_replace", 0)
-                                   if mt == "deepseek_v2" else 0),
+                                   if _dsk else 0),
+            moe_scoring="sigmoid" if mt == "deepseek_v3" else "softmax",
+            rope_interleave=bool(d.get("rope_interleave", True)),
+            mla_yarn_mscale=mt == "deepseek_v3",
             # HF defaults: Mixtral always normalizes top-k weights;
             # Qwen3MoeConfig defaults norm_topk_prob to FALSE when the
             # key is absent; the DeepSeek-V2 gate never normalizes.
@@ -556,7 +599,8 @@ class ModelConfig:
                     float(rs.get("beta_fast") or 32.0),
                     float(rs.get("beta_slow") or 1.0),
                     orig, float(attn),
-                    bool(rs.get("truncate", True)))
+                    bool(rs.get("truncate", True)),
+                    float(rs.get("mscale_all_dim") or 0.0))
         raise NotImplementedError(
             f"rope_scaling type {kind!r} not supported")
 

@@ -696,6 +696,8 @@ def _init_mla_params(cfg: ModelConfig, key: jax.Array,
         E = cfg.num_experts
         moe = attn_block(n_moe)
         moe["router"] = w((n_moe, D, E), D)
+        if cfg.moe_scoring == "sigmoid":
+            moe["router_bias"] = jnp.zeros((n_moe, E), jnp.float32)
         moe["gate_proj"] = w((n_moe, E, D, Fe), D)
         moe["up_proj"] = w((n_moe, E, D, Fe), D)
         moe["down_proj"] = w((n_moe, E, Fe, D), Fe)
@@ -711,27 +713,50 @@ def _init_mla_params(cfg: ModelConfig, key: jax.Array,
 
 
 def _deepseek_gate(cfg: ModelConfig, x: jnp.ndarray,
-                   router_w: jnp.ndarray) -> jnp.ndarray:
+                   router_w: jnp.ndarray,
+                   bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Routing scores AFTER DeepSeek's selection rules, as a dense [.., E]
-    weight map: softmax over fp32 logits; group-limited routing zeroes
-    every expert outside the top ``topk_group`` of ``n_group`` groups
-    (group score = max member score); top-k selected weights scale by
-    routed_scaling_factor, everything else 0. No normalization (the HF
-    gate never divides by the top-k sum)."""
-    scores = jax.nn.softmax((x @ router_w).astype(jnp.float32), axis=-1)
-    E = scores.shape[-1]
+    weight map.
+
+    V2 (softmax scoring): softmax over fp32 logits; group-limited
+    routing zeroes every expert outside the top ``topk_group`` of
+    ``n_group`` groups (group score = max member score); top-k selected
+    weights scale by routed_scaling_factor, no normalization.
+
+    V3 (sigmoid scoring): sigmoid scores; SELECTION uses scores + the
+    learned per-expert ``e_score_correction_bias`` with top-2-SUM group
+    scores, but the combine WEIGHTS are the raw sigmoid scores of the
+    chosen experts, optionally normalized (norm_topk_prob), then scaled.
+    (HF DeepseekV2MoEGate / DeepseekV3TopkRouter.)"""
+    logits = (x @ router_w).astype(jnp.float32)
+    E = logits.shape[-1]
+    sigmoid = cfg.moe_scoring == "sigmoid"
+    scores = jax.nn.sigmoid(logits) if sigmoid \
+        else jax.nn.softmax(logits, axis=-1)
+    choice = scores + bias if (sigmoid and bias is not None) else scores
     if cfg.topk_method == "group_limited_greedy":
         G = cfg.n_group
-        gs = scores.reshape(*scores.shape[:-1], G, E // G).max(axis=-1)
+        grouped = choice.reshape(*choice.shape[:-1], G, E // G)
+        if sigmoid:
+            g2, _ = jax.lax.top_k(grouped, 2)
+            gs = jnp.sum(g2, axis=-1)                        # top-2 sum
+        else:
+            gs = grouped.max(axis=-1)
         _, gidx = jax.lax.top_k(gs, cfg.topk_group)          # [.., tg]
-        gmask = jnp.sum(jax.nn.one_hot(gidx, G, dtype=scores.dtype),
+        gmask = jnp.sum(jax.nn.one_hot(gidx, G, dtype=choice.dtype),
                         axis=-2)                             # [.., G]
-        scores = scores * jnp.repeat(gmask, E // G, axis=-1)
-    topv, topi = jax.lax.top_k(scores, cfg.num_experts_per_tok)
-    weights = jnp.zeros_like(scores)
+        choice = jnp.where(jnp.repeat(gmask, E // G, axis=-1) > 0,
+                           choice, 0.0)
+    _, topi = jax.lax.top_k(choice, cfg.num_experts_per_tok)
+    sel = jnp.zeros_like(scores)
     for j in range(cfg.num_experts_per_tok):   # k is tiny/static
-        weights = weights + topv[..., j:j + 1] * jax.nn.one_hot(
-            topi[..., j], E, dtype=scores.dtype)
+        sel = sel + jax.nn.one_hot(topi[..., j], E, dtype=scores.dtype)
+    # V3 combines with the RAW sigmoid scores (bias shapes choice only);
+    # V2 combines with the masked selection values themselves.
+    weights = (scores if sigmoid else choice) * sel
+    if sigmoid and cfg.norm_topk_prob:
+        weights = weights / (jnp.sum(weights, axis=-1, keepdims=True)
+                             + 1e-20)
     return weights * cfg.routed_scaling_factor
 
 
@@ -743,7 +768,8 @@ def _mla_moe_mlp(cfg: ModelConfig, lp: Dict[str, jnp.ndarray],
     map; with a capacity factor the map feeds the group-chunked sparse
     dispatch (top-k FLOPs, ep-shardable) — only cf == 0 runs the dense
     every-expert oracle (the test reference)."""
-    weights = _deepseek_gate(cfg, x, lp["router"])           # [B, T, E]
+    weights = _deepseek_gate(cfg, x, lp["router"],
+                             lp.get("router_bias"))          # [B, T, E]
     if cfg.moe_capacity_factor > 0:
         from xllm_service_tpu.parallel.expert import moe_mlp
         routed, _ = moe_mlp(
@@ -768,8 +794,10 @@ def _mla_qkv(cfg: ModelConfig, lp, h, positions):
     Returns (q_tilde [B, T, Hq, r+rope], latent [B, T, 1, r+rope]):
     q_tilde = [W_bk^T q_nope ‖ rope(q_pe)], latent = [c_hat ‖ rope(k_pe)].
     """
-    from xllm_service_tpu.ops.rope import apply_rope_interleaved
+    from xllm_service_tpu.ops.rope import (apply_rope,
+                                           apply_rope_interleaved)
 
+    rope_fn = apply_rope_interleaved if cfg.rope_interleave else apply_rope
     B, T, _ = h.shape
     Hq = cfg.num_heads
     r, rope = cfg.kv_lora_rank, cfg.qk_rope_head_dim
@@ -781,16 +809,15 @@ def _mla_qkv(cfg: ModelConfig, lp, h, positions):
         q = h @ lp["q_proj"]
     q = q.reshape(B, T, Hq, cfg.qk_head_dim)
     q_nope, q_pe = q[..., :nope], q[..., nope:]
-    q_pe = apply_rope_interleaved(q_pe, positions, cfg.rope_theta,
-                                  cfg.rope_scaling)
+    q_pe = rope_fn(q_pe, positions, cfg.rope_theta, cfg.rope_scaling)
     # Absorb the key up-projection into the query side.
     q_eff = jnp.einsum("bthn,hnr->bthr", q_nope, lp["kv_b_k"])
     q_tilde = jnp.concatenate([q_eff, q_pe], axis=-1)        # [B,T,Hq,r+rope]
 
     ckv = h @ lp["kv_a"]                                     # [B,T,r+rope]
     c_hat = rms_norm(ckv[..., :r], lp["kv_a_norm"], cfg.rms_norm_eps)
-    k_pe = apply_rope_interleaved(ckv[..., r:], positions, cfg.rope_theta,
-                                  cfg.rope_scaling)
+    k_pe = rope_fn(ckv[..., r:], positions, cfg.rope_theta,
+                   cfg.rope_scaling)
     latent = jnp.concatenate([c_hat, k_pe], axis=-1)[:, :, None, :]
     return q_tilde, latent
 
@@ -803,7 +830,17 @@ def _mla_out(cfg: ModelConfig, lp, attn: jnp.ndarray) -> jnp.ndarray:
 
 
 def _mla_scale(cfg: ModelConfig) -> float:
-    return cfg.qk_head_dim ** -0.5
+    scale = cfg.qk_head_dim ** -0.5
+    rs = cfg.rope_scaling
+    if cfg.mla_yarn_mscale and rs is not None and rs[0] == "yarn":
+        # DeepSeek-V3 folds yarn's mscale into the softmax scale
+        # (squared — query and key sides), on top of the rope module's
+        # cos/sin attention factor. The V2 port does not.
+        factor, msa = rs[1], rs[7] if len(rs) > 7 else 0.0
+        if msa and factor > 1.0:
+            m = 0.1 * msa * math.log(factor) + 1.0
+            scale = scale * m * m
+    return scale
 
 
 def _mla_forward_prefill(params: Params, cfg: ModelConfig,

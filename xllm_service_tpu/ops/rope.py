@@ -23,7 +23,8 @@ import jax.numpy as jnp
 
 RopeScaling = Tuple  # ("llama3", f, lo, hi, orig) | ("linear", f, 0, 0, 0)
 #   | ("mrope", (s_t, s_h, s_w))
-#   | ("yarn", factor, beta_fast, beta_slow, orig, attn_factor, truncate)
+#   | ("yarn", factor, beta_fast, beta_slow, orig, attn_factor,
+#             truncate, mscale_all_dim)
 
 
 def rope_inv_freq(head_dim: int, theta: float,
@@ -58,7 +59,8 @@ def rope_inv_freq(head_dim: int, theta: float,
         # fewer than beta_slow interpolate by 1/factor, a linear ramp
         # mixes in between. The cos/sin attention factor is applied in
         # rope_cos_sin (this function returns frequencies only).
-        _, factor, beta_fast, beta_slow, orig, _attn, truncate = scaling
+        (_, factor, beta_fast, beta_slow, orig, _attn,
+         truncate) = scaling[:7]
 
         def correction_dim(rot):
             return (head_dim * math.log(orig / (rot * 2 * math.pi))
@@ -110,8 +112,9 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float,
 
 def _rotate(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
     head_dim = x.shape[-1]
-    cos = cos[..., None, :]  # broadcast over heads: [..., seq, 1, half]
-    sin = sin[..., None, :]
+    if x.ndim == cos.ndim + 1:   # head axis present: [..., seq, H, dim]
+        cos = cos[..., None, :]  # broadcast over heads: [..., seq, 1, half]
+        sin = sin[..., None, :]
     x1 = x[..., : head_dim // 2].astype(jnp.float32)
     x2 = x[..., head_dim // 2:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)

@@ -332,6 +332,11 @@ def _load_mla_checkpoint(r, cfg: ModelConfig, dtype, mesh):
     if moe_idx:
         moe = attn_block(moe_idx)
         moe["router"] = stack(M + "gate.weight", moe_idx, True)
+        if cfg.moe_scoring == "sigmoid":
+            # V3's learned selection bias (not a combine weight).
+            moe["router_bias"] = np.stack([
+                r.get(M.format(i=i) + "gate.e_score_correction_bias")
+                for i in moe_idx]).astype(np.float32)
         for nm in ("gate_proj", "up_proj", "down_proj"):
             rows = []
             for i in moe_idx:
@@ -570,12 +575,15 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
                 "type": "mrope",
                 "mrope_section": list(cfg.rope_scaling[1])}
         elif kind == "yarn":
-            _, factor, bf, bs, orig, attn, trunc = cfg.rope_scaling
+            (_, factor, bf, bs, orig, attn, trunc,
+             msa) = cfg.rope_scaling
             hf_cfg["rope_scaling"] = {
                 "rope_type": "yarn", "factor": factor,
                 "beta_fast": bf, "beta_slow": bs,
                 "original_max_position_embeddings": orig,
                 "attention_factor": attn, "truncate": trunc}
+            if msa:
+                hf_cfg["rope_scaling"]["mscale_all_dim"] = msa
         else:
             hf_cfg["rope_scaling"] = {
                 "rope_type": "linear", "factor": cfg.rope_scaling[1]}

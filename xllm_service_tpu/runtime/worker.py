@@ -126,6 +126,7 @@ _MODEL_REGISTRY = {
     "mistral-7b-v01": ModelConfig.mistral_7b_v01,
     "gemma2-9b": ModelConfig.gemma2_9b,
     "deepseek-v2-lite": ModelConfig.deepseek_v2_lite,
+    "deepseek-v3": ModelConfig.deepseek_v3,
     "mixtral-8x7b": ModelConfig.mixtral_8x7b,
     "tiny-moe": lambda: ModelConfig.tiny(num_experts=4),
 }
