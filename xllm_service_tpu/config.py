@@ -185,6 +185,12 @@ class ModelConfig:
     moe_scoring: str = "softmax"        # or "sigmoid" (V3)
     rope_interleave: bool = True
     mla_yarn_mscale: bool = False
+    # GPT-OSS: per-head attention-sink logits, biased q/k/v/o, a
+    # post-top-k-softmax router with bias, and clamped-GLU experts
+    # ((up+1)·gate·sigmoid(1.702·gate), clamp ±7) with fused interleaved
+    # gate_up weights split at load. Alternating sliding layers reuse
+    # the layer_sliding machinery.
+    gptoss: bool = False
     # Sparse dispatch capacity factor (parallel/expert.py): each expert
     # takes ≤ ceil(k·G·cf/E) tokens per group. ≥ E/k guarantees no drops;
     # 0 selects the dense-compute oracle (every expert on every token).
@@ -353,6 +359,24 @@ class ModelConfig:
                    moe_scoring="sigmoid", mla_yarn_mscale=True)
 
     @classmethod
+    def gpt_oss_20b(cls) -> "ModelConfig":
+        # GPT-OSS-20B: 32-expert top-4 clamped-GLU MoE, attention sinks,
+        # alternating 128-token sliding layers, yarn long context.
+        return cls(name="gpt-oss-20b", vocab_size=201088,
+                   hidden_size=2880, intermediate_size=2880,
+                   moe_intermediate_size=2880, num_layers=24,
+                   num_heads=64, num_kv_heads=8, head_dim=64,
+                   rope_theta=150000.0, rms_norm_eps=1e-5,
+                   max_position_embeddings=131072,
+                   rope_scaling=("yarn", 32.0, 32.0, 1.0, 4096,
+                                 1.3465735902799727, False, 0.0),
+                   attention_bias=True, sliding_window=128,
+                   layer_sliding=tuple((i + 1) % 2 == 1
+                                       for i in range(24)),
+                   num_experts=32, num_experts_per_tok=4,
+                   norm_topk_prob=False, gptoss=True)
+
+    @classmethod
     def gemma2_9b(cls) -> "ModelConfig":
         # Gemma-2-9B: alternating local/global attention (W=4096 on even
         # layers), soft-caps, four-norm blocks, GeGLU, 256-dim heads.
@@ -396,7 +420,7 @@ class ModelConfig:
         mt = d.get("model_type", "llama")
         supported = ("llama", "mistral", "qwen2", "qwen3", "phi3",
                      "mixtral", "gemma2", "qwen2_vl", "qwen3_moe",
-                     "deepseek_v2", "deepseek_v3")
+                     "deepseek_v2", "deepseek_v3", "gpt_oss")
         _dsk = mt in ("deepseek_v2", "deepseek_v3")
         if _dsk:
             tkm = d.get("topk_method")
@@ -433,9 +457,9 @@ class ModelConfig:
             # flatten, keeping the outer model_type.
             d = {**d, **d.get("text_config", {}), "model_type": mt}
         layer_sliding = None
-        if mt == "gemma2":
-            # Alternating local/global layers: HF's layer_types (or its
-            # default pattern — sliding on even-indexed layers).
+        if mt in ("gemma2", "gpt_oss"):
+            # Alternating local/global layers: HF's layer_types (or the
+            # shared default pattern — sliding on even-indexed layers).
             L = d["num_hidden_layers"]
             lt = d.get("layer_types") or [
                 "sliding_attention" if (i + 1) % 2 else "full_attention"
@@ -496,7 +520,7 @@ class ModelConfig:
                                       mt == "gemma2"),
             attention_bias=d.get("attention_bias",
                                  d.get("model_type")
-                                 in ("qwen2", "qwen2_vl")),
+                                 in ("qwen2", "qwen2_vl", "gpt_oss")),
             qk_norm=d.get("model_type") in ("qwen3", "qwen3_moe"),
             fused_proj=d.get("model_type") == "phi3",
             sliding_window=sw,
@@ -536,6 +560,7 @@ class ModelConfig:
             first_k_dense_replace=(d.get("first_k_dense_replace", 0)
                                    if _dsk else 0),
             moe_scoring="sigmoid" if mt == "deepseek_v3" else "softmax",
+            gptoss=mt == "gpt_oss",
             rope_interleave=bool(d.get("rope_interleave", True)),
             mla_yarn_mscale=mt == "deepseek_v3",
             # HF defaults: Mixtral always normalizes top-k weights;

@@ -78,6 +78,9 @@ def init_params(cfg: ModelConfig, key: jax.Array,
     if cfg.gemma:
         layers["pre_ff_norm"] = jnp.ones((L, D), dtype)
         layers["post_ff_norm"] = jnp.ones((L, D), dtype)
+    if cfg.gptoss:
+        layers["sinks"] = jnp.zeros((L, Hq), jnp.float32)
+        layers["o_bias"] = jnp.zeros((L, D), dtype)
     if cfg.qk_norm:
         layers["q_norm"] = jnp.ones((L, Dh), dtype)
         layers["k_norm"] = jnp.ones((L, Dh), dtype)
@@ -88,6 +91,11 @@ def init_params(cfg: ModelConfig, key: jax.Array,
         layers["gate_proj"] = w((L, E, D, Fe), D)
         layers["up_proj"] = w((L, E, D, Fe), D)
         layers["down_proj"] = w((L, E, Fe, D), Fe)
+        if cfg.gptoss:
+            layers["router_bias"] = jnp.zeros((L, E), jnp.float32)
+            layers["gate_bias"] = jnp.zeros((L, E, Fe), dtype)
+            layers["up_bias"] = jnp.zeros((L, E, Fe), dtype)
+            layers["down_bias"] = jnp.zeros((L, E, D), dtype)
     else:
         layers["gate_proj"] = w((L, D, F), D)
         layers["up_proj"] = w((L, D, F), D)
@@ -135,6 +143,18 @@ def _use_prefill_kernel(window: int, page_size: int) -> bool:
 # scan as traced per-layer values (Gemma-2 alternation): larger than any
 # context, so the window mask is a no-op.
 _FULL_WINDOW = 1 << 30
+
+
+def _scatter_topk(vals: jnp.ndarray, idx: jnp.ndarray,
+                  num_classes: int) -> jnp.ndarray:
+    """Scatter per-token top-k ``vals`` [.., k] at expert ids ``idx``
+    [.., k] into a dense [.., E] map (k is tiny/static). The one shared
+    idiom behind every router's dense weight map."""
+    out = jnp.zeros(vals.shape[:-1] + (num_classes,), vals.dtype)
+    for j in range(vals.shape[-1]):
+        out = out + vals[..., j:j + 1] * jax.nn.one_hot(
+            idx[..., j], num_classes, dtype=vals.dtype)
+    return out
 
 
 def _attn_extras(cfg: ModelConfig) -> Dict[str, Any]:
@@ -207,6 +227,36 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
     the serving layer can surface drop pressure instead of degrading
     silently."""
     zero = jnp.zeros((), jnp.int32)
+    if cfg.gptoss:
+        # GPT-OSS router: top-k over BIASED LOGITS, softmax over just
+        # the selected k logits → dense weight map (sums to 1 on the
+        # chosen experts); clamped-GLU experts with biases.
+        logits = (x @ lp["router"]).astype(jnp.float32) + lp["router_bias"]
+        k = cfg.num_experts_per_tok
+        topv, topi = jax.lax.top_k(logits, k)
+        weights = _scatter_topk(jax.nn.softmax(topv, axis=-1), topi,
+                                logits.shape[-1])
+        if cfg.moe_capacity_factor > 0:
+            from xllm_service_tpu.parallel.expert import moe_mlp
+            return moe_mlp(
+                x, lp["router"], lp["gate_proj"], lp["up_proj"],
+                lp["down_proj"], k, cfg.moe_capacity_factor,
+                valid=valid, group_size=cfg.moe_group_size,
+                norm_topk=False, gates=weights, expert_style="gptoss",
+                gate_b=lp["gate_bias"], up_b=lp["up_bias"],
+                down_b=lp["down_bias"])
+        # Dense oracle: every expert on every token, weighted.
+        hg = jnp.einsum("btd,edf->btef", x, lp["gate_proj"]) \
+            + lp["gate_bias"][None, None]
+        hu = jnp.einsum("btd,edf->btef", x, lp["up_proj"]) \
+            + lp["up_bias"][None, None]
+        hg = jnp.clip(hg, None, 7.0)
+        hu = jnp.clip(hu, -7.0, 7.0)
+        h = (hu + 1.0) * (hg * jax.nn.sigmoid(1.702 * hg))
+        out = jnp.einsum("btef,efd->bted", h, lp["down_proj"]) \
+            + lp["down_bias"][None, None]
+        return jnp.einsum("bted,bte->btd", out,
+                          weights.astype(x.dtype)), zero
     if not cfg.is_moe:
         gate = x @ lp["gate_proj"]
         # Gemma gates with tanh-GELU (gelu_pytorch_tanh); llama-family
@@ -230,10 +280,7 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
     topv, topi = jax.lax.top_k(gates, cfg.num_experts_per_tok)   # [B,T,K]
     if cfg.norm_topk_prob:
         topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
-    weights = jnp.zeros_like(gates).at[
-        jnp.arange(gates.shape[0])[:, None, None],
-        jnp.arange(gates.shape[1])[None, :, None],
-        topi].set(topv)                                          # [B,T,E]
+    weights = _scatter_topk(topv, topi, gates.shape[-1])         # [B,T,E]
     h = jax.nn.silu(jnp.einsum("btd,edf->btef", x, lp["gate_proj"])) \
         * jnp.einsum("btd,edf->btef", x, lp["up_proj"])
     out = jnp.einsum("btef,efd->bted", h, lp["down_proj"])
@@ -340,8 +387,11 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             v_all = overlay_fresh_kv(gather_pages(vp, page_table), v,
                                      start_pos)
             attn = mha_prefill_auto(q, k_all, v_all, kv_lengths, start_pos,
-                                    sliding_window=w_l, **extras)
+                                    sliding_window=w_l,
+                                    sinks=lp.get("sinks"), **extras)
         a = attn.reshape(B, T, -1) @ lp["o_proj"]
+        if "o_bias" in lp:
+            a = a + lp["o_bias"]
         if cfg.gemma:
             # Gemma four-norm block: post-norms apply to the SUBLAYER
             # OUTPUT before the residual add.
@@ -429,14 +479,14 @@ def forward_prefill_ring(params: Params, cfg: ModelConfig,
     from xllm_service_tpu.parallel.mesh import AXIS_TP
     from xllm_service_tpu.parallel.ring import ring_attention_sharded
 
-    if cfg.sliding_window or cfg.gemma or cfg.mla:
+    if cfg.sliding_window or cfg.gemma or cfg.mla or cfg.gptoss:
         # Ring rotation assumes full causal reach and the plain llama
-        # layer body; SWA/Gemma/MLA long prompts take the chunked-window
-        # path (whose flash fold skips out-of-window chunks, so the
-        # work is O(T·W) there anyway).
+        # layer body; SWA/Gemma/MLA/GPT-OSS long prompts take the
+        # chunked-window path (whose flash fold skips out-of-window
+        # chunks, so the work is O(T·W) there anyway).
         raise NotImplementedError(
             "ring prefill implements neither sliding-window masks, the "
-            "gemma layer body, nor latent attention")
+            "gemma layer body, latent attention, nor attention sinks")
 
     k_pages, v_pages = kv
     B, T = tokens.shape
@@ -521,8 +571,11 @@ def forward_embedding(params: Params, cfg: ModelConfig,
         k = rope_for(cfg.rope_scaling, k, positions, cfg.rope_theta)
         attn = mha_prefill(q, k, v, lengths,
                            jnp.zeros((B,), jnp.int32),
-                           sliding_window=w_l, **extras)
+                           sliding_window=w_l,
+                           sinks=lp.get("sinks"), **extras)
         a = attn.reshape(B, T, -1) @ lp["o_proj"]
+        if "o_bias" in lp:
+            a = a + lp["o_bias"]
         if cfg.gemma:
             x = x + rms_norm(a, lp["post_norm"], cfg.rms_norm_eps)
             h = rms_norm(x, lp["pre_ff_norm"], cfg.rms_norm_eps)
@@ -601,9 +654,12 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         attn = paged_decode_attention_current_auto(
             q[:, 0], kp, vp, page_table, cache_lens,
             k[:, 0], v[:, 0],
-            sliding_window=w_l, **extras)                        # [B,Hq,Dh]
+            sliding_window=w_l, sinks=lp.get("sinks"),
+            **extras)                                            # [B,Hq,Dh]
         B = tokens.shape[0]
         a = attn.reshape(B, 1, -1) @ lp["o_proj"]
+        if "o_bias" in lp:
+            a = a + lp["o_bias"]
         if cfg.gemma:
             x = x + rms_norm(a, lp["post_norm"], cfg.rms_norm_eps)
             h = rms_norm(x, lp["pre_ff_norm"], cfg.rms_norm_eps)
@@ -748,9 +804,8 @@ def _deepseek_gate(cfg: ModelConfig, x: jnp.ndarray,
         choice = jnp.where(jnp.repeat(gmask, E // G, axis=-1) > 0,
                            choice, 0.0)
     _, topi = jax.lax.top_k(choice, cfg.num_experts_per_tok)
-    sel = jnp.zeros_like(scores)
-    for j in range(cfg.num_experts_per_tok):   # k is tiny/static
-        sel = sel + jax.nn.one_hot(topi[..., j], E, dtype=scores.dtype)
+    sel = _scatter_topk(
+        jnp.ones(topi.shape, scores.dtype), topi, E)
     # V3 combines with the RAW sigmoid scores (bias shapes choice only);
     # V2 combines with the masked selection values themselves.
     weights = (scores if sigmoid else choice) * sel

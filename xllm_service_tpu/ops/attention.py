@@ -189,7 +189,8 @@ def _group_heads(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
 def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 kv_lengths: jnp.ndarray, q_start: jnp.ndarray,
                 logits_soft_cap: float = 0.0,
-                sliding_window=0, scale=None) -> jnp.ndarray:
+                sliding_window=0, scale=None,
+                sinks=None) -> jnp.ndarray:
     """Causal GQA attention for prefill.
 
     q: [B, T, Hq, D] — the new tokens, at global positions q_start[b] + t.
@@ -218,7 +219,18 @@ def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if not _win_off(sliding_window):
         mask &= kv_pos[:, None, :] > q_pos[:, :, None] - sliding_window
     logits = jnp.where(mask[:, None, None, :, :], logits, _NEG_INF)
+    if sinks is not None:
+        # GPT-OSS attention sinks: one learned per-head logit joins the
+        # softmax denominator, then its probability is dropped — an
+        # always-on "null token" that soaks attention mass.
+        sk = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(Hkv, -1)[None, :, :, None,
+                                                       None],
+            logits.shape[:-1] + (1,))
+        logits = jnp.concatenate([logits, sk], axis=-1)
     p = jax.nn.softmax(logits, axis=-1)
+    if sinks is not None:
+        p = p[..., :-1]
     out = jnp.einsum("bhgts,bshd->bthgd", p.astype(v.dtype), v)
     return out.reshape(B, T, Hq, D)
 
@@ -260,7 +272,8 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         kv_lengths: jnp.ndarray, q_start: jnp.ndarray,
                         logits_soft_cap: float = 0.0,
                         chunk_size: int = 512,
-                        sliding_window=0, scale=None) -> jnp.ndarray:
+                        sliding_window=0, scale=None,
+                        sinks=None) -> jnp.ndarray:
     """Flash-style causal GQA prefill: O(T · chunk) logits memory.
 
     Same contract as ``mha_prefill`` but instead of materializing the full
@@ -279,7 +292,7 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     G = Hq // Hkv
     if S <= chunk_size:
         return mha_prefill(q, k, v, kv_lengths, q_start, logits_soft_cap,
-                           sliding_window, scale)
+                           sliding_window, scale, sinks)
 
     nC = (S + chunk_size - 1) // chunk_size
     pad = nC * chunk_size - S
@@ -304,8 +317,18 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     min_q_pos = jnp.min(q_pos[:, 0])
 
     o0 = jnp.zeros((B, T, Hkv, G, D), jnp.float32)
-    m0 = jnp.full((B, T, Hkv, G), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, T, Hkv, G), jnp.float32)
+    if sinks is None:
+        m0 = jnp.full((B, T, Hkv, G), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, T, Hkv, G), jnp.float32)
+    else:
+        # A sink IS a flash-accumulator seed: running max starts at the
+        # sink logit with denominator exp(sink - sink) = 1 and zero
+        # numerator — the online softmax then carries the sink's
+        # denominator share exactly.
+        m0 = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(Hkv, G)[None, None],
+            (B, T, Hkv, G))
+        l0 = jnp.ones((B, T, Hkv, G), jnp.float32)
 
     def fold(carry, idx):
         o, m, l = carry
@@ -340,7 +363,8 @@ def mha_prefill_chunked(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def mha_prefill_auto(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      kv_lengths: jnp.ndarray, q_start: jnp.ndarray,
                      logits_soft_cap: float = 0.0,
-                     sliding_window=0, scale=None) -> jnp.ndarray:
+                     sliding_window=0, scale=None,
+                     sinks=None) -> jnp.ndarray:
     """Trace-time dispatch for prefill attention, by SCORE-TENSOR BYTES
     (4·B·Hq·T·S), not sequence length alone: at the batched-prefill
     bench shape (B=64, T=128, S=512) an S-only cutoff picked the dense
@@ -355,13 +379,14 @@ def mha_prefill_auto(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     score_bytes = 4 * B * Hq * T * S
     if score_bytes <= 64 * 1024 * 1024:
         return mha_prefill(q, k, v, kv_lengths, q_start, logits_soft_cap,
-                           sliding_window, scale)
+                           sliding_window, scale, sinks)
     per_pos = 4 * B * Hq * T                 # score bytes per kv position
     chunk = (32 * 1024 * 1024) // max(per_pos, 1)
     chunk = max(128, min(1024, (chunk // 128) * 128))
     return mha_prefill_chunked(q, k, v, kv_lengths, q_start,
                                logits_soft_cap, chunk_size=chunk,
-                               sliding_window=sliding_window, scale=scale)
+                               sliding_window=sliding_window, scale=scale,
+                               sinks=sinks)
 
 
 def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
@@ -371,7 +396,7 @@ def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
                                    k_cur: jnp.ndarray, v_cur: jnp.ndarray,
                                    logits_soft_cap: float = 0.0,
                                    sliding_window=0,
-                                   scale=None) -> jnp.ndarray:
+                                   scale=None, sinks=None) -> jnp.ndarray:
     """Decode attention over the cache PLUS the current token's K/V held
     in-registers (XLA reference path).
 
@@ -408,7 +433,14 @@ def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
         in_cache &= pos > cache_lens[:, None] - sliding_window
     mask = in_cache | (pos == S1 - 1)
     logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
+    if sinks is not None:
+        sk = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(Hkv, -1)[None, :, :, None],
+            logits.shape[:-1] + (1,))
+        logits = jnp.concatenate([logits, sk], axis=-1)
     p = jax.nn.softmax(logits, axis=-1)
+    if sinks is not None:
+        p = p[..., :-1]
     out = jnp.einsum("bhgs,bshd->bhgd", p.astype(v.dtype), v)
     return out.reshape(B, Hq, D)
 
@@ -416,12 +448,14 @@ def paged_decode_attention_current(q: jnp.ndarray, k_pages: jnp.ndarray,
 def paged_decode_attention_current_auto(q, k_pages, v_pages, page_table,
                                         cache_lens, k_cur, v_cur,
                                         logits_soft_cap: float = 0.0,
-                                        sliding_window=0, scale=None):
+                                        sliding_window=0, scale=None,
+                                        sinks=None):
     """Trace-time dispatch for the current-token variant. The Pallas
-    kernels implement neither soft-cap, windowed masks, nor scale
-    overrides, so any of those routes to the XLA reference path."""
+    kernels implement neither soft-cap, windowed masks, scale overrides,
+    nor attention sinks, so any of those routes to the XLA reference
+    path."""
     if logits_soft_cap == 0.0 and _win_off(sliding_window) \
-            and scale is None:
+            and scale is None and sinks is None:
         from xllm_service_tpu.ops import pallas
         if pallas.enabled():
             return pallas.paged_decode_attention_pallas(
@@ -429,7 +463,7 @@ def paged_decode_attention_current_auto(q, k_pages, v_pages, page_table,
                 k_cur=k_cur, v_cur=v_cur)
     return paged_decode_attention_current(
         q, k_pages, v_pages, page_table, cache_lens, k_cur, v_cur,
-        logits_soft_cap, sliding_window, scale)
+        logits_soft_cap, sliding_window, scale, sinks)
 
 
 def paged_decode_attention_auto(q: jnp.ndarray, k_pages: jnp.ndarray,

@@ -101,7 +101,10 @@ def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, gate_w: jnp.ndarray,
             valid: jnp.ndarray = None,
             group_size: int = 512,
             norm_topk: bool = True,
-            gates: jnp.ndarray = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            gates: jnp.ndarray = None,
+            expert_style: str = "swiglu",
+            gate_b: jnp.ndarray = None, up_b: jnp.ndarray = None,
+            down_b: jnp.ndarray = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sparse SwiGLU MoE layer, group-chunked.
 
     x: [B, T, D]; router_w [D, E]; gate/up [E, D, F]; down [E, F, D];
@@ -144,9 +147,26 @@ def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, gate_w: jnp.ndarray,
         lambda g, v: topk_dispatch(g, k, cap, v, norm_topk))(gates, vg)
     de = dispatch.astype(x.dtype)                        # [g, G, E, C]
     x_e = jnp.einsum("gnd,gnec->gecd", xg, de)           # [g, E, C, D]
-    h = jax.nn.silu(jnp.einsum("gecd,edf->gecf", x_e, gate_w)) \
-        * jnp.einsum("gecd,edf->gecf", x_e, up_w)
+    hg = jnp.einsum("gecd,edf->gecf", x_e, gate_w)
+    hu = jnp.einsum("gecd,edf->gecf", x_e, up_w)
+    if gate_b is not None:
+        hg = hg + gate_b[None, :, None, :]
+    if up_b is not None:
+        hu = hu + up_b[None, :, None, :]
+    if expert_style == "gptoss":
+        # GPT-OSS clamped GLU: gate <= 7, up in [-7, 7],
+        # (up + 1) * gate * sigmoid(1.702 * gate).
+        hg = jnp.clip(hg, None, 7.0)
+        hu = jnp.clip(hu, -7.0, 7.0)
+        h = (hu + 1.0) * (hg * jax.nn.sigmoid(1.702 * hg))
+    else:
+        h = jax.nn.silu(hg) * hu
     y_e = jnp.einsum("gecf,efd->gecd", h, down_w)        # [g, E, C, D]
+    if down_b is not None:
+        # Per-expert output bias combines with the routing weight like
+        # the rest of the expert output (weights sum to the router's
+        # normalization, so the bias share rides the same combine).
+        y_e = y_e + down_b[None, :, None, :]
     out = jnp.einsum("gecd,gnec->gnd", y_e, combine.astype(x.dtype))
     out = out.reshape(-1, D)[:N].reshape(B, T, D)
     # Every valid token requests exactly k experts; whatever didn't land

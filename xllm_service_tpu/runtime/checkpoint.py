@@ -182,10 +182,45 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
         layers["q_bias"] = stack(A + "q_proj.bias")
         layers["k_bias"] = stack(A + "k_proj.bias")
         layers["v_bias"] = stack(A + "v_proj.bias")
+        if A.format(i=0) + "o_proj.bias" in r:
+            layers["o_bias"] = stack(A + "o_proj.bias")
+    if cfg.gptoss:
+        layers["sinks"] = np.stack([
+            r.get(A.format(i=i) + "sinks") for i in range(L)
+        ]).astype(np.float32)
     if cfg.qk_norm:
         layers["q_norm"] = stack(A + "q_norm.weight")
         layers["k_norm"] = stack(A + "k_norm.weight")
-    if cfg.is_moe:
+    if cfg.gptoss:
+        # GPT-OSS experts are STACKED tensors with fused interleaved
+        # gate_up columns (gate even, up odd) and per-expert biases;
+        # router carries a bias and no transpose-free layout quirks.
+        X = "model.layers.{i}.mlp."
+        layers["router"] = stack(X + "router.weight", transpose=True)
+        layers["router_bias"] = np.stack([
+            r.get(X.format(i=i) + "router.bias") for i in range(L)
+        ]).astype(np.float32)
+        gu, gub, dn, dnb = [], [], [], []
+        for i in range(L):
+            g_up = r.get(X.format(i=i) + "experts.gate_up_proj")
+            g_upb = r.get(X.format(i=i) + "experts.gate_up_proj_bias")
+            gu.append(g_up)
+            gub.append(g_upb)
+            dn.append(r.get(X.format(i=i) + "experts.down_proj"))
+            dnb.append(r.get(X.format(i=i) + "experts.down_proj_bias"))
+        g_up = np.stack(gu)                      # [L, E, D, 2F]
+        g_upb = np.stack(gub)                    # [L, E, 2F]
+        layers["gate_proj"] = np.ascontiguousarray(
+            g_up[..., 0::2]).astype(dtype)
+        layers["up_proj"] = np.ascontiguousarray(
+            g_up[..., 1::2]).astype(dtype)
+        layers["gate_bias"] = np.ascontiguousarray(
+            g_upb[..., 0::2]).astype(dtype)
+        layers["up_bias"] = np.ascontiguousarray(
+            g_upb[..., 1::2]).astype(dtype)
+        layers["down_proj"] = np.stack(dn).astype(dtype)   # [L, E, F, D]
+        layers["down_bias"] = np.stack(dnb).astype(dtype)  # [L, E, D]
+    elif cfg.is_moe:
         E = cfg.num_experts
         # Two expert-key dialects: Qwen3-MoE (mlp.experts.N.*_proj +
         # mlp.gate) vs Mixtral (block_sparse_moe.experts.N.w1/w3/w2 +
@@ -452,10 +487,11 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
     weights)."""
     from safetensors.numpy import save_file
 
-    if cfg.mla:
+    if cfg.mla or cfg.gptoss:
         raise NotImplementedError(
-            "save_checkpoint for MLA (DeepSeek-V2) trees is not "
-            "implemented — the absorbed kv_b split is one-way for now")
+            "save_checkpoint for MLA/GPT-OSS trees is not implemented — "
+            "the absorbed kv_b / interleaved gate_up splits are one-way "
+            "for now")
 
     os.makedirs(model_dir, exist_ok=True)
     get = lambda x: np.asarray(jax.device_get(x))  # noqa: E731

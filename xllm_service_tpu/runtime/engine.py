@@ -467,7 +467,7 @@ class Engine:
         the chunked-window path that does)."""
         return (self._jit_prefill_ring is not None and start == 0
                 and not self.cfg.sliding_window and not self.cfg.gemma
-                and not self.cfg.mla
+                and not self.cfg.mla and not self.cfg.gptoss
                 and seq.req.mm_embeds is None
                 and not seq.req.prompt_logprobs
                 and len(seq.tokens) > self.ecfg.prefill_buckets[-1]

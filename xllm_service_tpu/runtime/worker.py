@@ -127,6 +127,7 @@ _MODEL_REGISTRY = {
     "gemma2-9b": ModelConfig.gemma2_9b,
     "deepseek-v2-lite": ModelConfig.deepseek_v2_lite,
     "deepseek-v3": ModelConfig.deepseek_v3,
+    "gpt-oss-20b": ModelConfig.gpt_oss_20b,
     "mixtral-8x7b": ModelConfig.mixtral_8x7b,
     "tiny-moe": lambda: ModelConfig.tiny(num_experts=4),
 }
