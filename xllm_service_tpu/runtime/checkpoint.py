@@ -196,6 +196,12 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
         # gate_up columns (gate even, up odd) and per-expert biases;
         # router carries a bias and no transpose-free layout quirks.
         X = "model.layers.{i}.mlp."
+        if X.format(i=0) + "experts.gate_up_proj_blocks" in r:
+            raise NotImplementedError(
+                "this GPT-OSS checkpoint is MXFP4-quantized "
+                "(gate_up_proj_blocks/_scales) — dequantize to bf16 "
+                "safetensors first; the quantized block format is not "
+                "implemented")
         layers["router"] = stack(X + "router.weight", transpose=True)
         layers["router_bias"] = np.stack([
             r.get(X.format(i=i) + "router.bias") for i in range(L)
