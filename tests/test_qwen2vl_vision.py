@@ -431,3 +431,130 @@ def test_load_returns_none_for_text_checkpoint(tmp_path):
     m = transformers.Qwen2ForCausalLM(cfg).float().eval()
     m.save_pretrained(str(tmp_path), safe_serialization=True)
     assert load_qwen2vl_vision(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# Qwen2.5-VL tower variant
+# ---------------------------------------------------------------------------
+
+_VC25 = dict(depth=2, hidden_size=64, num_heads=4, intermediate_size=96,
+             out_hidden_size=48, in_channels=3, patch_size=4,
+             spatial_merge_size=2, temporal_patch_size=2, window_size=16,
+             fullatt_block_indexes=[1])
+
+
+def _make_hf_vlm25(seed: int = 0):
+    cfg = transformers.Qwen2_5_VLConfig(
+        vocab_size=256, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        vision_config=dict(_VC25), max_position_embeddings=512,
+        image_token_id=250, vision_start_token_id=249, video_token_id=248)
+    torch.manual_seed(seed)
+    return transformers.Qwen2_5_VLForConditionalGeneration(cfg) \
+        .float().eval()
+
+
+@pytest.mark.parametrize("grids", [
+    [(1, 8, 8)],                   # 2x2 full windows
+    [(1, 6, 4)],                   # ragged: lh=3 pads to 2x2 windows
+    [(1, 8, 8), (1, 4, 4)],        # two images
+])
+def test_qwen25vl_tower_matches_torch_oracle(tmp_path, grids):
+    """Qwen2.5-VL deltas — RMSNorm blocks, biased gated-SwiGLU MLPs,
+    WINDOW attention with full-attention exception layers, and the
+    merger-order restore — match HF's visual() exactly."""
+    from xllm_service_tpu.models.qwen2vl_vision import (
+        encode_patches_v25, window_order)
+
+    model = _make_hf_vlm25()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    loaded = load_qwen2vl_vision(str(tmp_path), image_size=32)
+    assert loaded is not None
+    vcfg, params = loaded
+    from xllm_service_tpu.models.qwen2vl_vision import Qwen25VLVisionConfig
+    assert isinstance(vcfg, Qwen25VLVisionConfig)
+    assert vcfg.fullatt_block_indexes == (1,)
+
+    S = sum(t * h * w for t, h, w in grids)
+    rng = np.random.default_rng(2)
+    patches = rng.standard_normal((S, vcfg.patch_dim)).astype(np.float32)
+    with torch.no_grad():
+        visual = model.model.visual if hasattr(model.model, "visual") \
+            else model.visual
+        ref = visual(torch.from_numpy(patches),
+                     grid_thw=torch.tensor(grids, dtype=torch.long)).numpy()
+
+    m2 = vcfg.spatial_merge_size ** 2
+    cos, sin = rotary_cos_sin(vcfg, grids)
+    seg_full = segment_ids(grids)
+    widx, seg_win = window_order(vcfg, grids)
+    perm = (widx[:, None] * m2
+            + np.arange(m2, dtype=np.int32)[None, :]).reshape(-1)
+    got = np.asarray(encode_patches_v25(
+        params, vcfg, jnp.asarray(patches[perm]), jnp.asarray(cos[perm]),
+        jnp.asarray(sin[perm]), jnp.asarray(seg_full[perm]),
+        jnp.asarray(seg_win), jnp.asarray(np.argsort(widx))))
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=5e-4)
+
+
+def test_qwen25vl_full_serving_e2e(tmp_path, monkeypatch):
+    """A genuine Qwen2.5-VL checkpoint (mrope text + window-attention
+    tower in one dir) serves an image chat end to end."""
+    from xllm_service_tpu.config import (
+        EngineConfig, InstanceType, LoadBalancePolicyType, ServiceOptions)
+    from xllm_service_tpu.runtime.worker import Worker, WorkerOptions
+    from xllm_service_tpu.service.coordination import InMemoryStore
+    from xllm_service_tpu.service.master import Master
+    from xllm_service_tpu.service.httpd import http_json
+    from tests.test_multimodal import wait_until
+
+    monkeypatch.setenv("XLLM_VISION_IMAGE_SIZE", "32")
+    torch.manual_seed(1)
+    cfg = transformers.Qwen2_5_VLConfig(
+        vocab_size=512, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        vision_config=dict(_VC25), max_position_embeddings=512,
+        rope_scaling={"type": "mrope", "mrope_section": [2, 2, 2]},
+        image_token_id=505, vision_start_token_id=504,
+        video_token_id=503)
+    transformers.Qwen2_5_VLForConditionalGeneration(cfg).float().eval() \
+        .save_pretrained(str(tmp_path), safe_serialization=True)
+
+    store = InMemoryStore(sweep_interval_s=0.02)
+    master = Master(ServiceOptions(
+        http_port=0, rpc_port=0, num_output_pools=4,
+        load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
+        block_size=16, heartbeat_interval_s=0.2,
+        master_upload_interval_s=0.2), store=store).start()
+    w = None
+    try:
+        w = Worker(WorkerOptions(
+            port=0, instance_type=InstanceType.DEFAULT,
+            service_addr=master.rpc_address, model="q25vl",
+            model_dir=str(tmp_path), heartbeat_interval_s=0.2,
+            lease_ttl_s=2.0), store,
+            engine_cfg=EngineConfig(
+                page_size=16, num_pages=64, max_model_len=256,
+                max_batch_size=4, max_prefill_tokens=256,
+                prefill_buckets=(64, 128))).start()
+        mgr = master.scheduler.instance_mgr
+        assert wait_until(lambda: len(mgr.prefill_instances()) == 1)
+        assert w.primary_runtime().model_cfg.is_mrope
+        status, resp = http_json(
+            "POST", master.http_address, "/v1/chat/completions",
+            {"model": "q25vl", "messages": [{
+                "role": "user", "content": [
+                    {"type": "text", "text": "Windowed: "},
+                    {"type": "image_url",
+                     "image_url": {"url": "random:3"}}]}],
+             "max_tokens": 4, "temperature": 0.0, "ignore_eos": True},
+            timeout=120.0)
+        assert status == 200, resp
+        assert resp["usage"]["completion_tokens"] == 4
+        assert w._vision is not None and w._vision[0] == "qwen25vl"
+    finally:
+        if w:
+            w.stop()
+        master.stop()
+        store.close()

@@ -419,8 +419,9 @@ class ModelConfig:
         silently-wrong tokens."""
         mt = d.get("model_type", "llama")
         supported = ("llama", "mistral", "qwen2", "qwen3", "phi3",
-                     "mixtral", "gemma2", "qwen2_vl", "qwen3_moe",
-                     "deepseek_v2", "deepseek_v3", "gpt_oss")
+                     "mixtral", "gemma2", "qwen2_vl", "qwen2_5_vl",
+                     "qwen3_moe", "deepseek_v2", "deepseek_v3",
+                     "gpt_oss")
         _dsk = mt in ("deepseek_v2", "deepseek_v3")
         if _dsk:
             tkm = d.get("topk_method")
@@ -451,7 +452,7 @@ class ModelConfig:
             raise ValueError(
                 f"unsupported model_type {mt!r} (supported: "
                 f"{', '.join(supported)})")
-        if mt == "qwen2_vl":
+        if mt in ("qwen2_vl", "qwen2_5_vl"):
             # Current transformers nests the text stack under
             # text_config (published checkpoints keep it top-level) —
             # flatten, keeping the outer model_type.
@@ -472,7 +473,8 @@ class ModelConfig:
         # the full-attention fast paths stay eligible.
         sw = d.get("sliding_window") or None
         if sw is not None \
-                and mt in ("qwen2", "qwen3", "qwen2_vl", "qwen3_moe") \
+                and mt in ("qwen2", "qwen3", "qwen2_vl", "qwen2_5_vl",
+                           "qwen3_moe") \
                 and not d.get("use_sliding_window", False):
             # Qwen2-family raw config.json declares-but-disables the
             # window (e.g. Qwen2.5-7B-Instruct-1M: sliding_window 32768,
@@ -520,7 +522,8 @@ class ModelConfig:
                                       mt == "gemma2"),
             attention_bias=d.get("attention_bias",
                                  d.get("model_type")
-                                 in ("qwen2", "qwen2_vl", "gpt_oss")),
+                                 in ("qwen2", "qwen2_vl", "qwen2_5_vl",
+                                     "gpt_oss")),
             qk_norm=d.get("model_type") in ("qwen3", "qwen3_moe"),
             fused_proj=d.get("model_type") == "phi3",
             sliding_window=sw,

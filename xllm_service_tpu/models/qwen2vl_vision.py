@@ -312,3 +312,178 @@ def encode_images_fixed_grid(params: Qwen2VLVisionParams,
             params, cfg, jnp.asarray(patches), jnp.asarray(cos),
             jnp.asarray(sin), jnp.asarray(seg)), np.float32))
     return np.stack(outs)
+
+
+# ---------------------------------------------------------------------------
+# Qwen2.5-VL vision tower (variant): RMSNorm blocks, biased gated-SwiGLU
+# MLPs, and WINDOW attention — merge-cells are reordered into
+# window_size//merge//patch square windows, every layer attends within
+# its window except the fullatt_block_indexes layers which attend across
+# the whole image; the merger output is restored to the original order.
+# (HF oracle: Qwen2_5_VisionTransformerPretrainedModel.)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Qwen25VLVisionConfig:
+    depth: int = 32
+    embed_dim: int = 1280            # vision_config.hidden_size
+    num_heads: int = 16
+    intermediate_size: int = 3420
+    patch_size: int = 14
+    temporal_patch_size: int = 2
+    spatial_merge_size: int = 2
+    in_channels: int = 3
+    hidden_size: int = 3584          # out_hidden_size (LLM width)
+    window_size: int = 112
+    fullatt_block_indexes: Tuple[int, ...] = (7, 15, 23, 31)
+    image_size: int = 224
+    dtype: str = "float32"
+
+    # Shared geometry with the 2-VL tower (same patch/merger layout).
+    head_dim = Qwen2VLVisionConfig.head_dim
+    patch_dim = Qwen2VLVisionConfig.patch_dim
+    grid_side = Qwen2VLVisionConfig.grid_side
+    tokens_per_image = Qwen2VLVisionConfig.tokens_per_image
+
+    @classmethod
+    def from_hf_config(cls, d: Dict[str, Any],
+                       image_size: int = 224) -> "Qwen25VLVisionConfig":
+        unit = d.get("patch_size", 14) * d.get("spatial_merge_size", 2)
+        if image_size <= 0 or image_size % unit != 0:
+            raise ValueError(
+                f"vision image_size {image_size} must be a positive "
+                f"multiple of patch_size*spatial_merge_size ({unit})")
+        return cls(
+            depth=d.get("depth", 32),
+            embed_dim=d.get("hidden_size", 1280),
+            num_heads=d.get("num_heads", 16),
+            intermediate_size=d.get("intermediate_size", 3420),
+            patch_size=d.get("patch_size", 14),
+            temporal_patch_size=d.get("temporal_patch_size", 2),
+            spatial_merge_size=d.get("spatial_merge_size", 2),
+            in_channels=d.get("in_channels", 3),
+            hidden_size=d.get("out_hidden_size", 3584),
+            window_size=d.get("window_size", 112),
+            fullatt_block_indexes=tuple(
+                d.get("fullatt_block_indexes", (7, 15, 23, 31))),
+            image_size=image_size,
+        )
+
+
+def window_order(cfg: Qwen25VLVisionConfig,
+                 grid_thw: Sequence[Tuple[int, int, int]]
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """(window_index [S/m²], window segment ids [S]) — HF
+    get_window_index: merge-cells regroup into vit_merger_window_size²
+    square windows (ragged edges keep partial windows); the returned
+    index permutes merge-cell blocks, the segment ids mark window
+    membership PER PATCH in the permuted order."""
+    m = cfg.spatial_merge_size
+    win = cfg.window_size // m // cfg.patch_size
+    order: List[np.ndarray] = []
+    seg: List[np.ndarray] = []
+    base = 0
+    wid = 0
+    for t, h, w in grid_thw:
+        lh, lw = h // m, w // m
+        idx = np.arange(t * lh * lw).reshape(t, lh, lw)
+        pad_h = (-lh) % win
+        pad_w = (-lw) % win
+        padded = np.pad(idx, ((0, 0), (0, pad_h), (0, pad_w)),
+                        constant_values=-100)
+        nh, nw = (lh + pad_h) // win, (lw + pad_w) // win
+        padded = padded.reshape(t, nh, win, nw, win) \
+            .transpose(0, 1, 3, 2, 4).reshape(t * nh * nw, win * win)
+        for row in padded:
+            cells = row[row != -100]
+            if cells.size:
+                order.append(cells + base)
+                seg.append(np.full(cells.size * m * m, wid, np.int32))
+                wid += 1
+        base += t * lh * lw
+    return (np.concatenate(order).astype(np.int32),
+            np.concatenate(seg))
+
+
+def encode_patches_v25(params: Qwen2VLVisionParams,
+                       cfg: Qwen25VLVisionConfig,
+                       patches: jnp.ndarray, cos: jnp.ndarray,
+                       sin: jnp.ndarray, seg_full: jnp.ndarray,
+                       seg_win: jnp.ndarray,
+                       reverse_index: jnp.ndarray) -> jnp.ndarray:
+    """patches/cos/sin/segments arrive ALREADY in window order (host
+    side reorders by ``window_order``); ``reverse_index`` restores the
+    merged rows at the end. Per-layer attention scope: window segments
+    except the fullatt_block_indexes layers (per-image segments)."""
+    from xllm_service_tpu.ops.norm import rms_norm
+
+    S = patches.shape[0]
+    H, Dh = cfg.num_heads, cfg.head_dim
+    dtype = jnp.dtype(cfg.dtype)
+    x = patches.astype(dtype) @ params["patch_embed"]
+    mask_full = (seg_full[:, None] == seg_full[None, :])
+    mask_win = (seg_win[:, None] == seg_win[None, :])
+    full_flags = jnp.asarray(
+        [i in cfg.fullatt_block_indexes for i in range(cfg.depth)])
+    cos_h = cos[:, None, :]
+    sin_h = sin[:, None, :]
+
+    def block(x, xs):
+        lp, is_full = xs
+        mask = jnp.where(is_full, mask_full, mask_win)
+        h = rms_norm(x, lp["norm1_w"], 1e-6)
+        qkv = (h @ lp["qkv_w"] + lp["qkv_b"]).reshape(S, 3, H, Dh)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
+        q = ((q32 * cos_h) + (_rotate_half(q32) * sin_h)).astype(q.dtype)
+        k = ((k32 * cos_h) + (_rotate_half(k32) * sin_h)).astype(k.dtype)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+        logits = jnp.einsum("shd,thd->hst", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(mask[None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("hst,thd->shd", p.astype(v.dtype), v)
+        x = x + attn.reshape(S, -1) @ lp["proj_w"] + lp["proj_b"]
+        h = rms_norm(x, lp["norm2_w"], 1e-6)
+        h = jax.nn.silu(h @ lp["gate_w"] + lp["gate_b"]) \
+            * (h @ lp["up_w"] + lp["up_b"])
+        x = x + (h @ lp["down_w"] + lp["down_b"])
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, (params["blocks"], full_flags))
+    mg = params["merger"]
+    x = rms_norm(x, mg["ln_q_w"], 1e-6)
+    x = x.reshape(S // cfg.spatial_merge_size ** 2, -1)
+    x = jax.nn.gelu(x @ mg["mlp0_w"] + mg["mlp0_b"], approximate=False)
+    x = x @ mg["mlp2_w"] + mg["mlp2_b"]
+    return x[reverse_index]
+
+
+def encode_images_fixed_grid_v25(params, cfg: Qwen25VLVisionConfig,
+                                 pixel_batch: np.ndarray,
+                                 jit_fn=None) -> np.ndarray:
+    """Serving entry for the 2.5 tower: one compiled fixed-grid program
+    per image, window machinery precomputed host-side."""
+    fn = jit_fn if jit_fn is not None else encode_patches_v25
+    m2 = cfg.spatial_merge_size ** 2
+    grid0 = None
+    cached = None
+    outs = []
+    for img in pixel_batch:
+        patches, grid = flatten_image(img, cfg)
+        if grid != grid0:
+            cos, sin = rotary_cos_sin(cfg, [grid])
+            seg_full = segment_ids([grid])
+            widx, seg_win = window_order(cfg, [grid])
+            # Patch-level permutation from the merge-cell permutation.
+            perm = (widx[:, None] * m2
+                    + np.arange(m2, dtype=np.int32)[None, :]).reshape(-1)
+            cached = (perm, cos[perm], sin[perm], seg_full[perm],
+                      seg_win, np.argsort(widx).astype(np.int32))
+            grid0 = grid
+        perm, cosp, sinp, segf, segw, rev = cached
+        outs.append(np.asarray(fn(
+            params, cfg, jnp.asarray(patches[perm]), jnp.asarray(cosp),
+            jnp.asarray(sinp), jnp.asarray(segf), jnp.asarray(segw),
+            jnp.asarray(rev)), np.float32))
+    return np.stack(outs)

@@ -407,6 +407,85 @@ def _load_mla_checkpoint(r, cfg: ModelConfig, dtype, mesh):
     return jax.tree_util.tree_map(jax.device_put, params)
 
 
+def _visual_reader(model_dir: str, depth: int, dtype):
+    """Shared scaffolding for both vision-tower loaders: open the shard
+    reader, resolve the visual key prefix (published "visual." vs module
+    path "model.visual."), and return (reader, get, stack) — or None when
+    the directory has no tower."""
+    r = _ShardedReader(model_dir)
+    prefix = "visual." if "visual.patch_embed.proj.weight" in r \
+        else "model.visual."
+    if prefix + "patch_embed.proj.weight" not in r:
+        r.close()
+        return None
+
+    def g(name: str) -> np.ndarray:
+        return r.get(prefix + name)
+
+    def stack(fmt: str, transpose: bool = False) -> np.ndarray:
+        rows = []
+        for i in range(depth):
+            t = g(fmt.format(i=i))
+            rows.append(np.ascontiguousarray(t.T) if transpose else t)
+        return np.stack(rows).astype(dtype)
+
+    return r, g, stack
+
+
+def _conv_patch_embed(g, dtype) -> np.ndarray:
+    """Conv3d with stride == kernel over pre-flattened patch rows IS a
+    matmul: flatten the kernel, transpose to [C·tp·P·P, D]."""
+    conv = g("patch_embed.proj.weight")            # [D, C, tp, P, P]
+    return np.ascontiguousarray(
+        conv.reshape(conv.shape[0], -1).T).astype(dtype)
+
+
+def _merger_tree(g, dtype, with_bias_norm: bool):
+    out = {
+        "ln_q_w": g("merger.ln_q.weight").astype(dtype),
+        "mlp0_w": np.ascontiguousarray(
+            g("merger.mlp.0.weight").T).astype(dtype),
+        "mlp0_b": g("merger.mlp.0.bias").astype(dtype),
+        "mlp2_w": np.ascontiguousarray(
+            g("merger.mlp.2.weight").T).astype(dtype),
+        "mlp2_b": g("merger.mlp.2.bias").astype(dtype),
+    }
+    if with_bias_norm:
+        out["ln_q_b"] = g("merger.ln_q.bias").astype(dtype)
+    return out
+
+
+def _load_qwen25vl_vision(model_dir: str, vcfg):
+    """Qwen2.5-VL tower tree (RMSNorm blocks, biased gated-SwiGLU MLPs,
+    window machinery lives in the encoder, not the weights)."""
+    dtype = _np_dtype(vcfg.dtype)
+    opened = _visual_reader(model_dir, vcfg.depth, dtype)
+    if opened is None:
+        return None
+    r, g, stack = opened
+    B = "blocks.{i}."
+    params = {
+        "patch_embed": _conv_patch_embed(g, dtype),
+        "blocks": {
+            "norm1_w": stack(B + "norm1.weight"),
+            "qkv_w": stack(B + "attn.qkv.weight", transpose=True),
+            "qkv_b": stack(B + "attn.qkv.bias"),
+            "proj_w": stack(B + "attn.proj.weight", transpose=True),
+            "proj_b": stack(B + "attn.proj.bias"),
+            "norm2_w": stack(B + "norm2.weight"),
+            "gate_w": stack(B + "mlp.gate_proj.weight", transpose=True),
+            "gate_b": stack(B + "mlp.gate_proj.bias"),
+            "up_w": stack(B + "mlp.up_proj.weight", transpose=True),
+            "up_b": stack(B + "mlp.up_proj.bias"),
+            "down_w": stack(B + "mlp.down_proj.weight", transpose=True),
+            "down_b": stack(B + "mlp.down_proj.bias"),
+        },
+        "merger": _merger_tree(g, dtype, with_bias_norm=False),
+    }
+    r.close()
+    return vcfg, jax.tree_util.tree_map(jax.device_put, params)
+
+
 def load_qwen2vl_vision(model_dir: str, vcfg=None,
                         image_size: int = 224):
     """Load a Qwen2-VL checkpoint's vision tower (``visual.*`` keys; the
@@ -428,35 +507,24 @@ def load_qwen2vl_vision(model_dir: str, vcfg=None,
             d = json.load(f)
         if "vision_config" not in d:
             return None
+        if d["vision_config"].get("model_type") == "qwen2_5_vl" \
+                or "fullatt_block_indexes" in d["vision_config"]:
+            from xllm_service_tpu.models.qwen2vl_vision import (
+                Qwen25VLVisionConfig)
+            vcfg = Qwen25VLVisionConfig.from_hf_config(
+                d["vision_config"], image_size=image_size)
+            return _load_qwen25vl_vision(model_dir, vcfg)
         vcfg = Qwen2VLVisionConfig.from_hf_config(
             d["vision_config"], image_size=image_size)
 
-    r = _ShardedReader(model_dir)
-    prefix = "visual." if "visual.patch_embed.proj.weight" in r \
-        else "model.visual."
-    if prefix + "patch_embed.proj.weight" not in r:
-        r.close()
-        return None
     dtype = _np_dtype(vcfg.dtype)
-    L = vcfg.depth
-
-    def g(name: str) -> np.ndarray:
-        return r.get(prefix + name)
-
-    def stack(fmt: str, transpose: bool = False) -> np.ndarray:
-        rows = []
-        for i in range(L):
-            t = g(fmt.format(i=i))
-            rows.append(np.ascontiguousarray(t.T) if transpose else t)
-        return np.stack(rows).astype(dtype)
-
+    opened = _visual_reader(model_dir, vcfg.depth, dtype)
+    if opened is None:
+        return None
+    r, g, stack = opened
     B = "blocks.{i}."
-    conv = g("patch_embed.proj.weight")            # [D, C, tp, P, P]
     params = {
-        # Conv3d with stride == kernel over pre-flattened patch rows is a
-        # plain matmul: flatten the kernel, transpose to [C·tp·P·P, D].
-        "patch_embed": np.ascontiguousarray(
-            conv.reshape(conv.shape[0], -1).T).astype(dtype),
+        "patch_embed": _conv_patch_embed(g, dtype),
         "blocks": {
             "norm1_w": stack(B + "norm1.weight"),
             "norm1_b": stack(B + "norm1.bias"),
@@ -471,16 +539,7 @@ def load_qwen2vl_vision(model_dir: str, vcfg=None,
             "fc2_w": stack(B + "mlp.fc2.weight", transpose=True),
             "fc2_b": stack(B + "mlp.fc2.bias"),
         },
-        "merger": {
-            "ln_q_w": g("merger.ln_q.weight").astype(dtype),
-            "ln_q_b": g("merger.ln_q.bias").astype(dtype),
-            "mlp0_w": np.ascontiguousarray(
-                g("merger.mlp.0.weight").T).astype(dtype),
-            "mlp0_b": g("merger.mlp.0.bias").astype(dtype),
-            "mlp2_w": np.ascontiguousarray(
-                g("merger.mlp.2.weight").T).astype(dtype),
-            "mlp2_b": g("merger.mlp.2.bias").astype(dtype),
-        },
+        "merger": _merger_tree(g, dtype, with_bias_norm=True),
     }
     r.close()
     return vcfg, jax.tree_util.tree_map(jax.device_put, params)

@@ -1437,12 +1437,29 @@ class Worker:
                         # params as a traced argument, NOT a closure —
                         # closed-over weights get baked into the program
                         # as constants (gigabytes at real tower sizes).
-                        fn = jax.jit(
-                            lambda p, patches, cos, sin, seg:
-                            _qv.encode_patches(p, vcfg, patches, cos,
-                                               sin, seg))
-                        self._vision = ("qwen2vl", vcfg,
-                                        (_qv, params, fn))
+                        if isinstance(vcfg, _qv.Qwen25VLVisionConfig):
+                            kind = "qwen25vl"
+                            fn = jax.jit(
+                                lambda p, patches, cos, sin, sf, sw, rev:
+                                _qv.encode_patches_v25(
+                                    p, vcfg, patches, cos, sin, sf, sw,
+                                    rev))
+                            entry = _qv.encode_images_fixed_grid_v25
+                        else:
+                            kind = "qwen2vl"
+                            fn = jax.jit(
+                                lambda p, patches, cos, sin, seg:
+                                _qv.encode_patches(p, vcfg, patches, cos,
+                                                   sin, seg))
+                            entry = _qv.encode_images_fixed_grid
+                        # One encode entry point regardless of variant:
+                        # encode_images just calls it.
+                        jit = fn
+                        self._vision = (
+                            kind, vcfg,
+                            _ft.partial(entry, params, vcfg,
+                                        jit_fn=lambda p, c, *a:
+                                        jit(p, *a)))
                         return self._vision
 
                 from xllm_service_tpu.models import vision as _vision
@@ -1465,11 +1482,8 @@ class Worker:
         t0 = time.monotonic()
         pixels = np.stack([load_image(m, vcfg.image_size)
                            for m in mm_inputs])
-        if kind == "qwen2vl":
-            _qv, params, jit_fn = fn
-            out = _qv.encode_images_fixed_grid(
-                params, vcfg, pixels,
-                jit_fn=lambda p, c, *a: jit_fn(p, *a))
+        if kind in ("qwen2vl", "qwen25vl"):
+            out = fn(pixels)
         else:
             out = np.asarray(fn(pixels), np.float32)
         self.encode_seconds += time.monotonic() - t0
