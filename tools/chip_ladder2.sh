@@ -1,0 +1,57 @@
+#!/bin/bash
+# Stage-2 chip watch: picks up after tools/chip_ladder.sh exhausts its 72
+# probes. Keeps probing (killable subprocess only) until DEADLINE_EPOCH,
+# runs the conviction queue on first contact, then a full bench.py —
+# and stops touching the chip entirely once within QUIET_S of the
+# deadline so the driver's end-of-round snapshot finds it healthy.
+set -u
+cd /root/repo
+DEADLINE_EPOCH="${DEADLINE_EPOCH:?set to round-end unix time}"
+QUIET_S="${QUIET_S:-4500}"       # leave the chip alone this long before end
+
+probe() {
+  timeout 90 python - <<'EOF' 2>/dev/null
+import subprocess, sys
+try:
+    p = subprocess.run([sys.executable, '-c',
+                        'import jax; print(jax.devices()[0].device_kind)'],
+                       capture_output=True, text=True, timeout=80)
+    print((p.stdout or '').strip())
+except Exception:
+    pass
+EOF
+}
+
+log() { echo "$(date -u +%H:%M:%S) $*" >> /root/repo/ladder.log; }
+
+while :; do
+  now=$(date +%s)
+  left=$((DEADLINE_EPOCH - now))
+  if [ "$left" -le "$QUIET_S" ]; then
+    log "stage2: inside quiet window ($left s left) - standing down"
+    exit 0
+  fi
+  out=$(probe)
+  log "stage2 probe: $out"
+  if echo "$out" | grep -q "TPU"; then
+    log "stage2: chip back with $left s left - running queue"
+    if [ "$left" -gt $((QUIET_S + 2400)) ]; then
+      python -m benchmarks.decode_budget --batch 64 --ctx 384 --prefill \
+          > /root/repo/decode_budget_r4.log 2>&1
+      log "stage2: budget done rc=$?"
+      python tools/kernel_compile_probes.py > /root/repo/kernel_probes_r4.log 2>&1
+      python tools/prefill_kernel_probe.py >> /root/repo/kernel_probes_r4.log 2>&1
+      python tools/donation_probe.py > /root/repo/donation_probe_r4.log 2>&1
+      log "stage2: probes done"
+    fi
+    now=$(date +%s); left=$((DEADLINE_EPOCH - now))
+    if [ "$left" -gt $((QUIET_S + 1800)) ]; then
+      BENCH_WATCHDOG_S=$((left - QUIET_S - 300)) python bench.py \
+          > /root/repo/bench_r4_tpu.log 2>&1
+      log "stage2: bench done rc=$? - chip idle for driver"
+    fi
+    log "stage2: LADDER DATA READY"
+    exit 0
+  fi
+  sleep 300
+done
