@@ -46,7 +46,7 @@ def _make_hf_model(kind: str):
                        "llama_sharded": 3, "qwen3": 4, "phi3": 5,
                        "mistral": 6, "mistral_v01": 7, "phi3_swa": 8,
                        "gemma2": 9, "qwen3_moe": 10,
-                       "qwen3_moe_raw": 11}[kind])
+                       "qwen3_moe_raw": 11, "gemma3": 13}[kind])
     if kind in ("llama3", "llama_sharded"):
         cfg = transformers.LlamaConfig(
             **_DIMS, rope_theta=500000.0, tie_word_embeddings=True,
@@ -98,6 +98,17 @@ def _make_hf_model(kind: str):
             **_DIMS, head_dim=16, rope_theta=10000.0, sliding_window=4,
             attn_implementation="eager")
         model = transformers.Gemma2ForCausalLM(cfg)
+    elif kind == "gemma3":
+        # Gemma-3: gemma2's body minus soft-caps, plus qk-norm (with the
+        # (1+w) convention), a 5:1 sliding:full layer pattern, and
+        # PER-LAYER rope — local layers rotate with their own base and
+        # no scaling; global layers use rope_theta + linear scaling.
+        cfg = transformers.Gemma3TextConfig(
+            **_DIMS, head_dim=16, sliding_window=4,
+            rope_theta=1000000.0, rope_local_base_freq=10000.0,
+            rope_scaling={"rope_type": "linear", "factor": 8.0},
+            attn_implementation="eager")
+        model = transformers.Gemma3ForCausalLM(cfg)
     elif kind == "mixtral":
         cfg = transformers.MixtralConfig(
             **_DIMS, num_local_experts=4, num_experts_per_tok=2,
@@ -143,8 +154,8 @@ def _our_all_logits(cfg, params, prompt):
 
 @pytest.mark.parametrize("kind", ["llama3", "qwen2", "qwen3", "phi3",
                                   "mistral", "mistral_v01", "phi3_swa",
-                                  "gemma2", "mixtral", "qwen3_moe",
-                                  "qwen3_moe_raw"])
+                                  "gemma2", "gemma3", "mixtral",
+                                  "qwen3_moe", "qwen3_moe_raw"])
 def test_logits_match_torch_oracle(tmp_path, kind):
     """Every prompt position's logits match the torch forward of the same
     HF-written weights (fp32, tight tolerance, argmax everywhere)."""
@@ -428,6 +439,40 @@ def test_engine_greedy_matches_hf_greedy_gemma2(tmp_path):
     assert got == ref
 
 
+def test_engine_greedy_matches_hf_greedy_gemma3(tmp_path):
+    """Engine decode with Gemma-3's 5:1 per-layer windows and per-layer
+    rope bases matches torch greedy past the W=4 window."""
+    model = _make_hf_model("gemma3")
+    _save(model, str(tmp_path))
+    cfg, params = _load_ours(str(tmp_path))
+    assert cfg.gemma and cfg.qk_norm
+    assert cfg.rope_local_base_freq == 10000.0
+
+    prompt = [12, 250, 3, 77, 8, 1]
+    steps = 12
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        for _ in range(steps):
+            nxt = model(ids).logits[0, -1].argmax()
+            ids = torch.cat([ids, nxt.view(1, 1)], dim=1)
+    ref = ids[0, len(prompt):].tolist()
+
+    eng = Engine(cfg, EngineConfig(
+        page_size=4, num_pages=64, max_model_len=128, max_batch_size=2,
+        max_prefill_tokens=64, prefill_buckets=(8, 16, 32, 64)), params=params)
+    eng.add_request(EngineRequest(
+        request_id="g3", token_ids=list(prompt),
+        sampling=SamplingParams(max_tokens=steps, temperature=0.0,
+                                ignore_eos=True)))
+    got = []
+    for _ in range(200):
+        if not eng.has_work():
+            break
+        for out in eng.step():
+            got.extend(out.new_token_ids)
+    assert got == ref
+
+
 def test_engine_greedy_matches_hf_greedy_sliding_window(tmp_path):
     """Engine decode over the paged cache applies the sliding-window mask
     exactly as torch does: greedy continuations match while the context
@@ -459,3 +504,25 @@ def test_engine_greedy_matches_hf_greedy_sliding_window(tmp_path):
         for out in eng.step():
             got.extend(out.new_token_ids)
     assert got == ref
+
+
+def test_forward_embedding_all_body_variants(tmp_path):
+    """forward_embedding must trace for every layer-body variant (the
+    scan-xs combinations: plain, per-layer windows, per-layer windows +
+    per-layer rope) — a packing/unpacking mismatch here broke every
+    /v1/embeddings call in review."""
+    from xllm_service_tpu.models.transformer import forward_embedding
+
+    for kind in ("llama3", "gemma2", "gemma3"):
+        model = _make_hf_model(kind)
+        path = os.path.join(str(tmp_path), kind)
+        _save(model, path)
+        cfg, params = _load_ours(path)
+        out = forward_embedding(
+            params, cfg, jnp.asarray([[3, 1, 4, 1, 5, 0, 0, 0]], jnp.int32),
+            jnp.asarray([5], jnp.int32))
+        arr = np.asarray(out)
+        assert arr.shape == (1, cfg.hidden_size)
+        assert np.isfinite(arr).all()
+        np.testing.assert_allclose(np.linalg.norm(arr, axis=-1), 1.0,
+                                   rtol=1e-5)

@@ -141,6 +141,10 @@ class ModelConfig:
     # (1 + weight) RMSNorm convention is normalized away at checkpoint
     # load (runtime/checkpoint.py adds 1; save subtracts it back).
     gemma: bool = False
+    # Gemma-3: sliding (local) layers rotate with their own rope base
+    # and WITHOUT the long-context scaling; full (global) layers use
+    # rope_theta + rope_scaling. None = single rope base everywhere.
+    rope_local_base_freq: Optional[float] = None
     # MoE (0 experts → dense MLP).
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -392,6 +396,24 @@ class ModelConfig:
                    query_pre_attn_scalar=256, gemma=True)
 
     @classmethod
+    def gemma3_12b(cls) -> "ModelConfig":
+        # Gemma-3-12B text stack: 5:1 local:global layers (W=1024),
+        # per-layer rope bases (local 10k unscaled, global 1M with 8x
+        # linear scaling), qk-norm, no soft-caps.
+        return cls(name="gemma3-12b", vocab_size=262208,
+                   hidden_size=3840, intermediate_size=15360,
+                   num_layers=48, num_heads=16, num_kv_heads=8,
+                   head_dim=256, rope_theta=1000000.0,
+                   rope_local_base_freq=10000.0, rms_norm_eps=1e-6,
+                   max_position_embeddings=131072,
+                   rope_scaling=("linear", 8.0, 0.0, 0.0, 0),
+                   tie_word_embeddings=True, qk_norm=True,
+                   sliding_window=1024,
+                   layer_sliding=tuple((i + 1) % 6 != 0
+                                       for i in range(48)),
+                   query_pre_attn_scalar=256, gemma=True)
+
+    @classmethod
     def mixtral_8x7b(cls) -> "ModelConfig":
         # Mixtral-8x7B: the expert-parallel flagship (parallel/expert.py
         # top-k dispatch; experts shard over the mesh's ep axis).
@@ -419,7 +441,8 @@ class ModelConfig:
         silently-wrong tokens."""
         mt = d.get("model_type", "llama")
         supported = ("llama", "mistral", "qwen2", "qwen3", "phi3",
-                     "mixtral", "gemma2", "qwen2_vl", "qwen2_5_vl",
+                     "mixtral", "gemma2", "gemma3", "gemma3_text",
+                     "qwen2_vl", "qwen2_5_vl",
                      "qwen3_moe", "deepseek_v2", "deepseek_v3",
                      "gpt_oss")
         _dsk = mt in ("deepseek_v2", "deepseek_v3")
@@ -452,19 +475,49 @@ class ModelConfig:
             raise ValueError(
                 f"unsupported model_type {mt!r} (supported: "
                 f"{', '.join(supported)})")
-        if mt in ("qwen2_vl", "qwen2_5_vl"):
+        if mt in ("qwen2_vl", "qwen2_5_vl", "gemma3"):
             # Current transformers nests the text stack under
             # text_config (published checkpoints keep it top-level) —
             # flatten, keeping the outer model_type.
             d = {**d, **d.get("text_config", {}), "model_type": mt}
+        if mt in ("gemma3", "gemma3_text"):
+            mt = "gemma3_text"
+            # transformers' to_diff_dict omits class-default keys, and
+            # Gemma3TextConfig's defaults differ from the generic HF
+            # fallbacks below (head_dim 256 ≠ hidden/heads, theta 1e6,
+            # 262k vocab, tied embeddings) — overlay them first so a
+            # diff-style config.json loads faithfully.
+            d = {**{"vocab_size": 262208, "head_dim": 256,
+                    "rope_theta": 1000000.0,
+                    "max_position_embeddings": 131072,
+                    "sliding_window": 4096, "rms_norm_eps": 1e-6,
+                    "tie_word_embeddings": True,
+                    "query_pre_attn_scalar": 256,
+                    "intermediate_size": d.get(
+                        "intermediate_size", 9216),
+                    "num_key_value_heads": d.get(
+                        "num_key_value_heads", 4)},
+                 **d, "model_type": mt}
+            rs_kind = (d.get("rope_scaling") or {}).get(
+                "rope_type", (d.get("rope_scaling") or {}).get("type"))
+            if rs_kind not in (None, "default", "linear"):
+                raise ValueError(
+                    f"gemma3 rope_scaling {rs_kind!r} is not implemented "
+                    f"(global layers support linear scaling only)")
         layer_sliding = None
-        if mt in ("gemma2", "gpt_oss"):
+        if mt in ("gemma2", "gemma3_text", "gpt_oss"):
             # Alternating local/global layers: HF's layer_types (or the
             # shared default pattern — sliding on even-indexed layers).
             L = d["num_hidden_layers"]
-            lt = d.get("layer_types") or [
-                "sliding_attention" if (i + 1) % 2 else "full_attention"
-                for i in range(L)]
+            if mt == "gemma3_text":
+                # Gemma-3 default pattern: every 6th layer is global.
+                lt = d.get("layer_types") or [
+                    "full_attention" if (i + 1) % 6 == 0
+                    else "sliding_attention" for i in range(L)]
+            else:
+                lt = d.get("layer_types") or [
+                    "sliding_attention" if (i + 1) % 2
+                    else "full_attention" for i in range(L)]
             layer_sliding = tuple(t == "sliding_attention" for t in lt)
         # sliding_window is honored for ANY supported model_type — real
         # Phi-3 checkpoints declare it too (Phi-3-mini-4k ships 2047), not
@@ -483,7 +536,14 @@ class ModelConfig:
             # torch normalizes it to None; so must we. Mistral/Phi-3
             # have no gate — a set window is always live there.
             sw = None
-        if sw is not None and sw >= d.get("max_position_embeddings", 4096):
+        if sw is not None \
+                and sw >= d.get("max_position_embeddings", 4096) \
+                and mt != "gemma3_text":
+            # An at-least-context-wide window never binds, so dropping
+            # it keeps full-attention fast paths eligible. Gemma-3 is
+            # EXEMPT: its sliding/full layer pattern also selects the
+            # per-layer rope base, which must survive even when the
+            # window itself is inert (the mask is harmless then).
             sw = None
         if sw is not None:
             # Qwen2-family per-layer windows: the first max_window_layers
@@ -524,7 +584,8 @@ class ModelConfig:
                                  d.get("model_type")
                                  in ("qwen2", "qwen2_vl", "qwen2_5_vl",
                                      "gpt_oss")),
-            qk_norm=d.get("model_type") in ("qwen3", "qwen3_moe"),
+            qk_norm=d.get("model_type") in ("qwen3", "qwen3_moe",
+                                            "gemma3_text"),
             fused_proj=d.get("model_type") == "phi3",
             sliding_window=sw,
             layer_sliding=layer_sliding,
@@ -540,8 +601,10 @@ class ModelConfig:
                 if mt == "gemma2" else 0.0),
             query_pre_attn_scalar=(
                 d.get("query_pre_attn_scalar", 256)
-                if mt == "gemma2" else None),
-            gemma=mt == "gemma2",
+                if mt in ("gemma2", "gemma3_text") else None),
+            gemma=mt in ("gemma2", "gemma3_text"),
+            rope_local_base_freq=(d.get("rope_local_base_freq", 10000.0)
+                                  if mt == "gemma3_text" else None),
             num_experts=(d.get("num_experts", 0) if mt == "qwen3_moe"
                          else d.get("n_routed_experts", 0) if _dsk
                          else d.get("num_local_experts", 0)),

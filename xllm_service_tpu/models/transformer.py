@@ -30,7 +30,9 @@ import jax.numpy as jnp
 
 from xllm_service_tpu.config import ModelConfig
 from xllm_service_tpu.ops.norm import rms_norm
-from xllm_service_tpu.ops.rope import apply_rope, rope_for
+from xllm_service_tpu.ops.rope import (apply_rope,
+                                       apply_rope_dynamic,
+                                       rope_for)
 from xllm_service_tpu.ops.attention import (
     mha_prefill,
     mha_prefill_auto,
@@ -174,6 +176,27 @@ def _layer_windows(cfg: ModelConfig) -> Optional[jnp.ndarray]:
     return jnp.asarray(
         [cfg.sliding_window if s else _FULL_WINDOW
          for s in cfg.layer_sliding], jnp.int32)
+
+
+def _layer_rope(cfg: ModelConfig) -> Optional[jnp.ndarray]:
+    """[L, 2] (theta, linear factor) per layer when rope bases differ by
+    layer type (Gemma-3): sliding layers use rope_local_base_freq
+    unscaled; full layers use rope_theta with the linear factor."""
+    if cfg.rope_local_base_freq is None:
+        return None
+    factor = (cfg.rope_scaling[1]
+              if cfg.rope_scaling is not None
+              and cfg.rope_scaling[0] == "linear" else 1.0)
+    pattern = cfg.layer_sliding
+    if pattern is None:
+        # Uniform models: an all-sliding pattern collapses to
+        # layer_sliding None + sliding_window set at config load — every
+        # layer is then LOCAL; no window at all means every layer is
+        # global.
+        pattern = (cfg.sliding_window is not None,) * cfg.num_layers
+    rows = [(cfg.rope_local_base_freq, 1.0) if s
+            else (cfg.rope_theta, factor) for s in pattern]
+    return jnp.asarray(rows, jnp.float32)
 
 
 def _scale_embed(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
@@ -353,19 +376,30 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  < lengths[:, None])                             # [B, T]
     extras = _attn_extras(cfg)
     win_arr = _layer_windows(cfg)
+    rope_arr = _layer_rope(cfg)
 
     def layer(x, xs):
-        if win_arr is not None:
+        ro = None
+        if win_arr is not None and rope_arr is not None:
+            lp, kp, vp, w_l, ro = xs
+        elif win_arr is not None:
             lp, kp, vp, w_l = xs
+        elif rope_arr is not None:
+            lp, kp, vp, ro = xs
+            w_l = cfg.sliding_window or 0
         else:
             lp, kp, vp = xs
             w_l = cfg.sliding_window or 0
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)
-        q = rope_for(cfg.rope_scaling, q, positions, cfg.rope_theta,
-                     positions3=rope_pos)
-        k = rope_for(cfg.rope_scaling, k, positions, cfg.rope_theta,
-                     positions3=rope_pos)
+        if ro is not None:
+            q = apply_rope_dynamic(q, positions, ro[0], ro[1])
+            k = apply_rope_dynamic(k, positions, ro[0], ro[1])
+        else:
+            q = rope_for(cfg.rope_scaling, q, positions, cfg.rope_theta,
+                         positions3=rope_pos)
+            k = rope_for(cfg.rope_scaling, k, positions, cfg.rope_theta,
+                         positions3=rope_pos)
         # Attend against cache (prefix-cache hits) + this step's fresh K/V.
         # The pool itself is NOT written here: emitting updated pools as
         # scan ys would rewrite the whole pool per call — the fresh rows
@@ -406,8 +440,14 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             x = x + m
         return x, (k, v, dropped)
 
-    xs = (params["layers"], k_pages, v_pages) if win_arr is None \
-        else (params["layers"], k_pages, v_pages, win_arr)
+    if win_arr is not None and rope_arr is not None:
+        xs = (params["layers"], k_pages, v_pages, win_arr, rope_arr)
+    elif win_arr is not None:
+        xs = (params["layers"], k_pages, v_pages, win_arr)
+    elif rope_arr is not None:
+        xs = (params["layers"], k_pages, v_pages, rope_arr)
+    else:
+        xs = (params["layers"], k_pages, v_pages)
     x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs)
     k_pages, v_pages = write_prefill_kv_all_layers(
         k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths)
@@ -558,17 +598,28 @@ def forward_embedding(params: Params, cfg: ModelConfig,
                  < lengths[:, None])                             # [B, T]
     extras = _attn_extras(cfg)
     win_arr = _layer_windows(cfg)
+    rope_arr = _layer_rope(cfg)
 
     def layer(x, xs):
-        if win_arr is not None:
+        ro = None
+        if win_arr is not None and rope_arr is not None:
+            lp, w_l, ro = xs
+        elif win_arr is not None:
             lp, w_l = xs
+        elif rope_arr is not None:
+            lp, ro = xs
+            w_l = cfg.sliding_window or 0
         else:
             lp = xs
             w_l = cfg.sliding_window or 0
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)
-        q = rope_for(cfg.rope_scaling, q, positions, cfg.rope_theta)
-        k = rope_for(cfg.rope_scaling, k, positions, cfg.rope_theta)
+        if ro is not None:
+            q = apply_rope_dynamic(q, positions, ro[0], ro[1])
+            k = apply_rope_dynamic(k, positions, ro[0], ro[1])
+        else:
+            q = rope_for(cfg.rope_scaling, q, positions, cfg.rope_theta)
+            k = rope_for(cfg.rope_scaling, k, positions, cfg.rope_theta)
         attn = mha_prefill(q, k, v, lengths,
                            jnp.zeros((B,), jnp.int32),
                            sliding_window=w_l,
@@ -587,8 +638,14 @@ def forward_embedding(params: Params, cfg: ModelConfig,
             x = x + _mlp(lp, cfg, h, valid=tok_valid)[0]
         return x, None
 
-    xs = params["layers"] if win_arr is None \
-        else (params["layers"], win_arr)
+    if win_arr is not None and rope_arr is not None:
+        xs = (params["layers"], win_arr, rope_arr)
+    elif win_arr is not None:
+        xs = (params["layers"], win_arr)
+    elif rope_arr is not None:
+        xs = (params["layers"], rope_arr)
+    else:
+        xs = params["layers"]
     x, _ = jax.lax.scan(layer, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps).astype(
         jnp.float32)
@@ -629,25 +686,36 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     cache_lens = jnp.where(active, positions, 0)   # tokens already written
     extras = _attn_extras(cfg)
     win_arr = _layer_windows(cfg)
+    rope_arr = _layer_rope(cfg)
 
     def layer(x, xs):
-        if win_arr is not None:
+        ro = None
+        if win_arr is not None and rope_arr is not None:
+            lp, kp, vp, w_l, ro = xs
+        elif win_arr is not None:
             lp, kp, vp, w_l = xs
+        elif rope_arr is not None:
+            lp, kp, vp, ro = xs
+            w_l = cfg.sliding_window or 0
         else:
             lp, kp, vp = xs
             w_l = cfg.sliding_window or 0
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)                               # [B,1,H,Dh]
         pos2 = positions[:, None]
-        rp3 = None
-        if rope_delta is not None:
-            rp3 = jnp.broadcast_to(
-                (positions + rope_delta)[:, None, None],
-                (positions.shape[0], 3, 1))
-        q = rope_for(cfg.rope_scaling, q, pos2, cfg.rope_theta,
-                     positions3=rp3)
-        k = rope_for(cfg.rope_scaling, k, pos2, cfg.rope_theta,
-                     positions3=rp3)
+        if ro is not None:
+            q = apply_rope_dynamic(q, pos2, ro[0], ro[1])
+            k = apply_rope_dynamic(k, pos2, ro[0], ro[1])
+        else:
+            rp3 = None
+            if rope_delta is not None:
+                rp3 = jnp.broadcast_to(
+                    (positions + rope_delta)[:, None, None],
+                    (positions.shape[0], 3, 1))
+            q = rope_for(cfg.rope_scaling, q, pos2, cfg.rope_theta,
+                         positions3=rp3)
+            k = rope_for(cfg.rope_scaling, k, pos2, cfg.rope_theta,
+                         positions3=rp3)
         # The current token's K/V stays in-registers for attention; the
         # pool write happens once for all layers after the scan (carrying
         # the pool as scan ys would rewrite the whole pool per step).
@@ -672,8 +740,14 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             x = x + m
         return x, (k[:, 0], v[:, 0], dropped)
 
-    xs = (params["layers"], k_pages, v_pages) if win_arr is None \
-        else (params["layers"], k_pages, v_pages, win_arr)
+    if win_arr is not None and rope_arr is not None:
+        xs = (params["layers"], k_pages, v_pages, win_arr, rope_arr)
+    elif win_arr is not None:
+        xs = (params["layers"], k_pages, v_pages, win_arr)
+    elif rope_arr is not None:
+        xs = (params["layers"], k_pages, v_pages, rope_arr)
+    else:
+        xs = (params["layers"], k_pages, v_pages)
     x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs)
     k_pages, v_pages = write_decode_kv_all_layers(
         k_pages, v_pages, k_new, v_new, page_table, positions, active)

@@ -164,6 +164,20 @@ def apply_mrope(x: jnp.ndarray, positions3: jnp.ndarray, theta: float,
                    jnp.concatenate(parts_s, -1))
 
 
+def apply_rope_dynamic(x: jnp.ndarray, positions: jnp.ndarray,
+                       theta, factor) -> jnp.ndarray:
+    """Half-rotation rope where ``theta`` and ``factor`` (linear
+    position-interpolation divisor) may be TRACED per-layer scalars —
+    Gemma-3's local layers rotate with their own base and no scaling
+    while global layers use the long-context base, selected per layer
+    inside the scan."""
+    head_dim = x.shape[-1]
+    half = head_dim // 2
+    inv = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * (inv / factor)
+    return _rotate(x, jnp.cos(angles), jnp.sin(angles))
+
+
 def rope_for(cfg_scaling, x: jnp.ndarray, positions: jnp.ndarray,
              theta: float, positions3: Optional[jnp.ndarray] = None
              ) -> jnp.ndarray:

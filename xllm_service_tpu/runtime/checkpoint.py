@@ -189,8 +189,10 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
             r.get(A.format(i=i) + "sinks") for i in range(L)
         ]).astype(np.float32)
     if cfg.qk_norm:
-        layers["q_norm"] = stack(A + "q_norm.weight")
-        layers["k_norm"] = stack(A + "k_norm.weight")
+        # stack_norm folds Gemma's (1 + w) convention (Gemma-3 qk-norm);
+        # a plain stack for qwen3 (stack_norm is identity without gemma).
+        layers["q_norm"] = stack_norm(A + "q_norm.weight")
+        layers["k_norm"] = stack_norm(A + "k_norm.weight")
     if cfg.gptoss:
         # GPT-OSS experts are STACKED tensors with fused interleaved
         # gate_up columns (gate even, up odd) and per-expert biases;
@@ -602,8 +604,8 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
                     out[A + nm + ".bias"] = get(
                         lp[nm.replace("proj", "bias")][i])
         if "q_norm" in lp:
-            out[A + "q_norm.weight"] = get(lp["q_norm"][i])
-            out[A + "k_norm.weight"] = get(lp["k_norm"][i])
+            out[A + "q_norm.weight"] = get_norm(lp["q_norm"][i])
+            out[A + "k_norm.weight"] = get_norm(lp["k_norm"][i])
         if cfg.is_moe:
             X = (f"model.layers.{i}.mlp." if cfg.qwen_moe
                  else f"model.layers.{i}.block_sparse_moe.")

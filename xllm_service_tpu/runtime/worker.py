@@ -125,6 +125,7 @@ _MODEL_REGISTRY = {
     "mistral-7b": ModelConfig.mistral_7b,
     "mistral-7b-v01": ModelConfig.mistral_7b_v01,
     "gemma2-9b": ModelConfig.gemma2_9b,
+    "gemma3-12b": ModelConfig.gemma3_12b,
     "deepseek-v2-lite": ModelConfig.deepseek_v2_lite,
     "deepseek-v3": ModelConfig.deepseek_v3,
     "gpt-oss-20b": ModelConfig.gpt_oss_20b,
