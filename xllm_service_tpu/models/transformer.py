@@ -1,8 +1,13 @@
 """Functional decoder-only transformer over a paged KV cache.
 
-One implementation serves every dense family the reference stack deploys
-(Llama-2/3, TinyLlama, Qwen-2/2.5 — see ``ModelConfig``) plus Mixtral-style
-MoE. Design choices are TPU-first (SURVEY.md §7.1):
+One scanned layer body serves every family in ``docs/MODELS.md`` —
+Llama-2/3.x, Qwen2/2.5/3 (+Qwen3-MoE), Phi-3, Mistral (v0.1 sliding
+window and v0.2+), Gemma-2/3 (four-norm blocks, soft-caps, per-layer
+windows and rope bases as traced scan xs), Mixtral, GPT-OSS (attention
+sinks, clamped-GLU experts), the Qwen2/2.5-VL mrope text stacks — plus
+a dedicated multi-head-latent-attention path (DeepSeek-V2/V3/R1) that
+serves a latent pool through the same paged machinery. Design choices
+are TPU-first (SURVEY.md §7.1):
 
 - **Stacked layers + ``lax.scan``**: every per-layer weight carries a leading
   ``[L, ...]`` axis and the layer body is traced once, so compile time and
