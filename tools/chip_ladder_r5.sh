@@ -1,0 +1,69 @@
+#!/bin/bash
+# Round-5 chip watch. Probes the tunneled TPU every 5 min in a killable
+# subprocess; on first contact runs every line of tools/chip_queue_r5.txt
+# sequentially (the conviction ladder — the queue file is editable all
+# round, so probes built mid-round get picked up), then a watchdogged
+# bench.py, then stands down. Also stands down unconditionally once
+# within QUIET_S of DEADLINE_EPOCH so the driver's end-of-round snapshot
+# finds the chip idle.
+set -u
+cd /root/repo
+DEADLINE_EPOCH="${DEADLINE_EPOCH:?set to round-end unix time}"
+QUIET_S="${QUIET_S:-4500}"
+
+probe() {
+  timeout 90 python - <<'EOF' 2>/dev/null
+import subprocess, sys
+try:
+    p = subprocess.run([sys.executable, '-c',
+                        'import jax; print(jax.devices()[0].device_kind)'],
+                       capture_output=True, text=True, timeout=80)
+    print((p.stdout or '').strip())
+except Exception:
+    pass
+EOF
+}
+
+log() { echo "$(date -u +%H:%M:%S) $*" >> /root/repo/ladder.log; }
+
+log "r5 watcher armed (deadline=$DEADLINE_EPOCH quiet=$QUIET_S)"
+while :; do
+  now=$(date +%s)
+  left=$((DEADLINE_EPOCH - now))
+  if [ "$left" -le "$QUIET_S" ]; then
+    log "r5: inside quiet window ($left s left) - standing down"
+    exit 0
+  fi
+  out=$(probe)
+  log "r5 probe: $out"
+  if echo "$out" | grep -q "TPU"; then
+    log "r5: CHIP CONTACT with $left s left - running queue"
+    touch /root/repo/.chip_contact_r5
+    if [ "$left" -gt $((QUIET_S + 2400)) ] && [ -f tools/chip_queue_r5.txt ]; then
+      n=0
+      while IFS= read -r cmd; do
+        case "$cmd" in ''|'#'*) continue;; esac
+        n=$((n + 1))
+        now=$(date +%s); left=$((DEADLINE_EPOCH - now))
+        if [ "$left" -le $((QUIET_S + 2100)) ]; then
+          log "r5: queue item $n skipped (only $left s left)"
+          continue
+        fi
+        log "r5: queue[$n] START: $cmd"
+        bash -c "$cmd" >> /root/repo/chip_queue_r5.log 2>&1
+        log "r5: queue[$n] rc=$?"
+      done < tools/chip_queue_r5.txt
+    fi
+    now=$(date +%s); left=$((DEADLINE_EPOCH - now))
+    if [ "$left" -gt $((QUIET_S + 1800)) ]; then
+      BENCH_WATCHDOG_S=$((left - QUIET_S - 600)) python bench.py \
+          > /root/repo/bench_r5_tpu.log 2>&1
+      log "r5: bench done rc=$? - chip idle for driver"
+    else
+      log "r5: no time for bench (left=$left)"
+    fi
+    log "r5: LADDER DATA READY"
+    exit 0
+  fi
+  sleep 300
+done
