@@ -209,3 +209,36 @@ def test_mla_engine_greedy_matches_hf(tmp_path):
         for out in eng.step():
             got.extend(out.new_token_ids)
     assert got == ref
+
+
+def test_mla_decode_kernel_gate_matches_reference(tmp_path, monkeypatch):
+    """XLLM_PALLAS_MLA=1 routes absorbed-MLA decode through the paged
+    decode kernel (Pallas interpreter on CPU) — greedy tokens must equal
+    the default XLA-reference serving path."""
+    model = _make_hf("lite")
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    cfg, params = _load_ours(str(tmp_path))
+
+    prompt = [12, 250, 3, 77, 8, 1]
+    steps = 8
+
+    def run(kernel: bool):
+        monkeypatch.setenv("XLLM_PALLAS", "1" if kernel else "0")
+        monkeypatch.setenv("XLLM_PALLAS_MLA", "1" if kernel else "0")
+        eng = Engine(cfg, EngineConfig(
+            page_size=4, num_pages=64, max_model_len=128,
+            max_batch_size=2, max_prefill_tokens=64,
+            prefill_buckets=(8, 16, 32, 64)), params=params)
+        eng.add_request(EngineRequest(
+            request_id="mla", token_ids=list(prompt),
+            sampling=SamplingParams(max_tokens=steps, temperature=0.0,
+                                    ignore_eos=True)))
+        got = []
+        for _ in range(100):
+            if not eng.has_work():
+                break
+            for out in eng.step():
+                got.extend(out.new_token_ids)
+        return got
+
+    assert run(kernel=True) == run(kernel=False)

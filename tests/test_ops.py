@@ -375,6 +375,93 @@ class TestPallasPagedAttention:
                                             interpret=True)
         assert jnp.allclose(ref, out, atol=1e-5)
 
+    def test_model_deltas_match_reference(self):
+        """Sliding window (static and traced), Gemma soft-cap + scale
+        override, and GPT-OSS sinks in the V1 kernel vs the XLA
+        reference paths — the SWA-families-on-the-kernel-path surface
+        (round-4 verdict item 3)."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from xllm_service_tpu.ops.attention import (
+            paged_decode_attention, paged_decode_attention_current)
+        from xllm_service_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_pallas)
+
+        rng = np.random.default_rng(21)
+        B, Hq, Hkv, D, P, ps, MP = 3, 8, 2, 32, 16, 8, 6
+        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        pt = jnp.asarray(rng.integers(1, P, size=(B, MP)), jnp.int32)
+        ctx = jnp.asarray([13, 1, MP * ps], jnp.int32)
+        kc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+        sinks = jnp.asarray(rng.normal(size=(Hq,)), jnp.float32)
+
+        cases = [
+            dict(sliding_window=5),
+            dict(sliding_window=jnp.int32(5)),      # traced per-layer form
+            dict(sliding_window=1),                 # degenerate W=1
+            dict(logits_soft_cap=20.0),
+            dict(scale=0.17),
+            dict(sinks=sinks),
+            dict(sliding_window=7, logits_soft_cap=30.0, scale=0.2),
+            dict(sliding_window=4, sinks=sinks),    # GPT-OSS shape
+        ]
+        for extras in cases:
+            ref = paged_decode_attention_current(
+                q, k, v, pt, ctx, kc, vc,
+                extras.get("logits_soft_cap", 0.0),
+                extras.get("sliding_window", 0),
+                extras.get("scale"), extras.get("sinks"))
+            out = paged_decode_attention_pallas(
+                q, k, v, pt, ctx, kc, vc, interpret=True, **extras)
+            assert jnp.allclose(ref, out, atol=1e-5), (
+                extras, float(jnp.max(jnp.abs(ref - out))))
+            if "sinks" not in extras:
+                ref2 = paged_decode_attention(
+                    q, k, v, pt, ctx,
+                    extras.get("logits_soft_cap", 0.0),
+                    extras.get("sliding_window", 0),
+                    extras.get("scale"))
+                out2 = paged_decode_attention_pallas(
+                    q, k, v, pt, ctx, interpret=True, **extras)
+                assert jnp.allclose(ref2, out2, atol=1e-5), (
+                    extras, float(jnp.max(jnp.abs(ref2 - out2))))
+
+    def test_window_with_trimmed_null_pages(self):
+        """O(W) page trimming leaves leading NULL entries in the table;
+        the windowed kernel must never read their (stale page-0) bytes
+        into live lanes."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from xllm_service_tpu.ops.attention import (
+            paged_decode_attention_current)
+        from xllm_service_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_pallas)
+
+        rng = np.random.default_rng(22)
+        B, Hq, Hkv, D, P, ps, MP = 2, 4, 2, 16, 8, 4, 5
+        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
+        # Page 0 holds garbage that must stay masked.
+        k = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)) * 50, jnp.float32)
+        v = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)) * 50, jnp.float32)
+        W = 6
+        # ctx=17: positions < 17-6=11 are trimmable → pages 0,1 freed
+        # (positions 0..7), entries NULLed. Window spans pages 2..4.
+        pt = jnp.asarray([[0, 0, 3, 4, 5], [0, 0, 6, 7, 1]], jnp.int32)
+        ctx = jnp.asarray([17, 18], jnp.int32)
+        kc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+        ref = paged_decode_attention_current(
+            q, k, v, pt, ctx, kc, vc, sliding_window=W)
+        out = paged_decode_attention_pallas(
+            q, k, v, pt, ctx, kc, vc, sliding_window=W, interpret=True)
+        assert jnp.allclose(ref, out, atol=1e-5), \
+            float(jnp.max(jnp.abs(ref - out)))
+
     def test_multirow_kernel_matches_reference(self):
         """Multi-row kernel (XLLM_PALLAS_DECODE_V4: RB rows per grid
         cell via RB pipelined page streams) vs the XLA reference —

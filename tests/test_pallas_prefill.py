@@ -11,14 +11,18 @@ from xllm_service_tpu.ops.pallas.prefill_attention import (
     paged_prefill_attention_pallas)
 
 
-def _reference(q, k_fresh, v_fresh, k_pages, v_pages, pt, q_start, lengths):
+def _reference(q, k_fresh, v_fresh, k_pages, v_pages, pt, q_start, lengths,
+               **extras):
     k_all = overlay_fresh_kv(gather_pages(k_pages, pt), k_fresh, q_start)
     v_all = overlay_fresh_kv(gather_pages(v_pages, pt), v_fresh, q_start)
-    return mha_prefill(q, k_all, v_all, q_start + lengths, q_start)
+    return mha_prefill(q, k_all, v_all, q_start + lengths, q_start,
+                       extras.get("logits_soft_cap", 0.0),
+                       extras.get("sliding_window", 0),
+                       extras.get("scale"), extras.get("sinks"))
 
 
 def _case(seed, B, T, Hq, Hkv, D, P, ps, MP, q_starts, lengths,
-          q_block=128):
+          q_block=128, **extras):
     rng = np.random.default_rng(seed)
     q = jnp.asarray(rng.normal(size=(B, T, Hq, D)), jnp.float32)
     kf = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
@@ -31,10 +35,10 @@ def _case(seed, B, T, Hq, Hkv, D, P, ps, MP, q_starts, lengths,
     q_start = jnp.asarray(q_starts, jnp.int32)
     lens = jnp.asarray(lengths, jnp.int32)
 
-    ref = _reference(q, kf, vf, kp, vp, pt, q_start, lens)
+    ref = _reference(q, kf, vf, kp, vp, pt, q_start, lens, **extras)
     out = paged_prefill_attention_pallas(
         q, kf, vf, kp, vp, pt, q_start, lens, q_block=q_block,
-        interpret=True)
+        interpret=True, **extras)
     # Compare only valid rows: padded rows (t >= length) are unspecified
     # by the kernel contract (the engine never reads them).
     for b in range(ref.shape[0]):
@@ -73,6 +77,58 @@ class TestPallasPrefill:
         with pytest.raises(ValueError):
             _case(4, B=1, T=24, Hq=4, Hkv=2, D=16, P=8, ps=16, MP=2,
                   q_starts=[0], lengths=[24])
+
+
+class TestPallasPrefillModelDeltas:
+    """Windows / soft-cap / scale / sinks in the prefill kernel vs the
+    XLA reference — the surface that lets Gemma-2/3, GPT-OSS, Phi-3, and
+    Mistral-v0.1 ride the kernel path (round-4 verdict item 3)."""
+
+    def test_static_sliding_window(self):
+        # Window smaller than the fresh window AND the cached prefix:
+        # pool steps below the window must be excluded.
+        _case(10, B=3, T=32, Hq=8, Hkv=2, D=32, P=32, ps=16, MP=6,
+              q_starts=[16, 48, 0], lengths=[32, 16, 32], q_block=16,
+              sliding_window=9)
+
+    def test_traced_sliding_window(self):
+        # The per-layer scan passes a traced int32 scalar.
+        _case(11, B=2, T=32, Hq=8, Hkv=2, D=32, P=16, ps=16, MP=4,
+              q_starts=[16, 0], lengths=[32, 20], q_block=16,
+              sliding_window=jnp.int32(5))
+
+    def test_window_one_degenerate(self):
+        # W=1: every query attends only to itself.
+        _case(12, B=2, T=16, Hq=4, Hkv=2, D=16, P=8, ps=16, MP=2,
+              q_starts=[16, 0], lengths=[16, 7], q_block=16,
+              sliding_window=1)
+
+    def test_full_window_sentinel_is_noop(self):
+        # A larger-than-any-context window (the sentinel full-attention
+        # layers of a per-layer mix carry through the scan) must equal
+        # no window at all.
+        from xllm_service_tpu.models.transformer import _FULL_WINDOW
+        _case(16, B=2, T=32, Hq=8, Hkv=2, D=32, P=16, ps=16, MP=4,
+              q_starts=[16, 0], lengths=[32, 20], q_block=16,
+              sliding_window=jnp.int32(_FULL_WINDOW))
+
+    def test_soft_cap_and_scale(self):
+        _case(13, B=2, T=32, Hq=8, Hkv=2, D=32, P=16, ps=16, MP=4,
+              q_starts=[16, 0], lengths=[32, 11], q_block=16,
+              logits_soft_cap=25.0, scale=0.21)
+
+    def test_sinks(self):
+        rng = np.random.default_rng(14)
+        _case(14, B=2, T=32, Hq=8, Hkv=2, D=32, P=16, ps=16, MP=4,
+              q_starts=[16, 0], lengths=[32, 3], q_block=16,
+              sinks=jnp.asarray(rng.normal(size=(8,)), jnp.float32))
+
+    def test_gptoss_shape_window_plus_sinks(self):
+        rng = np.random.default_rng(15)
+        _case(15, B=2, T=32, Hq=8, Hkv=2, D=32, P=16, ps=16, MP=4,
+              q_starts=[32, 0], lengths=[32, 32], q_block=16,
+              sliding_window=6,
+              sinks=jnp.asarray(rng.normal(size=(8,)), jnp.float32))
 
 
 class TestPromptLogprobs:
@@ -165,3 +221,90 @@ class TestEnginePrefillKernelPath:
         assert set(xla) == set(pallas)
         for rid in xla:
             assert xla[rid] == pallas[rid], rid
+
+
+class TestEngineSWAKernelPath:
+    """SWA families end-to-end through the kernel path: same engine, same
+    prompts, greedy tokens identical between the XLA gather path and the
+    Pallas prefill+decode kernels (interpreter on CPU). Before round 5
+    these models were trace-time-bypassed to the gather path."""
+
+    def _ab(self, monkeypatch, cfg, seed=0):
+        import dataclasses as _dc
+
+        import jax
+
+        from xllm_service_tpu.config import EngineConfig
+        from xllm_service_tpu.models import transformer
+        from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+        from xllm_service_tpu.utils.types import SamplingParams
+
+        cfg = _dc.replace(cfg, dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(seed))
+        if "sinks" in params["layers"]:
+            # Nonzero sinks so the sink fold is genuinely exercised.
+            params["layers"]["sinks"] = 0.5 + 0.1 * jnp.arange(
+                params["layers"]["sinks"].size, dtype=jnp.float32
+            ).reshape(params["layers"]["sinks"].shape)
+        ecfg = EngineConfig(page_size=16, num_pages=64, max_model_len=256,
+                            max_batch_size=4, max_prefill_tokens=128,
+                            prefill_buckets=(16, 32, 64))
+        prompts = [list(range(1, 49)), [7, 9, 11] * 8, list(range(3, 20))]
+        sp = SamplingParams(max_tokens=24, temperature=0.0,
+                            ignore_eos=True)
+
+        def run(kernel: bool):
+            monkeypatch.setenv("XLLM_PALLAS", "1" if kernel else "0")
+            monkeypatch.setenv("XLLM_PALLAS_PREFILL",
+                               "1" if kernel else "0")
+            eng = Engine(cfg, ecfg, params=params)
+            outs = {}
+            for i, p in enumerate(prompts):
+                eng.add_request(EngineRequest(
+                    request_id=f"r{i}", token_ids=list(p), sampling=sp))
+            while eng.has_work():
+                for o in eng.step():
+                    outs.setdefault(o.request_id, []).extend(
+                        o.new_token_ids)
+            return outs
+
+        xla = run(kernel=False)
+        pal = run(kernel=True)
+        assert set(xla) == set(pal)
+        for rid in xla:
+            assert xla[rid] == pal[rid], (cfg.name, rid)
+
+    def test_uniform_window(self, monkeypatch):
+        # Mistral-v0.1 / Phi-3 shape: one static window, O(W) trimming
+        # live (24 < the 48-token prompts).
+        import dataclasses as _dc
+
+        from xllm_service_tpu.config import ModelConfig
+        cfg = _dc.replace(ModelConfig.tiny(), name="tiny-swa",
+                          sliding_window=24)
+        self._ab(monkeypatch, cfg)
+
+    def test_gemma2_style(self, monkeypatch):
+        # Soft-cap + scale override + alternating per-layer windows.
+        import dataclasses as _dc
+
+        from xllm_service_tpu.config import ModelConfig
+        cfg = _dc.replace(ModelConfig.tiny(), name="tiny-gemma",
+                          gemma=True, attn_logit_softcapping=30.0,
+                          final_logit_softcapping=10.0,
+                          query_pre_attn_scalar=16, sliding_window=24,
+                          layer_sliding=(True, False))
+        self._ab(monkeypatch, cfg)
+
+    def test_gptoss_style(self, monkeypatch):
+        # Sinks + biased projections + alternating windows + MoE.
+        import dataclasses as _dc
+
+        from xllm_service_tpu.config import ModelConfig
+        cfg = _dc.replace(ModelConfig.tiny(num_experts=4),
+                          name="tiny-gptoss", gptoss=True,
+                          attention_bias=True, sliding_window=16,
+                          layer_sliding=(True, False),
+                          num_experts_per_tok=2,
+                          moe_capacity_factor=4.0)
+        self._ab(monkeypatch, cfg)

@@ -1,7 +1,9 @@
-"""AOT compile-check: does the V2 transpose-free fold lower on v5e?
+"""AOT compile-checks for the gated decode-kernel variants on v5e.
 
-Expected to FAIL with "batch dims must be equal" (same dot form that
-killed V3's first version). Run only when no bench holds the chip."""
+V2 is expected to FAIL with "batch dims must be equal" (same dot form
+that killed V3's first version). The round-5 model-delta probes (window /
+soft-cap / scale / sinks in the V1 kernel) are new code Mosaic has never
+lowered on hardware. Run only when no bench holds the chip."""
 import sys
 
 import jax
@@ -18,20 +20,46 @@ k = jnp.zeros((P, ps, Hkv, D), jnp.bfloat16)
 pt = jnp.zeros((B, MP), jnp.int32)
 ctx = jnp.full((B,), 100, jnp.int32)
 kc = jnp.zeros((B, Hkv, D), jnp.bfloat16)
+winW = jnp.full((1,), 128, jnp.int32)
+sinks = jnp.zeros((Hq,), jnp.float32)
 
-for name, fn, kw in (
+# Absorbed-MLA decode shape (DeepSeek): one latent "head" of width
+# kv_lora_rank + rope = 576 — NOT 128-lane-aligned, the class of minor
+# dim round 3 proved Mosaic rejects in HBM DMA slices. Gates
+# XLLM_PALLAS_MLA (transformer._mla_forward_decode).
+q_mla = jnp.zeros((B, 16, 576), jnp.bfloat16)
+k_mla = jnp.zeros((P, ps, 1, 576), jnp.bfloat16)
+kc_mla = jnp.zeros((B, 1, 576), jnp.bfloat16)
+
+for name, fn, args, kw in (
+        ("V1 window", _paged_decode_attention_impl,
+         (q, k, k, pt, ctx, kc, kc, winW, None),
+         dict(interpret=False)),
+        ("V1 softcap+scale", _paged_decode_attention_impl,
+         (q, k, k, pt, ctx, kc, kc, winW, None),
+         dict(interpret=False, logits_soft_cap=50.0, scale=0.0625)),
+        ("V1 window+sinks", _paged_decode_attention_impl,
+         (q, k, k, pt, ctx, kc, kc, winW, sinks),
+         dict(interpret=False)),
         ("V2 transpose-free", _paged_decode_attention_impl,
+         (q, k, k, pt, ctx, kc, kc),
          dict(interpret=False, transpose_free=True)),
         ("V4 multirow x8", _paged_decode_attention_mr_impl,
+         (q, k, k, pt, ctx, kc, kc),
          dict(interpret=False, rows=8)),
         ("V4 multirow x16", _paged_decode_attention_mr_impl,
+         (q, k, k, pt, ctx, kc, kc),
          dict(interpret=False, rows=16)),
         ("V5 wide", _paged_decode_attention_wide_impl,
+         (q, k, k, pt, ctx, kc, kc),
          dict(interpret=False)),
+        ("V1 MLA shape (Hkv=1 D=576)", _paged_decode_attention_impl,
+         (q_mla, k_mla, k_mla, pt, ctx, kc_mla, kc_mla),
+         dict(interpret=False, scale=0.1)),
 ):
     try:
         jax.jit(lambda *a, fn=fn, kw=kw: fn(*a, **kw)).lower(
-            q, k, k, pt, ctx, kc, kc).compile()
+            *args).compile()
         print(f"{name}: COMPILE OK")
     except Exception as e:
         msg = str(e)

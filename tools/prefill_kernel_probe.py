@@ -1,6 +1,9 @@
 """AOT compile-check of the gated Pallas prefill kernel for v5e.
 
-Compile-only (no execution); run ONLY when no bench holds the chip."""
+Compile-only (no execution); run ONLY when no bench holds the chip.
+Probes the plain causal form AND the round-5 model-delta forms (dynamic
+sliding window, Gemma soft-cap/scale, GPT-OSS sinks) — each adds kernel
+code Mosaic has never lowered on hardware."""
 import sys
 
 import jax
@@ -18,13 +21,30 @@ kp = jnp.zeros((P, PS, Hkv, D), jnp.bfloat16)
 pt = jnp.zeros((B, MP), jnp.int32)
 qs = jnp.zeros((B,), jnp.int32)
 ln = jnp.full((B,), T, jnp.int32)
+win0 = jnp.zeros((1,), jnp.int32)
+winW = jnp.full((1,), 128, jnp.int32)
+sinks = jnp.zeros((Hq,), jnp.float32)
 
-try:
-    jax.jit(lambda *a: _impl(*a, q_block=128, interpret=False)).lower(
-        q, kf, kf, kp, kp, pt, qs, ln).compile()
-    print("PREFILL KERNEL: COMPILE OK")
-except Exception as e:
-    msg = str(e)
-    i = msg.find("Mosaic")
-    print("PREFILL KERNEL FAIL:",
-          msg[i:i + 1200] if i >= 0 else msg[:1200])
+SCALE = 1.0 / (D ** 0.5)
+
+for name, win, sk, kw in (
+        ("plain", win0, None, {}),
+        ("window", winW, None, {}),
+        ("softcap+scale", winW, None,
+         dict(logits_soft_cap=50.0, scale=0.0625)),
+        ("sinks", win0, sinks, {}),
+        ("gptoss window+sinks", winW, sinks, {}),
+):
+    try:
+        jax.jit(lambda *a, kw=kw: _impl(
+            *a, q_block=128, logits_soft_cap=kw.get(
+                "logits_soft_cap", 0.0),
+            scale=kw.get("scale", SCALE), interpret=False)).lower(
+            q, kf, kf, kp, kp, pt, qs, ln, win, sk).compile()
+        print(f"PREFILL KERNEL [{name}]: COMPILE OK")
+    except Exception as e:
+        msg = str(e)
+        i = msg.find("Mosaic")
+        print(f"PREFILL KERNEL [{name}] FAIL:",
+              (msg[i:i + 1200] if i >= 0 else msg[:1200])
+              .replace("\n", " "))

@@ -185,7 +185,11 @@ class ModelConfig:
     # per-expert selection bias (e_score_correction_bias — biases the
     # CHOICE, not the weights) and top-2-sum group scores; a flag for
     # the rope sub-head pair layout; and yarn's mscale² folded into the
-    # softmax scale (V3 attention does this, the V2 port does not).
+    # softmax scale. The mscale fold is keyed on the CHECKPOINT (yarn
+    # with nonzero mscale_all_dim), matching DeepSeek's original
+    # modeling code and vLLM for both V2 and V3 — real V2/V2-Lite
+    # checkpoints ship mscale_all_dim 0.707. (HF's in-tree V2 port
+    # omits the factor; that is its divergence, not ours.)
     moe_scoring: str = "softmax"        # or "sigmoid" (V3)
     rope_interleave: bool = True
     mla_yarn_mscale: bool = False
@@ -324,7 +328,8 @@ class ModelConfig:
         # paged cache holds 576 values/token instead of 16·384), 64
         # routed + 2 shared experts, greedy top-6, one dense first layer.
         # Real checkpoints add yarn scaling (factor 40, mscale 0.707 both
-        # ways → attention factor cancels to 1.0).
+        # ways → attention factor cancels to 1.0) with the mscale²
+        # softmax-scale fold live (mscale_all_dim 0.707 ≠ 0).
         return cls(name="deepseek-v2-lite", vocab_size=102400,
                    hidden_size=2048, intermediate_size=10944,
                    moe_intermediate_size=1408, num_layers=27,
@@ -337,7 +342,8 @@ class ModelConfig:
                    qk_rope_head_dim=64, v_head_dim=128,
                    num_experts=64, num_experts_per_tok=6,
                    n_shared_experts=2, first_k_dense_replace=1,
-                   routed_scaling_factor=1.0, norm_topk_prob=False)
+                   routed_scaling_factor=1.0, norm_topk_prob=False,
+                   mla_yarn_mscale=True)
 
     @classmethod
     def deepseek_v3(cls) -> "ModelConfig":
@@ -566,6 +572,9 @@ class ModelConfig:
             layer_sliding = None
         elif layer_sliding is not None and all(layer_sliding):
             layer_sliding = None        # uniform window, static fast path
+        parsed_rs = cls._parse_rope_scaling(
+            d.get("rope_scaling"),
+            d.get("max_position_embeddings", 4096))
         return cls(
             name=name,
             vocab_size=d["vocab_size"],
@@ -628,7 +637,14 @@ class ModelConfig:
             moe_scoring="sigmoid" if mt == "deepseek_v3" else "softmax",
             gptoss=mt == "gpt_oss",
             rope_interleave=bool(d.get("rope_interleave", True)),
-            mla_yarn_mscale=mt == "deepseek_v3",
+            # The mscale² softmax-scale fold follows the CHECKPOINT, not
+            # the model_type: DeepSeek's own modeling code (and vLLM)
+            # apply it whenever yarn ships a nonzero mscale_all_dim —
+            # real V2/V2-Lite checkpoints carry 0.707 — while HF's
+            # in-tree V2 port omits it (round-4 advisor finding).
+            mla_yarn_mscale=bool(
+                _dsk and parsed_rs is not None and parsed_rs[0] == "yarn"
+                and len(parsed_rs) > 7 and parsed_rs[7]),
             # HF defaults: Mixtral always normalizes top-k weights;
             # Qwen3MoeConfig defaults norm_topk_prob to FALSE when the
             # key is absent; the DeepSeek-V2 gate never normalizes.
@@ -636,9 +652,7 @@ class ModelConfig:
                                       mt != "qwen3_moe"))
             and mt != "deepseek_v2",
             qwen_moe=mt == "qwen3_moe",
-            rope_scaling=cls._parse_rope_scaling(
-                d.get("rope_scaling"),
-                d.get("max_position_embeddings", 4096)),
+            rope_scaling=parsed_rs,
         )
 
     @staticmethod

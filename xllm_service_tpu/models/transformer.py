@@ -39,8 +39,10 @@ from xllm_service_tpu.ops.rope import (apply_rope,
                                        apply_rope_dynamic,
                                        rope_for)
 from xllm_service_tpu.ops.attention import (
+    FULL_WINDOW,
     mha_prefill,
     mha_prefill_auto,
+    paged_decode_attention_current,
     paged_decode_attention_current_auto,
     gather_pages,
     overlay_fresh_kv,
@@ -148,8 +150,9 @@ def _use_prefill_kernel(window: int, page_size: int) -> bool:
 
 # Sentinel window for full-attention layers when windows ride the layer
 # scan as traced per-layer values (Gemma-2 alternation): larger than any
-# context, so the window mask is a no-op.
-_FULL_WINDOW = 1 << 30
+# context, so the window mask is a no-op. Shared with the Pallas kernels
+# (whose int32 window arithmetic bounds it at 2^30 — see ops/attention).
+_FULL_WINDOW = FULL_WINDOW
 
 
 def _scatter_topk(vals: jnp.ndarray, idx: jnp.ndarray,
@@ -413,13 +416,18 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         # streams pool pages + fresh blocks directly (no gathered-view
         # materialization); the XLA reference gathers then overlays.
         B, T = tokens.shape
-        if not cfg.sliding_window and not cfg.attn_logit_softcapping \
-                and cfg.query_pre_attn_scalar is None \
-                and _use_prefill_kernel(T, kp.shape[1]):
+        if _use_prefill_kernel(T, kp.shape[1]):
+            # The kernel implements the full model-delta surface —
+            # windows (static or traced per-layer), Gemma soft-cap and
+            # scale, GPT-OSS sinks — so SWA families are no longer
+            # trace-time-bypassed to the gather path (round-4 verdict).
             from xllm_service_tpu.ops.pallas import (
                 paged_prefill_attention_pallas)
             attn = paged_prefill_attention_pallas(
-                q, k, v, kp, vp, page_table, start_pos, lengths)
+                q, k, v, kp, vp, page_table, start_pos, lengths,
+                sliding_window=w_l, sinks=lp.get("sinks"),
+                logits_soft_cap=cfg.attn_logit_softcapping,
+                scale=extras.get("scale"))
         else:
             k_all = overlay_fresh_kv(gather_pages(kp, page_table), k,
                                      start_pos)
@@ -967,9 +975,11 @@ def _mla_scale(cfg: ModelConfig) -> float:
     scale = cfg.qk_head_dim ** -0.5
     rs = cfg.rope_scaling
     if cfg.mla_yarn_mscale and rs is not None and rs[0] == "yarn":
-        # DeepSeek-V3 folds yarn's mscale into the softmax scale
-        # (squared — query and key sides), on top of the rope module's
-        # cos/sin attention factor. The V2 port does not.
+        # DeepSeek folds yarn's mscale into the softmax scale (squared
+        # — query and key sides), on top of the rope module's cos/sin
+        # attention factor, whenever the checkpoint ships a nonzero
+        # mscale_all_dim (real V2 and V3 both do; HF's in-tree V2 port
+        # omits the factor — config.py keys the flag on the checkpoint).
         factor, msa = rs[1], rs[7] if len(rs) > 7 else 0.0
         if msa and factor > 1.0:
             m = 0.1 * msa * math.log(factor) + 1.0
@@ -1063,9 +1073,20 @@ def _mla_forward_decode(params: Params, cfg: ModelConfig,
             # the duplicate v_pages pool is write-only under MLA, a
             # known 2x-storage cost of keeping the engine's uniform
             # (k, v) pool plumbing (single-pool layout is a follow-up).
-            attn = paged_decode_attention_current_auto(
-                q_t[:, 0], kp, kp, page_table, cache_lens,
-                latent[:, 0], latent[:, 0], scale=_mla_scale(cfg))
+            # The XLA reference path is the DEFAULT here even with
+            # XLLM_PALLAS on: the absorbed-MLA block shape (Hkv=1,
+            # D=r+rope=576 — not 128-lane-aligned) has never been
+            # Mosaic-validated; XLLM_PALLAS_MLA=1 opts into the kernel
+            # once tools/kernel_compile_probes.py clears it on hardware.
+            from xllm_service_tpu.ops import pallas as _pallas
+            if _pallas.mla_kernel_enabled():
+                attn = paged_decode_attention_current_auto(
+                    q_t[:, 0], kp, kp, page_table, cache_lens,
+                    latent[:, 0], latent[:, 0], scale=_mla_scale(cfg))
+            else:
+                attn = paged_decode_attention_current(
+                    q_t[:, 0], kp, kp, page_table, cache_lens,
+                    latent[:, 0], latent[:, 0], scale=_mla_scale(cfg))
             x = x + _mla_out(cfg, lp, attn)[:, None, :]
             h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
             if moe:

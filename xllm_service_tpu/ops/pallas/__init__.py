@@ -35,6 +35,15 @@ def _on_tpu() -> bool:
         return False
 
 
+def mla_kernel_enabled() -> bool:
+    """Opt-in gate for routing absorbed-MLA decode (Hkv=1, D=r+rope —
+    e.g. 576 for DeepSeek, not 128-lane-aligned) through the paged
+    decode kernel. Off by default until the MLA-shaped AOT compile probe
+    (tools/kernel_compile_probes.py) clears Mosaic on hardware; the XLA
+    gather reference serves MLA otherwise."""
+    return os.environ.get("XLLM_PALLAS_MLA", "0") == "1" and enabled()
+
+
 def default_interpret() -> bool:
     """Kernel ``interpret=None`` resolution, shared by every kernel: run
     under the Pallas interpreter anywhere but a real TPU (so XLLM_PALLAS=1

@@ -58,9 +58,18 @@ def _row_kernel_default() -> bool:
     return os.environ.get("XLLM_PALLAS_DECODE_V3", "0") == "1"
 
 
-def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
-            m_ref, l_ref, acc_ref, *, page_size: int, pages_per_seq: int,
-            num_kv_heads: int, has_current: bool, transpose_free: bool):
+# Window sentinel: larger than any context. A plain int — module-level
+# jnp constants would be captured as pallas closure constants, which
+# pallas_call rejects; the shared definition documents the <= 2^30
+# int32-safety bound.
+from xllm_service_tpu.ops.attention import FULL_WINDOW as _FULL
+
+
+def _kernel(ctx_ref, pt_ref, win_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref,
+            sk_ref, o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
+            pages_per_seq: int, num_kv_heads: int, has_current: bool,
+            transpose_free: bool, logits_soft_cap: float, scale: float,
+            has_sinks: bool):
     b = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -72,8 +81,16 @@ def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
 
     ctx = ctx_ref[b]
     page_start = p * page_size
+    w = win_ref[0]
+    w_eff = jnp.where(w > 0, w, _FULL)
+    # The query's logical position: with the current token held
+    # in-registers the cache holds [0, ctx) and the query sits at ctx;
+    # without it, ctx INcludes the query token (position ctx − 1). The
+    # window keeps cache slot j > q_pos − W (slot j holds position j).
+    q_pos = ctx if has_current else ctx - 1
+    win_floor = q_pos - w_eff
 
-    @pl.when(page_start < ctx)
+    @pl.when((page_start < ctx) & (page_start + page_size - 1 > win_floor))
     def _fold():
         hq, d = q_ref.shape[1], q_ref.shape[2]
         g = hq // num_kv_heads
@@ -81,7 +98,6 @@ def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
         qg = q.reshape(num_kv_heads, g, d)                   # [Hkv, G, D]
         k = k_ref[0].astype(jnp.float32)                     # [ps, Hkv, D]
         v = v_ref[0].astype(jnp.float32)
-        scale = 1.0 / (d ** 0.5)
         if transpose_free:
             # Batch Hkv where it lives: [Hkv,G,D] x [ps,Hkv,D] -> [Hkv,G,ps]
             logits = jax.lax.dot_general(
@@ -94,9 +110,11 @@ def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
                 qg, kt, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32) * scale
         logits = logits.reshape(hq, page_size)               # [Hq, ps]
+        if logits_soft_cap > 0.0:
+            logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
         pos = page_start + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
-        mask = pos < ctx                                     # [1, ps]
+        mask = (pos < ctx) & (pos > win_floor)               # [1, ps]
         logits = jnp.where(mask, logits, _NEG_INF)
         m_prev = m_ref[:]                                    # [Hq, 1]
         blk_max = jnp.max(logits, axis=-1, keepdims=True)
@@ -124,29 +142,40 @@ def _kernel(ctx_ref, pt_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref, o_ref,
 
     @pl.when(p == pages_per_seq - 1)
     def _finalize():
+        m_fin = m_ref[:]
+        l_fin = l_ref[:]
+        acc_fin = acc_ref[:]
         if has_current:
             # Fold the current token's K/V (held in-registers, not yet in
-            # the pool) as a final always-valid single-position block.
+            # the pool) as a final always-valid single-position block
+            # (soft-capped like any cache logit; inside its own window).
             hq, d = q_ref.shape[1], q_ref.shape[2]
             g = hq // num_kv_heads
             q = q_ref[0].astype(jnp.float32)
             qg = q.reshape(num_kv_heads, g, d)
             kc = kc_ref[0].astype(jnp.float32)               # [Hkv, D]
             vc = vc_ref[0].astype(jnp.float32)
-            scale = 1.0 / (d ** 0.5)
             lc = jnp.sum(qg * kc[:, None, :], axis=-1) * scale  # [Hkv, G]
             lc = lc.reshape(hq, 1)
-            m_prev = m_ref[:]
-            m_new = jnp.maximum(m_prev, lc)
-            corr = jnp.exp(m_prev - m_new)
+            if logits_soft_cap > 0.0:
+                lc = logits_soft_cap * jnp.tanh(lc / logits_soft_cap)
+            m_new = jnp.maximum(m_fin, lc)
+            corr = jnp.exp(m_fin - m_new)
             pc = jnp.exp(lc - m_new)                         # [Hq, 1]
-            l_fin = l_ref[:] * corr + pc
+            l_fin = l_fin * corr + pc
             vc_full = jnp.broadcast_to(
                 vc[:, None, :], (num_kv_heads, g, d)).reshape(hq, d)
-            acc_fin = acc_ref[:] * corr + pc * vc_full
-        else:
-            l_fin = l_ref[:]
-            acc_fin = acc_ref[:]
+            acc_fin = acc_fin * corr + pc * vc_full
+            m_fin = m_new
+        if has_sinks:
+            # GPT-OSS sinks: the per-head logit joins the denominator
+            # only (never capped, never scaled — reference semantics,
+            # ops/attention.py paged_decode_attention_current).
+            sk = sk_ref[:].astype(jnp.float32)               # [Hq, 1]
+            m_sk = jnp.maximum(m_fin, sk)
+            corr = jnp.exp(m_fin - m_sk)
+            l_fin = l_fin * corr + jnp.exp(sk - m_sk)
+            acc_fin = acc_fin * corr
         denom = jnp.maximum(l_fin, 1e-30)
         o_ref[0] = (acc_fin / denom).astype(o_ref.dtype)
 
@@ -638,13 +667,23 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
                                   k_cur: jnp.ndarray = None,
                                   v_cur: jnp.ndarray = None,
                                   interpret: bool = None,
-                                  transpose_free: bool = None
-                                  ) -> jnp.ndarray:
+                                  transpose_free: bool = None,
+                                  sliding_window=0,
+                                  logits_soft_cap: float = 0.0,
+                                  scale=None,
+                                  sinks=None) -> jnp.ndarray:
     """q: [B, Hq, D]; k/v_pages: [P, ps, Hkv, D]; page_table: [B, MP];
     context_lens: [B] valid cache tokens. With ``k_cur``/``v_cur``
     [B, Hkv, D], the current (not-yet-written) token is folded as a final
     block — the contract of ``paged_decode_attention_current``. Returns
     [B, Hq, D].
+
+    ``sliding_window`` is a static int OR a traced int32 scalar (per-layer
+    window vectors riding the layer scan — Gemma-2/3, GPT-OSS); 0
+    disables. ``logits_soft_cap``/``scale`` static floats (Gemma);
+    ``sinks`` an optional [Hq] array (GPT-OSS). Model deltas are
+    implemented by the base (V1) kernel only — calls carrying any of them
+    route there regardless of the V3/V4/V5 experiment gates.
 
     ``transpose_free=None`` resolves the XLLM_PALLAS_DECODE_V2 env var
     HERE, outside the jit cache, so runtime toggles take effect (the
@@ -656,35 +695,47 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
     if interpret is None:
         from xllm_service_tpu.ops import pallas
         interpret = pallas.default_interpret()
-    if _wide_default():
-        return _paged_decode_attention_wide_impl(
-            q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur,
-            interpret=interpret)
-    mr = _multirow_default()
-    if mr > 1:
-        return _paged_decode_attention_mr_impl(
-            q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur,
-            rows=mr, interpret=interpret)
-    if _row_kernel_default():
-        return _paged_decode_attention_row_impl(
-            q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur,
-            interpret=interpret)
+    plain = (isinstance(sliding_window, int) and sliding_window == 0
+             and logits_soft_cap == 0.0 and scale is None
+             and sinks is None)
+    if plain:
+        if _wide_default():
+            return _paged_decode_attention_wide_impl(
+                q, k_pages, v_pages, page_table, context_lens, k_cur,
+                v_cur, interpret=interpret)
+        mr = _multirow_default()
+        if mr > 1:
+            return _paged_decode_attention_mr_impl(
+                q, k_pages, v_pages, page_table, context_lens, k_cur,
+                v_cur, rows=mr, interpret=interpret)
+        if _row_kernel_default():
+            return _paged_decode_attention_row_impl(
+                q, k_pages, v_pages, page_table, context_lens, k_cur,
+                v_cur, interpret=interpret)
+    win = jnp.asarray(sliding_window, jnp.int32).reshape(1)
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
     return _paged_decode_attention_impl(
-        q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur,
-        interpret=interpret, transpose_free=transpose_free)
+        q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur, win,
+        sinks, interpret=interpret, transpose_free=transpose_free,
+        logits_soft_cap=float(logits_soft_cap), scale=float(scale))
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("interpret", "transpose_free"))
+                   static_argnames=("interpret", "transpose_free",
+                                    "logits_soft_cap", "scale"))
 def _paged_decode_attention_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
                                  v_pages: jnp.ndarray,
                                  page_table: jnp.ndarray,
                                  context_lens: jnp.ndarray,
                                  k_cur: jnp.ndarray = None,
                                  v_cur: jnp.ndarray = None,
+                                 win: jnp.ndarray = None,
+                                 sinks: jnp.ndarray = None,
                                  interpret: bool = False,
-                                 transpose_free: bool = False
-                                 ) -> jnp.ndarray:
+                                 transpose_free: bool = False,
+                                 logits_soft_cap: float = 0.0,
+                                 scale: float = None) -> jnp.ndarray:
     B, Hq, D = q.shape
     _, page_size, Hkv, _ = k_pages.shape
     MP = page_table.shape[1]
@@ -692,24 +743,32 @@ def _paged_decode_attention_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
     if not has_current:
         k_cur = jnp.zeros((B, Hkv, D), q.dtype)
         v_cur = jnp.zeros((B, Hkv, D), q.dtype)
+    if win is None:
+        win = jnp.zeros((1,), jnp.int32)
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    has_sinks = sinks is not None
+    sk2 = (sinks.astype(jnp.float32).reshape(Hq, 1) if has_sinks
+           else jnp.zeros((Hq, 1), jnp.float32))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,               # context_lens, page_table
+        num_scalar_prefetch=3,          # context_lens, page_table, win
         grid=(B, MP),
         in_specs=[
             pl.BlockSpec((1, Hq, D),
-                         lambda b, p, ctx, pt: (b, 0, 0)),
+                         lambda b, p, ctx, pt, w: (b, 0, 0)),
             pl.BlockSpec((1, page_size, Hkv, D),
-                         lambda b, p, ctx, pt: (pt[b, p], 0, 0, 0)),
+                         lambda b, p, ctx, pt, w: (pt[b, p], 0, 0, 0)),
             pl.BlockSpec((1, page_size, Hkv, D),
-                         lambda b, p, ctx, pt: (pt[b, p], 0, 0, 0)),
+                         lambda b, p, ctx, pt, w: (pt[b, p], 0, 0, 0)),
             pl.BlockSpec((1, Hkv, D),
-                         lambda b, p, ctx, pt: (b, 0, 0)),
+                         lambda b, p, ctx, pt, w: (b, 0, 0)),
             pl.BlockSpec((1, Hkv, D),
-                         lambda b, p, ctx, pt: (b, 0, 0)),
+                         lambda b, p, ctx, pt, w: (b, 0, 0)),
+            pl.BlockSpec((Hq, 1), lambda b, p, ctx, pt, w: (0, 0)),
         ],
         out_specs=pl.BlockSpec((1, Hq, D),
-                               lambda b, p, ctx, pt: (b, 0, 0)),
+                               lambda b, p, ctx, pt, w: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Hq, 1), jnp.float32),    # running max
             pltpu.VMEM((Hq, 1), jnp.float32),    # running denom
@@ -719,11 +778,14 @@ def _paged_decode_attention_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
     out = pl.pallas_call(
         functools.partial(_kernel, page_size=page_size, pages_per_seq=MP,
                           num_kv_heads=Hkv, has_current=has_current,
-                          transpose_free=transpose_free),
+                          transpose_free=transpose_free,
+                          logits_soft_cap=logits_soft_cap, scale=scale,
+                          has_sinks=has_sinks),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(context_lens, page_table, q, k_pages, v_pages, k_cur, v_cur)
+    )(context_lens, page_table, win, q, k_pages, v_pages, k_cur, v_cur,
+      sk2)
     return out

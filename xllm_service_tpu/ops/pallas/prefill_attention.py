@@ -29,6 +29,26 @@ Masking: pool positions are valid while ``pos < q_start[b]`` (the cached
 prefix only — pool content past it is stale); fresh positions are valid
 while their window-local index is ``< lengths[b]``; causality masks
 ``pos > q_pos``. Fully-masked steps skip their MXU work via ``pl.when``.
+
+Model deltas beyond plain causal GQA (so SWA families are NOT bypassed to
+the gather path — round-4 verdict item 3):
+
+- ``sliding_window`` — a DYNAMIC int32 scalar (4th scalar-prefetch
+  operand), so Gemma-2/3 / GPT-OSS per-layer window vectors can ride the
+  layer scan as traced values (full-attention layers pass 0 or the
+  larger-than-any-context sentinel). The mask keeps
+  ``kv_pos > q_pos − W`` (HF semantics, ops/attention.py:200-202) and a
+  kv step entirely below every query's window skips its MXU work AND its
+  fold — with the engine's O(W) page trimming the dead steps are exactly
+  the trimmed (NULL) pages, whose stale bytes the mask would discard
+  anyway.
+- ``logits_soft_cap`` — Gemma-2's ``cap·tanh(logits/cap)``, static.
+- ``scale`` — Gemma's ``query_pre_attn_scalar**-0.5`` override, static.
+- ``sinks`` — GPT-OSS per-head sink logits, folded into the softmax
+  denominator at finalize (never capped, never scaled — matching
+  ``mha_prefill``'s concat-column-then-drop reference semantics). The
+  caller pre-broadcasts them to the kernel's [Hkv, QB·G, 1] block layout
+  in XLA, where the relayout is free.
 """
 
 from __future__ import annotations
@@ -42,7 +62,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from xllm_service_tpu.ops.attention import FULL_WINDOW
+
 _NEG_INF = -1e30
+# Larger than any context: a window of 0 (= disabled) is normalized to
+# this so the mask arithmetic stays branch-free in-kernel. A plain int
+# (not a jnp constant — module-level jax arrays would be captured as
+# pallas closure constants, which pallas_call rejects); the shared
+# definition documents the <= 2^30 int32-safety bound.
+_FULL = FULL_WINDOW
 
 
 def prefill_kernel_enabled() -> bool:
@@ -55,10 +83,11 @@ def prefill_kernel_enabled() -> bool:
     return pallas.enabled()
 
 
-def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
-            vf_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _kernel(qstart_ref, lens_ref, pt_ref, win_ref, q_ref, kp_ref, vp_ref,
+            kf_ref, vf_ref, sk_ref, o_ref, m_ref, l_ref, acc_ref, *,
             page_size: int, q_block: int, num_pool_steps: int,
-            num_kv_steps: int):
+            num_kv_steps: int, logits_soft_cap: float, scale: float,
+            has_sinks: bool):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     s = pl.program_id(2)
@@ -67,10 +96,11 @@ def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
     # transpose in XLA where it is free): in-kernel 4D transposes are a
     # known Mosaic lowering hazard on v5e (the V3 decode kernel died on
     # exactly this class — docs/PERF_NOTES.md round 3).
-    d = q_ref.shape[4]
     g = q_ref.shape[3] // q_block
     q_start = qstart_ref[b]
     length = lens_ref[b]
+    w = win_ref[0]
+    w_eff = jnp.where(w > 0, w, _FULL)
 
     @pl.when(s == 0)
     def _init():
@@ -89,12 +119,15 @@ def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
     # reads — the last valid row is selected downstream).
     q_lo = q_start + qi * q_block
 
-    # A pool step is live while it intersects the cached prefix; a fresh
-    # step while it intersects the true window AND is not entirely above
-    # the causal diagonal of this query block.
-    live_pool = is_pool & (pool_base < q_start)
+    # A kv step is live while some (q, kv) pair satisfies causality AND
+    # the window: needs kv ≤ q for some q in the block (base ≤ last query
+    # row) and kv > q − W for some q (block's last kv position above the
+    # FIRST query row's window floor). Pool steps additionally intersect
+    # the cached prefix; fresh steps the true window.
+    in_win = base + page_size - 1 > q_lo - w_eff
+    live_pool = is_pool & (pool_base < q_start) & in_win
     live_fresh = jnp.logical_not(is_pool) & \
-        (fresh_local_base < length) & (base <= q_lo + q_block - 1)
+        (fresh_local_base < length) & (base <= q_lo + q_block - 1) & in_win
 
     @pl.when(live_pool | live_fresh)
     def _fold():
@@ -102,7 +135,6 @@ def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
                        kf_ref[0, 0].astype(jnp.float32))     # [ps, Hkv, D]
         vb = jnp.where(is_pool, vp_ref[0].astype(jnp.float32),
                        vf_ref[0, 0].astype(jnp.float32))
-        scale = 1.0 / (d ** 0.5)
         qt = q_ref[0, 0].astype(jnp.float32)                 # [Hkv, QB*G, D]
         kt = jnp.transpose(kb, (1, 0, 2))                    # [Hkv, ps, D]
         vt = jnp.transpose(vb, (1, 0, 2))
@@ -110,6 +142,8 @@ def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
         logits = jax.lax.dot_general(
             qt, kt, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale
+        if logits_soft_cap > 0.0:
+            logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
 
         # Positions: kv along ps, queries along QB (replicated over G).
         kv_pos = base + jax.lax.broadcasted_iota(
@@ -117,10 +151,11 @@ def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
         q_pos = q_lo + jax.lax.broadcasted_iota(
             jnp.int32, (q_block, g, page_size), 0)
         # Pool: valid while pos < q_start. Fresh: valid while the local
-        # index < length. Both: causal.
+        # index < length. Both: causal + inside the sliding window.
         src_ok = jnp.where(is_pool, kv_pos < q_start,
                            kv_pos < q_start + length)
-        mask3 = (src_ok & (kv_pos <= q_pos)).reshape(
+        mask3 = (src_ok & (kv_pos <= q_pos)
+                 & (kv_pos > q_pos - w_eff)).reshape(
             1, q_block * g, page_size)                       # [1, QB*G, ps]
 
         logits = jnp.where(mask3, logits, _NEG_INF)
@@ -141,11 +176,23 @@ def _kernel(qstart_ref, lens_ref, pt_ref, q_ref, kp_ref, vp_ref, kf_ref,
 
     @pl.when(s == num_kv_steps - 1)
     def _finalize():
-        denom = jnp.maximum(l_ref[:], 1e-30)
+        m_fin = m_ref[:]
+        l_fin = l_ref[:]
+        acc_fin = acc_ref[:]
+        if has_sinks:
+            # GPT-OSS sinks: one per-head logit joins the denominator and
+            # its probability mass is dropped — fold it as a final
+            # single-position rescale of the accumulator.
+            sk = sk_ref[:].astype(jnp.float32)               # [Hkv,QB*G,1]
+            m_sk = jnp.maximum(m_fin, sk)
+            corr = jnp.exp(m_fin - m_sk)
+            l_fin = l_fin * corr + jnp.exp(sk - m_sk)
+            acc_fin = acc_fin * corr
+        denom = jnp.maximum(l_fin, 1e-30)
         # Written in the kernel's native [Hkv, QB*G, D] layout; the
         # caller transposes back in XLA (same hazard-avoidance as the
         # pre-relaid q input).
-        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_fin / denom).astype(o_ref.dtype)
 
 
 def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
@@ -156,24 +203,38 @@ def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
                                    q_start: jnp.ndarray,
                                    lengths: jnp.ndarray,
                                    q_block: int = 128,
-                                   interpret: bool = None) -> jnp.ndarray:
+                                   interpret: bool = None,
+                                   sliding_window=0,
+                                   logits_soft_cap: float = 0.0,
+                                   scale=None,
+                                   sinks=None) -> jnp.ndarray:
     """q/k_fresh/v_fresh: [B, T, H*, D] (this window, already roped);
     k/v_pages: [P, ps, Hkv, D]; page_table: [B, MP]; q_start: [B] cached
     prefix length; lengths: [B] true window length. Requires T % ps == 0
     (engine buckets are pow2 multiples of the page size — callers check).
-    ``interpret=None`` → Pallas interpreter off TPU (so the gated serving
-    path stays runnable in CPU tests), Mosaic on TPU. Returns
-    [B, T, Hq, D]."""
+    ``sliding_window`` is a static int OR a traced int32 scalar (per-layer
+    window vectors riding the layer scan); 0 disables. ``logits_soft_cap``
+    and ``scale`` are static floats (Gemma); ``sinks`` an optional [Hq]
+    array (GPT-OSS). ``interpret=None`` → Pallas interpreter off TPU (so
+    the gated serving path stays runnable in CPU tests), Mosaic on TPU.
+    Returns [B, T, Hq, D]."""
     if interpret is None:
         from xllm_service_tpu.ops import pallas
         interpret = pallas.default_interpret()
+    win = jnp.asarray(sliding_window, jnp.int32).reshape(1)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
     return _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table,
-                 q_start, lengths, q_block=q_block, interpret=interpret)
+                 q_start, lengths, win, sinks, q_block=q_block,
+                 logits_soft_cap=float(logits_soft_cap),
+                 scale=float(scale), interpret=interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("q_block", "interpret"))
+@functools.partial(jax.jit, static_argnames=("q_block", "logits_soft_cap",
+                                             "scale", "interpret"))
 def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
-          lengths, *, q_block: int, interpret: bool):
+          lengths, win, sinks, *, q_block: int, logits_soft_cap: float,
+          scale: float, interpret: bool):
     B, T, Hq, D = q.shape
     _, page_size, Hkv, _ = k_pages.shape
     MP = page_table.shape[1]
@@ -187,31 +248,36 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
     nF = T // page_size
     n_kv = MP + nF
     G = Hq // Hkv
+    has_sinks = sinks is not None
 
-    def pool_idx(b, qi, s, qstart, lens, pt):
+    def pool_idx(b, qi, s, qstart, lens, pt, w):
         # Pool steps DMA the mapped page; fresh steps DMA page 0 (unused).
         return (jnp.where(s < MP, pt[b, jnp.minimum(s, MP - 1)], 0),
                 0, 0, 0)
 
-    def fresh_idx(b, qi, s, qstart, lens, pt):
+    def fresh_idx(b, qi, s, qstart, lens, pt, w):
         # Fresh steps DMA their T-block; pool steps DMA block 0 (unused).
         return (b, jnp.maximum(s - MP, 0), 0, 0, 0)
 
+    def fixed_idx(b, qi, s, qstart, lens, pt, w):
+        return (0, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,              # q_start, lengths, page_table
+        num_scalar_prefetch=4,        # q_start, lengths, page_table, win
         grid=(B, nQ, n_kv),
         in_specs=[
             pl.BlockSpec((1, 1, Hkv, QB * G, D),
-                         lambda b, qi, s, qstart, lens, pt:
+                         lambda b, qi, s, qstart, lens, pt, w:
                          (b, qi, 0, 0, 0)),
             pl.BlockSpec((1, page_size, Hkv, D), pool_idx),
             pl.BlockSpec((1, page_size, Hkv, D), pool_idx),
             pl.BlockSpec((1, 1, page_size, Hkv, D), fresh_idx),
             pl.BlockSpec((1, 1, page_size, Hkv, D), fresh_idx),
+            pl.BlockSpec((Hkv, QB * G, 1), fixed_idx),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, Hkv, QB * G, D),
-            lambda b, qi, s, qstart, lens, pt: (b, qi, 0, 0, 0)),
+            lambda b, qi, s, qstart, lens, pt, w: (b, qi, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Hkv, QB * G, 1), jnp.float32),   # running max
             pltpu.VMEM((Hkv, QB * G, 1), jnp.float32),   # running denom
@@ -226,15 +292,25 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
         .reshape(B, nQ, Hkv, QB * G, D)
     kf5 = k_fresh.reshape(B, nF, page_size, Hkv, D)
     vf5 = v_fresh.reshape(B, nF, page_size, Hkv, D)
+    if has_sinks:
+        # [Hq] → the kernel's [Hkv, QB*G, 1] block layout (replicated
+        # over QB), pre-broadcast in XLA where the relayout is free.
+        sk3 = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(Hkv, 1, G),
+            (Hkv, QB, G)).reshape(Hkv, QB * G, 1)
+    else:
+        sk3 = jnp.zeros((Hkv, QB * G, 1), jnp.float32)
     out = pl.pallas_call(
         functools.partial(_kernel, page_size=page_size, q_block=QB,
-                          num_pool_steps=MP, num_kv_steps=n_kv),
+                          num_pool_steps=MP, num_kv_steps=n_kv,
+                          logits_soft_cap=logits_soft_cap, scale=scale,
+                          has_sinks=has_sinks),
         out_shape=jax.ShapeDtypeStruct((B, nQ, Hkv, QB * G, D), q.dtype),
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(q_start.astype(jnp.int32), lengths.astype(jnp.int32),
-      page_table, q6, k_pages, v_pages, kf5, vf5)
+      page_table, win, q6, k_pages, v_pages, kf5, vf5, sk3)
     out = out.reshape(B, nQ, Hkv, QB, G, D).transpose(0, 1, 3, 2, 4, 5)
     return out.reshape(B, T, Hq, D)
