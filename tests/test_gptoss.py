@@ -128,3 +128,79 @@ def test_sinks_chunked_matches_dense():
                                   chunk_size=chunk, sinks=sinks)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5)
+
+
+def _torch_dequant_mxfp4(blocks, scales):
+    """Independent reference dequantizer, written to the published HF
+    algorithm (transformers integrations/mxfp4.py
+    convert_moe_packed_tensors): LUT indexing low nibble first, ldexp by
+    scales − 127."""
+    lut = torch.tensor(
+        [0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+         -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0])
+    b = blocks.to(torch.long)
+    lo = lut[b & 0x0F]                                # [..., G, B]
+    hi = lut[b >> 4]
+    vals = torch.stack([lo, hi], dim=-1).reshape(
+        *blocks.shape[:-1], blocks.shape[-1] * 2)     # [..., G, 2B]
+    exp = (scales.to(torch.int32) - 127).unsqueeze(-1)
+    vals = torch.ldexp(vals, exp)
+    return vals.reshape(*blocks.shape[:-2], -1)       # [..., G*2B]
+
+
+def test_mxfp4_checkpoint_loads_and_matches_oracle(tmp_path):
+    """A GPT-OSS checkpoint in the RELEASED (MXFP4-quantized) dialect —
+    experts stored as *_blocks/*_scales uint8 — loads through the
+    round-5 dequantization path and produces the same logits as the
+    torch oracle running on independently-dequantized weights."""
+    from safetensors import safe_open
+    from safetensors.numpy import save_file
+
+    from xllm_service_tpu.runtime.checkpoint import dequant_mxfp4
+
+    model = _make_hf(seed=3)
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+
+    # Re-write the experts in quantized form: random blocks/scales (the
+    # dequant contract is exercised bit-for-bit regardless of whether a
+    # quantizer would emit them), with the bf16 keys REMOVED.
+    gen = torch.Generator().manual_seed(7)
+    tensors = {}
+    with safe_open(os.path.join(str(tmp_path), "model.safetensors"),
+                   framework="np") as f:
+        for key in f.keys():
+            tensors[key] = f.get_tensor(key)
+    E, D2, F2 = 4, 64, 96        # E experts, hidden, intermediate
+    for i in range(2):
+        P = f"model.layers.{i}.mlp.experts."
+        for proj, rows, cols in (("gate_up_proj", 2 * F2, D2),
+                                 ("down_proj", D2, F2)):
+            blocks = torch.randint(
+                0, 256, (E, rows, cols // 32, 16), generator=gen,
+                dtype=torch.uint8)
+            scales = torch.randint(
+                121, 134, (E, rows, cols // 32), generator=gen,
+                dtype=torch.uint8)
+            tensors.pop(P + proj)
+            tensors[P + proj + "_blocks"] = blocks.numpy()
+            tensors[P + proj + "_scales"] = scales.numpy()
+            # Oracle weights: independently dequantized, transposed to
+            # the module layout ([E, in, out] / [E, F, D]).
+            dq = _torch_dequant_mxfp4(blocks, scales)     # [E, rows, cols]
+            with torch.no_grad():
+                getattr(model.model.layers[i].mlp.experts,
+                        proj).copy_(dq.transpose(1, 2))
+            # Unit check: our numpy dequant == the torch reference.
+            np.testing.assert_array_equal(
+                dequant_mxfp4(blocks.numpy(), scales.numpy()),
+                dq.numpy())
+    save_file(tensors,
+              os.path.join(str(tmp_path), "model.safetensors"))
+
+    cfg, params = _load_ours(str(tmp_path))
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+    with torch.no_grad():
+        ref = model(torch.tensor([prompt])).logits[0].numpy()
+    ours = _our_all_logits(cfg, params, prompt)
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=5e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))

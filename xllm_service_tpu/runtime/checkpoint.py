@@ -48,6 +48,35 @@ def _np_dtype(name: str):
     return _BF16 if name == "bfloat16" else np.dtype(name)
 
 
+# The 16 MXFP4 (E2M1) code points, low nibble index order — OCP
+# Microscaling spec table; matches the LUT in HF transformers'
+# integrations/mxfp4.py (every released GPT-OSS checkpoint ships its
+# expert weights in this format).
+_FP4_VALUES = np.array(
+    [0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+     -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0], np.float32)
+
+
+def dequant_mxfp4(blocks: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """MXFP4 block-dequantization (host-side numpy).
+
+    ``blocks`` [..., G, B] uint8 — each byte packs two E2M1 values, LOW
+    nibble first; ``scales`` [..., G] uint8 — E8M0 shared exponents
+    (value = 2^(scales − 127)) per 2B-element block. Returns
+    [..., G·2B] float32. Layout contract: GPT-OSS safetensors store
+    ``*_blocks`` as [E, rows, cols/32, 16] with ``*_scales``
+    [E, rows, cols/32] — the reference dequantizer in HF transformers
+    (integrations/mxfp4.py convert_moe_packed_tensors) produces
+    [E, rows, cols] exactly as this does."""
+    lo = _FP4_VALUES[blocks & 0x0F]
+    hi = _FP4_VALUES[blocks >> 4]
+    vals = np.stack([lo, hi], axis=-1).reshape(
+        blocks.shape[:-1] + (blocks.shape[-1] * 2,))    # [..., G, 2B]
+    exp = scales.astype(np.int32) - 127
+    vals = np.ldexp(vals, exp[..., None]).astype(np.float32)
+    return vals.reshape(blocks.shape[:-2] + (-1,))
+
+
 class _ShardedReader:
     """Lazy tensor access across a directory's safetensors shards."""
 
@@ -198,24 +227,43 @@ def load_checkpoint(model_dir: str, cfg: ModelConfig,
         # gate_up columns (gate even, up odd) and per-expert biases;
         # router carries a bias and no transpose-free layout quirks.
         X = "model.layers.{i}.mlp."
-        if X.format(i=0) + "experts.gate_up_proj_blocks" in r:
-            raise NotImplementedError(
-                "this GPT-OSS checkpoint is MXFP4-quantized "
-                "(gate_up_proj_blocks/_scales) — dequantize to bf16 "
-                "safetensors first; the quantized block format is not "
-                "implemented")
+        # Released GPT-OSS weights ship the experts MXFP4-quantized
+        # (*_blocks/*_scales, [E, rows, cols/32, 16] uint8); dequantize
+        # at load (host numpy) to the same [E, rows, cols] the bf16
+        # dialect carries, then transpose into our x@W layout below.
+        # Biases and the router are unquantized in both dialects.
+        mxfp4 = X.format(i=0) + "experts.gate_up_proj_blocks" in r
         layers["router"] = stack(X + "router.weight", transpose=True)
         layers["router_bias"] = np.stack([
             r.get(X.format(i=i) + "router.bias") for i in range(L)
         ]).astype(np.float32)
         gu, gub, dn, dnb = [], [], [], []
         for i in range(L):
-            g_up = r.get(X.format(i=i) + "experts.gate_up_proj")
-            g_upb = r.get(X.format(i=i) + "experts.gate_up_proj_bias")
+            E_ = X.format(i=i) + "experts."
+            if mxfp4:
+                # Quantized storage is [E, out_rows, in] — the HF
+                # dequantizer transposes to the bf16 dialect's
+                # [E, in, out] (gate_up) / [E, F, D] (down); mirror it.
+                # Cast to the target dtype PER LAYER: fp4 values times a
+                # power-of-two scale are exactly representable in bf16,
+                # and staging all layers in f32 would double peak host
+                # RAM at exactly the 20B scale this path targets.
+                g_up = dequant_mxfp4(
+                    r.get(E_ + "gate_up_proj_blocks"),
+                    r.get(E_ + "gate_up_proj_scales")
+                ).transpose(0, 2, 1).astype(dtype)       # [E, D, 2F]
+                dn_i = dequant_mxfp4(
+                    r.get(E_ + "down_proj_blocks"),
+                    r.get(E_ + "down_proj_scales")
+                ).transpose(0, 2, 1).astype(dtype)       # [E, F, D]
+            else:
+                g_up = r.get(E_ + "gate_up_proj")
+                dn_i = r.get(E_ + "down_proj")
+            g_upb = r.get(E_ + "gate_up_proj_bias")
             gu.append(g_up)
             gub.append(g_upb)
-            dn.append(r.get(X.format(i=i) + "experts.down_proj"))
-            dnb.append(r.get(X.format(i=i) + "experts.down_proj_bias"))
+            dn.append(dn_i)
+            dnb.append(r.get(E_ + "down_proj_bias"))
         g_up = np.stack(gu)                      # [L, E, D, 2F]
         g_upb = np.stack(gub)                    # [L, E, 2F]
         layers["gate_proj"] = np.ascontiguousarray(
@@ -647,18 +695,32 @@ def save_checkpoint(params: Dict[str, Any], cfg: ModelConfig,
         "tie_word_embeddings": cfg.tie_word_embeddings,
         "attention_bias": cfg.attention_bias,
         "torch_dtype": cfg.dtype,
+        # Gemma-3 is distinguished from Gemma-2 by its per-layer rope
+        # base: labeling it gemma2 would reload without qk-norm and
+        # without rope_local_base_freq — silently wrong logits
+        # (round-4 advisor finding).
         "model_type": ("qwen2_vl" if cfg.is_mrope
+                       else "gemma3_text"
+                       if cfg.gemma and cfg.rope_local_base_freq
+                       is not None
                        else "gemma2" if cfg.gemma
                        else "qwen3" if cfg.qk_norm
                        else "phi3" if cfg.fused_proj
                        else "qwen2" if cfg.attention_bias else "llama"),
     }
+    if cfg.rope_local_base_freq is not None:
+        hf_cfg["rope_local_base_freq"] = cfg.rope_local_base_freq
     if cfg.sliding_window:
         hf_cfg["sliding_window"] = cfg.sliding_window
-        if cfg.gemma and cfg.layer_sliding is not None:
+        if cfg.gemma and (cfg.layer_sliding is not None
+                          or cfg.rope_local_base_freq is not None):
+            # Always explicit for gemma3: a uniform all-sliding window
+            # (layer_sliding None) left implicit would reload through
+            # the every-6th-layer-global default pattern.
+            ls = cfg.layer_sliding or (True,) * cfg.num_layers
             hf_cfg["layer_types"] = [
                 "sliding_attention" if s else "full_attention"
-                for s in cfg.layer_sliding]
+                for s in ls]
     if cfg.gemma:
         hf_cfg["attn_logit_softcapping"] = cfg.attn_logit_softcapping
         hf_cfg["final_logit_softcapping"] = cfg.final_logit_softcapping
