@@ -364,6 +364,8 @@ def _maybe_kv_probe(engine, cfg, ecfg) -> dict:
                                  iters=3)
         return {"direct_gbps": round(out["direct_gbps"], 2),
                 "host_shuttle_gbps": round(out["host_gbps"], 2),
+                "host_pipelined_gbps": round(
+                    out["host_pipelined_gbps"], 2),
                 "block_mb": round(out["bytes"] / 1e6, 1),
                 "pages": int(out["pages"])}
     except Exception as exc:  # noqa: BLE001 — probe must not kill the bench

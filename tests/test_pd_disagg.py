@@ -270,6 +270,64 @@ class TestPdDisaggregation:
         out = probe_kv_migration(a, b, n_pages=8, iters=3)
         assert out["bytes"] > 0
         assert out["direct_gbps"] > 0 and out["host_gbps"] > 0
+        assert out["host_pipelined_gbps"] > 0
+
+    def test_chunked_shuttle_matches_monolithic(self, store,
+                                                monkeypatch):
+        """The pipelined chunked shuttle (forced via a tiny chunk
+        budget) migrates correctly: same greedy text as the monolithic
+        shuttle, with the chunked counter proving the path ran."""
+        req = {"model": "tiny", "prompt": "pipeline the shuttle",
+               "max_tokens": 6, "temperature": 0.0, "ignore_eos": True}
+        texts = {}
+        for label, mb in (("chunked", "0.0001"), ("monolithic", "0")):
+            monkeypatch.setenv("XLLM_KV_SHUTTLE_CHUNK_MB", mb)
+            s = InMemoryStore(sweep_interval_s=0.02)
+            master, workers = make_pd_cluster(s)
+            prefill_w, _ = workers
+            try:
+                status, resp = http_json(
+                    "POST", master.http_address, "/v1/completions",
+                    req, timeout=120.0)
+                assert status == 200, resp
+                texts[label] = resp["choices"][0]["text"]
+                if label == "chunked":
+                    assert prefill_w.kv_migration_chunked > 0
+                else:
+                    assert prefill_w.kv_migration_chunked == 0
+                assert prefill_w.kv_migration_bytes > 0
+            finally:
+                for w in workers:
+                    w.stop()
+                master.stop()
+                s.close()
+        assert texts["chunked"] == texts["monolithic"]
+
+    def test_chunks_missing_falls_back_monolithic(self, store,
+                                                  monkeypatch):
+        """A decode side that lost its staged chunks answers the final
+        import with the chunks-missing refusal — the prefill side must
+        retry the monolithic shuttle and still serve the request."""
+        monkeypatch.setenv("XLLM_KV_SHUTTLE_CHUNK_MB", "0.0001")
+        master, workers = make_pd_cluster(store)
+        prefill_w, decode_w = workers
+        monkeypatch.setattr(decode_w, "_pop_staged_chunks",
+                            lambda *a, **k: None)
+        try:
+            status, resp = http_json(
+                "POST", master.http_address, "/v1/completions",
+                {"model": "tiny", "prompt": "lose my chunks",
+                 "max_tokens": 5, "temperature": 0.0,
+                 "ignore_eos": True}, timeout=120.0)
+            assert status == 200, resp
+            assert resp["usage"]["completion_tokens"] == 5
+            assert prefill_w.kv_migration_chunked == 0
+            # Decode adopted via the monolithic retry, not local decode.
+            assert decode_w.primary_runtime().engine.step_count > 0
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
 
     def test_pd_output_equals_single_worker(self, store):
         """Greedy continuation after migration must match a single-worker

@@ -1,6 +1,6 @@
 """KV-migration transport probe: the BASELINE.md north star (KV GB/s).
 
-Two transfer paths exist for PD disaggregation (SURVEY.md §7.3 item 1):
+Three transfer paths exist for PD disaggregation (SURVEY.md §7.3 item 1):
 
 - **direct** — both engines live in one process on one host's devices;
   the exported page block stays a device array and lands in the decode
@@ -9,8 +9,13 @@ Two transfer paths exist for PD disaggregation (SURVEY.md §7.3 item 1):
 - **host shuttle** — the cross-process wire path
   (device_get → meta+raw bytes → HTTP → frombuffer → device_put scatter,
   runtime/worker.py ``_serve_pd_prefill``/``_serve_kv_import``).
+- **pipelined host shuttle** — the round-5 chunked variant of the same
+  wire (worker ``_shuttle_send_chunks`` → ``/kv/chunk``): the block is
+  sliced along L, every D2H copy starts async up front, and chunks
+  stream host→device as their bytes land, overlapping the two tunnel
+  directions.
 
-``probe_kv_migration`` measures both on the live hardware with
+``probe_kv_migration`` measures all three on the live hardware with
 pool-layout-identical engines, so deployments (and bench.py) can record
 ``kv_migration_gbps`` instead of guessing. The HTTP hop itself is not
 simulated — the host path here measures the serialize/deserialize +
@@ -31,9 +36,10 @@ from xllm_service_tpu.runtime.engine import Engine, _kv_scatter
 
 def probe_kv_migration(src: Engine, dst: Engine, n_pages: int = 16,
                        iters: int = 5) -> Dict[str, float]:
-    """Move an ``n_pages`` KV block src→dst via both paths, ``iters``
-    timed reps each (one warmup). Engines must share pool layout.
-    Returns {"bytes", "direct_gbps", "host_gbps"}."""
+    """Move an ``n_pages`` KV block src→dst via all three paths,
+    ``iters`` timed reps each (one warmup). Engines must share pool
+    layout. Returns {"bytes", "pages", "direct_gbps", "host_gbps",
+    "host_pipelined_gbps"}."""
     ks, vs = src.kv
     if ks.shape[0:1] + ks.shape[2:] != \
             dst.kv[0].shape[0:1] + dst.kv[0].shape[2:]:
@@ -79,12 +85,39 @@ def probe_kv_migration(src: Engine, dst: Engine, n_pages: int = 16,
                              jnp.asarray(v2).astype(vd.dtype))
         _sync()
 
+    def host_pipelined_once() -> None:
+        # The round-5 chunked shuttle (worker._shuttle_send_chunked):
+        # slice the block along L, start EVERY device→host copy async up
+        # front, then stream chunks host→device as their bytes land — the
+        # tunnel's D2H of chunk i+1 overlaps the H2D of chunk i instead
+        # of the two directions strictly alternating on one monolith.
+        kd, vd = dst.kv
+        kb, vb = ks[:, src_idx], vs[:, src_idx]
+        L = int(kb.shape[0])
+        C = max(2, min(L, 8))
+        bounds = [(i * L // C, (i + 1) * L // C) for i in range(C)]
+        parts = [(kb[lo:hi], vb[lo:hi]) for lo, hi in bounds if hi > lo]
+        for pk, pv in parts:
+            pk.copy_to_host_async()
+            pv.copy_to_host_async()
+        up = []
+        for pk, pv in parts:
+            k_host = np.asarray(pk)            # completes the async D2H
+            v_host = np.asarray(pv)
+            up.append((jnp.asarray(k_host).astype(kd.dtype),
+                       jnp.asarray(v_host).astype(vd.dtype)))
+        k2 = jnp.concatenate([u[0] for u in up], axis=0)
+        v2 = jnp.concatenate([u[1] for u in up], axis=0)
+        dst.kv = _kv_scatter(kd, vd, dst_idx, k2, v2)
+        _sync()
+
     # Report the EFFECTIVE page count: callers print this next to the
     # bandwidth, and a silently clamped request must not claim a larger
     # measured block than was moved.
     out: Dict[str, float] = {"bytes": float(nbytes),
                              "pages": float(n_pages)}
-    for name, fn in (("direct", direct_once), ("host", host_once)):
+    for name, fn in (("direct", direct_once), ("host", host_once),
+                     ("host_pipelined", host_pipelined_once)):
         fn()                                   # warmup / compile
         t0 = time.monotonic()
         for _ in range(iters):

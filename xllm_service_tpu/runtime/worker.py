@@ -33,9 +33,10 @@ import threading
 
 import time
 import weakref
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from xllm_service_tpu.config import (
@@ -472,6 +473,7 @@ class Worker:
         router.route("POST", "/flip_role", self._serve_flip_role)
         router.route("POST", "/cancel", self._serve_cancel)
         router.route("POST", "/kv/import", self._serve_kv_import)
+        router.route("POST", "/kv/chunk", self._serve_kv_chunk)
         router.route("POST", "/encode", self._serve_encode)
         router.route("POST", "/v1/embeddings", self._serve_embeddings)
         self._router = router
@@ -493,6 +495,12 @@ class Worker:
         self.kv_migration_seconds = 0.0
         self.kv_migration_direct = 0    # device-to-device (no host copy)
         self.kv_migration_device_wire = 0  # cross-process PJRT transfer
+        self.kv_migration_chunked = 0   # pipelined host-shuttle sends
+        # Decode-side staging for the chunked shuttle: srid → parts.
+        # TTL-evicted (a prefill that died mid-send must not pin device
+        # buffers forever).
+        self._kv_chunk_staging: Dict[str, Dict[str, Any]] = {}
+        self._kv_chunk_mu = threading.Lock()
         # Decode peers that proved unable to pull the device wire (424):
         # stop offering and take the host shuttle straight away.
         self._wire_refused: set = set()
@@ -508,7 +516,7 @@ class Worker:
             max_concurrency=lambda: self.opts.max_concurrency,
             admission_exempt=_ADMISSION_EXEMPT + (
                 "/sleep", "/wakeup", "/cancel", "/flip_role",
-                "/fork_master", "/kv/import", "/encode"))
+                "/fork_master", "/kv/import", "/kv/chunk", "/encode"))
         self.name = self._srv.address
 
         self._loop_thread = threading.Thread(
@@ -1263,6 +1271,8 @@ class Worker:
                      f"{self.kv_migration_direct}")
         lines.append(f"xllm_worker_kv_migration_device_wire_total "
                      f"{self.kv_migration_device_wire}")
+        lines.append(f"xllm_worker_kv_migration_chunked_total "
+                     f"{self.kv_migration_chunked}")
         from xllm_service_tpu.runtime.kv_wire import peek_device_wire
         wire = peek_device_wire()
         if wire is not None:
@@ -1580,8 +1590,11 @@ class Worker:
             return self._migrate_direct(live, rt, srid, peer)
 
         wire = self._kv_wire_for(decode_name)
+        # Export stays ON DEVICE for every transport: the wire pulls it
+        # directly, and the chunked shuttle needs device slices to
+        # overlap its D2H copies with the socket sends.
         with self._engine_lock:
-            exported = rt.engine.export_held(srid, device=wire is not None)
+            exported = rt.engine.export_held(srid, device=True)
         if exported is None:
             return Response.error(500, "prefill KV export failed")
         tokens, k, v = exported
@@ -1590,12 +1603,10 @@ class Worker:
                                              tokens, k, v, wire)
             if resp is not None:
                 return resp
-            # Wire handshake failed or the peer can't pull — downgrade
-            # the exported device block to host bytes and take the
-            # shuttle below (the held entry is already released, so a
-            # re-export is not possible).
-            k = np.asarray(jax.device_get(k))
-            v = np.asarray(jax.device_get(v))
+            # Wire handshake failed or the peer can't pull — fall
+            # through to the host shuttle (the held entry is already
+            # released, so a re-export is not possible; k/v stay valid
+            # device arrays).
 
         t0 = time.monotonic()
         meta = {
@@ -1610,9 +1621,60 @@ class Worker:
             "dtype": str(k.dtype),
             "stream": live.stream,
         }
-        payload = (json.dumps(stamp(meta)).encode("utf-8") + b"\n"
-                   + k.tobytes() + v.tobytes())
         from xllm_service_tpu.service.httpd import http_stream
+
+        # Pipelined chunked shuttle first: every D2H copy is started
+        # async up front, each chunk POSTs as its bytes land, and the
+        # decode side device_puts chunks on arrival — both tunnel
+        # directions stay busy instead of one monolithic get→send→put
+        # chain. Falls back to the monolithic shuttle on any miss.
+        k_host = v_host = None
+        total, chunk_bytes = self._shuttle_send_chunks(
+            decode_name, srid, k, v)
+        if total:
+            head = b""
+            chunks = iter(())
+            try:
+                chunks = http_stream(
+                    "POST", decode_name, "/kv/import",
+                    obj=stamp({**meta, "chunked": {"total": total}}),
+                    timeout=self.opts.request_timeout_s)
+                head = next(chunks, b"")
+            except Exception as e:  # noqa: BLE001 — peer unreachable
+                logger.warning("chunked kv import to %s failed (%s); "
+                               "decoding locally", decode_name, e)
+                k_host = np.asarray(jax.device_get(k))
+                v_host = np.asarray(jax.device_get(v))
+                return self._local_decode_fallback(live, tokens, k_host,
+                                                   v_host)
+            parsed = self._parse_import_head(head)
+            err = ((parsed or {}).get("error") or {})
+            msg = err.get("message", "") if isinstance(err, dict) else ""
+            if parsed is None or parsed.get("status") == "accepted":
+                self.kv_migration_bytes += chunk_bytes
+                self.kv_migration_seconds += time.monotonic() - t0
+                self.kv_migration_chunked += 1
+                return self._finish_migration(
+                    live, decode_name, tokens, head, chunks, parsed,
+                    lambda: (np.asarray(jax.device_get(k)),
+                             np.asarray(jax.device_get(v))))
+            if not msg.startswith("chunks-missing"):
+                # Genuine refusal (no capacity / model asleep) — the
+                # monolithic retry would meet the same answer.
+                logger.warning("kv import rejected by %s (%r); decoding "
+                               "locally", decode_name, head[:120])
+                k_host = np.asarray(jax.device_get(k))
+                v_host = np.asarray(jax.device_get(v))
+                return self._local_decode_fallback(live, tokens, k_host,
+                                                   v_host)
+            logger.warning("chunked staging incomplete on %s; retrying "
+                           "monolithic", decode_name)
+
+        if k_host is None:
+            k_host = np.asarray(jax.device_get(k))
+            v_host = np.asarray(jax.device_get(v))
+        payload = (json.dumps(stamp(meta)).encode("utf-8") + b"\n"
+                   + k_host.tobytes() + v_host.tobytes())
         head = b""
         chunks = iter(())
         try:
@@ -1623,12 +1685,146 @@ class Worker:
         except Exception as e:  # noqa: BLE001 — decode instance unreachable
             logger.warning("kv migration to %s failed (%s); decoding "
                            "locally", decode_name, e)
-            return self._local_decode_fallback(live, tokens, k, v)
+            return self._local_decode_fallback(live, tokens, k_host,
+                                               v_host)
         self.kv_migration_bytes += len(payload)
         self.kv_migration_seconds += time.monotonic() - t0
         return self._finish_migration(
             live, decode_name, tokens, head, chunks,
-            self._parse_import_head(head), lambda: (k, v))
+            self._parse_import_head(head),
+            lambda: (k_host, v_host))
+
+    def _shuttle_send_chunks(self, decode_name: str, srid: str,
+                             k, v) -> Tuple[int, int]:
+        """Pipelined half of the host shuttle: slice the exported device
+        block along the layer axis, start EVERY device→host copy async
+        up front, then POST each chunk to the decode side's /kv/chunk as
+        its bytes land (which device_puts on arrival, overlapping the
+        opposite tunnel direction). Returns (chunk count, bytes sent) on
+        success, (0, 0) when chunking is off / not worthwhile / any POST
+        failed (the caller then takes the monolithic path; TTL eviction
+        clears any partially-staged chunks on the peer). The byte count
+        is the CALLER's to commit, and only on an accepted import — a
+        fallback to the monolithic shuttle after these sends must not
+        count the same KV block twice in the bandwidth gauge."""
+        try:
+            chunk_mb = float(os.environ.get("XLLM_KV_SHUTTLE_CHUNK_MB",
+                                            "32"))
+        except ValueError:
+            chunk_mb = 32.0
+        if chunk_mb <= 0 or not hasattr(k, "copy_to_host_async"):
+            return 0, 0
+        L = int(k.shape[0])
+        layer_bytes = 2 * int(np.prod(k.shape[1:])) * k.dtype.itemsize
+        per_chunk = max(1, int(chunk_mb * 1e6) // max(layer_bytes, 1))
+        n = (L + per_chunk - 1) // per_chunk
+        if n < 2:
+            return 0, 0         # one chunk ⇒ nothing to overlap
+        bounds = [(i * per_chunk, min(L, (i + 1) * per_chunk))
+                  for i in range(n)]
+        try:
+            parts = [(k[lo:hi], v[lo:hi]) for lo, hi in bounds]
+            for pk, pv in parts:
+                pk.copy_to_host_async()
+                pv.copy_to_host_async()
+        except Exception as e:  # noqa: BLE001 — backend quirk → monolith
+            logger.info("chunked shuttle slicing failed (%s); "
+                        "monolithic", e)
+            return 0, 0
+        from xllm_service_tpu.service.httpd import http_stream_status
+        sent = 0
+        for idx, ((lo, hi), (pk, pv)) in enumerate(zip(bounds, parts)):
+            k_host = np.asarray(pk)           # completes the async D2H
+            v_host = np.asarray(pv)
+            meta = stamp({
+                "service_request_id": srid,
+                "idx": idx, "total": n, "lo": lo, "hi": hi,
+                "shape": list(k_host.shape), "dtype": str(k_host.dtype),
+            })
+            payload = (json.dumps(meta).encode("utf-8") + b"\n"
+                       + k_host.tobytes() + v_host.tobytes())
+            try:
+                status, body = http_stream_status(
+                    "POST", decode_name, "/kv/chunk", raw=payload,
+                    timeout=self.opts.request_timeout_s)
+                body.close()
+            except Exception as e:  # noqa: BLE001 — peer miss → monolith
+                logger.info("kv chunk %d/%d to %s failed (%s)",
+                            idx + 1, n, decode_name, e)
+                return 0, 0
+            if status != 200:
+                # Older peer (404) or refusal: monolithic fallback.
+                logger.info("kv chunk %d/%d refused by %s (HTTP %d)",
+                            idx + 1, n, decode_name, status)
+                return 0, 0
+            sent += len(payload)
+        return n, sent
+
+    def _serve_kv_chunk(self, req: Request) -> Response:
+        """Decode-side staging of one pipelined-shuttle chunk: bytes →
+        device_put (async H2D — the upload proceeds while the prefill
+        side reads its next chunk) under (srid, idx). The final
+        /kv/import with a ``chunked`` manifest assembles and adopts."""
+        return self._guarded(self._serve_kv_chunk_inner, req)
+
+    def _serve_kv_chunk_inner(self, req: Request) -> Response:
+        nl = req.body.find(b"\n")
+        if nl < 0:
+            return Response.error(400, "missing meta line")
+        try:
+            meta = json.loads(req.body[:nl].decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            return Response.error(400, f"bad meta: {e}")
+        check_version(meta, "kv_chunk")
+        import ml_dtypes
+        dtype = (ml_dtypes.bfloat16 if meta["dtype"] == "bfloat16"
+                 else np.dtype(meta["dtype"]))
+        shape = tuple(meta["shape"])
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        blob = req.body[nl + 1:]
+        if len(blob) != 2 * nbytes:
+            return Response.error(400, f"chunk size mismatch: "
+                                       f"{len(blob)} != {2 * nbytes}")
+        k_np = np.frombuffer(blob[:nbytes], dtype=dtype).reshape(shape)
+        v_np = np.frombuffer(blob[nbytes:], dtype=dtype).reshape(shape)
+        # device_put is async: the H2D upload overlaps the prefill
+        # side's next D2H + send. (np arrays are copied by the runtime,
+        # so the request body buffer may be freed immediately.)
+        k_dev = jax.device_put(k_np)
+        v_dev = jax.device_put(v_np)
+        srid = meta["service_request_id"]
+        now = time.monotonic()
+        with self._kv_chunk_mu:
+            self._evict_stale_chunks_locked(now)
+            entry = self._kv_chunk_staging.setdefault(
+                srid, {"t": now, "total": int(meta["total"]),
+                       "parts": {}})
+            entry["t"] = now
+            entry["parts"][int(meta["idx"])] = (k_dev, v_dev)
+        return Response.json({"status": "staged"})
+
+    def _evict_stale_chunks_locked(self, now: float,
+                                   ttl: float = 60.0) -> None:
+        """Drop staging entries whose final /kv/import never came (a
+        prefill worker that died mid-send must not pin device buffers).
+        Caller holds _kv_chunk_mu."""
+        for srid in [s for s, e in self._kv_chunk_staging.items()
+                     if now - e["t"] > ttl]:
+            del self._kv_chunk_staging[srid]
+            logger.warning("evicted stale kv-chunk staging for %s", srid)
+
+    def _pop_staged_chunks(self, srid: str, total: int):
+        """Assemble a completed chunk set into (k, v) device arrays, or
+        None when any part is missing (prefill retries monolithic)."""
+        with self._kv_chunk_mu:
+            entry = self._kv_chunk_staging.pop(srid, None)
+        if entry is None or entry["total"] != total \
+                or len(entry["parts"]) != total:
+            return None
+        parts = [entry["parts"][i] for i in range(total)]
+        k = jnp.concatenate([p[0] for p in parts], axis=0)
+        v = jnp.concatenate([p[1] for p in parts], axis=0)
+        return k, v
 
     @staticmethod
     def _parse_import_head(head: bytes) -> Optional[Dict[str, Any]]:
@@ -2052,16 +2248,30 @@ class Worker:
         return self._guarded(self._serve_kv_import_inner, req)
 
     def _serve_kv_import_inner(self, req: Request) -> Response:
+        # Two body forms: meta-line + raw KV bytes (monolithic shuttle),
+        # or a bare JSON object (device-wire ticket / chunked manifest —
+        # no bytes on this request).
         nl = req.body.find(b"\n")
-        if nl < 0:
-            return Response.error(400, "missing meta line")
+        head = req.body[:nl] if nl >= 0 else req.body
         try:
-            meta = json.loads(req.body[:nl].decode("utf-8"))
+            meta = json.loads(head.decode("utf-8"))
         except (ValueError, UnicodeDecodeError) as e:
             return Response.error(400, f"bad meta: {e}")
         check_version(meta, "kv_import")
+        chunked = meta.get("chunked")
         tr = meta.get("transfer")
-        if tr is not None:
+        if chunked is not None:
+            # Pipelined shuttle: the KV arrived earlier as /kv/chunk
+            # parts already device_put; assemble them. A 424 with the
+            # chunks-missing prefix tells the prefill side a monolithic
+            # retry is worthwhile (vs a capacity refusal, which is not).
+            got = self._pop_staged_chunks(meta["service_request_id"],
+                                          int(chunked.get("total", 0)))
+            if got is None:
+                return Response.error(
+                    424, "chunks-missing: staging incomplete or expired")
+            k, v = got
+        elif tr is not None:
             # Device wire: the body carries a pull ticket, not bytes —
             # fetch the staged block device-to-device from the prefill
             # worker's transfer server. A 424 tells the prefill side to
@@ -2186,6 +2396,11 @@ class Worker:
         hb_failures = 0
         while not self._stop.wait(self.opts.heartbeat_interval_s):
             try:
+                # Periodic sweep of orphaned chunked-shuttle staging —
+                # lazy eviction alone never fires on an idle decode
+                # worker, pinning a dead prefill's device KV forever.
+                with self._kv_chunk_mu:
+                    self._evict_stale_chunks_locked(time.monotonic())
                 if self._lease_id is not None:
                     self.store.lease_keepalive(self._lease_id)
                 if self._service_config_stale:
