@@ -36,6 +36,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from xllm_service_tpu.utils.jaxcache import enable_compile_cache
+enable_compile_cache()
+
 
 def _scan_slope(build_fn, n_lo: int, n_hi: int) -> float:
     """ms per iteration of ``body`` = slope between a ``n_lo``- and a

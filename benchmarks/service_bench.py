@@ -146,27 +146,60 @@ class FakeWorker:
 
 
 def run(num_requests: int, concurrency: int, n_workers: int,
-        gen_tokens: int, stream: bool) -> Dict:
-    store = InMemoryStore()
-    opts = ServiceOptions(
-        http_port=0, rpc_port=0,
-        load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
-        heartbeat_interval_s=0.5, master_upload_interval_s=0.5)
-    master = Master(opts, store=store).start()
+        gen_tokens: int, stream: bool, store_kind: str = "mem") -> Dict:
+    """``store_kind='native-etcd'`` routes every coordination operation
+    (leases, keepalives, watches, master upload) through the native
+    etcd-v3-gateway server (csrc/xllm_etcd.cpp) over real sockets — the
+    deployable topology — so the req/s number includes the coordination
+    plane's hot-path overhead instead of an in-memory dict's."""
+    etcd_srv = None
+    side_stores: List = []
+    store_factory = None
+    store = None
+    master = None
     workers: List[FakeWorker] = []
     try:
-        return _measure(master, workers, store, num_requests, concurrency,
-                        n_workers, gen_tokens, stream)
+        if store_kind == "native-etcd":
+            from xllm_service_tpu.service.etcd_native import NativeEtcdServer
+            from xllm_service_tpu.service.etcd_store import EtcdStore
+            etcd_srv = NativeEtcdServer().start()
+            store = EtcdStore(etcd_srv.address)
+
+            def store_factory():
+                s = EtcdStore(etcd_srv.address)
+                side_stores.append(s)
+                return s
+        else:
+            store = InMemoryStore()
+        opts = ServiceOptions(
+            http_port=0, rpc_port=0,
+            load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
+            heartbeat_interval_s=0.5, master_upload_interval_s=0.5)
+        master = Master(opts, store=store).start()
+        out = _measure(master, workers, store, num_requests, concurrency,
+                       n_workers, gen_tokens, stream,
+                       store_factory=store_factory)
+        out["detail"]["store"] = store_kind
+        return out
     finally:
         for w in workers:
             w.stop()
-        master.stop()
-        store.close()
+        if master is not None:
+            master.stop()
+        for s in side_stores:
+            s.close()
+        if store is not None:
+            store.close()
+        if etcd_srv is not None:
+            etcd_srv.stop()
 
 
 def _measure(master, workers, store, num_requests, concurrency,
-             n_workers, gen_tokens, stream) -> Dict:
-    workers.extend(FakeWorker(store, master.rpc_address, gen_tokens)
+             n_workers, gen_tokens, stream, store_factory=None) -> Dict:
+    # Each fake worker gets its own store connection when a factory is
+    # given (native-etcd leg: one socket per worker, like a real fleet).
+    mk = store_factory or (lambda: store)
+    workers.extend(FakeWorker(mk(), master.rpc_address, gen_tokens)
                    for _ in range(n_workers))
     deadline = time.monotonic() + 15
     while time.monotonic() < deadline:
@@ -633,7 +666,15 @@ def main() -> None:
                     help="run N service replicas as separate OS "
                          "processes against a shared store (horizontal "
                          "scaling leg)")
+    ap.add_argument("--store", choices=["mem", "native-etcd"],
+                    default="mem",
+                    help="coordination plane: in-memory dict or the "
+                         "native etcd-v3-gateway server over sockets")
     args = ap.parse_args()
+    if args.store != "mem" and (args.service_procs > 0 or args.overload):
+        ap.error("--store native-etcd is only wired into the single-"
+                 "process leg; the --service-procs and --overload legs "
+                 "run on their own store plane")
     if args.service_procs > 0:
         print(json.dumps(run_multiproc(
             args.requests, args.concurrency, args.workers,
@@ -647,7 +688,7 @@ def main() -> None:
             args.worker_delay_ms)))
         return
     print(json.dumps(run(args.requests, args.concurrency, args.workers,
-                         args.gen_tokens, args.stream)))
+                         args.gen_tokens, args.stream, args.store)))
 
 
 if __name__ == "__main__":

@@ -10,7 +10,10 @@ import sys
 import jax
 import jax.numpy as jnp
 
+
 sys.path.insert(0, "/root/repo")
+from xllm_service_tpu.utils.jaxcache import enable_compile_cache
+enable_compile_cache()
 import dataclasses as dc
 
 from xllm_service_tpu.config import EngineConfig, ModelConfig

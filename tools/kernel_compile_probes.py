@@ -9,7 +9,10 @@ import sys
 import jax
 import jax.numpy as jnp
 
+
 sys.path.insert(0, "/root/repo")
+from xllm_service_tpu.utils.jaxcache import enable_compile_cache
+enable_compile_cache()
 from xllm_service_tpu.ops.pallas.paged_attention import (
     _paged_decode_attention_impl, _paged_decode_attention_mr_impl,
     _paged_decode_attention_wide_impl)

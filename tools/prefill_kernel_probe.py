@@ -9,7 +9,10 @@ import sys
 import jax
 import jax.numpy as jnp
 
+
 sys.path.insert(0, "/root/repo")
+from xllm_service_tpu.utils.jaxcache import enable_compile_cache
+enable_compile_cache()
 from xllm_service_tpu.ops.pallas.prefill_attention import _impl
 
 B, T, Hq, Hkv, D = 2, 256, 32, 8, 64

@@ -97,6 +97,23 @@ class WorkerOptions:
     murmur_seed: int = 0
 
 
+def _decode_kv_blob(meta: Dict[str, Any], blob: bytes):
+    """Decode one KV wire body (monolithic /kv/import or one /kv/chunk):
+    ``blob`` is k-bytes then v-bytes at ``meta``'s shape/dtype. Raises
+    ValueError on a size mismatch (the HTTP 400 text)."""
+    import ml_dtypes
+    dtype = (ml_dtypes.bfloat16 if meta["dtype"] == "bfloat16"
+             else np.dtype(meta["dtype"]))
+    shape = tuple(meta["shape"])
+    nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    if len(blob) != 2 * nbytes:
+        raise ValueError(
+            f"payload size mismatch: {len(blob)} != {2 * nbytes}")
+    k = np.frombuffer(blob[:nbytes], dtype=dtype).reshape(shape)
+    v = np.frombuffer(blob[nbytes:], dtype=dtype).reshape(shape)
+    return k, v
+
+
 def _mm_meta(req) -> Optional[Dict[str, Any]]:
     """Multimodal state for a migration meta line (None for text): the
     vision embeddings, splice positions, and mrope prompt streams the
@@ -1673,6 +1690,10 @@ class Worker:
         if k_host is None:
             k_host = np.asarray(jax.device_get(k))
             v_host = np.asarray(jax.device_get(v))
+        # Host copies made: drop the device refs now instead of pinning
+        # 2x block-size of HBM through the POST + stream-head wait (and,
+        # for concurrent migrations, each other).
+        k = v = None
         payload = (json.dumps(stamp(meta)).encode("utf-8") + b"\n"
                    + k_host.tobytes() + v_host.tobytes())
         head = b""
@@ -1733,9 +1754,12 @@ class Worker:
             return 0, 0
         from xllm_service_tpu.service.httpd import http_stream_status
         sent = 0
-        for idx, ((lo, hi), (pk, pv)) in enumerate(zip(bounds, parts)):
+        for idx, (lo, hi) in enumerate(bounds):
+            pk, pv = parts[idx]
+            parts[idx] = None                 # free each slice post-copy
             k_host = np.asarray(pk)           # completes the async D2H
             v_host = np.asarray(pv)
+            pk = pv = None
             meta = stamp({
                 "service_request_id": srid,
                 "idx": idx, "total": n, "lo": lo, "hi": hi,
@@ -1776,17 +1800,10 @@ class Worker:
         except (ValueError, UnicodeDecodeError) as e:
             return Response.error(400, f"bad meta: {e}")
         check_version(meta, "kv_chunk")
-        import ml_dtypes
-        dtype = (ml_dtypes.bfloat16 if meta["dtype"] == "bfloat16"
-                 else np.dtype(meta["dtype"]))
-        shape = tuple(meta["shape"])
-        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
-        blob = req.body[nl + 1:]
-        if len(blob) != 2 * nbytes:
-            return Response.error(400, f"chunk size mismatch: "
-                                       f"{len(blob)} != {2 * nbytes}")
-        k_np = np.frombuffer(blob[:nbytes], dtype=dtype).reshape(shape)
-        v_np = np.frombuffer(blob[nbytes:], dtype=dtype).reshape(shape)
+        try:
+            k_np, v_np = _decode_kv_blob(meta, req.body[nl + 1:])
+        except ValueError as e:
+            return Response.error(400, str(e))
         # device_put is async: the H2D upload overlaps the prefill
         # side's next D2H + send. (np arrays are copied by the runtime,
         # so the request body buffer may be freed immediately.)
@@ -2288,17 +2305,10 @@ class Worker:
             except Exception as e:  # noqa: BLE001 — failed mid-pull
                 return Response.error(424, f"wire-pull: {e}")
         else:
-            import ml_dtypes
-            dtype = (ml_dtypes.bfloat16 if meta["dtype"] == "bfloat16"
-                     else np.dtype(meta["dtype"]))
-            shape = tuple(meta["shape"])
-            nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
-            blob = req.body[nl + 1:]
-            if len(blob) != 2 * nbytes:
-                return Response.error(400, f"payload size mismatch: "
-                                           f"{len(blob)} != {2 * nbytes}")
-            k = np.frombuffer(blob[:nbytes], dtype=dtype).reshape(shape)
-            v = np.frombuffer(blob[nbytes:], dtype=dtype).reshape(shape)
+            try:
+                k, v = _decode_kv_blob(meta, req.body[nl + 1:])
+            except ValueError as e:
+                return Response.error(400, str(e))
 
         ok, live, first_out, rt = self.adopt_migrated(meta, k, v)
         if rt is None:
@@ -2474,6 +2484,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         # worker still probes (and can hang on) the TPU tunnel.
         import jax as _jax
         _jax.config.update("jax_platforms", "cpu")
+    else:
+        # Same persistent compile cache as bench.py / the ladder tools:
+        # a worker booting after a bench session re-loads the identical
+        # engine programs instead of re-paying minutes-per-program
+        # tunnel compiles during warmup (registration-time TTFT).
+        from xllm_service_tpu.utils.jaxcache import enable_compile_cache
+        enable_compile_cache()
 
     parser = argparse.ArgumentParser(
         description="xllm-service-tpu worker (TPU engine instance)")

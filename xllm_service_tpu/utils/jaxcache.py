@@ -1,0 +1,32 @@
+"""Shared JAX compilation-cache setup for every chip-touching tool.
+
+Through the tunneled TPU backend a single compile can take minutes
+(docs/PERF_NOTES.md round 3: one session measured >1609 s for ~4
+programs); the axon backend is proven to serialize executables into the
+persistent cache. Caching in ONE directory shared by bench.py, the
+conviction-ladder tools, and the probes means any compile paid once in a
+session is free for every later process — in particular the driver's
+end-of-round bench resumes from whatever the builder session compiled.
+
+Call before the first jit compilation; safe everywhere (falls back to
+uncached on any error, e.g. a backend that cannot serialize).
+"""
+
+from __future__ import annotations
+
+import os
+
+CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache")
+
+
+def enable_compile_cache(cache_dir: str = CACHE_DIR) -> None:
+    import jax
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
