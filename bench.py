@@ -54,19 +54,18 @@ _CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _enable_compile_cache() -> None:
-    """Persist compiled executables across processes/sessions. Through the
-    tunneled TPU backend a single compile can take minutes; the cache is
-    the difference between a bench that fits its budget and one that dies
-    in warmup. Cache misses behave exactly as before, so this is safe even
-    if the experimental backend cannot serialize executables."""
-    import jax
+    """Persist compiled executables across processes/sessions (shared
+    helper: xllm_service_tpu/utils/jaxcache.py — same .jax_cache/ dir as
+    the conviction-ladder tools and the worker). Through the tunneled TPU
+    backend a single compile can take minutes; the cache is the
+    difference between a bench that fits its budget and one that dies in
+    warmup."""
     try:
-        os.makedirs(_CACHE_DIR, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from xllm_service_tpu.utils.jaxcache import enable_compile_cache
     except Exception:  # noqa: BLE001 — cache is an optimization only
-        pass
+        return
+    enable_compile_cache(_CACHE_DIR)
 
 
 def _emit(obj) -> None:
@@ -191,7 +190,10 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     from xllm_service_tpu.runtime.engine import Engine, EngineRequest
     from xllm_service_tpu.utils.types import SamplingParams
 
-    _enable_compile_cache()
+    if not (force_cpu or os.environ.get("JAX_PLATFORMS") == "cpu"):
+        # Tunnel runs only: the CPU AOT cache path spams feature-mismatch
+        # warnings and carries a SIGILL caveat (utils/jaxcache.py).
+        _enable_compile_cache()
     if force_cpu:
         # The site hook pins jax_platforms="axon,cpu" at import, which
         # overrides the JAX_PLATFORMS env var — only an explicit config

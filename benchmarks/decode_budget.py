@@ -40,6 +40,15 @@ from xllm_service_tpu.utils.jaxcache import enable_compile_cache
 enable_compile_cache()
 
 
+def _mark(name, value) -> None:
+    """Stream each component's result to stderr AS IT LANDS: through the
+    tunnel a full run is ~30 slow remote compiles, and the 08:30 round-5
+    attempt lost 2h10m of convictions when the tunnel died before the
+    final JSON line. Partial lines make every completed slope durable."""
+    import sys
+    print(f"PARTIAL {name} = {value}", file=sys.stderr, flush=True)
+
+
 def _scan_slope(build_fn, n_lo: int, n_hi: int) -> float:
     """ms per iteration of ``body`` = slope between a ``n_lo``- and a
     ``n_hi``-iteration scan of it, one host readback each.
@@ -69,8 +78,8 @@ def _prefill_budget(args, rng) -> dict:
     from xllm_service_tpu.models import transformer
     from xllm_service_tpu.ops import attention as att
     from xllm_service_tpu.ops import pallas as pallas_mod
-    from xllm_service_tpu.ops.pallas.prefill_attention import _impl \
-        as prefill_kernel_impl
+    from xllm_service_tpu.ops.pallas.prefill_attention import (
+        paged_prefill_attention_pallas)
     from xllm_service_tpu.runtime.engine import Engine
 
     import dataclasses as dc
@@ -119,6 +128,7 @@ def _prefill_budget(args, rng) -> dict:
 
     out["full_step_ms"] = round(
         _scan_slope(full_build, 1, max(args.n_lo, 3)), 2)
+    _mark("prefill.full_step_ms", out["full_step_ms"])
 
     # One layer's attention, both paths, q/k/v random at layer shapes.
     q = jnp.asarray(rng.normal(size=(B, T, Hq, D)), dt)
@@ -133,7 +143,7 @@ def _prefill_budget(args, rng) -> dict:
         return att.mha_prefill_auto(qi, k_all, v_all, kv_lens, start)
 
     def kernel_attn(qi):
-        return prefill_kernel_impl(
+        return paged_prefill_attention_pallas(
             qi, kf, vf, kp, vp, pt, start, lens, q_block=128,
             interpret=pallas_mod.default_interpret())
 
@@ -153,6 +163,7 @@ def _prefill_budget(args, rng) -> dict:
         except Exception as exc:  # noqa: BLE001
             out[name + "_layer_ms"] = \
                 f"error: {type(exc).__name__}: {exc}"
+        _mark("prefill." + name + "_layer_ms", out[name + "_layer_ms"])
 
     # Post-scan all-layer scatter of the fresh ys.
     k_new = jnp.asarray(rng.normal(size=(L, B, T, Hkv, D)), dt)
@@ -170,6 +181,7 @@ def _prefill_budget(args, rng) -> dict:
 
     out["kv_scatter_ms"] = round(
         _scan_slope(scat_build, args.n_lo, args.n_hi), 3)
+    _mark("prefill.kv_scatter_ms", out["kv_scatter_ms"])
 
     # MXU reference: the layer's matmul tower (qkv + o + mlp) x L, no
     # attention math — what the step would cost if matmul-bound.
@@ -205,6 +217,7 @@ def _prefill_budget(args, rng) -> dict:
 
     out["matmul_tower_ms"] = round(
         _scan_slope(tower_build, args.n_lo, args.n_hi), 3)
+    _mark("prefill.matmul_tower_ms", out["matmul_tower_ms"])
     return out
 
 
@@ -319,6 +332,7 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — a kernel that fails to
             # lower must not hide the others' numbers
             detail[name + "_ms"] = f"error: {type(exc).__name__}: {exc}"
+        _mark(name + "_ms", detail[name + "_ms"])
 
     # All-layer KV scatter, as the engine issues it once per decode step.
     k_all = jnp.asarray(rng.normal(size=(L, B, Hkv, D)), dt)
@@ -346,6 +360,8 @@ def main() -> None:
     if not args.no_decode:
         detail["kv_scatter_all_layers_ms"] = round(
             _scan_slope(scatter_build, args.n_lo, args.n_hi), 4)
+        _mark("kv_scatter_all_layers_ms",
+              detail["kv_scatter_all_layers_ms"])
 
     # lm_head + greedy argmax tail.
     h0 = jnp.asarray(rng.normal(size=(B, D * Hq)), dt)
@@ -366,6 +382,7 @@ def main() -> None:
     if not args.no_decode:
         detail["lm_head_greedy_ms"] = round(
             _scan_slope(head_build, args.n_lo, args.n_hi), 4)
+        _mark("lm_head_greedy_ms", detail["lm_head_greedy_ms"])
 
     if args.prefill:
         detail["prefill"] = _prefill_budget(args, rng)

@@ -131,7 +131,14 @@ class TestEtcdStore:
         if request.param == "real":
             addr = os.environ.get("XLLM_ETCD_ADDR")
             if not addr:
-                pytest.skip("XLLM_ETCD_ADDR not set")
+                # Environment-blocked, verified round 5: no etcd/etcdctl
+                # binary anywhere in the image, no Go toolchain, zero
+                # egress — stock etcd cannot be obtained or built here.
+                # The native server (csrc/xllm_etcd.cpp) is the
+                # deployable coordination plane; point XLLM_ETCD_ADDR at
+                # a real quorum to run this leg.
+                pytest.skip("XLLM_ETCD_ADDR not set "
+                            "(no etcd binary obtainable in this image)")
             client = EtcdStore(addr)
             client.delete_prefix("XLLMTEST:")
             yield client

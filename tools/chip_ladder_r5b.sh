@@ -1,0 +1,113 @@
+#!/bin/bash
+# Round-5 watcher, second arming (reviewed). The 08:30 ladder reached
+# the chip and compiled ~2h10m, then the TUNNEL dropped (:8103 gone,
+# ~10:45 UTC) leaving the ladder chain hung on a dead socket. This
+# watcher probes in killable subprocesses; on contact it reaps the
+# stale dead-transport chain recorded in .ladder_stale_pid (pid +
+# cmdline-identity pattern per line; safe — the round-3 wedge pattern
+# was killing a client with a LIVE session), runs the conviction queue,
+# then a watchdogged bench. Every queue item runs in the background
+# with a deadline babysitter: if the item outlives the quiet window the
+# watcher records it as the new stale pid and stands down WITHOUT
+# killing it (wedge discipline), so the driver's snapshot never races a
+# chip holder and a future watcher can reap it.
+set -u
+cd /root/repo
+DEADLINE_EPOCH="${DEADLINE_EPOCH:?set to round-end unix time}"
+QUIET_S="${QUIET_S:-4500}"
+
+# Singleton: one watcher per repo.
+exec 9> /root/repo/.ladder_watch.lock
+flock -n 9 || { echo "watcher already running" >&2; exit 1; }
+
+probe() {
+  timeout 90 python - </dev/null 2>/dev/null <<'PYEOF'
+import subprocess, sys
+try:
+    p = subprocess.run([sys.executable, '-c',
+                        'import jax; print(jax.devices()[0].device_kind)'],
+                       capture_output=True, text=True, timeout=80)
+    print((p.stdout or '').strip())
+except Exception:
+    pass
+PYEOF
+}
+
+log() { echo "$(date -u +%H:%M:%S) $*" >> /root/repo/ladder.log; }
+
+reap_stale() {
+  [ -f .ladder_stale_pid ] || return 0
+  while read -r sp pat; do
+    [ -n "${sp:-}" ] || continue
+    if [ -r "/proc/$sp/cmdline" ] \
+        && tr '\0' ' ' < "/proc/$sp/cmdline" | grep -qE "${pat:-.}"; then
+      log "r5b: reaping stale dead-transport pid $sp"
+      kill -9 "$sp" 2>/dev/null
+    fi
+  done < .ladder_stale_pid
+  rm -f .ladder_stale_pid
+}
+
+# Runs "$1" in background; waits until done OR the quiet window starts.
+# Returns 0 if it finished, 1 if the watcher must stand down (the still-
+# running pid has been recorded for the next watcher).
+run_bounded() {
+  bash -c "$1" </dev/null &
+  local qpid=$!
+  while kill -0 "$qpid" 2>/dev/null; do
+    local now left
+    now=$(date +%s); left=$((DEADLINE_EPOCH - now))
+    if [ "$left" -le "$QUIET_S" ]; then
+      echo "$qpid ." >> .ladder_stale_pid
+      log "r5b: item pid $qpid outlived the window - recorded, standing down"
+      return 1
+    fi
+    sleep 20
+  done
+  wait "$qpid" 2>/dev/null
+  return 0
+}
+
+log "r5b watcher armed (deadline=$DEADLINE_EPOCH quiet=$QUIET_S)"
+while :; do
+  now=$(date +%s)
+  left=$((DEADLINE_EPOCH - now))
+  if [ "$left" -le "$QUIET_S" ]; then
+    log "r5b: inside quiet window ($left s left) - standing down"
+    exit 0
+  fi
+  out=$(probe)
+  log "r5b probe: $out"
+  if echo "$out" | grep -q "TPU"; then
+    log "r5b: CHIP CONTACT with $left s left"
+    touch /root/repo/.chip_contact_r5
+    reap_stale
+    if [ "$left" -gt $((QUIET_S + 2400)) ] && [ -f tools/chip_queue_r5.txt ]; then
+      n=0
+      while IFS= read -r cmd <&8; do
+        case "$cmd" in ''|'#'*) continue;; esac
+        n=$((n + 1))
+        now=$(date +%s); left=$((DEADLINE_EPOCH - now))
+        if [ "$left" -le $((QUIET_S + 2100)) ]; then
+          log "r5b: queue item $n skipped (only $left s left)"
+          continue
+        fi
+        log "r5b: queue[$n] START: $cmd"
+        run_bounded "$cmd >> /root/repo/chip_queue_r5.log 2>&1" \
+          || exit 0
+        log "r5b: queue[$n] done"
+      done 8< tools/chip_queue_r5.txt
+    fi
+    now=$(date +%s); left=$((DEADLINE_EPOCH - now))
+    if [ "$left" -gt $((QUIET_S + 1800)) ]; then
+      run_bounded "BENCH_WATCHDOG_S=$((left - QUIET_S - 600)) python bench.py > /root/repo/bench_r5_tpu.log 2>&1" \
+        && log "r5b: bench done - chip idle" \
+        || exit 0
+    else
+      log "r5b: no time for bench (left=$left)"
+    fi
+    log "r5b: LADDER DATA READY"
+    exit 0
+  fi
+  sleep 300
+done

@@ -22,6 +22,12 @@ CACHE_DIR = os.path.join(
 
 
 def enable_compile_cache(cache_dir: str = CACHE_DIR) -> None:
+    # CPU-pinned processes skip the cache: XLA's CPU AOT deserialization
+    # spams machine-feature-mismatch warnings (internal prefer-no-scatter
+    # pseudo-features) and carries a SIGILL caveat, while the cache's
+    # entire value here is amortizing minutes-long TUNNEL compiles.
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return
     import jax
     try:
         os.makedirs(cache_dir, exist_ok=True)
