@@ -246,6 +246,11 @@ def main() -> None:
                          "find out where the seconds go)")
     ap.add_argument("--no-decode", action="store_true",
                     help="skip the decode components (prefill-only run)")
+    ap.add_argument("--essential", action="store_true",
+                    help="only the owner-question components (XLA gather "
+                         "+ the default (B,pages) kernel + scatter + "
+                         "lm_head): ~10 fewer tunnel compiles than the "
+                         "full five-variant kernel A/B")
     args = ap.parse_args()
 
     from xllm_service_tpu.ops import attention as att
@@ -317,6 +322,9 @@ def main() -> None:
             _paged_decode_attention_wide_impl, interpret=interpret),
     }
 
+    if args.essential:
+        keep = ("attn_xla_gather", "attn_pallas_grid")
+        variants = {k: v for k, v in variants.items() if k in keep}
     detail = {"shape": {"B": B, "Hq": Hq, "Hkv": Hkv, "D": D,
                         "page_size": ps, "table_width": MP,
                         "ctx_tokens": ctx_tokens, "layers": L},
