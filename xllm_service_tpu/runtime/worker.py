@@ -93,6 +93,13 @@ class WorkerOptions:
     # server (runtime/kv_wire.py). Auto-degrades to the host shuttle on
     # backends that can't serve transfers; off pins the host shuttle.
     pd_device_wire: bool = True
+    # Pre-compile every steady-state engine program (and, for multimodal
+    # models, the vision tower) BEFORE self-registration, so no routed
+    # request ever pays a compile: through the tunneled TPU backend one
+    # compile is minutes — first-request TTFT would blow the SLO by two
+    # orders of magnitude. None = auto (on for TPU backends, off on CPU
+    # where tests boot dozens of workers and compiles are cheap anyway).
+    warmup: Optional[bool] = None
     seed: int = 0
     murmur_seed: int = 0
 
@@ -547,9 +554,50 @@ class Worker:
     # ------------------------------------------------------------------
     # Lifecycle
     # ------------------------------------------------------------------
+    @staticmethod
+    def _warmup_extended() -> bool:
+        return os.environ.get("XLLM_WARMUP_EXTENDED", "1") != "0"
+
+    def _should_warmup(self) -> bool:
+        if self.opts.warmup is not None:
+            return self.opts.warmup
+        try:
+            return jax.devices()[0].platform == "tpu"
+        except Exception:  # noqa: BLE001 — backend init failure
+            return False
+
+    def _warmup_all(self) -> None:
+        """Registered = ready: compile every steady-state program before
+        the instance becomes routable (the reference's engine arrives
+        warmed; here the engine is in-repo so the worker owns it)."""
+        for name, rt in self.runtimes.items():
+            if rt.engine is None:
+                continue
+            # Engines are single-threaded and warmup drives DONATED-KV
+            # jitted steps: the HTTP server is already up (start() binds
+            # it first), so a concurrent /sleep or KV export racing an
+            # in-flight warmup step would use-after-donate the pool —
+            # hold the same lock every other engine toucher holds.
+            with self._engine_lock:
+                t = rt.engine.warmup(extended=self._warmup_extended())
+            logger.info("engine warmup for %s: %.1fs", name, t)
+        # Vision tower (fixed serve-time grid = exactly one program):
+        # without this the FIRST image request pays the tower compile.
+        if any(rt.model_cfg.is_mrope for rt in self.runtimes.values()):
+            t0 = time.monotonic()
+            try:
+                self.encode_images(["random:0"])
+                logger.info("vision warmup: %.1fs",
+                            time.monotonic() - t0)
+            except Exception as e:  # noqa: BLE001 — a missing tower dir
+                # must not block a text-only deployment of a VLM config
+                logger.warning("vision warmup skipped: %s", e)
+
     def start(self) -> "Worker":
         self._srv.start()
         _LOCAL_WORKERS[self.name] = self
+        if self._should_warmup():
+            self._warmup_all()
         self._register()
         # Failover-follow is only for workers CONFIGURED with a service in
         # front: a deliberately standalone worker sharing the store must
@@ -1325,6 +1373,15 @@ class Worker:
             return Response.error(404, f"model {model} not on this worker")
         with self._engine_lock:
             rt.wakeup()
+            if self._should_warmup():
+                # Scoped only (never the extended sweep): _engine_lock is
+                # worker-wide, so this stalls every model on the worker
+                # for its duration. Warm wakes re-load from the
+                # persistent cache in seconds; a cold wake of a
+                # fork-staged model compiles just the scoped handful,
+                # and rarer shapes lazily compile as before (visible in
+                # the recompile counters).
+                rt.engine.warmup(extended=False)
         self._work_event.set()
         return Response.json({"ok": True, "model": model,
                               "state": rt.state})
@@ -2515,6 +2572,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--max-model-len", type=int, default=2048)
     parser.add_argument("--max-batch-size", type=int, default=8)
     parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--warmup", dest="warmup", default=None,
+                        action="store_true",
+                        help="pre-compile all engine programs before "
+                             "registration (default: auto — on for TPU)")
+    parser.add_argument("--no-warmup", dest="warmup",
+                        action="store_false")
     parser.add_argument("--dp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--ep", type=int, default=1)
@@ -2561,7 +2624,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         model_dir=args.model_dir,
         heartbeat_interval_s=args.heartbeat_interval_s,
         lease_ttl_s=3 * args.heartbeat_interval_s,
-        enable_profiling=args.enable_profiling)
+        enable_profiling=args.enable_profiling, warmup=args.warmup)
     worker = Worker(opts, store, engine_cfg=engine_cfg, mesh=mesh).start()
     logger.info("worker %s serving model %s (type %s)",
                 worker.name, args.model, args.instance_type)
