@@ -52,7 +52,12 @@ reap_stale() {
 # Returns 0 if it finished, 1 if the watcher must stand down (the still-
 # running pid has been recorded for the next watcher).
 run_bounded() {
-  bash -c "$1" </dev/null &
+  # Stray output appends to chip_queue_r5.log at the OUTER process level
+  # so a queue item's own '> file' redirect wins for its output instead
+  # of being overridden (concatenating '>>' INSIDE the -c string after
+  # the item's redirects would truncate the item's file and steal its
+  # output — reviewed failure).
+  bash -c "$1" </dev/null >> /root/repo/chip_queue_r5.log 2>&1 &
   local qpid=$!
   while kill -0 "$qpid" 2>/dev/null; do
     local now left
@@ -93,8 +98,7 @@ while :; do
           continue
         fi
         log "r5b: queue[$n] START: $cmd"
-        run_bounded "$cmd >> /root/repo/chip_queue_r5.log 2>&1" \
-          || exit 0
+        run_bounded "$cmd" || exit 0
         log "r5b: queue[$n] done"
       done 8< tools/chip_queue_r5.txt
     fi
