@@ -33,6 +33,20 @@ import threading
 import time
 from typing import Dict, List
 
+import os as _os
+
+_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+
+
+def _child_env(**extra):
+    """Subprocess env for replicas/helpers: repo root PREPENDED to any
+    caller-supplied PYTHONPATH (never clobbered), CPU pinned."""
+    pp = _os.environ.get("PYTHONPATH", "")
+    return dict(_os.environ,
+                PYTHONPATH=_REPO_ROOT + (_os.pathsep + pp if pp else ""),
+                JAX_PLATFORMS="cpu", **extra)
+
+
 from xllm_service_tpu.config import (
     InstanceType, LoadBalancePolicyType, ServiceOptions)
 from xllm_service_tpu.service.coordination import (
@@ -318,7 +332,7 @@ def _spawn_service(store_addr: str):
     import subprocess
     import sys
 
-    env = dict(os.environ, PYTHONPATH=os.getcwd(), JAX_PLATFORMS="cpu")
+    env = _child_env()
     proc = subprocess.Popen(
         [sys.executable, "-m", "xllm_service_tpu.service.master",
          "--host", "127.0.0.1", "--http-port", "0", "--rpc-port", "0",
@@ -360,7 +374,7 @@ def _spawn_helper(args: List[str]):
     import subprocess
     import sys
     import tempfile
-    env = dict(os.environ, PYTHONPATH=os.getcwd(), JAX_PLATFORMS="cpu")
+    env = _child_env()
     # stderr to a file, not a pipe (an unread pipe fills and blocks the
     # helper mid-bench) — read back only to diagnose a dead helper.
     errf = tempfile.NamedTemporaryFile(
@@ -379,8 +393,8 @@ def worker_host_main(store_addr: str, master_rpc: str, n_workers: int,
     worker-side request handling doesn't share an interpreter with the
     bench clients. Prints READY, then serves until stdin closes."""
     import sys
-    from xllm_service_tpu.service.coordination_net import RemoteStore
-    store = RemoteStore(store_addr)
+    from xllm_service_tpu.service.coordination_net import connect_store
+    store = connect_store(store_addr)
     workers = [FakeWorker(store, master_rpc, gen_tokens)
                for _ in range(n_workers)]
     print("READY", flush=True)
@@ -401,7 +415,8 @@ def client_shard_main(addrs: List[str], num_requests: int,
 
 def run_multiproc(num_requests: int, concurrency: int, n_workers: int,
                   gen_tokens: int, stream: bool, n_procs: int,
-                  client_procs: int = 4) -> Dict:
+                  client_procs: int = 4,
+                  store_kind: str = "mem") -> Dict:
     """The horizontal-scaling leg: N service replicas as separate OS
     processes (each with its own GIL) against one shared store — the
     Python answer to the reference's brpc event-loop concurrency, and
@@ -412,21 +427,29 @@ def run_multiproc(num_requests: int, concurrency: int, n_workers: int,
     1 until the harness itself was sharded)."""
     from xllm_service_tpu.service.coordination_net import StoreServer
 
-    store_srv = StoreServer().start()
     procs: List = []
     helpers: List = []
+    store_srv = None
     try:
+        if store_kind == "native-etcd":
+            from xllm_service_tpu.service.etcd_native import (
+                NativeEtcdServer)
+            store_srv = NativeEtcdServer().start()
+            store_addr = "etcd://" + store_srv.address
+        else:
+            store_srv = StoreServer().start()
+            store_addr = store_srv.address
         # Append each replica to `procs` AS it boots: if a later spawn
         # raises, the finally block must still reap the earlier ones.
         spawned = []
         for _ in range(n_procs):
-            s = _spawn_service(store_srv.address)
+            s = _spawn_service(store_addr)
             procs.append(s[0])
             spawned.append(s)
         addrs = [s[1] for s in spawned]
         master_rpc = next((s[2] for s in spawned if s[3]), spawned[0][2])
 
-        wh = _spawn_helper(["--worker-host", store_srv.address,
+        wh = _spawn_helper(["--worker-host", store_addr,
                             master_rpc, str(n_workers), str(gen_tokens)])
         helpers.append(wh)
         if wh.stdout.readline().strip() != "READY":
@@ -504,6 +527,7 @@ def run_multiproc(num_requests: int, concurrency: int, n_workers: int,
                 "num_requests": num_requests,
                 "concurrency": shard_conc * len(shards),
                 "service_procs": n_procs,
+                "store": store_kind,
                 "client_procs": len(shards),
                 "workers": n_workers, "gen_tokens": gen_tokens,
                 "errors": errors,
@@ -535,7 +559,8 @@ def run_multiproc(num_requests: int, concurrency: int, n_workers: int,
                 os.unlink(h.err_path)
             except (OSError, AttributeError):
                 pass
-        store_srv.stop()
+        if store_srv is not None:
+            store_srv.stop()
 
 
 def overload_run(max_concurrency: int, offered_levels: List[int],
@@ -671,14 +696,14 @@ def main() -> None:
                     help="coordination plane: in-memory dict or the "
                          "native etcd-v3-gateway server over sockets")
     args = ap.parse_args()
-    if args.store != "mem" and (args.service_procs > 0 or args.overload):
-        ap.error("--store native-etcd is only wired into the single-"
-                 "process leg; the --service-procs and --overload legs "
-                 "run on their own store plane")
+    if args.store != "mem" and args.overload:
+        ap.error("--store native-etcd is not wired into the --overload "
+                 "leg")
     if args.service_procs > 0:
         print(json.dumps(run_multiproc(
             args.requests, args.concurrency, args.workers,
-            args.gen_tokens, args.stream, args.service_procs)))
+            args.gen_tokens, args.stream, args.service_procs,
+            store_kind=args.store)))
         return
     if args.overload:
         levels = [args.max_concurrency // 2, args.max_concurrency,
