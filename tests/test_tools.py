@@ -1,0 +1,87 @@
+"""Chip-ops tooling that runs UNATTENDED between the conviction ladder
+and the headline bench (tools/act_on_convictions.py): wrong decisions
+here silently serve the driver's end-of-round bench with the wrong
+kernels, so the decision table is pinned."""
+
+import importlib.util
+import os
+
+_SPEC = importlib.util.spec_from_file_location(
+    "act_on_convictions",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools", "act_on_convictions.py"))
+aoc = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(aoc)
+
+ALL_PREFILL_OK = "\n".join(
+    f"PREFILL KERNEL [{form}]: COMPILE OK"
+    for form in ("plain", "window", "softcap+scale", "sinks",
+                 "gptoss window+sinks"))
+
+
+class TestDecide:
+    def test_prefill_flips_on_compile_plus_win(self):
+        env = aoc.decide(ALL_PREFILL_OK, {
+            "prefill.attn_xla_gather_layer_ms": 300.0,
+            "prefill.attn_pallas_kernel_layer_ms": 20.0})
+        assert env == {"XLLM_PALLAS_PREFILL": "1"}
+
+    def test_prefill_stays_off_on_loss(self):
+        env = aoc.decide(ALL_PREFILL_OK, {
+            "prefill.attn_xla_gather_layer_ms": 20.0,
+            "prefill.attn_pallas_kernel_layer_ms": 300.0})
+        assert env == {}
+
+    def test_prefill_stays_off_on_any_compile_fail(self):
+        probes = ALL_PREFILL_OK.replace(
+            "PREFILL KERNEL [sinks]: COMPILE OK",
+            "PREFILL KERNEL [sinks]: FAIL: Mosaic lowering")
+        env = aoc.decide(probes, {
+            "prefill.attn_pallas_kernel_layer_ms": 1.0})
+        assert env == {}
+
+    def test_negative_gather_slope_treated_as_missing(self):
+        # A scan slope can come out negative at noise level; the kernel
+        # still flips on its own positive number + clean compiles.
+        env = aoc.decide(ALL_PREFILL_OK, {
+            "prefill.attn_xla_gather_layer_ms": -0.002,
+            "prefill.attn_pallas_kernel_layer_ms": 0.5})
+        assert env.get("XLLM_PALLAS_PREFILL") == "1"
+
+    def test_decode_variant_needs_compile_and_ten_pct(self):
+        probes = ALL_PREFILL_OK + "\nV4 multirow x8: COMPILE OK\n" \
+                                  "V5 wide: COMPILE OK"
+        budget = {"attn_pallas_grid_ms": 0.20,
+                  "attn_pallas_grid_v2_ms": 0.05,   # wins but no compile
+                  "attn_pallas_multirow_v4x8_ms": 0.12,
+                  "attn_pallas_wide_v5_ms": 0.19}   # <10% win
+        env = aoc.decide(probes, budget)
+        assert env.get("XLLM_PALLAS_DECODE_V4") == "8"
+        assert "XLLM_PALLAS_DECODE_V2" not in env
+        assert "XLLM_PALLAS_DECODE_V5" not in env
+
+    def test_empty_inputs_no_decisions(self):
+        assert aoc.decide("", {}) == {}
+
+
+class TestBudgetParsing:
+    def test_partial_lines_and_final_json_merge(self, tmp_path):
+        p = tmp_path / "budget.log"
+        p.write_text(
+            "PARTIAL attn_pallas_grid_ms = 0.5\n"
+            '{"metric": "decode_budget", "value": 1, "detail": '
+            '{"attn_xla_gather_ms": 0.7, '
+            '"prefill": {"full_step_ms": 9.0}}}\n')
+        vals = aoc._budget_values(str(p))
+        assert vals["attn_pallas_grid_ms"] == 0.5
+        assert vals["attn_xla_gather_ms"] == 0.7
+        assert vals["prefill.full_step_ms"] == 9.0
+
+    def test_newest_log_with_data_wins(self, tmp_path):
+        old = tmp_path / "full.log"
+        new = tmp_path / "essential.log"
+        old.write_text("PARTIAL attn_pallas_grid_ms = 9.9\n")
+        new.write_text("PARTIAL attn_pallas_grid_ms = 0.1\n")
+        os.utime(old, (1, 1))
+        vals = aoc._budget_values(str(old), str(new))
+        assert vals["attn_pallas_grid_ms"] == 0.1
