@@ -342,3 +342,53 @@ class TestFactoryFallback:
         nat = _mk(router, max_concurrency=3)
         assert nat.admission is not None and nat.admission.active == 0
         nat.stop()
+
+
+class TestHandlerPool:
+    """Hybrid dispatch: a bounded reuse pool for the steady state, with
+    overflow to fresh per-request threads whenever every pool thread is
+    busy — long-poll/stream handlers pinning pool threads must never
+    make later requests queue behind them (StoreServer /watch blocks
+    30 s; the admission limit is live and can exceed the boot-time pool
+    size)."""
+
+    def test_requests_beyond_pool_cap_are_not_queued(self):
+        gate = threading.Event()
+        started = []
+        router = Router()
+
+        def slow(r):
+            started.append(time.monotonic())
+            gate.wait(10)
+            return Response.json({"ok": True})
+
+        router.route("POST", "/slow", slow)
+        srv = _mk(router)
+        srv._pool_cap = 2        # shrink the reuse pool for the test
+        try:
+            results = []
+
+            def client():
+                conn = http.client.HTTPConnection(srv.address, timeout=15)
+                conn.request("POST", "/slow", body=b"{}")
+                results.append(conn.getresponse().status)
+                conn.close()
+
+            clients = [threading.Thread(target=client) for _ in range(6)]
+            for c in clients:
+                c.start()
+            # All six handlers must be RUNNING concurrently (2 pooled +
+            # 4 overflow threads) despite the cap — none parked in the
+            # executor queue behind the gate.
+            deadline = time.monotonic() + 5
+            while len(started) < 6 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert len(started) == 6, \
+                f"only {len(started)} handlers running; rest queued"
+            gate.set()
+            for c in clients:
+                c.join(timeout=10)
+            assert results.count(200) == 6
+        finally:
+            gate.set()
+            srv.stop()
