@@ -67,6 +67,21 @@ def _scan_slope(build_fn, n_lo: int, n_hi: int) -> float:
     return 1e3 * (times[n_hi] - times[n_lo]) / (n_hi - n_lo)
 
 
+def _page_table(B: int, n_tokens: int, ps: int, P: int):
+    """Per-row distinct live pages covering ``n_tokens`` KV slots PLUS
+    the next write position (the +1 page: a decode at position
+    n_tokens-1 writes into the last mapped page; forgetting the +1 maps
+    the write to NULL page 0 where mode="drop" silently discards it —
+    the degeneracy main() used to work around ad hoc). Page 0 = NULL
+    padding; width rounded to pow2 like the engine's table buckets."""
+    need = -(-(n_tokens + 1) // ps)
+    MP = 1 << max(need - 1, 0).bit_length()
+    pt = np.zeros((B, MP), np.int32)
+    for b in range(B):
+        pt[b, :need] = 1 + ((np.arange(need) + b * need) % (P - 1))
+    return jnp.asarray(pt), MP
+
+
 def _prefill_budget(args, rng) -> dict:
     """Decompose one prefill call at the headline bench shape (B=32
     prompts x T=128 tokens; llama3-1b geometry): the full jitted program
@@ -102,12 +117,7 @@ def _prefill_budget(args, rng) -> dict:
     P = ecfg.num_pages
     L, Hq, Hkv = cfg.num_layers, cfg.num_heads, cfg.num_kv_heads
     D = cfg.head_dim
-    need = -(-(T + 1) // ps)
-    MP = 1 << max(need - 1, 0).bit_length()
-    pt = np.zeros((B, MP), np.int32)
-    for b in range(B):
-        pt[b, :need] = 1 + ((np.arange(need) + b * need) % (P - 1))
-    pt = jnp.asarray(pt)
+    pt, MP = _page_table(B, T, ps, P)
     tokens = jnp.asarray(
         rng.integers(0, cfg.vocab_size, size=(B, T)), jnp.int32)
     start = jnp.zeros((B,), jnp.int32)
@@ -129,6 +139,45 @@ def _prefill_budget(args, rng) -> dict:
     out["full_step_ms"] = round(
         _scan_slope(full_build, 1, max(args.n_lo, 3)), 2)
     _mark("prefill.full_step_ms", out["full_step_ms"])
+
+    # The COMPOSED decode step at the DECODE bench shape (--batch/--ctx
+    # — deliberately NOT the prefill-leg shape above; it reads the
+    # random-init pool through its own larger table, which prices the
+    # same HBM traffic): the number the standalone decode component
+    # slopes must explain. Residue = this − (L × attn_layer +
+    # kv_scatter + lm_head + weight reads) = glue (rope, norms,
+    # sampling, ys stacking). Lives here only because this leg owns the
+    # Engine; main() re-parents it to the detail top level.
+    if not args.no_decode:
+        Bd = args.batch if not args.small else 4
+        ctx_d = args.ctx if not args.small else 24
+        ptd, _ = _page_table(Bd, ctx_d, ps, P)
+        tok_d = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(Bd,)), jnp.int32)
+        # Last WRITTEN position (page mapped by the +1 in _page_table);
+        # position ctx_d with an unmapped page would silently drop the
+        # KV scatter and understate the step.
+        pos_d = jnp.full((Bd,), ctx_d - 1, jnp.int32)
+        act_d = jnp.ones((Bd,), bool)
+
+        def dec_build(n):
+            @jax.jit
+            def run():
+                def body(carry, _):
+                    tok, kv = carry
+                    logits, kv2 = transformer.forward_decode(
+                        params, cfg, tok, pos_d, act_d, kv, ptd)
+                    return (jnp.argmax(logits, -1).astype(jnp.int32),
+                            kv2), ()
+                (tok_fin, kv_fin), _ = jax.lax.scan(
+                    body, (tok_d, kv0), None, length=n)
+                return tok_fin[0] + kv_fin[0][0, 1, 0, 0, 0].astype(
+                    jnp.int32)
+            return run
+
+        out["decode_full_step_ms"] = round(
+            _scan_slope(dec_build, args.n_lo, args.n_hi), 3)
+        _mark("decode_full_step_ms", out["decode_full_step_ms"])
 
     # One layer's attention, both paths, q/k/v random at layer shapes.
     q = jnp.asarray(rng.normal(size=(B, T, Hq, D)), dt)
@@ -268,20 +317,13 @@ def main() -> None:
         B, Hq, Hkv, D, ps, L, V = args.batch, 32, 8, 64, 64, 16, 128256
         P = 1024
     ctx_tokens = args.ctx if not args.small else 24
-    MP = max(1, -(-(ctx_tokens + 1) // ps))
-    MP = 1 << (MP - 1).bit_length()
     interpret = pallas_mod.default_interpret()
 
     rng = np.random.default_rng(0)
     dt = jnp.bfloat16
     k_pages = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), dt)
     v_pages = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), dt)
-    # Distinct live pages per row, page 0 = NULL padding.
-    pt = np.zeros((B, MP), np.int32)
-    need = -(-ctx_tokens // ps)
-    for b in range(B):
-        pt[b, :need] = 1 + ((np.arange(need) + b * need) % (P - 1))
-    pt = jnp.asarray(pt)
+    pt, MP = _page_table(B, ctx_tokens, ps, P)
     ctx = jnp.full((B,), ctx_tokens, jnp.int32)
     q0 = jnp.asarray(rng.normal(size=(B, Hq, D)), dt)
     kc = jnp.asarray(rng.normal(size=(B, Hkv, D)), dt)
@@ -394,6 +436,9 @@ def main() -> None:
 
     if args.prefill:
         detail["prefill"] = _prefill_budget(args, rng)
+        if "decode_full_step_ms" in detail["prefill"]:
+            detail["decode_full_step_ms"] = \
+                detail["prefill"].pop("decode_full_step_ms")
 
     # Weight-read floor for context: params bytes / HBM bandwidth.
     params_b = 1.24e9 * 2 if not args.small else 0
