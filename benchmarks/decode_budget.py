@@ -193,7 +193,7 @@ def _prefill_budget(args, rng) -> dict:
 
     def kernel_attn(qi):
         return paged_prefill_attention_pallas(
-            qi, kf, vf, kp, vp, pt, start, lens, q_block=128,
+            qi, kf, vf, kp, vp, pt, start, lens,
             interpret=pallas_mod.default_interpret())
 
     for name, fn in (("attn_xla_gather", gather_attn),

@@ -1,0 +1,136 @@
+"""Offline Mosaic verdicts for EVERY Pallas kernel form (v5e, no chip).
+
+The tunnel-dependent probes (tools/prefill_kernel_probe.py,
+tools/kernel_compile_probes.py) queued behind chip contact for three
+rounds; this runs the identical compile checks through the local
+libtpu topology (tools/aot_tpu.py) so the Mosaic half of the
+validate-the-kernels demand is answered regardless of tunnel health.
+Shapes match the probes' bench geometry exactly.
+
+Prints one verdict line per form (same COMPILE OK / FAIL grammar the
+act_on_convictions parser reads) plus a JSON summary; write the output
+to kernel_probes_r5.log to feed the hands-free bench gating.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+
+from tools.aot_tpu import aot_compile, sds
+
+
+_VERDICTS: dict = {}
+
+
+def _probe(name, fn, args, key=None, **kw):
+    """``key`` names the distinct program; forms that trace to the SAME
+    program (the prefill window rides as a traced scalar, so "plain" and
+    "window" are byte-identical) share one compile and one verdict."""
+    key = key or name
+    if key not in _VERDICTS:
+        try:
+            aot_compile(functools.partial(fn, **kw) if kw else fn, args)
+            _VERDICTS[key] = (True, "")
+        except Exception as e:  # noqa: BLE001 — verdicts, not crashes
+            msg = str(e).replace("\n", " ")   # one LINE per verdict
+            i = msg.find("Mosaic")
+            _VERDICTS[key] = (False, msg[i if i >= 0 else 0:][:300])
+    ok, msg = _VERDICTS[key]
+    print(f"{name}: COMPILE OK" if ok else f"{name}: FAIL: {msg}")
+    return ok
+
+
+def main() -> int:
+    from xllm_service_tpu.ops.pallas.paged_attention import (
+        _paged_decode_attention_impl, _paged_decode_attention_mr_impl,
+        _paged_decode_attention_row_impl,
+        _paged_decode_attention_wide_impl)
+    from xllm_service_tpu.ops.pallas.prefill_attention import _impl
+
+    results = {}
+
+    # ---- prefill kernel, all model-delta forms (probe geometry) ----
+    B, T, Hq, Hkv, D = 2, 256, 32, 8, 64
+    P, PS, MP = 64, 64, 8
+    q = sds((B, T, Hq, D), jnp.bfloat16)
+    kf = sds((B, T, Hkv, D), jnp.bfloat16)
+    kp = sds((P, PS, Hkv, D), jnp.bfloat16)
+    pt = sds((B, MP), jnp.int32)
+    qs = sds((B,), jnp.int32)
+    ln = sds((B,), jnp.int32)
+    win = sds((1,), jnp.int32)
+    sinks = sds((Hq,), jnp.float32)
+    scale = 1.0 / (D ** 0.5)
+    # The window is a TRACED scalar operand, so "plain"/"window" (and
+    # "sinks"/"gptoss window+sinks") trace to identical programs — the
+    # key dedupes their compiles while still printing all five verdict
+    # lines the act_on_convictions parser counts.
+    for name, key, sk, kw in (
+            ("plain", "pf-base", None, {}),
+            ("window", "pf-base", None, {}),
+            ("softcap+scale", "pf-cap", None,
+             dict(logits_soft_cap=50.0, scale=0.0625)),
+            ("sinks", "pf-sinks", sinks, {}),
+            ("gptoss window+sinks", "pf-sinks", sinks, {}),
+    ):
+        results[f"prefill/{name}"] = _probe(
+            f"PREFILL KERNEL [{name}]", _impl,
+            (q, kf, kf, kp, kp, pt, qs, ln, win, sk), key=key,
+            q_block=64, logits_soft_cap=kw.get("logits_soft_cap", 0.0),
+            scale=kw.get("scale", scale), interpret=False)
+
+    # ---- decode kernels, bench geometry ----
+    Bd = 64
+    qd = sds((Bd, Hq, D), jnp.bfloat16)
+    kd = sds((1024, PS, Hkv, D), jnp.bfloat16)
+    ptd = sds((Bd, 8), jnp.int32)
+    ctx = sds((Bd,), jnp.int32)
+    kc = sds((Bd, Hkv, D), jnp.bfloat16)
+    winW = sds((1,), jnp.int32)
+    q_mla = sds((Bd, 16, 576), jnp.bfloat16)
+    k_mla = sds((1024, PS, 1, 576), jnp.bfloat16)
+    kc_mla = sds((Bd, 1, 576), jnp.bfloat16)
+    for name, fn, args, kw in (
+            ("V1 base", _paged_decode_attention_impl,
+             (qd, kd, kd, ptd, ctx, kc, kc), dict(interpret=False)),
+            ("V1 window", _paged_decode_attention_impl,
+             (qd, kd, kd, ptd, ctx, kc, kc, winW, None),
+             dict(interpret=False)),
+            ("V1 window+sinks", _paged_decode_attention_impl,
+             (qd, kd, kd, ptd, ctx, kc, kc, winW, sinks),
+             dict(interpret=False)),
+            ("V2 transpose-free", _paged_decode_attention_impl,
+             (qd, kd, kd, ptd, ctx, kc, kc),
+             dict(interpret=False, transpose_free=True)),
+            ("V3 row", _paged_decode_attention_row_impl,
+             (qd, kd, kd, ptd, ctx, kc, kc), dict(interpret=False)),
+            ("V4 multirow x8", _paged_decode_attention_mr_impl,
+             (qd, kd, kd, ptd, ctx, kc, kc),
+             dict(interpret=False, rows=8)),
+            ("V4 multirow x16", _paged_decode_attention_mr_impl,
+             (qd, kd, kd, ptd, ctx, kc, kc),
+             dict(interpret=False, rows=16)),
+            ("V5 wide", _paged_decode_attention_wide_impl,
+             (qd, kd, kd, ptd, ctx, kc, kc), dict(interpret=False)),
+            ("V1 MLA shape (Hkv=1 D=576)", _paged_decode_attention_impl,
+             (q_mla, k_mla, k_mla, ptd, ctx, kc_mla, kc_mla),
+             dict(interpret=False, scale=0.1)),
+    ):
+        results[f"decode/{name}"] = _probe(name, fn, args, **kw)
+
+    print(json.dumps({"aot_target": "v5e (local libtpu topology)",
+                      "pass": sum(results.values()),
+                      "total": len(results),
+                      "results": results}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,71 @@
+"""Local TPU AOT compilation — Mosaic/XLA validation with NO chip.
+
+Round-5 discovery: the image ships a full local ``libtpu.so`` even
+though the runtime backend is the remote-compile axon tunnel, so
+``jax.experimental.topologies`` can compile v5e executables entirely
+offline. Everything the conviction ladder's compile-only probes wanted
+from the chip — does Mosaic lower each Pallas kernel form, what does
+XLA's cost model say about a program's bytes/flops at TPU lowering
+(no CPU bf16-emulation artifacts) — is available locally, any time,
+regardless of tunnel health. Execution still needs the chip; this is
+the compile half.
+
+Usage:
+    from tools.aot_tpu import aot_compile, sds
+    compiled = aot_compile(fn, arg_shapedtypes)   # raises on Mosaic fail
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Never let this helper touch the (possibly wedged) tunnel: pin CPU as
+# the runtime platform before jax initializes (hard assignment — a
+# caller-exported JAX_PLATFORMS=tpu/axon would otherwise re-open the
+# tunnel this module exists to avoid); the TPU work happens at COMPILE
+# time against the offline topology.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+except Exception:  # noqa: BLE001
+    pass
+
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec  # noqa: E402
+
+_TOPO = None
+_MESH = None
+
+
+def _mesh():
+    global _TOPO, _MESH
+    if _MESH is None:
+        # Single-chip v5e, matching the only real device this
+        # environment can execute on (the host bounds are pinned to one
+        # chip, so a different topology string would be inconsistent).
+        _TOPO = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:1x1",
+            chips_per_host_bounds=(1, 1, 1), num_slices=1)
+        _MESH = topologies.make_mesh(_TOPO, (1,), ("x",))
+    return _MESH
+
+
+def sds(shape, dtype):
+    """ShapeDtypeStruct bound to the offline TPU topology (replicated —
+    single-chip probes)."""
+    return jax.ShapeDtypeStruct(
+        tuple(shape), dtype,
+        sharding=NamedSharding(_mesh(), PartitionSpec()))
+
+
+def aot_compile(fn, args, **jit_kw):
+    """jit → lower → compile ``fn`` for the offline v5e target. Returns
+    the compiled object (``.cost_analysis()`` / ``.as_text()`` work);
+    raises whatever Mosaic/XLA raises on a lowering failure."""
+    return jax.jit(fn, **jit_kw).lower(*args).compile()

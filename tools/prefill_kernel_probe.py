@@ -40,7 +40,7 @@ for name, win, sk, kw in (
 ):
     try:
         jax.jit(lambda *a, kw=kw: _impl(
-            *a, q_block=128, logits_soft_cap=kw.get(
+            *a, q_block=64, logits_soft_cap=kw.get(
                 "logits_soft_cap", 0.0),
             scale=kw.get("scale", SCALE), interpret=False)).lower(
             q, kf, kf, kp, kp, pt, qs, ln, win, sk).compile()

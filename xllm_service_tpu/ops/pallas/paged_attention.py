@@ -553,8 +553,14 @@ def _mr_kernel(ctx_ref, pt_ref, q_ref, *refs, page_size: int,
             preferred_element_type=jnp.float32) * scale
         logits = logits.reshape(rows, hq, page_size)
         pos = page_start + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, page_size), 2)
-        mask = pos < ctxs[:, None, None]                  # [RB, 1, ps]
+            jnp.int32, (1, page_size), 1)                 # [1, ps]
+        # Per-row scalar compares, stacked: reshaping the [RB] ctx
+        # vector to [RB,1,1] is a Mosaic-unlowerable shape cast
+        # ("tpu.reshape vector<8xi32> -> vector<8x1x1xi32>" — offline
+        # v5e AOT probe); scalar-vs-vector broadcasts are fine and RB
+        # is static.
+        mask = jnp.stack([pos < ctx_ref[row0 + r]
+                          for r in range(rows)])          # [RB, 1, ps]
         logits = jnp.where(mask, logits, _NEG_INF)
         m_prev = m_ref[...]                               # [RB, Hq, 1]
         blk_max = jnp.max(logits, axis=-1, keepdims=True)

@@ -56,6 +56,7 @@ from __future__ import annotations
 import functools
 import math
 import os
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -152,8 +153,12 @@ def _kernel(qstart_ref, lens_ref, pt_ref, win_ref, q_ref, kp_ref, vp_ref,
             jnp.int32, (q_block, g, page_size), 0)
         # Pool: valid while pos < q_start. Fresh: valid while the local
         # index < length. Both: causal + inside the sliding window.
-        src_ok = jnp.where(is_pool, kv_pos < q_start,
-                           kv_pos < q_start + length)
+        # Select the scalar THRESHOLD, not the boolean vectors: a select
+        # whose operands are i1 VECTORS is unlegalizable for Mosaic
+        # ("failed to legalize arith.select" on vector<...xi1> — found
+        # by the offline v5e AOT probe, tools/aot_kernel_probes.py).
+        src_limit = jnp.where(is_pool, q_start, q_start + length)
+        src_ok = kv_pos < src_limit
         mask3 = (src_ok & (kv_pos <= q_pos)
                  & (kv_pos > q_pos - w_eff)).reshape(
             1, q_block * g, page_size)                       # [1, QB*G, ps]
@@ -202,7 +207,7 @@ def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
                                    page_table: jnp.ndarray,
                                    q_start: jnp.ndarray,
                                    lengths: jnp.ndarray,
-                                   q_block: int = 128,
+                                   q_block: Optional[int] = None,
                                    interpret: bool = None,
                                    sliding_window=0,
                                    logits_soft_cap: float = 0.0,
@@ -221,6 +226,19 @@ def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
     if interpret is None:
         from xllm_service_tpu.ops import pallas
         interpret = pallas.default_interpret()
+    if q_block is None:
+        # 64 is the shape-safe default: the offline v5e AOT envelope
+        # (tools/aot_kernel_probes.py, round 5) showed q_block=128
+        # blowing XLA's default scoped-VMEM budget at several serving
+        # shapes (incl. B=32/64 with T=128 — the bench prefill shape)
+        # while 64 compiles everywhere tested (T 128-2048, B 1-64).
+        # Override for on-chip A/Bs; 128 also works with
+        # --xla_tpu_scoped_vmem_limit_kib=32768.
+        try:
+            q_block = int(os.environ.get(
+                "XLLM_PALLAS_PREFILL_QBLOCK", "64"))
+        except ValueError:
+            q_block = 64
     win = jnp.asarray(sliding_window, jnp.int32).reshape(1)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
