@@ -122,8 +122,28 @@ def main() -> int:
             ("V1 MLA shape (Hkv=1 D=576)", _paged_decode_attention_impl,
              (q_mla, k_mla, k_mla, ptd, ctx, kc_mla, kc_mla),
              dict(interpret=False, scale=0.1)),
+            ("V1 layered full-pool (L=16)",
+             lambda q, kp, vp, pt, c, k1, v1, l:
+             _paged_decode_attention_impl(
+                 q, kp, vp, pt, c, k1, v1, interpret=False, layer=l),
+             (qd, sds((16, 1024, PS, Hkv, D), jnp.bfloat16),
+              sds((16, 1024, PS, Hkv, D), jnp.bfloat16), ptd, ctx, kc, kc,
+              sds((), jnp.int32)),
+             {}),
     ):
         results[f"decode/{name}"] = _probe(name, fn, args, **kw)
+
+    # ---- the in-place decode KV write (the scatter replacement) ----
+    from xllm_service_tpu.ops.pallas.kv_update import paged_kv_update
+    results["decode/kv_update"] = _probe(
+        "KV UPDATE (in-place write)",
+        lambda kp, vp, knn, vnn, pt, pos, act: paged_kv_update(
+            kp, vp, knn, vnn, pt, pos, act, interpret=False),
+        (sds((16, 1024, PS, Hkv, D), jnp.bfloat16),
+         sds((16, 1024, PS, Hkv, D), jnp.bfloat16),
+         sds((16, Bd, Hkv, D), jnp.bfloat16),
+         sds((16, Bd, Hkv, D), jnp.bfloat16),
+         ptd, ctx, sds((Bd,), jnp.bool_)))
 
     print(json.dumps({"aot_target": "v5e (local libtpu topology)",
                       "pass": sum(results.values()),

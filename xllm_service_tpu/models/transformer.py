@@ -139,6 +139,20 @@ def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
 # — two separate compiled programs, one source of truth)
 # ---------------------------------------------------------------------------
 
+def _layer_unroll() -> int:
+    """Unroll factor for the layer scans (XLLM_UNROLL_LAYERS, default
+    1 = rolled). Round-5 pool-copy experiment knob: XLA cannot prove
+    the post-scan KV write in-place while the pool is read inside a
+    NESTED while loop, so it copies both pools every burst iteration;
+    unrolling exposes straight-line reads the alias analysis can see
+    through."""
+    import os
+    try:
+        return max(1, int(os.environ.get("XLLM_UNROLL_LAYERS", "1")))
+    except ValueError:
+        return 1
+
+
 def _use_prefill_kernel(window: int, page_size: int) -> bool:
     """Trace-time gate for the Pallas flash-prefill kernel: env-enabled
     AND the window tiles exactly into pool pages (engine buckets are pow2
@@ -461,7 +475,7 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         xs = (params["layers"], k_pages, v_pages, rope_arr)
     else:
         xs = (params["layers"], k_pages, v_pages)
-    x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs)
+    x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs, unroll=_layer_unroll())
     k_pages, v_pages = write_prefill_kv_all_layers(
         k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -659,7 +673,7 @@ def forward_embedding(params: Params, cfg: ModelConfig,
         xs = (params["layers"], rope_arr)
     else:
         xs = params["layers"]
-    x, _ = jax.lax.scan(layer, x, xs)
+    x, _ = jax.lax.scan(layer, x, xs, unroll=_layer_unroll())
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps).astype(
         jnp.float32)
     mask = (jnp.arange(T, dtype=jnp.int32)[None] <
@@ -701,17 +715,22 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     win_arr = _layer_windows(cfg)
     rope_arr = _layer_rope(cfg)
 
+    # The attention dispatch gets the FULL 5D pools + a traced layer
+    # scalar: on the Pallas path the kernel's page DMAs index
+    # [L, P, ps, Hkv, D] directly (round-5: a per-layer pool slice
+    # feeding a custom call is MATERIALIZED — 134 MB x 2 pools x layers
+    # per step); the XLA gather fallback slices per layer, which fuses.
     def layer(x, xs):
         ro = None
         if win_arr is not None and rope_arr is not None:
-            lp, kp, vp, w_l, ro = xs
+            lp, li, w_l, ro = xs
         elif win_arr is not None:
-            lp, kp, vp, w_l = xs
+            lp, li, w_l = xs
         elif rope_arr is not None:
-            lp, kp, vp, ro = xs
+            lp, li, ro = xs
             w_l = cfg.sliding_window or 0
         else:
-            lp, kp, vp = xs
+            lp, li = xs
             w_l = cfg.sliding_window or 0
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)                               # [B,1,H,Dh]
@@ -733,10 +752,10 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         # pool write happens once for all layers after the scan (carrying
         # the pool as scan ys would rewrite the whole pool per step).
         attn = paged_decode_attention_current_auto(
-            q[:, 0], kp, vp, page_table, cache_lens,
+            q[:, 0], k_pages, v_pages, page_table, cache_lens,
             k[:, 0], v[:, 0],
             sliding_window=w_l, sinks=lp.get("sinks"),
-            **extras)                                            # [B,Hq,Dh]
+            layer=li, **extras)                                  # [B,Hq,Dh]
         B = tokens.shape[0]
         a = attn.reshape(B, 1, -1) @ lp["o_proj"]
         if "o_bias" in lp:
@@ -753,15 +772,16 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             x = x + m
         return x, (k[:, 0], v[:, 0], dropped)
 
+    li_arr = jnp.arange(cfg.num_layers, dtype=jnp.int32)
     if win_arr is not None and rope_arr is not None:
-        xs = (params["layers"], k_pages, v_pages, win_arr, rope_arr)
+        xs = (params["layers"], li_arr, win_arr, rope_arr)
     elif win_arr is not None:
-        xs = (params["layers"], k_pages, v_pages, win_arr)
+        xs = (params["layers"], li_arr, win_arr)
     elif rope_arr is not None:
-        xs = (params["layers"], k_pages, v_pages, rope_arr)
+        xs = (params["layers"], li_arr, rope_arr)
     else:
-        xs = (params["layers"], k_pages, v_pages)
-    x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs)
+        xs = (params["layers"], li_arr)
+    x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs, unroll=_layer_unroll())
     k_pages, v_pages = write_decode_kv_all_layers(
         k_pages, v_pages, k_new, v_new, page_table, positions, active)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)

@@ -122,6 +122,20 @@ def write_decode_kv(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     return k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape)
 
 
+def _kv_update_kernel_enabled() -> bool:
+    """Gate for the Pallas in-place decode KV write
+    (ops/pallas/kv_update.py): on wherever the Pallas kernels are on
+    (XLLM_PALLAS semantics), with its own off-switch XLLM_PALLAS_KV=0.
+    The XLA scatter it replaces copies BOTH pools around every decode
+    step inside the fused burst (~8.6 GB/step at the bench shape) —
+    the round-5 offline-AOT conviction."""
+    import os
+    if os.environ.get("XLLM_PALLAS_KV", "1") != "1":
+        return False
+    from xllm_service_tpu.ops import pallas
+    return pallas.enabled()
+
+
 def write_decode_kv_all_layers(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
                                k_new: jnp.ndarray, v_new: jnp.ndarray,
                                page_table: jnp.ndarray,
@@ -135,6 +149,23 @@ def write_decode_kv_all_layers(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     which would rewrite the entire pool in HBM every decode step (measured
     ~13 ms/step per GB of pool). One donated scatter after the scan is
     in-place."""
+    L, _, ps_, Hkv_, D_ = k_pages.shape
+    # Kernel eligibility: page tiles must exist (ps % 8), the per-cell
+    # VMEM footprint must fit comfortably (4 pool-tile blocks + 2 new-row
+    # blocks, double-buffered — deep/wide models fall back to the XLA
+    # scatter rather than failing Mosaic allocation), and MLA's
+    # (Hkv=1, D=576) latent shape stays behind the same opt-in as its
+    # attention kernel (XLLM_PALLAS_MLA) until probed on hardware.
+    tile_bytes = L * 8 * Hkv_ * D_ * k_pages.dtype.itemsize
+    row_bytes = L * Hkv_ * D_ * k_new.dtype.itemsize
+    footprint = 2 * (4 * tile_bytes + 2 * row_bytes)
+    mla_shape = Hkv_ == 1 and D_ % 128 != 0
+    if _kv_update_kernel_enabled() and ps_ % 8 == 0 \
+            and footprint < 6 * 2 ** 20 \
+            and not mla_shape:
+        from xllm_service_tpu.ops.pallas.kv_update import paged_kv_update
+        return paged_kv_update(k_pages, v_pages, k_new, v_new,
+                               page_table, positions, active)
     L = k_pages.shape[0]
     page_size = k_pages.shape[2]
     num_slots = k_pages.shape[1] * page_size
@@ -458,18 +489,30 @@ def paged_decode_attention_current_auto(q, k_pages, v_pages, page_table,
                                         cache_lens, k_cur, v_cur,
                                         logits_soft_cap: float = 0.0,
                                         sliding_window=0, scale=None,
-                                        sinks=None):
+                                        sinks=None, layer=None):
     """Trace-time dispatch for the current-token variant. The base (V1)
     Pallas kernel implements the full model-delta surface — windowed
     masks (static or traced per-layer), Gemma soft-cap and scale
     overrides, GPT-OSS sinks — so SWA families ride the kernel path too
-    (round-4 verdict item 3)."""
+    (round-4 verdict item 3).
+
+    ``layer`` (traced int32 scalar) + FULL 5D pools routes the kernel's
+    page DMAs straight into [L, P, ps, Hkv, D] — no per-layer pool
+    slice for XLA to materialize (134 MB x 2 pools x layers per decode
+    step, the round-5 offline-AOT conviction). The XLA fallback slices
+    locally (its gather fuses; nothing materializes)."""
     from xllm_service_tpu.ops import pallas
     if pallas.enabled():
         return pallas.paged_decode_attention_pallas(
             q, k_pages, v_pages, page_table, cache_lens,
             k_cur=k_cur, v_cur=v_cur, sliding_window=sliding_window,
-            logits_soft_cap=logits_soft_cap, scale=scale, sinks=sinks)
+            logits_soft_cap=logits_soft_cap, scale=scale, sinks=sinks,
+            layer=layer)
+    if layer is not None:
+        k_pages = jax.lax.dynamic_index_in_dim(
+            k_pages, layer, axis=0, keepdims=False)
+        v_pages = jax.lax.dynamic_index_in_dim(
+            v_pages, layer, axis=0, keepdims=False)
     return paged_decode_attention_current(
         q, k_pages, v_pages, page_table, cache_lens, k_cur, v_cur,
         logits_soft_cap, sliding_window, scale, sinks)

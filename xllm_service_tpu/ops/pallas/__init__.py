@@ -48,7 +48,17 @@ def default_interpret() -> bool:
     """Kernel ``interpret=None`` resolution, shared by every kernel: run
     under the Pallas interpreter anywhere but a real TPU (so XLLM_PALLAS=1
     on CPU exercises kernel paths in tests instead of crashing in
-    Mosaic)."""
+    Mosaic). ``XLLM_PALLAS_INTERPRET=0`` forces REAL Mosaic lowering
+    regardless of the runtime platform — required by the offline v5e
+    AOT checks (tools/aot_engine_check.py), whose runtime backend is the
+    pinned CPU while the compile target is the libtpu topology (without
+    the override every kernel silently lowers as interpreter ops and
+    the 'TPU' program under analysis contains no Mosaic at all)."""
+    env = os.environ.get("XLLM_PALLAS_INTERPRET", "").strip()
+    if env in ("0", "false", "no"):
+        return False
+    if env in ("1", "true", "yes"):
+        return True
     return not _on_tpu()
 
 

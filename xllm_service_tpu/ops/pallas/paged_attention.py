@@ -69,7 +69,7 @@ def _kernel(ctx_ref, pt_ref, win_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref,
             sk_ref, o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
             pages_per_seq: int, num_kv_heads: int, has_current: bool,
             transpose_free: bool, logits_soft_cap: float, scale: float,
-            has_sinks: bool):
+            has_sinks: bool, layered: bool = False):
     b = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -96,8 +96,11 @@ def _kernel(ctx_ref, pt_ref, win_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref,
         g = hq // num_kv_heads
         q = q_ref[0].astype(jnp.float32)                     # [Hq, D]
         qg = q.reshape(num_kv_heads, g, d)                   # [Hkv, G, D]
-        k = k_ref[0].astype(jnp.float32)                     # [ps, Hkv, D]
-        v = v_ref[0].astype(jnp.float32)
+        # ``layered``: the pool rides FULL as [L, P, ps, Hkv, D] and the
+        # block is [1, 1, ps, Hkv, D] (the round-5 fix for the per-layer
+        # 134 MB slice materialization feeding this custom call).
+        k = (k_ref[0, 0] if layered else k_ref[0]).astype(jnp.float32)
+        v = (v_ref[0, 0] if layered else v_ref[0]).astype(jnp.float32)
         if transpose_free:
             # Batch Hkv where it lives: [Hkv,G,D] x [ps,Hkv,D] -> [Hkv,G,ps]
             logits = jax.lax.dot_general(
@@ -677,7 +680,8 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
                                   sliding_window=0,
                                   logits_soft_cap: float = 0.0,
                                   scale=None,
-                                  sinks=None) -> jnp.ndarray:
+                                  sinks=None,
+                                  layer=None) -> jnp.ndarray:
     """q: [B, Hq, D]; k/v_pages: [P, ps, Hkv, D]; page_table: [B, MP];
     context_lens: [B] valid cache tokens. With ``k_cur``/``v_cur``
     [B, Hkv, D], the current (not-yet-written) token is folded as a final
@@ -704,6 +708,19 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
     plain = (isinstance(sliding_window, int) and sliding_window == 0
              and logits_soft_cap == 0.0 and scale is None
              and sinks is None)
+    if plain and layer is not None and (
+            _wide_default() or _multirow_default() > 1
+            or _row_kernel_default()):
+        # Experiment-variant A/B with the layered serving path: the
+        # V3/V4/V5 kernels take per-layer pools, so slice here (the
+        # materialization cost is the experiment's to measure — without
+        # this the env knobs would silently no-op from serving).
+        k_pages = jax.lax.dynamic_index_in_dim(
+            k_pages, layer, axis=0, keepdims=False)
+        v_pages = jax.lax.dynamic_index_in_dim(
+            v_pages, layer, axis=0, keepdims=False)
+        layer = None
+    plain = plain and layer is None
     if plain:
         if _wide_default():
             return _paged_decode_attention_wide_impl(
@@ -724,7 +741,14 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
     return _paged_decode_attention_impl(
         q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur, win,
         sinks, interpret=interpret, transpose_free=transpose_free,
-        logits_soft_cap=float(logits_soft_cap), scale=float(scale))
+        logits_soft_cap=float(logits_soft_cap), scale=float(scale),
+        layer=layer)
+
+
+def _kernel_layered(ctx_ref, pt_ref, win_ref, lyr_ref, *rest, **kw):
+    """Layered-pool entry: the 4th scalar-prefetch ref (layer) is
+    consumed by the BLOCK INDEX MAPS only — the body never reads it."""
+    return _kernel(ctx_ref, pt_ref, win_ref, *rest, **kw)
 
 
 @functools.partial(jax.jit,
@@ -741,9 +765,14 @@ def _paged_decode_attention_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
                                  interpret: bool = False,
                                  transpose_free: bool = False,
                                  logits_soft_cap: float = 0.0,
-                                 scale: float = None) -> jnp.ndarray:
+                                 scale: float = None,
+                                 layer: jnp.ndarray = None) -> jnp.ndarray:
     B, Hq, D = q.shape
-    _, page_size, Hkv, _ = k_pages.shape
+    layered = layer is not None
+    if layered:
+        _, _, page_size, Hkv, _ = k_pages.shape
+    else:
+        _, page_size, Hkv, _ = k_pages.shape
     MP = page_table.shape[1]
     has_current = k_cur is not None
     if not has_current:
@@ -757,41 +786,56 @@ def _paged_decode_attention_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
     sk2 = (sinks.astype(jnp.float32).reshape(Hq, 1) if has_sinks
            else jnp.zeros((Hq, 1), jnp.float32))
 
+    if layered:
+        # Pool blocks index (layer, page) straight out of the FULL
+        # [L, P, ps, Hkv, D] pool — no per-layer slice exists for XLA
+        # to materialize (134 MB x layers x 2 pools per decode step).
+        lyr = layer.reshape(1).astype(jnp.int32)
+        pool_spec = pl.BlockSpec(
+            (1, 1, page_size, Hkv, D),
+            lambda b, p, ctx, pt, w, l: (l[0], pt[b, p], 0, 0, 0))
+        n_prefetch = 4
+        def small(ix):
+            return lambda b, p, ctx, pt, w, l: ix(b)
+    else:
+        pool_spec = pl.BlockSpec(
+            (1, page_size, Hkv, D),
+            lambda b, p, ctx, pt, w: (pt[b, p], 0, 0, 0))
+        n_prefetch = 3
+        def small(ix):
+            return lambda b, p, ctx, pt, w: ix(b)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,          # context_lens, page_table, win
+        num_scalar_prefetch=n_prefetch,  # ctx, page_table, win[, layer]
         grid=(B, MP),
         in_specs=[
-            pl.BlockSpec((1, Hq, D),
-                         lambda b, p, ctx, pt, w: (b, 0, 0)),
-            pl.BlockSpec((1, page_size, Hkv, D),
-                         lambda b, p, ctx, pt, w: (pt[b, p], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, Hkv, D),
-                         lambda b, p, ctx, pt, w: (pt[b, p], 0, 0, 0)),
-            pl.BlockSpec((1, Hkv, D),
-                         lambda b, p, ctx, pt, w: (b, 0, 0)),
-            pl.BlockSpec((1, Hkv, D),
-                         lambda b, p, ctx, pt, w: (b, 0, 0)),
-            pl.BlockSpec((Hq, 1), lambda b, p, ctx, pt, w: (0, 0)),
+            pl.BlockSpec((1, Hq, D), small(lambda b: (b, 0, 0))),
+            pool_spec,
+            pool_spec,
+            pl.BlockSpec((1, Hkv, D), small(lambda b: (b, 0, 0))),
+            pl.BlockSpec((1, Hkv, D), small(lambda b: (b, 0, 0))),
+            pl.BlockSpec((Hq, 1), small(lambda b: (0, 0))),
         ],
-        out_specs=pl.BlockSpec((1, Hq, D),
-                               lambda b, p, ctx, pt, w: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, Hq, D), small(lambda b: (b, 0, 0))),
         scratch_shapes=[
             pltpu.VMEM((Hq, 1), jnp.float32),    # running max
             pltpu.VMEM((Hq, 1), jnp.float32),    # running denom
             pltpu.VMEM((Hq, D), jnp.float32),    # output accumulator
         ],
     )
+    prefetch = (context_lens, page_table, win) + (
+        (lyr,) if layered else ())
     out = pl.pallas_call(
-        functools.partial(_kernel, page_size=page_size, pages_per_seq=MP,
+        functools.partial(_kernel_layered if layered else _kernel,
+                          page_size=page_size, pages_per_seq=MP,
                           num_kv_heads=Hkv, has_current=has_current,
                           transpose_free=transpose_free,
                           logits_soft_cap=logits_soft_cap, scale=scale,
-                          has_sinks=has_sinks),
+                          has_sinks=has_sinks, layered=layered),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(context_lens, page_table, win, q, k_pages, v_pages, k_cur, v_cur,
-      sk2)
+    )(*prefetch, q, k_pages, v_pages, k_cur, v_cur, sk2)
     return out
