@@ -308,3 +308,30 @@ class TestEngineSWAKernelPath:
                           num_experts_per_tok=2,
                           moe_capacity_factor=4.0)
         self._ab(monkeypatch, cfg)
+
+
+def test_layered_prefill_kernel_matches_sliced():
+    """layer= over FULL 5D pools must equal the non-layered kernel on
+    pools[l] — a regression confined to the layered index maps (e.g. a
+    transposed (l, page) order) must fail HERE with a per-layer diff,
+    not only in the slow end-to-end engine A/B."""
+    import numpy as np
+    from xllm_service_tpu.ops.pallas.prefill_attention import (
+        paged_prefill_attention_pallas)
+    rng = np.random.default_rng(3)
+    L, P, ps, Hkv, D, B, T, MP, Hq = 3, 8, 8, 2, 16, 2, 16, 4, 4
+    kp5 = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+    vp5 = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(B, T, Hq, D)), jnp.float32)
+    kf = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+    vf = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+    pt = jnp.asarray(1 + rng.integers(0, P - 1, size=(B, MP)), jnp.int32)
+    start = jnp.asarray([8, 16], jnp.int32)
+    lens = jnp.full((B,), T, jnp.int32)
+    for l in range(L):
+        ref = paged_prefill_attention_pallas(
+            q, kf, vf, kp5[l], vp5[l], pt, start, lens, interpret=True)
+        got = paged_prefill_attention_pallas(
+            q, kf, vf, kp5, vp5, pt, start, lens, interpret=True,
+            layer=jnp.int32(l))
+        assert jnp.allclose(ref, got, atol=1e-6), f"layer {l}"

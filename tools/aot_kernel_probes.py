@@ -133,6 +133,17 @@ def main() -> int:
     ):
         results[f"decode/{name}"] = _probe(name, fn, args, **kw)
 
+    # ---- layered prefill (full 5D pools + traced layer index) ----
+    results["prefill/layered full-pool (L=16)"] = _probe(
+        "PREFILL KERNEL [layered full-pool]",
+        lambda qq, kff, vff, kpp, vpp, ptt, qss, lnn, ww, ll: _impl(
+            qq, kff, vff, kpp, vpp, ptt, qss, lnn, ww, None, ll,
+            q_block=64, logits_soft_cap=0.0, scale=scale,
+            interpret=False),
+        (q, kf, kf, sds((16, P, PS, Hkv, D), jnp.bfloat16),
+         sds((16, P, PS, Hkv, D), jnp.bfloat16), pt, qs, ln, win,
+         sds((), jnp.int32)))
+
     # ---- the in-place decode KV write (the scatter replacement) ----
     from xllm_service_tpu.ops.pallas.kv_update import paged_kv_update
     results["decode/kv_update"] = _probe(

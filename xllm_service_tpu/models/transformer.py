@@ -403,14 +403,14 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     def layer(x, xs):
         ro = None
         if win_arr is not None and rope_arr is not None:
-            lp, kp, vp, w_l, ro = xs
+            lp, li, w_l, ro = xs
         elif win_arr is not None:
-            lp, kp, vp, w_l = xs
+            lp, li, w_l = xs
         elif rope_arr is not None:
-            lp, kp, vp, ro = xs
+            lp, li, ro = xs
             w_l = cfg.sliding_window or 0
         else:
-            lp, kp, vp = xs
+            lp, li = xs
             w_l = cfg.sliding_window or 0
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)
@@ -427,10 +427,13 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         # scan ys would rewrite the whole pool per call — the fresh rows
         # come out as small ys instead and land in one scatter after the
         # scan. Two paths (trace-time choice): the gated Pallas kernel
-        # streams pool pages + fresh blocks directly (no gathered-view
-        # materialization); the XLA reference gathers then overlays.
+        # streams pool pages + fresh blocks from the FULL 5D pools (the
+        # traced layer index joins the page in its DMA indices — a
+        # per-layer slice feeding a custom call is MATERIALIZED, the
+        # round-5 conviction); the XLA reference slices locally (its
+        # gather fuses) then overlays.
         B, T = tokens.shape
-        if _use_prefill_kernel(T, kp.shape[1]):
+        if _use_prefill_kernel(T, k_pages.shape[2]):
             # The kernel implements the full model-delta surface —
             # windows (static or traced per-layer), Gemma soft-cap and
             # scale, GPT-OSS sinks — so SWA families are no longer
@@ -438,11 +441,15 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             from xllm_service_tpu.ops.pallas import (
                 paged_prefill_attention_pallas)
             attn = paged_prefill_attention_pallas(
-                q, k, v, kp, vp, page_table, start_pos, lengths,
-                sliding_window=w_l, sinks=lp.get("sinks"),
+                q, k, v, k_pages, v_pages, page_table, start_pos,
+                lengths, sliding_window=w_l, sinks=lp.get("sinks"),
                 logits_soft_cap=cfg.attn_logit_softcapping,
-                scale=extras.get("scale"))
+                scale=extras.get("scale"), layer=li)
         else:
+            kp = jax.lax.dynamic_index_in_dim(k_pages, li, axis=0,
+                                              keepdims=False)
+            vp = jax.lax.dynamic_index_in_dim(v_pages, li, axis=0,
+                                              keepdims=False)
             k_all = overlay_fresh_kv(gather_pages(kp, page_table), k,
                                      start_pos)
             v_all = overlay_fresh_kv(gather_pages(vp, page_table), v,
@@ -467,14 +474,15 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             x = x + m
         return x, (k, v, dropped)
 
+    li_arr = jnp.arange(cfg.num_layers, dtype=jnp.int32)
     if win_arr is not None and rope_arr is not None:
-        xs = (params["layers"], k_pages, v_pages, win_arr, rope_arr)
+        xs = (params["layers"], li_arr, win_arr, rope_arr)
     elif win_arr is not None:
-        xs = (params["layers"], k_pages, v_pages, win_arr)
+        xs = (params["layers"], li_arr, win_arr)
     elif rope_arr is not None:
-        xs = (params["layers"], k_pages, v_pages, rope_arr)
+        xs = (params["layers"], li_arr, rope_arr)
     else:
-        xs = (params["layers"], k_pages, v_pages)
+        xs = (params["layers"], li_arr)
     x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs, unroll=_layer_unroll())
     k_pages, v_pages = write_prefill_kv_all_layers(
         k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths)

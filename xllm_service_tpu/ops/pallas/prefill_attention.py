@@ -84,11 +84,19 @@ def prefill_kernel_enabled() -> bool:
     return pallas.enabled()
 
 
+def _kernel_layered(qstart_ref, lens_ref, pt_ref, win_ref, lyr_ref,
+                    *rest, **kw):
+    """Layered-pool entry: the 5th scalar-prefetch ref (layer) is
+    consumed by the BLOCK INDEX MAPS only."""
+    return _kernel(qstart_ref, lens_ref, pt_ref, win_ref, *rest,
+                   layered=True, **kw)
+
+
 def _kernel(qstart_ref, lens_ref, pt_ref, win_ref, q_ref, kp_ref, vp_ref,
             kf_ref, vf_ref, sk_ref, o_ref, m_ref, l_ref, acc_ref, *,
             page_size: int, q_block: int, num_pool_steps: int,
             num_kv_steps: int, logits_soft_cap: float, scale: float,
-            has_sinks: bool):
+            has_sinks: bool, layered: bool = False):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     s = pl.program_id(2)
@@ -132,9 +140,11 @@ def _kernel(qstart_ref, lens_ref, pt_ref, win_ref, q_ref, kp_ref, vp_ref,
 
     @pl.when(live_pool | live_fresh)
     def _fold():
-        kb = jnp.where(is_pool, kp_ref[0].astype(jnp.float32),
+        kp_blk = kp_ref[0, 0] if layered else kp_ref[0]
+        vp_blk = vp_ref[0, 0] if layered else vp_ref[0]
+        kb = jnp.where(is_pool, kp_blk.astype(jnp.float32),
                        kf_ref[0, 0].astype(jnp.float32))     # [ps, Hkv, D]
-        vb = jnp.where(is_pool, vp_ref[0].astype(jnp.float32),
+        vb = jnp.where(is_pool, vp_blk.astype(jnp.float32),
                        vf_ref[0, 0].astype(jnp.float32))
         qt = q_ref[0, 0].astype(jnp.float32)                 # [Hkv, QB*G, D]
         kt = jnp.transpose(kb, (1, 0, 2))                    # [Hkv, ps, D]
@@ -212,9 +222,14 @@ def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
                                    sliding_window=0,
                                    logits_soft_cap: float = 0.0,
                                    scale=None,
-                                   sinks=None) -> jnp.ndarray:
+                                   sinks=None,
+                                   layer=None) -> jnp.ndarray:
     """q/k_fresh/v_fresh: [B, T, H*, D] (this window, already roped);
-    k/v_pages: [P, ps, Hkv, D]; page_table: [B, MP]; q_start: [B] cached
+    k/v_pages: [P, ps, Hkv, D] — or, with ``layer`` (a traced int32
+    scalar), the FULL stacked [L, P, ps, Hkv, D] pools, whose page DMAs
+    the kernel indexes at (layer, page) directly so no per-layer slice
+    is ever materialized (the serving path always uses this form);
+    page_table: [B, MP]; q_start: [B] cached
     prefix length; lengths: [B] true window length. Requires T % ps == 0
     (engine buckets are pow2 multiples of the page size — callers check).
     ``sliding_window`` is a static int OR a traced int32 scalar (per-layer
@@ -243,7 +258,7 @@ def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     return _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table,
-                 q_start, lengths, win, sinks, q_block=q_block,
+                 q_start, lengths, win, sinks, layer, q_block=q_block,
                  logits_soft_cap=float(logits_soft_cap),
                  scale=float(scale), interpret=interpret)
 
@@ -251,10 +266,14 @@ def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
 @functools.partial(jax.jit, static_argnames=("q_block", "logits_soft_cap",
                                              "scale", "interpret"))
 def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
-          lengths, win, sinks, *, q_block: int, logits_soft_cap: float,
-          scale: float, interpret: bool):
+          lengths, win, sinks, layer=None, *, q_block: int,
+          logits_soft_cap: float, scale: float, interpret: bool):
     B, T, Hq, D = q.shape
-    _, page_size, Hkv, _ = k_pages.shape
+    layered = layer is not None
+    if layered:
+        _, _, page_size, Hkv, _ = k_pages.shape
+    else:
+        _, page_size, Hkv, _ = k_pages.shape
     MP = page_table.shape[1]
     if T % page_size != 0:
         raise ValueError(f"window {T} not a multiple of page {page_size}")
@@ -268,34 +287,52 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
     G = Hq // Hkv
     has_sinks = sinks is not None
 
-    def pool_idx(b, qi, s, qstart, lens, pt, w):
-        # Pool steps DMA the mapped page; fresh steps DMA page 0 (unused).
-        return (jnp.where(s < MP, pt[b, jnp.minimum(s, MP - 1)], 0),
-                0, 0, 0)
-
-    def fresh_idx(b, qi, s, qstart, lens, pt, w):
-        # Fresh steps DMA their T-block; pool steps DMA block 0 (unused).
+    # ``layered``: the pools ride FULL as [L, P, ps, Hkv, D] and the
+    # traced layer index (5th prefetch scalar) joins the page in the
+    # block index — no per-layer pool slice for XLA to materialize
+    # (the round-5 decode conviction applies to prefill identically).
+    # One set of index maps for both arities: the layered form appends
+    # the layer prefetch ref, which only pool_idx consumes (*_ swallows
+    # it elsewhere — the decode kernel's adapter pattern).
+    def fresh_idx(b, qi, s, qstart, lens, pt, w, *_):
+        # Fresh steps DMA their T-block; pool steps block 0 (unused).
         return (b, jnp.maximum(s - MP, 0), 0, 0, 0)
 
-    def fixed_idx(b, qi, s, qstart, lens, pt, w):
+    def fixed_idx(b, qi, s, qstart, lens, pt, w, *_):
         return (0, 0, 0)
 
+    def q_idx(b, qi, s, qstart, lens, pt, w, *_):
+        return (b, qi, 0, 0, 0)
+
+    if layered:
+        def pool_idx(b, qi, s, qstart, lens, pt, w, l):
+            return (l[0],
+                    jnp.where(s < MP, pt[b, jnp.minimum(s, MP - 1)], 0),
+                    0, 0, 0)
+
+        pool_block = (1, 1, page_size, Hkv, D)
+        n_prefetch = 5
+    else:
+        def pool_idx(b, qi, s, qstart, lens, pt, w):
+            # Pool steps DMA the mapped page; fresh steps page 0 (unused).
+            return (jnp.where(s < MP, pt[b, jnp.minimum(s, MP - 1)], 0),
+                    0, 0, 0)
+
+        pool_block = (1, page_size, Hkv, D)
+        n_prefetch = 4
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,        # q_start, lengths, page_table, win
+        num_scalar_prefetch=n_prefetch,  # q_start, lens, pt, win[, layer]
         grid=(B, nQ, n_kv),
         in_specs=[
-            pl.BlockSpec((1, 1, Hkv, QB * G, D),
-                         lambda b, qi, s, qstart, lens, pt, w:
-                         (b, qi, 0, 0, 0)),
-            pl.BlockSpec((1, page_size, Hkv, D), pool_idx),
-            pl.BlockSpec((1, page_size, Hkv, D), pool_idx),
+            pl.BlockSpec((1, 1, Hkv, QB * G, D), q_idx),
+            pl.BlockSpec(pool_block, pool_idx),
+            pl.BlockSpec(pool_block, pool_idx),
             pl.BlockSpec((1, 1, page_size, Hkv, D), fresh_idx),
             pl.BlockSpec((1, 1, page_size, Hkv, D), fresh_idx),
             pl.BlockSpec((Hkv, QB * G, 1), fixed_idx),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, Hkv, QB * G, D),
-            lambda b, qi, s, qstart, lens, pt, w: (b, qi, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, Hkv, QB * G, D), q_idx),
         scratch_shapes=[
             pltpu.VMEM((Hkv, QB * G, 1), jnp.float32),   # running max
             pltpu.VMEM((Hkv, QB * G, 1), jnp.float32),   # running denom
@@ -319,7 +356,8 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
     else:
         sk3 = jnp.zeros((Hkv, QB * G, 1), jnp.float32)
     out = pl.pallas_call(
-        functools.partial(_kernel, page_size=page_size, q_block=QB,
+        functools.partial(_kernel_layered if layered else _kernel,
+                          page_size=page_size, q_block=QB,
                           num_pool_steps=MP, num_kv_steps=n_kv,
                           logits_soft_cap=logits_soft_cap, scale=scale,
                           has_sinks=has_sinks),
@@ -329,6 +367,8 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(q_start.astype(jnp.int32), lengths.astype(jnp.int32),
-      page_table, win, q6, k_pages, v_pages, kf5, vf5, sk3)
+      page_table, win,
+      *((layer.reshape(1).astype(jnp.int32),) if layered else ()),
+      q6, k_pages, v_pages, kf5, vf5, sk3)
     out = out.reshape(B, nQ, Hkv, QB, G, D).transpose(0, 1, 3, 2, 4, 5)
     return out.reshape(B, T, Hq, D)
