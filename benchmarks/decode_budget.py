@@ -343,7 +343,20 @@ def main() -> None:
             return run
         return build
 
+    # The serving default is the LAYERED kernel (full 5D pools + traced
+    # layer index — no per-layer slice materialization); the sliced
+    # forms remain as A/B references.
+    L_pool = 4   # enough layers to expose slice-vs-layered cost
+    kp5 = jnp.asarray(rng.normal(size=(L_pool, P, ps, Hkv, D)), dt)
+    vp5 = jnp.asarray(rng.normal(size=(L_pool, P, ps, Hkv, D)), dt)
+
+    def layered_attn(q, k, v, t, c, kcur, vcur):
+        return _paged_decode_attention_impl(
+            q, kp5, vp5, t, c, kcur, vcur, interpret=interpret,
+            layer=jnp.int32(1))
+
     variants = {
+        "attn_pallas_layered": layered_attn,
         "attn_xla_gather": lambda q, k, v, t, c, kcur, vcur:
             att.paged_decode_attention_current(q, k, v, t, c, kcur, vcur),
         "attn_pallas_grid": functools.partial(
@@ -365,7 +378,8 @@ def main() -> None:
     }
 
     if args.essential:
-        keep = ("attn_xla_gather", "attn_pallas_grid")
+        keep = ("attn_pallas_layered", "attn_xla_gather",
+                "attn_pallas_grid")
         variants = {k: v for k, v in variants.items() if k in keep}
     detail = {"shape": {"B": B, "Hq": Hq, "Hkv": Hkv, "D": D,
                         "page_size": ps, "table_width": MP,
@@ -393,7 +407,7 @@ def main() -> None:
     # every row would collide on one flat slot — a degenerate scatter,
     # not the engine's per-row distinct-page write.
     positions = jnp.full((B,), ctx_tokens - 1, jnp.int32)
-    active = jnp.ones((B,), jnp.int32)
+    active = jnp.ones((B,), bool)
 
     def scatter_build(n):
         @jax.jit
@@ -412,6 +426,33 @@ def main() -> None:
             _scan_slope(scatter_build, args.n_lo, args.n_hi), 4)
         _mark("kv_scatter_all_layers_ms",
               detail["kv_scatter_all_layers_ms"])
+
+        # The in-place Pallas KV write (serving default on TPU) vs the
+        # XLA scatter above — the round-5 fix for the per-step full-pool
+        # copies.
+        from xllm_service_tpu.ops.pallas.kv_update import paged_kv_update
+
+        def kvk_build(n):
+            @jax.jit
+            def run():
+                def body(carry, _):
+                    kp, vp = carry
+                    kp2, vp2 = paged_kv_update(
+                        kp, vp, k_all, v_all, pt, positions, active,
+                        interpret=interpret)
+                    return (kp2, vp2), ()
+                (kp2, _), _ = jax.lax.scan(body, (kp_l, vp_l), None,
+                                           length=n)
+                return kp2[0, 1, 0, 0, 0]
+            return run
+
+        try:
+            detail["kv_update_kernel_ms"] = round(
+                _scan_slope(kvk_build, args.n_lo, args.n_hi), 4)
+        except Exception as exc:  # noqa: BLE001
+            detail["kv_update_kernel_ms"] = \
+                f"error: {type(exc).__name__}: {exc}"
+        _mark("kv_update_kernel_ms", detail["kv_update_kernel_ms"])
 
     # lm_head + greedy argmax tail.
     h0 = jnp.asarray(rng.normal(size=(B, D * Hq)), dt)
