@@ -727,3 +727,36 @@ class TestPagedKvUpdateKernel:
                 q, kp5, vp5, pt, ctx, kc, vc, interpret=True,
                 layer=jnp.int32(l))
             assert jnp.allclose(ref, got, atol=1e-6), f"layer {l}"
+
+
+class TestPagedPrefillKvUpdateKernel:
+    """The in-place prefill KV write (page-granular RMW) must match the
+    XLA scatter on aligned windows, including ragged lengths, NULL
+    pages, and prefix-cache (nonzero page-aligned start) rows."""
+
+    def test_matches_xla_scatter(self, monkeypatch):
+        import numpy as np
+        from xllm_service_tpu.ops import attention as att
+        from xllm_service_tpu.ops.pallas.kv_update import (
+            paged_prefill_kv_update)
+        monkeypatch.setenv("XLLM_PALLAS_KV", "0")   # pin the reference
+        rng = np.random.default_rng(5)
+        L, P, ps, Hkv, D, B, T, MP = 3, 32, 8, 2, 16, 4, 16, 6
+        kp = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+        kn = jnp.asarray(rng.normal(size=(L, B, T, Hkv, D)), jnp.float32)
+        vn = jnp.asarray(rng.normal(size=(L, B, T, Hkv, D)), jnp.float32)
+        # DISJOINT pages per row — the allocator's exclusive-ownership
+        # invariant (the RMW page write requires it; a shared page's
+        # identity-written tail would clobber the other owner's rows).
+        pt = jnp.asarray(np.arange(1, B * MP + 1).reshape(B, MP),
+                         jnp.int32)
+        pt = pt.at[2, :].set(0)                      # NULL row
+        start = jnp.asarray([0, 8, 0, 16], jnp.int32)  # page-aligned
+        lens = jnp.asarray([16, 11, 16, 5], jnp.int32)  # ragged tails
+        ref_k, ref_v = att.write_prefill_kv_all_layers(
+            kp, vp, kn, vn, pt, start, lens)
+        new_k, new_v = paged_prefill_kv_update(
+            kp, vp, kn, vn, pt, start, lens, interpret=True)
+        assert jnp.array_equal(ref_k, new_k)
+        assert jnp.array_equal(ref_v, new_v)

@@ -745,6 +745,13 @@ class EngineConfig:
                 f"max_model_len={self.max_model_len} must be a multiple of "
                 f"page_size={self.page_size}")
         self.max_pages_per_seq = self.max_model_len // self.page_size
+        # Every chunked-prefill window start is a sum of earlier bucket
+        # sizes, so starts stay page-aligned iff EVERY bucket is a page
+        # multiple. The in-place prefill KV-write kernel requires that
+        # alignment; mixed buckets (e.g. a 200-token bucket on 64-token
+        # pages) keep the XLA scatter path instead of corrupting pools.
+        self.prefill_page_aligned = all(
+            b % self.page_size == 0 for b in self.prefill_buckets)
 
 
 def load_json(path: str) -> Dict[str, Any]:

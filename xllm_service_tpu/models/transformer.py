@@ -346,6 +346,7 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                     prompt_lp_targets: Optional[jnp.ndarray] = None,
                     return_stats: bool = False,
                     rope_pos: Optional[jnp.ndarray] = None,
+                    page_aligned_prefill: bool = True,
                     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], KVCache]:
     """Prefill ``tokens`` [B, T] (padded; true new-token counts in
     ``lengths``; nonzero ``start_pos`` = prefix-cache hit, those tokens are
@@ -485,7 +486,8 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         xs = (params["layers"], li_arr)
     x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs, unroll=_layer_unroll())
     k_pages, v_pages = write_prefill_kv_all_layers(
-        k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths)
+        k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths,
+        page_aligned_starts=page_aligned_prefill)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:

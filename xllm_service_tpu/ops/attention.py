@@ -183,9 +183,31 @@ def write_prefill_kv_all_layers(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
                                 k_new: jnp.ndarray, v_new: jnp.ndarray,
                                 page_table: jnp.ndarray,
                                 start_pos: jnp.ndarray,
-                                lengths: jnp.ndarray
+                                lengths: jnp.ndarray,
+                                page_aligned_starts: bool = True
                                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Prefill counterpart: k_new [L, B, T, Hkv, D] → one scatter."""
+    """Prefill counterpart: k_new [L, B, T, Hkv, D] → one scatter — or,
+    on the Pallas path, the in-place page-granular write kernel (the
+    XLA scatter copies a full pool around the write per prefill call;
+    the decode conviction's sibling). Kernel eligibility is static:
+    T % ps == 0 (bucketed windows) and page-aligned window starts,
+    which the engine guarantees whenever its prefill buckets are
+    page-multiples (chunked-prefill starts advance by bucket sizes;
+    prefix-cache grants are whole pages)."""
+    T_, ps2 = k_new.shape[2], k_pages.shape[2]
+    _, _, _, Hkv2, D2 = k_pages.shape
+    mla_shape2 = Hkv2 == 1 and D2 % 128 != 0
+    # Per-cell VMEM: 6 page blocks (4 pool + 2 new), double-buffered —
+    # the same comfort threshold as the decode gate, falling back to
+    # the scatter instead of failing Mosaic allocation.
+    cell_bytes = 2 * 6 * ps2 * Hkv2 * D2 * k_pages.dtype.itemsize
+    if _kv_update_kernel_enabled() and page_aligned_starts \
+            and T_ % ps2 == 0 and ps2 % 8 == 0 \
+            and cell_bytes < 6 * 2 ** 20 and not mla_shape2:
+        from xllm_service_tpu.ops.pallas.kv_update import (
+            paged_prefill_kv_update)
+        return paged_prefill_kv_update(k_pages, v_pages, k_new, v_new,
+                                       page_table, start_pos, lengths)
     L, B, T = k_new.shape[0], k_new.shape[1], k_new.shape[2]
     page_size = k_pages.shape[2]
     num_slots = k_pages.shape[1] * page_size

@@ -121,3 +121,89 @@ def paged_kv_update(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         interpret=interpret,
     )(slot, kn, vn, k_pages, v_pages)
     return (ko, vo)
+
+
+def _prefill_kernel(pagemap_ref, valid_ref, kn_ref, vn_ref,
+                    kp_in_ref, vp_in_ref, ko_ref, vo_ref, *,
+                    page_size: int):
+    """Grid (L, B, nW): write window-page ``w`` of row ``b`` into its
+    mapped pool page for layer ``l``. Valid token count ``valid_ref[b,w]``
+    masks the tail partial page (and 0 = dropped/NULL → identity)."""
+    b = pl.program_id(1)
+    w = pl.program_id(2)
+    n_valid = valid_ref[b, w]
+    tok_mask = (jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, page_size, 1, 1), 2) < n_valid)
+    ko_ref[...] = jnp.where(tok_mask, kn_ref[0], kp_in_ref[...])
+    vo_ref[...] = jnp.where(tok_mask, vn_ref[0], vp_in_ref[...])
+
+
+def paged_prefill_kv_update(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                            k_new: jnp.ndarray, v_new: jnp.ndarray,
+                            page_table: jnp.ndarray,
+                            start_pos: jnp.ndarray,
+                            lengths: jnp.ndarray, *,
+                            interpret: bool = None):
+    """In-place prefill KV write: the window's fresh rows
+    [L, B, T, Hkv, D] land in their mapped pool pages with declared
+    aliasing — the XLA scatter otherwise copies a full pool around the
+    write at every prefill call (the decode conviction's sibling).
+
+    Requires page-aligned window starts (``start_pos % ps == 0`` —
+    engine invariant: prefix-cache grants are whole pages and mid-prompt
+    chunked-prefill windows are full page-multiple buckets, only the
+    FINAL chunk is ragged; the caller's static gate covers this via
+    T % ps == 0), and EXCLUSIVE page ownership per row (the allocator
+    invariant): the tail of a partially-valid page is identity-written
+    from its old bytes, which would clobber a co-owner's rows if pages
+    were ever shared."""
+    if interpret is None:
+        from xllm_service_tpu.ops import pallas
+        interpret = pallas.default_interpret()
+    L, P, ps, Hkv, D = k_pages.shape
+    B, T = k_new.shape[1], k_new.shape[2]
+    nW = T // ps
+
+    # Per (b, w): target page id (NULL/out-of-table → identity write on
+    # page 0) and valid token count within the page window.
+    w_idx = jnp.arange(nW, dtype=jnp.int32)[None, :]            # [1,nW]
+    page_idx = (start_pos[:, None] // ps) + w_idx               # [B,nW]
+    in_table = page_idx < page_table.shape[1]
+    page = jnp.where(
+        in_table,
+        jnp.take_along_axis(
+            page_table, jnp.minimum(page_idx, page_table.shape[1] - 1),
+            axis=1),
+        0)
+    n_valid = jnp.clip(lengths[:, None] - w_idx * ps, 0, ps)
+    n_valid = jnp.where(in_table & (page > 0), n_valid, 0)
+    pagemap = page.astype(jnp.int32)
+    n_valid = n_valid.astype(jnp.int32)
+
+    pool_spec = pl.BlockSpec(
+        (1, 1, ps, Hkv, D),
+        lambda l, b, w, pm, nv: (l, pm[b, w], 0, 0, 0))
+    new_spec = pl.BlockSpec(
+        (1, 1, 1, ps, Hkv, D),
+        lambda l, b, w, pm, nv: (l, b, w, 0, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # pagemap, n_valid
+        grid=(L, B, nW),
+        in_specs=[new_spec, new_spec, pool_spec, pool_spec],
+        out_specs=[pool_spec, pool_spec],
+    )
+    kn = k_new.reshape(L, B, nW, ps, Hkv, D)
+    vn = v_new.reshape(L, B, nW, ps, Hkv, D)
+    ko, vo = pl.pallas_call(
+        functools.partial(_prefill_kernel, page_size=ps),
+        out_shape=[jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                   jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype)],
+        grid_spec=grid_spec,
+        # flat operands incl. prefetch: 0=pagemap 1=n_valid 2=k_new
+        # 3=v_new 4=k_pool 5=v_pool -> outputs 0/1.
+        input_output_aliases={4: 0, 5: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(pagemap, n_valid, kn, vn, k_pages, v_pages)
+    return (ko, vo)

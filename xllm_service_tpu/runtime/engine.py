@@ -212,15 +212,17 @@ class Engine:
         self._slot_st: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
 
         K = engine_cfg.num_top_logprobs
+        aligned = getattr(engine_cfg, "prefill_page_aligned", True)
         self._jit_prefill = jax.jit(
-            functools.partial(_prefill_step, cfg=model_cfg, num_top=K),
+            functools.partial(_prefill_step, cfg=model_cfg, num_top=K,
+                              page_aligned=aligned),
             donate_argnums=(2,), static_argnames=("t_len",))
         # echo+logprobs variant: also scores every window token. Compiled
         # on first use (rare path; the recompile counter will note it) —
         # warmup stays lean.
         self._jit_prefill_plp = jax.jit(
             functools.partial(_prefill_step, cfg=model_cfg, num_top=K,
-                              with_prompt_lps=True),
+                              with_prompt_lps=True, page_aligned=aligned),
             donate_argnums=(2,), static_argnames=("t_len",))
         # Sequence-parallel ring prefill: available when the mesh has an
         # sp axis — prompts longer than the largest single-chip bucket
@@ -1476,7 +1478,8 @@ def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
                   mm_positions=None, plp_targets=None, bias_ids=None,
                   bias_vals=None, rope_pos=None, *, cfg: ModelConfig,
                   num_top: int = 0, t_len: int = 0,
-                  with_prompt_lps: bool = False):
+                  with_prompt_lps: bool = False,
+                  page_aligned: bool = True):
     start_pos = packed[:, 0]
     lengths = packed[:, 1]
     tokens = packed[:, _PREFILL_HDR:_PREFILL_HDR + t_len]
@@ -1486,7 +1489,8 @@ def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
         params, cfg, tokens, start_pos, lengths, kv, page_table,
         mm_embeds=mm_embeds, mm_positions=mm_positions,
         prompt_lp_targets=plp_targets if with_prompt_lps else None,
-        return_stats=True, rope_pos=rope_pos)
+        return_stats=True, rope_pos=rope_pos,
+        page_aligned_prefill=page_aligned)
     if with_prompt_lps:
         last_logits, _, kv, plp, stats = res
     else:
