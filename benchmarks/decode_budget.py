@@ -222,7 +222,7 @@ def _prefill_budget(args, rng) -> dict:
         @jax.jit
         def run():
             def body(kv, _):
-                return att.write_prefill_kv_all_layers(
+                return att.write_prefill_kv_all_layers_xla(
                     kv[0], kv[1], k_new, v_new, pt, start, lens), ()
             kv_fin, _ = jax.lax.scan(body, kv0, None, length=n)
             return kv_fin[0][0, 1, 0, 0, 0]
@@ -414,7 +414,7 @@ def main() -> None:
         def run():
             def body(carry, _):
                 kp, vp = carry
-                kp2, vp2 = att.write_decode_kv_all_layers(
+                kp2, vp2 = att.write_decode_kv_all_layers_xla(
                     kp, vp, k_all, v_all, pt, positions, active)
                 return (kp2, vp2), ()
             (kp2, _), _ = jax.lax.scan(body, (kp_l, vp_l), None, length=n)

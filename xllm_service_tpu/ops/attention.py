@@ -166,6 +166,15 @@ def write_decode_kv_all_layers(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         from xllm_service_tpu.ops.pallas.kv_update import paged_kv_update
         return paged_kv_update(k_pages, v_pages, k_new, v_new,
                                page_table, positions, active)
+    return write_decode_kv_all_layers_xla(
+        k_pages, v_pages, k_new, v_new, page_table, positions, active)
+
+
+def write_decode_kv_all_layers_xla(k_pages, v_pages, k_new, v_new,
+                                   page_table, positions, active
+                                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The raw XLA scatter (kernel-free reference) — the gate's
+    fallback, and the A/B baseline the budget table pins by name."""
     L = k_pages.shape[0]
     page_size = k_pages.shape[2]
     num_slots = k_pages.shape[1] * page_size
@@ -208,6 +217,14 @@ def write_prefill_kv_all_layers(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
             paged_prefill_kv_update)
         return paged_prefill_kv_update(k_pages, v_pages, k_new, v_new,
                                        page_table, start_pos, lengths)
+    return write_prefill_kv_all_layers_xla(
+        k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths)
+
+
+def write_prefill_kv_all_layers_xla(k_pages, v_pages, k_new, v_new,
+                                    page_table, start_pos, lengths
+                                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The raw XLA prefill scatter (kernel-free reference)."""
     L, B, T = k_new.shape[0], k_new.shape[1], k_new.shape[2]
     page_size = k_pages.shape[2]
     num_slots = k_pages.shape[1] * page_size
