@@ -760,3 +760,42 @@ class TestPagedPrefillKvUpdateKernel:
             kp, vp, kn, vn, pt, start, lens, interpret=True)
         assert jnp.array_equal(ref_k, new_k)
         assert jnp.array_equal(ref_v, new_v)
+
+
+def test_kv_update_kernels_match_scatter_at_mla_latent_shape():
+    """DeepSeek-style latent pools (Hkv=1, minor dim NOT 128-aligned)
+    ride the in-place writers too. This pins interpret-mode PARITY at a
+    small unaligned-minor geometry (D=72) against the raw _xla scatters
+    called directly; Mosaic compilability at the real (Hkv=1, D=576)
+    shape is evidenced separately by the offline AOT probe matrix
+    (docs/AOT_VERDICTS_r5.txt)."""
+    import numpy as np
+    from xllm_service_tpu.ops import attention as att
+    from xllm_service_tpu.ops.pallas.kv_update import (
+        paged_kv_update, paged_prefill_kv_update)
+    rng = np.random.default_rng(7)
+    L, P, ps, Hkv, D, B, MP = 2, 24, 8, 1, 72, 3, 4
+    kp = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+    pt = jnp.asarray(np.arange(1, B * MP + 1).reshape(B, MP), jnp.int32)
+    # decode write
+    kn = jnp.asarray(rng.normal(size=(L, B, Hkv, D)), jnp.float32)
+    vn = jnp.asarray(rng.normal(size=(L, B, Hkv, D)), jnp.float32)
+    pos = jnp.asarray([0, 9, 23], jnp.int32)
+    act = jnp.asarray([1, 1, 0], bool)
+    ref = att.write_decode_kv_all_layers_xla(kp, vp, kn, vn, pt, pos, act)
+    got = paged_kv_update(kp, vp, kn, vn, pt, pos, act, interpret=True)
+    assert jnp.array_equal(ref[0], got[0]) and jnp.array_equal(ref[1],
+                                                               got[1])
+    # prefill write
+    T = 16
+    knp = jnp.asarray(rng.normal(size=(L, B, T, Hkv, D)), jnp.float32)
+    vnp = jnp.asarray(rng.normal(size=(L, B, T, Hkv, D)), jnp.float32)
+    start = jnp.asarray([0, 8, 16], jnp.int32)
+    lens = jnp.asarray([16, 10, 3], jnp.int32)
+    ref = att.write_prefill_kv_all_layers_xla(kp, vp, knp, vnp, pt,
+                                              start, lens)
+    got = paged_prefill_kv_update(kp, vp, knp, vnp, pt, start, lens,
+                                  interpret=True)
+    assert jnp.array_equal(ref[0], got[0]) and jnp.array_equal(ref[1],
+                                                               got[1])

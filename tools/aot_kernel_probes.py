@@ -156,6 +156,31 @@ def main() -> int:
          sds((16, Bd, Hkv, D), jnp.bfloat16),
          ptd, ctx, sds((Bd,), jnp.bool_)))
 
+    results["decode/kv_update MLA latent (Hkv=1 D=576)"] = _probe(
+        "KV UPDATE @ MLA latent",
+        lambda kp, vp, knn, vnn, pt, pos, act: paged_kv_update(
+            kp, vp, knn, vnn, pt, pos, act, interpret=False),
+        (sds((16, 1024, PS, 1, 576), jnp.bfloat16),
+         sds((16, 1024, PS, 1, 576), jnp.bfloat16),
+         sds((16, Bd, 1, 576), jnp.bfloat16),
+         sds((16, Bd, 1, 576), jnp.bfloat16),
+         ptd, ctx, sds((Bd,), jnp.bool_)))
+
+    from xllm_service_tpu.ops.pallas.kv_update import (
+        paged_prefill_kv_update)
+    for tag, HkvW, DW in (("", Hkv, D), (" MLA latent (Hkv=1 D=576)",
+                                         1, 576)):
+        results[f"prefill/kv_update{tag}"] = _probe(
+            f"PREFILL KV UPDATE{tag.upper() if not tag else ' @ MLA latent'}",
+            lambda kp, vp, knn, vnn, pt2, st, lnn: paged_prefill_kv_update(
+                kp, vp, knn, vnn, pt2, st, lnn, interpret=False),
+            (sds((16, 1024, PS, HkvW, DW), jnp.bfloat16),
+             sds((16, 1024, PS, HkvW, DW), jnp.bfloat16),
+             sds((16, 32, 128, HkvW, DW), jnp.bfloat16),
+             sds((16, 32, 128, HkvW, DW), jnp.bfloat16),
+             sds((32, MP), jnp.int32), sds((32,), jnp.int32),
+             sds((32,), jnp.int32)))
+
     print(json.dumps({"aot_target": "v5e (local libtpu topology)",
                       "pass": sum(results.values()),
                       "total": len(results),
