@@ -320,11 +320,20 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             "model": cfg.name, "platform": platform,
             "device_kind": getattr(dev, "device_kind", ""),
             # Which gated kernels this run used (A/B bookkeeping).
+            # XLLM_PALLAS_KV / XLLM_WRITE_THEN_ATTEND default to AUTO
+            # (follow XLLM_PALLAS), not off — recording unset as "0"
+            # would claim a feature-off run for a feature-on number.
             "kernel_flags": {
-                k: os.environ.get(k, "0") for k in
-                ("XLLM_PALLAS", "XLLM_PALLAS_DECODE_V2",
-                 "XLLM_PALLAS_DECODE_V3", "XLLM_PALLAS_DECODE_V4",
-                 "XLLM_PALLAS_DECODE_V5", "XLLM_PALLAS_PREFILL")},
+                **{k: os.environ.get(k, "0") for k in
+                   ("XLLM_PALLAS", "XLLM_PALLAS_DECODE_V2",
+                    "XLLM_PALLAS_DECODE_V3", "XLLM_PALLAS_DECODE_V4",
+                    "XLLM_PALLAS_DECODE_V5", "XLLM_PALLAS_PREFILL")},
+                **{k: os.environ.get(k, "auto") for k in
+                   ("XLLM_PALLAS_KV", "XLLM_WRITE_THEN_ATTEND")}},
+            # The .bench_env lines applied at startup (key → effective
+            # value), so a headline number records which hands-free
+            # conviction gates were active when it was measured.
+            "bench_env": dict(_BENCH_ENV),
             "batch": batch, "prompt_len": prompt_len, "gen_len": gen_len,
             "warmup_s": round(warmup_s, 1),
             "tpot_ms": round(tpot_ms, 3),
@@ -374,6 +383,14 @@ def _maybe_kv_probe(engine, cfg, ecfg) -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+# Keys/values .bench_env carried this run, with the value actually in
+# effect (a caller's explicit env overrides the file). Lands in the
+# result JSON so headline numbers record which gates were active —
+# every process (parent, TPU child, CPU fallback) re-reads the same
+# file at its own startup, so the snapshot is always populated.
+_BENCH_ENV: dict = {}
+
+
 def _load_bench_env() -> None:
     """Apply KEY=VAL lines from .bench_env (written by
     tools/act_on_convictions.py after the conviction ladder) without
@@ -389,7 +406,9 @@ def _load_bench_env() -> None:
                 if not line or line.startswith("#") or "=" not in line:
                     continue
                 k, v = line.split("=", 1)
-                os.environ.setdefault(k.strip(), v.strip())
+                k = k.strip()
+                os.environ.setdefault(k, v.strip())
+                _BENCH_ENV[k] = os.environ[k]   # the EFFECTIVE value
     except OSError:
         pass
 

@@ -1,0 +1,128 @@
+"""The HLO copy-census probe (tools/aot_copy_census.py) as a tier-1
+check: future PRs cannot silently reintroduce KV-pool copies around the
+attention/writer custom calls or the jit-call boundary.
+
+Two tiers inside one file:
+- pure text-parsing units (always run, no compiler);
+- real v5e AOT assertions through the local-libtpu topology
+  (tools/aot_tpu.py; runtime stays the pinned CPU) — skipped cleanly
+  when the image has no usable libtpu/topology, so the suite stays
+  green on CPU-only environments while asserting for real wherever the
+  AOT path exists.
+"""
+
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from tools.aot_copy_census import census_pool_copies
+
+POOL = (2, 32, 64, 8, 64)
+
+
+class TestCensusParser:
+    def test_counts_pool_sized_copies_only(self):
+        hlo = """
+ENTRY %main (p0: bf16[2,32,64,8,64]) -> bf16[2,32,64,8,64] {
+  %copy.1 = bf16[2,32,64,8,64]{4,3,2,1,0:T(8,128)(2,1)} copy(%p0)
+  %copy.2 = bf16[2,32,64,8,64]{2,4,3,1,0:T(8,128)(2,1)} copy(%copy.1)
+  %copy.3 = f32[64,8,64]{2,1,0} copy(%other)
+  %add.1 = bf16[2,32,64,8,64]{4,3,2,1,0} add(%copy.1, %copy.2)
+}
+"""
+        hits = census_pool_copies(hlo, POOL)
+        assert len(hits) == 2          # the small copy and the add don't count
+
+    def test_async_copy_counts_start_only(self):
+        hlo = """
+  %cs = (bf16[2,32,64,8,64]{4,3,2,1,0}, u32[]) copy-start(%p0)
+  %cd = bf16[2,32,64,8,64]{4,3,2,1,0} copy-done(%cs)
+"""
+        # copy-done would double-count the same physical copy.
+        assert len(census_pool_copies(hlo, POOL)) == 1
+
+    def test_zero_on_clean_text(self):
+        assert census_pool_copies("%fusion.1 = bf16[8,8]{1,0} fusion()",
+                                  POOL) == []
+
+    def test_alternate_memory_prefetch_excluded(self):
+        # An S(1) (alternate-memory-space) copy is XLA prefetching a
+        # toy-sized pool into faster memory — an optimization, not the
+        # defensive HBM copy class under test.
+        hlo = ("%cs = (bf16[2,32,64,8,64]{4,3,2,1,0:T(8,128)(2,1)S(1)}, "
+               "bf16[2,32,64,8,64]{4,3,2,1,0:T(8,128)(2,1)}, u32[]{:S(2)})"
+               " copy-start(bf16[2,32,64,8,64]{4,3,2,1,0} %p)")
+        assert census_pool_copies(hlo, POOL) == []
+
+
+@pytest.fixture(scope="module")
+def aot():
+    """The offline v5e compile path, or a skip where the image can't
+    build the TPU topology (no libtpu)."""
+    try:
+        from tools.aot_tpu import aot_compile, sds
+        sds((8, 128), jnp.float32)      # forces topology construction
+    except Exception as e:  # noqa: BLE001 — environment-dependent
+        pytest.skip(f"no offline TPU topology: {type(e).__name__}: {e}")
+    return aot_compile, sds
+
+
+@pytest.fixture()
+def census_env(monkeypatch):
+    """The kernel mix the census compiles: aliased Pallas writers +
+    XLA attention, REAL Mosaic lowering (no interpreter)."""
+    monkeypatch.setenv("XLLM_PALLAS_INTERPRET", "0")
+    monkeypatch.setenv("XLLM_PALLAS", "0")
+    monkeypatch.setenv("XLLM_PALLAS_PREFILL", "0")
+    monkeypatch.setenv("XLLM_PALLAS_KV", "1")
+
+
+class TestCensusAot:
+    def test_positive_control_undonated_writer_copies(self, aot,
+                                                      census_env):
+        """An UN-donated aliased write forces XLA to copy both pools —
+        the census must see them, or a zero result proves nothing."""
+        aot_compile, sds = aot
+        from xllm_service_tpu.ops.pallas.kv_update import paged_kv_update
+        L, P, PS, Hkv, D, B, MP = POOL[0], POOL[1], POOL[2], POOL[3], \
+            POOL[4], 4, 2
+        args = (sds(POOL, jnp.bfloat16), sds(POOL, jnp.bfloat16),
+                sds((L, B, Hkv, D), jnp.bfloat16),
+                sds((L, B, Hkv, D), jnp.bfloat16),
+                sds((B, MP), jnp.int32), sds((B,), jnp.int32),
+                sds((B,), jnp.bool_))
+
+        def write(kp, vp, kn, vn, pt, pos, act):
+            return paged_kv_update(kp, vp, kn, vn, pt, pos, act,
+                                   interpret=False)
+
+        undonated = aot_compile(write, args)
+        assert len(census_pool_copies(undonated.as_text(), POOL)) >= 2
+        donated = aot_compile(write, args, donate_argnums=(0, 1))
+        assert census_pool_copies(donated.as_text(), POOL) == []
+
+    def test_decode_step_zero_pool_copies_wta(self, aot, census_env):
+        """The real (tiny-shaped, structurally identical) decode step
+        with write_then_attend on: ZERO pool-sized copies anywhere in
+        the optimized HLO — loop bodies and the call boundary."""
+        aot_compile, _ = aot
+        import tools.aot_copy_census as cc
+        cc._WTA[0] = True
+        progs = cc.build_programs(tiny=True)
+        fn, args, donate, pool_shape = progs["decode_single"]
+        kw = cc._kv_layout_kwargs(args, donate, cc._N_OUT["decode_single"])
+        compiled = aot_compile(fn, args, donate_argnums=donate, **kw)
+        hits = census_pool_copies(compiled.as_text(), pool_shape)
+        assert hits == [], hits
+
+    def test_prefill_zero_pool_copies_wta(self, aot, census_env):
+        aot_compile, _ = aot
+        import tools.aot_copy_census as cc
+        cc._WTA[0] = True
+        progs = cc.build_programs(tiny=True)
+        fn, args, donate, pool_shape = progs["prefill"]
+        kw = cc._kv_layout_kwargs(args, donate, cc._N_OUT["prefill"])
+        compiled = aot_compile(fn, args, donate_argnums=donate, **kw)
+        hits = census_pool_copies(compiled.as_text(), pool_shape)
+        assert hits == [], hits

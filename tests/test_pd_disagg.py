@@ -5,6 +5,8 @@ SURVEY.md §7.2 step 7)."""
 import json
 import time
 
+import importlib.util
+
 import pytest
 
 from xllm_service_tpu.config import (
@@ -170,6 +172,9 @@ class TestPdDisaggregation:
             master2.stop()
             wire_store.close()
 
+    @pytest.mark.skipif(
+        importlib.util.find_spec("jax.experimental.transfer") is None,
+        reason="jax.experimental.transfer missing in this toolchain")
     def test_device_wire_migration_matches_host_shuttle(self, store):
         """Cross-process data plane (runtime/kv_wire.py): the KV block
         moves via the PJRT transfer server (pull ticket in /kv/import,
@@ -216,6 +221,9 @@ class TestPdDisaggregation:
         ("unsupported", True),    # peer backend can never pull
         ("transient", False),     # one-off mid-pull error: retry later
     ])
+    @pytest.mark.skipif(
+        importlib.util.find_spec("jax.experimental.transfer") is None,
+        reason="jax.experimental.transfer missing in this toolchain")
     def test_device_wire_pull_failure_falls_back_to_host(
             self, store, monkeypatch, failure, blacklists):
         """A decode side that cannot pull (424) must not fail the

@@ -1,0 +1,335 @@
+"""Write-then-attend KV plumbing (EngineConfig.write_then_attend /
+XLLM_WRITE_THEN_ATTEND): the pool rides the layer scan as a carry, each
+layer writes its fresh K/V in place BEFORE attending, and attention
+reads everything — including the current window/token — from the pool.
+
+Covers: the single-layer aliased writers against the XLA scatter
+references (including every drop case), the pool-only prefill kernel
+form against the dual-source reference, and engine-level greedy-token
+identity with the flag on vs off — the acceptance gate of the
+re-plumb."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from xllm_service_tpu.ops import attention as att
+from xllm_service_tpu.ops.pallas.kv_update import (
+    paged_kv_update_layer, paged_prefill_kv_update_layer)
+
+
+class TestLayerWriters:
+    """The traced-layer single-layer writers must match the all-layers
+    XLA scatters layer by layer, drops included."""
+
+    def test_decode_layer_writer_matches_scatter(self):
+        rng = np.random.default_rng(11)
+        L, P, ps, Hkv, D, B, MP = 3, 32, 8, 2, 64, 5, 4
+        kp = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+        kn = jnp.asarray(rng.normal(size=(L, B, Hkv, D)), jnp.float32)
+        vn = jnp.asarray(rng.normal(size=(L, B, Hkv, D)), jnp.float32)
+        pt = jnp.asarray(np.arange(1, B * MP + 1).reshape(B, MP),
+                         jnp.int32)
+        pt = pt.at[1, :].set(0)                    # NULL row → dropped
+        pos = jnp.asarray([0, 5, 7, 13, 100], jnp.int32)  # 100 off-table
+        act = jnp.asarray([1, 1, 0, 1, 1], bool)          # row 2 inactive
+        ref_k, ref_v = att.write_decode_kv_all_layers_xla(
+            kp, vp, kn, vn, pt, pos, act)
+        got_k, got_v = kp, vp
+        for li in range(L):
+            got_k, got_v = paged_kv_update_layer(
+                got_k, got_v, kn[li], vn[li], pt, pos, act,
+                jnp.int32(li), interpret=True)
+        assert jnp.array_equal(ref_k, got_k)
+        assert jnp.array_equal(ref_v, got_v)
+        # The XLA fallback writer agrees too (the wta path's
+        # kernel-ineligible branch).
+        got_k, got_v = kp, vp
+        for li in range(L):
+            got_k, got_v = att.write_decode_kv_layer_xla(
+                got_k, got_v, kn[li], vn[li], pt, pos, act, jnp.int32(li))
+        assert jnp.array_equal(ref_k, got_k)
+        assert jnp.array_equal(ref_v, got_v)
+
+    def test_prefill_layer_writer_matches_scatter(self):
+        rng = np.random.default_rng(12)
+        L, P, ps, Hkv, D, B, T, MP = 3, 32, 8, 2, 16, 4, 16, 6
+        kp = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+        kn = jnp.asarray(rng.normal(size=(L, B, T, Hkv, D)), jnp.float32)
+        vn = jnp.asarray(rng.normal(size=(L, B, T, Hkv, D)), jnp.float32)
+        pt = jnp.asarray(np.arange(1, B * MP + 1).reshape(B, MP),
+                         jnp.int32)
+        pt = pt.at[2, :].set(0)                        # NULL row
+        start = jnp.asarray([0, 8, 0, 16], jnp.int32)  # page-aligned
+        lens = jnp.asarray([16, 11, 16, 5], jnp.int32)  # ragged tails
+        ref_k, ref_v = att.write_prefill_kv_all_layers_xla(
+            kp, vp, kn, vn, pt, start, lens)
+        got_k, got_v = kp, vp
+        for li in range(L):
+            got_k, got_v = paged_prefill_kv_update_layer(
+                got_k, got_v, kn[li], vn[li], pt, start, lens,
+                jnp.int32(li), interpret=True)
+        assert jnp.array_equal(ref_k, got_k)
+        assert jnp.array_equal(ref_v, got_v)
+        got_k, got_v = kp, vp
+        for li in range(L):
+            got_k, got_v = att.write_prefill_kv_layer_xla(
+                got_k, got_v, kn[li], vn[li], pt, start, lens,
+                jnp.int32(li))
+        assert jnp.array_equal(ref_k, got_k)
+        assert jnp.array_equal(ref_v, got_v)
+
+    def test_prefill_layer_writer_unaligned_start_falls_back(self,
+                                                             monkeypatch):
+        """A mid-page window start must NOT reach the page-granular
+        kernel (it would misplace whole pages); the dispatcher's
+        page_aligned_starts=False pins the XLA scatter, which handles
+        any alignment."""
+        monkeypatch.setenv("XLLM_PALLAS_KV", "1")
+        rng = np.random.default_rng(13)
+        L, P, ps, Hkv, D, B, T, MP = 2, 32, 8, 1, 16, 2, 16, 6
+        kp = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+        kn = jnp.asarray(rng.normal(size=(L, B, T, Hkv, D)), jnp.float32)
+        vn = jnp.asarray(rng.normal(size=(L, B, T, Hkv, D)), jnp.float32)
+        pt = jnp.asarray(np.arange(1, B * MP + 1).reshape(B, MP),
+                         jnp.int32)
+        start = jnp.asarray([4, 20], jnp.int32)        # UNALIGNED
+        lens = jnp.asarray([16, 9], jnp.int32)
+        ref = att.write_prefill_kv_all_layers_xla(kp, vp, kn, vn, pt,
+                                                  start, lens)
+        for li in range(L):
+            kp, vp = att.write_prefill_kv_layer(
+                kp, vp, kn[li], vn[li], pt, start, lens, jnp.int32(li),
+                page_aligned_starts=False)
+        assert jnp.array_equal(ref[0], kp)
+        assert jnp.array_equal(ref[1], vp)
+
+
+class TestPoolOnlyPrefillKernel:
+    """The from_pool (write-then-attend) form of the prefill attention
+    kernel: window K/V pre-written into the pool, no fresh operands,
+    ragged tail read through the page table."""
+
+    def _case(self, seed, B, T, Hq, Hkv, D, P, ps, MP, q_starts, lengths,
+              q_block=16, **extras):
+        from xllm_service_tpu.ops.attention import (
+            gather_pages, mha_prefill, write_prefill_kv_all_layers_xla)
+        from xllm_service_tpu.ops.pallas.prefill_attention import (
+            paged_prefill_attention_pallas)
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.normal(size=(B, T, Hq, D)), jnp.float32)
+        kf = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+        vf = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+        kp = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+        # Disjoint tables so each row's window pages are its own.
+        pt = jnp.asarray(1 + np.arange(B * MP).reshape(B, MP), jnp.int32)
+        q_start = jnp.asarray(q_starts, jnp.int32)
+        lens = jnp.asarray(lengths, jnp.int32)
+        # Reference: dual-source (pool prefix + fresh overlay).
+        k_all = att.overlay_fresh_kv(gather_pages(kp, pt), kf, q_start)
+        v_all = att.overlay_fresh_kv(gather_pages(vp, pt), vf, q_start)
+        ref = mha_prefill(q, k_all, v_all, q_start + lens, q_start,
+                          extras.get("logits_soft_cap", 0.0),
+                          extras.get("sliding_window", 0),
+                          extras.get("scale"), extras.get("sinks"))
+        # Write the window into the pool first, then attend pool-only.
+        kp2, vp2 = write_prefill_kv_all_layers_xla(
+            kp[None], vp[None], kf[None], vf[None], pt, q_start, lens)
+        out = paged_prefill_attention_pallas(
+            q, None, None, kp2[0], vp2[0], pt, q_start, lens,
+            q_block=q_block, interpret=True, from_pool=True, **extras)
+        for b in range(B):
+            n = int(lens[b])
+            got, want = out[b, :n], ref[b, :n]
+            assert jnp.allclose(got, want, atol=2e-5), (
+                b, float(jnp.max(jnp.abs(got - want))))
+
+    def test_plain_and_ragged(self):
+        self._case(20, B=3, T=32, Hq=8, Hkv=2, D=32, P=32, ps=16, MP=4,
+                   q_starts=[0, 16, 0], lengths=[32, 16, 7])
+
+    def test_cached_prefix_and_window(self):
+        self._case(21, B=2, T=32, Hq=8, Hkv=2, D=32, P=32, ps=16, MP=6,
+                   q_starts=[32, 16], lengths=[32, 20], sliding_window=9)
+
+    def test_softcap_scale_sinks(self):
+        rng = np.random.default_rng(22)
+        self._case(22, B=2, T=32, Hq=8, Hkv=2, D=32, P=32, ps=16, MP=4,
+                   q_starts=[16, 0], lengths=[32, 11],
+                   logits_soft_cap=25.0, scale=0.21,
+                   sinks=jnp.asarray(rng.normal(size=(8,)), jnp.float32))
+
+    def test_layered_pool_only_matches_sliced(self):
+        from xllm_service_tpu.ops.pallas.prefill_attention import (
+            paged_prefill_attention_pallas)
+        rng = np.random.default_rng(23)
+        L, P, ps, Hkv, D, B, T, MP, Hq = 3, 8, 8, 2, 16, 2, 16, 4, 4
+        kp5 = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)),
+                          jnp.float32)
+        vp5 = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)),
+                          jnp.float32)
+        q = jnp.asarray(rng.normal(size=(B, T, Hq, D)), jnp.float32)
+        pt = jnp.asarray(1 + rng.integers(0, P - 1, size=(B, MP)),
+                         jnp.int32)
+        start = jnp.asarray([8, 16], jnp.int32)
+        lens = jnp.full((B,), T, jnp.int32)
+        for li in range(L):
+            ref = paged_prefill_attention_pallas(
+                q, None, None, kp5[li], vp5[li], pt, start, lens,
+                interpret=True, from_pool=True)
+            got = paged_prefill_attention_pallas(
+                q, None, None, kp5, vp5, pt, start, lens,
+                interpret=True, from_pool=True, layer=jnp.int32(li))
+            assert jnp.allclose(ref, got, atol=1e-6), f"layer {li}"
+
+
+def _run_engine(monkeypatch, env: dict, cfg=None, prompts=None,
+                max_tokens=8, ecfg_kw=None):
+    from xllm_service_tpu.config import EngineConfig, ModelConfig
+    from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+    from xllm_service_tpu.utils.types import SamplingParams
+
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    cfg = cfg or ModelConfig.tiny(vocab_size=256)
+    kw = dict(page_size=16, num_pages=64, max_model_len=256,
+              max_batch_size=4, max_prefill_tokens=128,
+              prefill_buckets=(16, 32, 64), decode_steps=4)
+    kw.update(ecfg_kw or {})
+    ecfg = EngineConfig(**kw)
+    prompts = prompts or [list(range(1, 33)), [7, 9, 11] * 8]
+    sp = SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                        ignore_eos=True)
+    eng = Engine(cfg, ecfg, seed=0)
+    outs = {}
+    # Second wave repeats prompt 0 → prefix-cache hit → q_start > 0.
+    for wave in (prompts, [prompts[0]]):
+        for i, p in enumerate(wave):
+            rid = f"r{len(outs)}-{i}"
+            eng.add_request(EngineRequest(
+                request_id=rid, token_ids=list(p), sampling=sp))
+        while eng.has_work():
+            for o in eng.step():
+                outs.setdefault(o.request_id, []).extend(o.new_token_ids)
+    return outs
+
+
+class TestEngineWriteThenAttend:
+    """Greedy generations must be token-identical with the flag on vs
+    off — through fused decode bursts, chunked prefill windows, and a
+    prefix-cache readmission — on both the Pallas (interpreter) and
+    pure-XLA serving paths. The acceptance gate of the re-plumb."""
+
+    def test_identical_generations_pallas_path(self, monkeypatch):
+        base = {"XLLM_PALLAS": "1", "XLLM_PALLAS_PREFILL": "1"}
+        off = _run_engine(monkeypatch,
+                          dict(base, XLLM_WRITE_THEN_ATTEND="0"))
+        on = _run_engine(monkeypatch,
+                         dict(base, XLLM_WRITE_THEN_ATTEND="1"))
+        assert set(off) == set(on)
+        for rid in off:
+            assert off[rid] == on[rid], rid
+
+    def test_identical_generations_xla_path(self, monkeypatch):
+        base = {"XLLM_PALLAS": "0", "XLLM_PALLAS_PREFILL": "0"}
+        off = _run_engine(monkeypatch,
+                          dict(base, XLLM_WRITE_THEN_ATTEND="0"))
+        on = _run_engine(monkeypatch,
+                         dict(base, XLLM_WRITE_THEN_ATTEND="1"))
+        assert set(off) == set(on)
+        for rid in off:
+            assert off[rid] == on[rid], rid
+
+    def test_identical_generations_swa(self, monkeypatch):
+        """Sliding-window model (windowed masks + O(W) page trimming)
+        through the wta path."""
+        import dataclasses
+
+        from xllm_service_tpu.config import ModelConfig
+        cfg = dataclasses.replace(ModelConfig.tiny(vocab_size=256),
+                                  name="tiny-swa-wta", sliding_window=24)
+        base = {"XLLM_PALLAS": "1", "XLLM_PALLAS_PREFILL": "1"}
+        off = _run_engine(monkeypatch,
+                          dict(base, XLLM_WRITE_THEN_ATTEND="0"),
+                          cfg=cfg, max_tokens=16)
+        on = _run_engine(monkeypatch,
+                         dict(base, XLLM_WRITE_THEN_ATTEND="1"),
+                         cfg=cfg, max_tokens=16)
+        assert set(off) == set(on)
+        for rid in off:
+            assert off[rid] == on[rid], rid
+
+    def test_env_flag_reaches_config(self, monkeypatch):
+        from xllm_service_tpu.config import EngineConfig
+        monkeypatch.setenv("XLLM_WRITE_THEN_ATTEND", "1")
+        assert EngineConfig(page_size=16, num_pages=32,
+                            max_model_len=64).write_then_attend is True
+        monkeypatch.setenv("XLLM_WRITE_THEN_ATTEND", "0")
+        assert EngineConfig(page_size=16, num_pages=32,
+                            max_model_len=64).write_then_attend is False
+        monkeypatch.delenv("XLLM_WRITE_THEN_ATTEND")
+        assert EngineConfig(page_size=16, num_pages=32,
+                            max_model_len=64).write_then_attend is None
+
+
+class TestMlaWriteThenAttend:
+    """MLA (latent-pool) forward parity with the flag on vs off, plus
+    the page_aligned_prefill regression (advisor bugfix): an MLA config
+    with non-page-multiple prefill buckets produces UNALIGNED window
+    starts mid-prompt, which must keep the kernel-free scatter instead
+    of corrupting the pool via page-granular writes."""
+
+    def _mla_cfg(self):
+        from xllm_service_tpu.config import ModelConfig
+        return ModelConfig(
+            name="tiny-mla", vocab_size=128, hidden_size=32,
+            intermediate_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=4, kv_lora_rank=16, qk_rope_head_dim=8,
+            qk_nope_head_dim=16, v_head_dim=16, dtype="float32")
+
+    def _forward(self, monkeypatch, wta, start, T, aligned,
+                 pallas="1"):
+        from xllm_service_tpu.models import transformer
+        monkeypatch.setenv("XLLM_PALLAS", pallas)
+        cfg = self._mla_cfg()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(1))
+        kv = transformer.init_kv_cache(cfg, 16, 8, jnp.float32)
+        rng = np.random.default_rng(7)
+        B = 2
+        toks = jnp.asarray(rng.integers(1, 127, size=(B, T)), jnp.int32)
+        starts = jnp.asarray([0, start], jnp.int32)
+        lens = jnp.asarray([T, T - 3], jnp.int32)
+        pt = jnp.asarray(np.arange(1, B * 6 + 1).reshape(B, 6), jnp.int32)
+        last, _, kv2 = transformer.forward_prefill(
+            params, cfg, toks, starts, lens, kv, pt,
+            page_aligned_prefill=aligned, write_then_attend=wta)
+        return (np.asarray(last), np.asarray(kv2[0]), np.asarray(kv2[1]))
+
+    def test_mla_wta_matches_baseline(self, monkeypatch):
+        base = self._forward(monkeypatch, wta=False, start=8, T=16,
+                             aligned=True, pallas="0")
+        got = self._forward(monkeypatch, wta=True, start=8, T=16,
+                            aligned=True)
+        for a, b in zip(base, got):
+            assert np.max(np.abs(a - b)) < 2e-4
+
+    def test_mla_misaligned_bucket_uses_scatter(self, monkeypatch):
+        """start_pos=20 on 8-token pages (a 20-token bucket's second
+        window): before page_aligned_prefill was threaded through
+        _mla_forward_prefill, the kernel path engaged with the
+        unaligned start and silently corrupted the pool."""
+        base = self._forward(monkeypatch, wta=False, start=20, T=16,
+                             aligned=False, pallas="0")
+        got = self._forward(monkeypatch, wta=False, start=20, T=16,
+                            aligned=False)
+        for a, b in zip(base, got):
+            assert np.max(np.abs(a - b)) < 2e-4
+        got_wta = self._forward(monkeypatch, wta=True, start=20, T=16,
+                                aligned=False)
+        for a, b in zip(base, got_wta):
+            assert np.max(np.abs(a - b)) < 2e-4

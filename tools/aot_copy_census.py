@@ -1,0 +1,295 @@
+"""HLO copy census: prove the KV pools never move, INCLUDING the
+jit-call boundary.
+
+Round 5 fixed the in-loop pool copies (aliased Pallas writers + layered
+attention) and left one residue documented: XLA still copied the pools
+a handful of times per CALL around the two custom calls, because the
+opaque attention call read a buffer the post-scan writer aliased —
+amortized to noise inside the fused 64-step decode burst, but
+~10-15 GB per PREFILL call. Write-then-attend
+(EngineConfig.write_then_attend / XLLM_WRITE_THEN_ATTEND) removes the
+hazard at the root: the aliased writer is the pool's first consumer in
+every layer body, so nothing ever reads the pre-write buffer.
+
+This tool is the ground truth for that claim: it AOT-compiles the
+jitted serving programs for v5e (tools/aot_tpu.py — local libtpu, no
+chip, CPU runtime pinned) and counts COPY instructions whose result is
+pool-sized anywhere in the optimized HLO — loop bodies AND the entry
+computation, i.e. the call boundary round 5's in-loop census could not
+see. Expected with write_then_attend on: zero in the prefill program
+and zero in the decode burst.
+
+Run:  python tools/aot_copy_census.py            # bench shape, A/B
+      python tools/aot_copy_census.py --tiny     # small shapes (fast)
+
+Prints one verdict line per (program, mode) plus a JSON summary. The
+tier-1 suite runs the same census at the tiny shape
+(tests/test_copy_census.py), so a PR reintroducing pool copies fails
+CI instead of shipping a silent 10 GB/call regression.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.aot_tpu import aot_compile, sds  # noqa: E402  (pins CPU)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+# "%copy.3 = bf16[16,512,128,8,64]{...} copy(...)" — async copies lower
+# as copy-start/copy-done pairs whose copy-start result is a TUPLE
+# "(bf16[...]{...}, u32[])"; count starts only, or one physical copy
+# would tally twice. The opcode match anchors on "<space>opcode(" so
+# copy-done / fusion metadata never match.
+_SHAPE_RE = re.compile(r"=\s*\(?\s*[a-z0-9]+\[([0-9,]*)\]")
+_OP_RE = re.compile(r"\s(copy|copy-start)\(")
+
+
+def census_pool_copies(hlo_text: str, pool_shape) -> list:
+    """All copy/copy-start instructions in ``hlo_text`` whose result has
+    exactly the pool's element count. Returns the matched shape strings
+    (empty list = the pools never move).
+
+    Copies into/out of an ALTERNATE memory space (an ``S(k)`` layout
+    annotation, k != 0) are excluded: those are XLA's memory-space-
+    assignment prefetches into faster memory — an optimization that only
+    exists when the pool is toy-sized enough to fit — not the defensive
+    HBM↔HBM pool copies this census hunts (which carry default-space
+    layouts on both sides)."""
+    want = 1
+    for d in pool_shape:
+        want *= int(d)
+    hits = []
+    for line in hlo_text.splitlines():
+        op = _OP_RE.search(line)
+        if not op:
+            continue
+        m = _SHAPE_RE.search(line)
+        if not m:
+            continue
+        if re.search(r"S\([1-9]", line[:op.start()]):
+            # The RESULT (destination) lives in alternate memory: a
+            # prefetch, not a copy-out. A defensive copy's destination
+            # is default-space even when its OPERAND was placed in
+            # S(1) (that one must still count — the positive control's
+            # aliased-output copy-back is exactly that shape).
+            continue
+        dims = m.group(1)
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        if n == want:
+            hits.append(f"{op.group(1)} {dims}")
+    return hits
+
+
+def _llama3_1b_sds():
+    from xllm_service_tpu.config import ModelConfig
+    cfg = ModelConfig.llama3_1b()
+    L, Hq, Hkv, D = (cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
+                     cfg.head_dim)
+    V, H, I = cfg.vocab_size, cfg.hidden_size, cfg.intermediate_size
+    bf = jnp.bfloat16
+    layers = {
+        "input_norm": sds((L, H), bf), "post_norm": sds((L, H), bf),
+        "q_proj": sds((L, H, Hq * D), bf),
+        "k_proj": sds((L, H, Hkv * D), bf),
+        "v_proj": sds((L, H, Hkv * D), bf),
+        "o_proj": sds((L, Hq * D, H), bf),
+        "gate_proj": sds((L, H, I), bf), "up_proj": sds((L, H, I), bf),
+        "down_proj": sds((L, I, H), bf),
+    }
+    params = {"embed": sds((V, H), bf), "final_norm": sds((H,), bf),
+              "layers": layers}
+    return cfg, params
+
+
+def _tiny_sds():
+    from xllm_service_tpu.config import ModelConfig
+    # Small for compile speed but MOSAIC-ALIGNED: Hkv=8 sublanes and
+    # D=64 lanes, matching the round-5 validated probe geometry
+    # (docs/AOT_VERDICTS_r5.txt) — the test suite's tiny config (Hkv=2,
+    # D=16) hits in-kernel [ps, Hkv, D] relayouts v5e Mosaic refuses to
+    # lower, the same class round 3 hit in the V3 decode kernel.
+    cfg = ModelConfig(name="tiny-census", vocab_size=256, hidden_size=128,
+                      intermediate_size=256, num_layers=2, num_heads=16,
+                      num_kv_heads=8, head_dim=64, rope_theta=10000.0,
+                      max_position_embeddings=512, dtype="bfloat16")
+    L, Hq, Hkv, D = (cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
+                     cfg.head_dim)
+    V, H, I = cfg.vocab_size, cfg.hidden_size, cfg.intermediate_size
+    bf = jnp.bfloat16
+    layers = {
+        "input_norm": sds((L, H), bf), "post_norm": sds((L, H), bf),
+        "q_proj": sds((L, H, Hq * D), bf),
+        "k_proj": sds((L, H, Hkv * D), bf),
+        "v_proj": sds((L, H, Hkv * D), bf),
+        "o_proj": sds((L, Hq * D, H), bf),
+        "gate_proj": sds((L, H, I), bf), "up_proj": sds((L, H, I), bf),
+        "down_proj": sds((L, I, H), bf),
+    }
+    params = {"embed": sds((V, H), bf), "final_norm": sds((H,), bf),
+              "layers": layers}
+    return cfg, params
+
+
+def build_programs(tiny: bool = False):
+    """(name → (fn, args, donate_argnums, pool_shape)) for the census:
+    the prefill step, the single decode step, and the fused decode
+    burst, at the bench geometry (or a scaled-down structurally
+    identical one for the tier-1 check)."""
+    from xllm_service_tpu.models import transformer
+
+    if tiny:
+        cfg, params = _tiny_sds()
+        P, ps, burst = 32, 64, 4
+        B, ctx, Bp, T = 4, 96, 4, 64
+    else:
+        cfg, params = _llama3_1b_sds()
+        # The headline bench geometry: page_size 128, 512-page pool,
+        # B=64 ctx=384 decode bursts of 64, one-call B=64 T=128 prefill.
+        P, ps, burst = 512, 128, 64
+        B, ctx, Bp, T = 64, 384, 64, 128
+    L, Hkv, D = cfg.num_layers, cfg.kv_cache_heads, cfg.kv_cache_dim
+    pool_shape = (L, P, ps, Hkv, D)
+    kv = (sds(pool_shape, jnp.bfloat16), sds(pool_shape, jnp.bfloat16))
+
+    def pow2(n):
+        return 1 << max(n - 1, 0).bit_length()
+
+    MP = pow2(-(-(ctx + 1) // ps))
+    tok = sds((B,), jnp.int32)
+    pos = sds((B,), jnp.int32)
+    act = sds((B,), jnp.bool_)
+    pt = sds((B, MP), jnp.int32)
+
+    def decode_single(params, tok, pos, act, kv, pt):
+        logits, kv = transformer.forward_decode(
+            params, cfg, tok, pos, act, kv, pt,
+            write_then_attend=_WTA[0])
+        return jnp.argmax(logits, -1).astype(jnp.int32), kv
+
+    def decode_burst(params, tok, pos, act, kv, pt):
+        def body(carry, _):
+            t, p, kv = carry
+            logits, kv = transformer.forward_decode(
+                params, cfg, t, p, act, kv, pt,
+                write_then_attend=_WTA[0])
+            t2 = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (t2, p + 1, kv), t2
+        (t, p, kv2), toks = jax.lax.scan(
+            body, (tok, pos, kv), None, length=burst)
+        return toks, t, p, kv2
+
+    MPp = pow2(-(-(T + 1) // ps))
+    tokens = sds((Bp, T), jnp.int32)
+    start = sds((Bp,), jnp.int32)
+    lens = sds((Bp,), jnp.int32)
+    ptp = sds((Bp, MPp), jnp.int32)
+
+    def prefill_step(params, tokens, start, lens, kv, ptp):
+        last, _, kv = transformer.forward_prefill(
+            params, cfg, tokens, start, lens, kv, ptp,
+            write_then_attend=_WTA[0])
+        return jnp.argmax(last, -1).astype(jnp.int32), kv
+
+    return {
+        "prefill": (prefill_step, (params, tokens, start, lens, kv, ptp),
+                    (4,), pool_shape),
+        "decode_single": (decode_single, (params, tok, pos, act, kv, pt),
+                          (4,), pool_shape),
+        "decode_burst": (decode_burst, (params, tok, pos, act, kv, pt),
+                         (4,), pool_shape),
+    }
+
+
+# write_then_attend is threaded through a mutable cell so build_programs
+# traces fresh closures per mode (jit caches by function identity — the
+# census compiles a new function object per (program, mode) anyway).
+_WTA = [True]
+
+
+def _kv_layout_kwargs(args, donate, n_out, kv_out=None):
+    """The engine's boundary-layout pin (runtime/engine.py
+    _kv_default_layouts): KV pools at default major-to-minor on BOTH
+    sides of the jit. Without it XLA assigns the pool parameters an
+    attention-biased layout while the aliased writer custom call needs
+    the default — 4 full-pool conversion copies per call."""
+    from jax.experimental.layout import DeviceLocalLayout, Layout
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tools.aot_tpu import _mesh
+    sh = NamedSharding(_mesh(), PartitionSpec())
+    kv_idx = donate[0]
+    lay = tuple(Layout(DeviceLocalLayout(tuple(range(x.ndim))), sh)
+                for x in args[kv_idx])
+    ins = [None] * len(args)
+    ins[kv_idx] = lay
+    outs = [None] * n_out
+    outs[-1 if kv_out is None else kv_out] = lay
+    return {"in_shardings": tuple(ins), "out_shardings": tuple(outs)}
+
+
+_N_OUT = {"prefill": 2, "decode_single": 2, "decode_burst": 4}
+
+
+def run_census(tiny: bool = False, modes=(True, False)) -> dict:
+    """Compile each program per write_then_attend mode; returns
+    {f"{name}[wta={mode}]": {"ok":, "pool_copies":, "hits": [...]}}."""
+    results = {}
+    for mode in modes:
+        _WTA[0] = mode
+        for name, (fn, args, donate, pool_shape) in \
+                build_programs(tiny).items():
+            tag = f"{name}[wta={'on' if mode else 'off'}]"
+            try:
+                kw = _kv_layout_kwargs(args, donate, _N_OUT[name])
+                compiled = aot_compile(fn, args, donate_argnums=donate,
+                                       **kw)
+                hits = census_pool_copies(compiled.as_text(), pool_shape)
+                results[tag] = {"ok": True, "pool_copies": len(hits),
+                                "hits": hits[:8]}
+                print(f"{tag}: COMPILE OK  pool_copies={len(hits)}")
+            except Exception as e:  # noqa: BLE001 — verdicts, not crashes
+                msg = str(e).replace("\n", " ")[:300]
+                results[tag] = {"ok": False, "error": msg}
+                print(f"{tag}: FAIL: {msg}")
+    return results
+
+
+def main() -> int:
+    tiny = "--tiny" in sys.argv
+    # Real Mosaic lowering, with the kernel mix THIS toolchain lowers:
+    # the aliased KV writers (XLLM_PALLAS_KV=1 — the aliasing story the
+    # census is about) + XLA attention. The baked jax's Mosaic is older
+    # than round 5's and rejects the attention kernels' in-kernel
+    # [ps, Hkv, D] relayouts ("transpose[permutation=(1,0,2)]" /
+    # 3D dots — see tools/aot_kernel_probes.py output on this image),
+    # so XLLM_PALLAS=1 programs cannot compile offline here; XLA
+    # attention reads the same pool buffers, so the copy hazard under
+    # test — attention reading what the writer aliases — is identical.
+    # The wta flag itself is passed explicitly per mode (not via env)
+    # so one process covers the A/B.
+    os.environ["XLLM_PALLAS_INTERPRET"] = "0"
+    os.environ["XLLM_PALLAS"] = "0"
+    os.environ["XLLM_PALLAS_PREFILL"] = "0"
+    os.environ["XLLM_PALLAS_KV"] = "1"
+    results = run_census(tiny=tiny)
+    on_clean = all(r["ok"] and r["pool_copies"] == 0
+                   for t, r in results.items() if "[wta=on]" in t)
+    print(json.dumps({"aot_target": "v5e:1x1 (local libtpu)",
+                      "tiny": tiny,
+                      "write_then_attend_zero_pool_copies": on_clean,
+                      "results": results}))
+    return 0 if on_clean else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

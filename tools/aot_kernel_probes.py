@@ -181,6 +181,51 @@ def main() -> int:
              sds((32, MP), jnp.int32), sds((32,), jnp.int32),
              sds((32,), jnp.int32)))
 
+    # ---- write-then-attend forms: the single-layer (traced layer
+    # index) aliased writers and the pool-only prefill attention ----
+    from xllm_service_tpu.ops.pallas.kv_update import (
+        paged_kv_update_layer, paged_prefill_kv_update_layer)
+    lyr = sds((), jnp.int32)
+    for tag, HkvW, DW in (("", Hkv, D), (" MLA latent (Hkv=1 D=576)",
+                                         1, 576)):
+        results[f"decode/kv_update_layer{tag}"] = _probe(
+            f"KV UPDATE LAYER (write-then-attend){tag}",
+            lambda kp, vp, knn, vnn, pt2, pos, act, ll:
+            paged_kv_update_layer(kp, vp, knn, vnn, pt2, pos, act, ll,
+                                  interpret=False),
+            (sds((16, 1024, PS, HkvW, DW), jnp.bfloat16),
+             sds((16, 1024, PS, HkvW, DW), jnp.bfloat16),
+             sds((Bd, HkvW, DW), jnp.bfloat16),
+             sds((Bd, HkvW, DW), jnp.bfloat16),
+             ptd, ctx, sds((Bd,), jnp.bool_), lyr))
+        results[f"prefill/kv_update_layer{tag}"] = _probe(
+            f"PREFILL KV UPDATE LAYER (write-then-attend){tag}",
+            lambda kp, vp, knn, vnn, pt2, st, lnn, ll:
+            paged_prefill_kv_update_layer(kp, vp, knn, vnn, pt2, st,
+                                          lnn, ll, interpret=False),
+            (sds((16, 1024, PS, HkvW, DW), jnp.bfloat16),
+             sds((16, 1024, PS, HkvW, DW), jnp.bfloat16),
+             sds((32, 128, HkvW, DW), jnp.bfloat16),
+             sds((32, 128, HkvW, DW), jnp.bfloat16),
+             sds((32, MP), jnp.int32), sds((32,), jnp.int32),
+             sds((32,), jnp.int32), lyr))
+
+    results["prefill/pool-only (write-then-attend)"] = _probe(
+        "PREFILL KERNEL [pool-only]",
+        lambda qq, kpp, vpp, ptt, qss, lnn, ww: _impl(
+            qq, None, None, kpp, vpp, ptt, qss, lnn, ww, None,
+            q_block=64, logits_soft_cap=0.0, scale=scale,
+            interpret=False, from_pool=True),
+        (q, kp, kp, pt, qs, ln, win))
+    results["prefill/pool-only layered (write-then-attend)"] = _probe(
+        "PREFILL KERNEL [pool-only layered]",
+        lambda qq, kpp, vpp, ptt, qss, lnn, ww, ll: _impl(
+            qq, None, None, kpp, vpp, ptt, qss, lnn, ww, None, ll,
+            q_block=64, logits_soft_cap=0.0, scale=scale,
+            interpret=False, from_pool=True),
+        (q, sds((16, P, PS, Hkv, D), jnp.bfloat16),
+         sds((16, P, PS, Hkv, D), jnp.bfloat16), pt, qs, ln, win, lyr))
+
     print(json.dumps({"aot_target": "v5e (local libtpu topology)",
                       "pass": sum(results.values()),
                       "total": len(results),

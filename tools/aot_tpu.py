@@ -29,6 +29,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # time against the offline topology.
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# libtpu init otherwise spends ~7 MINUTES retrying GCP instance-metadata
+# fetches (30 tries x several variables against a 403ing endpoint) the
+# first time a topology is requested in this container. Pin the answers
+# it would have fetched — there is no real chip behind this module by
+# design, so the static v5e single-host values are always right — and
+# tell it to skip the metadata server outright. setdefault: a caller
+# with a genuinely different accelerator can still override.
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
+os.environ.setdefault("TPU_WORKER_ID", "0")
+os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+
 import jax  # noqa: E402
 
 try:

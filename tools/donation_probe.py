@@ -29,8 +29,10 @@ st_f = jnp.zeros((2, 4), jnp.float32)
 st_i = jnp.zeros((2, 2), jnp.int32)
 key = jax.random.PRNGKey(0)
 
+# t_len is a POSITIONAL static (arg 12) now — the pinned-layout jits
+# reject kwargs outright (runtime/engine.py).
 low = eng._jit_prefill.lower(eng.params, packed, eng.kv, st_f, st_i,
-                             key, None, None, None, None, None, t_len=16)
+                             key, None, None, None, None, None, None, 16)
 comp = low.compile()
 ma = comp.memory_analysis()
 print("PREFILL alias bytes:", ma.alias_size_in_bytes,

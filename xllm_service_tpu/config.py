@@ -738,6 +738,16 @@ class EngineConfig:
     sp: int = 1
     # Offline (batch) requests are preempted by online ones.
     max_num_seqs: int = 256             # scheduler queue cap
+    # Write-then-attend KV plumbing (round-5 "known residue" fix): the
+    # pool rides the layer scan as a carry, each layer writes its fresh
+    # K/V in place (aliased Pallas writer) BEFORE attending, and the
+    # attention kernels read everything — including the current window /
+    # token — from the pool. Kills the jit-call-boundary pool copies XLA
+    # inserts around the post-scan writer (~10-15 GB per prefill call at
+    # the bench shape). None = auto: on wherever the Pallas kernels are
+    # on (pallas.enabled()), off on the pure-XLA path, resolved at
+    # Engine init. Env XLLM_WRITE_THEN_ATTEND=0/1 overrides.
+    write_then_attend: Optional[bool] = None
 
     def __post_init__(self) -> None:
         if self.max_model_len % self.page_size != 0:
@@ -752,6 +762,11 @@ class EngineConfig:
         # pages) keep the XLA scatter path instead of corrupting pools.
         self.prefill_page_aligned = all(
             b % self.page_size == 0 for b in self.prefill_buckets)
+        env = os.environ.get("XLLM_WRITE_THEN_ATTEND", "").strip()
+        if env in ("0", "false", "no"):
+            self.write_then_attend = False
+        elif env in ("1", "true", "yes"):
+            self.write_then_attend = True
 
 
 def load_json(path: str) -> Dict[str, Any]:

@@ -42,12 +42,16 @@ from xllm_service_tpu.ops.attention import (
     FULL_WINDOW,
     mha_prefill,
     mha_prefill_auto,
+    paged_decode_attention,
+    paged_decode_attention_auto,
     paged_decode_attention_current,
     paged_decode_attention_current_auto,
     gather_pages,
     overlay_fresh_kv,
     write_prefill_kv_all_layers,
+    write_prefill_kv_layer,
     write_decode_kv_all_layers,
+    write_decode_kv_layer,
 )
 
 Params = Dict[str, Any]
@@ -347,6 +351,7 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                     return_stats: bool = False,
                     rope_pos: Optional[jnp.ndarray] = None,
                     page_aligned_prefill: bool = True,
+                    write_then_attend: bool = False,
                     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], KVCache]:
     """Prefill ``tokens`` [B, T] (padded; true new-token counts in
     ``lengths``; nonzero ``start_pos`` = prefix-cache hit, those tokens are
@@ -371,6 +376,16 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     pool (``_mla_forward_prefill``); multimodal splice is not defined
     for them.
 
+    ``write_then_attend`` (static): the round-5 "known residue" fix —
+    the pool rides the layer scan as a CARRY and each layer writes its
+    fresh window into the pool FIRST (aliased Pallas writer = the
+    pool's first consumer), then attention reads everything — cached
+    prefix AND the current window — from the pool. Kills the jit-call-
+    boundary pool copies XLA inserts when an opaque attention call
+    reads a buffer the post-scan writer aliases (~10-15 GB per prefill
+    call at the bench shape). Default off here; the engine turns it on
+    per EngineConfig.write_then_attend.
+
     Returns (last_logits [B, V] fp32, all_logits [B, T, V] fp32 or None,
     kv'). ``return_all_logits`` (static) gates the full-prompt lm_head: at
     serving shapes a [B, T, V] fp32 tensor is gigabytes of HBM and a T×
@@ -383,7 +398,9 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             params, cfg, tokens, start_pos, lengths, kv, page_table,
             return_all_logits=return_all_logits,
             prompt_lp_targets=prompt_lp_targets,
-            return_stats=return_stats)
+            return_stats=return_stats,
+            page_aligned_prefill=page_aligned_prefill,
+            write_then_attend=write_then_attend)
     k_pages, v_pages = kv
     x = _scale_embed(cfg, params["embed"][tokens]
                      .astype(jnp.dtype(cfg.dtype)))              # [B, T, D]
@@ -401,7 +418,12 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     win_arr = _layer_windows(cfg)
     rope_arr = _layer_rope(cfg)
 
-    def layer(x, xs):
+    def layer(carry, xs):
+        if write_then_attend:
+            x, kp_c, vp_c = carry
+        else:
+            x = carry
+            kp_c, vp_c = k_pages, v_pages
         ro = None
         if win_arr is not None and rope_arr is not None:
             lp, li, w_l, ro = xs
@@ -423,33 +445,62 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                          positions3=rope_pos)
             k = rope_for(cfg.rope_scaling, k, positions, cfg.rope_theta,
                          positions3=rope_pos)
-        # Attend against cache (prefix-cache hits) + this step's fresh K/V.
-        # The pool itself is NOT written here: emitting updated pools as
-        # scan ys would rewrite the whole pool per call — the fresh rows
-        # come out as small ys instead and land in one scatter after the
-        # scan. Two paths (trace-time choice): the gated Pallas kernel
-        # streams pool pages + fresh blocks from the FULL 5D pools (the
-        # traced layer index joins the page in its DMA indices — a
-        # per-layer slice feeding a custom call is MATERIALIZED, the
-        # round-5 conviction); the XLA reference slices locally (its
-        # gather fuses) then overlays.
         B, T = tokens.shape
-        if _use_prefill_kernel(T, k_pages.shape[2]):
-            # The kernel implements the full model-delta surface —
-            # windows (static or traced per-layer), Gemma soft-cap and
-            # scale, GPT-OSS sinks — so SWA families are no longer
-            # trace-time-bypassed to the gather path (round-4 verdict).
+        if write_then_attend:
+            # Write-then-attend: the window's fresh K/V lands in the
+            # pool FIRST (the aliased writer is the pool's first
+            # consumer inside the scan carry — no defensive copy), then
+            # attention reads everything — cached prefix AND current
+            # window — from the pool. No dual cached/fresh source, no
+            # overlay.
+            kp_c, vp_c = write_prefill_kv_layer(
+                kp_c, vp_c, k, v, page_table, start_pos, lengths, li,
+                page_aligned_starts=page_aligned_prefill)
+            if _use_prefill_kernel(T, kp_c.shape[2]):
+                from xllm_service_tpu.ops.pallas import (
+                    paged_prefill_attention_pallas)
+                attn = paged_prefill_attention_pallas(
+                    q, None, None, kp_c, vp_c, page_table, start_pos,
+                    lengths, sliding_window=w_l, sinks=lp.get("sinks"),
+                    logits_soft_cap=cfg.attn_logit_softcapping,
+                    scale=extras.get("scale"), layer=li, from_pool=True)
+            else:
+                kp = jax.lax.dynamic_index_in_dim(kp_c, li, axis=0,
+                                                  keepdims=False)
+                vp = jax.lax.dynamic_index_in_dim(vp_c, li, axis=0,
+                                                  keepdims=False)
+                # Pool already holds the window — gather, no overlay.
+                attn = mha_prefill_auto(
+                    q, gather_pages(kp, page_table),
+                    gather_pages(vp, page_table), kv_lengths, start_pos,
+                    sliding_window=w_l, sinks=lp.get("sinks"), **extras)
+        elif _use_prefill_kernel(T, kp_c.shape[2]):
+            # Attend against cache (prefix-cache hits) + this step's
+            # fresh K/V; the pool itself is NOT written here: emitting
+            # updated pools as scan ys would rewrite the whole pool per
+            # call — the fresh rows come out as small ys instead and
+            # land in one scatter after the scan. The gated Pallas
+            # kernel streams pool pages + fresh blocks from the FULL 5D
+            # pools (the traced layer index joins the page in its DMA
+            # indices — a per-layer slice feeding a custom call is
+            # MATERIALIZED, the round-5 conviction). The kernel
+            # implements the full model-delta surface — windows (static
+            # or traced per-layer), Gemma soft-cap and scale, GPT-OSS
+            # sinks — so SWA families are no longer trace-time-bypassed
+            # to the gather path (round-4 verdict).
             from xllm_service_tpu.ops.pallas import (
                 paged_prefill_attention_pallas)
             attn = paged_prefill_attention_pallas(
-                q, k, v, k_pages, v_pages, page_table, start_pos,
+                q, k, v, kp_c, vp_c, page_table, start_pos,
                 lengths, sliding_window=w_l, sinks=lp.get("sinks"),
                 logits_soft_cap=cfg.attn_logit_softcapping,
                 scale=extras.get("scale"), layer=li)
         else:
-            kp = jax.lax.dynamic_index_in_dim(k_pages, li, axis=0,
+            # The XLA reference slices locally (its gather fuses) then
+            # overlays the not-yet-written fresh window.
+            kp = jax.lax.dynamic_index_in_dim(kp_c, li, axis=0,
                                               keepdims=False)
-            vp = jax.lax.dynamic_index_in_dim(v_pages, li, axis=0,
+            vp = jax.lax.dynamic_index_in_dim(vp_c, li, axis=0,
                                               keepdims=False)
             k_all = overlay_fresh_kv(gather_pages(kp, page_table), k,
                                      start_pos)
@@ -473,6 +524,8 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
             m, dropped = _mlp(lp, cfg, h, valid=tok_valid)
             x = x + m
+        if write_then_attend:
+            return (x, kp_c, vp_c), dropped
         return x, (k, v, dropped)
 
     li_arr = jnp.arange(cfg.num_layers, dtype=jnp.int32)
@@ -484,10 +537,15 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         xs = (params["layers"], li_arr, rope_arr)
     else:
         xs = (params["layers"], li_arr)
-    x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs, unroll=_layer_unroll())
-    k_pages, v_pages = write_prefill_kv_all_layers(
-        k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths,
-        page_aligned_starts=page_aligned_prefill)
+    if write_then_attend:
+        (x, k_pages, v_pages), dropped_l = jax.lax.scan(
+            layer, (x, k_pages, v_pages), xs, unroll=_layer_unroll())
+    else:
+        x, (k_new, v_new, dropped_l) = jax.lax.scan(
+            layer, x, xs, unroll=_layer_unroll())
+        k_pages, v_pages = write_prefill_kv_all_layers(
+            k_pages, v_pages, k_new, v_new, page_table, start_pos,
+            lengths, page_aligned_starts=page_aligned_prefill)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
@@ -703,6 +761,7 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                    kv: KVCache, page_table: jnp.ndarray,
                    return_stats: bool = False,
                    rope_delta: Optional[jnp.ndarray] = None,
+                   write_then_attend: bool = False,
                    ) -> Tuple[jnp.ndarray, KVCache]:
     """One decode step for ``tokens`` [B] at ``positions`` [B]
     (``active`` [B] bool masks empty batch slots). Returns
@@ -712,11 +771,18 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     ``rope_delta`` [B] — mrope models only: per-sequence offset between
     the rope position of a generated token and its KV storage position
     (images compress T·H·W patch tokens into a max(t,h,w)-sized rope
-    span, so post-image rope positions trail storage positions)."""
+    span, so post-image rope positions trail storage positions).
+
+    ``write_then_attend`` (static): the pool rides the layer scan as a
+    carry; each layer writes the current token's K/V in place (aliased
+    Pallas writer) BEFORE attending, and attention reads the pool alone
+    — the ``k_cur``/``v_cur`` plumbing disappears, and so do the
+    jit-call-boundary pool copies around the post-scan scatter."""
     if cfg.mla:
         return _mla_forward_decode(params, cfg, tokens, positions,
                                    active, kv, page_table,
-                                   return_stats=return_stats)
+                                   return_stats=return_stats,
+                                   write_then_attend=write_then_attend)
     k_pages, v_pages = kv
     x = _scale_embed(cfg, params["embed"][tokens[:, None]]
                      .astype(jnp.dtype(cfg.dtype)))              # [B,1,D]
@@ -730,7 +796,12 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     # [L, P, ps, Hkv, D] directly (round-5: a per-layer pool slice
     # feeding a custom call is MATERIALIZED — 134 MB x 2 pools x layers
     # per step); the XLA gather fallback slices per layer, which fuses.
-    def layer(x, xs):
+    def layer(carry, xs):
+        if write_then_attend:
+            x, kp_c, vp_c = carry
+        else:
+            x = carry
+            kp_c, vp_c = k_pages, v_pages
         ro = None
         if win_arr is not None and rope_arr is not None:
             lp, li, w_l, ro = xs
@@ -758,14 +829,30 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                          positions3=rp3)
             k = rope_for(cfg.rope_scaling, k, pos2, cfg.rope_theta,
                          positions3=rp3)
-        # The current token's K/V stays in-registers for attention; the
-        # pool write happens once for all layers after the scan (carrying
-        # the pool as scan ys would rewrite the whole pool per step).
-        attn = paged_decode_attention_current_auto(
-            q[:, 0], k_pages, v_pages, page_table, cache_lens,
-            k[:, 0], v[:, 0],
-            sliding_window=w_l, sinks=lp.get("sinks"),
-            layer=li, **extras)                                  # [B,Hq,Dh]
+        if write_then_attend:
+            # Write-then-attend: the current token's K/V goes into the
+            # pool FIRST (per-layer aliased write; the writer is the
+            # carried pool's first consumer), then attention reads the
+            # pool alone with context INCLUDING the current token — no
+            # k_cur/v_cur plumbing.
+            kp_c, vp_c = write_decode_kv_layer(
+                kp_c, vp_c, k[:, 0], v[:, 0], page_table, positions,
+                active, li)
+            attn = paged_decode_attention_auto(
+                q[:, 0], kp_c, vp_c, page_table,
+                jnp.where(active, positions + 1, 0),
+                sliding_window=w_l, sinks=lp.get("sinks"),
+                layer=li, **extras)                              # [B,Hq,Dh]
+        else:
+            # The current token's K/V stays in-registers for attention;
+            # the pool write happens once for all layers after the scan
+            # (carrying the pool as scan ys would rewrite the whole pool
+            # per step).
+            attn = paged_decode_attention_current_auto(
+                q[:, 0], kp_c, vp_c, page_table, cache_lens,
+                k[:, 0], v[:, 0],
+                sliding_window=w_l, sinks=lp.get("sinks"),
+                layer=li, **extras)                              # [B,Hq,Dh]
         B = tokens.shape[0]
         a = attn.reshape(B, 1, -1) @ lp["o_proj"]
         if "o_bias" in lp:
@@ -780,6 +867,8 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
             m, dropped = _mlp(lp, cfg, h, valid=active[:, None])
             x = x + m
+        if write_then_attend:
+            return (x, kp_c, vp_c), dropped
         return x, (k[:, 0], v[:, 0], dropped)
 
     li_arr = jnp.arange(cfg.num_layers, dtype=jnp.int32)
@@ -791,9 +880,14 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         xs = (params["layers"], li_arr, rope_arr)
     else:
         xs = (params["layers"], li_arr)
-    x, (k_new, v_new, dropped_l) = jax.lax.scan(layer, x, xs, unroll=_layer_unroll())
-    k_pages, v_pages = write_decode_kv_all_layers(
-        k_pages, v_pages, k_new, v_new, page_table, positions, active)
+    if write_then_attend:
+        (x, k_pages, v_pages), dropped_l = jax.lax.scan(
+            layer, (x, k_pages, v_pages), xs, unroll=_layer_unroll())
+    else:
+        x, (k_new, v_new, dropped_l) = jax.lax.scan(
+            layer, x, xs, unroll=_layer_unroll())
+        k_pages, v_pages = write_decode_kv_all_layers(
+            k_pages, v_pages, k_new, v_new, page_table, positions, active)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
@@ -1023,7 +1117,9 @@ def _mla_forward_prefill(params: Params, cfg: ModelConfig,
                          page_table: jnp.ndarray,
                          return_all_logits: bool = False,
                          prompt_lp_targets: Optional[jnp.ndarray] = None,
-                         return_stats: bool = False):
+                         return_stats: bool = False,
+                         page_aligned_prefill: bool = True,
+                         write_then_attend: bool = False):
     k_pages, v_pages = kv
     L_dense = params["layers"]["input_norm"].shape[0]
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
@@ -1035,14 +1131,34 @@ def _mla_forward_prefill(params: Params, cfg: ModelConfig,
                  < lengths[:, None])                             # [B, T]
 
     def body(moe: bool):
-        def layer(x, xs):
-            lp, kp, vp = xs
-            h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-            q_t, latent = _mla_qkv(cfg, lp, h, positions)
-            lat_all = overlay_fresh_kv(gather_pages(kp, page_table),
-                                       latent, start_pos)
-            attn = mha_prefill_auto(q_t, lat_all, lat_all, kv_lengths,
-                                    start_pos, scale=_mla_scale(cfg))
+        def layer(carry, xs):
+            if write_then_attend:
+                x, kp_full, vp_full = carry
+                lp, li = xs
+                h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+                q_t, latent = _mla_qkv(cfg, lp, h, positions)
+                # Write the latent window first (both pools carry the
+                # same latent row — the engine's uniform (k, v)
+                # plumbing), then attend from the pool: no overlay.
+                kp_full, vp_full = write_prefill_kv_layer(
+                    kp_full, vp_full, latent, latent,
+                    page_table, start_pos, lengths, li,
+                    page_aligned_starts=page_aligned_prefill)
+                kp = jax.lax.dynamic_index_in_dim(kp_full, li, axis=0,
+                                                  keepdims=False)
+                lat_all = gather_pages(kp, page_table)
+                attn = mha_prefill_auto(q_t, lat_all, lat_all,
+                                        kv_lengths, start_pos,
+                                        scale=_mla_scale(cfg))
+            else:
+                x, = carry
+                lp, kp, vp = xs
+                h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+                q_t, latent = _mla_qkv(cfg, lp, h, positions)
+                lat_all = overlay_fresh_kv(gather_pages(kp, page_table),
+                                           latent, start_pos)
+                attn = mha_prefill_auto(q_t, lat_all, lat_all, kv_lengths,
+                                        start_pos, scale=_mla_scale(cfg))
             x = x + _mla_out(cfg, lp, attn)
             h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
             if moe:
@@ -1050,22 +1166,37 @@ def _mla_forward_prefill(params: Params, cfg: ModelConfig,
             else:
                 x = x + (jax.nn.silu(h @ lp["gate_proj"])
                          * (h @ lp["up_proj"])) @ lp["down_proj"]
-            return x, (latent, latent)
+            if write_then_attend:
+                return (x, kp_full, vp_full), None
+            return (x,), (latent, latent)
         return layer
 
-    x, (k_d, v_d) = jax.lax.scan(
-        body(False), x,
-        (params["layers"], k_pages[:L_dense], v_pages[:L_dense]))
-    if "layers_moe" in params:
-        x, (k_m, v_m) = jax.lax.scan(
-            body(True), x,
-            (params["layers_moe"], k_pages[L_dense:], v_pages[L_dense:]))
-        k_new = jnp.concatenate([k_d, k_m], axis=0)
-        v_new = jnp.concatenate([v_d, v_m], axis=0)
+    if write_then_attend:
+        li_d = jnp.arange(L_dense, dtype=jnp.int32)
+        (x, k_pages, v_pages), _ = jax.lax.scan(
+            body(False), (x, k_pages, v_pages), (params["layers"], li_d))
+        if "layers_moe" in params:
+            n_moe = params["layers_moe"]["input_norm"].shape[0]
+            li_m = L_dense + jnp.arange(n_moe, dtype=jnp.int32)
+            (x, k_pages, v_pages), _ = jax.lax.scan(
+                body(True), (x, k_pages, v_pages),
+                (params["layers_moe"], li_m))
     else:
-        k_new, v_new = k_d, v_d
-    k_pages, v_pages = write_prefill_kv_all_layers(
-        k_pages, v_pages, k_new, v_new, page_table, start_pos, lengths)
+        (x,), (k_d, v_d) = jax.lax.scan(
+            body(False), (x,),
+            (params["layers"], k_pages[:L_dense], v_pages[:L_dense]))
+        if "layers_moe" in params:
+            (x,), (k_m, v_m) = jax.lax.scan(
+                body(True), (x,),
+                (params["layers_moe"], k_pages[L_dense:],
+                 v_pages[L_dense:]))
+            k_new = jnp.concatenate([k_d, k_m], axis=0)
+            v_new = jnp.concatenate([v_d, v_m], axis=0)
+        else:
+            k_new, v_new = k_d, v_d
+        k_pages, v_pages = write_prefill_kv_all_layers(
+            k_pages, v_pages, k_new, v_new, page_table, start_pos,
+            lengths, page_aligned_starts=page_aligned_prefill)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
@@ -1086,7 +1217,8 @@ def _mla_forward_decode(params: Params, cfg: ModelConfig,
                         tokens: jnp.ndarray, positions: jnp.ndarray,
                         active: jnp.ndarray, kv: KVCache,
                         page_table: jnp.ndarray,
-                        return_stats: bool = False):
+                        return_stats: bool = False,
+                        write_then_attend: bool = False):
     k_pages, v_pages = kv
     L_dense = params["layers"]["input_norm"].shape[0]
     x = params["embed"][tokens[:, None]].astype(jnp.dtype(cfg.dtype))
@@ -1094,29 +1226,58 @@ def _mla_forward_decode(params: Params, cfg: ModelConfig,
     B = tokens.shape[0]
 
     def body(moe: bool):
-        def layer(x, xs):
-            lp, kp, vp = xs
-            h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-            q_t, latent = _mla_qkv(cfg, lp, h, positions[:, None])
-            # Both "k" and "v" reads come from the SAME latent pool (kp
-            # twice — XLA CSEs the duplicate gather into one HBM read);
-            # the duplicate v_pages pool is write-only under MLA, a
-            # known 2x-storage cost of keeping the engine's uniform
-            # (k, v) pool plumbing (single-pool layout is a follow-up).
-            # The XLA reference path is the DEFAULT here even with
-            # XLLM_PALLAS on: the absorbed-MLA block shape (Hkv=1,
-            # D=r+rope=576 — not 128-lane-aligned) has never been
-            # Mosaic-validated; XLLM_PALLAS_MLA=1 opts into the kernel
-            # once tools/kernel_compile_probes.py clears it on hardware.
+        def layer(carry, xs):
             from xllm_service_tpu.ops import pallas as _pallas
-            if _pallas.mla_kernel_enabled():
-                attn = paged_decode_attention_current_auto(
-                    q_t[:, 0], kp, kp, page_table, cache_lens,
-                    latent[:, 0], latent[:, 0], scale=_mla_scale(cfg))
+            if write_then_attend:
+                x, kp_full, vp_full = carry
+                lp, li = xs
+                h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+                q_t, latent = _mla_qkv(cfg, lp, h, positions[:, None])
+                # Latent row into the pool first (aliased write), then
+                # attend from the pool with context INCLUDING the
+                # current token — no k_cur/v_cur plumbing. MLA keeps
+                # its own kernel opt-in (XLLM_PALLAS_MLA): the absorbed
+                # block shape (Hkv=1, D=576) routes to the XLA gather
+                # reference otherwise.
+                kp_full, vp_full = write_decode_kv_layer(
+                    kp_full, vp_full, latent[:, 0], latent[:, 0],
+                    page_table, positions, active, li)
+                ctx = jnp.where(active, positions + 1, 0)
+                if _pallas.mla_kernel_enabled():
+                    attn = _pallas.paged_decode_attention_pallas(
+                        q_t[:, 0], kp_full, kp_full, page_table, ctx,
+                        k_cur=None, v_cur=None, scale=_mla_scale(cfg),
+                        layer=li)
+                else:
+                    kp = jax.lax.dynamic_index_in_dim(
+                        kp_full, li, axis=0, keepdims=False)
+                    attn = paged_decode_attention(
+                        q_t[:, 0], kp, kp, page_table, ctx,
+                        scale=_mla_scale(cfg))
             else:
-                attn = paged_decode_attention_current(
-                    q_t[:, 0], kp, kp, page_table, cache_lens,
-                    latent[:, 0], latent[:, 0], scale=_mla_scale(cfg))
+                x, = carry
+                lp, kp, vp = xs
+                h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+                q_t, latent = _mla_qkv(cfg, lp, h, positions[:, None])
+                # Both "k" and "v" reads come from the SAME latent pool
+                # (kp twice — XLA CSEs the duplicate gather into one HBM
+                # read); the duplicate v_pages pool is write-only under
+                # MLA, a known 2x-storage cost of keeping the engine's
+                # uniform (k, v) pool plumbing (single-pool layout is a
+                # follow-up). The XLA reference path is the DEFAULT here
+                # even with XLLM_PALLAS on: the absorbed-MLA block shape
+                # (Hkv=1, D=r+rope=576 — not 128-lane-aligned) has never
+                # been Mosaic-validated; XLLM_PALLAS_MLA=1 opts into the
+                # kernel once tools/kernel_compile_probes.py clears it
+                # on hardware.
+                if _pallas.mla_kernel_enabled():
+                    attn = paged_decode_attention_current_auto(
+                        q_t[:, 0], kp, kp, page_table, cache_lens,
+                        latent[:, 0], latent[:, 0], scale=_mla_scale(cfg))
+                else:
+                    attn = paged_decode_attention_current(
+                        q_t[:, 0], kp, kp, page_table, cache_lens,
+                        latent[:, 0], latent[:, 0], scale=_mla_scale(cfg))
             x = x + _mla_out(cfg, lp, attn)[:, None, :]
             h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
             if moe:
@@ -1124,22 +1285,36 @@ def _mla_forward_decode(params: Params, cfg: ModelConfig,
             else:
                 x = x + (jax.nn.silu(h @ lp["gate_proj"])
                          * (h @ lp["up_proj"])) @ lp["down_proj"]
-            return x, (latent[:, 0], latent[:, 0])
+            if write_then_attend:
+                return (x, kp_full, vp_full), None
+            return (x,), (latent[:, 0], latent[:, 0])
         return layer
 
-    x, (k_d, v_d) = jax.lax.scan(
-        body(False), x,
-        (params["layers"], k_pages[:L_dense], v_pages[:L_dense]))
-    if "layers_moe" in params:
-        x, (k_m, v_m) = jax.lax.scan(
-            body(True), x,
-            (params["layers_moe"], k_pages[L_dense:], v_pages[L_dense:]))
-        k_new = jnp.concatenate([k_d, k_m], axis=0)
-        v_new = jnp.concatenate([v_d, v_m], axis=0)
+    if write_then_attend:
+        li_d = jnp.arange(L_dense, dtype=jnp.int32)
+        (x, k_pages, v_pages), _ = jax.lax.scan(
+            body(False), (x, k_pages, v_pages), (params["layers"], li_d))
+        if "layers_moe" in params:
+            n_moe = params["layers_moe"]["input_norm"].shape[0]
+            li_m = L_dense + jnp.arange(n_moe, dtype=jnp.int32)
+            (x, k_pages, v_pages), _ = jax.lax.scan(
+                body(True), (x, k_pages, v_pages),
+                (params["layers_moe"], li_m))
     else:
-        k_new, v_new = k_d, v_d
-    k_pages, v_pages = write_decode_kv_all_layers(
-        k_pages, v_pages, k_new, v_new, page_table, positions, active)
+        (x,), (k_d, v_d) = jax.lax.scan(
+            body(False), (x,),
+            (params["layers"], k_pages[:L_dense], v_pages[:L_dense]))
+        if "layers_moe" in params:
+            (x,), (k_m, v_m) = jax.lax.scan(
+                body(True), (x,),
+                (params["layers_moe"], k_pages[L_dense:],
+                 v_pages[L_dense:]))
+            k_new = jnp.concatenate([k_d, k_m], axis=0)
+            v_new = jnp.concatenate([v_d, v_m], axis=0)
+        else:
+            k_new, v_new = k_d, v_d
+        k_pages, v_pages = write_decode_kv_all_layers(
+            k_pages, v_pages, k_new, v_new, page_table, positions, active)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:

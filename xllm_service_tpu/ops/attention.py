@@ -123,15 +123,24 @@ def write_decode_kv(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
 
 
 def _kv_update_kernel_enabled() -> bool:
-    """Gate for the Pallas in-place decode KV write
-    (ops/pallas/kv_update.py): on wherever the Pallas kernels are on
-    (XLLM_PALLAS semantics), with its own off-switch XLLM_PALLAS_KV=0.
-    The XLA scatter it replaces copies BOTH pools around every decode
-    step inside the fused burst (~8.6 GB/step at the bench shape) —
-    the round-5 offline-AOT conviction."""
+    """Gate for the Pallas in-place KV writers
+    (ops/pallas/kv_update.py): unset follows the base XLLM_PALLAS
+    semantics (on wherever the Pallas kernels are on);
+    XLLM_PALLAS_KV=0 switches the writers off on their own;
+    XLLM_PALLAS_KV=1 FORCES them on even with XLLM_PALLAS=0 — the
+    aliased writers lower on Mosaic toolchains whose attention-kernel
+    relayouts do not, and XLA-attention + Pallas-writers is a
+    legitimate serving mix (it is what the write-then-attend copy
+    census compiles, tools/aot_copy_census.py). The XLA scatter the
+    writers replace copies BOTH pools around every decode step inside
+    the fused burst (~8.6 GB/step at the bench shape) — the round-5
+    offline-AOT conviction."""
     import os
-    if os.environ.get("XLLM_PALLAS_KV", "1") != "1":
+    env = os.environ.get("XLLM_PALLAS_KV", "").strip()
+    if env in ("0", "false", "no"):
         return False
+    if env in ("1", "true", "yes"):
+        return True
     from xllm_service_tpu.ops import pallas
     return pallas.enabled()
 
@@ -242,6 +251,97 @@ def write_prefill_kv_all_layers_xla(k_pages, v_pages, k_new, v_new,
     k_flat = k_pages.reshape(pool_shape).at[:, flat].set(
         k_new.reshape(new_shape), mode="drop")
     v_flat = v_pages.reshape(pool_shape).at[:, flat].set(
+        v_new.reshape(new_shape), mode="drop")
+    return (k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape))
+
+
+def write_decode_kv_layer(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                          k_new: jnp.ndarray, v_new: jnp.ndarray,
+                          page_table: jnp.ndarray,
+                          positions: jnp.ndarray, active: jnp.ndarray,
+                          layer) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Write ONE decode token's K/V for ONE (traced) layer into the FULL
+    [L, P, ps, Hkv, D] pools — the write-then-attend layer-body writer.
+
+    The pool rides the layer scan as a CARRY: each layer writes its
+    fresh row first (the aliased Pallas kernel is the pool's first
+    consumer, so XLA needs no defensive copy), then attention reads
+    everything — including the current token — from the pool.
+    k_new/v_new: [B, Hkv, D]; ``layer``: traced int32 scalar."""
+    _, _, ps_, Hkv_, D_ = k_pages.shape
+    if _kv_update_kernel_enabled() and ps_ % 8 == 0:
+        from xllm_service_tpu.ops.pallas.kv_update import (
+            paged_kv_update_layer)
+        return paged_kv_update_layer(k_pages, v_pages, k_new, v_new,
+                                     page_table, positions, active, layer)
+    return write_decode_kv_layer_xla(k_pages, v_pages, k_new, v_new,
+                                     page_table, positions, active, layer)
+
+
+def write_decode_kv_layer_xla(k_pages, v_pages, k_new, v_new, page_table,
+                              positions, active, layer
+                              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """XLA reference for the single-layer decode write (scatter at a
+    traced layer index) — the kernel-free fallback and test oracle."""
+    L = k_pages.shape[0]
+    page_size = k_pages.shape[2]
+    num_slots = k_pages.shape[1] * page_size
+    flat = _flat_kv_index(page_table, positions[:, None], page_size,
+                          num_slots, active[:, None])[:, 0]     # [B]
+    pool_shape = (L, -1) + k_pages.shape[3:]
+    lyr = jnp.asarray(layer, jnp.int32)
+    k_flat = k_pages.reshape(pool_shape).at[lyr, flat].set(
+        k_new, mode="drop")
+    v_flat = v_pages.reshape(pool_shape).at[lyr, flat].set(
+        v_new, mode="drop")
+    return (k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape))
+
+
+def write_prefill_kv_layer(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                           k_new: jnp.ndarray, v_new: jnp.ndarray,
+                           page_table: jnp.ndarray,
+                           start_pos: jnp.ndarray, lengths: jnp.ndarray,
+                           layer, page_aligned_starts: bool = True
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Prefill counterpart of ``write_decode_kv_layer``: one layer's
+    fresh window [B, T, Hkv, D] lands in the full pools BEFORE that
+    layer's attention reads the window back through the page table
+    (write-then-attend). The write covers the not-yet-attended window,
+    not just committed tokens. Kernel eligibility mirrors
+    ``write_prefill_kv_all_layers`` (page-aligned starts, T % ps == 0);
+    otherwise the XLA scatter at a traced layer index."""
+    T_, ps2 = k_new.shape[1], k_pages.shape[2]
+    if _kv_update_kernel_enabled() and page_aligned_starts \
+            and T_ % ps2 == 0 and ps2 % 8 == 0:
+        from xllm_service_tpu.ops.pallas.kv_update import (
+            paged_prefill_kv_update_layer)
+        return paged_prefill_kv_update_layer(
+            k_pages, v_pages, k_new, v_new, page_table, start_pos,
+            lengths, layer)
+    return write_prefill_kv_layer_xla(k_pages, v_pages, k_new, v_new,
+                                      page_table, start_pos, lengths,
+                                      layer)
+
+
+def write_prefill_kv_layer_xla(k_pages, v_pages, k_new, v_new,
+                               page_table, start_pos, lengths, layer
+                               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """XLA reference for the single-layer prefill window write."""
+    L = k_pages.shape[0]
+    B, T = k_new.shape[0], k_new.shape[1]
+    page_size = k_pages.shape[2]
+    num_slots = k_pages.shape[1] * page_size
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    positions = start_pos[:, None] + t
+    valid = t < lengths[:, None]
+    flat = _flat_kv_index(page_table, positions, page_size, num_slots,
+                          valid).reshape(-1)                    # [B*T]
+    pool_shape = (L, -1) + k_pages.shape[3:]
+    new_shape = (B * T,) + k_new.shape[2:]
+    lyr = jnp.asarray(layer, jnp.int32)
+    k_flat = k_pages.reshape(pool_shape).at[lyr, flat].set(
+        k_new.reshape(new_shape), mode="drop")
+    v_flat = v_pages.reshape(pool_shape).at[lyr, flat].set(
         v_new.reshape(new_shape), mode="drop")
     return (k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape))
 
@@ -565,7 +665,8 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
                            v_pages: jnp.ndarray, page_table: jnp.ndarray,
                            context_lens: jnp.ndarray,
                            logits_soft_cap: float = 0.0,
-                           sliding_window=0, scale=None) -> jnp.ndarray:
+                           sliding_window=0, scale=None,
+                           sinks=None) -> jnp.ndarray:
     """Single-token GQA attention against the paged cache (XLA reference path).
 
     q: [B, Hq, D]; page_table: [B, max_pages]; context_lens: [B] (number of
@@ -589,6 +690,41 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
         # context_lens − 1): keep j > (context_lens − 1) − W.
         mask &= pos > context_lens[:, None] - 1 - sliding_window
     logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
+    if sinks is not None:
+        # GPT-OSS sinks: concat-column-then-drop, the same reference
+        # semantics as paged_decode_attention_current.
+        sk = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(Hkv, -1)[None, :, :, None],
+            logits.shape[:-1] + (1,))
+        logits = jnp.concatenate([logits, sk], axis=-1)
     p = jax.nn.softmax(logits, axis=-1)
+    if sinks is not None:
+        p = p[..., :-1]
     out = jnp.einsum("bhgs,bshd->bhgd", p.astype(v.dtype), v)
     return out.reshape(B, Hq, D)
+
+
+def paged_decode_attention_auto(q, k_pages, v_pages, page_table,
+                                context_lens, logits_soft_cap: float = 0.0,
+                                sliding_window=0, scale=None, sinks=None,
+                                layer=None):
+    """Write-then-attend decode dispatch: the current token's K/V is
+    already IN the pool (written by the layer body's aliased writer), so
+    ``context_lens`` INCLUDES it and there is no ``k_cur``/``v_cur``
+    plumbing. The Pallas kernel path reads the full 5D pools at a traced
+    ``layer``; the XLA fallback slices locally (its gather fuses)."""
+    from xllm_service_tpu.ops import pallas
+    if pallas.enabled():
+        return pallas.paged_decode_attention_pallas(
+            q, k_pages, v_pages, page_table, context_lens,
+            k_cur=None, v_cur=None, sliding_window=sliding_window,
+            logits_soft_cap=logits_soft_cap, scale=scale, sinks=sinks,
+            layer=layer)
+    if layer is not None:
+        k_pages = jax.lax.dynamic_index_in_dim(
+            k_pages, layer, axis=0, keepdims=False)
+        v_pages = jax.lax.dynamic_index_in_dim(
+            v_pages, layer, axis=0, keepdims=False)
+    return paged_decode_attention(
+        q, k_pages, v_pages, page_table, context_lens, logits_soft_cap,
+        sliding_window, scale, sinks)

@@ -1,0 +1,19 @@
+"""Pallas TPU API compatibility.
+
+The kernels target the current pallas API (``pltpu.CompilerParams``);
+older jax releases (<= 0.4.x, including the pinned toolchain image)
+ship the same class as ``pltpu.TPUCompilerParams``. One alias here so
+every kernel module compiles against either — without it the whole
+Pallas surface (and every interpret-mode test) dies at call time with
+AttributeError on the older API.
+"""
+
+from jax.experimental.pallas import tpu as _pltpu
+
+CompilerParams = getattr(_pltpu, "CompilerParams", None) \
+    or _pltpu.TPUCompilerParams
+
+# ``pltpu.HBM`` (newer name) == ``TPUMemorySpace.ANY`` on the older
+# API: "leave the operand in HBM, the kernel DMAs it itself" (the V3
+# row kernel's manual double-buffered page fetch).
+HBM = getattr(_pltpu, "HBM", None) or _pltpu.TPUMemorySpace.ANY

@@ -40,6 +40,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from xllm_service_tpu.ops.pallas._compat import (
+    CompilerParams as _CompilerParams)
+
 # Sentinel slot for rows whose write must be dropped (inactive, NULL
 # page, position beyond the table): the index maps send them to page 0
 # tile 0 and the kernel's mask makes the write-back an identity.
@@ -53,11 +56,22 @@ def _kernel(slot_ref, kn_ref, vn_ref, ko_in_ref, vo_in_ref,
     within = jnp.maximum(slot, 0) % page_size
     off = within % 8
     live = slot >= 0
+    # The iota mask carries FULL trailing (Hkv, D) dims: a (.., 1, 1)
+    # mask would need a vector broadcast in both sublanes and lanes,
+    # which this toolchain's Mosaic does not implement.
+    hkv, d = ko_ref.shape[3], ko_ref.shape[4]
     row_mask = (jax.lax.broadcasted_iota(
-        jnp.int32, (1, 1, 8, 1, 1), 2) == off) & live
+        jnp.int32, (1, 1, 8, hkv, d), 2) == off) & live
 
-    ko_ref[...] = jnp.where(row_mask, kn_ref[0][:, None, None], ko_in_ref[...])
-    vo_ref[...] = jnp.where(row_mask, vn_ref[0][:, None, None], vo_in_ref[...])
+    # Select in f32: this toolchain's Mosaic lowers 32-bit vector
+    # selects only ("Only 32-bit select supported" on bf16 operands);
+    # the conversion is VMEM-local and the kernel is memory-bound.
+    ko_ref[...] = jnp.where(
+        row_mask, kn_ref[0][:, None, None].astype(jnp.float32),
+        ko_in_ref[...].astype(jnp.float32)).astype(ko_ref.dtype)
+    vo_ref[...] = jnp.where(
+        row_mask, vn_ref[0][:, None, None].astype(jnp.float32),
+        vo_in_ref[...].astype(jnp.float32)).astype(vo_ref.dtype)
 
 
 def paged_kv_update(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
@@ -116,10 +130,94 @@ def paged_kv_update(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         # of the kernel: declared in-place, so the burst loop stops
         # copying 4.3 GB of pool per step.
         input_output_aliases={3: 0, 4: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(slot, kn, vn, k_pages, v_pages)
+    return (ko, vo)
+
+
+def _kernel_layer(slot_ref, lyr_ref, kn_ref, vn_ref, ko_in_ref, vo_in_ref,
+                  ko_ref, vo_ref, *, page_size: int):
+    """Single-layer decode write (write-then-attend layer body): the
+    traced layer index rides as a scalar-prefetch operand consumed by
+    the block index maps, so the tile RMW lands straight in the FULL
+    [L, P, ps, Hkv, D] pool — no per-layer slice exists, and the
+    aliased write is the pool's first consumer inside the layer scan."""
+    b = pl.program_id(0)
+    slot = slot_ref[b]
+    off = (jnp.maximum(slot, 0) % page_size) % 8
+    live = slot >= 0
+    hkv, d = ko_ref.shape[3], ko_ref.shape[4]
+    row_mask = (jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, 8, hkv, d), 2) == off) & live
+    ko_ref[...] = jnp.where(
+        row_mask, kn_ref[0][None, None, None].astype(jnp.float32),
+        ko_in_ref[...].astype(jnp.float32)).astype(ko_ref.dtype)
+    vo_ref[...] = jnp.where(
+        row_mask, vn_ref[0][None, None, None].astype(jnp.float32),
+        vo_in_ref[...].astype(jnp.float32)).astype(vo_ref.dtype)
+
+
+def paged_kv_update_layer(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                          k_new: jnp.ndarray, v_new: jnp.ndarray,
+                          page_table: jnp.ndarray, positions: jnp.ndarray,
+                          active: jnp.ndarray, layer: jnp.ndarray, *,
+                          interpret: bool = None):
+    """In-place write of one decode token's K/V for ONE (traced) layer.
+
+    The write-then-attend sibling of ``paged_kv_update``: the layer scan
+    carries the full pools and each layer body writes its own fresh row
+    BEFORE attending, so the attention kernel reads everything —
+    including the current token — from the pool. k_pages/v_pages:
+    [L, P, ps, Hkv, D] (aliased to the outputs); k_new/v_new:
+    [B, Hkv, D]; layer: traced int32 scalar. Semantics per row match
+    ``paged_kv_update`` exactly (inactive/NULL/off-table rows drop)."""
+    if interpret is None:
+        from xllm_service_tpu.ops import pallas
+        interpret = pallas.default_interpret()
+    L, P, ps, Hkv, D = k_pages.shape
+    B = k_new.shape[0]
+
+    page_idx = positions // ps
+    in_range = (page_idx < page_table.shape[1]) & active
+    page = jnp.where(
+        in_range,
+        jnp.take_along_axis(page_table,
+                            jnp.minimum(page_idx, page_table.shape[1] - 1)
+                            [:, None], axis=1)[:, 0],
+        0)
+    slot = jnp.where(in_range & (page > 0),
+                     page * ps + positions % ps,
+                     _DROP).astype(jnp.int32)
+    lyr = jnp.asarray(layer, jnp.int32).reshape(1)
+
+    def tile_idx(b, slot_ref, lyr_ref):
+        s = jnp.maximum(slot_ref[b], 0)
+        return (lyr_ref[0], s // ps, (s % ps) // 8, 0, 0)
+
+    pool_spec = pl.BlockSpec((1, 1, 8, Hkv, D), tile_idx)
+    new_spec = pl.BlockSpec((1, Hkv, D),
+                            lambda b, slot_ref, lyr_ref: (b, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                    # slot, layer
+        grid=(B,),
+        in_specs=[new_spec, new_spec, pool_spec, pool_spec],
+        out_specs=[pool_spec, pool_spec],
+    )
+    ko, vo = pl.pallas_call(
+        functools.partial(_kernel_layer, page_size=ps),
+        out_shape=[jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                   jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype)],
+        grid_spec=grid_spec,
+        # flat operands incl. prefetch: 0=slot 1=layer 2=k_new 3=v_new
+        # 4=k_pool 5=v_pool -> outputs 0/1. Declared in-place so the
+        # pool never moves while it rides the layer scan as a carry.
+        input_output_aliases={4: 0, 5: 1},
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(slot, lyr, k_new, v_new, k_pages, v_pages)
     return (ko, vo)
 
 
@@ -132,10 +230,15 @@ def _prefill_kernel(pagemap_ref, valid_ref, kn_ref, vn_ref,
     b = pl.program_id(1)
     w = pl.program_id(2)
     n_valid = valid_ref[b, w]
+    hkv, d = ko_ref.shape[3], ko_ref.shape[4]
     tok_mask = (jax.lax.broadcasted_iota(
-        jnp.int32, (1, 1, page_size, 1, 1), 2) < n_valid)
-    ko_ref[...] = jnp.where(tok_mask, kn_ref[0], kp_in_ref[...])
-    vo_ref[...] = jnp.where(tok_mask, vn_ref[0], vp_in_ref[...])
+        jnp.int32, (1, 1, page_size, hkv, d), 2) < n_valid)
+    ko_ref[...] = jnp.where(tok_mask, kn_ref[0].astype(jnp.float32),
+                            kp_in_ref[...].astype(jnp.float32)
+                            ).astype(ko_ref.dtype)
+    vo_ref[...] = jnp.where(tok_mask, vn_ref[0].astype(jnp.float32),
+                            vp_in_ref[...].astype(jnp.float32)
+                            ).astype(vo_ref.dtype)
 
 
 def paged_prefill_kv_update(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
@@ -202,8 +305,96 @@ def paged_prefill_kv_update(k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         # flat operands incl. prefetch: 0=pagemap 1=n_valid 2=k_new
         # 3=v_new 4=k_pool 5=v_pool -> outputs 0/1.
         input_output_aliases={4: 0, 5: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(pagemap, n_valid, kn, vn, k_pages, v_pages)
+    return (ko, vo)
+
+
+def _prefill_kernel_layer(pagemap_ref, valid_ref, lyr_ref, kn_ref, vn_ref,
+                          kp_in_ref, vp_in_ref, ko_ref, vo_ref, *,
+                          page_size: int):
+    """Grid (B, nW): single-layer prefill page write at a traced layer
+    index (the write-then-attend layer body's writer). Same masking as
+    ``_prefill_kernel``; the layer scalar is consumed by the block index
+    maps only."""
+    b = pl.program_id(0)
+    w = pl.program_id(1)
+    n_valid = valid_ref[b, w]
+    hkv, d = ko_ref.shape[3], ko_ref.shape[4]
+    tok_mask = (jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, page_size, hkv, d), 2) < n_valid)
+    ko_ref[...] = jnp.where(tok_mask, kn_ref[...].astype(jnp.float32),
+                            kp_in_ref[...].astype(jnp.float32)
+                            ).astype(ko_ref.dtype)
+    vo_ref[...] = jnp.where(tok_mask, vn_ref[...].astype(jnp.float32),
+                            vp_in_ref[...].astype(jnp.float32)
+                            ).astype(vo_ref.dtype)
+
+
+def paged_prefill_kv_update_layer(k_pages: jnp.ndarray,
+                                  v_pages: jnp.ndarray,
+                                  k_new: jnp.ndarray, v_new: jnp.ndarray,
+                                  page_table: jnp.ndarray,
+                                  start_pos: jnp.ndarray,
+                                  lengths: jnp.ndarray,
+                                  layer: jnp.ndarray, *,
+                                  interpret: bool = None):
+    """In-place prefill window write for ONE (traced) layer — the
+    write-then-attend sibling of ``paged_prefill_kv_update``. The pool
+    rides the layer scan as a carry; each layer writes its own fresh
+    window [B, T, Hkv, D] into the FULL [L, P, ps, Hkv, D] pools BEFORE
+    its attention kernel reads the window back through the page table.
+    The write covers the not-yet-attended window, not just committed
+    tokens. Requires page-aligned window starts and T % ps == 0 (same
+    invariants and drop semantics as ``paged_prefill_kv_update``)."""
+    if interpret is None:
+        from xllm_service_tpu.ops import pallas
+        interpret = pallas.default_interpret()
+    L, P, ps, Hkv, D = k_pages.shape
+    B, T = k_new.shape[0], k_new.shape[1]
+    nW = T // ps
+
+    w_idx = jnp.arange(nW, dtype=jnp.int32)[None, :]            # [1,nW]
+    page_idx = (start_pos[:, None] // ps) + w_idx               # [B,nW]
+    in_table = page_idx < page_table.shape[1]
+    page = jnp.where(
+        in_table,
+        jnp.take_along_axis(
+            page_table, jnp.minimum(page_idx, page_table.shape[1] - 1),
+            axis=1),
+        0)
+    n_valid = jnp.clip(lengths[:, None] - w_idx * ps, 0, ps)
+    n_valid = jnp.where(in_table & (page > 0), n_valid, 0)
+    pagemap = page.astype(jnp.int32)
+    n_valid = n_valid.astype(jnp.int32)
+    lyr = jnp.asarray(layer, jnp.int32).reshape(1)
+
+    pool_spec = pl.BlockSpec(
+        (1, 1, ps, Hkv, D),
+        lambda b, w, pm, nv, ly: (ly[0], pm[b, w], 0, 0, 0))
+    new_spec = pl.BlockSpec(
+        (1, 1, ps, Hkv, D),
+        lambda b, w, pm, nv, ly: (b, w, 0, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,                 # pagemap, n_valid, layer
+        grid=(B, nW),
+        in_specs=[new_spec, new_spec, pool_spec, pool_spec],
+        out_specs=[pool_spec, pool_spec],
+    )
+    kn = k_new.reshape(B, nW, ps, Hkv, D)
+    vn = v_new.reshape(B, nW, ps, Hkv, D)
+    ko, vo = pl.pallas_call(
+        functools.partial(_prefill_kernel_layer, page_size=ps),
+        out_shape=[jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                   jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype)],
+        grid_spec=grid_spec,
+        # flat operands incl. prefetch: 0=pagemap 1=n_valid 2=layer
+        # 3=k_new 4=v_new 5=k_pool 6=v_pool -> outputs 0/1.
+        input_output_aliases={5: 0, 6: 1},
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(pagemap, n_valid, lyr, kn, vn, k_pages, v_pages)
     return (ko, vo)

@@ -28,6 +28,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from xllm_service_tpu.ops.pallas._compat import (
+    CompilerParams as _CompilerParams, HBM as _HBM)
+
 _NEG_INF = -1e30
 
 
@@ -316,8 +319,8 @@ def _paged_decode_attention_row_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Hq, W), lambda b, ctx, pt: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.HBM),    # whole K pool
-            pl.BlockSpec(memory_space=pltpu.HBM),    # whole V pool
+            pl.BlockSpec(memory_space=_HBM),    # whole K pool
+            pl.BlockSpec(memory_space=_HBM),    # whole V pool
             pl.BlockSpec((1, 1, W), lambda b, ctx, pt: (b, 0, 0)),
             pl.BlockSpec((1, 1, W), lambda b, ctx, pt: (b, 0, 0)),
         ],
@@ -333,7 +336,7 @@ def _paged_decode_attention_row_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
                           has_current=has_current),
         out_shape=jax.ShapeDtypeStruct((B, Hq, W), jnp.float32),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(context_lens, page_table, q_wide, k_flat, v_flat, kc_flat, vc_flat)
@@ -492,7 +495,7 @@ def _paged_decode_attention_wide_impl(q: jnp.ndarray,
                           pages_per_seq=MP, has_current=has_current),
         out_shape=jax.ShapeDtypeStruct((B, Hq, W), jnp.float32),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(context_lens, page_table, q_wide, k_flat, v_flat, kc_flat,
@@ -661,7 +664,7 @@ def _paged_decode_attention_mr_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
                           has_current=has_current),
         out_shape=jax.ShapeDtypeStruct((Bp, Hq, D), q.dtype),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(context_lens, page_table, q,
@@ -834,7 +837,7 @@ def _paged_decode_attention_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
                           has_sinks=has_sinks, layered=layered),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(*prefetch, q, k_pages, v_pages, k_cur, v_cur, sk2)

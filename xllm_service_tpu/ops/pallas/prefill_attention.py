@@ -63,6 +63,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from xllm_service_tpu.ops.pallas._compat import (
+    CompilerParams as _CompilerParams)
+
 from xllm_service_tpu.ops.attention import FULL_WINDOW
 
 _NEG_INF = -1e30
@@ -92,11 +95,31 @@ def _kernel_layered(qstart_ref, lens_ref, pt_ref, win_ref, lyr_ref,
                    layered=True, **kw)
 
 
+def _kernel_pool(qstart_ref, lens_ref, pt_ref, win_ref, q_ref, kp_ref,
+                 vp_ref, sk_ref, o_ref, m_ref, l_ref, acc_ref, **kw):
+    """Pool-only entry (write-then-attend): no fresh-block operands —
+    the window's K/V is already IN the pool, so every kv step streams
+    pool pages and the ragged tail reads through the page table."""
+    return _kernel(qstart_ref, lens_ref, pt_ref, win_ref, q_ref, kp_ref,
+                   vp_ref, None, None, sk_ref, o_ref, m_ref, l_ref,
+                   acc_ref, pool_only=True, **kw)
+
+
+def _kernel_layered_pool(qstart_ref, lens_ref, pt_ref, win_ref, lyr_ref,
+                         q_ref, kp_ref, vp_ref, sk_ref, o_ref, m_ref,
+                         l_ref, acc_ref, **kw):
+    """Layered pool-only entry (the write-then-attend serving form)."""
+    return _kernel(qstart_ref, lens_ref, pt_ref, win_ref, q_ref, kp_ref,
+                   vp_ref, None, None, sk_ref, o_ref, m_ref, l_ref,
+                   acc_ref, layered=True, pool_only=True, **kw)
+
+
 def _kernel(qstart_ref, lens_ref, pt_ref, win_ref, q_ref, kp_ref, vp_ref,
             kf_ref, vf_ref, sk_ref, o_ref, m_ref, l_ref, acc_ref, *,
             page_size: int, q_block: int, num_pool_steps: int,
             num_kv_steps: int, logits_soft_cap: float, scale: float,
-            has_sinks: bool, layered: bool = False):
+            has_sinks: bool, layered: bool = False,
+            pool_only: bool = False):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     s = pl.program_id(2)
@@ -117,11 +140,17 @@ def _kernel(qstart_ref, lens_ref, pt_ref, win_ref, q_ref, kp_ref, vp_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    is_pool = s < num_pool_steps
     # Global position of this block's first kv token.
     pool_base = s * page_size
-    fresh_local_base = (s - num_pool_steps) * page_size
-    base = jnp.where(is_pool, pool_base, q_start + fresh_local_base)
+    is_pool = (s < num_pool_steps) if not pool_only else True
+    if pool_only:
+        # Write-then-attend: the pool holds the window too, so every
+        # step is a pool step and positions are valid through
+        # q_start + length (the ragged tail reads through the table).
+        base = pool_base
+    else:
+        fresh_local_base = (s - num_pool_steps) * page_size
+        base = jnp.where(is_pool, pool_base, q_start + fresh_local_base)
 
     # Query rows of this block sit at global positions q_start + qi*QB + t
     # (padded rows past ``length`` produce garbage that the engine never
@@ -134,18 +163,28 @@ def _kernel(qstart_ref, lens_ref, pt_ref, win_ref, q_ref, kp_ref, vp_ref,
     # FIRST query row's window floor). Pool steps additionally intersect
     # the cached prefix; fresh steps the true window.
     in_win = base + page_size - 1 > q_lo - w_eff
-    live_pool = is_pool & (pool_base < q_start) & in_win
-    live_fresh = jnp.logical_not(is_pool) & \
-        (fresh_local_base < length) & (base <= q_lo + q_block - 1) & in_win
+    if pool_only:
+        live = (pool_base < q_start + length) \
+            & (base <= q_lo + q_block - 1) & in_win
+    else:
+        live_pool = is_pool & (pool_base < q_start) & in_win
+        live_fresh = jnp.logical_not(is_pool) & \
+            (fresh_local_base < length) & (base <= q_lo + q_block - 1) \
+            & in_win
+        live = live_pool | live_fresh
 
-    @pl.when(live_pool | live_fresh)
+    @pl.when(live)
     def _fold():
         kp_blk = kp_ref[0, 0] if layered else kp_ref[0]
         vp_blk = vp_ref[0, 0] if layered else vp_ref[0]
-        kb = jnp.where(is_pool, kp_blk.astype(jnp.float32),
-                       kf_ref[0, 0].astype(jnp.float32))     # [ps, Hkv, D]
-        vb = jnp.where(is_pool, vp_blk.astype(jnp.float32),
-                       vf_ref[0, 0].astype(jnp.float32))
+        if pool_only:
+            kb = kp_blk.astype(jnp.float32)                  # [ps, Hkv, D]
+            vb = vp_blk.astype(jnp.float32)
+        else:
+            kb = jnp.where(is_pool, kp_blk.astype(jnp.float32),
+                           kf_ref[0, 0].astype(jnp.float32))
+            vb = jnp.where(is_pool, vp_blk.astype(jnp.float32),
+                           vf_ref[0, 0].astype(jnp.float32))
         qt = q_ref[0, 0].astype(jnp.float32)                 # [Hkv, QB*G, D]
         kt = jnp.transpose(kb, (1, 0, 2))                    # [Hkv, ps, D]
         vt = jnp.transpose(vb, (1, 0, 2))
@@ -167,7 +206,12 @@ def _kernel(qstart_ref, lens_ref, pt_ref, win_ref, q_ref, kp_ref, vp_ref,
         # whose operands are i1 VECTORS is unlegalizable for Mosaic
         # ("failed to legalize arith.select" on vector<...xi1> — found
         # by the offline v5e AOT probe, tools/aot_kernel_probes.py).
-        src_limit = jnp.where(is_pool, q_start, q_start + length)
+        # Pool-only: the pool holds the window too, so the whole
+        # [0, q_start + length) range is valid.
+        if pool_only:
+            src_limit = q_start + length
+        else:
+            src_limit = jnp.where(is_pool, q_start, q_start + length)
         src_ok = kv_pos < src_limit
         mask3 = (src_ok & (kv_pos <= q_pos)
                  & (kv_pos > q_pos - w_eff)).reshape(
@@ -223,7 +267,8 @@ def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
                                    logits_soft_cap: float = 0.0,
                                    scale=None,
                                    sinks=None,
-                                   layer=None) -> jnp.ndarray:
+                                   layer=None,
+                                   from_pool: bool = False) -> jnp.ndarray:
     """q/k_fresh/v_fresh: [B, T, H*, D] (this window, already roped);
     k/v_pages: [P, ps, Hkv, D] — or, with ``layer`` (a traced int32
     scalar), the FULL stacked [L, P, ps, Hkv, D] pools, whose page DMAs
@@ -237,6 +282,13 @@ def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
     and ``scale`` are static floats (Gemma); ``sinks`` an optional [Hq]
     array (GPT-OSS). ``interpret=None`` → Pallas interpreter off TPU (so
     the gated serving path stays runnable in CPU tests), Mosaic on TPU.
+
+    ``from_pool`` (static) — the write-then-attend form: the window's
+    K/V was already written into the pool (ops/pallas/kv_update.py
+    layered writers), so there is NO separate fresh-block stream —
+    ``k_fresh``/``v_fresh`` are ignored (pass None), every kv step is a
+    pool step, and positions are valid through q_start + length (the
+    ragged window tail reads through the page table).
     Returns [B, T, Hq, D]."""
     if interpret is None:
         from xllm_service_tpu.ops import pallas
@@ -257,17 +309,22 @@ def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
     win = jnp.asarray(sliding_window, jnp.int32).reshape(1)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if from_pool:
+        k_fresh = v_fresh = None
     return _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table,
                  q_start, lengths, win, sinks, layer, q_block=q_block,
                  logits_soft_cap=float(logits_soft_cap),
-                 scale=float(scale), interpret=interpret)
+                 scale=float(scale), interpret=interpret,
+                 from_pool=from_pool)
 
 
 @functools.partial(jax.jit, static_argnames=("q_block", "logits_soft_cap",
-                                             "scale", "interpret"))
+                                             "scale", "interpret",
+                                             "from_pool"))
 def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
           lengths, win, sinks, layer=None, *, q_block: int,
-          logits_soft_cap: float, scale: float, interpret: bool):
+          logits_soft_cap: float, scale: float, interpret: bool,
+          from_pool: bool = False):
     B, T, Hq, D = q.shape
     layered = layer is not None
     if layered:
@@ -275,14 +332,14 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
     else:
         _, page_size, Hkv, _ = k_pages.shape
     MP = page_table.shape[1]
-    if T % page_size != 0:
+    if not from_pool and T % page_size != 0:
         raise ValueError(f"window {T} not a multiple of page {page_size}")
     # Largest block ≤ q_block that tiles T exactly — any window passing
     # the page-multiple check above gets a valid (if smaller) q block
     # rather than a trace-time crash on non-pow2 buckets.
     QB = math.gcd(T, min(q_block, T))
     nQ = T // QB
-    nF = T // page_size
+    nF = 0 if from_pool else T // page_size
     n_kv = MP + nF
     G = Hq // Hkv
     has_sinks = sinks is not None
@@ -321,17 +378,21 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
         pool_block = (1, page_size, Hkv, D)
         n_prefetch = 4
 
+    in_specs = [
+        pl.BlockSpec((1, 1, Hkv, QB * G, D), q_idx),
+        pl.BlockSpec(pool_block, pool_idx),
+        pl.BlockSpec(pool_block, pool_idx),
+    ]
+    if not from_pool:
+        in_specs += [
+            pl.BlockSpec((1, 1, page_size, Hkv, D), fresh_idx),
+            pl.BlockSpec((1, 1, page_size, Hkv, D), fresh_idx),
+        ]
+    in_specs.append(pl.BlockSpec((Hkv, QB * G, 1), fixed_idx))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=n_prefetch,  # q_start, lens, pt, win[, layer]
         grid=(B, nQ, n_kv),
-        in_specs=[
-            pl.BlockSpec((1, 1, Hkv, QB * G, D), q_idx),
-            pl.BlockSpec(pool_block, pool_idx),
-            pl.BlockSpec(pool_block, pool_idx),
-            pl.BlockSpec((1, 1, page_size, Hkv, D), fresh_idx),
-            pl.BlockSpec((1, 1, page_size, Hkv, D), fresh_idx),
-            pl.BlockSpec((Hkv, QB * G, 1), fixed_idx),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, Hkv, QB * G, D), q_idx),
         scratch_shapes=[
             pltpu.VMEM((Hkv, QB * G, 1), jnp.float32),   # running max
@@ -345,8 +406,9 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
     # hazard on v5e (see the V3 decode kernel history).
     q6 = q.reshape(B, nQ, QB, Hkv, G, D).transpose(0, 1, 3, 2, 4, 5) \
         .reshape(B, nQ, Hkv, QB * G, D)
-    kf5 = k_fresh.reshape(B, nF, page_size, Hkv, D)
-    vf5 = v_fresh.reshape(B, nF, page_size, Hkv, D)
+    if not from_pool:
+        kf5 = k_fresh.reshape(B, nF, page_size, Hkv, D)
+        vf5 = v_fresh.reshape(B, nF, page_size, Hkv, D)
     if has_sinks:
         # [Hq] → the kernel's [Hkv, QB*G, 1] block layout (replicated
         # over QB), pre-broadcast in XLA where the relayout is free.
@@ -355,20 +417,25 @@ def _impl(q, k_fresh, v_fresh, k_pages, v_pages, page_table, q_start,
             (Hkv, QB, G)).reshape(Hkv, QB * G, 1)
     else:
         sk3 = jnp.zeros((Hkv, QB * G, 1), jnp.float32)
+    if from_pool:
+        body = _kernel_layered_pool if layered else _kernel_pool
+    else:
+        body = _kernel_layered if layered else _kernel
     out = pl.pallas_call(
-        functools.partial(_kernel_layered if layered else _kernel,
+        functools.partial(body,
                           page_size=page_size, q_block=QB,
                           num_pool_steps=MP, num_kv_steps=n_kv,
                           logits_soft_cap=logits_soft_cap, scale=scale,
                           has_sinks=has_sinks),
         out_shape=jax.ShapeDtypeStruct((B, nQ, Hkv, QB * G, D), q.dtype),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(q_start.astype(jnp.int32), lengths.astype(jnp.int32),
       page_table, win,
       *((layer.reshape(1).astype(jnp.int32),) if layered else ()),
-      q6, k_pages, v_pages, kf5, vf5, sk3)
+      q6, k_pages, v_pages,
+      *(() if from_pool else (kf5, vf5)), sk3)
     out = out.reshape(B, nQ, Hkv, QB, G, D).transpose(0, 1, 3, 2, 4, 5)
     return out.reshape(B, T, Hq, D)

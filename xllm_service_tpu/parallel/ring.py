@@ -102,10 +102,18 @@ def ring_attention_sharded(mesh: Mesh, axis_name: str = "sp",
     grouping inside the block must stay aligned), lengths replicated."""
     qkv_spec = P(None, axis_name, head_axis, None)
 
+    # jax.shard_map(check_vma=) is the current API; the pinned-toolchain
+    # jax (<= 0.4.x) ships it as experimental.shard_map with check_rep=.
+    if hasattr(jax, "shard_map"):
+        smap = functools.partial(jax.shard_map, check_vma=False)
+    else:
+        from jax.experimental.shard_map import shard_map as _sm
+        smap = functools.partial(_sm, check_rep=False)
+
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        smap, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, P()),
-        out_specs=qkv_spec, check_vma=False)
+        out_specs=qkv_spec)
     def _ring(q, k, v, kv_lengths):
         return ring_attention(q, k, v, axis_name, kv_lengths)
 

@@ -213,17 +213,67 @@ class Engine:
 
         K = engine_cfg.num_top_logprobs
         aligned = getattr(engine_cfg, "prefill_page_aligned", True)
+        # Write-then-attend resolution: the config's None means auto —
+        # on wherever the Pallas kernels are on (the aliased writers are
+        # what make the in-scan pool write free), off on the pure-XLA
+        # path, which keeps its attend-then-scatter ordering.
+        wta = getattr(engine_cfg, "write_then_attend", None)
+        if wta is None:
+            from xllm_service_tpu.ops import pallas
+            wta = pallas.enabled()
+        self.write_then_attend = bool(wta)
+        # Pin the KV pools' layout to default major-to-minor at every
+        # jitted step boundary. Without the pin, XLA's layout assignment
+        # gives the pool PARAMETERS an attention-biased layout while the
+        # aliased Pallas writer custom call requires the default — the
+        # conflict materializes as 2 pools × (in + out) = 4 FULL-POOL
+        # conversion copies per call (~4.3 GB/call at the bench shape;
+        # the jit-call-boundary copies of docs/PERF_NOTES.md, proven
+        # gone by tools/aot_copy_census.py). Single-device engines only:
+        # the layout/sharding interplay on meshes is unvalidated, and
+        # best-effort — any failure falls back to unpinned jits.
+        kvl = self._kv_default_layouts()
+        if kvl is not None:
+            # Commit the pools to the pinned layout up front so the
+            # FIRST call already sees it: otherwise call 1 compiles
+            # against the unpinned input layout and every later call
+            # (whose kv is the pinned-layout output of call 1) compiles
+            # the same program a second time — a spurious
+            # post-warmup-recompile per program.
+            try:
+                self.kv = tuple(jax.device_put(x, l)
+                                for x, l in zip(self.kv, kvl))
+            except Exception:  # noqa: BLE001 — pinning is best-effort
+                kvl = None
+
+        def _pin(n_in: int, kv_in: int, n_out: int, kv_out: int = 3):
+            if kvl is None:
+                return {}
+            ins: List[Any] = [None] * n_in
+            ins[kv_in] = kvl
+            outs: List[Any] = [None] * n_out
+            outs[kv_out] = kvl
+            return {"in_shardings": tuple(ins),
+                    "out_shardings": tuple(outs)}
+
+        # t_len rides as a POSITIONAL static (arg 12): pjit rejects
+        # kwargs outright once in_shardings is specified, so the layout
+        # pin forces the positional convention at every call site.
         self._jit_prefill = jax.jit(
             functools.partial(_prefill_step, cfg=model_cfg, num_top=K,
-                              page_aligned=aligned),
-            donate_argnums=(2,), static_argnames=("t_len",))
+                              page_aligned=aligned,
+                              write_then_attend=self.write_then_attend),
+            donate_argnums=(2,), static_argnums=(12,),
+            **_pin(12, 2, 5))
         # echo+logprobs variant: also scores every window token. Compiled
         # on first use (rare path; the recompile counter will note it) —
         # warmup stays lean.
         self._jit_prefill_plp = jax.jit(
             functools.partial(_prefill_step, cfg=model_cfg, num_top=K,
-                              with_prompt_lps=True, page_aligned=aligned),
-            donate_argnums=(2,), static_argnames=("t_len",))
+                              with_prompt_lps=True, page_aligned=aligned,
+                              write_then_attend=self.write_then_attend),
+            donate_argnums=(2,), static_argnums=(12,),
+            **_pin(12, 2, 6))
         # Sequence-parallel ring prefill: available when the mesh has an
         # sp axis — prompts longer than the largest single-chip bucket
         # prefill in ONE sp-sharded step instead of many chunked windows.
@@ -235,15 +285,31 @@ class Engine:
                                   num_top=K, mesh=mesh),
                 donate_argnums=(2,), static_argnames=("t_len",))
         self._jit_decode = jax.jit(
-            functools.partial(_decode_step, cfg=model_cfg, num_top=K),
-            donate_argnums=(2, 6))
+            functools.partial(_decode_step, cfg=model_cfg, num_top=K,
+                              write_then_attend=self.write_then_attend),
+            donate_argnums=(2, 6), **_pin(9, 2, 6))
         # tokens/positions (1, 2) are donated too: each burst feeds back
         # the previous burst's returned final-state handles, and a donated
         # input lets XLA alias the new final state into the same buffers.
+        multi_pin = _pin(11, 4, 8)
+        if multi_pin:
+            # The burst's device-resident token/position handles flow
+            # OUT (fin_tok/fin_pos) and back IN next burst; under
+            # partially-specified shardings their layout must be pinned
+            # on both sides too or the upload-path and resident-path
+            # calls compile separate cache entries.
+            vec = self._vec_default_layout()
+            ins = list(multi_pin["in_shardings"])
+            ins[1] = ins[2] = vec
+            outs = list(multi_pin["out_shardings"])
+            outs[6] = outs[7] = vec
+            multi_pin = {"in_shardings": tuple(ins),
+                         "out_shardings": tuple(outs)}
         self._jit_decode_multi = jax.jit(
             functools.partial(_decode_multi_step, cfg=model_cfg,
-                              n_steps=engine_cfg.decode_steps, num_top=K),
-            donate_argnums=(1, 2, 4, 8))
+                              n_steps=engine_cfg.decode_steps, num_top=K,
+                              write_then_attend=self.write_then_attend),
+            donate_argnums=(1, 2, 4, 8), **multi_pin)
         # Device-resident decode state between bursts: the previous
         # burst's final (tokens, positions) handles plus a host snapshot
         # proving they still describe the running batch, and the device
@@ -274,6 +340,33 @@ class Engine:
         # warmup means a shape escaped warmup's coverage.
         self.phase_times: Dict[str, float] = collections.defaultdict(float)
         self.phase_counts: Dict[str, int] = collections.defaultdict(int)
+
+    def _vec_default_layout(self):
+        """Default layout for the burst's [B] int32 token/position
+        carries (same best-effort contract as _kv_default_layouts)."""
+        try:
+            from jax.experimental.layout import (DeviceLocalLayout,
+                                                 Layout)
+            return Layout(DeviceLocalLayout((0,)),
+                          jax.tree_util.tree_leaves(self.kv)[0].sharding)
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _kv_default_layouts(self):
+        """Default major-to-minor Layout pair for the KV pools (None =
+        don't pin: sharded engines, or a jax without the layout API).
+        See the comment at the jit definitions."""
+        if self.mesh is not None:
+            return None
+        try:
+            from jax.experimental.layout import (DeviceLocalLayout,
+                                                 Layout)
+            return tuple(
+                Layout(DeviceLocalLayout(tuple(range(x.ndim))),
+                       x.sharding)
+                for x in self.kv)
+        except Exception:  # noqa: BLE001 — pinning is an optimization
+            return None
 
     @contextlib.contextmanager
     def _phase(self, name: str):
@@ -765,13 +858,13 @@ class Engine:
                     jitted(self.params, jnp.asarray(packed), self.kv,
                            st_f32, st_i32, key, mm_e, mm_p,
                            plp_targets, bias_ids, bias_vals, rope_pos,
-                           t_len=T)
+                           T)
             else:
                 plp = None
                 fused, top_ids, top_lps, self.kv, mdrop = \
                     jitted(self.params, jnp.asarray(packed), self.kv,
                            st_f32, st_i32, key, mm_e, mm_p, None,
-                           bias_ids, bias_vals, rope_pos, t_len=T)
+                           bias_ids, bias_vals, rope_pos, T)
         self._note_recompile("prefill_plp" if plp_mode else "prefill",
                              jitted, cache_before)
         with self._phase("prefill.readback"):
@@ -1375,7 +1468,7 @@ class Engine:
                 self.params,
                 jnp.zeros((B, _PREFILL_HDR + T + mp), jnp.int32),
                 self.kv, st_f32, st_i32, key, None, None, None,
-                b_ids, b_vals, warm_rp, t_len=T)
+                b_ids, b_vals, warm_rp, T)
 
         # Decode (single + fused multi): every pow2 table width. Inactive
         # slots + NULL pages make the KV writes no-ops.
@@ -1409,9 +1502,21 @@ class Engine:
                 tok0 = jnp.zeros((Bmax,), jnp.int32)
                 pos0 = jnp.zeros((Bmax,), jnp.int32)
                 apt0 = jnp.zeros((Bmax, 2 + mp), jnp.int32)
-                (_, _, _, self.kv, _, _, _, _) = self._jit_decode_multi(
+                (_, _, _, self.kv, _, _, f_tok,
+                 f_pos) = self._jit_decode_multi(
                     self.params, tok0, pos0, apt0, self.kv, st_f32,
                     st_i32, key, None, b_ids, b_vals)
+                # Second call feeding back the returned device-resident
+                # carries and a split (device-committed) key: the
+                # serving path's resident-reuse signature. Under the
+                # pinned-layout jits, committed-vs-uncommitted inputs
+                # are distinct pjit cache signatures (same executable,
+                # no compile) — prime both here or the first serving
+                # burst shows up in the recompile counters.
+                key2 = jax.random.split(key)[0]
+                (_, _, _, self.kv, _, _, _, _) = self._jit_decode_multi(
+                    self.params, f_tok, f_pos, apt0, self.kv, st_f32,
+                    st_i32, key2, None, b_ids, b_vals)
         jax.block_until_ready(jax.tree_util.tree_leaves(self.kv)[0])
         return time.monotonic() - t0
 
@@ -1476,10 +1581,11 @@ def _split_tok_lp(fused: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
 
 def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
                   mm_positions=None, plp_targets=None, bias_ids=None,
-                  bias_vals=None, rope_pos=None, *, cfg: ModelConfig,
-                  num_top: int = 0, t_len: int = 0,
+                  bias_vals=None, rope_pos=None, t_len: int = 0, *,
+                  cfg: ModelConfig, num_top: int = 0,
                   with_prompt_lps: bool = False,
-                  page_aligned: bool = True):
+                  page_aligned: bool = True,
+                  write_then_attend: bool = False):
     start_pos = packed[:, 0]
     lengths = packed[:, 1]
     tokens = packed[:, _PREFILL_HDR:_PREFILL_HDR + t_len]
@@ -1490,7 +1596,8 @@ def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
         mm_embeds=mm_embeds, mm_positions=mm_positions,
         prompt_lp_targets=plp_targets if with_prompt_lps else None,
         return_stats=True, rope_pos=rope_pos,
-        page_aligned_prefill=page_aligned)
+        page_aligned_prefill=page_aligned,
+        write_then_attend=write_then_attend)
     if with_prompt_lps:
         last_logits, _, kv, plp, stats = res
     else:
@@ -1530,7 +1637,7 @@ def _prefill_ring_step(params, packed, kv, st_f32, st_i32, key,
 
 def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None,
                  bias_ids=None, bias_vals=None, *, cfg: ModelConfig,
-                 num_top: int = 0):
+                 num_top: int = 0, write_then_attend: bool = False):
     tokens = packed[:, 0]
     positions = packed[:, 1]
     active = packed[:, 2].astype(bool)
@@ -1539,7 +1646,8 @@ def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None,
     st = SamplingTensors.unpack(st_f32, st_i32)
     logits, kv, stats = transformer.forward_decode(
         params, cfg, tokens, positions, active, kv, page_table,
-        return_stats=True, rope_delta=rope_delta)
+        return_stats=True, rope_delta=rope_delta,
+        write_then_attend=write_then_attend)
     tok = sample_tokens(logits, st, key, positions=positions, counts=counts,
                         bias_ids=bias_ids, bias_vals=bias_vals)
     lp = compute_logprobs(logits, tok)
@@ -1555,7 +1663,7 @@ def _decode_step(params, packed, kv, st_f32, st_i32, key, counts=None,
 def _decode_multi_step(params, tokens, positions, active_pt, kv, st_f32,
                        st_i32, key, counts=None, bias_ids=None,
                        bias_vals=None, *, cfg: ModelConfig, n_steps: int,
-                       num_top: int = 0):
+                       num_top: int = 0, write_then_attend: bool = False):
     """``n_steps`` fused greedy/sampled decode iterations: the scan body is
     traced once, tokens feed forward on-device, and only the [N, B] token/
     logprob blocks cross back to the host — one dispatch per N tokens.
@@ -1578,7 +1686,8 @@ def _decode_multi_step(params, tokens, positions, active_pt, kv, st_f32,
         tok, pos, kv, cnt, drop = carry
         logits, kv, stats = transformer.forward_decode(
             params, cfg, tok, pos, active, kv, page_table,
-            return_stats=True, rope_delta=rope_delta)
+            return_stats=True, rope_delta=rope_delta,
+            write_then_attend=write_then_attend)
         new_tok = sample_tokens(logits, st, key_i, positions=pos,
                                 counts=cnt, bias_ids=bias_ids,
                                 bias_vals=bias_vals)

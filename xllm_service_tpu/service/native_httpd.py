@@ -317,8 +317,19 @@ class NativeHttpServer:
                         daemon=True,
                         name=f"httpd-native-{self.port}-ovf").start()
                 else:
-                    fut = self._pool.submit(self._run_pooled,
-                                            rid, req, counted)
+                    try:
+                        fut = self._pool.submit(self._run_pooled,
+                                                rid, req, counted)
+                    except BaseException:
+                        # submit() raising (a late dispatch racing
+                        # stop()'s pool shutdown) means _run_pooled's
+                        # finally never runs: give the busy count back
+                        # here or it stays inflated forever and every
+                        # future request takes the per-request-Thread
+                        # overflow path.
+                        with self._pool_lock:
+                            self._pool_busy -= 1
+                        raise
                     # A fresh Thread's crash used to print via the
                     # default excepthook; an unread Future swallows it
                     # — re-surface.

@@ -79,6 +79,11 @@ def _type_str(tp: Any) -> str:
         if issubclass(tp, enum.Enum):
             return f"enum:{tp.__name__}"
         return tp.__name__
+    if tp is Any:
+        # str(typing.Any) is version-dependent ("typing.Any" on 3.10,
+        # "Any" once it became a proper class) — pin the stable spelling
+        # or the golden contract diff flags a phantom drift.
+        return "Any"
     return str(tp).replace(" ", "")
 
 
