@@ -61,3 +61,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "expected_lock_violations: test provokes lock-order "
         "violations on purpose (skips the swallowed-violation check)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 budgeted run "
+        "(`-m 'not slow'`); run explicitly or with -m slow")

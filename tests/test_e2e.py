@@ -172,10 +172,61 @@ class TestEndToEnd:
             conn.close()
             assert 'xllm_worker_phase_seconds_total' in wtext
             assert 'phase="prefill.dispatch"' in wtext
+
+            # Keep-alive reuse pool counters (service→worker transport)
+            # surface on /metrics so transport regressions are visible
+            # under service_bench. Cluster traffic above (registration
+            # RPCs + the completion relay) must have moved them.
+            conn = http.client.HTTPConnection(master.http_address,
+                                              timeout=10)
+            conn.request("GET", "/metrics")
+            mtext = conn.getresponse().read().decode()
+            conn.close()
+            for counter in ("hits_total", "misses_total",
+                            "overflow_total", "expired_total", "idle"):
+                assert (f'xllm_http_conn_pool_{counter}'
+                        f'{{plane="service"}} ') in mtext, mtext
+            misses = next(
+                int(line.split()[-1]) for line in mtext.splitlines()
+                if line.startswith('xllm_http_conn_pool_misses_total'
+                                   '{plane="service"}'))
+            assert misses >= 1     # at least one fresh TCP connect
         finally:
             for w in workers:
                 w.stop()
             master.stop()
+
+    def test_conn_pool_counters_unit(self):
+        """Pool-counter semantics pinned without a cluster: a put past
+        the per-address cap counts overflow; an idle-expired get counts
+        expiry + miss; a warm get counts a hit."""
+        from xllm_service_tpu.service.httpd import _ConnPool
+
+        class _FakeConn:
+            sock = None
+
+            def close(self):
+                pass
+
+        pool = _ConnPool()
+        for _ in range(pool._MAX_IDLE_PER_ADDR + 1):
+            pool.put("a:1", _FakeConn())
+        st = pool.stats()
+        assert st["overflow_total"] == 1
+        assert st["idle"] == pool._MAX_IDLE_PER_ADDR
+        conn, reused = pool.get("a:1", timeout=1.0)
+        assert reused
+        assert pool.stats()["hits_total"] == 1
+        # Age the rest out: the next get must expire them and MISS.
+        with pool._lock:
+            pool._idle["a:1"] = [(c, t - 2 * pool._MAX_IDLE_S)
+                                 for (c, t) in pool._idle["a:1"]]
+        conn2, reused2 = pool.get("a:1", timeout=1.0)
+        assert not reused2
+        st = pool.stats()
+        assert st["misses_total"] == 1
+        assert st["expired_total"] == pool._MAX_IDLE_PER_ADDR - 1
+        conn2.close()
 
     def test_admin_flags_hot_reload(self, store):
         """SLO thresholds flip at runtime through /admin/flags (the
@@ -418,6 +469,28 @@ class TestEmbeddings:
             np.testing.assert_allclose(e0, e1, atol=1e-5)
             assert np.linalg.norm(e0 - e2) > 1e-3
             assert resp["usage"]["prompt_tokens"] > 0
+
+            # Over-limit inputs get a 400 naming the limit and the
+            # offending input — NEVER a silent truncation to the first
+            # 256 tokens (a truncated embedding is a wrong answer that
+            # looks right). Pins Worker.EMBED_MAX_TOKENS semantics.
+            from xllm_service_tpu.runtime.worker import Worker
+            limit = Worker.EMBED_MAX_TOKENS
+            # ByteTokenizer (the registry-model fallback): 1 token/byte.
+            status, resp = http_json(
+                "POST", master.http_address, "/v1/embeddings",
+                {"model": "tiny", "input": ["short", "x" * (limit + 40)]},
+                timeout=120.0)
+            assert status == 400, resp
+            msg = resp["error"]["message"]
+            assert str(limit) in msg, msg        # limit named
+            assert "input 1" in msg, msg         # offender named
+            # Exactly at the limit still succeeds (boundary pin).
+            status, resp = http_json(
+                "POST", master.http_address, "/v1/embeddings",
+                {"model": "tiny", "input": ["y" * limit]}, timeout=120.0)
+            assert status == 200, resp
+            assert resp["usage"]["prompt_tokens"] == limit
         finally:
             for w in workers:
                 w.stop()

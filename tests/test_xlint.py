@@ -1,0 +1,216 @@
+"""tools/xlint — the tier-1 static-analysis gate.
+
+Three layers, mirroring tests/test_copy_census.py's structure:
+1. the REAL tree is clean (with the checked-in allowlists applied) —
+   this is the standing gate the perf invariants ride on;
+2. positive controls: a fixture tree with one deliberate violation per
+   rule, proving each rule actually fires (a linter that never fires
+   proves nothing);
+3. a clean fixture full of near-miss patterns, pinning zero false
+   positives, plus engine-level allowlist hygiene (justification
+   required, stale entries reported).
+"""
+
+import json
+import os
+
+import pytest
+
+from tools.xlint import REPO_ROOT, load_allowlist, main, run
+from tools.xlint.rules import LOCK_RANK_TABLE, RULES
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "xlint_fixtures")
+BAD = os.path.join(FIXTURES, "bad")
+CLEAN = os.path.join(FIXTURES, "clean")
+NO_ALLOWLISTS = os.path.join(FIXTURES, "no_allowlists")  # doesn't exist
+
+
+def _run_fixture(root):
+    return run(["xllm_service_tpu"], root=root,
+               allowlist_dir=NO_ALLOWLISTS)
+
+
+class TestRealTree:
+    def test_real_tree_is_clean(self):
+        """The acceptance gate: all six rules over xllm_service_tpu/,
+        checked-in allowlists applied, zero findings."""
+        findings = run(["xllm_service_tpu"])
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_cli_clean_exit_and_json(self, capsys):
+        rc = main(["--json", "xllm_service_tpu"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["clean"] is True
+        assert out["findings"] == []
+        assert set(out["rules"]) == {r.name for r in RULES}
+
+    def test_allowlists_are_annotated(self):
+        """Every checked-in allowlist entry carries a justification
+        (the engine enforces it; this pins that the shipped lists
+        parse without config errors)."""
+        for rule in RULES:
+            entries, errors = load_allowlist(rule.name)
+            assert errors == [], [e.render() for e in errors]
+            for key, justification in entries.items():
+                assert len(justification) > 20, \
+                    f"{rule.name}: {key} justification too thin"
+
+    def test_subtree_run_skips_whole_package_judgments(self):
+        """Linting a subtree must not call every flag documented in
+        docs/FLAGS.md 'never read', nor call allowlist entries whose
+        findings live outside the subtree 'stale' — both judgments
+        need whole-package scope. Uses the real checked-in allowlists,
+        exactly like the CLI."""
+        findings = run(["xllm_service_tpu/service"],
+                       rule_names=["flag-registry"])
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_lock_rank_table_matches_locks_docstring(self):
+        """The canonical table in tools/xlint/rules.py and the prose
+        table in utils/locks.py must name the same locks."""
+        from xllm_service_tpu.utils import locks
+        doc = locks.__doc__
+        for name, rank in LOCK_RANK_TABLE.items():
+            assert name in doc, \
+                f"lock {name!r} (rank {rank}) missing from the " \
+                f"utils/locks.py docstring table"
+
+
+class TestPositiveControls:
+    """One deliberate violation per rule: each must fire on the bad
+    fixture tree (the forced-copy-control pattern)."""
+
+    @pytest.fixture(scope="class")
+    def bad_findings(self):
+        return _run_fixture(BAD)
+
+    def _keys(self, findings, rule):
+        return {f.key for f in findings if f.rule == rule}
+
+    def test_every_rule_fires(self, bad_findings):
+        fired = {f.rule for f in bad_findings}
+        expected = {r.name for r in RULES}
+        assert expected <= fired, f"rules that never fired: " \
+                                  f"{expected - fired}"
+
+    def test_mosaic_compat_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "mosaic-compat")
+        p = "xllm_service_tpu/ops/bad_mosaic.py"
+        assert f"{p}::pltpu.CompilerParams" in keys
+        assert f"{p}::pltpu.TPUCompilerParams" in keys
+        assert f"{p}::pltpu.HBM" in keys
+        assert f"{p}::jax.shard_map" in keys
+        assert f"{p}::jax.set_mesh" in keys
+        assert f"{p}::jax.experimental.shard_map.shard_map" in keys
+
+    def test_donation_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "donation-coverage")
+        p = "xllm_service_tpu/runtime/engine.py"
+        assert f"{p}::_step_undonated::donate" in keys
+        assert f"{p}::_step_undonated::layout-pin" in keys
+        assert f"{p}::_step_partial::donate" in keys
+        assert f"{p}::_decorated_undonated::donate" in keys
+        assert f"{p}::_step_nonliteral::donate-nonliteral" in keys
+        # The correctly-donated-and-pinned jit must NOT fire.
+        assert not any("_step_good" in k for k in keys)
+
+    def test_lock_rank_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "lock-rank")
+        p = "xllm_service_tpu/utils/bad_locks.py"
+        assert f"{p}::fixture.bogus::undeclared" in keys
+        assert f"{p}::tracer::rank-mismatch" in keys
+        assert f"{p}::W.inversion::worker.engine<worker.hb" in keys
+        assert f"{p}::W.one_hop_inversion::call:_helper::" \
+               f"worker.engine<worker.hb" in keys
+        # The increasing nesting in fine() must NOT fire.
+        assert not any("W.fine" in k for k in keys)
+
+    def test_flag_registry_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "flag-registry")
+        assert "flags::XLLM_FIXTURE_UNDOC" in keys
+        assert "docs::XLLM_FIXTURE_STALE" in keys
+
+    def test_traced_host_sync_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "traced-host-sync")
+        p = "xllm_service_tpu/models/bad_sync.py"
+        assert f"{p}::_traced::.item()" in keys
+        assert f"{p}::_traced::np.asarray" in keys
+        assert f"{p}::_traced::float(x)" in keys
+        assert f"{p}::body::np.asarray" in keys, \
+            "scan bodies must be treated as traced"
+
+    def test_service_hygiene_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "service-hygiene")
+        p = "xllm_service_tpu/service/httpd.py"
+        assert f"{p}::Handler.dispatch::sleep" in keys
+        assert f"{p}::Handler.dispatch::result" in keys
+        assert f"{p}::Handler.dispatch::swallow" in keys
+
+
+class TestNoFalsePositives:
+    def test_clean_fixture_is_clean(self):
+        findings = _run_fixture(CLEAN)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
+class TestAllowlistHygiene:
+    def test_entry_without_justification_is_config_error(self, tmp_path):
+        d = tmp_path / "allowlists"
+        d.mkdir()
+        (d / "mosaic-compat.txt").write_text(
+            "xllm_service_tpu/ops/bad_mosaic.py::jax.shard_map\n")
+        findings = run(["xllm_service_tpu"], root=BAD,
+                       allowlist_dir=str(d))
+        assert any(f.rule == "allowlist"
+                   and "no justification" in f.message
+                   for f in findings)
+        # The unjustified entry must NOT suppress the finding.
+        assert any(f.key.endswith("::jax.shard_map")
+                   for f in findings if f.rule == "mosaic-compat")
+
+    def test_stale_entry_is_reported(self, tmp_path):
+        d = tmp_path / "allowlists"
+        d.mkdir()
+        (d / "mosaic-compat.txt").write_text(
+            "nowhere.py::jax.shard_map  # vetted long ago\n")
+        findings = run(["xllm_service_tpu"], root=BAD,
+                       allowlist_dir=str(d))
+        assert any(f.rule == "allowlist" and "stale" in f.message
+                   for f in findings)
+
+    def test_justified_entry_suppresses(self, tmp_path):
+        d = tmp_path / "allowlists"
+        d.mkdir()
+        (d / "mosaic-compat.txt").write_text(
+            "xllm_service_tpu/ops/bad_mosaic.py::jax.shard_map"
+            "  # fixture: vetted for this test\n")
+        findings = run(["xllm_service_tpu"], root=BAD,
+                       allowlist_dir=str(d))
+        assert not any(f.key.endswith("::jax.shard_map")
+                       for f in findings if f.rule == "mosaic-compat")
+        assert not any(f.rule == "allowlist" for f in findings)
+
+
+class TestCli:
+    def test_findings_exit_nonzero(self, capsys, monkeypatch):
+        # Point the CLI at the bad fixture via explicit paths — run()
+        # resolves relative paths against the repo root.
+        rel = os.path.relpath(BAD, REPO_ROOT)
+        rc = main(["--rule", "mosaic-compat",
+                   os.path.join(rel, "xllm_service_tpu")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "mosaic-compat" in out
+
+    def test_unknown_rule_is_usage_error(self, capsys):
+        rc = main(["--rule", "no-such-rule"])
+        assert rc == 2
+
+    def test_list_rules(self, capsys):
+        rc = main(["--list-rules"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        for r in RULES:
+            assert r.name in out

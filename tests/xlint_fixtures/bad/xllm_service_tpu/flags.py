@@ -1,0 +1,6 @@
+"""Positive control for flag-registry: an env gate docs/FLAGS.md (the
+fixture one) does not document. Never imported."""
+
+import os
+
+VALUE = os.environ.get("XLLM_FIXTURE_UNDOC", "0")

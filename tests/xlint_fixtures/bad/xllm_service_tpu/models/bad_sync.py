@@ -1,0 +1,22 @@
+"""Positive control for traced-host-sync: host materializations inside
+jit- and scan-traced bodies. Never imported."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _traced(x, kv):
+    v = x.item()                  # device→host sync
+    a = np.asarray(kv)            # numpy materialization
+    f = float(x)                  # host cast of traced arg
+    return jnp.sum(kv) + v + f + a.sum()
+
+
+_jit = jax.jit(_traced)
+
+
+def scan_user(xs):
+    def body(c, x):
+        return c, np.asarray(x)   # host sync inside a scan body
+    return jax.lax.scan(body, 0, xs)

@@ -1,0 +1,12 @@
+"""Positive control for mosaic-compat: every forbidden spelling, each of
+which broke (or would break) one Mosaic generation. Never imported."""
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.shard_map import shard_map  # noqa: F401
+
+_params = pltpu.CompilerParams          # new-API-only spelling
+_params_old = pltpu.TPUCompilerParams   # old-API-only spelling
+_hbm = pltpu.HBM
+_smap = jax.shard_map
+_setmesh = jax.set_mesh

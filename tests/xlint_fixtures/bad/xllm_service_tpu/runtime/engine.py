@@ -1,0 +1,52 @@
+"""Positive control for donation-coverage: jit entry points carrying a
+KV pool without donation and/or without a layout pin. Mirrors
+tests/test_copy_census.py's forced-copy control: the rule must FIRE
+here or it proves nothing. Never imported — parsed only."""
+
+import functools
+
+import jax
+
+
+def _step_undonated(params, packed, kv):
+    return kv
+
+
+# No donate_argnums at all, no pin → both findings.
+_jit_bad = jax.jit(_step_undonated)
+
+
+def _step_partial(params, packed, kv, st):
+    return kv
+
+
+# donate_argnums present but omits the kv position (2); splat-less.
+_jit_omits = jax.jit(functools.partial(_step_partial, params=None),
+                     donate_argnums=(3,))
+
+
+@jax.jit
+def _decorated_undonated(params, kv):
+    return kv
+
+
+def _step_nonliteral(params, packed, kv):
+    return kv
+
+
+_DONATE = (2,)
+# donate_argnums present but not a literal: unverifiable is a finding.
+_jit_nonliteral = jax.jit(_step_nonliteral, donate_argnums=_DONATE,
+                          in_shardings=None)
+
+
+def _step_good(params, packed, kv):
+    return kv
+
+
+def _pin():
+    return {}
+
+
+# Correct shape: donated AND pinned (via splat) — must NOT fire.
+_jit_good = jax.jit(_step_good, donate_argnums=(2,), **_pin())

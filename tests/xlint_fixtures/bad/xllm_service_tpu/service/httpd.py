@@ -1,0 +1,17 @@
+"""Positive control for service-hygiene: a dispatch-path sleep, an
+unbounded .result(), and an unjustified broad swallow. Never imported.
+(The file NAME matters: the rule scopes to the real dispatch files.)"""
+
+import time
+
+
+class Handler:
+    def dispatch(self, req):
+        time.sleep(0.1)                  # blocks a request thread
+        fut = req.submit()
+        val = fut.result()               # unbounded wait
+        try:
+            req.close()
+        except Exception:                # swallowed, no justification
+            pass
+        return val

@@ -1,0 +1,31 @@
+"""Positive control for lock-rank: a declaration off the table, a rank
+mismatch, a lexical inversion, and a one-hop call inversion. Never
+imported."""
+
+from xllm_service_tpu.utils.locks import make_lock
+
+
+class W:
+    def __init__(self):
+        self._hb_lock = make_lock("worker.hb", 5)
+        self._engine_lock = make_lock("worker.engine", 20)
+        self._bogus = make_lock("fixture.bogus", 1)     # not in the table
+        self._wrong = make_lock("tracer", 50)           # table says 90
+
+    def inversion(self):
+        with self._engine_lock:          # rank 20
+            with self._hb_lock:          # rank 5 — inversion
+                pass
+
+    def _helper(self):
+        with self._hb_lock:
+            pass
+
+    def one_hop_inversion(self):
+        with self._engine_lock:          # rank 20
+            self._helper()               # acquires rank 5 — inversion
+
+    def fine(self):
+        with self._hb_lock:
+            with self._engine_lock:      # 5 → 20, increasing — OK
+                pass

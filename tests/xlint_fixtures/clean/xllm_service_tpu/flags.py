@@ -1,0 +1,5 @@
+"""Clean fixture: the read flag is documented in the fixture FLAGS.md."""
+
+import os
+
+VALUE = os.environ.get("XLLM_FIXTURE_OK", "0")

@@ -1,0 +1,21 @@
+"""Clean fixture: traced bodies that stay on-device; host numpy only at
+module scope (trace-time constants) and in un-traced host helpers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TABLE = np.asarray([1.0, 2.0])   # module-level constant: host is fine
+
+
+def _traced(x, kv, *, cfg=None):
+    return jnp.sum(kv) * x + jnp.asarray(_TABLE, x.dtype).sum()
+
+
+_jit = jax.jit(_traced, donate_argnums=(1,))
+
+
+def host_entry(fn, x):
+    """Host-side caller of the jitted fn — np here is legitimate and
+    must not be flagged (it is not jit-reachable)."""
+    return np.asarray(fn(x))

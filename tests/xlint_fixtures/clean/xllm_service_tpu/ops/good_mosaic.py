@@ -1,0 +1,8 @@
+"""Clean fixture: version-sensitive names via the compat shim only."""
+
+from xllm_service_tpu.ops.pallas._compat import (CompilerParams, HBM,
+                                                 shard_map_unchecked)
+
+_params = CompilerParams
+_hbm = HBM
+_smap = shard_map_unchecked

@@ -1,0 +1,27 @@
+"""Clean fixture: the correct shapes of everything the rules check —
+zero findings expected. Never imported."""
+
+import functools
+
+import jax
+
+
+def _pin(n_in, kv_in, n_out):
+    return {}
+
+
+def _step(params, packed, kv):
+    return kv
+
+
+# Donated AND pinned (best-effort splat, the engine's real idiom).
+_jit_step = jax.jit(functools.partial(_step, params=None),
+                    donate_argnums=(2,), **_pin(3, 2, 1))
+
+
+def _no_kv(params, packed):
+    return packed
+
+
+# No KV-pool args — donation not required.
+_jit_other = jax.jit(_no_kv)

@@ -1,0 +1,38 @@
+"""Clean fixture: table-conformant declarations, increasing nesting,
+legal re-entrant re-acquisition."""
+
+from xllm_service_tpu.utils.locks import make_lock, make_rlock
+
+
+class W:
+    def __init__(self):
+        self._hb_lock = make_lock("worker.hb", 5)
+        self._engine_lock = make_lock("worker.engine", 20)
+        self._mgr_lock = make_rlock("instance_mgr", 30)
+
+    def increasing(self):
+        with self._hb_lock:
+            with self._engine_lock:
+                pass
+
+    def _helper(self):
+        with self._mgr_lock:
+            pass
+
+    def reentrant_ok(self):
+        # Re-acquiring the SAME re-entrant lock through a call is legal
+        # (CheckedLock skips the rank check for the owning thread).
+        with self._mgr_lock:
+            self._helper()
+
+    def _starts_background(self):
+        # A closure acquiring a LOWER lock runs later on its own
+        # thread — defining it is not acquiring it.
+        def drain():
+            with self._hb_lock:
+                pass
+        return drain
+
+    def closure_not_an_acquire(self):
+        with self._engine_lock:
+            self._starts_background()

@@ -1,0 +1,283 @@
+"""xlint — repo-aware static analysis for the invariants the perf work
+rests on.
+
+Each round of this project has re-discovered the same classes of defect
+at runtime (or on hardware, hours later): a jit boundary that silently
+re-grew per-call pool copies, a Pallas kernel using an API name the
+pinned Mosaic doesn't ship, a lock acquired against the rank table, an
+env gate that never made it into docs/FLAGS.md. The rules in
+``tools/xlint/rules.py`` prove those invariants over the source tree —
+stdlib ``ast`` only, no third-party deps — and tier-1 runs them on every
+test pass (``tests/test_xlint.py``).
+
+Usage::
+
+    python -m tools.xlint                 # lint xllm_service_tpu/
+    python -m tools.xlint --json          # machine-readable findings
+    python -m tools.xlint --rule lock-rank path/  # one rule, one subtree
+
+Exit status: 0 clean, 1 findings, 2 usage/config error.
+
+Vetted exceptions live in ``tools/xlint/allowlists/<rule>.txt``, one
+``<finding-key>  # justification`` per line. Every entry MUST carry a
+justification comment, and entries that no longer match any finding are
+themselves reported (stale-allowlist), so the lists can only shrink or
+stay honest. See docs/STATIC_ANALYSIS.md for the rule catalogue and the
+incidents that motivated each rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+ALLOWLIST_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "allowlists")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation.
+
+    ``key`` is the stable identity used for allowlisting — derived from
+    path + symbol, never from line numbers, so an unrelated edit above a
+    vetted exception can't silently un-vet it."""
+
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    key: str
+    message: str
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}" \
+               f"  (key: {self.key})"
+
+
+@dataclasses.dataclass
+class Module:
+    """A parsed source file."""
+
+    path: str          # repo-relative, posix separators
+    abspath: str
+    source: str
+    lines: List[str]
+    tree: ast.AST
+
+
+class RepoTree:
+    """The parsed file set one lint run sees."""
+
+    def __init__(self, modules: List[Module], root: str) -> None:
+        self.modules = modules
+        self.root = root
+        self._by_path = {m.path: m for m in modules}
+
+    def get(self, path: str) -> Optional[Module]:
+        return self._by_path.get(path)
+
+    def read_text(self, relpath: str) -> Optional[str]:
+        """Non-Python companion files (docs/FLAGS.md) resolved against
+        the repo root; None when absent."""
+        p = os.path.join(self.root, relpath)
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def covers_package(self, pkg: str = "xllm_service_tpu") -> bool:
+        """True when this run's scope includes the package top level —
+        scoped subtree runs (e.g. one service/ file) must not judge
+        whole-package properties (flag reverse-drift, allowlist
+        staleness)."""
+        prefix = pkg + "/"
+        return any(m.path.startswith(prefix) and m.path.count("/") == 1
+                   for m in self.modules)
+
+
+def _iter_py_files(path: str) -> Iterable[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__"
+                             and not d.startswith("."))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def load_tree(paths: Sequence[str], root: str = REPO_ROOT) -> \
+        Tuple[RepoTree, List[Finding]]:
+    """Parse every .py under ``paths``. Unparseable files become
+    findings (rule ``parse-error``) rather than crashes — a syntax error
+    anywhere must not blind the whole lint run."""
+    modules: List[Module] = []
+    errors: List[Finding] = []
+    for p in paths:
+        absp = p if os.path.isabs(p) else os.path.join(root, p)
+        for f in _iter_py_files(absp):
+            rel = os.path.relpath(f, root).replace(os.sep, "/")
+            try:
+                with open(f, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+                tree = ast.parse(src, filename=f)
+            except (OSError, SyntaxError, ValueError) as e:
+                errors.append(Finding(
+                    rule="parse-error", path=rel,
+                    line=getattr(e, "lineno", 0) or 0,
+                    key=f"{rel}::parse",
+                    message=f"cannot parse: {e}"))
+                continue
+            modules.append(Module(path=rel, abspath=f, source=src,
+                                  lines=src.splitlines(), tree=tree))
+    return RepoTree(modules, root), errors
+
+
+# ---------------------------------------------------------------------------
+# Allowlists
+# ---------------------------------------------------------------------------
+
+def load_allowlist(rule_name: str,
+                   allowlist_dir: str = ALLOWLIST_DIR
+                   ) -> Tuple[Dict[str, str], List[Finding]]:
+    """→ ({finding-key: justification}, config-error findings).
+
+    Format: one ``key  # justification`` per line; blank lines and
+    pure-comment lines ignored. An entry WITHOUT a justification is a
+    config error — a vetted exception nobody can explain isn't vetted."""
+    path = os.path.join(allowlist_dir, f"{rule_name}.txt")
+    entries: Dict[str, str] = {}
+    errors: List[Finding] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = f.readlines()
+    except OSError:
+        return entries, errors
+    rel = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+    for i, line in enumerate(raw, 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, comment = line.partition("#")
+        key = key.strip()
+        justification = comment.strip()
+        if not justification:
+            errors.append(Finding(
+                rule="allowlist", path=rel, line=i,
+                key=f"{rel}::L{i}",
+                message=f"allowlist entry {key!r} has no justification "
+                        f"comment — every vetted exception must say why"))
+            continue
+        entries[key] = justification
+    return entries, errors
+
+
+def apply_allowlist(findings: List[Finding], rule_name: str,
+                    allowlist_dir: str = ALLOWLIST_DIR,
+                    report_stale: bool = True) -> List[Finding]:
+    """Filter ``findings`` through the rule's allowlist; malformed and
+    STALE entries (matching nothing) come back as findings themselves.
+    ``report_stale=False`` for scoped runs — an entry whose finding
+    lives outside the linted subtree is not stale."""
+    entries, errors = load_allowlist(rule_name, allowlist_dir)
+    used = set()
+    kept: List[Finding] = []
+    for f in findings:
+        if f.key in entries:
+            used.add(f.key)
+        else:
+            kept.append(f)
+    rel = f"tools/xlint/allowlists/{rule_name}.txt"
+    if report_stale:
+        for key in entries:
+            if key not in used:
+                kept.append(Finding(
+                    rule="allowlist", path=rel, line=0,
+                    key=f"{rel}::{key}",
+                    message=f"stale allowlist entry {key!r} matches "
+                            f"no finding — remove it (the exception "
+                            f"no longer exists)"))
+    return kept + errors
+
+
+# ---------------------------------------------------------------------------
+# Runner / CLI
+# ---------------------------------------------------------------------------
+
+def run(paths: Sequence[str], rule_names: Optional[Sequence[str]] = None,
+        root: str = REPO_ROOT,
+        allowlist_dir: str = ALLOWLIST_DIR) -> List[Finding]:
+    """Lint ``paths`` with the selected rules (default: all)."""
+    from tools.xlint.rules import RULES
+    tree, findings = load_tree(paths, root=root)
+    selected = {r.name: r for r in RULES}
+    if rule_names:
+        unknown = [n for n in rule_names if n not in selected]
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {unknown}; "
+                f"available: {sorted(selected)}")
+        selected = {n: selected[n] for n in rule_names}
+    full_scope = tree.covers_package()
+    for rule in selected.values():
+        findings.extend(apply_allowlist(
+            rule.check(tree), rule.name, allowlist_dir,
+            report_stale=full_scope))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    from tools.xlint.rules import RULES
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.xlint",
+        description="repo-aware static analysis "
+                    "(see docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*", default=["xllm_service_tpu"],
+                    help="files/directories to lint "
+                         "(default: xllm_service_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON object instead of text lines")
+    ap.add_argument("--rule", action="append", dest="rules",
+                    metavar="NAME",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.name}: {r.describe}")
+        return 0
+
+    try:
+        findings = run(args.paths, rule_names=args.rules)
+    except ValueError as e:
+        print(f"xlint: {e}")
+        return 2
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "rules": [r.name for r in RULES
+                      if not args.rules or r.name in args.rules],
+            "clean": not findings,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"xlint: {len(findings)} finding(s)" if findings
+              else "xlint: clean")
+    return 1 if findings else 0

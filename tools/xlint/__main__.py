@@ -1,0 +1,13 @@
+import signal
+import sys
+
+from tools.xlint import main
+
+if __name__ == "__main__":
+    # Findings are often piped to head/grep — die quietly on SIGPIPE
+    # instead of tracebacking.
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    except (AttributeError, ValueError):
+        pass
+    sys.exit(main())

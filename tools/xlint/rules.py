@@ -1,0 +1,998 @@
+"""The six xlint rules. Each proves one invariant the serving/perf work
+depends on; docs/STATIC_ANALYSIS.md records the incident that motivated
+each. All analysis is stdlib ``ast`` — name/alias based, intentionally
+under-approximate: a rule must never crash on odd code, and a miss is a
+gap to close later, not a reason to over-report.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.xlint import Finding, Module, RepoTree
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _module_aliases(mod: Module) -> Dict[str, Set[str]]:
+    """Names bound at module level to modules we care about:
+    {"jax": {...}, "pltpu": {...}, "np": {...}, "functools": {...},
+    "time": {...}}."""
+    out: Dict[str, Set[str]] = {
+        "jax": set(), "pltpu": set(), "np": set(), "functools": set(),
+        "time": set()}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                if a.name == "jax":
+                    out["jax"].add(bound)
+                elif a.name == "jax.experimental.pallas.tpu":
+                    out["pltpu"].add(a.asname or a.name)
+                elif a.name == "numpy":
+                    out["np"].add(bound)
+                elif a.name == "functools":
+                    out["functools"].add(bound)
+                elif a.name == "time":
+                    out["time"].add(bound)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax.experimental.pallas":
+                for a in node.names:
+                    if a.name == "tpu":
+                        out["pltpu"].add(a.asname or a.name)
+    return out
+
+
+def _is_call_to(node: ast.Call, aliases: Set[str], attr: str) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == attr
+            and isinstance(f.value, ast.Name) and f.value.id in aliases)
+
+
+def _const_int_set(node: Optional[ast.AST]) -> Optional[Set[int]]:
+    """Literal int / tuple-of-ints → set; None when non-literal."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.add(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _qualname_of(stack: Sequence[ast.AST]) -> str:
+    parts = [n.name for n in stack
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef))]
+    return ".".join(parts) or "<module>"
+
+
+def _walk_same_scope(fndef: ast.AST):
+    """Walk a function body WITHOUT descending into nested function
+    definitions — a closure's body runs when the closure runs (often on
+    another thread), not when the enclosing function is called."""
+    work = list(ast.iter_child_nodes(fndef))
+    while work:
+        node = work.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            work.extend(ast.iter_child_nodes(node))
+
+
+class _ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the class/function nesting stack."""
+
+    def __init__(self) -> None:
+        self.stack: List[ast.AST] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+# ---------------------------------------------------------------------------
+# Rule 1: mosaic-compat
+# ---------------------------------------------------------------------------
+
+_COMPAT_MODULE = "xllm_service_tpu/ops/pallas/_compat.py"
+# API names whose spelling differs across the jax/Mosaic versions this
+# repo must run on (PR-1 regression: the pinned 0.4.x toolchain ships
+# TPUCompilerParams/TPUMemorySpace; current jax ships
+# CompilerParams/HBM). Only the one shim module may touch either
+# spelling directly.
+_PLTPU_FORBIDDEN = ("CompilerParams", "TPUCompilerParams", "HBM",
+                    "TPUMemorySpace")
+# jax.* surface that moved across the same versions (shard_map left
+# experimental and grew check_vma; set_mesh is new-API-only).
+_JAX_FORBIDDEN = ("shard_map", "set_mesh")
+_FORBIDDEN_FROM_IMPORTS = {
+    "jax.experimental.pallas.tpu": set(_PLTPU_FORBIDDEN),
+    "jax.experimental.shard_map": {"shard_map"},
+    "jax.experimental": {"shard_map"},
+    "jax": set(_JAX_FORBIDDEN),
+}
+
+
+class MosaicCompatRule:
+    name = "mosaic-compat"
+    describe = ("version-sensitive pallas/jax API names "
+                "(CompilerParams/HBM/shard_map/set_mesh) only via "
+                "ops/pallas/_compat.py")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in tree.modules:
+            if mod.path.endswith("ops/pallas/_compat.py"):
+                continue
+            aliases = _module_aliases(mod)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name):
+                    base = node.value.id
+                    sym = None
+                    if base in aliases["pltpu"] and \
+                            node.attr in _PLTPU_FORBIDDEN:
+                        sym = f"pltpu.{node.attr}"
+                    elif base in aliases["jax"] and \
+                            node.attr in _JAX_FORBIDDEN:
+                        sym = f"jax.{node.attr}"
+                    if sym:
+                        findings.append(Finding(
+                            rule=self.name, path=mod.path,
+                            line=node.lineno,
+                            key=f"{mod.path}::{sym}",
+                            message=f"direct {sym} — spell it via "
+                                    f"{_COMPAT_MODULE} so both Mosaic "
+                                    f"generations lower it"))
+                elif isinstance(node, ast.ImportFrom):
+                    banned = _FORBIDDEN_FROM_IMPORTS.get(
+                        node.module or "")
+                    if not banned:
+                        continue
+                    for a in node.names:
+                        if a.name in banned:
+                            sym = f"{node.module}.{a.name}"
+                            findings.append(Finding(
+                                rule=self.name, path=mod.path,
+                                line=node.lineno,
+                                key=f"{mod.path}::{sym}",
+                                message=f"direct import of {sym} — "
+                                        f"import the alias from "
+                                        f"{_COMPAT_MODULE} instead"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule 2: donation-coverage
+# ---------------------------------------------------------------------------
+
+# Parameter names that mean "this argument is a KV pool buffer" at the
+# runtime/ jit boundaries. A jit whose signature carries one of these
+# moves the pool across the host boundary every call: without donation
+# XLA materializes a pool-sized copy per call, and without a layout pin
+# (in_shardings/out_shardings, even best-effort via a **splat) layout
+# assignment can re-introduce full-pool conversion copies — the exact
+# regression tools/aot_copy_census.py caught in round 6.
+_KV_PARAM_NAMES = {"kv", "kv_pages", "k_pages", "v_pages", "kv_cache"}
+# Only the serving boundary is in scope: ops/ kernels also take
+# k_pages/v_pages but run INSIDE the engine's jitted step, where
+# donation is the outer jit's job (donating there would corrupt direct
+# kernel-test callers' buffers).
+_DONATION_SCOPE = ("runtime/",)
+
+
+def _positional_params(fndef: ast.AST) -> List[str]:
+    a = fndef.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+class DonationCoverageRule:
+    name = "donation-coverage"
+    describe = ("runtime/ jax.jit entry points carrying KV-pool arrays "
+                "must donate them and pin layouts")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        findings: List[Finding] = []
+        # Repo-wide function index for cross-module resolution (the
+        # worker jits functions imported from models/).
+        fn_index: Dict[str, List[ast.AST]] = {}
+        for mod in tree.modules:
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    fn_index.setdefault(node.name, []).append(node)
+        for mod in tree.modules:
+            if not any(s in mod.path for s in _DONATION_SCOPE):
+                continue
+            aliases = _module_aliases(mod)
+            local = {n.name: n for n in mod.tree.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))}
+            for site in self._jit_sites(mod, aliases):
+                findings.extend(self._check_site(
+                    mod, site, local, fn_index))
+        return findings
+
+    def _jit_sites(self, mod: Module, aliases) -> List[Tuple]:
+        """→ [(wrapped_expr, jit_keywords, lineno)] for every jax.jit
+        call — plain calls and functools.partial(jax.jit, ...)
+        decorators."""
+        sites = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    _is_call_to(node, aliases["jax"], "jit"):
+                wrapped = node.args[0] if node.args else None
+                sites.append((wrapped, node.keywords, node.lineno))
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and \
+                            _is_call_to(dec, aliases["functools"],
+                                        "partial") and dec.args and \
+                            isinstance(dec.args[0], ast.Attribute) and \
+                            dec.args[0].attr == "jit" and \
+                            isinstance(dec.args[0].value, ast.Name) and \
+                            dec.args[0].value.id in aliases["jax"]:
+                        sites.append((node, dec.keywords, node.lineno))
+                    elif isinstance(dec, ast.Attribute) and \
+                            dec.attr == "jit" and \
+                            isinstance(dec.value, ast.Name) and \
+                            dec.value.id in aliases["jax"]:
+                        # bare @jax.jit — no kwargs at all
+                        sites.append((node, [], node.lineno))
+        return sites
+
+    def _check_site(self, mod: Module, site, local, fn_index
+                    ) -> List[Finding]:
+        wrapped, keywords, lineno = site
+        fndef, n_bound = self._resolve(wrapped, local, fn_index, mod)
+        if fndef is None:
+            return []
+        params = _positional_params(fndef)[n_bound:]
+        kv_idx = [i for i, p in enumerate(params) if p in _KV_PARAM_NAMES]
+        if not kv_idx:
+            return []
+        name = getattr(fndef, "name", "<lambda>")
+        out: List[Finding] = []
+        kw = {k.arg: k.value for k in keywords if k.arg is not None}
+        has_splat = any(k.arg is None for k in keywords)
+        donated = _const_int_set(kw.get("donate_argnums"))
+        if "donate_argnums" in kw and donated is None:
+            # Present but not a literal int/tuple: this is exactly the
+            # site the rule exists for, so "can't verify" is a finding
+            # (mirrors the non-literal make_lock check), not a pass.
+            out.append(Finding(
+                rule=self.name, path=mod.path, line=lineno,
+                key=f"{mod.path}::{name}::donate-nonliteral",
+                message=f"jax.jit of {name} carries KV-pool args but "
+                        f"its donate_argnums is not a literal — the "
+                        f"static checker cannot verify pool coverage; "
+                        f"spell the indices inline"))
+        elif any(i not in (donated or ()) for i in kv_idx):
+            missing = [i for i in kv_idx if i not in (donated or ())]
+            out.append(Finding(
+                rule=self.name, path=mod.path, line=lineno,
+                key=f"{mod.path}::{name}::donate",
+                message=f"jax.jit of {name} carries KV-pool args at "
+                        f"positions {kv_idx} but donate_argnums "
+                        f"{'omits ' + str(missing) if donated is not None else 'is missing'}"
+                        f" — every call will pay a pool-sized copy"))
+        if not has_splat and "in_shardings" not in kw and \
+                "out_shardings" not in kw:
+            out.append(Finding(
+                rule=self.name, path=mod.path, line=lineno,
+                key=f"{mod.path}::{name}::layout-pin",
+                message=f"jax.jit of {name} carries KV-pool args but "
+                        f"pins no layouts (no in_/out_shardings and no "
+                        f"**pin splat) — layout assignment can "
+                        f"reintroduce full-pool conversion copies "
+                        f"(tools/aot_copy_census.py, round 6)"))
+        return out
+
+    def _resolve(self, wrapped, local, fn_index, mod
+                 ) -> Tuple[Optional[ast.AST], int]:
+        """→ (function def or lambda, count of partial-bound positional
+        args). None when the wrapped callable can't be resolved
+        statically."""
+        n_bound = 0
+        if isinstance(wrapped, ast.Call):
+            # functools.partial(fn, ...) — kwargs binding leaves
+            # positional indexes unchanged; positional binding shifts.
+            f = wrapped.func
+            is_partial = (isinstance(f, ast.Attribute)
+                          and f.attr == "partial") or \
+                         (isinstance(f, ast.Name) and f.id == "partial")
+            if is_partial and wrapped.args:
+                n_bound = len(wrapped.args) - 1
+                wrapped = wrapped.args[0]
+            else:
+                return None, 0
+        if isinstance(wrapped, ast.Lambda):
+            return wrapped, n_bound
+        if isinstance(wrapped, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return wrapped, n_bound
+        if isinstance(wrapped, ast.Name):
+            if wrapped.id in local:
+                return local[wrapped.id], n_bound
+            cands = fn_index.get(wrapped.id, [])
+            if len(cands) == 1:
+                return cands[0], n_bound
+        return None, 0
+
+
+# ---------------------------------------------------------------------------
+# Rule 3: lock-rank
+# ---------------------------------------------------------------------------
+
+# The canonical rank table. MUST stay in sync with the docstring table
+# in xllm_service_tpu/utils/locks.py — the declaration check below makes
+# an out-of-table make_lock a finding, so adding a lock means editing
+# both (that is the point: the table is reviewed, not accreted).
+LOCK_RANK_TABLE: Dict[str, int] = {
+    "worker.hb": 5,
+    "scheduler.req": 10,
+    "worker.live": 10,
+    "worker.engine": 20,
+    "instance_mgr": 30,
+    "kvcache_mgr": 35,
+    "coordination_net": 60,
+    "etcd.watches": 60,
+    "tracer": 90,
+    "http.stats": 90,
+    "misc.pool": 90,
+    "worker.vision": 90,
+    "misc.counter": 91,
+    "httpd.connpool": 92,
+    "hashing.native": 95,
+    "native_httpd.lib": 96,
+    "etcd_native.build": 97,
+}
+
+
+class LockRankRule:
+    name = "lock-rank"
+    describe = ("make_lock declarations match the rank table; nested "
+                "lock scopes acquire in strictly increasing rank")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        findings: List[Finding] = []
+        decls = self._collect_decls(tree, findings)
+        for mod in tree.modules:
+            self._check_nesting(mod, decls, findings)
+        return findings
+
+    def _collect_decls(self, tree: RepoTree, findings: List[Finding]
+                       ) -> Dict[Tuple[str, Optional[str], str],
+                                 Tuple[str, int, bool]]:
+        """(path, class, varname) → (lockname, rank, reentrant); also
+        validates each declaration against the canonical table."""
+        decls: Dict[Tuple[str, Optional[str], str],
+                    Tuple[str, int, bool]] = {}
+        for mod in tree.modules:
+            rule = self
+
+            class V(_ScopedVisitor):
+                def visit_Assign(self, node: ast.Assign) -> None:
+                    v = node.value
+                    if isinstance(v, ast.Call) and \
+                            isinstance(v.func, ast.Name) and \
+                            v.func.id in ("make_lock", "make_rlock"):
+                        rule._record_decl(mod, node, v,
+                                          self.stack, decls, findings)
+                    self.generic_visit(node)
+            V().visit(mod.tree)
+        return decls
+
+    def _record_decl(self, mod: Module, assign: ast.Assign,
+                     call: ast.Call, stack, decls,
+                     findings: List[Finding]) -> None:
+        args = call.args
+        if len(args) < 2 or not all(
+                isinstance(a, ast.Constant) for a in args[:2]):
+            findings.append(Finding(
+                rule=self.name, path=mod.path, line=call.lineno,
+                key=f"{mod.path}::make_lock-nonliteral",
+                message="make_lock/make_rlock with non-literal "
+                        "name/rank — the static checker (and any "
+                        "reader) can't verify it against the table"))
+            return
+        lockname, rank = args[0].value, args[1].value
+        reentrant = call.func.id == "make_rlock"
+        expect = LOCK_RANK_TABLE.get(lockname)
+        if expect is None:
+            findings.append(Finding(
+                rule=self.name, path=mod.path, line=call.lineno,
+                key=f"{mod.path}::{lockname}::undeclared",
+                message=f"lock {lockname!r} (rank {rank}) is not in "
+                        f"the rank table — add it to "
+                        f"tools/xlint/rules.py LOCK_RANK_TABLE and the "
+                        f"utils/locks.py docstring table"))
+        elif expect != rank:
+            findings.append(Finding(
+                rule=self.name, path=mod.path, line=call.lineno,
+                key=f"{mod.path}::{lockname}::rank-mismatch",
+                message=f"lock {lockname!r} declared rank {rank} but "
+                        f"the table says {expect}"))
+        cls = next((n.name for n in reversed(stack)
+                    if isinstance(n, ast.ClassDef)), None)
+        for t in assign.targets:
+            if isinstance(t, ast.Attribute):
+                decls[(mod.path, cls, t.attr)] = (lockname, rank,
+                                                  reentrant)
+            elif isinstance(t, ast.Name):
+                decls[(mod.path, None, t.id)] = (lockname, rank,
+                                                 reentrant)
+
+    @staticmethod
+    def _lock_of(path: str, cls: Optional[str], expr: ast.AST, decls
+                 ) -> Optional[Tuple[str, int, bool]]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self":
+            return decls.get((path, cls, expr.attr))
+        if isinstance(expr, ast.Name):
+            return decls.get((path, None, expr.id))
+        return None
+
+    def _check_nesting(self, mod: Module, decls,
+                       findings: List[Finding]) -> None:
+        rule = self
+        # First pass: per class, which locks does each method acquire
+        # lexically anywhere inside it (for the one-hop call check).
+        meth_acquires: Dict[Tuple[str, str], List[Tuple[str, int, bool]]]\
+            = {}
+        for cls in [n for n in ast.walk(mod.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            for m in [n for n in cls.body
+                      if isinstance(n, ast.FunctionDef)]:
+                acq = []
+                for w in _walk_same_scope(m):
+                    if isinstance(w, ast.With):
+                        for item in w.items:
+                            ent = self._lock_of(mod.path, cls.name,
+                                                item.context_expr, decls)
+                            if ent:
+                                acq.append(ent)
+                meth_acquires[(cls.name, m.name)] = acq
+
+        class V(_ScopedVisitor):
+            def __init__(self) -> None:
+                super().__init__()
+                self.held: List[Tuple[str, int, bool]] = []
+
+            def _cls(self) -> Optional[str]:
+                return next((n.name for n in reversed(self.stack)
+                             if isinstance(n, ast.ClassDef)), None)
+
+            def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+                # A new function body is a new acquisition scope: a
+                # nested def's body runs later, not under the
+                # lexically-enclosing with.
+                old = self.held
+                self.held = []
+                super().visit_FunctionDef(node)
+                self.held = old
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_With(self, node: ast.With) -> None:
+                added = 0
+                for item in node.items:
+                    ent = rule._lock_of(mod.path, self._cls(),
+                                        item.context_expr, decls)
+                    if ent is None:
+                        continue
+                    lockname, rank, reentrant = ent
+                    if self.held:
+                        top_name, top_rank, top_re = self.held[-1]
+                        same_reentrant = (reentrant and top_re
+                                          and lockname == top_name)
+                        if top_rank >= rank and not same_reentrant:
+                            findings.append(Finding(
+                                rule=rule.name, path=mod.path,
+                                line=node.lineno,
+                                key=f"{mod.path}::"
+                                    f"{_qualname_of(self.stack)}::"
+                                    f"{top_name}<{lockname}",
+                                message=f"acquires {lockname!r} (rank "
+                                        f"{rank}) while holding "
+                                        f"{top_name!r} (rank "
+                                        f"{top_rank}) — lock order "
+                                        f"must be strictly increasing "
+                                        f"(utils/locks.py)"))
+                    self.held.append(ent)
+                    added += 1
+                for s in node.body:
+                    self.visit(s)
+                for _ in range(added):
+                    self.held.pop()
+
+            def visit_Call(self, node: ast.Call) -> None:
+                # One-hop: calling a same-class method that itself
+                # acquires a rank ≤ the one we hold is the same
+                # inversion, one frame deeper.
+                f = node.func
+                if self.held and isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "self":
+                    cls = self._cls()
+                    top_name, top_rank, top_re = self.held[-1]
+                    for (lockname, rank, reentrant) in \
+                            meth_acquires.get((cls, f.attr), ()):
+                        same_re = (reentrant and top_re
+                                   and lockname == top_name)
+                        if top_rank >= rank and not same_re:
+                            findings.append(Finding(
+                                rule=rule.name, path=mod.path,
+                                line=node.lineno,
+                                key=f"{mod.path}::"
+                                    f"{_qualname_of(self.stack)}::"
+                                    f"call:{f.attr}::"
+                                    f"{top_name}<{lockname}",
+                                message=f"calls self.{f.attr}() — "
+                                        f"which acquires {lockname!r} "
+                                        f"(rank {rank}) — while "
+                                        f"holding {top_name!r} (rank "
+                                        f"{top_rank})"))
+                self.generic_visit(node)
+        V().visit(mod.tree)
+
+
+# ---------------------------------------------------------------------------
+# Rule 4: flag-registry
+# ---------------------------------------------------------------------------
+
+_FLAG_RE = re.compile(r"XLLM_[A-Z0-9_]+")
+_FLAGS_DOC = "docs/FLAGS.md"
+
+
+class FlagRegistryRule:
+    name = "flag-registry"
+    describe = ("every XLLM_* env read appears in docs/FLAGS.md (and "
+                "every documented flag is actually read)")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        findings: List[Finding] = []
+        reads: Dict[str, Tuple[str, int]] = {}
+        for mod in tree.modules:
+            for name, line in self._env_reads(mod):
+                reads.setdefault(name, (mod.path, line))
+        doc = tree.read_text(_FLAGS_DOC)
+        if doc is None:
+            findings.append(Finding(
+                rule=self.name, path=_FLAGS_DOC, line=0,
+                key=f"{_FLAGS_DOC}::missing",
+                message="docs/FLAGS.md not found — the flag registry "
+                        "has nowhere to live"))
+            return findings
+        documented = set(_FLAG_RE.findall(doc))
+        for name in sorted(set(reads) - documented):
+            path, line = reads[name]
+            findings.append(Finding(
+                rule=self.name, path=path, line=line,
+                key=f"flags::{name}",
+                message=f"env gate {name} is read here but absent from "
+                        f"docs/FLAGS.md — document it (semantics, "
+                        f"default, interaction)"))
+        # The reverse direction (documented-but-unread) is only sound
+        # when the lint scope covers the whole package — a subtree run
+        # (e.g. `--rule flag-registry xllm_service_tpu/service`) sees
+        # only that subtree's reads and would call every other
+        # documented flag stale.
+        if tree.covers_package():
+            for name in sorted(documented - set(reads)):
+                findings.append(Finding(
+                    rule=self.name, path=_FLAGS_DOC, line=0,
+                    key=f"docs::{name}",
+                    message=f"{name} is documented in docs/FLAGS.md "
+                            f"but never read by package code — stale "
+                            f"doc, or the read lives outside the "
+                            f"package (allowlist with the real "
+                            f"reader)"))
+        return findings
+
+    @staticmethod
+    def _env_reads(mod: Module) -> List[Tuple[str, int]]:
+        out: List[Tuple[str, int]] = []
+
+        def flag_const(node: ast.AST) -> Optional[str]:
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    _FLAG_RE.fullmatch(node.value):
+                return node.value
+            return None
+
+        def is_environ(node: ast.AST) -> bool:
+            return (isinstance(node, ast.Attribute)
+                    and node.attr == "environ") or \
+                   (isinstance(node, ast.Name) and node.id == "environ")
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                is_read = False
+                if isinstance(f, ast.Attribute):
+                    if f.attr in ("get", "setdefault", "pop") and \
+                            is_environ(f.value):
+                        is_read = True
+                    elif f.attr == "getenv":
+                        is_read = True
+                elif isinstance(f, ast.Name) and f.id == "getenv":
+                    is_read = True
+                if is_read and node.args:
+                    name = flag_const(node.args[0])
+                    if name:
+                        out.append((name, node.lineno))
+            elif isinstance(node, ast.Subscript) and \
+                    is_environ(node.value):
+                name = flag_const(node.slice)
+                if name:
+                    out.append((name, node.lineno))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Rule 5: traced-host-sync
+# ---------------------------------------------------------------------------
+
+# Files whose functions can end up inside a jit trace. A host sync
+# (.item(), np.asarray, device_get) inside a traced body either fails at
+# trace time on abstract values or — worse, under some transforms —
+# silently forces a device→host round trip per call.
+_TRACED_SCOPE = ("xllm_service_tpu/models/", "xllm_service_tpu/ops/",
+                 "xllm_service_tpu/runtime/engine.py")
+_NP_SYNC_FNS = {"asarray", "array", "asanyarray", "ascontiguousarray",
+                "copy"}
+# Params that are static (trace-time Python) by convention across this
+# codebase: configs/meshes, and the kernel wrappers' compile-time
+# scalars (they flow into static_argnames jit params — the wrappers
+# float()-normalize them so 0 vs 0.0 doesn't split the jit cache).
+# Casts of these are trace-time Python, not host syncs.
+_STATIC_PARAM_NAMES = {"cfg", "config", "mesh", "axis_name",
+                       "scale", "logits_soft_cap"}
+
+
+class TracedHostSyncRule:
+    name = "traced-host-sync"
+    describe = (".item()/np.asarray/device_get/host casts inside "
+                "jit- or scan-traced bodies in models/, ops/, engine")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        scoped = [m for m in tree.modules
+                  if any(m.path.startswith(s) or m.path == s.rstrip("/")
+                         for s in _TRACED_SCOPE)]
+        index = self._function_index(scoped)
+        roots = self._roots(scoped, index)
+        reachable = self._closure(roots, index, scoped)
+        findings: List[Finding] = []
+        for mod, fndef in reachable:
+            findings.extend(self._scan_traced(mod, fndef))
+        return findings
+
+    # -- call-graph construction ---------------------------------------
+    @staticmethod
+    def _function_index(scoped: List[Module]
+                        ) -> Dict[str, List[Tuple[Module, ast.AST]]]:
+        index: Dict[str, List[Tuple[Module, ast.AST]]] = {}
+        for mod in scoped:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    index.setdefault(node.name, []).append((mod, node))
+        return index
+
+    def _roots(self, scoped: List[Module], index
+               ) -> List[Tuple[Module, ast.AST]]:
+        roots: List[Tuple[Module, ast.AST]] = []
+        for mod in scoped:
+            aliases = _module_aliases(mod)
+            local = {n.name: n for n in ast.walk(mod.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))}
+
+            def resolve(expr) -> Optional[ast.AST]:
+                if isinstance(expr, ast.Call):   # functools.partial(f,…)
+                    f = expr.func
+                    if ((isinstance(f, ast.Attribute)
+                         and f.attr == "partial")
+                        or (isinstance(f, ast.Name)
+                            and f.id == "partial")) and expr.args:
+                        return resolve(expr.args[0])
+                    return None
+                if isinstance(expr, ast.Name):
+                    return local.get(expr.id)
+                if isinstance(expr, ast.Lambda):
+                    return expr
+                return None
+
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    # jax.jit(f) / jax.jit(partial(f, …))
+                    if _is_call_to(node, aliases["jax"], "jit") and \
+                            node.args:
+                        r = resolve(node.args[0])
+                        if r is not None:
+                            roots.append((mod, r))
+                    # jax.lax.scan(body, …) / lax.scan(body, …): the
+                    # body is traced wherever the scan call sits.
+                    f = node.func
+                    if isinstance(f, ast.Attribute) and \
+                            f.attr == "scan" and node.args:
+                        base = f.value
+                        is_lax = (isinstance(base, ast.Name)
+                                  and base.id == "lax") or \
+                                 (isinstance(base, ast.Attribute)
+                                  and base.attr == "lax")
+                        if is_lax:
+                            r = resolve(node.args[0])
+                            if r is not None:
+                                roots.append((mod, r))
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        is_jit = (isinstance(dec, ast.Attribute)
+                                  and dec.attr == "jit") or \
+                                 (isinstance(dec, ast.Call)
+                                  and isinstance(dec.func,
+                                                 ast.Attribute)
+                                  and dec.func.attr in ("jit",)
+                                  ) or \
+                                 (isinstance(dec, ast.Call)
+                                  and bool(dec.args)
+                                  and isinstance(dec.args[0],
+                                                 ast.Attribute)
+                                  and dec.args[0].attr == "jit")
+                        if is_jit:
+                            roots.append((mod, node))
+        return roots
+
+    def _closure(self, roots, index, scoped
+                 ) -> List[Tuple[Module, ast.AST]]:
+        seen: Set[int] = set()
+        out: List[Tuple[Module, ast.AST]] = []
+        work = list(roots)
+        while work:
+            mod, fndef = work.pop()
+            if id(fndef) in seen:
+                continue
+            seen.add(id(fndef))
+            out.append((mod, fndef))
+            # Edges: bare-name calls and module-attr calls whose
+            # terminal name uniquely resolves within the scoped set.
+            for node in ast.walk(fndef):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                callee = None
+                if isinstance(f, ast.Name):
+                    callee = f.id
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name):
+                    callee = f.attr
+                if callee is None:
+                    continue
+                cands = index.get(callee, [])
+                if len(cands) == 1:
+                    work.append(cands[0])
+        return out
+
+    @staticmethod
+    def _static_argnames(fndef: ast.AST) -> Set[str]:
+        """Params a jit decorator declares static (static_argnames=):
+        those are trace-time Python values, so host casts of them are
+        legitimate."""
+        out: Set[str] = set()
+        for dec in getattr(fndef, "decorator_list", ()):
+            if not isinstance(dec, ast.Call):
+                continue
+            for kw in dec.keywords:
+                if kw.arg == "static_argnames":
+                    v = kw.value
+                    if isinstance(v, (ast.Tuple, ast.List)):
+                        for el in v.elts:
+                            if isinstance(el, ast.Constant) and \
+                                    isinstance(el.value, str):
+                                out.add(el.value)
+                    elif isinstance(v, ast.Constant) and \
+                            isinstance(v.value, str):
+                        out.add(v.value)
+        return out
+
+    # -- the actual flags ----------------------------------------------
+    def _scan_traced(self, mod: Module, fndef: ast.AST
+                     ) -> List[Finding]:
+        findings: List[Finding] = []
+        aliases = _module_aliases(mod)
+        name = getattr(fndef, "name", "<lambda>")
+        a = fndef.args
+        traced_params = {p.arg for p in (*a.posonlyargs, *a.args)
+                         if p.arg not in _STATIC_PARAM_NAMES
+                         and p.arg != "self"}
+        traced_params -= self._static_argnames(fndef)
+
+        def emit(node, what, why) -> None:
+            findings.append(Finding(
+                rule=self.name, path=mod.path, line=node.lineno,
+                key=f"{mod.path}::{name}::{what}",
+                message=f"{what} inside traced body {name}() — {why}"))
+
+        for node in ast.walk(fndef):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in ("item", "tolist") and not node.args:
+                    emit(node, f".{f.attr}()",
+                         "forces a device→host sync per trace")
+                elif f.attr in _NP_SYNC_FNS and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in aliases["np"]:
+                    emit(node, f"np.{f.attr}",
+                         "numpy materialization of a traced value")
+                elif f.attr == "device_get" and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in aliases["jax"]:
+                    emit(node, "jax.device_get",
+                         "explicit device→host transfer")
+            elif isinstance(f, ast.Name) and \
+                    f.id in ("float", "int", "bool") and \
+                    len(node.args) == 1 and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in traced_params:
+                emit(node, f"{f.id}({node.args[0].id})",
+                     "host cast of a (potentially traced) argument")
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule 6: service-hygiene
+# ---------------------------------------------------------------------------
+
+# The httpd dispatch path: every function in these files runs on a
+# request thread unless it is a dedicated background-thread target.
+_SERVICE_FILES = (
+    "xllm_service_tpu/service/httpd.py",
+    "xllm_service_tpu/service/native_httpd.py",
+    "xllm_service_tpu/service/http_service.py",
+    "xllm_service_tpu/service/response_handler.py",
+    "xllm_service_tpu/service/rpc_service.py",
+)
+_BROAD_EXC = ("Exception", "BaseException")
+
+
+def _noqa_justified(comment: str) -> bool:
+    """True when the except line's comment carries a noqa AND a prose
+    justification beyond the bare code (``# noqa: BLE001`` alone is not
+    a justification — ``# noqa: BLE001 — close is best-effort`` is)."""
+    m = re.search(r"noqa\s*:?\s*([A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)?",
+                  comment)
+    if m is None:
+        return False
+    rest = comment[m.end():]
+    return len(re.findall(r"\w", rest)) >= 3
+
+
+class ServiceHygieneRule:
+    name = "service-hygiene"
+    describe = ("no blocking sleeps / unbounded .result() / "
+                "unjustified exception swallows on the httpd dispatch "
+                "path")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in tree.modules:
+            if mod.path not in _SERVICE_FILES:
+                continue
+            thread_targets = self._thread_targets(mod)
+            aliases = _module_aliases(mod)
+            rule = self
+
+            class V(_ScopedVisitor):
+                def _in_thread_target(self) -> bool:
+                    return any(
+                        isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                        and n.name in thread_targets
+                        for n in self.stack)
+
+                def visit_Call(self, node: ast.Call) -> None:
+                    f = node.func
+                    if isinstance(f, ast.Attribute):
+                        if f.attr == "sleep" and \
+                                isinstance(f.value, ast.Name) and \
+                                f.value.id in aliases["time"] and \
+                                not self._in_thread_target():
+                            findings.append(Finding(
+                                rule=rule.name, path=mod.path,
+                                line=node.lineno,
+                                key=f"{mod.path}::"
+                                    f"{_qualname_of(self.stack)}::"
+                                    f"sleep",
+                                message="time.sleep on the dispatch "
+                                        "path blocks a request thread "
+                                        "— use timeouts/events or a "
+                                        "background thread"))
+                        elif f.attr == "result" and not node.args and \
+                                not node.keywords and \
+                                not self._in_thread_target():
+                            findings.append(Finding(
+                                rule=rule.name, path=mod.path,
+                                line=node.lineno,
+                                key=f"{mod.path}::"
+                                    f"{_qualname_of(self.stack)}::"
+                                    f"result",
+                                message=".result() with no timeout on "
+                                        "the dispatch path — a wedged "
+                                        "future pins the thread "
+                                        "forever"))
+                    self.generic_visit(node)
+
+                def visit_ExceptHandler(self,
+                                        node: ast.ExceptHandler) -> None:
+                    broad = node.type is None or (
+                        isinstance(node.type, ast.Name)
+                        and node.type.id in _BROAD_EXC)
+                    swallows = all(isinstance(s, ast.Pass)
+                                   for s in node.body)
+                    if broad and swallows:
+                        line = mod.lines[node.lineno - 1] \
+                            if node.lineno <= len(mod.lines) else ""
+                        comment = line.partition("#")[2]
+                        if not _noqa_justified(comment):
+                            findings.append(Finding(
+                                rule=rule.name, path=mod.path,
+                                line=node.lineno,
+                                key=f"{mod.path}::"
+                                    f"{_qualname_of(self.stack)}::"
+                                    f"swallow",
+                                message="broad except swallowing all "
+                                        "errors with no justification "
+                                        "— narrow it, or annotate "
+                                        "'# noqa: BLE001 — <why this "
+                                        "is safe to drop>'"))
+                    self.generic_visit(node)
+            V().visit(mod.tree)
+        return findings
+
+    @staticmethod
+    def _thread_targets(mod: Module) -> Set[str]:
+        targets: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        v = kw.value
+                        if isinstance(v, ast.Attribute):
+                            targets.add(v.attr)
+                        elif isinstance(v, ast.Name):
+                            targets.add(v.id)
+        return targets
+
+
+RULES = [
+    MosaicCompatRule(),
+    DonationCoverageRule(),
+    LockRankRule(),
+    FlagRegistryRule(),
+    TracedHostSyncRule(),
+    ServiceHygieneRule(),
+]

@@ -17,3 +17,19 @@ CompilerParams = getattr(_pltpu, "CompilerParams", None) \
 # API: "leave the operand in HBM, the kernel DMAs it itself" (the V3
 # row kernel's manual double-buffered page fetch).
 HBM = getattr(_pltpu, "HBM", None) or _pltpu.TPUMemorySpace.ANY
+
+
+def shard_map_unchecked():
+    """The shard_map entry point with replication checking off, across
+    both API generations: current jax ships ``jax.shard_map`` with
+    ``check_vma=``; the pinned 0.4.x toolchain ships
+    ``jax.experimental.shard_map.shard_map`` with ``check_rep=``.
+    Returns a callable with the usual (f, mesh=..., in_specs=...,
+    out_specs=...) signature."""
+    import functools
+
+    import jax
+    if hasattr(jax, "shard_map"):
+        return functools.partial(jax.shard_map, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm  # noqa: E501 — the one sanctioned spelling site
+    return functools.partial(_sm, check_rep=False)

@@ -102,13 +102,11 @@ def ring_attention_sharded(mesh: Mesh, axis_name: str = "sp",
     grouping inside the block must stay aligned), lengths replicated."""
     qkv_spec = P(None, axis_name, head_axis, None)
 
-    # jax.shard_map(check_vma=) is the current API; the pinned-toolchain
-    # jax (<= 0.4.x) ships it as experimental.shard_map with check_rep=.
-    if hasattr(jax, "shard_map"):
-        smap = functools.partial(jax.shard_map, check_vma=False)
-    else:
-        from jax.experimental.shard_map import shard_map as _sm
-        smap = functools.partial(_sm, check_rep=False)
+    # shard_map spelling differs across the jax generations this repo
+    # runs on; the one sanctioned shim lives in ops/pallas/_compat.py
+    # (enforced by tools/xlint mosaic-compat).
+    from xllm_service_tpu.ops.pallas._compat import shard_map_unchecked
+    smap = shard_map_unchecked()
 
     @functools.partial(
         smap, mesh=mesh,

@@ -284,8 +284,8 @@ class HttpService:
                 done[0] = True
                 try:
                     body.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — the worker socket may
+                    pass            # already be dead; drop is the intent
                 self.scheduler.finish_request(req.service_request_id)
             resp_obj.on_close = on_close
             return resp_obj
@@ -446,6 +446,17 @@ class HttpService:
             f"xllm_service_is_master "
             f"{1 if self.scheduler.is_master else 0}",
         ]
+        # Keep-alive reuse pool: regressions show here as hit:miss
+        # decay / overflow growth before they show as service_bench
+        # latency. The pool is PROCESS-global (httpd._POOL), so the
+        # plane label marks the exporting process — in the normal
+        # separate-process deployment this is the service→worker
+        # transport; co-located planes (the test harness) export the
+        # same series under distinct labels instead of colliding.
+        from xllm_service_tpu.service.httpd import conn_pool_stats
+        for k, v in conn_pool_stats().items():
+            lines.append(f'xllm_http_conn_pool_{k}{{plane="service"}} '
+                         f'{v}')
         # Admission pressure (set by Master after server construction):
         # active slots + total 503-rejected per server.
         for srv_name, adm in (self.admissions or {}).items():

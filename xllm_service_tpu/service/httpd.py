@@ -253,13 +253,13 @@ class _RequestHandler(BaseHTTPRequestHandler):
             if resp.stream is not None and hasattr(resp.stream, "close"):
                 try:
                     resp.stream.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — best-effort cleanup;
+                    pass            # the response is already resolved
             if resp.on_close is not None:
                 try:
                     resp.on_close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — a failing finish hook
+                    pass            # must not poison this server thread
 
     def _write(self, resp: Response) -> None:
         self.send_response(resp.status)
@@ -392,6 +392,23 @@ class _ConnPool:
         self._idle: Dict[str, List[Tuple[HTTPConnection, float]]] = {}
         self._lock = make_lock("httpd.connpool", 92)
         self._last_sweep = 0.0
+        # Reuse counters (served at /metrics): a transport regression —
+        # peers closing keep-alives early, the idle window mistuned, the
+        # per-address cap too small under fan-out — shows up here as a
+        # falling hit:miss ratio or climbing overflow before it shows up
+        # as p50 latency in service_bench. Mutated under _lock.
+        self.hits_total = 0        # get() satisfied from the pool
+        self.misses_total = 0      # get() had to open a fresh TCP conn
+        self.overflow_total = 0    # put() dropped a conn (addr cap full)
+        self.expired_total = 0     # idle conns aged out (sweep or get)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits_total": self.hits_total,
+                    "misses_total": self.misses_total,
+                    "overflow_total": self.overflow_total,
+                    "expired_total": self.expired_total,
+                    "idle": sum(len(v) for v in self._idle.values())}
 
     def get(self, address: str, timeout: float
             ) -> Tuple[HTTPConnection, bool]:
@@ -408,6 +425,11 @@ class _ConnPool:
                     break
                 stale.append(cand)
             stale.extend(self._sweep_locked(now))
+            self.expired_total += len(stale)
+            if conn is not None:
+                self.hits_total += 1
+            else:
+                self.misses_total += 1
         for c in stale:
             c.close()
         if conn is not None:
@@ -425,7 +447,11 @@ class _ConnPool:
             if len(conns) < self._MAX_IDLE_PER_ADDR:
                 conns.append((conn, now))
                 conn = None
-            evicted.extend(self._sweep_locked(now))
+            else:
+                self.overflow_total += 1
+            swept = self._sweep_locked(now)
+            self.expired_total += len(swept)
+            evicted.extend(swept)
         if conn is not None:
             evicted.append(conn)
         for c in evicted:
@@ -453,6 +479,11 @@ class _ConnPool:
 
 
 _POOL = _ConnPool()
+
+
+def conn_pool_stats() -> Dict[str, int]:
+    """Process-wide keep-alive pool counters for /metrics exporters."""
+    return _POOL.stats()
 
 # Failures while SENDING on a reused socket — the request never reached
 # the peer whole, so one fresh-connection retry cannot double-execute it.

@@ -368,13 +368,13 @@ class NativeHttpServer:
             if resp.stream is not None and hasattr(resp.stream, "close"):
                 try:
                     resp.stream.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — best-effort cleanup;
+                    pass            # the C++ side already resolved rid
             if resp.on_close is not None:
                 try:
                     resp.on_close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — a failing finish hook
+                    pass            # must not poison the pool thread
 
     def _respond(self, rid: int, status: int, headers: Dict[str, str],
                  body: bytes) -> None:

@@ -21,13 +21,21 @@ increasing):
     50  (reserved: coordination store — uses a Condition-wrapped RLock,
          checked by its own single-class discipline, see coordination.py)
     60  coordination_net, etcd.watches  — store transports
-    90  leaves: tracer, http stats, fan-in pools, worker.vision
+    90  leaves: tracer, http.stats, misc.pool (fan-in), worker.vision
     91  misc.counter                    — may be bumped under any leaf
     92  httpd.connpool                  — guards the keep-alive dict only
     95  hashing.native                  — innermost (C call guard)
+    96  native_httpd.lib                — one-shot native-library load
+    97  etcd_native.build               — one-shot etcd-client build
 
 Production (env unset) pays zero overhead: ``make_lock`` returns plain
 ``threading.Lock``/``RLock``.
+
+This table is machine-checked: ``tools/xlint`` (rule ``lock-rank``)
+verifies every ``make_lock``/``make_rlock`` declaration against its
+mirror copy (``LOCK_RANK_TABLE`` in tools/xlint/rules.py) and statically
+rejects nested ``with``-lock scopes that acquire out of rank order —
+update BOTH tables when adding a lock.
 """
 
 from __future__ import annotations
