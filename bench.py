@@ -187,6 +187,7 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     import jax
 
     from xllm_service_tpu.config import EngineConfig, ModelConfig
+    from xllm_service_tpu.obs import default_registry, histogram_quantile
     from xllm_service_tpu.runtime.engine import Engine, EngineRequest
     from xllm_service_tpu.utils.types import SamplingParams
 
@@ -265,7 +266,18 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     # cache-served — detail.warmup_s vs boot_warm_s shows the split).
     boot_cold_s = time.monotonic() - t_boot0
 
+    # Per-request latency trajectory, recorded into the SAME
+    # service-plane histogram series (names + log buckets) the front
+    # door exports, then scraped back out of the rendered exposition
+    # with obs.histogram_quantile — the arithmetic a dashboard would
+    # run, so BENCH_*.json percentiles and /metrics cannot drift apart.
+    lat = default_registry()
+    h_ttft = lat.histogram("xllm_service_ttft_ms")
+    h_tpot = lat.histogram("xllm_service_tpot_ms")
+    h_queue = lat.histogram("xllm_service_queue_wait_ms")
+
     sp = SamplingParams(max_tokens=gen_len, temperature=0.0, ignore_eos=True)
+    t_add = {}
     for i in range(batch):
         # Distinct prompts: identical ones would prefix-cache-hit after
         # the first batch, silently benchmarking cache lookups instead of
@@ -276,13 +288,24 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             token_ids=[(i + j) % (cfg.vocab_size - 1) + 1
                        for j in range(prompt_len)],
             sampling=sp))
+        t_add[f"bench-{i}"] = time.monotonic()
     # Prefill outside the timed window: the metric is steady-state decode.
     # Still measured — prefill is the compute-bound phase, so its MFU shows
     # what the matmul path achieves when not weight-read-bound.
     _STAGE["name"] = "prefill"
     tp0 = time.monotonic()
     while engine.waiting:
-        engine.step()
+        t_step = time.monotonic()
+        step_outs = engine.step()
+        now = time.monotonic()
+        for out in step_outs:
+            # First output of a request = its first sampled token:
+            # TTFT from submission; queue wait = time spent waiting for
+            # the step that scheduled its prefill to begin.
+            ta = t_add.pop(out.request_id, None)
+            if ta is not None:
+                h_ttft.observe(1000.0 * (now - ta))
+                h_queue.observe(1000.0 * (t_step - ta))
     prefill_s = time.monotonic() - tp0
     prefill_tokens = batch * prompt_len
 
@@ -290,9 +313,22 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     t0 = time.monotonic()
     tokens = 0
     while engine.has_work():
-        for out in engine.step():
+        t_step = time.monotonic()
+        step_outs = engine.step()
+        step_el = time.monotonic() - t_step
+        for out in step_outs:
             tokens += len(out.new_token_ids)
+            if out.new_token_ids:
+                # Per-token latency of this sequence in this step; a
+                # fused burst amortizes one step across N tokens.
+                h_tpot.observe(1000.0 * step_el / len(out.new_token_ids))
     elapsed = time.monotonic() - t0
+
+    lat_scrape = lat.render()
+
+    def _q(family: str, q: float):
+        v = histogram_quantile(lat_scrape, family, q)
+        return round(v, 3) if v is not None else None
 
     # "No routed request ever pays a compile", proven per round: the
     # post-warmup recompile counters after the measured run, and the
@@ -370,6 +406,16 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             "boot_warm_s": round(boot_warm_s, 2),
             "recompiles_post_warmup": recompiles_post_warmup,
             "tpot_ms": round(tpot_ms, 3),
+            # Latency trajectory, scraped from the service-plane
+            # histogram series recorded above (log-bucket interpolated
+            # — dashboard-faithful, not exact order statistics).
+            "ttft_ms_p50": _q("xllm_service_ttft_ms", 0.50),
+            "ttft_ms_p90": _q("xllm_service_ttft_ms", 0.90),
+            "ttft_ms_p99": _q("xllm_service_ttft_ms", 0.99),
+            "tpot_ms_p50": _q("xllm_service_tpot_ms", 0.50),
+            "tpot_ms_p90": _q("xllm_service_tpot_ms", 0.90),
+            "tpot_ms_p99": _q("xllm_service_tpot_ms", 0.99),
+            "queue_wait_ms_p99": _q("xllm_service_queue_wait_ms", 0.99),
             "mfu": round(mfu, 4) if mfu is not None else None,
             "prefill_tokens_per_s": round(prefill_tokens / prefill_s, 1),
             # Prefill runs the lm_head only on the LAST position per

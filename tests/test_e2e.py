@@ -191,6 +191,88 @@ class TestEndToEnd:
                 if line.startswith('xllm_http_conn_pool_misses_total'
                                    '{plane="service"}'))
             assert misses >= 1     # at least one fresh TCP connect
+
+            # Exposition-format gate on BOTH planes: every line parses
+            # and every histogram is internally consistent (_bucket
+            # cumulative-monotone, _count == +Inf bucket, _sum present).
+            from xllm_service_tpu.obs import validate_exposition
+            for plane, text in (("service", mtext), ("worker", wtext)):
+                errs = validate_exposition(text)
+                assert errs == [], f"{plane} /metrics invalid: {errs}"
+            # The request latency histograms recorded the completion
+            # (non-stream: TTFT is worker-side only, but queue-wait and
+            # end-to-end are always observable at the front door).
+            assert "xllm_service_queue_wait_ms_bucket" in mtext
+            assert "xllm_service_e2e_ms_count" in mtext
+            # Engine step-loop flush split occupancy prefill vs decode.
+            assert ('xllm_worker_step_tokens_total'
+                    '{model="tiny",phase="prefill"}') in wtext
+            assert ('xllm_worker_step_tokens_total'
+                    '{model="tiny",phase="decode"}') in wtext
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
+    def test_request_span_timeline_cross_plane(self, store):
+        """Stream a chat completion, then pull its merged span timeline
+        from /admin/trace/<id>: the full service-plane stage sequence
+        plus the worker-side stages (shipped on the heartbeat path)
+        under the SAME correlation id the service stamped on the
+        forwarded request (x-xllm-request-id)."""
+        import http.client
+        master, workers = make_cluster(store)
+        try:
+            payloads = list(iter_sse_events(http_stream(
+                "POST", master.http_address, "/v1/chat/completions",
+                {"model": "tiny",
+                 "messages": [{"role": "user", "content": "trace me"}],
+                 "max_tokens": 3, "temperature": 0.0, "stream": True,
+                 "ignore_eos": True}, timeout=120.0)))
+            assert payloads[-1] == "[DONE]"
+            srid = json.loads(payloads[0])["id"]
+
+            def fetch_span():
+                conn = http.client.HTTPConnection(master.http_address,
+                                                  timeout=10)
+                conn.request("GET", f"/admin/trace/{srid}")
+                r = conn.getresponse()
+                body = r.read().decode()
+                conn.close()
+                return (json.loads(body) if r.status == 200 else None)
+
+            # Worker stages arrive on the next heartbeat (0.2s cadence).
+            def worker_merged():
+                span = fetch_span()
+                return span is not None and any(
+                    e["plane"] == "worker" for e in span["events"])
+            assert wait_until(worker_merged, timeout=15.0), \
+                "worker span stages never merged into the service trace"
+
+            span = fetch_span()
+            assert span["request_id"] == srid
+            stages = {(e["plane"], e["stage"]) for e in span["events"]}
+            for st in ("received", "admitted", "scheduled", "dispatched",
+                       "first_token", "finished"):
+                assert ("service", st) in stages, (st, sorted(stages))
+            for st in ("received", "scheduled", "first_token",
+                       "finished"):
+                assert ("worker", st) in stages, (st, sorted(stages))
+            # The worker read the service's correlation header and
+            # logged its span under that exact id.
+            assert span["attrs"]["worker"]["correlation_header"] == srid
+            # Events are wall-clock ordered; per-plane monotonic stamps
+            # order that plane's own stages.
+            svc = [e["stage"] for e in span["events"]
+                   if e["plane"] == "service"]
+            assert svc.index("received") < svc.index("first_token") \
+                < svc.index("finished")
+            # Unknown ids 404 instead of fabricating a timeline.
+            conn = http.client.HTTPConnection(master.http_address,
+                                              timeout=10)
+            conn.request("GET", "/admin/trace/no-such-request")
+            assert conn.getresponse().status == 404
+            conn.close()
         finally:
             for w in workers:
                 w.stop()

@@ -1,0 +1,296 @@
+"""obs/ — the metrics registry, exposition format, and span timelines.
+
+Unit layer of the PR-3 observability subsystem: the e2e layer
+(tests/test_e2e.py) validates both planes' live /metrics against the
+same ``validate_exposition`` used here and pulls a streamed request's
+merged span timeline through ``/admin/trace/<id>``.
+"""
+
+import threading
+
+import pytest
+
+from xllm_service_tpu.obs import (
+    DEFAULT_LATENCY_BUCKETS_MS, Registry, SpanStore, histogram_quantile,
+    parse_exposition, validate_exposition)
+
+
+class TestRegistry:
+    def test_counter_inc_and_render(self):
+        r = Registry()
+        c = r.counter("xllm_t_total", "help text", labelnames=("k",))
+        c.inc(k="a")
+        c.inc(2, k="a")
+        c.inc(k="b")
+        assert c.value(k="a") == 3
+        text = r.render()
+        assert '# TYPE xllm_t_total counter' in text
+        assert 'xllm_t_total{k="a"} 3' in text
+        assert 'xllm_t_total{k="b"} 1' in text
+
+    def test_counter_rejects_negative(self):
+        c = Registry().counter("xllm_t_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_label_set_must_match_declaration(self):
+        r = Registry()
+        g = r.gauge("xllm_g", labelnames=("model",))
+        with pytest.raises(ValueError):
+            g.set(1)                        # missing label
+        with pytest.raises(ValueError):
+            g.set(1, model="m", extra="x")  # extra label
+
+    def test_redeclaration_conflicts_raise(self):
+        r = Registry()
+        r.counter("xllm_t_total")
+        with pytest.raises(ValueError):
+            r.gauge("xllm_t_total")         # kind conflict
+        with pytest.raises(ValueError):
+            r.counter("xllm_t_total", labelnames=("k",))  # label conflict
+        # Idempotent get-or-create returns the same family.
+        assert r.counter("xllm_t_total") is r.counter("xllm_t_total")
+        # Histogram bucket edges are part of the series shape too.
+        h = r.histogram("xllm_h", buckets=(1, 2, 3))
+        with pytest.raises(ValueError):
+            r.histogram("xllm_h", buckets=(1, 2, 4))
+        assert r.histogram("xllm_h") is h   # buckets omitted: no conflict
+
+    def test_gauge_clear_rebuild(self):
+        r = Registry()
+        g = r.gauge("xllm_g", labelnames=("instance",))
+        g.set(1, instance="a")
+        g.set(2, instance="b")
+        g.clear()
+        g.set(3, instance="c")
+        text = r.render()
+        assert 'instance="a"' not in text
+        assert 'xllm_g{instance="c"} 3' in text
+
+    def test_int_value_formatting(self):
+        """Existing consumers substring-match 'name 1' — integral floats
+        must render without a trailing .0."""
+        r = Registry()
+        r.gauge("xllm_g").set(1.0)
+        assert "xllm_g 1\n" in r.render()
+
+    def test_label_escaping_roundtrip(self):
+        r = Registry()
+        nasty = 'a"b\\c\nd'
+        r.gauge("xllm_g", labelnames=("k",)).set(1, k=nasty)
+        text = r.render()
+        samples, _t, errors = parse_exposition(text)
+        assert errors == []
+        assert any(s[1].get("k") == nasty for s in samples)
+
+    def test_histogram_exposition_is_consistent(self):
+        r = Registry()
+        h = r.histogram("xllm_lat_ms", labelnames=("phase",))
+        for v in (0.5, 3, 3, 40, 700, 1e6):   # incl. a +Inf-bucket sample
+            h.observe(v, phase="p")
+        text = r.render()
+        assert validate_exposition(text) == []
+        samples, _t, _e = parse_exposition(text)
+        count = next(v for n, lbl, v in samples
+                     if n == "xllm_lat_ms_count")
+        assert count == 6
+
+    def test_histogram_quantile_interpolation(self):
+        r = Registry()
+        h = r.histogram("xllm_lat_ms", buckets=(10, 100, 1000))
+        for _ in range(99):
+            h.observe(50)       # all in (10, 100]
+        h.observe(999)
+        # p50 interpolates inside the (10, 100] bucket.
+        q50 = h.quantile(0.5)
+        assert 10 < q50 <= 100
+        assert h.quantile(1.0) == 1000
+        # Scrape-side quantile agrees with the in-memory one.
+        assert histogram_quantile(r.render(), "xllm_lat_ms", 0.5) \
+            == pytest.approx(q50)
+
+    def test_quantile_empty_is_none(self):
+        h = Registry().histogram("xllm_lat_ms")
+        assert h.quantile(0.5) is None
+
+    def test_default_buckets_are_log_spaced_increasing(self):
+        bs = DEFAULT_LATENCY_BUCKETS_MS
+        assert list(bs) == sorted(bs)
+        assert all(b2 / b1 >= 2.0 for b1, b2 in zip(bs, bs[1:]))
+
+    def test_thread_safety_counts_every_inc(self):
+        r = Registry()
+        c = r.counter("xllm_t_total")
+        h = r.histogram("xllm_lat_ms")
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+                h.observe(5.0)
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == 8000
+        assert h.count() == 8000
+
+
+class TestExpfmt:
+    def test_bad_lines_are_errors_not_crashes(self):
+        text = ("xllm_ok 1\n"
+                "not a sample line at all !!\n"
+                'xllm_bad{unclosed="x 1\n'
+                "xllm_nan_value abc\n")
+        samples, _t, errors = parse_exposition(text)
+        assert [s[0] for s in samples] == ["xllm_ok"]
+        assert len(errors) == 3
+
+    def test_histogram_inconsistencies_detected(self):
+        # Non-monotone buckets, _count != +Inf, missing _sum.
+        text = ("# TYPE xllm_h histogram\n"
+                'xllm_h_bucket{le="10"} 5\n'
+                'xllm_h_bucket{le="100"} 3\n'
+                'xllm_h_bucket{le="+Inf"} 9\n'
+                "xllm_h_count 7\n")
+        errs = validate_exposition(text)
+        assert any("not monotone" in e for e in errs)
+        assert any("_count" in e for e in errs)
+        assert any("_sum" in e for e in errs)
+
+    def test_missing_inf_bucket_detected(self):
+        text = ('xllm_h_bucket{le="10"} 5\n'
+                "xllm_h_count 5\nxllm_h_sum 1\n")
+        assert any("+Inf" in e for e in validate_exposition(text))
+
+
+class TestSpanStore:
+    def test_record_is_idempotent_per_stage_and_plane(self):
+        s = SpanStore()
+        s.record("r", "received", t_mono=1.0)
+        s.record("r", "received", t_mono=9.0)     # retry path: ignored
+        span = s.get("r")
+        assert len(span["events"]) == 1
+        assert span["events"][0]["t_mono"] == 1.0
+        # Same stage from ANOTHER plane is a distinct event.
+        s.record("r", "received", plane="worker")
+        assert len(s.get("r")["events"]) == 2
+
+    def test_ring_evicts_oldest(self):
+        s = SpanStore(capacity=2)
+        for rid in ("a", "b", "c"):
+            s.record(rid, "received")
+        assert s.get("a") is None
+        assert s.get("b") is not None and s.get("c") is not None
+        assert len(s) == 2
+
+    def test_evicted_finished_marks_are_discarded(self):
+        """The service plane records 'finished' but never drains —
+        eviction must clear the finished mark too or the queue leaks
+        one id per request forever."""
+        s = SpanStore(capacity=2)
+        for rid in ("a", "b", "c"):
+            s.record(rid, "finished")
+        assert sorted(b["request_id"]
+                      for b in s.drain_finished()) == ["b", "c"]
+        assert s.drain_finished() == []
+
+    def test_interval_ms(self):
+        s = SpanStore()
+        s.record("r", "received", t_mono=1.0)
+        s.record("r", "first_token", t_mono=1.25)
+        assert s.interval_ms("r", "received", "first_token") \
+            == pytest.approx(250.0)
+        assert s.interval_ms("r", "received", "finished") is None
+
+    def test_merge_remote_dedupes_by_source_and_keeps_attrs(self):
+        s = SpanStore()
+        s.record("r", "received")
+        events = [{"stage": "first_token", "t_wall": 5.0, "t_mono": 1.0}]
+        s.merge_remote("r", "worker", events, source="w:1",
+                       attrs={"correlation_header": "r"})
+        s.merge_remote("r", "worker", events, source="w:1")   # duplicate
+        s.merge_remote("r", "worker", events, source="w:2")   # distinct
+        span = s.get("r")
+        worker_events = [e for e in span["events"]
+                         if e["plane"] == "worker"]
+        assert len(worker_events) == 2
+        assert span["attrs"]["worker"]["correlation_header"] == "r"
+
+    def test_drain_finished_and_requeue(self):
+        s = SpanStore()
+        s.record("r", "received")
+        assert s.drain_finished() == []        # not finished yet
+        s.record("r", "finished")
+        batch = s.drain_finished()
+        assert [b["request_id"] for b in batch] == ["r"]
+        assert s.get("r") is None              # exported, off the ring
+        s.requeue(batch)                       # failed ship comes back
+        assert s.get("r") is not None
+        assert [b["request_id"]
+                for b in s.drain_finished()] == ["r"]
+
+    def test_get_events_sorted_by_wall_clock(self):
+        s = SpanStore()
+        s.record("r", "finished", t_wall=10.0)
+        s.merge_remote("r", "worker",
+                       [{"stage": "first_token", "t_wall": 4.0}])
+        stages = [e["stage"] for e in s.get("r")["events"]]
+        assert stages == ["first_token", "finished"]
+
+
+class TestTracerSatellite:
+    """RequestTracer: size-capped rotation + the close()/trace() race."""
+
+    def test_default_path_is_jsonl(self):
+        from xllm_service_tpu.config import ServiceOptions
+        from xllm_service_tpu.service.tracer import RequestTracer
+        assert RequestTracer().path.endswith(".jsonl")
+        assert ServiceOptions().trace_path.endswith(".jsonl")
+
+    def test_rotation_caps_file_size(self, tmp_path, monkeypatch):
+        import json
+        import os
+        from xllm_service_tpu.service.tracer import RequestTracer
+        monkeypatch.setenv("XLLM_TRACE_MAX_BYTES", "500")
+        path = str(tmp_path / "t.jsonl")
+        tr = RequestTracer(path, enable=True)
+        for i in range(100):
+            tr.trace(f"r{i}", {"stage": "ingress", "pad": "x" * 50})
+        tr.close()
+        assert os.path.exists(path + ".1"), "never rotated"
+        # Live file stays under one cap (absent if the final write
+        # landed exactly on a rotation); rotated file holds whole lines.
+        if os.path.exists(path):
+            assert os.path.getsize(path) <= 500 + 200
+        with open(path + ".1", encoding="utf-8") as f:
+            for line in f:
+                json.loads(line)
+
+    def test_unbounded_by_default(self, tmp_path, monkeypatch):
+        import os
+        from xllm_service_tpu.service.tracer import RequestTracer
+        monkeypatch.delenv("XLLM_TRACE_MAX_BYTES", raising=False)
+        path = str(tmp_path / "t.jsonl")
+        tr = RequestTracer(path, enable=True)
+        for i in range(50):
+            tr.trace("r", {"pad": "x" * 100})
+        tr.close()
+        assert not os.path.exists(path + ".1")
+        assert os.path.getsize(path) > 5000
+
+    def test_late_trace_after_close_does_not_reopen(self, tmp_path):
+        import os
+        from xllm_service_tpu.service.tracer import RequestTracer
+        path = str(tmp_path / "t.jsonl")
+        tr = RequestTracer(path, enable=True)
+        tr.trace("r", {"stage": "ingress"})
+        tr.close()
+        size = os.path.getsize(path)
+        tr.trace("r", {"stage": "late-egress"})   # the race: dropped
+        assert tr._f is None
+        assert os.path.getsize(path) == size
+        tr.reopen()                               # explicit re-arm works
+        tr.trace("r", {"stage": "after-reopen"})
+        tr.close()
+        assert os.path.getsize(path) > size

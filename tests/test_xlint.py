@@ -148,6 +148,14 @@ class TestPositiveControls:
         assert f"{p}::Handler.dispatch::result" in keys
         assert f"{p}::Handler.dispatch::swallow" in keys
 
+    def test_metrics_registry_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "metrics-registry")
+        p = "xllm_service_tpu/service/bad_metrics.py"
+        assert f"{p}::render_metrics::xllm_fixture_requests_total" in keys
+        assert f"{p}::render_metrics::xllm_fixture_load" in keys
+        # Interpolated name fragments still resolve to a stable key.
+        assert f"{p}::render_metrics::xllm_fixture_*" in keys
+
 
 class TestNoFalsePositives:
     def test_clean_fixture_is_clean(self):

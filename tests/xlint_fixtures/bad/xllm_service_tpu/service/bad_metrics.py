@@ -1,0 +1,15 @@
+"""metrics-registry positive controls: hand-rolled Prometheus
+exposition f-strings outside xllm_service_tpu/obs/. Each shape below
+mirrors a line the pre-registry /metrics handlers actually built."""
+
+
+def render_metrics(requests_total, model, load, k, v):
+    lines = [
+        # Bare name + interpolated value.
+        f"xllm_fixture_requests_total {requests_total}",
+        # Labeled series (escaped braces) + value.
+        f'xllm_fixture_load{{model="{model}"}} {load}',
+    ]
+    # Interpolated name fragment (the worker's load-metrics loop shape).
+    lines.append(f'xllm_fixture_{k}{{model="{model}"}} {v}')
+    return "\n".join(lines)

@@ -1,0 +1,6 @@
+"""Pin the metrics-registry skip: exposition f-strings are legal inside
+xllm_service_tpu/obs/ — it is the one module allowed to build them."""
+
+
+def render_sample(value):
+    return f'xllm_fixture_obs_total{{plane="obs"}} {value}'

@@ -1,0 +1,15 @@
+"""metrics-registry near-misses that must NOT fire: xllm_-prefixed
+f-strings that are not exposition sample lines."""
+
+
+def near_misses(k, err, count):
+    # Name-only f-string: a registry key, no value after whitespace.
+    family = f"xllm_fixture_{k}"
+    # Log message: prose follows the name, not an interpolated value.
+    msg = f"xllm_fixture worker died: {err}"
+    # Value interpolation NOT preceded by a name{...}+whitespace shape.
+    kv = f"{k}={count}"
+    # A plain (non-f) constant is out of the rule's documented scope:
+    # it carries no interpolated value, so it cannot be a live series.
+    static = "xllm_fixture_static_gauge 1"
+    return family, msg, kv, static

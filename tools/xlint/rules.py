@@ -357,11 +357,12 @@ LOCK_RANK_TABLE: Dict[str, int] = {
     "coordination_net": 60,
     "etcd.watches": 60,
     "tracer": 90,
-    "http.stats": 90,
     "misc.pool": 90,
     "worker.vision": 90,
     "misc.counter": 91,
     "httpd.connpool": 92,
+    "obs.registry": 93,
+    "obs.spans": 94,
     "hashing.native": 95,
     "native_httpd.lib": 96,
     "etcd_native.build": 97,
@@ -988,6 +989,62 @@ class ServiceHygieneRule:
         return targets
 
 
+# ---------------------------------------------------------------------------
+# Rule 7: metrics-registry
+# ---------------------------------------------------------------------------
+
+_OBS_DIR = "xllm_service_tpu/obs/"
+# A hand-rolled Prometheus sample line inside an f-string: an xllm_-
+# prefixed series name (this repo's namespace; interpolated fragments
+# allowed — \x00 marks each FormattedValue in the template), an optional
+# {label} section, whitespace, then an interpolated value. Name-only
+# f-strings (registry keys like f"xllm_worker_{k}") carry no value
+# interpolation after whitespace and do not match.
+_EXPO_RE = re.compile(
+    r"(?:^|[^A-Za-z0-9_:])"
+    r"(xllm_[A-Za-z0-9_:\x00]*)"
+    r"(?:\{[^{}]*\})?"
+    r"[ \t]+\x00")
+
+
+class MetricsRegistryRule:
+    name = "metrics-registry"
+    describe = ("no hand-rolled Prometheus exposition f-strings "
+                "('name{...} value') outside xllm_service_tpu/obs/ — "
+                "every /metrics line renders via the obs registry")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        findings: List[Finding] = []
+        rule = self
+        for mod in tree.modules:
+            if mod.path.startswith(_OBS_DIR):
+                continue        # the one place exposition may be built
+
+            class V(_ScopedVisitor):
+                def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+                    template = "".join(
+                        part.value
+                        if isinstance(part, ast.Constant)
+                        and isinstance(part.value, str) else "\x00"
+                        for part in node.values)
+                    m = _EXPO_RE.search(template)
+                    if m is not None:
+                        series = m.group(1).replace("\x00", "*")
+                        findings.append(Finding(
+                            rule=rule.name, path=mod.path,
+                            line=node.lineno,
+                            key=f"{mod.path}::"
+                                f"{_qualname_of(self.stack)}::{series}",
+                            message=f"hand-rolled exposition line for "
+                                    f"{series!r} — record it through "
+                                    f"the obs registry (Counter/Gauge/"
+                                    f"Histogram) and render /metrics "
+                                    f"from Registry.render() instead"))
+                    self.generic_visit(node)
+            V().visit(mod.tree)
+        return findings
+
+
 RULES = [
     MosaicCompatRule(),
     DonationCoverageRule(),
@@ -995,4 +1052,5 @@ RULES = [
     FlagRegistryRule(),
     TracedHostSyncRule(),
     ServiceHygieneRule(),
+    MetricsRegistryRule(),
 ]

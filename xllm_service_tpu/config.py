@@ -54,7 +54,8 @@ class ServiceOptions:
     model_id: str = ""
 
     enable_request_trace: bool = False
-    trace_path: str = "trace/trace.json"
+    # .jsonl: the file has always been JSON Lines (one record per line).
+    trace_path: str = "trace/trace.jsonl"
     enable_decode_response_to_service: bool = False
 
     # SLO routing thresholds (hot-reloadable in the reference,

@@ -1,0 +1,25 @@
+"""obs — the observability core: metrics registry + request spans.
+
+Dependency-free (stdlib only), thread-safe, shared by both planes:
+
+- ``metrics``: Counter / Gauge / Histogram families with label sets,
+  log-spaced latency buckets, Prometheus text exposition. Every
+  ``/metrics`` line in this repo renders through a ``Registry``
+  (enforced by the ``metrics-registry`` xlint rule).
+- ``expfmt``: the read side — exposition parsing, structural histogram
+  validation (tier-1 tests), and ``histogram_quantile`` (bench.py's
+  latency percentiles).
+- ``spans``: per-request stage timelines in a bounded ring, merged
+  across the service/worker boundary by correlation id and served at
+  ``GET /admin/trace/<request_id>``.
+
+See docs/OBSERVABILITY.md for the full series and stage catalogue.
+"""
+
+from xllm_service_tpu.obs.expfmt import (           # noqa: F401
+    histogram_quantile, parse_exposition, validate_exposition)
+from xllm_service_tpu.obs.metrics import (          # noqa: F401
+    DEFAULT_LATENCY_BUCKETS_MS, Counter, Gauge, Histogram, Registry,
+    default_registry)
+from xllm_service_tpu.obs.spans import (            # noqa: F401
+    REQUEST_ID_HEADER, SERVICE_STAGES, WORKER_STAGES, SpanStore)

@@ -1,0 +1,183 @@
+"""Per-request span timelines: where did this request's latency go?
+
+One span per ``service_request_id``: an ordered list of stage events,
+each stamped with the recording process's monotonic clock (interval
+arithmetic within a plane) and wall clock (cross-plane ordering — the
+service and worker monotonic clocks share no epoch). The service plane
+records received → admitted → scheduled → dispatched → first_token →
+finished; the worker records its own received → scheduled →
+first_token → finished under the SAME correlation id (propagated as the
+``x-xllm-request-id`` header on the forwarded request) and ships
+finished spans back on the heartbeat path, where the service merges
+them in with ``plane="worker"``. The merged timeline is queryable at
+``GET /admin/trace/<request_id>`` on the service plane.
+
+Storage is a bounded ring: the oldest span is evicted when ``capacity``
+is exceeded, so tracing is always on without growing without bound
+(size the ring via ``XLLM_SPAN_RING`` at the call site that builds the
+store). Thread-safe; rank ``obs.spans`` in the utils/locks.py table.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, List, Optional
+
+from xllm_service_tpu.utils.locks import make_lock
+
+# Canonical service-plane stage order (docs/OBSERVABILITY.md); extra
+# stages (e.g. "redispatch"/"redispatched") may interleave — the first
+# occurrence per (stage, plane) wins (see record()).
+SERVICE_STAGES = ("received", "admitted", "scheduled", "dispatched",
+                  "first_token", "finished")
+WORKER_STAGES = ("received", "scheduled", "first_token", "finished")
+
+DEFAULT_CAPACITY = 2048
+
+# The correlation header the service stamps on every forwarded request;
+# the worker tags its span stages with this id (defined here, not in
+# http_service, so the worker doesn't import the whole service plane
+# for one constant).
+REQUEST_ID_HEADER = "x-xllm-request-id"
+
+
+class SpanStore:
+    """Ring buffer of span timelines keyed by correlation id."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self.capacity = max(1, int(capacity))
+        self._lock = make_lock("obs.spans", 94)
+        # rid → {"request_id", "attrs", "events": [event...]}; insertion
+        # order is eviction order.
+        self._spans: "collections.OrderedDict[str, Dict[str, Any]]" = \
+            collections.OrderedDict()
+        # drain_finished queue. Always ⊆ the ring's keys (eviction
+        # discards the mark too) so a plane that never drains — the
+        # service, which drains nothing; only workers export — stays
+        # bounded by ``capacity`` instead of leaking one id per request.
+        self._finished: set = set()
+
+    # -- recording ------------------------------------------------------
+    def _span_locked(self, rid: str) -> Dict[str, Any]:
+        span = self._spans.get(rid)
+        if span is None:
+            span = {"request_id": rid, "attrs": {}, "events": []}
+            self._spans[rid] = span
+            while len(self._spans) > self.capacity:
+                old_rid, _old = self._spans.popitem(last=False)
+                self._finished.discard(old_rid)
+        return span
+
+    def annotate(self, rid: str, **attrs: Any) -> None:
+        with self._lock:
+            self._span_locked(rid)["attrs"].update(attrs)
+
+    def record(self, rid: str, stage: str, plane: str = "service",
+               t_mono: Optional[float] = None,
+               t_wall: Optional[float] = None, **attrs: Any) -> None:
+        """Record one stage event. Idempotent per (stage, plane): retry
+        paths (redispatch, on_close backstops) may reach the same stage
+        twice, and the FIRST occurrence is the truthful timestamp."""
+        event = {"stage": stage, "plane": plane,
+                 "t_mono": time.monotonic() if t_mono is None else t_mono,
+                 "t_wall": time.time() if t_wall is None else t_wall}
+        event.update(attrs)
+        with self._lock:
+            span = self._span_locked(rid)
+            if any(e["stage"] == stage and e["plane"] == plane
+                   for e in span["events"]):
+                return
+            span["events"].append(event)
+            if stage == "finished":
+                self._finished.add(rid)
+
+    def merge_remote(self, rid: str, plane: str,
+                     events: List[Dict[str, Any]],
+                     source: str = "",
+                     attrs: Optional[Dict[str, Any]] = None) -> None:
+        """Fold another plane's exported events into this store (the
+        heartbeat merge path). Remote monotonic stamps are meaningful
+        only relative to each other; the wall stamps order them against
+        local stages. Remote attrs land under ``attrs[<plane>]`` so the
+        worker's view (e.g. the correlation header it actually read)
+        never clobbers local keys."""
+        with self._lock:
+            span = self._span_locked(rid)
+            if attrs:
+                span["attrs"].setdefault(plane, {}).update(attrs)
+            for e in events:
+                ev = dict(e)
+                ev["plane"] = plane
+                if source:
+                    ev.setdefault("source", source)
+                if any(x["stage"] == ev.get("stage")
+                       and x["plane"] == plane
+                       and x.get("source") == ev.get("source")
+                       for x in span["events"]):
+                    continue
+                span["events"].append(ev)
+
+    # -- querying -------------------------------------------------------
+    def get(self, rid: str) -> Optional[Dict[str, Any]]:
+        """A deep-enough copy of one span, events sorted by wall clock
+        (cross-plane safe; stable for same-stamp events)."""
+        with self._lock:
+            span = self._spans.get(rid)
+            if span is None:
+                return None
+            events = [dict(e) for e in span["events"]]
+            attrs = dict(span["attrs"])
+        events.sort(key=lambda e: e.get("t_wall", 0.0))
+        return {"request_id": rid, "attrs": attrs, "events": events}
+
+    def interval_ms(self, rid: str, a: str, b: str,
+                    plane: str = "service") -> Optional[float]:
+        """Monotonic-clock interval between two stages recorded by the
+        SAME plane (None when either is missing)."""
+        with self._lock:
+            span = self._spans.get(rid)
+            if span is None:
+                return None
+            ts = {e["stage"]: e["t_mono"] for e in span["events"]
+                  if e["plane"] == plane and "t_mono" in e}
+        if a not in ts or b not in ts:
+            return None
+        return 1000.0 * (ts[b] - ts[a])
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    # -- worker-side export (heartbeat path) ----------------------------
+    def drain_finished(self) -> List[Dict[str, Any]]:
+        """Pop every span that reached ``finished`` since the last
+        drain, removing them from the ring (the exporter owns them now).
+        On a failed ship, hand the batch back via ``requeue``."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            rids, self._finished = sorted(self._finished), set()
+            for rid in rids:
+                span = self._spans.pop(rid, None)
+                if span is not None:
+                    out.append({"request_id": rid,
+                                "attrs": dict(span["attrs"]),
+                                "events": [dict(e)
+                                           for e in span["events"]]})
+        return out
+
+    def requeue(self, drained: List[Dict[str, Any]]) -> None:
+        """Return an undeliverable drained batch so the next heartbeat
+        retries it (ring bounds still apply)."""
+        with self._lock:
+            for rec in drained:
+                rid = rec["request_id"]
+                if rid in self._spans:
+                    continue
+                self._spans[rid] = {"request_id": rid,
+                                    "attrs": dict(rec.get("attrs", {})),
+                                    "events": list(rec.get("events", []))}
+                self._finished.add(rid)
+                while len(self._spans) > self.capacity:
+                    evicted_rid, _ = self._spans.popitem(last=False)
+                    self._finished.discard(evicted_rid)

@@ -326,6 +326,11 @@ class Engine:
         self._bias: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
 
         self.step_count = 0
+        # What the LAST step() iteration did — the worker's obs flush
+        # reads these right after step() returns (same thread) to split
+        # batch token occupancy prefill vs decode on /metrics.
+        self.last_step_kind = "idle"          # "prefill"|"decode"|"idle"
+        self.last_step_tokens = 0
         self.num_preemptions = 0
         # MoE capacity-drop accounting (VERDICT r2 weak #4: drops must be
         # visible). Monotonic per-engine counter of (token, expert)
@@ -697,7 +702,15 @@ class Engine:
         outs = self._drain_cancelled()
         with self._phase("sched"):
             batch = self._schedule_prefill()
+        self.last_step_kind = ("prefill" if batch
+                               else "decode" if self.running else "idle")
+        self.last_step_tokens = 0
+        pre = len(outs)
         if batch:
+            # Occupancy is the PROMPT tokens this batch computes (the
+            # scheduled windows), not the one sampled token per window.
+            self.last_step_tokens = sum(
+                self._next_window(s, s.num_computed) for s in batch)
             outs.extend(self._run_prefill(batch))
         elif self.running:
             N = self.ecfg.decode_steps
@@ -712,6 +725,8 @@ class Engine:
                 outs.extend(self._run_decode_multi())
             else:
                 outs.extend(self._run_decode())
+            self.last_step_tokens = sum(
+                len(o.new_token_ids) for o in outs[pre:])
         return outs
 
     def _drain_cancelled(self) -> List[StepOutput]:

@@ -43,6 +43,7 @@ from xllm_service_tpu.config import (
     EngineConfig, InstanceType, ModelConfig)
 from xllm_service_tpu.nlp.tokenizer import (
     IncrementalDecoder, Tokenizer, TokenizerFactory)
+from xllm_service_tpu.obs import REQUEST_ID_HEADER, Registry, SpanStore
 from xllm_service_tpu.runtime.engine import Engine, EngineRequest, StepOutput
 from xllm_service_tpu.service.coordination import (
     KEY_MASTER_ADDR, CoordinationStore, instance_prefix)
@@ -458,6 +459,15 @@ class Worker:
         self._work_event = threading.Event()
         self._stop = threading.Event()
         self._latency = LatencyMetrics()
+        # Per-worker observability: metrics registry + span ring. Per
+        # WORKER, not process-global — the test harness co-locates
+        # several workers serving the same model name in one process,
+        # and model-labeled series must not collide across them
+        # (obs/metrics.py module docstring). The engine loop flushes
+        # step-level stats here each iteration; /metrics renders it.
+        self.obs = Registry()
+        self.spans = SpanStore(capacity=int(os.environ.get(
+            "XLLM_SPAN_RING", "2048")))
         # Serializes heartbeat BUILD+SEND: without it a pre-drain
         # heartbeat still in flight can land after the drain heartbeat
         # and re-mark the models awake at the router.
@@ -799,9 +809,69 @@ class Worker:
                     outs = eng.step()
                 step_ms = 1000.0 * (time.monotonic() - t0)
                 self._dispatch_outputs(rt, outs, step_ms)
+                self._flush_engine_obs(rt, step_ms)
             if not busy:
                 self._work_event.wait(timeout=0.05)
                 self._work_event.clear()
+
+    def _flush_engine_obs(self, rt: ModelRuntime, step_ms: float) -> None:
+        """Per-iteration flush of step-level engine stats into the
+        registry: queue depths / KV utilization / preemptions (via
+        ``_engine_load``, the single load_metrics assembly point), batch
+        token occupancy split prefill vs decode, per-step wall time, and
+        the phase/recompile ledger. Runs on the engine-loop thread right
+        after ``step()`` — ``last_step_*`` are only written there."""
+        eng = rt.engine
+        if eng is None:
+            return
+        self._engine_load(rt)
+        kind = eng.last_step_kind
+        if kind == "idle":
+            return
+        m = rt.model
+        self.obs.counter(
+            "xllm_worker_steps_total",
+            "engine iterations by phase",
+            labelnames=("model", "phase")).inc(1, model=m, phase=kind)
+        self.obs.counter(
+            "xllm_worker_step_tokens_total",
+            "batch token occupancy: prompt tokens computed (prefill) / "
+            "tokens sampled (decode)",
+            labelnames=("model", "phase")).inc(
+            eng.last_step_tokens, model=m, phase=kind)
+        self.obs.histogram(
+            "xllm_worker_step_ms", "wall time of one engine step",
+            labelnames=("model", "phase")).observe(
+            step_ms, model=m, phase=kind)
+        self._flush_phase_ledger(rt)
+
+    def _flush_phase_ledger(self, rt: ModelRuntime) -> None:
+        """Mirror the engine's phase wall-time ledger + post-warmup
+        recompile counters into the registry (same series /metrics
+        always exported; now they update every iteration too)."""
+        eng = rt.engine
+        if eng is None:
+            return
+        m = rt.model
+        c_secs = self.obs.counter(
+            "xllm_worker_phase_seconds_total",
+            "host-side wall time per engine phase",
+            labelnames=("model", "phase"))
+        c_calls = self.obs.counter(
+            "xllm_worker_phase_calls_total",
+            labelnames=("model", "phase"))
+        c_rec = self.obs.counter(
+            "xllm_worker_recompiles_total",
+            "post-warmup compiles per program (0 is the contract)",
+            labelnames=("model", "program"))
+        for name, entry in eng.phase_report().items():
+            if isinstance(entry, dict):
+                c_secs.set_total(entry["total_ms"] / 1e3,
+                                 model=m, phase=name)
+                c_calls.set_total(entry["calls"], model=m, phase=name)
+            else:   # "<prog>.recompile" counters
+                c_rec.set_total(entry, model=m,
+                                program=name.rsplit(".", 1)[0])
 
     def _dispatch_outputs(self, rt: ModelRuntime,
                           outs: List[StepOutput], step_ms: float) -> None:
@@ -818,9 +888,18 @@ class Worker:
                 live.first_out_time = now
                 self._latency.recent_max_ttft_ms = max(
                     self._latency.recent_max_ttft_ms, step_ms)
+                self.spans.record(live.service_request_id, "first_token",
+                                  plane="worker", t_mono=now)
             else:
                 self._latency.recent_max_tbt_ms = max(
                     self._latency.recent_max_tbt_ms, step_ms)
+            if out.finished:
+                # Engine-level finish (length/eos/cancel). The span goes
+                # onto the heartbeat export queue here; consumer-side
+                # finishes (stop strings) surface as the CANCELLED out
+                # the engine emits after the consumer cancels.
+                self.spans.record(live.service_request_id, "finished",
+                                  plane="worker", t_mono=now)
             if live.stream_to_service:
                 to_service.extend(self._process_step_output(live, out))
                 if out.finished or live.choices[
@@ -1187,12 +1266,28 @@ class Worker:
     def _serve_generate(self, req: Request, is_chat: bool) -> Response:
         return self._guarded(self._serve_generate_inner, req, is_chat)
 
+    def _ingress_span(self, srid: str, t_recv: float,
+                      headers: Dict[str, str]) -> None:
+        """Open this worker's side of the request span under the SAME
+        correlation id the service used (the ``x-xllm-request-id``
+        header it stamped on the forward; the body's
+        ``service_request_id`` is the fallback for direct-to-worker
+        callers). Ships back on the heartbeat once finished."""
+        corr = headers.get(REQUEST_ID_HEADER, "")
+        if corr:
+            self.spans.annotate(srid, correlation_header=corr)
+        self.spans.record(srid, "received", plane="worker", t_mono=t_recv)
+
     def _serve_generate_inner(self, req: Request,
                               is_chat: bool) -> Response:
+        t_recv = time.monotonic()
         try:
             body = req.json()
         except Exception:  # noqa: BLE001
             return Response.error(400, "invalid JSON body")
+        srid_hint = body.get("service_request_id") or ""
+        if srid_hint:
+            self._ingress_span(srid_hint, t_recv, req.headers)
         routing = body.get("routing") or {}
         sp_body = body.get("sampling") or {}
         try:
@@ -1230,6 +1325,11 @@ class Worker:
             live = self._parse_generate(body, is_chat)
         except (TypeError, ValueError, RuntimeError) as e:
             return Response.error(400, str(e))
+        if not srid_hint:   # direct-to-worker: srid minted in the parse
+            self._ingress_span(live.service_request_id, t_recv,
+                               req.headers)
+        self.spans.record(live.service_request_id, "scheduled",
+                          plane="worker")
         if live.stream_to_service:
             # Topology 2: tokens flow worker → service RPC fan-in; the
             # relay response is a plain ack (rpc_service/service.h:67-79).
@@ -1303,66 +1403,54 @@ class Worker:
                      for m, rt in self.runtimes.items()]})
 
     def _serve_metrics(self, req: Request) -> Response:
-        lines = []
-        for m, rt in self.runtimes.items():
+        """Refresh scrape-time mirrors, render the registry. Series
+        names are unchanged from the hand-assembled exporter this
+        replaced (the metrics-registry xlint rule keeps every line
+        flowing through xllm_service_tpu/obs/)."""
+        obs = self.obs
+        for _m, rt in self.runtimes.items():
             if rt.engine is None:
                 continue
-            lm = rt.engine.load_metrics()
-            for k, v in lm.items():
-                lines.append(
-                    f'xllm_worker_{k}{{model="{m}"}} {v}')
-            # Per-phase step-time attribution (pack / dispatch / readback /
-            # post per program) + post-warmup recompile counters — the
-            # same ledger bench.py surfaces, live per serving worker.
-            for name, entry in rt.engine.phase_report().items():
-                if isinstance(entry, dict):
-                    lines.append(
-                        f'xllm_worker_phase_seconds_total'
-                        f'{{model="{m}",phase="{name}"}} '
-                        f'{entry["total_ms"] / 1e3:.6f}')
-                    lines.append(
-                        f'xllm_worker_phase_calls_total'
-                        f'{{model="{m}",phase="{name}"}} {entry["calls"]}')
-                else:   # "<prog>.recompile" counters
-                    program = name.rsplit(".", 1)[0]
-                    lines.append(
-                        f'xllm_worker_recompiles_total'
-                        f'{{model="{m}",program="{program}"}} {entry}')
+            # Queue depths / KV utilization / preemptions + the
+            # per-phase step-time attribution (pack / dispatch /
+            # readback per program) and post-warmup recompile counters
+            # — the same ledger bench.py surfaces, live per worker.
+            self._engine_load(rt)
+            self._flush_phase_ledger(rt)
         # Keep-alive reuse pool, labeled with the exporting plane (the
         # pool is process-global — see the service-side exporter note).
         # In the separate-process deployment this is the worker→service
         # fan-in transport.
-        from xllm_service_tpu.service.httpd import conn_pool_stats
-        for k, v in conn_pool_stats().items():
-            lines.append(f'xllm_http_conn_pool_{k}{{plane="worker"}} '
-                         f'{v}')
-        lines.append(f"xllm_worker_encode_seconds_total "
-                     f"{self.encode_seconds:.6f}")
-        lines.append(f"xllm_worker_encode_calls_total {self.encode_calls}")
-        lines.append(f"xllm_worker_encode_images_total "
-                     f"{self.encode_images_total}")
-        lines.append(f"xllm_worker_kv_migration_bytes_total "
-                     f"{self.kv_migration_bytes}")
-        lines.append(f"xllm_worker_kv_migration_seconds_total "
-                     f"{self.kv_migration_seconds:.6f}")
-        lines.append(f"xllm_worker_kv_migration_direct_total "
-                     f"{self.kv_migration_direct}")
-        lines.append(f"xllm_worker_kv_migration_device_wire_total "
-                     f"{self.kv_migration_device_wire}")
-        lines.append(f"xllm_worker_kv_migration_chunked_total "
-                     f"{self.kv_migration_chunked}")
+        from xllm_service_tpu.service.httpd import flush_conn_pool_metrics
+        flush_conn_pool_metrics(obs, plane="worker")
+        obs.counter("xllm_worker_encode_seconds_total").set_total(
+            self.encode_seconds)
+        obs.counter("xllm_worker_encode_calls_total").set_total(
+            self.encode_calls)
+        obs.counter("xllm_worker_encode_images_total").set_total(
+            self.encode_images_total)
+        obs.counter("xllm_worker_kv_migration_bytes_total").set_total(
+            self.kv_migration_bytes)
+        obs.counter("xllm_worker_kv_migration_seconds_total").set_total(
+            self.kv_migration_seconds)
+        obs.counter("xllm_worker_kv_migration_direct_total").set_total(
+            self.kv_migration_direct)
+        obs.counter(
+            "xllm_worker_kv_migration_device_wire_total").set_total(
+            self.kv_migration_device_wire)
+        obs.counter("xllm_worker_kv_migration_chunked_total").set_total(
+            self.kv_migration_chunked)
         from xllm_service_tpu.runtime.kv_wire import peek_device_wire
         wire = peek_device_wire()
         if wire is not None:
-            lines.append(f"xllm_worker_kv_wire_staged "
-                         f"{wire.staged_count()}")
-            lines.append(f"xllm_worker_kv_wire_leaked_total "
-                         f"{wire.leaked}")
+            obs.gauge("xllm_worker_kv_wire_staged").set(
+                wire.staged_count())
+            obs.counter("xllm_worker_kv_wire_leaked_total").set_total(
+                wire.leaked)
         if self.kv_migration_seconds > 0:
-            lines.append(
-                f"xllm_worker_kv_migration_gbps "
-                f"{self.kv_migration_bytes / self.kv_migration_seconds / 1e9:.4f}")
-        return Response(body="\n".join(lines).encode() + b"\n",
+            obs.gauge("xllm_worker_kv_migration_gbps").set(
+                self.kv_migration_bytes / self.kv_migration_seconds / 1e9)
+        return Response(body=obs.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
     def _serve_sleep(self, req: Request) -> Response:
@@ -1649,6 +1737,7 @@ class Worker:
             return Response.error(400, str(e))
         rt = self.runtimes.get(live.model) or self.primary_runtime()
         srid = live.service_request_id
+        self.spans.record(srid, "scheduled", plane="worker")
         try:
             first = live.q.get(
                 timeout=self.opts.request_timeout_s)   # prefill StepOutput
@@ -2338,6 +2427,11 @@ class Worker:
             self._drop_live(srid)
             return False, None, None, rt
         self._work_event.set()
+        # Decode-side span: a migrated sequence is received+scheduled in
+        # one adoption; merged at the service alongside the prefill
+        # worker's stages (distinct heartbeat source).
+        self.spans.record(srid, "received", plane="worker")
+        self.spans.record(srid, "scheduled", plane="worker")
         return True, live, first_out, rt
 
     def _serve_kv_import(self, req: Request) -> Response:
@@ -2519,6 +2613,27 @@ class Worker:
         with self._hb_lock:
             return self._send_heartbeat_locked()
 
+    def _engine_load(self, rt: ModelRuntime) -> LoadMetrics:
+        """THE single assembly point of ``engine.load_metrics()`` — the
+        heartbeat, ``/metrics``, and the per-step registry flush all go
+        through here (two hand-assembled copies used to live at the
+        heartbeat and /metrics sites and could drift). Mirrors every
+        load key into the registry as ``xllm_worker_<key>{model=...}``
+        and returns the heartbeat's ``LoadMetrics``."""
+        eng = rt.engine
+        if eng is None:
+            return LoadMetrics()
+        lm = eng.load_metrics()
+        for k, v in lm.items():
+            self.obs.gauge(f"xllm_worker_{k}",
+                           labelnames=("model",)).set(v, model=rt.model)
+        return LoadMetrics(
+            waiting_requests=lm["waiting_requests"],
+            running_requests=lm["running_requests"],
+            kv_cache_usage=lm["kv_cache_usage"],
+            num_preemptions=lm["num_preemptions"],
+            moe_dropped_tokens=lm.get("moe_dropped_tokens", 0))
+
     def _send_heartbeat_locked(self) -> bool:
         rt = self.primary_runtime()
         load = LoadMetrics()
@@ -2528,25 +2643,29 @@ class Worker:
             m: (MODEL_DRAINING if self._draining else r.state)
             for m, r in self.runtimes.items()}
         if rt.engine is not None:
-            lm = rt.engine.load_metrics()
-            load = LoadMetrics(
-                waiting_requests=lm["waiting_requests"],
-                running_requests=lm["running_requests"],
-                kv_cache_usage=lm["kv_cache_usage"],
-                num_preemptions=lm["num_preemptions"],
-                moe_dropped_tokens=lm.get("moe_dropped_tokens", 0))
+            load = self._engine_load(rt)
             ev = rt.engine.drain_kvcache_event()
             stored = [h.hex() for h in ev.stored]
             removed = [h.hex() for h in ev.removed]
+        # Finished request spans ride the heartbeat to the service's
+        # span ring (same correlation id); an undelivered batch is
+        # requeued so the next beat retries it.
+        span_batch = self.spans.drain_finished()
         hb = Heartbeat(
             name=self.name, instance_type=self.instance_type,
             load=load, latency=self._latency,
             cache_stored=stored, cache_removed=removed,
-            model_states=model_states)
+            model_states=model_states, spans=span_batch)
         self._latency = LatencyMetrics()
-        status, _ = http_json("POST", self.service_addr,
-                              "/rpc/heartbeat", stamp(hb.to_json()),
-                              timeout=10.0)
+        try:
+            status, _ = http_json("POST", self.service_addr,
+                                  "/rpc/heartbeat", stamp(hb.to_json()),
+                                  timeout=10.0)
+        except Exception:
+            self.spans.requeue(span_batch)
+            raise
+        if status != 200:
+            self.spans.requeue(span_batch)
         return status == 200
 
     def heartbeat_once(self) -> None:

@@ -24,13 +24,14 @@ from __future__ import annotations
 import json
 import logging
 import math
+import os
 import queue
-import threading
-
+import time
 
 from typing import Any, Dict, Iterator, List, Optional
 
 from xllm_service_tpu.config import ServiceOptions
+from xllm_service_tpu.obs import REQUEST_ID_HEADER, Registry, SpanStore
 from xllm_service_tpu.service.httpd import (
     Request, Response, Router, http_json, http_stream_status)
 from xllm_service_tpu.service.instance_types import RequestPhase
@@ -42,9 +43,77 @@ from xllm_service_tpu.utils.misc import short_uuid
 from xllm_service_tpu.utils.types import (
     FinishReason, Request as SchedRequest, RequestOutput,
     parse_openai_sampling, validate_sampling)
-from xllm_service_tpu.utils.locks import make_lock
 
 logger = logging.getLogger(__name__)
+
+
+class _RequestObs:
+    """Per-request latency/span bookkeeping on the front door.
+
+    One instance rides each completion request through its response
+    path; every method is idempotent (retry paths and on_close backstops
+    may reach the same milestone twice) and the FIRST occurrence is the
+    truthful timestamp. TPOT in the relay-stream topology is a
+    frame-interval approximation (the relay never parses tokens out of
+    the proxied bytes — see docs/OBSERVABILITY.md)."""
+
+    __slots__ = ("svc", "srid", "t0", "t_first", "tokens", "_done",
+                 "_dispatched")
+
+    def __init__(self, svc: "HttpService", srid: str, kind: str,
+                 model: str) -> None:
+        self.svc = svc
+        self.srid = srid
+        self.t0 = time.monotonic()
+        self.t_first = 0.0
+        self.tokens = 0
+        self._done = False
+        self._dispatched = False
+        svc.spans.annotate(srid, kind=kind, model=model)
+        svc.spans.record(srid, "received", t_mono=self.t0)
+
+    def stage(self, stage: str, **attrs: Any) -> None:
+        self.svc.spans.record(self.srid, stage, **attrs)
+
+    def dispatched(self, target: str) -> None:
+        now = time.monotonic()
+        if self._dispatched:
+            # Redispatch attempt: the first dispatch keeps the
+            # queue-wait truth, but the instance that actually serves
+            # the request must be visible in the trace (at most one
+            # redispatch per request by design).
+            self.svc.spans.record(self.srid, "redispatched", t_mono=now,
+                                  target=target)
+            return
+        self._dispatched = True
+        self.svc.spans.record(self.srid, "dispatched", t_mono=now,
+                              target=target)
+        self.svc.h_queue_wait.observe(1000.0 * (now - self.t0))
+
+    def first_token(self) -> None:
+        if self.t_first:
+            return
+        self.t_first = time.monotonic()
+        self.svc.spans.record(self.srid, "first_token",
+                              t_mono=self.t_first)
+        self.svc.h_ttft.observe(1000.0 * (self.t_first - self.t0))
+
+    def add_tokens(self, n: int) -> None:
+        self.tokens += max(int(n), 0)
+
+    def finished(self, error: bool = False) -> None:
+        if self._done:
+            return
+        self._done = True
+        now = time.monotonic()
+        self.svc.spans.record(self.srid, "finished", t_mono=now,
+                              error=bool(error))
+        if error:
+            return          # a refused/timed-out request is not a latency
+        self.svc.h_e2e.observe(1000.0 * (now - self.t0))
+        if self.t_first and self.tokens > 1:
+            self.svc.h_tpot.observe(
+                1000.0 * (now - self.t_first) / (self.tokens - 1))
 
 
 class HttpService:
@@ -53,12 +122,37 @@ class HttpService:
         self.scheduler = scheduler
         self.tracer = RequestTracer(opts.trace_path,
                                     opts.enable_request_trace)
-        self._num_requests = 0
-        self._num_errors = 0
         # {"http": Admission, "rpc": Admission} — injected by Master once
         # the servers exist; /metrics reports their pressure.
         self.admissions = None
-        self._lock = make_lock("http.stats", 90)
+        # The service plane's metrics registry + span ring. One
+        # HttpService per process in production, so this IS the
+        # process-global registry there; the co-located test harness
+        # gets per-plane attribution for free (obs/metrics.py docstring).
+        self.obs = Registry()
+        self.spans = SpanStore(capacity=int(os.environ.get(
+            "XLLM_SPAN_RING", "2048")))
+        self._m_requests = self.obs.counter(
+            "xllm_service_requests_total",
+            "completion/chat requests accepted by the front door")
+        self._m_errors = self.obs.counter(
+            "xllm_service_errors_total",
+            "requests that ended in a scheduling/worker/timeout error")
+        self._m_requests.inc(0.0)       # render 0 from boot, like the
+        self._m_errors.inc(0.0)         # f-string exporter always did
+        self.h_ttft = self.obs.histogram(
+            "xllm_service_ttft_ms",
+            "received -> first streamed token (stream/RPC topologies)")
+        self.h_tpot = self.obs.histogram(
+            "xllm_service_tpot_ms",
+            "mean inter-token gap per request (frame-interval "
+            "approximation in the relay-stream topology)")
+        self.h_e2e = self.obs.histogram(
+            "xllm_service_e2e_ms", "received -> finished")
+        self.h_queue_wait = self.obs.histogram(
+            "xllm_service_queue_wait_ms",
+            "received -> dispatched to a worker (schedule + rewrite + "
+            "redispatch time)")
 
     def install(self, router: Router) -> None:
         router.route("GET", "/hello",
@@ -73,6 +167,7 @@ class HttpService:
         router.route("POST", "/model/triggers", self._model_triggers)
         router.route("POST", "/admin/flags", self._admin_flags)
         router.route("GET", "/admin/flags", self._admin_flags_get)
+        router.route_prefix("GET", "/admin/trace/", self._admin_trace)
 
     # ------------------------------------------------------------------
     # Request building (generate_request, service.cpp:239-267)
@@ -109,8 +204,7 @@ class HttpService:
     # Completions / ChatCompletions (service.cpp:338-475)
     # ------------------------------------------------------------------
     def _completions(self, http_req: Request, is_chat: bool) -> Response:
-        with self._lock:
-            self._num_requests += 1
+        self._m_requests.inc()
         try:
             body = http_req.json()
         except (ValueError, json.JSONDecodeError):
@@ -129,15 +223,20 @@ class HttpService:
             validate_sampling(req.sampling, req.stream)
         except (TypeError, ValueError) as e:
             return Response.error(400, f"invalid request: {e}")
+        robs = _RequestObs(self, req.service_request_id, kind,
+                           body.get("model", ""))
+        robs.stage("admitted", stream=req.stream)
         self.tracer.trace(req.service_request_id,
                           {"stage": "ingress", "kind": kind, "body": body,
                            "x_request_time": req.arrival_time or None})
         status, routing = self.scheduler.schedule(req)
         if not status.ok:
-            with self._lock:
-                self._num_errors += 1
+            self._m_errors.inc()
+            robs.finished(error=True)
             code = 503 if status.code.name == "UNAVAILABLE" else 400
             return Response.error(code, status.message)
+        robs.stage("scheduled", prefill=routing.prefill_name,
+                   decode=routing.decode_name)
 
         # Rewrite the forwarded body (service.cpp:457-463). The parsed
         # SamplingParams travel with it so the worker honors exactly what
@@ -154,11 +253,20 @@ class HttpService:
         target = self.scheduler.instance_mgr.address_of(
             routing.prefill_name)
         if target is None:
+            self._m_errors.inc()
+            robs.finished(error=True)
             return Response.error(503, "routed instance vanished")
 
         if self.opts.enable_decode_response_to_service:
-            return self._rpc_mode_response(req, fwd, target, path, is_chat)
-        return self._relay_mode_response(req, fwd, target, path)
+            return self._rpc_mode_response(req, fwd, target, path,
+                                           is_chat, robs)
+        return self._relay_mode_response(req, fwd, target, path, robs)
+
+    def _fwd_headers(self, req: SchedRequest) -> Dict[str, str]:
+        """Correlation header for every forward of this request — the
+        worker stamps its span stages with the same id, so the merged
+        timeline at /admin/trace/<id> crosses the plane boundary."""
+        return {REQUEST_ID_HEADER: req.service_request_id}
 
     # -- re-dispatch ------------------------------------------------------
     def _redispatch(self, req: SchedRequest,
@@ -172,6 +280,8 @@ class HttpService:
         registry so finish metrics drain the instance that actually does
         the work. Returns the new target address, or None."""
         old = req.routing.prefill_name if req.routing else ""
+        self.spans.record(req.service_request_id, "redispatch",
+                          from_instance=old)
         status, routing = self.scheduler.schedule(req)
         if not status.ok or routing.prefill_name == old:
             if status.ok and old:
@@ -203,7 +313,8 @@ class HttpService:
             try:
                 status, resp = http_json(
                     "POST", target, path, fwd,
-                    timeout=self.opts.request_timeout_s)
+                    timeout=self.opts.request_timeout_s,
+                    headers=self._fwd_headers(req))
             except ConnectionRefusedError:
                 new = self._redispatch(req, fwd) if attempt == 0 else None
                 if new:
@@ -219,7 +330,8 @@ class HttpService:
 
     # -- topology 1: HTTP relay (service.cpp:168-236) ---------------------
     def _relay_mode_response(self, req: SchedRequest, fwd: Dict[str, Any],
-                             target: str, path: str) -> Response:
+                             target: str, path: str,
+                             robs: _RequestObs) -> Response:
         self.scheduler.record_new_request(req, lambda out: True)
         if req.stream:
             # Eager open: the worker's status is known BEFORE any bytes
@@ -227,10 +339,12 @@ class HttpService:
             # errors surface with their real status code instead of
             # error JSON inside a 200 SSE stream.
             for attempt in (0, 1):
+                robs.dispatched(target)
                 try:
                     status, body = http_stream_status(
                         "POST", target, path, fwd,
-                        timeout=self.opts.request_timeout_s)
+                        timeout=self.opts.request_timeout_s,
+                        headers=self._fwd_headers(req))
                 except Exception as e:  # noqa: BLE001
                     # Refusal-class failures only (see _redispatch):
                     # a timeout may mean the worker already started.
@@ -243,8 +357,8 @@ class HttpService:
                         continue
                     self.scheduler.finish_request(req.service_request_id,
                                                   cancelled=True)
-                    with self._lock:
-                        self._num_errors += 1
+                    self._m_errors.inc()
+                    robs.finished(error=True)
                     return Response.error(503, f"worker error: {e}")
                 if status == 200:
                     break
@@ -256,8 +370,8 @@ class HttpService:
                         continue
                 self.scheduler.finish_request(req.service_request_id,
                                               cancelled=True)
-                with self._lock:
-                    self._num_errors += 1
+                self._m_errors.inc()
+                robs.finished(error=True)
                 return Response(status=status, body=err)
 
             trace_egress = self.tracer.egress_for(req.service_request_id)
@@ -265,10 +379,29 @@ class HttpService:
             def relay() -> Iterator[bytes]:
                 try:
                     for chunk in body:
+                        robs.first_token()
+                        # Frame-count approximation of the token count:
+                        # the relay proxies bytes without parsing, and
+                        # one worker StepOutput is one SSE data frame.
+                        # [DONE] is a terminator, not a StepOutput.
+                        robs.add_tokens(chunk.count(b"data: ")
+                                        - chunk.count(b"data: [DONE]"))
                         if trace_egress is not None:
                             trace_egress(chunk)
                         yield chunk
+                except GeneratorExit:
+                    # Client went away mid-stream: a truncated request
+                    # must not pollute the latency histograms.
+                    robs.finished(error=True)
+                    raise
+                except Exception:
+                    # Worker died mid-relay: an aborted stream is an
+                    # error, not an e2e/tpot sample.
+                    self._m_errors.inc()
+                    robs.finished(error=True)
+                    raise
                 finally:
+                    robs.finished()
                     self.scheduler.finish_request(req.service_request_id)
             resp_obj = Response.sse(relay())
             done = [False]
@@ -286,18 +419,31 @@ class HttpService:
                     body.close()
                 except Exception:  # noqa: BLE001 — the worker socket may
                     pass            # already be dead; drop is the intent
+                # A never-started body means the client died during the
+                # header write — not a completed request.
+                robs.finished(error=True)
                 self.scheduler.finish_request(req.service_request_id)
             resp_obj.on_close = on_close
             return resp_obj
+        robs.dispatched(target)
         try:
             status, resp = self._send_with_redispatch(req, fwd, target,
                                                       path)
         except Exception as e:  # noqa: BLE001 — worker unreachable
             self.scheduler.finish_request(req.service_request_id,
                                           cancelled=True)
-            with self._lock:
-                self._num_errors += 1
+            self._m_errors.inc()
+            robs.finished(error=True)
             return Response.error(503, f"worker error: {e}")
+        if isinstance(resp, dict):
+            # Non-stream relay: the worker's first token is invisible
+            # here (one response body); TTFT for this request merges in
+            # from the worker-side span. Usage gives the exact count.
+            robs.add_tokens((resp.get("usage") or {})
+                            .get("completion_tokens", 0))
+        robs.finished(error=status != 200)
+        if status != 200:
+            self._m_errors.inc()
         self.scheduler.finish_request(req.service_request_id)
         self.tracer.trace(req.service_request_id,
                           {"stage": "egress", "body": resp})
@@ -305,8 +451,8 @@ class HttpService:
 
     # -- topology 2: decode → service RPC fan-in --------------------------
     def _rpc_mode_response(self, req: SchedRequest, fwd: Dict[str, Any],
-                           target: str, path: str,
-                           is_chat: bool) -> Response:
+                           target: str, path: str, is_chat: bool,
+                           robs: _RequestObs) -> Response:
         out_q: "queue.Queue[Optional[RequestOutput]]" = queue.Queue()
 
         def on_output(out: RequestOutput) -> bool:
@@ -316,6 +462,7 @@ class HttpService:
             return True
 
         self.scheduler.record_new_request(req, on_output)
+        robs.dispatched(target)
         try:
             status, ack = self._send_with_redispatch(req, fwd, target,
                                                      path)
@@ -324,8 +471,8 @@ class HttpService:
         except Exception as e:  # noqa: BLE001
             self.scheduler.finish_request(req.service_request_id,
                                           cancelled=True)
-            with self._lock:
-                self._num_errors += 1
+            self._m_errors.inc()
+            robs.finished(error=True)
             return Response.error(503, f"worker error: {e}")
 
         timeout = self.opts.request_timeout_s
@@ -333,7 +480,12 @@ class HttpService:
         def next_output() -> Optional[RequestOutput]:
             """None = finished sentinel; raises queue.Empty on timeout —
             a worker that acked then died must not hang the client."""
-            return out_q.get(timeout=timeout)
+            out = out_q.get(timeout=timeout)
+            if out is not None:
+                robs.first_token()
+                robs.add_tokens(sum(len(s.token_ids)
+                                    for s in out.outputs))
+            return out
 
         if req.stream:
             asm = (ChatStreamAssembler if is_chat
@@ -343,26 +495,41 @@ class HttpService:
             trace_egress = self.tracer.egress_for(req.service_request_id)
 
             def gen() -> Iterator[bytes]:
-                while True:
-                    try:
-                        out = next_output()
-                    except queue.Empty:
-                        self.scheduler.finish_request(
-                            req.service_request_id, cancelled=True)
-                        frame = (b'data: {"error": {"message": '
-                                 b'"generation timed out", '
-                                 b'"type": "timeout"}}\n\n')
-                        if trace_egress is not None:
-                            trace_egress(frame)
-                        yield frame
-                        return
-                    if out is None:
-                        return
-                    for frame in asm.on_output(out):
-                        if trace_egress is not None:
-                            trace_egress(frame)
-                        yield frame
-            return Response.sse(gen())
+                try:
+                    while True:
+                        try:
+                            out = next_output()
+                        except queue.Empty:
+                            self.scheduler.finish_request(
+                                req.service_request_id, cancelled=True)
+                            robs.finished(error=True)
+                            frame = (b'data: {"error": {"message": '
+                                     b'"generation timed out", '
+                                     b'"type": "timeout"}}\n\n')
+                            if trace_egress is not None:
+                                trace_egress(frame)
+                            yield frame
+                            return
+                        if out is None:
+                            return
+                        for frame in asm.on_output(out):
+                            if trace_egress is not None:
+                                trace_egress(frame)
+                            yield frame
+                except GeneratorExit:
+                    robs.finished(error=True)   # truncated by the client
+                    raise
+                finally:
+                    robs.finished()
+            resp_obj = Response.sse(gen())
+
+            def on_close() -> None:
+                # Never-started body (client died during header write):
+                # close the span as an error, not a latency sample; a
+                # normally-finished stream already sealed it (no-op).
+                robs.finished(error=True)
+            resp_obj.on_close = on_close
+            return resp_obj
 
         coll = ResponseCollector(req.service_request_id, req.model, is_chat,
                                  target_n=max(1, req.sampling.n))
@@ -372,8 +539,8 @@ class HttpService:
             except queue.Empty:
                 self.scheduler.finish_request(req.service_request_id,
                                               cancelled=True)
-                with self._lock:
-                    self._num_errors += 1
+                self._m_errors.inc()
+                robs.finished(error=True)
                 self.tracer.trace(req.service_request_id,
                                   {"stage": "egress", "status": 504,
                                    "error": "generation timed out"})
@@ -383,6 +550,7 @@ class HttpService:
                 break
             coll.add(out)
         final = coll.body()
+        robs.finished()
         self.tracer.trace(req.service_request_id,
                           {"stage": "egress", "body": final})
         return Response.json(final)
@@ -430,22 +598,23 @@ class HttpService:
                      for m, st in sorted(models.items())]})
 
     def _metrics(self, http_req: Request) -> Response:
+        """Refresh scrape-time mirrors from live state, then render the
+        whole registry (series names unchanged from the hand-assembled
+        exporter this replaced; the metrics-registry xlint rule keeps it
+        that way)."""
+        obs = self.obs
         mgr = self.scheduler.instance_mgr
-        lines = [
-            f"xllm_service_requests_total {self._num_requests}",
-            f"xllm_service_errors_total {self._num_errors}",
-            f"xllm_service_tracked_requests "
-            f"{self.scheduler.num_tracked_requests()}",
-            f"xllm_service_instances {len(mgr.names())}",
-            f"xllm_service_prefill_instances "
-            f"{len(mgr.prefill_instances())}",
-            f"xllm_service_decode_instances "
-            f"{len(mgr.decode_instances())}",
-            f"xllm_service_cache_blocks "
-            f"{self.scheduler.kvcache_mgr.num_blocks()}",
-            f"xllm_service_is_master "
-            f"{1 if self.scheduler.is_master else 0}",
-        ]
+        obs.gauge("xllm_service_tracked_requests").set(
+            self.scheduler.num_tracked_requests())
+        obs.gauge("xllm_service_instances").set(len(mgr.names()))
+        obs.gauge("xllm_service_prefill_instances").set(
+            len(mgr.prefill_instances()))
+        obs.gauge("xllm_service_decode_instances").set(
+            len(mgr.decode_instances()))
+        obs.gauge("xllm_service_cache_blocks").set(
+            self.scheduler.kvcache_mgr.num_blocks())
+        obs.gauge("xllm_service_is_master").set(
+            1 if self.scheduler.is_master else 0)
         # Keep-alive reuse pool: regressions show here as hit:miss
         # decay / overflow growth before they show as service_bench
         # latency. The pool is PROCESS-global (httpd._POOL), so the
@@ -453,31 +622,50 @@ class HttpService:
         # separate-process deployment this is the service→worker
         # transport; co-located planes (the test harness) export the
         # same series under distinct labels instead of colliding.
-        from xllm_service_tpu.service.httpd import conn_pool_stats
-        for k, v in conn_pool_stats().items():
-            lines.append(f'xllm_http_conn_pool_{k}{{plane="service"}} '
-                         f'{v}')
+        from xllm_service_tpu.service.httpd import flush_conn_pool_metrics
+        flush_conn_pool_metrics(obs, plane="service")
         # Admission pressure (set by Master after server construction):
         # active slots + total 503-rejected per server.
         for srv_name, adm in (self.admissions or {}).items():
-            tag = f'server="{srv_name}"'
-            lines.append(
-                f"xllm_service_admission_active{{{tag}}} {adm.active}")
-            lines.append(f"xllm_service_admission_rejected_total{{{tag}}} "
-                         f"{adm.rejected_total}")
+            obs.gauge("xllm_service_admission_active",
+                      labelnames=("server",)).set(adm.active,
+                                                  server=srv_name)
+            obs.counter("xllm_service_admission_rejected_total",
+                        labelnames=("server",)).set_total(
+                adm.rejected_total, server=srv_name)
+        # Per-instance load: rebuilt from scratch each scrape so gauges
+        # for departed instances don't linger forever.
+        g_wait = obs.gauge("xllm_instance_waiting_requests",
+                           labelnames=("instance",))
+        g_run = obs.gauge("xllm_instance_running_requests",
+                          labelnames=("instance",))
+        g_kv = obs.gauge("xllm_instance_kv_cache_usage",
+                         labelnames=("instance",))
+        for g in (g_wait, g_run, g_kv):
+            g.clear()
         for name in mgr.names():
             inst = mgr.get(name)
             if inst is None:
                 continue
-            tag = f'instance="{name}"'
-            lines.append(f"xllm_instance_waiting_requests{{{tag}}} "
-                         f"{inst.load.waiting_requests}")
-            lines.append(f"xllm_instance_running_requests{{{tag}}} "
-                         f"{inst.load.running_requests}")
-            lines.append(f"xllm_instance_kv_cache_usage{{{tag}}} "
-                         f"{inst.load.kv_cache_usage}")
-        return Response(body="\n".join(lines).encode() + b"\n",
+            g_wait.set(inst.load.waiting_requests, instance=name)
+            g_run.set(inst.load.running_requests, instance=name)
+            g_kv.set(inst.load.kv_cache_usage, instance=name)
+        return Response(body=obs.render().encode(),
                         content_type="text/plain; version=0.0.4")
+
+    # ------------------------------------------------------------------
+    # Cross-plane request spans: GET /admin/trace/<service_request_id>
+    # ------------------------------------------------------------------
+    def _admin_trace(self, http_req: Request) -> Response:
+        rid = http_req.path[len("/admin/trace/"):]
+        if not rid:
+            return Response.error(400, "missing request id")
+        span = self.spans.get(rid)
+        if span is None:
+            return Response.error(
+                404, f"no span for {rid!r} (never seen, or evicted "
+                     f"from the ring — size it with XLLM_SPAN_RING)")
+        return Response.json(span)
 
     # ------------------------------------------------------------------
     # Manual sleep/wakeup (service.cpp:510-550)

@@ -485,6 +485,21 @@ def conn_pool_stats() -> Dict[str, int]:
     """Process-wide keep-alive pool counters for /metrics exporters."""
     return _POOL.stats()
 
+
+def flush_conn_pool_metrics(registry, plane: str) -> None:
+    """Mirror the pool counters into an obs registry under the exporting
+    plane's label (the pool is process-global; co-located planes export
+    the same series under distinct labels instead of colliding). Shared
+    by both planes' /metrics handlers so the series shapes can't drift."""
+    for k, v in conn_pool_stats().items():
+        name = f"xllm_http_conn_pool_{k}"
+        if k.endswith("_total"):
+            registry.counter(name, labelnames=("plane",)).set_total(
+                v, plane=plane)
+        else:
+            registry.gauge(name, labelnames=("plane",)).set(
+                v, plane=plane)
+
 # Failures while SENDING on a reused socket — the request never reached
 # the peer whole, so one fresh-connection retry cannot double-execute it.
 _SEND_ERRORS = (http.client.CannotSendRequest, ConnectionResetError,

@@ -162,6 +162,11 @@ class Heartbeat:
     cache_removed: List[str] = dataclasses.field(default_factory=list)
     # Per-model sleep/wake state for the serverless layer.
     model_states: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Finished request-span timelines since the last beat
+    # ([{"request_id", "attrs", "events": [...]}], obs/spans.py): the
+    # service merges them into its span ring under the same correlation
+    # id, so /admin/trace/<id> shows worker-side stages too.
+    spans: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
     timestamp: float = dataclasses.field(default_factory=time.time)
 
     def to_json(self) -> Dict[str, Any]:
@@ -173,6 +178,7 @@ class Heartbeat:
             "cache_stored": self.cache_stored,
             "cache_removed": self.cache_removed,
             "model_states": self.model_states,
+            "spans": self.spans,
             "timestamp": self.timestamp,
         }
 
@@ -190,5 +196,6 @@ class Heartbeat:
             cache_stored=list(d.get("cache_stored", [])),
             cache_removed=list(d.get("cache_removed", [])),
             model_states=dict(d.get("model_states", {})),
+            spans=list(d.get("spans", [])),
             timestamp=d.get("timestamp", time.time()),
         )

@@ -44,6 +44,9 @@ class Master:
             serverless_models=serverless_models)
         self.http_service = HttpService(opts, self.scheduler)
         self.rpc_service = RpcService(opts, self.scheduler)
+        # Worker span stages arrive on the RPC plane (heartbeats) but
+        # are queried on the HTTP plane (/admin/trace/<id>): one store.
+        self.rpc_service.spans = self.http_service.spans
 
         # Both servers enforce opts.max_concurrency as live admission
         # control (the reference's brpc max_concurrency backpressure,

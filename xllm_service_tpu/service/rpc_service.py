@@ -27,6 +27,10 @@ class RpcService:
     def __init__(self, opts: ServiceOptions, scheduler: Scheduler) -> None:
         self.opts = opts
         self.scheduler = scheduler
+        # SpanStore of the co-resident HttpService (wired by Master):
+        # heartbeat-shipped worker span stages merge here so the
+        # /admin/trace/<id> timeline crosses the plane boundary.
+        self.spans = None
 
     def install(self, router: Router) -> None:
         router.route("GET", "/rpc/hello",
@@ -48,6 +52,14 @@ class RpcService:
         if not hb.name:
             return Response.error(400, "heartbeat missing name")
         registered = self.scheduler.handle_instance_heartbeat(hb)
+        if self.spans is not None:
+            for rec in hb.spans:
+                rid = rec.get("request_id")
+                if rid:
+                    self.spans.merge_remote(
+                        rid, plane="worker",
+                        events=rec.get("events", []), source=hb.name,
+                        attrs=rec.get("attrs") or None)
         return Response.json({"ok": True, "registered": registered})
 
     # -- Generations fan-in (rpc_service/service.cpp:149-213) -------------

@@ -21,9 +21,11 @@ increasing):
     50  (reserved: coordination store — uses a Condition-wrapped RLock,
          checked by its own single-class discipline, see coordination.py)
     60  coordination_net, etcd.watches  — store transports
-    90  leaves: tracer, http.stats, misc.pool (fan-in), worker.vision
+    90  leaves: tracer, misc.pool (fan-in), worker.vision
     91  misc.counter                    — may be bumped under any leaf
     92  httpd.connpool                  — guards the keep-alive dict only
+    93  obs.registry                    — metrics families (never calls out)
+    94  obs.spans                       — span ring buffer (never calls out)
     95  hashing.native                  — innermost (C call guard)
     96  native_httpd.lib                — one-shot native-library load
     97  etcd_native.build               — one-shot etcd-client build
