@@ -187,9 +187,11 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     import jax
 
     from xllm_service_tpu.config import EngineConfig, ModelConfig
-    from xllm_service_tpu.obs import default_registry, histogram_quantile
+    from xllm_service_tpu.obs import (
+        default_registry, histogram_fraction_le, histogram_quantile)
+    from xllm_service_tpu.obs.slo import SloConfig
     from xllm_service_tpu.runtime.engine import Engine, EngineRequest
-    from xllm_service_tpu.utils.types import SamplingParams
+    from xllm_service_tpu.utils.types import FinishReason, SamplingParams
 
     if not (force_cpu or os.environ.get("JAX_PLATFORMS") == "cpu"):
         # Tunnel runs only: the CPU AOT cache path spams feature-mismatch
@@ -275,9 +277,11 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     h_ttft = lat.histogram("xllm_service_ttft_ms")
     h_tpot = lat.histogram("xllm_service_tpot_ms")
     h_queue = lat.histogram("xllm_service_queue_wait_ms")
+    h_e2e = lat.histogram("xllm_service_e2e_ms")
 
     sp = SamplingParams(max_tokens=gen_len, temperature=0.0, ignore_eos=True)
     t_add = {}
+    t_submit = {}       # survives the first-token pop: e2e needs it
     for i in range(batch):
         # Distinct prompts: identical ones would prefix-cache-hit after
         # the first batch, silently benchmarking cache lookups instead of
@@ -288,7 +292,7 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             token_ids=[(i + j) % (cfg.vocab_size - 1) + 1
                        for j in range(prompt_len)],
             sampling=sp))
-        t_add[f"bench-{i}"] = time.monotonic()
+        t_add[f"bench-{i}"] = t_submit[f"bench-{i}"] = time.monotonic()
     # Prefill outside the timed window: the metric is steady-state decode.
     # Still measured — prefill is the compute-bound phase, so its MFU shows
     # what the matmul path achieves when not weight-read-bound.
@@ -306,6 +310,8 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             if ta is not None:
                 h_ttft.observe(1000.0 * (now - ta))
                 h_queue.observe(1000.0 * (t_step - ta))
+            if out.finish_reason != FinishReason.NONE:
+                h_e2e.observe(1000.0 * (now - t_submit[out.request_id]))
     prefill_s = time.monotonic() - tp0
     prefill_tokens = batch * prompt_len
 
@@ -322,6 +328,9 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
                 # Per-token latency of this sequence in this step; a
                 # fused burst amortizes one step across N tokens.
                 h_tpot.observe(1000.0 * step_el / len(out.new_token_ids))
+            if out.finish_reason != FinishReason.NONE:
+                h_e2e.observe(1000.0 * (time.monotonic()
+                                        - t_submit[out.request_id]))
     elapsed = time.monotonic() - t0
 
     lat_scrape = lat.render()
@@ -329,6 +338,17 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     def _q(family: str, q: float):
         v = histogram_quantile(lat_scrape, family, q)
         return round(v, 3) if v is not None else None
+
+    # SLO attainment against the configured targets (XLLM_SLO_* env,
+    # same defaults as the live /admin/slo engine), from the SAME
+    # scraped buckets as the percentiles above — BENCH_*.json tracks
+    # the fraction of requests under target per round.
+    slo_thr = {o.name: o.threshold_ms
+               for o in SloConfig.from_env().objectives}
+
+    def _attainment(family: str, threshold_ms: float):
+        v = histogram_fraction_le(lat_scrape, family, threshold_ms)
+        return round(v, 4) if v is not None else None
 
     # "No routed request ever pays a compile", proven per round: the
     # post-warmup recompile counters after the measured run, and the
@@ -416,6 +436,13 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             "tpot_ms_p90": _q("xllm_service_tpot_ms", 0.90),
             "tpot_ms_p99": _q("xllm_service_tpot_ms", 0.99),
             "queue_wait_ms_p99": _q("xllm_service_queue_wait_ms", 0.99),
+            "e2e_ms_p99": _q("xllm_service_e2e_ms", 0.99),
+            "slo_ttft_attainment": _attainment(
+                "xllm_service_ttft_ms", slo_thr["ttft"]),
+            "slo_e2e_attainment": _attainment(
+                "xllm_service_e2e_ms", slo_thr["e2e"]),
+            "slo_targets_ms": {"ttft": slo_thr["ttft"],
+                               "e2e": slo_thr["e2e"]},
             "mfu": round(mfu, 4) if mfu is not None else None,
             "prefill_tokens_per_s": round(prefill_tokens / prefill_s, 1),
             # Prefill runs the lm_head only on the LAST position per

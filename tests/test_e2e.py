@@ -530,6 +530,203 @@ class TestEndToEnd:
             master.stop()
 
 
+def _get_text(address: str, path: str) -> str:
+    import http.client
+    conn = http.client.HTTPConnection(address, timeout=10)
+    conn.request("GET", path)
+    body = conn.getresponse().read().decode()
+    conn.close()
+    return body
+
+
+class TestJudgmentLayer:
+    """PR-4 acceptance: drive load past a deliberately tight SLO target
+    and prove the whole attribution loop — burn-rate breach at
+    /admin/slo, the breach event at /admin/events, the routing audit on
+    the request's span, a parseable flight-recorder bundle holding all
+    of it, and both planes' /metrics still passing the exposition
+    validator with the new series present."""
+
+    def test_slo_breach_audit_events_and_debug_bundle(self, store,
+                                                      monkeypatch):
+        # Sub-millisecond targets: every real request breaches. Fast
+        # ticks so the breach opens inside the test budget; windows wide
+        # enough that the bad traffic cannot age OUT of the fast window
+        # (closing the breach) before the later assertions run.
+        monkeypatch.setenv("XLLM_SLO_TTFT_MS", "0.01")
+        monkeypatch.setenv("XLLM_SLO_E2E_MS", "0.01")
+        monkeypatch.setenv("XLLM_SLO_QUEUE_WAIT_MS", "0.01")
+        monkeypatch.setenv("XLLM_SLO_FAST_WINDOW_S", "30.0")
+        monkeypatch.setenv("XLLM_SLO_SLOW_WINDOW_S", "120.0")
+        monkeypatch.setenv("XLLM_SLO_TICK_S", "0.1")
+        opts = ServiceOptions(
+            http_port=0, rpc_port=0, num_output_pools=4,
+            load_balance_policy=LoadBalancePolicyType.CACHE_AWARE,
+            block_size=16, heartbeat_interval_s=0.2,
+            master_upload_interval_s=0.2)
+        master = Master(opts, store=store).start()
+        workers = [Worker(WorkerOptions(
+            port=0, instance_type=InstanceType.DEFAULT,
+            service_addr=master.rpc_address, model="tiny",
+            heartbeat_interval_s=0.2, lease_ttl_s=2.0), store,
+            engine_cfg=small_engine_cfg()).start()]
+        try:
+            assert wait_until(
+                lambda: len(master.scheduler.instance_mgr
+                            .prefill_instances()) == 1, timeout=15.0)
+            srid = None
+            for i in range(3):
+                status, resp = http_json(
+                    "POST", master.http_address, "/v1/completions",
+                    {"model": "tiny", "prompt": f"breach me {i}",
+                     "max_tokens": 2, "temperature": 0.0,
+                     "ignore_eos": True}, timeout=60.0)
+                assert status == 200, resp
+                srid = resp["id"]
+
+            # 1) /admin/slo: the e2e objective breaches with a nonzero
+            # fast-window burn (every request blew the 0.01ms target).
+            def breached():
+                status, slo = http_json("GET", master.http_address,
+                                        "/admin/slo")
+                if status != 200:
+                    return False
+                obj = slo["objectives"]["e2e"]
+                return bool(obj["breach"]) \
+                    and obj["windows"]["fast"]["burn_rate"] > 0
+            assert wait_until(breached, timeout=15.0), \
+                "SLO breach never opened"
+            status, slo = http_json("GET", master.http_address,
+                                    "/admin/slo")
+            assert "e2e" in slo["breached"]
+            assert slo["objectives"]["e2e"]["windows"]["fast"][
+                "attainment"] < 1.0
+
+            # 2) /admin/events: the breach event is in the log, next to
+            # the cluster-lifecycle events that preceded it.
+            status, ev = http_json("GET", master.http_address,
+                                   "/admin/events?since=0")
+            assert status == 200
+            types = {e["type"] for e in ev["events"]}
+            assert "slo_breach_open" in types, types
+            assert "master_elected" in types
+            assert "instance_join" in types
+            assert "instance_confirm" in types
+            assert ev["latest_seq"] >= len(ev["events"])
+            open_ev = next(e for e in ev["events"]
+                           if e["type"] == "slo_breach_open")
+            assert open_ev["attrs"]["fast_burn"] > 0
+            # since=<seq> pagination: nothing before the cursor.
+            status, tail = http_json(
+                "GET", master.http_address,
+                f"/admin/events?since={open_ev['seq'] - 1}")
+            assert all(e["seq"] >= open_ev["seq"]
+                       for e in tail["events"])
+
+            # 3) The routing audit rode the request's span: candidates
+            # with their score terms, and the winner that served it.
+            status, span = http_json("GET", master.http_address,
+                                     f"/admin/trace/{srid}")
+            assert status == 200, span
+            audit = span["attrs"]["schedule_decision"]
+            assert audit["policy"] == "cache_aware"
+            cands = audit["prefill"]["candidates"]
+            assert cands and all(
+                k in cands[0] for k in ("instance", "score",
+                                        "match_ratio", "kv_usage",
+                                        "waiting_ratio"))
+            assert audit["prefill"]["winner"] == workers[0].name
+            # No prefix overlap on a cold cache: the fallback is named.
+            assert audit["prefill"]["fallback_reason"] \
+                == "no_prefix_overlap"
+
+            # 4) /admin/debug_bundle: one parseable snapshot with all of
+            # the above inside.
+            status, bundle = http_json("GET", master.http_address,
+                                       "/admin/debug_bundle")
+            assert status == 200
+            assert bundle["is_master"] is True
+            assert bundle["service_id"] == master.scheduler.service_id
+            inst = {i["name"]: i for i in bundle["instances"]}
+            assert workers[0].name in inst
+            assert "heartbeat_age_s" in inst[workers[0].name]
+            assert bundle["slo"]["objectives"]["e2e"]["breach"]
+            assert any(e["type"] == "slo_breach_open"
+                       for e in bundle["events"])
+            assert isinstance(bundle["tracked_requests"], list)
+            recent = bundle["spans"]["recent_finished"]
+            assert any(s["request_id"] == srid for s in recent)
+            assert "schedule_decision" in next(
+                s for s in recent if s["request_id"] == srid)["attrs"]
+            assert bundle["flags"]["target_ttft_ms"] == \
+                opts.target_ttft_ms
+            # The embedded metrics text is the real exposition.
+            from xllm_service_tpu.obs import validate_exposition
+            assert validate_exposition(bundle["metrics"]) == []
+
+            # 5) Both planes' live /metrics still validate, with the new
+            # judgment-layer series present.
+            mtext = _get_text(master.http_address, "/metrics")
+            wtext = _get_text(workers[0].name, "/metrics")
+            for plane, text in (("service", mtext), ("worker", wtext)):
+                errs = validate_exposition(text)
+                assert errs == [], f"{plane} /metrics invalid: {errs}"
+            assert 'xllm_slo_breach{objective="e2e"} 1' in mtext
+            assert 'xllm_slo_attainment{objective="e2e"}' in mtext
+            assert 'xllm_slo_burn_rate{objective="e2e",window="fast"}' \
+                in mtext
+            assert 'xllm_events_total{type="slo_breach_open"} ' in mtext
+            assert ('xllm_schedule_decisions_total{policy="cache_aware"'
+                    ',reason="fallback"} ') in mtext
+            assert "xllm_span_evictions_total 0" in mtext
+            assert "xllm_span_evictions_total 0" in wtext
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
+    def test_trace_tombstone_410_after_eviction(self, store, monkeypatch):
+        """A span the ring HELD and evicted answers 410 {"evicted":
+        true} at /admin/trace — distinguishable from a never-seen 404."""
+        monkeypatch.setenv("XLLM_SPAN_RING", "4")
+        master, workers = make_cluster(store)
+        try:
+            srids = []
+            for i in range(6):      # overflow the 4-slot ring
+                status, resp = http_json(
+                    "POST", master.http_address, "/v1/completions",
+                    {"model": "tiny", "prompt": f"evict {i}",
+                     "max_tokens": 1, "temperature": 0.0,
+                     "ignore_eos": True}, timeout=60.0)
+                assert status == 200, resp
+                srids.append(resp["id"])
+            import http.client
+            conn = http.client.HTTPConnection(master.http_address,
+                                              timeout=10)
+            conn.request("GET", f"/admin/trace/{srids[0]}")
+            r = conn.getresponse()
+            body = json.loads(r.read().decode())
+            conn.close()
+            assert r.status == 410, body
+            assert body["evicted"] is True
+            # Never-seen ids still 404.
+            conn = http.client.HTTPConnection(master.http_address,
+                                              timeout=10)
+            conn.request("GET", "/admin/trace/never-seen-rid")
+            assert conn.getresponse().status == 404
+            conn.close()
+            # The eviction is visible on /metrics.
+            mtext = _get_text(master.http_address, "/metrics")
+            evicted = next(
+                int(line.split()[-1]) for line in mtext.splitlines()
+                if line.startswith("xllm_span_evictions_total"))
+            assert evicted >= 2
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
+
 class TestEmbeddings:
     def test_embeddings_endpoint(self, store):
         master, workers = make_cluster(store)

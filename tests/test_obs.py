@@ -6,13 +6,18 @@ same ``validate_exposition`` used here and pulls a streamed request's
 merged span timeline through ``/admin/trace/<id>``.
 """
 
+import math
 import threading
 
 import pytest
 
 from xllm_service_tpu.obs import (
-    DEFAULT_LATENCY_BUCKETS_MS, Registry, SpanStore, histogram_quantile,
+    DEFAULT_LATENCY_BUCKETS_MS, AnomalyDetector, EventLog, InstanceSignal,
+    Registry, SloConfig, SloEngine, SloObjective, SpanStore,
+    fraction_le_from_buckets, histogram_fraction_le, histogram_quantile,
     parse_exposition, validate_exposition)
+from xllm_service_tpu.obs.events import EVENT_TYPES
+from xllm_service_tpu.obs.expfmt import quantile_from_buckets
 
 
 class TestRegistry:
@@ -76,12 +81,30 @@ class TestRegistry:
 
     def test_label_escaping_roundtrip(self):
         r = Registry()
-        nasty = 'a"b\\c\nd'
-        r.gauge("xllm_g", labelnames=("k",)).set(1, k=nasty)
+        # Incl. a literal backslash followed by 'n' (the sequential-
+        # replace unescape bug: '\\n' must round-trip as backslash+n,
+        # not swallow the backslash and emit a newline).
+        for nasty in ('a"b\\c\nd', "C:\\new\\path", "\\\\n", "end\\"):
+            r.gauge("xllm_g", labelnames=("k",)).set(1, k=nasty)
+            text = r.render()
+            samples, _t, errors = parse_exposition(text)
+            assert errors == []
+            assert any(s[1].get("k") == nasty for s in samples), nasty
+
+    def test_nan_sample_renders_without_breaking_the_scrape(self):
+        """One NaN value (e.g. shipped through JSON from a heartbeat)
+        must render as NaN in its own series, not 500 every future
+        /metrics render."""
+        import math as _math
+        r = Registry()
+        r.gauge("xllm_g", labelnames=("k",)).set(float("nan"), k="bad")
+        r.gauge("xllm_g", labelnames=("k",)).set(2, k="good")
         text = r.render()
+        assert 'xllm_g{k="bad"} NaN' in text
+        assert 'xllm_g{k="good"} 2' in text
         samples, _t, errors = parse_exposition(text)
         assert errors == []
-        assert any(s[1].get("k") == nasty for s in samples)
+        assert any(_math.isnan(v) for _n, _l, v in samples)
 
     def test_histogram_exposition_is_consistent(self):
         r = Registry()
@@ -164,6 +187,82 @@ class TestExpfmt:
         assert any("+Inf" in e for e in validate_exposition(text))
 
 
+class TestHistogramQuantileEdges:
+    """histogram_quantile contract at the edges: empty series, all mass
+    in +Inf, a single finite bucket, and q=0/q=1."""
+
+    def test_empty_histogram_is_none(self):
+        assert quantile_from_buckets([], 0.5) is None
+        assert quantile_from_buckets([(10.0, 0.0),
+                                      (math.inf, 0.0)], 0.5) is None
+        # Scraped form: family absent entirely, and present-but-empty.
+        assert histogram_quantile("", "xllm_h", 0.5) is None
+        empty = ('xllm_h_bucket{le="10"} 0\n'
+                 'xllm_h_bucket{le="+Inf"} 0\n'
+                 "xllm_h_sum 0\nxllm_h_count 0\n")
+        assert histogram_quantile(empty, "xllm_h", 0.5) is None
+
+    def test_all_mass_in_inf_bucket_clamps_to_last_finite_edge(self):
+        text = ('xllm_h_bucket{le="10"} 0\n'
+                'xllm_h_bucket{le="100"} 0\n'
+                'xllm_h_bucket{le="+Inf"} 7\n'
+                "xllm_h_sum 70000\nxllm_h_count 7\n")
+        # Every sample is past the last finite edge: the estimate clamps
+        # there instead of fabricating a value beyond the buckets.
+        for q in (0.1, 0.5, 0.99, 1.0):
+            assert histogram_quantile(text, "xllm_h", q) == 100.0
+
+    def test_single_finite_bucket_interpolates_from_zero(self):
+        bs = [(100.0, 7.0), (math.inf, 7.0)]
+        assert quantile_from_buckets(bs, 0.5) == pytest.approx(50.0)
+        assert quantile_from_buckets(bs, 0.0) == pytest.approx(0.0)
+        assert quantile_from_buckets(bs, 1.0) == pytest.approx(100.0)
+
+    def test_q0_and_q1_bounds(self):
+        text = ('xllm_h_bucket{le="10"} 4\n'
+                'xllm_h_bucket{le="100"} 9\n'
+                'xllm_h_bucket{le="+Inf"} 9\n'
+                "xllm_h_sum 200\nxllm_h_count 9\n")
+        assert histogram_quantile(text, "xllm_h", 0.0) == 0.0
+        assert histogram_quantile(text, "xllm_h", 1.0) == 100.0
+        # In-memory path agrees (same arithmetic, one copy).
+        h = Registry().histogram("xllm_h2", buckets=(10, 100))
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+        assert h.quantile(1.0) is None      # still empty
+
+
+class TestFractionLe:
+    """The SLO-attainment arithmetic (inverse of the quantile)."""
+
+    def test_empty_is_none(self):
+        assert fraction_le_from_buckets([], 10) is None
+        assert histogram_fraction_le("", "xllm_h", 10) is None
+
+    def test_interpolates_inside_bucket(self):
+        bs = [(10.0, 0.0), (20.0, 10.0), (math.inf, 10.0)]
+        # Threshold midway through the (10, 20] bucket → half its mass.
+        assert fraction_le_from_buckets(bs, 15.0) == pytest.approx(0.5)
+        assert fraction_le_from_buckets(bs, 10.0) == pytest.approx(0.0)
+        assert fraction_le_from_buckets(bs, 20.0) == pytest.approx(1.0)
+
+    def test_inf_mass_counts_as_over_threshold(self):
+        bs = [(10.0, 5.0), (math.inf, 10.0)]
+        assert fraction_le_from_buckets(bs, 1e9) == pytest.approx(0.5)
+
+    def test_matches_quantile_roundtrip(self):
+        r = Registry()
+        h = r.histogram("xllm_h", buckets=(10, 100, 1000))
+        for v in (5, 50, 50, 500, 500, 500):
+            h.observe(v)
+        text = r.render()
+        frac = histogram_fraction_le(text, "xllm_h", 100.0)
+        assert frac == pytest.approx(0.5)   # 3 of 6 at/under 100
+        # quantile(frac) lands back on the threshold (shared arithmetic)
+        assert histogram_quantile(text, "xllm_h", frac) \
+            == pytest.approx(100.0)
+
+
 class TestSpanStore:
     def test_record_is_idempotent_per_stage_and_plane(self):
         s = SpanStore()
@@ -238,6 +337,86 @@ class TestSpanStore:
         stages = [e["stage"] for e in s.get("r")["events"]]
         assert stages == ["first_token", "finished"]
 
+    def test_merge_remote_repeated_heartbeat_delivery_is_idempotent(self):
+        """The worker requeues an unacked span batch and re-ships it on
+        the next beat: the SAME finished span arriving twice from the
+        same source must merge to one set of events (and one attrs
+        fold), not a doubled timeline."""
+        s = SpanStore()
+        s.record("r", "received")
+        rec = {"request_id": "r",
+               "attrs": {"correlation_header": "r"},
+               "events": [
+                   {"stage": "received", "t_wall": 1.0, "t_mono": 0.1},
+                   {"stage": "scheduled", "t_wall": 1.1, "t_mono": 0.2},
+                   {"stage": "first_token", "t_wall": 2.0, "t_mono": 1.0},
+                   {"stage": "finished", "t_wall": 3.0, "t_mono": 2.0}]}
+        for _ in range(3):      # heartbeat retry storm
+            s.merge_remote("r", "worker", rec["events"], source="w:1",
+                           attrs=rec["attrs"])
+        span = s.get("r")
+        worker_events = [e for e in span["events"]
+                         if e["plane"] == "worker"]
+        assert len(worker_events) == 4
+        assert span["attrs"]["worker"] == {"correlation_header": "r"}
+        # A DIFFERENT worker's copy of the same stages (PD handoff) is
+        # still distinct evidence, keyed by source.
+        s.merge_remote("r", "worker", rec["events"], source="w:2")
+        assert len([e for e in s.get("r")["events"]
+                    if e["plane"] == "worker"]) == 8
+
+    def test_evictions_counted_and_tombstoned(self):
+        s = SpanStore(capacity=2)
+        for rid in ("a", "b", "c", "d"):
+            s.record(rid, "received")
+        assert s.eviction_count() == 2
+        assert s.was_evicted("a") and s.was_evicted("b")
+        # Live and never-seen ids are NOT "evicted".
+        assert not s.was_evicted("c")
+        assert not s.was_evicted("nope")
+        # A tombstoned id coming back to life is live again.
+        s.record("a", "received")
+        assert not s.was_evicted("a")
+        assert s.get("a") is not None
+
+    def test_evict_revive_evict_keeps_tombstone(self):
+        """Evicted → re-created → evicted again: the SECOND tombstone
+        must survive the first (stale) deque entry's lifecycle."""
+        s = SpanStore(capacity=1)
+        s.record("x", "received")
+        s.record("other", "received")       # evicts x (tombstone #1)
+        assert s.was_evicted("x")
+        s.record("x", "received")           # x revives, evicts other
+        assert not s.was_evicted("x")
+        s.record("other2", "received")      # evicts x again (#2)
+        assert s.was_evicted("x")
+        # Churn enough rids to cycle the tombstone deque: x's live
+        # tombstone must not be collateral damage of its stale copy.
+        for i in range(5):
+            s.record(f"churn-{i}", "received")
+        assert s.was_evicted("x")
+
+    def test_requeue_past_capacity_counts_evictions(self):
+        s = SpanStore(capacity=1)
+        s.record("r1", "finished")
+        batch = s.drain_finished()
+        s.record("r2", "received")      # fills the ring
+        s.requeue(batch)                # evicts r2
+        assert s.was_evicted("r2")
+        assert s.eviction_count() == 1
+
+    def test_tail_finished_only(self):
+        s = SpanStore()
+        s.record("live", "received")
+        for rid in ("f1", "f2"):
+            s.record(rid, "received")
+            s.record(rid, "finished")
+        tail = s.tail(10, finished_only=True)
+        assert [t["request_id"] for t in tail] == ["f1", "f2"]
+        assert [t["request_id"] for t in s.tail(1, finished_only=True)] \
+            == ["f2"]
+        assert len(s.tail(10)) == 3
+
 
 class TestTracerSatellite:
     """RequestTracer: size-capped rotation + the close()/trace() race."""
@@ -294,3 +473,218 @@ class TestTracerSatellite:
         tr.trace("r", {"stage": "after-reopen"})
         tr.close()
         assert os.path.getsize(path) > size
+
+
+class TestEventLog:
+    def test_closed_taxonomy(self):
+        log = EventLog()
+        with pytest.raises(ValueError):
+            log.emit("not_a_declared_type")
+        seq = log.emit("instance_join", instance="w:1")
+        assert seq == 1
+        assert log.counts()["instance_join"] == 1
+
+    def test_every_catalog_type_is_documented(self):
+        """The taxonomy table in docs/OBSERVABILITY.md names every
+        declared type (the doc-side half of the event-catalog gate)."""
+        import os
+        doc_path = os.path.join(os.path.dirname(__file__), "..",
+                                "docs", "OBSERVABILITY.md")
+        with open(doc_path, encoding="utf-8") as f:
+            doc = f.read()
+        for t in EVENT_TYPES:
+            assert t in doc, f"event type {t!r} missing from " \
+                             f"docs/OBSERVABILITY.md"
+
+    def test_ring_bounds_with_visible_drops(self):
+        log = EventLog(capacity=3)
+        for i in range(5):
+            log.emit("redispatch", n=i)
+        assert len(log) == 3
+        assert log.dropped == 2
+        events = log.since(0)
+        # seq numbers keep counting; the gap IS the truncation signal.
+        assert [e["seq"] for e in events] == [3, 4, 5]
+        assert log.latest_seq == 5
+
+    def test_since_and_limit(self):
+        log = EventLog()
+        for i in range(6):
+            log.emit("role_flip", i=i)
+        assert [e["seq"] for e in log.since(4)] == [5, 6]
+        # limit pages from the OLDEST match: a poller resuming from
+        # next_since walks the ring page by page without skipping.
+        assert [e["seq"] for e in log.since(0, limit=2)] == [1, 2]
+        assert [e["seq"] for e in log.since(2, limit=2)] == [3, 4]
+        assert log.since(99) == []
+        # Attrs are carried and copies are independent.
+        ev = log.since(5)[0]
+        ev["attrs"]["i"] = "mutated"
+        assert log.since(5)[0]["attrs"]["i"] == 5
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestSloEngine:
+    def _engine(self, events=None):
+        clock = FakeClock()
+        traffic = {"good": 0.0, "total": 0.0}
+        cfg = SloConfig(
+            objectives=[SloObjective("e2e", 0.9, 100.0)],
+            fast_window_s=10.0, slow_window_s=60.0, tick_s=1.0)
+        eng = SloEngine(cfg, lambda: {"e2e": (traffic["good"],
+                                              traffic["total"])},
+                        events=events, clock=clock)
+        return eng, clock, traffic
+
+    def test_no_traffic_burns_nothing(self):
+        eng, clock, _ = self._engine()
+        clock.advance(5)
+        state = eng.tick()
+        obj = state["objectives"]["e2e"]
+        assert obj["windows"]["fast"]["burn_rate"] == 0.0
+        assert not obj["breach"]
+        assert state["breached"] == []
+
+    def test_breach_opens_and_closes_with_events(self):
+        log = EventLog()
+        eng, clock, traffic = self._engine(events=log)
+        # All-good traffic: burn 0.
+        traffic["good"] = traffic["total"] = 100
+        clock.advance(2)
+        assert not eng.tick()["objectives"]["e2e"]["breach"]
+        # 50 all-bad requests: window bad fraction spikes, budget is
+        # 10% → burn >> 1 in both windows → breach opens.
+        traffic["total"] += 50
+        clock.advance(2)
+        state = eng.tick()
+        obj = state["objectives"]["e2e"]
+        assert obj["breach"]
+        assert obj["windows"]["fast"]["burn_rate"] > 1.0
+        assert state["breached"] == ["e2e"]
+        opens = [e for e in log.since(0)
+                 if e["type"] == "slo_breach_open"]
+        assert len(opens) == 1
+        assert opens[0]["attrs"]["objective"] == "e2e"
+        # Re-ticking while still breached must NOT re-emit the open.
+        clock.advance(2)
+        eng.tick()
+        assert len([e for e in log.since(0)
+                    if e["type"] == "slo_breach_open"]) == 1
+        # Good traffic resumes; once the bad burst ages out of the fast
+        # window the breach closes.
+        traffic["good"] += 500
+        traffic["total"] += 500
+        clock.advance(12)               # past the fast window
+        state = eng.tick()
+        assert not state["objectives"]["e2e"]["breach"]
+        closes = [e for e in log.since(0)
+                  if e["type"] == "slo_breach_close"]
+        assert len(closes) == 1
+
+    def test_attainment_windows_delta_not_cumulative(self):
+        eng, clock, traffic = self._engine()
+        traffic["good"] = traffic["total"] = 1000   # ancient good epoch
+        clock.advance(2)
+        eng.tick()
+        clock.advance(60)               # age it past both windows
+        eng.tick()
+        traffic["total"] += 10          # 10 recent all-bad requests
+        clock.advance(2)
+        obj = eng.tick()["objectives"]["e2e"]
+        # The fast window sees ONLY the recent bad traffic, not the
+        # cumulative 99% attainment.
+        assert obj["windows"]["fast"]["attainment"] == pytest.approx(0.0)
+        assert obj["attainment_total"] > 0.9
+
+    def test_export_renders_valid_series(self):
+        eng, clock, traffic = self._engine()
+        traffic["good"] = traffic["total"] = 5
+        clock.advance(2)
+        eng.tick()
+        r = Registry()
+        eng.export(r)
+        text = r.render()
+        assert validate_exposition(text) == []
+        assert 'xllm_slo_attainment{objective="e2e"} 1' in text
+        assert 'xllm_slo_breach{objective="e2e"} 0' in text
+        assert 'xllm_slo_burn_rate{objective="e2e",window="fast"} 0' \
+            in text
+
+
+class TestAnomalyDetector:
+    def _sig(self, name="w:1", age=0.1, deadline=10.0, p99=None, kv=0.0):
+        return InstanceSignal(name=name, heartbeat_age_s=age,
+                              heartbeat_deadline_s=deadline,
+                              step_ms_p99=p99, kv_usage=kv)
+
+    def test_heartbeat_gap_opens_and_closes(self):
+        log = EventLog()
+        det = AnomalyDetector(events=log)
+        det.observe([self._sig(age=30.0)])
+        assert [a["type"] for a in det.active()] == ["heartbeat_gap"]
+        det.observe([self._sig(age=0.5)])
+        assert det.active() == []
+        types = [e["type"] for e in log.since(0)]
+        assert types == ["anomaly_open", "anomaly_close"]
+
+    def test_kv_saturation_threshold(self):
+        det = AnomalyDetector(kv_sat=0.9)
+        det.observe([self._sig(kv=0.95)])
+        assert [a["type"] for a in det.active()] == ["kv_saturation"]
+        det.observe([self._sig(kv=0.5)])
+        assert det.active() == []
+
+    def test_step_regression_vs_rolling_baseline(self):
+        log = EventLog()
+        det = AnomalyDetector(events=log, step_factor=3.0,
+                              min_baseline_samples=3)
+        # Baseline warms on steady samples; no anomaly.
+        for _ in range(4):
+            det.observe([self._sig(p99=10.0)])
+        assert det.active() == []
+        # 10x regression against the ~10ms baseline: opens.
+        det.observe([self._sig(p99=100.0)])
+        active = det.active()
+        assert [a["type"] for a in active] == ["step_ms_regression"]
+        assert active[0]["baseline_ms"] == pytest.approx(10.0)
+        # The regressed sample must NOT have polluted the baseline:
+        # recovery closes it against the same ~10ms baseline.
+        det.observe([self._sig(p99=12.0)])
+        assert det.active() == []
+
+    def test_baseline_needs_warmup(self):
+        det = AnomalyDetector(min_baseline_samples=3)
+        det.observe([self._sig(p99=10.0)])
+        det.observe([self._sig(p99=500.0)])     # only 1 prior sample
+        assert det.active() == []
+
+    def test_removed_instance_closes_anomalies(self):
+        log = EventLog()
+        det = AnomalyDetector(events=log)
+        det.observe([self._sig(name="w:1", age=30.0)])
+        det.observe([])                          # instance gone
+        assert det.active() == []
+        closes = [e for e in log.since(0) if e["type"] == "anomaly_close"]
+        assert closes and closes[0]["attrs"]["reason"] \
+            == "instance_removed"
+
+    def test_export_rebuilds_gauge(self):
+        det = AnomalyDetector()
+        det.observe([self._sig(name="w:1", kv=0.99)])
+        r = Registry()
+        det.export(r)
+        assert ('xllm_anomaly_active{type="kv_saturation",'
+                'instance="w:1"} 1') in r.render()
+        det.observe([self._sig(name="w:1", kv=0.1)])
+        det.export(r)
+        assert "xllm_anomaly_active{" not in r.render()

@@ -156,6 +156,14 @@ class TestPositiveControls:
         # Interpolated name fragments still resolve to a stable key.
         assert f"{p}::render_metrics::xllm_fixture_*" in keys
 
+    def test_event_catalog_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "event-catalog")
+        p = "xllm_service_tpu/service/bad_events.py"
+        # Undeclared type: the closed taxonomy rejects it.
+        assert f"{p}::event::fixture_bogus_event" in keys
+        # Non-literal type: unverifiable statically — also a finding.
+        assert f"{p}::event-nonliteral" in keys
+
 
 class TestNoFalsePositives:
     def test_clean_fixture_is_clean(self):

@@ -1,0 +1,15 @@
+"""event-catalog positive controls: emit sites the closed taxonomy
+must reject — an undeclared type and a non-literal type."""
+
+
+class Service:
+    def __init__(self, events):
+        self.events = events
+
+    def undeclared(self):
+        # Type not in the fixture EVENT_TYPES catalog.
+        self.events.emit("fixture_bogus_event", detail=1)
+
+    def nonliteral(self, kind):
+        # Cannot be verified statically against the catalog.
+        self.events.emit(kind, detail=2)

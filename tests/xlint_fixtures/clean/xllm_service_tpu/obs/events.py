@@ -1,0 +1,5 @@
+"""Fixture catalog for the event-catalog rule (clean tree)."""
+
+EVENT_TYPES = (
+    "fixture_ok_event",
+)

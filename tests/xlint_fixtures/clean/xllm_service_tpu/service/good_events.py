@@ -1,0 +1,20 @@
+"""event-catalog near-misses that must NOT fire."""
+
+
+class Service:
+    def __init__(self, events, bus, logger):
+        self.events = events
+        self.bus = bus
+        self.logger = logger
+
+    def fine(self, payload):
+        # Declared type: clean.
+        self.events.emit("fixture_ok_event", detail=payload)
+        # .emit() on receivers that are NOT an event log (signal buses,
+        # loggers) are out of the rule's namespace.
+        self.bus.emit("whatever_shape_it_likes")
+        self.logger.emit(payload)
+        # A local variable named like an event log still counts — and
+        # this one uses a declared type, so it stays clean.
+        events = self.events
+        events.emit("fixture_ok_event")

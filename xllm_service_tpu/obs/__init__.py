@@ -12,14 +12,24 @@ Dependency-free (stdlib only), thread-safe, shared by both planes:
 - ``spans``: per-request stage timelines in a bounded ring, merged
   across the service/worker boundary by correlation id and served at
   ``GET /admin/trace/<request_id>``.
+- ``events``: the bounded structured cluster event log (closed
+  taxonomy, ``event-catalog`` xlint rule) behind ``GET /admin/events``.
+- ``slo``: the judgment layer — multi-window SLO burn-rate engine and
+  the watchdog's anomaly detector, behind ``GET /admin/slo`` and the
+  ``xllm_slo_*`` / ``xllm_anomaly_active`` series.
 
 See docs/OBSERVABILITY.md for the full series and stage catalogue.
 """
 
+from xllm_service_tpu.obs.events import (           # noqa: F401
+    EVENT_TYPES, EventLog)
 from xllm_service_tpu.obs.expfmt import (           # noqa: F401
-    histogram_quantile, parse_exposition, validate_exposition)
+    fraction_le_from_buckets, histogram_fraction_le, histogram_quantile,
+    parse_exposition, validate_exposition)
 from xllm_service_tpu.obs.metrics import (          # noqa: F401
     DEFAULT_LATENCY_BUCKETS_MS, Counter, Gauge, Histogram, Registry,
     default_registry)
+from xllm_service_tpu.obs.slo import (              # noqa: F401
+    AnomalyDetector, InstanceSignal, SloConfig, SloEngine, SloObjective)
 from xllm_service_tpu.obs.spans import (            # noqa: F401
     REQUEST_ID_HEADER, SERVICE_STAGES, WORKER_STAGES, SpanStore)

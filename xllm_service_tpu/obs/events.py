@@ -1,0 +1,121 @@
+"""Structured cluster event log: what happened to the cluster, when.
+
+The judgment layer's memory. Metrics answer "how much"; spans answer
+"where did THIS request's time go"; this log answers "what did the
+CLUSTER do in the 30 seconds before that request failed" — instance
+lifecycle, role flips, master elections, redispatches, SLO breaches and
+watchdog anomalies, in one bounded, ordered, queryable ring.
+
+Design rules:
+
+- CLOSED taxonomy. ``EVENT_TYPES`` below is the complete catalogue;
+  ``emit()`` rejects anything else at runtime, and the ``event-catalog``
+  xlint rule rejects it statically at every ``*.emit("<type>", ...)``
+  call site (tools/xlint/rules.py). An event type nobody declared is an
+  event type no dashboard, alert, or post-mortem tool knows to look for.
+- Bounded and always on: a ring of ``capacity`` events (size it with
+  ``XLLM_EVENT_RING`` at the call site that builds the log); the oldest
+  events drop, with a drop counter so truncation is visible.
+- Dependency-free and thread-safe; rank ``obs.events`` in the
+  utils/locks.py table — ``emit`` never calls out, so it is safe under
+  every serving-path lock (instance books, scheduler registry).
+
+Queried at ``GET /admin/events?since=<seq>`` on the service plane and
+snapshotted whole into ``GET /admin/debug_bundle``; per-type totals are
+mirrored into the registry as ``xllm_events_total{type}`` at scrape
+time (the scrape-time-mirror pattern, obs/metrics.py docstring).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, List, Optional
+
+from xllm_service_tpu.utils.locks import make_lock
+
+# The complete event taxonomy (docs/OBSERVABILITY.md documents each).
+# Adding a type means adding it HERE (the event-catalog xlint rule pins
+# every emit site to this tuple) and documenting it.
+EVENT_TYPES = (
+    "instance_join",        # worker key seen in the store (pending)
+    "instance_confirm",     # registration complete: instance routable
+    "instance_remove",      # lease expiry / store DELETE cleanup
+    "role_flip",            # dynamic PD role change
+    "master_elected",       # this replica won/took over the election
+    "master_lease_lost",    # this replica's lease expired under it
+    "redispatch",           # request re-routed after a worker refusal
+    "slo_breach_open",      # an SLO objective's burn rate crossed open
+    "slo_breach_close",     # ... and recovered
+    "anomaly_open",         # watchdog opened a per-instance anomaly
+    "anomaly_close",        # ... and it cleared
+)
+
+DEFAULT_CAPACITY = 1024
+
+
+class EventLog:
+    """Bounded, ordered, thread-safe structured event ring."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self.capacity = max(1, int(capacity))
+        self._lock = make_lock("obs.events", 80)
+        self._ring: "collections.deque[Dict[str, Any]]" = \
+            collections.deque(maxlen=self.capacity)
+        self._seq = 0
+        self._counts: Dict[str, int] = {t: 0 for t in EVENT_TYPES}
+        self._dropped = 0
+
+    def emit(self, type: str, **attrs: Any) -> int:
+        """Append one event; returns its sequence number. ``type`` MUST
+        be in ``EVENT_TYPES`` (closed taxonomy — see module doc)."""
+        if type not in EVENT_TYPES:
+            raise ValueError(
+                f"event type {type!r} is not in the obs/events.py "
+                f"catalog {EVENT_TYPES}")
+        with self._lock:
+            self._seq += 1
+            if len(self._ring) == self.capacity:
+                self._dropped += 1
+            self._ring.append({"seq": self._seq, "type": type,
+                               "t_wall": time.time(), "attrs": attrs})
+            self._counts[type] += 1
+            return self._seq
+
+    # -- querying -------------------------------------------------------
+    def since(self, seq: int = 0,
+              limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Events with sequence number > ``seq``, oldest first — the
+        OLDEST ``limit`` matches, so a poller resuming from the last
+        seq it saw walks the whole ring page by page (newest-first
+        truncation would permanently skip stored events the cursor can
+        never reach). A reader that fell behind the RING sees a gap in
+        seq numbers — that IS the signal that events were dropped, not
+        silently papered over."""
+        with self._lock:
+            out = [dict(e, attrs=dict(e["attrs"]))
+                   for e in self._ring if e["seq"] > seq]
+        if limit is not None and len(out) > limit:
+            out = out[:limit]
+        return out
+
+    def counts(self) -> Dict[str, int]:
+        """Per-type emitted totals since boot (NOT ring occupancy) —
+        the ``xllm_events_total{type}`` scrape-time mirror source."""
+        with self._lock:
+            return dict(self._counts)
+
+    @property
+    def latest_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted from the ring (visible truncation)."""
+        with self._lock:
+            return self._dropped
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)

@@ -26,8 +26,26 @@ Sample = Tuple[str, Dict[str, str], float]
 
 
 def _unescape(v: str) -> str:
-    return v.replace("\\n", "\n").replace('\\"', '"') \
-        .replace("\\\\", "\\")
+    """Left-to-right scan, one escape at a time — sequential
+    str.replace passes mangle a literal backslash followed by ``n``
+    (``\\\\n`` would lose its backslash to the ``\\n`` pass first)."""
+    out: List[str] = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ('"', "\\"):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
 
 
 def _parse_value(s: str) -> float:
@@ -173,13 +191,35 @@ def quantile_from_buckets(bs: List[Tuple[float, float]], q: float
     return prev_edge
 
 
-def histogram_quantile(text_or_samples, family: str, q: float,
-                       labels: Optional[Dict[str, str]] = None
-                       ) -> Optional[float]:
-    """Estimate the q-quantile of one scraped histogram series.
-    ``labels`` selects the series (``le`` excluded); None matches only
-    the unlabeled series. Returns None when the series is absent or
-    empty."""
+def fraction_le_from_buckets(bs: List[Tuple[float, float]],
+                             threshold: float) -> Optional[float]:
+    """Fraction of observations ≤ ``threshold`` — the inverse of
+    ``quantile_from_buckets``, with the same linear interpolation inside
+    the containing bucket. ``bs`` is ``[(le, cumulative_count)]`` sorted
+    ascending, ending with ``+Inf``. Mass in the ``+Inf`` bucket counts
+    as ABOVE any finite threshold (the conservative reading). None on an
+    empty series. This is the one copy of the SLO-attainment arithmetic:
+    the live engine (obs/slo.py) and bench.py's scraped
+    ``slo_*_attainment`` fields both run it."""
+    if not bs or bs[-1][1] <= 0:
+        return None
+    total = bs[-1][1]
+    prev_edge, prev_cum = 0.0, 0.0
+    for le, cum in bs:
+        if threshold <= le:
+            if math.isinf(le):
+                return prev_cum / total
+            in_bucket = cum - prev_cum
+            width = le - prev_edge
+            frac = (threshold - prev_edge) / width if width > 0 else 1.0
+            return (prev_cum + in_bucket * frac) / total
+        prev_edge, prev_cum = le, cum
+    return 1.0
+
+
+def _series_buckets(text_or_samples, family: str,
+                    labels: Optional[Dict[str, str]]
+                    ) -> List[Tuple[float, float]]:
     if isinstance(text_or_samples, str):
         samples, _types, _errors = parse_exposition(text_or_samples)
     else:
@@ -191,4 +231,26 @@ def histogram_quantile(text_or_samples, family: str, q: float,
                 and _series_key(slabels) == want:
             bs.append((_parse_value(slabels["le"]), value))
     bs.sort(key=lambda p: p[0])
-    return quantile_from_buckets(bs, q)
+    return bs
+
+
+def histogram_fraction_le(text_or_samples, family: str, threshold: float,
+                          labels: Optional[Dict[str, str]] = None
+                          ) -> Optional[float]:
+    """Fraction of one scraped histogram series' observations ≤
+    ``threshold`` (SLO attainment against a latency target). Series
+    selection matches ``histogram_quantile``; None when the series is
+    absent or empty."""
+    return fraction_le_from_buckets(
+        _series_buckets(text_or_samples, family, labels), threshold)
+
+
+def histogram_quantile(text_or_samples, family: str, q: float,
+                       labels: Optional[Dict[str, str]] = None
+                       ) -> Optional[float]:
+    """Estimate the q-quantile of one scraped histogram series.
+    ``labels`` selects the series (``le`` excluded); None matches only
+    the unlabeled series. Returns None when the series is absent or
+    empty."""
+    return quantile_from_buckets(
+        _series_buckets(text_or_samples, family, labels), q)

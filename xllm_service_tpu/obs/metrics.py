@@ -52,8 +52,13 @@ _NAME_OK = "abcdefghijklmnopqrstuvwxyz" \
 def _fmt(v: float) -> str:
     """Prometheus sample value: integers without a trailing .0 (existing
     series like ``xllm_service_instances 1`` are grepped as substrings by
-    tests and ops scripts), shortest-repr floats otherwise."""
+    tests and ops scripts), shortest-repr floats otherwise. NaN renders
+    as ``NaN`` (valid exposition) — one NaN sample (e.g. a heartbeat
+    shipping a NaN load value through JSON) must poison its own series,
+    not 500 every future /metrics render via ``int(nan)``."""
     f = float(v)
+    if math.isnan(f):
+        return "NaN"
     if math.isinf(f):
         return "+Inf" if f > 0 else "-Inf"
     if f == int(f) and abs(f) < 1e15:
@@ -212,18 +217,16 @@ class Histogram(_Family):
             s = self._series.get(self._key(labels))
             return s.total if s is not None else 0
 
-    def quantile(self, q: float, **labels: Any) -> Optional[float]:
-        """Estimated q-quantile of one label set — the same
-        ``le``-bucket interpolation the scrape side runs
-        (``expfmt.quantile_from_buckets``: one copy of the arithmetic,
-        so in-memory and scraped quantiles cannot drift). None with no
-        observations; samples past the last finite edge clamp to it."""
-        if not 0.0 <= q <= 1.0:
-            raise ValueError(f"quantile {q} outside [0, 1]")
-        from xllm_service_tpu.obs.expfmt import quantile_from_buckets
+    def cumulative(self, **labels: Any
+                   ) -> Optional[List[Tuple[float, float]]]:
+        """Snapshot of one label set's cumulative bucket counts as
+        ``[(le, cum), ...]`` ending with the ``+Inf`` bucket — the exact
+        shape ``expfmt``'s bucket arithmetic consumes, so the SLO
+        engine's window deltas and a scraped dashboard read the SAME
+        numbers. None when the series has never been observed."""
         with self._lock:
             s = self._series.get(self._key(labels))
-            if s is None or s.total == 0:
+            if s is None:
                 return None
             counts = list(s.counts)
             total = s.total
@@ -233,6 +236,20 @@ class Histogram(_Family):
             cum += c
             bs.append((edge, float(cum)))
         bs.append((math.inf, float(total)))
+        return bs
+
+    def quantile(self, q: float, **labels: Any) -> Optional[float]:
+        """Estimated q-quantile of one label set — the same
+        ``le``-bucket interpolation the scrape side runs
+        (``expfmt.quantile_from_buckets``: one copy of the arithmetic,
+        so in-memory and scraped quantiles cannot drift). None with no
+        observations; samples past the last finite edge clamp to it."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        from xllm_service_tpu.obs.expfmt import quantile_from_buckets
+        bs = self.cumulative(**labels)
+        if bs is None or bs[-1][1] == 0:
+            return None
         return quantile_from_buckets(bs, q)
 
     def render(self, out: List[str]) -> None:

@@ -57,17 +57,50 @@ class SpanStore:
         # service, which drains nothing; only workers export — stays
         # bounded by ``capacity`` instead of leaking one id per request.
         self._finished: set = set()
+        # Eviction visibility: a counter (exported as
+        # ``xllm_span_evictions_total`` on both planes) plus a small
+        # tombstone ring of evicted rids, so ``GET /admin/trace/<id>``
+        # can answer "this id existed but fell off the ring" (HTTP 410)
+        # instead of an indistinguishable 404.
+        self._evictions = 0
+        self._tombstones: "collections.deque[str]" = collections.deque(
+            maxlen=max(64, self.capacity // 8))
+        self._tombstone_set: set = set()
 
     # -- recording ------------------------------------------------------
+    def _evict_overflow_locked(self) -> None:
+        while len(self._spans) > self.capacity:
+            old_rid, _old = self._spans.popitem(last=False)
+            self._finished.discard(old_rid)
+            self._evictions += 1
+            if len(self._tombstones) == self._tombstones.maxlen:
+                dead = self._tombstones.popleft()
+                self._tombstone_set.discard(dead)
+            self._tombstones.append(old_rid)
+            self._tombstone_set.add(old_rid)
+
     def _span_locked(self, rid: str) -> Dict[str, Any]:
         span = self._spans.get(rid)
         if span is None:
             span = {"request_id": rid, "attrs": {}, "events": []}
             self._spans[rid] = span
-            while len(self._spans) > self.capacity:
-                old_rid, _old = self._spans.popitem(last=False)
-                self._finished.discard(old_rid)
+            # A tombstoned rid coming back to life is live again, not
+            # evicted (e.g. a worker requeue landing after an eviction).
+            self._revive_tombstone_locked(rid)
+            self._evict_overflow_locked()
         return span
+
+    def _revive_tombstone_locked(self, rid: str) -> None:
+        """Clear a tombstone for an rid that is live again — from BOTH
+        structures: a stale deque copy left behind would, on its
+        eventual popleft, discard the set entry backing a NEWER
+        tombstone of the same rid."""
+        if rid in self._tombstone_set:
+            self._tombstone_set.discard(rid)
+            try:
+                self._tombstones.remove(rid)
+            except ValueError:
+                pass
 
     def annotate(self, rid: str, **attrs: Any) -> None:
         with self._lock:
@@ -145,6 +178,43 @@ class SpanStore:
             return None
         return 1000.0 * (ts[b] - ts[a])
 
+    def eviction_count(self) -> int:
+        """Spans dropped by ring overflow since construction (the
+        ``xllm_span_evictions_total`` scrape-time mirror source)."""
+        with self._lock:
+            return self._evictions
+
+    def was_evicted(self, rid: str) -> bool:
+        """True when ``rid`` once held a span that the ring evicted (and
+        it has not been re-created since). Bounded memory: only the most
+        recent evictions are remembered — beyond the tombstone ring an
+        evicted id degrades back to an honest 404."""
+        with self._lock:
+            return rid in self._tombstone_set and rid not in self._spans
+
+    def tail(self, n: int, finished_only: bool = False
+             ) -> List[Dict[str, Any]]:
+        """Deep-enough copies of the newest ``n`` spans (insertion
+        order), optionally only those that reached ``finished`` on some
+        plane — the debug bundle's recent-request evidence. Copies are
+        taken UNDER the lock (like ``get``): live spans mutate
+        concurrently, and the incident-debug path must not 500 on a
+        dict-changed-during-iteration race."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for span in reversed(self._spans.values()):
+                if finished_only and not any(
+                        e.get("stage") == "finished"
+                        for e in span["events"]):
+                    continue
+                out.append({"request_id": span["request_id"],
+                            "attrs": dict(span["attrs"]),
+                            "events": [dict(e) for e in span["events"]]})
+                if len(out) >= n:
+                    break
+        out.reverse()
+        return out
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._spans)
@@ -177,7 +247,6 @@ class SpanStore:
                 self._spans[rid] = {"request_id": rid,
                                     "attrs": dict(rec.get("attrs", {})),
                                     "events": list(rec.get("events", []))}
+                self._revive_tombstone_locked(rid)
                 self._finished.add(rid)
-                while len(self._spans) > self.capacity:
-                    evicted_rid, _ = self._spans.popitem(last=False)
-                    self._finished.discard(evicted_rid)
+                self._evict_overflow_locked()

@@ -44,6 +44,7 @@ from xllm_service_tpu.config import (
 from xllm_service_tpu.nlp.tokenizer import (
     IncrementalDecoder, Tokenizer, TokenizerFactory)
 from xllm_service_tpu.obs import REQUEST_ID_HEADER, Registry, SpanStore
+from xllm_service_tpu.obs.expfmt import quantile_from_buckets
 from xllm_service_tpu.runtime.engine import Engine, EngineRequest, StepOutput
 from xllm_service_tpu.service.coordination import (
     KEY_MASTER_ADDR, CoordinationStore, instance_prefix)
@@ -472,6 +473,13 @@ class Worker:
         # heartbeat still in flight can land after the drain heartbeat
         # and re-mark the models awake at the router.
         self._hb_lock = make_lock("worker.hb", 5)
+        # Last-shipped cumulative step_ms bucket counts per
+        # (model, phase): the heartbeat diffs against these so
+        # LatencyMetrics.step_ms_p99 is the p99 of the steps since the
+        # PREVIOUS beat (a recent signal the service watchdog can
+        # baseline), not a boot-cumulative average that dampens
+        # regressions. Touched only under _hb_lock.
+        self._hb_step_cum: Dict[Any, List[Any]] = {}
         self._decode_to_service = False
         # Heartbeat / generation-push target. Starts at the configured
         # address and FOLLOWS the store's master advertisement
@@ -1450,6 +1458,13 @@ class Worker:
         if self.kv_migration_seconds > 0:
             obs.gauge("xllm_worker_kv_migration_gbps").set(
                 self.kv_migration_bytes / self.kv_migration_seconds / 1e9)
+        # Span-ring eviction visibility (same series name as the service
+        # plane — each plane's registry owns its own ring).
+        obs.counter(
+            "xllm_span_evictions_total",
+            "request spans dropped by ring overflow "
+            "(size the ring with XLLM_SPAN_RING)").set_total(
+            self.spans.eviction_count())
         return Response(body=obs.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
@@ -2634,6 +2649,35 @@ class Worker:
             num_preemptions=lm["num_preemptions"],
             moe_dropped_tokens=lm.get("moe_dropped_tokens", 0))
 
+    def _recent_step_p99(self, rt: ModelRuntime):
+        """p99 of ``xllm_worker_step_ms`` over the samples recorded
+        since the last DELIVERED heartbeat, merged across
+        prefill+decode — computed from the same registry buckets
+        /metrics exports (the delta of cumulative bucket counts is
+        itself a histogram). Returns ``(p99, pending_baseline)``; the
+        caller commits the baseline only after the service acks the
+        beat, so a failed send folds its interval into the next one
+        instead of silently dropping a regression window. p99 0.0 = no
+        steps ran in the interval (no signal)."""
+        h = self.obs.histogram(
+            "xllm_worker_step_ms", "wall time of one engine step",
+            labelnames=("model", "phase"))
+        pending: Dict[Any, List[Any]] = dict(self._hb_step_cum)
+        merged: Optional[List[Any]] = None
+        for phase in ("prefill", "decode"):
+            cur = h.cumulative(model=rt.model, phase=phase)
+            if cur is None:
+                continue
+            prev = self._hb_step_cum.get((rt.model, phase))
+            pending[(rt.model, phase)] = cur
+            delta = cur if prev is None else \
+                [(le, c - p) for (le, c), (_le, p) in zip(cur, prev)]
+            merged = delta if merged is None else \
+                [(le, a + b) for (le, a), (_le, b) in zip(merged, delta)]
+        if not merged or merged[-1][1] <= 0:
+            return 0.0, pending
+        return quantile_from_buckets(merged, 0.99) or 0.0, pending
+
     def _send_heartbeat_locked(self) -> bool:
         rt = self.primary_runtime()
         load = LoadMetrics()
@@ -2647,6 +2691,11 @@ class Worker:
             ev = rt.engine.drain_kvcache_event()
             stored = [h.hex() for h in ev.stored]
             removed = [h.hex() for h in ev.removed]
+        # Recent step-time p99 rides the existing latency payload so the
+        # service watchdog can baseline per-instance step regressions;
+        # the bucket baseline commits only on a delivered beat (below).
+        self._latency.step_ms_p99, step_baseline = \
+            self._recent_step_p99(rt)
         # Finished request spans ride the heartbeat to the service's
         # span ring (same correlation id); an undelivered batch is
         # requeued so the next beat retries it.
@@ -2666,6 +2715,8 @@ class Worker:
             raise
         if status != 200:
             self.spans.requeue(span_batch)
+        else:
+            self._hb_step_cum = step_baseline
         return status == 200
 
     def heartbeat_once(self) -> None:

@@ -90,11 +90,17 @@ class InstanceMgr:
                  is_master: bool = True,
                  control: Optional[ControlFn] = None,
                  model_memory_gb: Optional[Dict[str, float]] = None,
-                 serverless_models: Optional[List[str]] = None) -> None:
+                 serverless_models: Optional[List[str]] = None,
+                 events=None) -> None:
         self.opts = opts
         self.store = store
         self.is_master = is_master
         self.control = control or _default_control
+        # Cluster event log (obs.EventLog, optional): instance lifecycle
+        # and role flips land there so a post-mortem can replay what the
+        # cluster did. emit() never calls out and ranks above this
+        # class's lock, so emitting under _lock is safe.
+        self.events = events
         self.model_memory_gb = dict(model_memory_gb
                                     or DEFAULT_MODEL_MEMORY_GB)
         # Models every instance should hold as sleeping replicas
@@ -164,6 +170,10 @@ class InstanceMgr:
                 elif self.is_master:
                     self._pending[name] = meta
                     self._removed.discard(name)
+                    if self.events is not None:
+                        self.events.emit(
+                            "instance_join", instance=name,
+                            instance_type=meta.instance_type.value)
                 else:
                     # Replica path: heartbeats flow to the MASTER only, so
                     # a replica must treat store presence as registration
@@ -245,6 +255,11 @@ class InstanceMgr:
             inst.model_states[m] = MODEL_AWAKE
         if self.serverless_models and not from_bootstrap and self.is_master:
             self._fork_master_and_sleep(inst)
+        if self.events is not None:
+            self.events.emit(
+                "instance_confirm", instance=meta.name,
+                instance_type=inst.instance_type.value,
+                models=list(meta.models), bootstrap=from_bootstrap)
         logger.info("registered instance %s type=%s models=%s",
                     meta.name, inst.instance_type.value, meta.models)
         return inst
@@ -329,6 +344,8 @@ class InstanceMgr:
             if name in self._mix_names:
                 self._mix_names.discard(name)
                 self._reseat_mix()
+        if self.events is not None:
+            self.events.emit("instance_remove", instance=name)
         logger.info("removed instance %s", name)
         if self.on_removed is not None:
             try:
@@ -387,6 +404,24 @@ class InstanceMgr:
     def address_of(self, name: str) -> Optional[str]:
         inst = self.get(name)
         return inst.meta.rpc_address if inst else None
+
+    def instance_table(self) -> List[Dict[str, Any]]:
+        """Flight-recorder view of the live instance books (the debug
+        bundle's cluster evidence): role, addresses, model states, last
+        load/latency, and heartbeat age per registered instance."""
+        now = time.monotonic()
+        with self._lock:
+            return [{"name": name,
+                     "instance_type": s.instance_type.value,
+                     "declared_type": s.meta.instance_type.value,
+                     "rpc_address": s.meta.rpc_address,
+                     "models": dict(s.model_states),
+                     "load": s.load.to_json(),
+                     "latency": s.latency.to_json(),
+                     "heartbeat_age_s": round(now - s.last_heartbeat, 3),
+                     "flipped_from": s.flipped_from.value
+                     if s.flipped_from else None}
+                    for name, s in self._instances.items()]
 
     def instance_info(self, name: str) -> Optional[Dict[str, Any]]:
         inst = self.get(name)
@@ -572,6 +607,10 @@ class InstanceMgr:
             return False
         inst.flipped_from = None if inst.flipped_from else from_type
         self._set_role(name, to_type)
+        if self.events is not None:
+            self.events.emit("role_flip", instance=name,
+                             from_type=from_type.value,
+                             to_type=to_type.value)
         logger.info("flipped %s %s→%s", name, from_type.value, to_type.value)
         # Fire-and-forget worker notification; on TPU a flip just changes
         # which compiled program set the worker prioritizes (SURVEY.md §7.1).

@@ -99,10 +99,17 @@ class LoadMetrics:
 
 @dataclasses.dataclass
 class LatencyMetrics:
-    """Recent max TTFT / inter-token latency (types.h:118-127)."""
+    """Recent max TTFT / inter-token latency (types.h:118-127), plus the
+    worker's recent engine-step p99 (additive vs. the reference): the
+    p99 of ``xllm_worker_step_ms`` over the samples since the previous
+    heartbeat, computed worker-side from the same registry buckets the
+    worker's /metrics exports. The service watchdog compares it against
+    a per-instance rolling baseline to open ``step_ms_regression``
+    anomalies. 0.0 = no steps ran in the interval (no signal)."""
 
     recent_max_ttft_ms: float = 0.0
     recent_max_tbt_ms: float = 0.0
+    step_ms_p99: float = 0.0
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

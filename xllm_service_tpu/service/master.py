@@ -13,12 +13,14 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import threading
 from typing import Dict, List, Optional
 
 from xllm_service_tpu.config import (
     LoadBalancePolicyType, ServiceOptions, options_from_env)
+from xllm_service_tpu.obs import EventLog
 from xllm_service_tpu.service.coordination import CoordinationStore
 from xllm_service_tpu.service.coordination_net import connect_store
 from xllm_service_tpu.service.http_service import HttpService
@@ -38,15 +40,26 @@ class Master:
         self.opts = opts
         self.store = store if store is not None \
             else connect_store(opts.etcd_addr)
+        # One cluster event log for the whole service process, created
+        # BEFORE the scheduler so the initial master election is the
+        # first thing it records (ring size: XLLM_EVENT_RING).
+        self.events = EventLog(
+            capacity=int(os.environ.get("XLLM_EVENT_RING", "1024")))
         self.scheduler = Scheduler(
             opts, self.store, control=control,
             model_memory_gb=model_memory_gb,
-            serverless_models=serverless_models)
-        self.http_service = HttpService(opts, self.scheduler)
+            serverless_models=serverless_models, events=self.events)
+        self.http_service = HttpService(opts, self.scheduler,
+                                        events=self.events)
         self.rpc_service = RpcService(opts, self.scheduler)
         # Worker span stages arrive on the RPC plane (heartbeats) but
         # are queried on the HTTP plane (/admin/trace/<id>): one store.
         self.rpc_service.spans = self.http_service.spans
+        # Routing audits land on the request's span and in
+        # xllm_schedule_decisions_total — the scheduler is built first,
+        # so it learns the HTTP plane's span ring/registry here.
+        self.scheduler.spans = self.http_service.spans
+        self.scheduler.obs = self.http_service.obs
 
         # Both servers enforce opts.max_concurrency as live admission
         # control (the reference's brpc max_concurrency backpressure,
@@ -79,6 +92,9 @@ class Master:
     def start(self) -> "Master":
         self._http_srv.start()
         self._rpc_srv.start()
+        # SLO burn-rate evaluation + per-instance anomaly watchdog
+        # (obs/slo.py; cadence XLLM_SLO_TICK_S).
+        self.http_service.start_watchdog()
         # Advertise reachable addresses through the store (current master
         # publishes them; replicas re-publish on takeover) so workers can
         # follow a failover without a fronting VIP.
@@ -94,6 +110,9 @@ class Master:
         self._stopped.set()
         self._http_srv.stop()
         self._rpc_srv.stop()
+        # After the servers: in-flight requests drain first, so the
+        # watchdog/tracer shutdown can't drop their last writes.
+        self.http_service.close()
         self.scheduler.stop()
 
     def wait(self) -> None:

@@ -65,10 +65,20 @@ class Scheduler:
     def __init__(self, opts: ServiceOptions, store: CoordinationStore,
                  control=None,
                  model_memory_gb: Optional[Dict[str, float]] = None,
-                 serverless_models: Optional[List[str]] = None) -> None:
+                 serverless_models: Optional[List[str]] = None,
+                 events=None) -> None:
         self.opts = opts
         self.store = store
         self.service_id = f"service-{short_uuid(8)}"
+        # Decision-attributable observability (all optional — standalone
+        # schedulers in unit tests run without them): the cluster event
+        # log (obs.EventLog, shared with InstanceMgr/HttpService), and
+        # the service plane's span ring + registry, wired by Master
+        # AFTER HttpService exists so routing audits land on the
+        # request's span and in xllm_schedule_decisions_total.
+        self.events = events
+        self.spans = None
+        self.obs = None
 
         self.tokenizer: Tokenizer = TokenizerFactory.create_tokenizer(
             opts.tokenizer_path)
@@ -83,11 +93,14 @@ class Scheduler:
         if not self.is_master:
             self._master_watch = store.add_watch(
                 KEY_MASTER, self._on_master_event)
+        elif self.events is not None:
+            self.events.emit("master_elected", service_id=self.service_id,
+                             how="boot")
 
         self.instance_mgr = InstanceMgr(
             opts, store, is_master=self.is_master, control=control,
             model_memory_gb=model_memory_gb,
-            serverless_models=serverless_models)
+            serverless_models=serverless_models, events=self.events)
         self.kvcache_mgr = GlobalKVCacheMgr(
             store, block_size=opts.block_size, seed=opts.murmur_hash3_seed,
             is_master=self.is_master)
@@ -120,6 +133,10 @@ class Scheduler:
             self.instance_mgr.is_master = True
             self.kvcache_mgr.is_master = True
             self._publish_addresses()
+            if self.events is not None:
+                self.events.emit("master_elected",
+                                 service_id=self.service_id,
+                                 how="takeover")
             logger.info("%s took over as master", self.service_id)
 
     def announce(self, rpc_addr: str, http_addr: str) -> None:
@@ -154,6 +171,10 @@ class Scheduler:
             # deleted/foreign key.
             return
         was_master = self.is_master
+        if self.events is not None:
+            self.events.emit("master_lease_lost",
+                             service_id=self.service_id,
+                             was_master=was_master)
         self._lease_id = self.store.lease_grant(
             max(3 * self.opts.heartbeat_interval_s, 3.0))
         if self.store.compare_create(KEY_MASTER, self.service_id,
@@ -162,6 +183,10 @@ class Scheduler:
             self.instance_mgr.is_master = True
             self.kvcache_mgr.is_master = True
             self._publish_addresses()   # old advert died with the lease
+            if self.events is not None:
+                self.events.emit("master_elected",
+                                 service_id=self.service_id,
+                                 how="re-elected")
             if was_master:
                 logger.warning("%s lease expired but election was vacant; "
                                "re-elected with a fresh lease",
@@ -223,26 +248,41 @@ class Scheduler:
         if request.model:
             self.instance_mgr.update_model_heat(request.model)
 
+        # Per-decision audit: the policy fills in the candidates it
+        # considered, each candidate's score terms, and the winner;
+        # _record_decision attaches it to the request's span and bumps
+        # xllm_schedule_decisions_total{policy,reason}.
+        audit: Dict[str, Any] = {}
         # Serverless multi-model path: the target must have the model awake
         # (scheduler.cpp:100-119 → instance_mgr.cpp:1087-1185).
         if request.model and self.instance_mgr.serverless_models:
             name = self.instance_mgr.get_awake_instance(request.model)
+            how = "awake"
             if name is None:
                 name = self.instance_mgr.allocate_instance_for_model(
                     request.model)
+                how = "allocated"
+            audit.update(policy="serverless", model=request.model,
+                         reason=how if name else "no_instance",
+                         prefill={"winner": name},
+                         decode={"winner": name})
             if name is None:
+                self._record_decision(request, audit)
                 return Status(StatusCode.UNAVAILABLE,
                               f"no instance for model {request.model}"
                               ), Routing()
             routing = Routing(prefill_name=name, decode_name=name)
         else:
             prefill, decode = self.lb_policy.select_instances_pair(
-                request.token_ids)
+                request.token_ids, audit=audit)
             if prefill is None:
+                audit.setdefault("reason", "no_instance")
+                self._record_decision(request, audit)
                 return Status(StatusCode.UNAVAILABLE,
                               "no prefill instance available"), Routing()
             routing = Routing(prefill_name=prefill,
                               decode_name=decode or prefill)
+        self._record_decision(request, audit)
 
         # EPD: route the encode stage to a dedicated ENCODE instance when
         # one exists (the prefill worker falls back to local encode).
@@ -256,6 +296,26 @@ class Scheduler:
             routing.prefill_name, RequestPhase.SCHEDULE,
             len(request.token_ids))
         return Status(), routing
+
+    def _record_decision(self, request: Request,
+                         audit: Dict[str, Any]) -> None:
+        """Attach the routing audit to the request's span and aggregate
+        the outcome. Observe-only: never influences the decision. A
+        re-dispatch runs schedule() again and overwrites the span's
+        ``schedule_decision`` with the decision that actually stuck (the
+        ``redispatch`` stage event keeps the history)."""
+        if not audit:
+            return
+        if self.spans is not None:
+            self.spans.annotate(request.service_request_id,
+                                schedule_decision=audit)
+        if self.obs is not None:
+            self.obs.counter(
+                "xllm_schedule_decisions_total",
+                "routing decisions by policy and outcome",
+                labelnames=("policy", "reason")).inc(
+                policy=audit.get("policy", "unknown"),
+                reason=audit.get("reason", "unknown"))
 
     # ------------------------------------------------------------------
     # Registry + token fan-in (scheduler.cpp:197-302, 329-372)
@@ -357,6 +417,20 @@ class Scheduler:
     def num_tracked_requests(self) -> int:
         with self._req_lock:
             return len(self._requests)
+
+    def tracked_requests_info(self) -> List[Dict[str, Any]]:
+        """Flight-recorder view of the live request registry (the debug
+        bundle's in-flight evidence): who is running where, for how
+        long, and how far along."""
+        now = time.monotonic()
+        with self._req_lock:
+            return [{"service_request_id": srid,
+                     "age_s": round(now - t.created, 3),
+                     "prefill": t.prefill_name,
+                     "decode": t.decode_name,
+                     "prefill_done": t.prefill_done,
+                     "num_generated": t.num_generated}
+                    for srid, t in self._requests.items()]
 
     def _on_instance_removed(self, name: str) -> None:
         self.kvcache_mgr.remove_instance(name)

@@ -81,7 +81,11 @@ class RequestTracer:
                     self._written = 0
             self._f.write(line + "\n")
             self._f.flush()
-            self._written += len(line) + 1
+            # Byte length, not character count: the cap is seeded from
+            # os.fstat and documented as XLLM_TRACE_MAX_BYTES — counting
+            # characters lets multibyte traces overrun the 2x-cap disk
+            # bound.
+            self._written += len(line.encode("utf-8")) + 1
             if self.max_bytes > 0 and self._written >= self.max_bytes:
                 self._rotate_locked()
 

@@ -21,6 +21,14 @@ increasing):
     50  (reserved: coordination store — uses a Condition-wrapped RLock,
          checked by its own single-class discipline, see coordination.py)
     60  coordination_net, etcd.watches  — store transports
+    78  obs.slo                         — SLO burn-rate engine state
+                                          (emits events 80, reads
+                                          registry 93 while held)
+    79  obs.watchdog                    — anomaly-detector state (emits
+                                          events 80 while held)
+    80  obs.events                      — cluster event ring (never
+                                          calls out; safe under every
+                                          serving-path lock)
     90  leaves: tracer, misc.pool (fan-in), worker.vision
     91  misc.counter                    — may be bumped under any leaf
     92  httpd.connpool                  — guards the keep-alive dict only
