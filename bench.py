@@ -218,7 +218,12 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
         ecfg = EngineConfig(page_size=16, num_pages=pages,
                             max_model_len=256, max_batch_size=batch,
                             max_prefill_tokens=256,
-                            prefill_buckets=(32, 64))
+                            prefill_buckets=(32, 64),
+                            # Honored on the tiny path too so a CPU run
+                            # can demonstrate the decode-pipeline
+                            # overlap counters (default stays 1).
+                            decode_steps=int(os.environ.get(
+                                "BENCH_DECODE_STEPS", "1")))
     else:
         cfg = ModelConfig.llama3_1b()
         # Throughput shape: decode is weight-read-bound, so tokens/s (and
@@ -369,6 +374,13 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     throughput = tokens / elapsed
     steps = tokens / batch              # decode iterations per sequence
     tpot_ms = 1000.0 * elapsed / max(steps, 1)
+    # Pipelined-decode overlap health (speculative next-burst dispatch,
+    # XLLM_DECODE_PIPELINE): how often burst k+1 was consumed as
+    # speculated, and the host-side device-idle bubble per burst
+    # boundary the pipeline did not cover — with the split
+    # device_wait/host_copy readback phases (detail.phases below) this
+    # is what proves the overlap win on the next BENCH_*.json.
+    overlap = engine.overlap_metrics()
 
     # MFU: FLOPs each decoded token costs = 2 * matmul params + attention
     # reads over the mean live context (2 FLOPs/MAC; QK^T and PV each touch
@@ -412,7 +424,8 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
                     "XLLM_PALLAS_DECODE_V3", "XLLM_PALLAS_DECODE_V4",
                     "XLLM_PALLAS_DECODE_V5", "XLLM_PALLAS_PREFILL")},
                 **{k: os.environ.get(k, "auto") for k in
-                   ("XLLM_PALLAS_KV", "XLLM_WRITE_THEN_ATTEND")}},
+                   ("XLLM_PALLAS_KV", "XLLM_WRITE_THEN_ATTEND",
+                    "XLLM_DECODE_PIPELINE")}},
             # The .bench_env lines applied at startup (key → effective
             # value), so a headline number records which hands-free
             # conviction gates were active when it was measured.
@@ -426,6 +439,13 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             "boot_warm_s": round(boot_warm_s, 2),
             "recompiles_post_warmup": recompiles_post_warmup,
             "tpot_ms": round(tpot_ms, 3),
+            "decode_overlap_hit_ratio": round(overlap["hit_ratio"], 4),
+            "decode_device_idle_ms_per_burst": round(
+                overlap["device_idle_ms_per_burst"], 3),
+            "decode_overlap_spec": {
+                "dispatches": overlap["spec_dispatches"],
+                "hits": overlap["spec_hits"],
+                "rollbacks": overlap["spec_rollbacks"]},
             # Latency trajectory, scraped from the service-plane
             # histogram series recorded above (log-bucket interpolated
             # — dashboard-faithful, not exact order statistics).
@@ -454,8 +474,11 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             if peak > 0 else None,
             "model_flops_per_token": flops_per_token,
             "chip_peak_flops": peak,
-            # Host/device wall-time attribution per engine phase (dispatch
-            # is async-call time; readback absorbs device compute + RTT).
+            # Host/device wall-time attribution per engine phase
+            # (dispatch is async-call time; the former conflated
+            # readback is split into device_wait — wait for the
+            # producing computation — vs host_copy — the residual
+            # device→host materialization).
             "phases": engine.phase_report(),
             **({"kv_migration": kv_probe} if kv_probe else {}),
             "reference_baseline": "target_tpot=50ms SLO default "

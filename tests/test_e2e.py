@@ -214,6 +214,74 @@ class TestEndToEnd:
                 w.stop()
             master.stop()
 
+    def test_streamed_chat_decode_pipeline_overlap(self, store):
+        """Pipelined decode end to end: a streamed chat over a
+        fused-burst engine (decode_steps=4, XLLM_DECODE_PIPELINE auto-on)
+        completes with the usual SSE grammar, and the worker /metrics
+        plane proves the overlap engaged — speculative dispatch/hit
+        counters nonzero, hit-ratio gauge exported, burst readbacks
+        overlapping live next-burst dispatches."""
+        import http.client
+        opts = ServiceOptions(
+            http_port=0, rpc_port=0, num_output_pools=4,
+            load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
+            block_size=16, heartbeat_interval_s=0.2,
+            master_upload_interval_s=0.2)
+        master = Master(opts, store=store).start()
+        # Large pages so the speculative burst's KV writes stay covered
+        # by the already-grown tables on most bursts (speculation never
+        # allocates — a page-boundary burst skips, the rest hit).
+        ecfg = EngineConfig(page_size=64, num_pages=32, max_model_len=256,
+                            max_batch_size=4, max_prefill_tokens=256,
+                            prefill_buckets=(32, 64, 128),
+                            decode_steps=4)
+        wopts = WorkerOptions(
+            port=0, instance_type=InstanceType.DEFAULT,
+            service_addr=master.rpc_address, model="tiny",
+            heartbeat_interval_s=0.2, lease_ttl_s=2.0)
+        worker = Worker(wopts, store, engine_cfg=ecfg).start()
+        try:
+            assert wait_until(
+                lambda: len(master.scheduler.instance_mgr
+                            .prefill_instances()) == 1, timeout=15.0)
+            payloads = list(iter_sse_events(http_stream(
+                "POST", master.http_address, "/v1/chat/completions",
+                {"model": "tiny",
+                 "messages": [{"role": "user", "content": "overlap"}],
+                 "max_tokens": 24, "temperature": 0.0, "stream": True,
+                 "ignore_eos": True}, timeout=120.0)))
+            assert payloads[-1] == "[DONE]"
+            objs = [json.loads(p) for p in payloads[:-1]]
+            assert objs[0]["object"] == "chat.completion.chunk"
+            assert any(o["choices"] and o["choices"][0]["finish_reason"]
+                       == "length" for o in objs)
+
+            eng = worker.primary_runtime().engine
+            assert eng.phase_counts["decode_multi.spec_hit"] > 0
+            assert eng.phase_counts["decode_multi.spec_dispatch"] > 0
+            conn = http.client.HTTPConnection(worker.name, timeout=10)
+            conn.request("GET", "/metrics")
+            wtext = conn.getresponse().read().decode()
+            conn.close()
+            hits = next(
+                float(line.split()[-1]) for line in wtext.splitlines()
+                if line.startswith('xllm_worker_decode_overlap_spec_'
+                                   'total{model="tiny",result="hit"}'))
+            assert hits > 0
+            ratio = next(
+                float(line.split()[-1]) for line in wtext.splitlines()
+                if line.startswith('xllm_worker_decode_overlap_hit_'
+                                   'ratio{model="tiny"}'))
+            assert ratio > 0
+            # The split readback attribution reaches the phase ledger.
+            assert 'phase="decode_multi.device_wait"' in wtext
+            assert 'phase="decode_multi.host_copy"' in wtext
+            from xllm_service_tpu.obs import validate_exposition
+            assert validate_exposition(wtext) == []
+        finally:
+            worker.stop()
+            master.stop()
+
     def test_request_span_timeline_cross_plane(self, store):
         """Stream a chat completion, then pull its merged span timeline
         from /admin/trace/<id>: the full service-plane stage sequence

@@ -678,9 +678,13 @@ class TestMultiStepDecode:
         from xllm_service_tpu.utils.types import SamplingParams
 
         mcfg = ModelConfig.tiny(vocab_size=64)
+        # decode_pipeline off: an accepted SPECULATIVE burst bypasses
+        # the resident snapshot entirely (it never re-packs) — this test
+        # exercises the fallback resident-reuse mechanism itself.
         ecfg = EngineConfig(page_size=8, num_pages=32, max_model_len=64,
                             max_batch_size=2, max_prefill_tokens=64,
-                            prefill_buckets=(16,), decode_steps=4)
+                            prefill_buckets=(16,), decode_steps=4,
+                            decode_pipeline=False)
         eng = Engine(mcfg, ecfg, seed=0)
         eng.add_request(EngineRequest(
             request_id="r", token_ids=list(range(1, 9)),
@@ -795,6 +799,234 @@ def test_multi_step_lookahead_clamped_to_max_tokens():
             toks.extend(out.new_token_ids)
     assert len(toks) == 2
     assert eng.num_preemptions == 0
+
+
+# ---------------------------------------------------------------------------
+# Pipelined decode: speculative next-burst dispatch + async readback
+# ---------------------------------------------------------------------------
+
+class TestDecodePipeline:
+    """XLLM_DECODE_PIPELINE: burst k+1 dispatched speculatively from
+    burst k's device carries before burst k's readback. Contract pinned
+    here: token ids, logprobs and finish reasons are BYTE-IDENTICAL with
+    the pipeline on vs off across the whole rollback matrix (mid-burst
+    EOS, preempt-during-speculation, admit-invalidates-carries,
+    max_tokens expiry on the burst boundary), and the overlap counters
+    prove the speculation actually engaged."""
+
+    MCFG = ModelConfig.tiny(vocab_size=64)
+
+    @staticmethod
+    def _ecfg(pipeline, **kw):
+        d = dict(page_size=32, num_pages=16, max_model_len=64,
+                 max_batch_size=2, max_prefill_tokens=64,
+                 prefill_buckets=(8, 16, 32), decode_steps=4,
+                 decode_pipeline=pipeline)
+        d.update(kw)
+        return EngineConfig(**d)
+
+    @staticmethod
+    def _drive(eng, feed=None):
+        """Drive to idle; returns {rid: (tokens, logprobs, reason)}.
+        ``feed`` = optional {step_number: EngineRequest} mid-run admits
+        (applied before that step runs — the step count is identical on
+        vs off, one burst per step, so both paths see the same admit
+        point)."""
+        toks, lps, reasons = {}, {}, {}
+        fed = set()
+        step = 0
+        while eng.has_work() or (feed and len(fed) < len(feed)):
+            step += 1
+            if feed and step in feed and step not in fed:
+                eng.add_request(feed[step])
+                fed.add(step)
+            for out in eng.step():
+                toks.setdefault(out.request_id, []).extend(
+                    out.new_token_ids)
+                lps.setdefault(out.request_id, []).extend(out.logprobs)
+                if out.finished:
+                    reasons[out.request_id] = out.finish_reason
+            assert step < 200, "engine did not drain"
+        return {r: (toks[r], lps[r], reasons.get(r)) for r in toks}
+
+    @pytest.fixture(scope="class")
+    def greedy_probe(self):
+        """The tiny model's greedy continuation of prompt 1..8 — shared
+        across the matrix (every Engine construction re-compiles its
+        programs on CPU; the probe only needs to run once)."""
+        eng = Engine(self.MCFG, self._ecfg(False), seed=0)
+        eng.add_request(EngineRequest(
+            request_id="p", token_ids=list(range(1, 9)),
+            sampling=SamplingParams(max_tokens=12, temperature=0.0,
+                                    ignore_eos=True)))
+        return self._drive(eng)["p"][0]
+
+    def test_default_resolution_and_env_override(self, monkeypatch):
+        assert Engine(self.MCFG, self._ecfg(None),
+                      seed=0).decode_pipeline is True
+        assert Engine(self.MCFG, self._ecfg(None, decode_steps=1),
+                      seed=0).decode_pipeline is False
+        # Forcing the pipeline on cannot override single-step decode
+        # (there are no burst carries to speculate from).
+        assert Engine(self.MCFG, self._ecfg(True, decode_steps=1),
+                      seed=0).decode_pipeline is False
+        monkeypatch.setenv("XLLM_DECODE_PIPELINE", "0")
+        assert Engine(self.MCFG, self._ecfg(None),
+                      seed=0).decode_pipeline is False
+        monkeypatch.setenv("XLLM_DECODE_PIPELINE", "1")
+        assert Engine(self.MCFG, self._ecfg(None),
+                      seed=0).decode_pipeline is True
+
+    def test_rollback_mid_burst_eos(self, greedy_probe):
+        """A sequence hitting EOS mid-burst while a speculative burst is
+        in flight: the speculation rolls back, the continuing sequence's
+        stream (and the finisher's truncation) are byte-identical to the
+        pipeline-off run."""
+        eos = greedy_probe[1]  # second generated token → stops mid-burst
+
+        def run(pipeline):
+            e = Engine(self.MCFG, self._ecfg(pipeline), seed=0)
+            e.add_request(EngineRequest(
+                request_id="a", token_ids=list(range(1, 9)),
+                sampling=SamplingParams(max_tokens=12, temperature=0.0),
+                eos_token_ids=(eos,)))
+            e.add_request(EngineRequest(
+                request_id="b", token_ids=list(range(3, 11)),
+                sampling=SamplingParams(max_tokens=12, temperature=0.0,
+                                        ignore_eos=True)))
+            return self._drive(e), e.overlap_metrics()
+
+        on, om_on = run(True)
+        off, om_off = run(False)
+        assert on == off
+        assert on["a"][2] == FinishReason.STOP
+        assert len(on["a"][0]) == 2          # prefill token + the eos
+        assert on["b"][2] == FinishReason.LENGTH
+        assert om_on["spec_rollbacks"] >= 1, om_on
+        assert om_off["spec_dispatches"] == 0
+
+    def test_rollback_admit_invalidates_carries(self):
+        """A mid-generation admission drains the in-flight speculation
+        (the admit path must not wait behind it) and the next step
+        prefills the new prompt; both sequences' streams match the
+        pipeline-off run exactly."""
+        req_b = EngineRequest(
+            request_id="b", token_ids=list(range(3, 11)),
+            sampling=SamplingParams(max_tokens=16, temperature=0.0,
+                                    ignore_eos=True))
+
+        def run(pipeline):
+            e = Engine(self.MCFG, self._ecfg(pipeline), seed=0)
+            e.add_request(EngineRequest(
+                request_id="a", token_ids=list(range(1, 9)),
+                sampling=SamplingParams(max_tokens=16, temperature=0.0,
+                                        ignore_eos=True)))
+            out = self._drive(e, feed={3: dataclasses.replace(req_b)})
+            return out, e.overlap_metrics()
+
+        on, om_on = run(True)
+        off, om_off = run(False)
+        assert on == off
+        assert len(on["b"][0]) == 16
+        assert om_on["spec_rollbacks"] >= 1, om_on
+        assert om_on["spec_hits"] >= 1, om_on
+
+    def test_rollback_preempt_during_speculative_burst(self):
+        """An online admission that must preempt the decoding offline
+        sequence (page pressure) while its speculative burst is in
+        flight: rollback + recompute-on-readmit, streams identical to
+        the pipeline-off run."""
+        req_on = EngineRequest(
+            request_id="on", token_ids=list(range(3, 11)),
+            sampling=SamplingParams(max_tokens=4, temperature=0.0,
+                                    ignore_eos=True))
+
+        def run(pipeline):
+            # 1 usable page: admitting "on" forces the offline preempt.
+            e = Engine(self.MCFG,
+                       self._ecfg(pipeline, num_pages=2,
+                                  max_prefill_tokens=32), seed=0)
+            e.add_request(EngineRequest(
+                request_id="off", token_ids=list(range(1, 9)),
+                sampling=SamplingParams(max_tokens=12, temperature=0.0,
+                                        ignore_eos=True),
+                offline=True))
+            out = self._drive(e, feed={3: dataclasses.replace(req_on)})
+            return out, e.num_preemptions, e.overlap_metrics()
+
+        on, pre_on, om_on = run(True)
+        off, pre_off, om_off = run(False)
+        assert on == off
+        assert pre_on == pre_off == 1
+        assert len(on["off"][0]) == 12       # finished after readmission
+        assert om_on["spec_rollbacks"] >= 1, om_on
+
+    def test_no_speculation_across_max_tokens_boundary(self):
+        """max_tokens expiry exactly on a burst boundary is PREDICTABLE:
+        the engine skips speculating that burst instead of dispatching a
+        guaranteed rollback, and streams still match pipeline-off."""
+
+        def run(pipeline):
+            e = Engine(self.MCFG, self._ecfg(pipeline), seed=0)
+            # gen 1 (prefill) + 4 + 4 = 9: expires at burst 2's end.
+            e.add_request(EngineRequest(
+                request_id="a", token_ids=list(range(1, 9)),
+                sampling=SamplingParams(max_tokens=9, temperature=0.0,
+                                        ignore_eos=True)))
+            e.add_request(EngineRequest(
+                request_id="b", token_ids=list(range(3, 11)),
+                sampling=SamplingParams(max_tokens=21, temperature=0.0,
+                                        ignore_eos=True)))
+            return self._drive(e), e.overlap_metrics(), e
+
+        on, om_on, e_on = run(True)
+        off, _, _ = run(False)
+        assert on == off
+        assert on["a"][2] == FinishReason.LENGTH
+        assert len(on["a"][0]) == 9
+        assert len(on["b"][0]) == 21
+        # The boundary expiry was skipped, not rolled back — and later
+        # b-only bursts still speculate.
+        assert om_on["spec_rollbacks"] == 0, om_on
+        assert om_on["spec_hits"] >= 1, om_on
+        # "Overlap demonstrably engaged" (acceptance gate): the burst
+        # readback split into device_wait/host_copy, and host_copy ran
+        # while a speculative next-burst dispatch was live (every
+        # spec_dispatch is issued before its burst's readback blocks).
+        pc = e_on.phase_counts
+        assert pc["decode_multi.spec_dispatch"] >= 1
+        assert pc["decode_multi.device_wait"] >= 1
+        assert pc["decode_multi.host_copy"] >= 1
+        assert "decode_multi.readback" not in pc  # renamed, not doubled
+        # Covered boundaries book 0 idle; the ledger counts them all.
+        assert pc["decode_multi.device_idle"] >= pc["decode_multi.spec_hit"]
+        assert om_on["spec_dispatches"] == om_on["spec_hits"]
+        assert om_on["hit_ratio"] > 0
+
+    def test_top_logprobs_identical_with_pipeline(self):
+        """Top-k alternatives ride the speculative burst's gated
+        transfer: identical top_logprobs on vs off (and the transfer is
+        skipped entirely when nobody asked — same outputs either way)."""
+
+        def run(pipeline, want):
+            e = Engine(self.MCFG,
+                       self._ecfg(pipeline, num_top_logprobs=2), seed=0)
+            e.add_request(EngineRequest(
+                request_id="r", token_ids=list(range(1, 9)),
+                sampling=SamplingParams(max_tokens=8, temperature=0.0,
+                                        ignore_eos=True, logprobs=want,
+                                        top_logprobs=2)))
+            tops = []
+            while e.has_work():
+                for out in e.step():
+                    if out.top_logprobs:
+                        tops.extend(out.top_logprobs)
+            return tops
+
+        on = run(True, True)
+        assert on == run(False, True)
+        assert len(on) == 8
+        assert run(True, False) == []     # transfer gated off: no tops
 
 
 # ---------------------------------------------------------------------------

@@ -141,6 +141,12 @@ class TestPositiveControls:
         assert f"{p}::body::np.asarray" in keys, \
             "scan bodies must be treated as traced"
 
+    def test_hot_loop_readback_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "hot-loop-blocking-readback")
+        p = "xllm_service_tpu/runtime/engine.py"
+        assert f"{p}::Engine._run_decode_fixture::np.asarray" in keys
+        assert f"{p}::Engine._run_decode_fixture::jax.device_get" in keys
+
     def test_service_hygiene_controls(self, bad_findings):
         keys = self._keys(bad_findings, "service-hygiene")
         p = "xllm_service_tpu/service/httpd.py"

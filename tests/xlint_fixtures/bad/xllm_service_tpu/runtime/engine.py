@@ -6,6 +6,7 @@ here or it proves nothing. Never imported — parsed only."""
 import functools
 
 import jax
+import numpy as np
 
 
 def _step_undonated(params, packed, kv):
@@ -50,3 +51,13 @@ def _pin():
 
 # Correct shape: donated AND pinned (via splat) — must NOT fire.
 _jit_good = jax.jit(_step_good, donate_argnums=(2,), **_pin())
+
+
+class Engine:
+    """Positive control for hot-loop-blocking-readback: step methods
+    blocking the host on device readbacks instead of routing them
+    through the async _read_host helper."""
+
+    def _run_decode_fixture(self, fused, mdrop):
+        host = np.asarray(fused)            # finding: blocking readback
+        return host, jax.device_get(mdrop)  # finding: explicit transfer

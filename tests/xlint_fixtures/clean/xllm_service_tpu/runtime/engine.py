@@ -4,6 +4,8 @@ zero findings expected. Never imported."""
 import functools
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 
 def _pin(n_in, kv_in, n_out):
@@ -25,3 +27,24 @@ def _no_kv(params, packed):
 
 # No KV-pool args — donation not required.
 _jit_other = jax.jit(_no_kv)
+
+
+class Engine:
+    """hot-loop-blocking-readback near-misses: host-side packing, jnp
+    uploads, and the sanctioned helper itself — zero findings."""
+
+    def _read_host(self, *arrays):
+        # The one sanctioned blocking point, exempt by name.
+        return tuple(np.asarray(a) for a in arrays)
+
+    def _run_decode_fixture(self, packed):
+        staged = np.ascontiguousarray(packed)   # host pack, not readback
+        dev = jnp.asarray(staged)               # upload, not a readback
+        host, = self._read_host(dev)            # the sanctioned route
+        return host
+
+
+def _module_level_readback(x):
+    # Outside the Engine class: host-side caller, out of the rule's
+    # scope (and not jit-reachable, so traced-host-sync skips it too).
+    return np.asarray(x)

@@ -868,6 +868,76 @@ class TracedHostSyncRule:
 
 
 # ---------------------------------------------------------------------------
+# Rule 5b: hot-loop-blocking-readback
+# ---------------------------------------------------------------------------
+
+_ENGINE_FILE = "xllm_service_tpu/runtime/engine.py"
+# The one sanctioned blocking-readback site: Engine._read_host starts an
+# async device→host copy, waits with split device_wait/host_copy
+# attribution, then materializes. Every other np.asarray/device_get on a
+# device array inside an Engine method either hides a host sync in the
+# serving loop (the BENCH_TPU_LAST.json 5.9 s "readback" that was really
+# unattributed device wait) or belongs on a justified allowlist entry
+# for a genuinely cold path (PD KV export).
+_READBACK_HELPER = "_read_host"
+
+
+class HotLoopBlockingReadbackRule:
+    name = "hot-loop-blocking-readback"
+    describe = ("blocking device→host readbacks (np.asarray / np.array "
+                "/ jax.device_get) inside Engine methods must go "
+                "through Engine._read_host (async copy + "
+                "device_wait/host_copy split attribution); cold paths "
+                "need a justified allowlist entry")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in tree.modules:
+            if mod.path != _ENGINE_FILE:
+                continue
+            aliases = _module_aliases(mod)
+            for node in mod.tree.body:
+                if not (isinstance(node, ast.ClassDef)
+                        and node.name == "Engine"):
+                    continue
+                for item in node.body:
+                    if not isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                        continue
+                    if item.name == _READBACK_HELPER:
+                        continue
+                    findings.extend(self._scan(mod, item, aliases))
+        return findings
+
+    def _scan(self, mod: Module, fndef: ast.AST,
+              aliases: Dict[str, Set[str]]) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(fndef):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)):
+                continue
+            if f.attr in ("asarray", "array") and \
+                    f.value.id in aliases["np"]:
+                what = f"np.{f.attr}"
+            elif f.attr == "device_get" and f.value.id in aliases["jax"]:
+                what = "jax.device_get"
+            else:
+                continue
+            out.append(Finding(
+                rule=self.name, path=mod.path, line=node.lineno,
+                key=f"{mod.path}::Engine.{fndef.name}::{what}",
+                message=f"{what} in Engine.{fndef.name}() blocks the "
+                        f"host on a device readback — route it through "
+                        f"Engine.{_READBACK_HELPER}() (async copy + "
+                        f"device_wait/host_copy split attribution), or "
+                        f"allowlist the cold path with a justification"))
+        return out
+
+
+# ---------------------------------------------------------------------------
 # Rule 6: service-hygiene
 # ---------------------------------------------------------------------------
 
@@ -1161,6 +1231,7 @@ RULES = [
     LockRankRule(),
     FlagRegistryRule(),
     TracedHostSyncRule(),
+    HotLoopBlockingReadbackRule(),
     ServiceHygieneRule(),
     MetricsRegistryRule(),
     EventCatalogRule(),

@@ -749,6 +749,18 @@ class EngineConfig:
     # on (pallas.enabled()), off on the pure-XLA path, resolved at
     # Engine init. Env XLLM_WRITE_THEN_ATTEND=0/1 overrides.
     write_then_attend: Optional[bool] = None
+    # Pipelined decode: after dispatching fused burst k, start its
+    # device→host copy asynchronously and — while the batch snapshot
+    # still matches — speculatively dispatch burst k+1 from the
+    # device-resident carries BEFORE blocking on burst k's readback, so
+    # the host post (stop detection, page bookkeeping, prefix-cache
+    # registration) overlaps the next burst's device compute. A wrong
+    # speculation (finish/preempt/admit) is discarded and re-dispatched
+    # from host truth; token streams are byte-identical either way
+    # (pinned in tests/test_engine.py). None = auto: on when
+    # decode_steps > 1, off for single-step decode. Env
+    # XLLM_DECODE_PIPELINE=0/1 overrides.
+    decode_pipeline: Optional[bool] = None
 
     def __post_init__(self) -> None:
         if self.max_model_len % self.page_size != 0:
@@ -768,6 +780,11 @@ class EngineConfig:
             self.write_then_attend = False
         elif env in ("1", "true", "yes"):
             self.write_then_attend = True
+        env = os.environ.get("XLLM_DECODE_PIPELINE", "").strip()
+        if env in ("0", "false", "no"):
+            self.decode_pipeline = False
+        elif env in ("1", "true", "yes"):
+            self.decode_pipeline = True
 
 
 def load_json(path: str) -> Dict[str, Any]:

@@ -316,6 +316,23 @@ class Engine:
         # copy of the active+page-table block with its host mirror for
         # change detection. (docs/PERF_NOTES.md "ranked next steps" #1.)
         self._resident: Optional[Dict[str, Any]] = None
+        # Pipelined decode (docs/PERF_NOTES.md round 7): after burst k is
+        # dispatched, burst k+1 can be dispatched SPECULATIVELY from the
+        # device-resident carries before burst k's outputs are read back
+        # — burst k's host post then overlaps burst k+1's device
+        # compute. None = auto: on whenever bursts are fused.
+        dp = getattr(engine_cfg, "decode_pipeline", None)
+        if dp is None:
+            dp = engine_cfg.decode_steps > 1
+        self.decode_pipeline = bool(dp) and engine_cfg.decode_steps > 1
+        # The in-flight speculative burst's device handles + the batch
+        # snapshot it assumed (consumed or rolled back by the next step).
+        self._pending: Optional[Dict[str, Any]] = None
+        # Device-idle attribution: when the previous decode burst's
+        # outputs became ready, and whether a speculative burst was
+        # already covering the gap to the next dispatch.
+        self._last_burst_ready_t: Optional[float] = None
+        self._last_burst_step = -1
         self._dev_active_pt: Optional[jnp.ndarray] = None
         self._active_pt_mirror: Optional[np.ndarray] = None
         # Output-token histogram [B, V] for presence/frequency penalties;
@@ -409,6 +426,62 @@ class Engine:
                 out[name] = cnt
         return out
 
+    def _read_host(self, phase: str, *arrays):
+        """Blocking device→host readback with split attribution.
+
+        The conflated ``*.readback`` phase absorbed device compute AND
+        the host copy in one number, which made TPOT attribution
+        misleading in every TPU bench so far (BENCH_TPU_LAST.json:
+        5,946 ms of ``decode_multi.readback`` that was mostly the device
+        running the scan). Here an async copy is started for every live
+        array first (idempotent — the pipelined decode path already
+        started them at dispatch), ``<phase>.device_wait`` absorbs the
+        wait for the producing computation, and ``<phase>.host_copy``
+        the residual materialization. Returns one host array (or None)
+        per input. The xlint ``hot-loop-blocking-readback`` rule pins
+        this as the only blocking-readback site in the step methods."""
+        live = [a for a in arrays if a is not None]
+        t0 = time.monotonic()
+        _start_host_copy(*live)
+        if live:
+            jax.block_until_ready(live)
+        t1 = time.monotonic()
+        out = tuple(None if a is None else np.asarray(a) for a in arrays)
+        t2 = time.monotonic()
+        self.phase_times[phase + ".device_wait"] += t1 - t0
+        self.phase_counts[phase + ".device_wait"] += 1
+        self.phase_times[phase + ".host_copy"] += t2 - t1
+        self.phase_counts[phase + ".host_copy"] += 1
+        return out
+
+    @staticmethod
+    def _want_top(top_ids, seqs) -> bool:
+        """Transfer gate for the top-k alternative blocks: they cross
+        to host only when some sequence in ``seqs`` asked for logprobs.
+        The device-side compute gate (``num_top_logprobs``) stays as-is
+        — the host round-trip is what the gate saves."""
+        return top_ids is not None and any(
+            s.req.sampling.logprobs for s in seqs)
+
+    def overlap_metrics(self) -> Dict[str, Any]:
+        """Decode-pipeline health for the obs registry / bench JSON:
+        speculation dispatch/hit/rollback counts, the hit ratio, and
+        host-side device-idle ms per burst boundary (0 for boundaries a
+        speculative burst covered)."""
+        disp = self.phase_counts.get("decode_multi.spec_dispatch", 0)
+        hits = self.phase_counts.get("decode_multi.spec_hit", 0)
+        idle_n = self.phase_counts.get("decode_multi.device_idle", 0)
+        idle_s = self.phase_times.get("decode_multi.device_idle", 0.0)
+        return {
+            "spec_dispatches": disp,
+            "spec_hits": hits,
+            "spec_rollbacks": self.phase_counts.get(
+                "decode_multi.spec_rollback", 0),
+            "hit_ratio": hits / disp if disp else 0.0,
+            "device_idle_ms_per_burst":
+                1e3 * idle_s / idle_n if idle_n else 0.0,
+        }
+
     # ------------------------------------------------------------------
     # Request intake
     # ------------------------------------------------------------------
@@ -443,6 +516,10 @@ class Engine:
                         1, self.ecfg.max_model_len - len(req.token_ids))))
         if req.arrival_time == 0.0:
             req.arrival_time = time.monotonic()
+        # Admission forces a pipeline drain: a speculative burst assumed
+        # an unchanged batch, and the admit path must never wait behind
+        # it (the next step schedules this prompt's prefill instead).
+        self.drain_pipeline()
         seq = Sequence(req=req, tokens=list(req.token_ids))
         self._by_id[req.request_id] = seq
         self.waiting.append(seq)
@@ -707,6 +784,10 @@ class Engine:
         self.last_step_tokens = 0
         pre = len(outs)
         if batch:
+            # A scheduled prefill invalidates any speculative burst (the
+            # admit path usually already drained it; continuation
+            # windows land here too).
+            self.drain_pipeline()
             # Occupancy is the PROMPT tokens this batch computes (the
             # scheduled windows), not the one sampled token per window.
             self.last_step_tokens = sum(
@@ -724,6 +805,8 @@ class Engine:
                     for s in self.running):
                 outs.extend(self._run_decode_multi())
             else:
+                # Single-step fallback: burst carries are unusable.
+                self.drain_pipeline()
                 outs.extend(self._run_decode())
             self.last_step_tokens = sum(
                 len(o.new_token_ids) for o in outs[pre:])
@@ -882,15 +965,13 @@ class Engine:
                            bias_ids, bias_vals, rope_pos, T)
         self._note_recompile("prefill_plp" if plp_mode else "prefill",
                              jitted, cache_before)
-        with self._phase("prefill.readback"):
-            next_tok, logprob = _split_tok_lp(np.asarray(fused))
-            self._note_moe_dropped(mdrop)
-            if plp is not None:
-                plp = np.asarray(plp)
-            if top_ids is not None:
-                # One bulk device->host transfer, not one per sequence.
-                top_ids = np.asarray(top_ids)
-                top_lps = np.asarray(top_lps)
+        want_top = self._want_top(top_ids, batch)
+        fused, plp, top_ids, top_lps, mdrop = self._read_host(
+            "prefill", fused, plp,
+            top_ids if want_top else None,
+            top_lps if want_top else None, mdrop)
+        next_tok, logprob = _split_tok_lp(fused)
+        self._note_moe_dropped(mdrop)
         if plp is not None:
             # Stitch this window's scores into the per-sequence ledger:
             # window position t scored the token at global t+1.
@@ -966,12 +1047,13 @@ class Engine:
                     st_f32, st_i32, key, bias_ids, bias_vals, t_len=T)
         self._note_recompile("prefill_ring", self._jit_prefill_ring,
                              cache_before)
-        with self._phase("prefill_ring.readback"):
-            next_tok, logprob = _split_tok_lp(np.asarray(fused))
-            self._note_moe_dropped(mdrop)
-            if top_ids is not None:
-                top_ids = np.asarray(top_ids)
-                top_lps = np.asarray(top_lps)
+        want_top = self._want_top(top_ids, (seq,))
+        fused, top_ids, top_lps, mdrop = self._read_host(
+            "prefill_ring", fused,
+            top_ids if want_top else None,
+            top_lps if want_top else None, mdrop)
+        next_tok, logprob = _split_tok_lp(fused)
+        self._note_moe_dropped(mdrop)
         self._counts = None
         seq.status = SeqStatus.RUNNING
         seq.num_computed = len(seq.tokens)
@@ -1030,13 +1112,13 @@ class Engine:
                     st_f32, st_i32, key, self._ensure_counts(),
                     *self._ensure_bias())
         self._note_recompile("decode", self._jit_decode, cache_before)
-        with self._phase("decode.readback"):
-            next_tok, logprob = _split_tok_lp(np.asarray(fused))
-            self._note_moe_dropped(mdrop)
-            if top_ids is not None:
-                # One bulk device->host transfer, not one per sequence.
-                top_ids = np.asarray(top_ids)
-                top_lps = np.asarray(top_lps)
+        want_top = self._want_top(top_ids, self.running)
+        fused, top_ids, top_lps, mdrop = self._read_host(
+            "decode", fused,
+            top_ids if want_top else None,
+            top_lps if want_top else None, mdrop)
+        next_tok, logprob = _split_tok_lp(fused)
+        self._note_moe_dropped(mdrop)
         outs: List[StepOutput] = []
         # Snapshot (seq, slot) first: _append_token may preempt a *later*
         # sequence in this list (page-growth pressure), clearing its slot
@@ -1059,7 +1141,76 @@ class Engine:
         Pages are pre-grown for the whole lookahead; finish detection runs
         on host afterwards, discarding tokens sampled past a stop. Each
         surviving sequence gets ONE StepOutput carrying its accepted token
-        run, so streaming consumers see a burst of up to N tokens."""
+        run, so streaming consumers see a burst of up to N tokens.
+
+        Pipelined (``decode_pipeline``): burst k+1's inputs are burst k's
+        device-resident carries (``fin_tok``/``fin_pos``) — they do not
+        depend on burst k's host readback at all, only stop/finish/admit
+        handling does. So after dispatching burst k, its device→host copy
+        starts asynchronously and, when no host event can be pending,
+        burst k+1 is dispatched SPECULATIVELY before blocking on burst
+        k's copy; the host post of burst k then runs concurrently with
+        burst k+1's device compute. A speculation invalidated by the post
+        (EOS/length finish, preempt, admit, trim) is discarded: its rng
+        split is never committed (the replacement burst re-splits the
+        same key — token streams stay byte-identical to pipeline-off,
+        pinned in tests/test_engine.py), the penalty histogram rebuilds
+        from host truth, and its in-place KV writes are harmless — they
+        land only at positions >= every sequence's computed length
+        (re-written by the replacement burst before they are attended or
+        content-addressed), and pages released meanwhile are only reused
+        by computations the runtime enqueues after it (program order on
+        the one device stream)."""
+        burst = None
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            if self._pending_matches(pending):
+                # Speculation hit: burst k+1 was dispatched before burst
+                # k's readback and the batch still matches its carries —
+                # consume it with zero pack/upload work; the device
+                # never idled across the boundary.
+                self.phase_counts["decode_multi.spec_hit"] += 1
+                self._rng_key = pending["next_key"]
+                self._note_burst_gap(overlapped=True)
+                burst = pending
+            else:
+                self._discard_spec(pending)
+        if burst is None:
+            burst = self._dispatch_burst()
+            if burst is None:
+                return []
+        # Two-deep pipeline: enqueue burst k+1 BEFORE blocking on burst
+        # k's host copy (no-op when ineligible or the pipeline is off).
+        # Whenever spec is non-None, the host copy below overlaps a live
+        # next-burst device dispatch (spec_dispatch counts those).
+        spec = self._dispatch_spec(burst) if self.decode_pipeline else None
+        fused, top_ids, top_lps, mdrop = self._read_host(
+            "decode_multi", burst["fused"],
+            burst["top_ids"] if burst["want_top"] else None,
+            burst["top_lps"] if burst["want_top"] else None,
+            burst["mdrop"])
+        toks, logps = _split_tok_lp(fused)               # [N, B] each
+        self._note_moe_dropped(mdrop)
+        self._last_burst_ready_t = time.monotonic()
+        self._last_burst_step = self.step_count
+
+        outs = self._post_decode_multi(burst, toks, logps, top_ids,
+                                       top_lps, carry_free=spec is None)
+        if spec is not None:
+            if self._pending_matches(spec):
+                self._pending = spec
+            else:
+                # The post discovered the speculation was wrong (a finish
+                # mid-burst, a trim, ...) — discard before anything else
+                # observes the stale carries.
+                self._discard_spec(spec)
+        return outs
+
+    def _dispatch_burst(self) -> Optional[Dict[str, Any]]:
+        """Pack + dispatch one fused burst from host truth (the
+        non-speculative path), start its outputs' async host copy, and
+        return the burst's device handles (None when pre-grow preempted
+        the whole batch away)."""
         N = self.ecfg.decode_steps
         B = self.ecfg.max_batch_size
         with self._phase("decode_multi.pack"):
@@ -1078,7 +1229,7 @@ class Engine:
                     self._grow_pages(seq,
                                      lookahead=max(remaining - 1, 0))
             if not self.running:
-                return []
+                return None
             self._slot_active[:] = 0
             for seq in self.running:
                 i = seq.slot
@@ -1118,6 +1269,7 @@ class Engine:
                 dev_pos = jnp.asarray(np.ascontiguousarray(self._slot_pos))
                 resident_hit = False
             self._resident = None     # handles are consumed (donated)
+        self._note_burst_gap(overlapped=False)
         cache_before = self._jit_cache_size(self._jit_decode_multi)
         with self._phase("decode_multi.dispatch"):
             (fused, top_ids, top_lps, self.kv, self._counts,
@@ -1128,13 +1280,141 @@ class Engine:
         self._note_recompile("decode_multi", self._jit_decode_multi,
                              cache_before)
         self.phase_counts["decode_multi.resident_hit"] += int(resident_hit)
-        with self._phase("decode_multi.readback"):
-            toks, logps = _split_tok_lp(np.asarray(fused))  # [N, B] each
-            self._note_moe_dropped(mdrop)
-            if top_ids is not None:
-                top_ids = np.asarray(top_ids)    # [N, B, K]
-                top_lps = np.asarray(top_lps)
+        want_top = self._want_top(top_ids, self.running)
+        _start_host_copy(fused, top_ids if want_top else None,
+                         top_lps if want_top else None)
+        return {"fused": fused, "top_ids": top_ids, "top_lps": top_lps,
+                "mdrop": mdrop, "fin_tok": fin_tok, "fin_pos": fin_pos,
+                "want_top": want_top}
 
+    def _dispatch_spec(self, burst: Dict[str, Any]
+                       ) -> Optional[Dict[str, Any]]:
+        """Speculatively dispatch the NEXT burst from ``burst``'s
+        device-resident carries, before ``burst``'s readback. The rng
+        split is held uncommitted in the returned dict (committed only
+        on acceptance) so a rollback replays the exact pipeline-off key
+        stream. Starts the async host copy of the speculative outputs
+        immediately: by the time the next step accepts them the copy has
+        been overlapping host post + device compute for a whole burst."""
+        if not self._spec_eligible():
+            return None
+        next_key, key = jax.random.split(self._rng_key)
+        cache_before = self._jit_cache_size(self._jit_decode_multi)
+        with self._phase("decode_multi.spec_dispatch"):
+            (fused, top_ids, top_lps, self.kv, self._counts,
+             mdrop, fin_tok, fin_pos) = self._jit_decode_multi(
+                    self.params, burst["fin_tok"], burst["fin_pos"],
+                    self._dev_active_pt, self.kv, *self._slot_st, key,
+                    self._ensure_counts(), *self._ensure_bias())
+        self._note_recompile("decode_multi", self._jit_decode_multi,
+                             cache_before)
+        _start_host_copy(fused, top_ids if burst["want_top"] else None,
+                         top_lps if burst["want_top"] else None)
+        return {"fused": fused, "top_ids": top_ids, "top_lps": top_lps,
+                "mdrop": mdrop, "fin_tok": fin_tok, "fin_pos": fin_pos,
+                "want_top": burst["want_top"], "next_key": next_key,
+                "members": tuple((s.req.request_id, s.slot)
+                                 for s in self.running)}
+
+    def _spec_eligible(self) -> bool:
+        """May the next burst be dispatched from the current burst's
+        device carries before its outputs are read back? Conservative —
+        only when the host post cannot need anything the speculation
+        lacks: no queued or cancelled work (the next step would schedule
+        a prefill), nobody can expire by length inside the current burst
+        (an EOS still rolls back — it is unpredictable), the speculative
+        writes stay inside ``max_model_len``, the existing page tables
+        already cover them (speculation never allocates, so a rollback
+        has nothing to undo), and any penalty histogram is already
+        device-resident (a host rebuild would read a stale ledger)."""
+        N = self.ecfg.decode_steps
+        if self.waiting or self._cancelled or self._slot_st is None \
+                or self._dev_active_pt is None:
+            return False
+        ps = self.ecfg.page_size
+        for s in self.running:
+            rem = s.req.sampling.max_tokens - s.num_generated
+            if rem <= N:
+                return False
+            if len(s.tokens) + 2 * N - 1 > self.ecfg.max_model_len:
+                return False
+            cover = len(s.tokens) + N + min(N, rem - N) - 1
+            if len(s.pages) * ps < cover:
+                return False
+        if self._counts is None and any(
+                s.req.sampling.presence_penalty
+                or s.req.sampling.frequency_penalty
+                for s in self.running):
+            return False
+        return True
+
+    def _pending_matches(self, p: Dict[str, Any]) -> bool:
+        """A speculative burst stays valid only while the batch is
+        exactly what its carries assumed: same membership in the same
+        slots (an EOS/length finish, preempt, cancel or import changes
+        it — and membership equality implies every sequence accepted the
+        full burst, so the host token tail EQUALS the device carries)
+        and an unchanged active+page-table block (sliding-window trims
+        and page growth re-upload it)."""
+        if self._active_pt_mirror is None or self._slot_st is None:
+            return False
+        members = tuple((s.req.request_id, s.slot) for s in self.running)
+        if not members or members != p["members"]:
+            return False
+        mp = self._active_pt_mirror.shape[1] - 2
+        apt_now = self._slot_packed[:, 2:_PACK_COLS + mp]
+        return (self._active_pt_mirror.shape == apt_now.shape
+                and np.array_equal(self._active_pt_mirror, apt_now))
+
+    def _discard_spec(self, p: Dict[str, Any]) -> None:
+        """Roll a speculative burst back (host bookkeeping only — the
+        device computation finishes on its own and its outputs are
+        dropped). The rng key was never committed, so the replacement
+        burst re-splits the same key; the penalty histogram rebuilds
+        from host truth at the next dispatch; the resident carries are
+        dropped so the replacement uploads fresh token/position state."""
+        self.phase_counts["decode_multi.spec_rollback"] += 1
+        self._counts = None
+        self._resident = None
+        # A rolled-back boundary is neither idle nor covered: the device
+        # spent it computing the discarded burst (wasted work, counted
+        # above) — exclude it from the idle ledger rather than book a
+        # saturated device as a bubble.
+        self._last_burst_ready_t = None
+
+    def drain_pipeline(self) -> None:
+        """Discard any in-flight speculative burst. Called wherever
+        engine state changes outside the decode loop — admits, KV
+        import/export, warmup — and by the worker's sleep path."""
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._discard_spec(pending)
+
+    def _note_burst_gap(self, overlapped: bool) -> None:
+        """Device-idle attribution per burst boundary: host time between
+        the previous burst's outputs being ready and this dispatch,
+        during which the device had nothing queued — 0 when a
+        speculative burst covered the gap. Only consecutive decode
+        bursts count: a prefill or idle stretch in between is
+        scheduling, and a rolled-back boundary is excluded entirely
+        (_discard_spec clears the timestamp — the device was busy on
+        the discarded burst, not idle)."""
+        t = self._last_burst_ready_t
+        if t is None or self.step_count != self._last_burst_step + 1:
+            return
+        gap = 0.0 if overlapped else max(time.monotonic() - t, 0.0)
+        self.phase_times["decode_multi.device_idle"] += gap
+        self.phase_counts["decode_multi.device_idle"] += 1
+
+    def _post_decode_multi(self, burst: Dict[str, Any], toks, logps,
+                           top_ids, top_lps,
+                           carry_free: bool) -> List[StepOutput]:
+        """Host post of one fused burst: append accepted tokens, detect
+        finishes, register prefix pages, trim sliding windows. Runs
+        concurrently with the next burst's device compute when one was
+        dispatched speculatively (``carry_free=False`` — the carries
+        were donated into it, so resident state must not be kept)."""
+        N = self.ecfg.decode_steps
         outs: List[StepOutput] = []
         with self._phase("decode_multi.post"):
             for seq, slot in [(s, s.slot) for s in self.running]:
@@ -1177,12 +1457,16 @@ class Engine:
             # its host tail now EQUALS the device carry — the snapshot
             # below re-proves that at next dispatch; any host-side change
             # in between (admit, preempt, import) makes it miss and fall
-            # back to a fresh upload.
-            self._resident = {
-                "tok": fin_tok, "pos": fin_pos,
-                "snap": tuple((s.req.request_id, s.slot, s.tokens[-1],
-                               len(s.tokens) - 1) for s in self.running),
-            }
+            # back to a fresh upload. When a speculative burst was
+            # dispatched the carries were donated into it (the pending
+            # dict carries the next-resident state instead).
+            if carry_free:
+                self._resident = {
+                    "tok": burst["fin_tok"], "pos": burst["fin_pos"],
+                    "snap": tuple((s.req.request_id, s.slot, s.tokens[-1],
+                                   len(s.tokens) - 1)
+                                  for s in self.running),
+                }
         return outs
 
     def _top_entry(self, seq: Sequence, top_ids, top_lps,
@@ -1335,6 +1619,7 @@ class Engine:
         seq = self._held.pop(request_id, None)
         if seq is None:
             return None
+        self.drain_pipeline()
         k_pages, v_pages = self.kv
         idx = jnp.asarray(seq.pages, jnp.int32)
         k, v = k_pages[:, idx], v_pages[:, idx]
@@ -1359,6 +1644,7 @@ class Engine:
         ``tokens[:-1]``. Returns False (clean refusal → caller falls back)
         when no slot/pages are free or the payload doesn't match this
         engine's KV layout."""
+        self.drain_pipeline()
         n_pages_needed = self._pages_needed(len(tokens))
         k_pages, v_pages = self.kv
         expect = (k_pages.shape[0], n_pages_needed, k_pages.shape[2],
@@ -1428,6 +1714,7 @@ class Engine:
         Shapes are driven directly through the jitted steps with inert
         inputs (all-NULL page tables, inactive slots) — no allocator or
         slot state is touched. Returns seconds spent."""
+        self.drain_pipeline()
         t0 = time.monotonic()
         buckets = tuple(buckets or self.ecfg.prefill_buckets)
         Bmax = self.ecfg.max_batch_size
@@ -1571,6 +1858,21 @@ def _kv_scatter(k_pages, v_pages, idx, k_new, v_new):
     Recompiles per distinct imported-page count; serving shapes hit a
     handful of counts, all cached after first use."""
     return k_pages.at[:, idx].set(k_new), v_pages.at[:, idx].set(v_new)
+
+
+def _start_host_copy(*arrays) -> None:
+    """Kick off device→host copies without blocking (``jax.Array
+    .copy_to_host_async``; re-requesting an in-flight copy is a no-op,
+    and array types without the method are simply read synchronously
+    later). The pipelined decode path calls this at dispatch so the copy
+    overlaps the next burst's device compute and the host post."""
+    for a in arrays:
+        if a is None:
+            continue
+        try:
+            a.copy_to_host_async()
+        except AttributeError:
+            pass
 
 
 def _top_row(top_ids, top_lps, row: int) -> List[Dict[str, Any]]:

@@ -224,6 +224,9 @@ class ModelRuntime:
         if self.state == MODEL_ASLEEP:
             return
         if self.engine is not None:
+            # Settle the decode pipeline first: an in-flight speculative
+            # burst must not be left referencing a pool we are dropping.
+            self.engine.drain_pipeline()
             self._host_params = jax.tree_util.tree_map(
                 np.asarray, jax.device_get(self.engine.params))
             self.engine = None      # KV pool + device params released
@@ -852,6 +855,36 @@ class Worker:
             labelnames=("model", "phase")).observe(
             step_ms, model=m, phase=kind)
         self._flush_phase_ledger(rt)
+        self._flush_overlap(rt)
+
+    def _flush_overlap(self, rt: ModelRuntime) -> None:
+        """Decode-pipeline overlap health: speculative-burst
+        dispatch/hit/rollback counters plus the two derived gauges a
+        dashboard charts — speculation hit ratio and device-idle ms per
+        burst boundary (docs/OBSERVABILITY.md)."""
+        eng = rt.engine
+        if eng is None:
+            return
+        om = eng.overlap_metrics()
+        m = rt.model
+        c = self.obs.counter(
+            "xllm_worker_decode_overlap_spec_total",
+            "speculative next-burst dispatches by outcome "
+            "(pipelined decode, XLLM_DECODE_PIPELINE)",
+            labelnames=("model", "result"))
+        c.set_total(om["spec_dispatches"], model=m, result="dispatch")
+        c.set_total(om["spec_hits"], model=m, result="hit")
+        c.set_total(om["spec_rollbacks"], model=m, result="rollback")
+        self.obs.gauge(
+            "xllm_worker_decode_overlap_hit_ratio",
+            "fraction of speculative burst dispatches consumed as-is",
+            labelnames=("model",)).set(om["hit_ratio"], model=m)
+        self.obs.gauge(
+            "xllm_worker_decode_overlap_device_idle_ms_per_burst",
+            "host-side gap per decode burst boundary not covered by a "
+            "speculative burst",
+            labelnames=("model",)).set(
+            om["device_idle_ms_per_burst"], model=m)
 
     def _flush_phase_ledger(self, rt: ModelRuntime) -> None:
         """Mirror the engine's phase wall-time ledger + post-warmup
@@ -1425,6 +1458,7 @@ class Worker:
             # — the same ledger bench.py surfaces, live per worker.
             self._engine_load(rt)
             self._flush_phase_ledger(rt)
+            self._flush_overlap(rt)
         # Keep-alive reuse pool, labeled with the exporting plane (the
         # pool is process-global — see the service-side exporter note).
         # In the separate-process deployment this is the worker→service
