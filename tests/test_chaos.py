@@ -4,12 +4,15 @@ load and prove the reference's headline fault-tolerance claim end to end
 reference README.md Key Features) — with real OS processes, real
 sockets, and the native C++ etcd server as the coordination plane.
 
-Complements tests/test_ha.py (which kills the MASTER): here the control
-plane survives and must (a) fail in-flight requests to the dead
-instance cleanly — no hangs, a definite HTTP error (clients retry; the
-reference behaves the same), (b) expire the dead worker's lease and
-remove it from the registry, and (c) route every subsequent request to
-the surviving instance.
+Complements tests/test_ha.py (which kills the MASTER) and
+tests/test_failpoints.py (the fast, deterministic in-process
+failpoint version of this scenario): here the control plane survives
+and must (a) RECOVER in-flight streams mid-generation — the relay
+detects the broken worker socket, re-prefills prompt + delivered
+tokens on the survivor, and splices the continuation into the open
+stream (docs/ROBUSTNESS.md), so every client stream completes,
+(b) expire the dead worker's lease and remove it from the registry,
+and (c) route every subsequent request to the surviving instance.
 """
 
 import http.client
@@ -26,8 +29,16 @@ import pytest
 from xllm_service_tpu.config import LoadBalancePolicyType, ServiceOptions
 from xllm_service_tpu.service.master import Master
 
-pytestmark = pytest.mark.skipif(
-    os.environ.get("XLLM_SKIP_SLOW") == "1", reason="slow chaos test")
+# Slow-marked: real process spawns + a C++ etcd build + SIGKILL timing
+# make this the heavyweight end of the chaos ladder; the tier-1 budget
+# carries its fast deterministic twin instead
+# (tests/test_failpoints.py, worker.die_after_n_tokens on in-process
+# workers). Run explicitly or with -m slow.
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(os.environ.get("XLLM_SKIP_SLOW") == "1",
+                       reason="slow chaos test"),
+]
 
 
 def wait_until(cond, timeout=30.0, step=0.1):
@@ -131,11 +142,35 @@ def test_worker_sigkill_under_load_reroutes():
             t.join(timeout=120)
         assert all(t.is_alive() is False for t in threads), \
             "a client hung after the worker died"
-        # No hangs; requests either completed or failed definitively.
+        # Mid-stream recovery: EVERY stream completes — the ones that
+        # were mid-generation on the killed worker resume on the
+        # survivor (before this subsystem, a mid-stream kill was a
+        # client-visible error and only the survivor's streams passed).
         outcomes = [r for r in results if r is not None]
         assert len(outcomes) == len(results)
         n_ok = sum(1 for ok, _, _ in outcomes if ok)
-        assert n_ok >= 1, f"nothing survived: {outcomes}"
+        assert n_ok == len(outcomes), \
+            f"streams died with the worker: {outcomes}"
+
+        # The failover is visible: nonzero recovery successes on
+        # /metrics and a request_recovered event at /admin/events.
+        host, _, port = master.http_address.partition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=30)
+        conn.request("GET", "/metrics")
+        metrics = conn.getresponse().read().decode()
+        conn.close()
+        line = [ln for ln in metrics.splitlines()
+                if ln.startswith('xllm_request_recoveries_total'
+                                 '{result="success"}')]
+        assert line and float(line[0].split()[-1]) >= 1, \
+            "no successful recovery recorded"
+        conn = http.client.HTTPConnection(host, int(port), timeout=30)
+        conn.request("GET", "/admin/events?limit=512")
+        events = json.loads(conn.getresponse().read().decode())
+        conn.close()
+        assert any(e["type"] == "request_recovered"
+                   for e in events["events"]), \
+            "no request_recovered event in the cluster log"
 
         # Lease expiry removes the dead instance (1.5 s TTL + slack).
         assert wait_until(

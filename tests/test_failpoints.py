@@ -1,0 +1,558 @@
+"""Failpoint-driven chaos + recovery + retry policy (tier-1).
+
+The fast, deterministic complement to tests/test_chaos.py's SIGKILL
+runs: `worker.die_after_n_tokens` on one of two IN-PROCESS workers
+kills it mid-generation (broken streams, dropped heartbeats, refused
+work — the process survives so the test stays cheap), and the service
+must resume the stream on the survivor with exactly-once tokens
+(docs/ROBUSTNESS.md). Covers both response topologies, plus the
+failpoint/retry-policy units and the closed-catalog contract.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from xllm_service_tpu.config import (
+    EngineConfig, InstanceType, LoadBalancePolicyType, ServiceOptions)
+from xllm_service_tpu.obs import EventLog, FAILPOINTS, Failpoints, Registry
+from xllm_service_tpu.runtime.worker import Worker, WorkerOptions
+from xllm_service_tpu.service.coordination import InMemoryStore
+from xllm_service_tpu.service.httpd import (
+    http_json, http_stream, iter_sse_events)
+from xllm_service_tpu.service.master import Master
+from xllm_service_tpu.utils.retry import RetryPolicy
+
+
+def wait_until(cond, timeout=15.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# Units: the failpoint registry
+# ---------------------------------------------------------------------------
+class TestFailpoints:
+    def test_closed_catalog(self):
+        fp = Failpoints(env="")
+        with pytest.raises(ValueError):
+            fp.arm("worker.no_such_site")
+        with pytest.raises(ValueError):
+            fp.fire("worker.no_such_site")
+        with pytest.raises(ValueError):
+            fp.arm("worker.refuse_generate", mode="sometimes")
+
+    def test_unarmed_is_noop(self):
+        fp = Failpoints(env="")
+        for name in FAILPOINTS:
+            assert fp.fire(name) is None
+            assert fp.trips(name) == 0
+
+    def test_count_mode_fires_exactly_n_times(self):
+        fp = Failpoints(env="")
+        fp.arm("worker.refuse_generate", mode="count", n=3)
+        fired = [fp.fire("worker.refuse_generate") for _ in range(6)]
+        assert [bool(x) for x in fired] == [True] * 3 + [False] * 3
+        assert fp.trips("worker.refuse_generate") == 3
+        # Auto-disarmed after the budget.
+        assert "worker.refuse_generate" not in fp.state()["armed"]
+
+    def test_after_mode_fires_once_at_threshold(self):
+        fp = Failpoints(env="")
+        fp.arm("worker.die_after_n_tokens", mode="after", n=6)
+        hits = [fp.fire("worker.die_after_n_tokens", n=2)
+                for _ in range(5)]
+        # Cumulative units 2,4,6 → fires exactly on the third pass,
+        # then never again (auto-disarm).
+        assert [bool(x) for x in hits] == [False, False, True,
+                                           False, False]
+
+    def test_always_carries_value_and_off_overrides(self):
+        fp = Failpoints(env="")
+        fp.arm("worker.slow_response_ms", mode="always", value=250.0)
+        assert fp.fire("worker.slow_response_ms") == 250.0
+        fp.arm("worker.slow_response_ms", mode="off")
+        assert fp.fire("worker.slow_response_ms") is None
+
+    def test_env_spec_grammar(self):
+        fp = Failpoints(
+            env="worker.die_after_n_tokens=after:6,"
+                "worker.slow_response_ms=always:250,"
+                "worker.refuse_generate=count:2")
+        state = fp.state()
+        assert state["armed"]["worker.die_after_n_tokens"]["mode"] \
+            == "after"
+        assert state["armed"]["worker.slow_response_ms"]["value"] == 250.0
+        assert state["armed"]["worker.refuse_generate"]["n"] == 2.0
+        with pytest.raises(ValueError):
+            Failpoints(env="worker.refuse_generate")      # no '='
+        with pytest.raises(ValueError):
+            Failpoints(env="worker.refuse_generate=count")  # missing arg
+
+    def test_trip_visibility(self):
+        events = EventLog(capacity=16)
+        obs = Registry()
+        fp = Failpoints(events=events, obs=obs, env="")
+        fp.arm("service.fail_redispatch", mode="count", n=1)
+        assert fp.fire("service.fail_redispatch")
+        assert obs.counter(
+            "xllm_failpoints_tripped_total",
+            labelnames=("name",)).value(
+            name="service.fail_redispatch") == 1
+        evs = events.since(0)
+        assert [e["type"] for e in evs] == ["failpoint_tripped"]
+        assert evs[0]["attrs"]["name"] == "service.fail_redispatch"
+
+
+# ---------------------------------------------------------------------------
+# Units: the shared retry/backoff policy
+# ---------------------------------------------------------------------------
+class TestRetryPolicy:
+    def test_deterministic_exponential_when_unjittered(self):
+        p = RetryPolicy(base_delay_s=0.1, max_delay_s=1.0,
+                        multiplier=2.0, jitter=0.0)
+        assert [p.delay(k) for k in range(5)] == \
+            [0.1, 0.2, 0.4, 0.8, 1.0]          # capped at max_delay_s
+
+    def test_jitter_bounds(self):
+        p = RetryPolicy(base_delay_s=0.1, max_delay_s=10.0,
+                        multiplier=2.0, jitter=0.5)
+        for _ in range(100):
+            d = p.delay(2)     # pure delay 0.4
+            assert 0.2 <= d <= 0.4
+
+    def test_sleep_refuses_past_deadline(self):
+        p = RetryPolicy(base_delay_s=5.0, jitter=0.0)
+        t0 = time.monotonic()
+        assert p.sleep(0, deadline=t0 - 1.0) is False
+        assert time.monotonic() - t0 < 1.0     # did not sleep 5 s
+        # ... and clamps to the remaining window instead of overshooting.
+        t0 = time.monotonic()
+        assert p.sleep(0, deadline=t0 + 0.05) is True
+        assert time.monotonic() - t0 < 1.0
+
+    def test_stop_event_wait(self):
+        p = RetryPolicy(base_delay_s=5.0, jitter=0.0)
+        ev = threading.Event()
+        ev.set()
+        t0 = time.monotonic()
+        assert p.sleep(0, stop_event=ev) is False
+        assert time.monotonic() - t0 < 1.0
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("XLLM_RETRY_ATTEMPTS", "5")
+        monkeypatch.setenv("XLLM_RETRY_BASE_MS", "10")
+        monkeypatch.setenv("XLLM_RETRY_MAX_MS", "100")
+        p = RetryPolicy.from_env()
+        assert p.max_attempts == 5
+        assert p.base_delay_s == pytest.approx(0.01)
+        assert p.max_delay_s == pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# Units: the ledger-aware relay frame processor
+# ---------------------------------------------------------------------------
+class _FakeLedgerScheduler:
+    """Mirrors Scheduler's ledger contract for RelayLedger units."""
+
+    def __init__(self):
+        self.delivered = []
+        self.pending = []
+
+    def note_delivered(self, srid, ids, has_text=True):
+        if has_text:
+            self.delivered += self.pending + list(ids)
+            self.pending = []
+        else:
+            self.pending += list(ids)
+        return len(self.delivered)
+
+    def delivered_total(self, srid):
+        return len(self.delivered) + len(self.pending)
+
+
+class _FakeManager:
+    def __init__(self):
+        self.scheduler = _FakeLedgerScheduler()
+
+
+def _chat_req():
+    from xllm_service_tpu.utils.types import Request as SchedRequest
+    return SchedRequest(model="tiny", service_request_id="r1",
+                        stream=True, token_ids=[1, 2, 3])
+
+
+class TestRelayLedger:
+    def _mk(self, is_chat=True):
+        from xllm_service_tpu.service.recovery import RelayLedger
+        mgr = _FakeManager()
+        return RelayLedger(mgr, _chat_req(), is_chat=is_chat), mgr
+
+    def _chunk(self, content="x", ids=(7,), finish=None, role=None):
+        delta = {"role": role} if role else {"content": content}
+        obj = {"id": "r1", "object": "chat.completion.chunk",
+               "created": 111, "model": "tiny",
+               "choices": [{"index": 0, "delta": delta,
+                            "finish_reason": finish}]}
+        if ids:
+            obj["xllm"] = {"token_ids": list(ids)}
+        return json.dumps(obj, separators=(",", ":"))
+
+    def test_strips_extension_and_feeds_ledger(self):
+        led, mgr = self._mk()
+        frame, n = led.on_payload(self._chunk(content="ab", ids=(7, 8)))
+        assert n == 2
+        assert mgr.scheduler.delivered == [7, 8]
+        obj = json.loads(frame.decode()[len("data: "):])
+        assert "xllm" not in obj
+        assert obj["choices"][0]["delta"]["content"] == "ab"
+
+    def test_heldback_delta_parks_pending_until_text_flushes(self):
+        led, mgr = self._mk()
+        led.on_payload(self._chunk(content="", ids=(7,)))
+        assert mgr.scheduler.delivered == [] and \
+            mgr.scheduler.pending == [7]
+        led.on_payload(self._chunk(content="xy", ids=(8,)))
+        assert mgr.scheduler.delivered == [7, 8]
+
+    def _role_payload(self, created=999):
+        return json.dumps(
+            {"id": "r1", "object": "chat.completion.chunk",
+             "created": created, "model": "tiny",
+             "choices": [{"index": 0,
+                          "delta": {"role": "assistant"},
+                          "finish_reason": None}]})
+
+    def test_resumed_suppresses_role_chunk_and_pins_created(self):
+        led, _ = self._mk()
+        # A real chat stream opens with the role chunk; created=111.
+        frame, _ = led.on_payload(self._role_payload(created=111))
+        assert frame is not None and led.role_sent
+        led.on_payload(self._chunk(content="a", ids=(7,)))
+        led.resumed = True
+        frame, n = led.on_payload(self._role_payload())
+        assert frame is None and n == 0        # duplicate role chunk
+        frame, _ = led.on_payload(self._chunk(content="b", ids=(8,)))
+        obj = json.loads(frame.decode()[len("data: "):])
+        assert obj["created"] == 111           # original stream's value
+
+    def test_resume_before_role_chunk_forwards_survivors_role(self):
+        # Worker died after headers but before its first frame: the
+        # client has no role chunk yet, so the survivor's must pass
+        # through or the chat stream is malformed.
+        led, _ = self._mk()
+        led.resumed = True
+        frame, n = led.on_payload(self._role_payload())
+        assert frame is not None and n == 0
+        assert led.role_sent
+        # ...and a second role chunk (another failover) IS suppressed.
+        frame, _ = led.on_payload(self._role_payload())
+        assert frame is None
+
+    def test_resumed_rewrites_usage_to_client_truth(self):
+        led, mgr = self._mk()
+        led.on_payload(self._chunk(content="a", ids=(7,)))
+        led.resumed = True
+        led.on_payload(self._chunk(content="b", ids=(8,)))
+        usage = {"id": "r1", "object": "chat.completion.chunk",
+                 "created": 999, "model": "tiny", "choices": [],
+                 "usage": {"prompt_tokens": 5, "completion_tokens": 1,
+                           "total_tokens": 6}}
+        frame, _ = led.on_payload(json.dumps(usage))
+        obj = json.loads(frame.decode()[len("data: "):])
+        # prompt = the ORIGINAL prompt (3 ids), completion = full
+        # client-visible ledger — not the survivor's local view.
+        assert obj["usage"]["prompt_tokens"] == 3
+        assert obj["usage"]["completion_tokens"] == 2
+
+    def test_done_and_finish_tracking(self):
+        led, _ = self._mk()
+        frame, _ = led.on_payload(self._chunk(content="a", finish="length"))
+        assert led.finished and not led.done
+        frame, _ = led.on_payload(" [DONE] ")
+        assert led.done and frame == b"data: [DONE]\n\n"
+
+    def test_synthesize_finish_shapes(self):
+        led, _ = self._mk(is_chat=False)
+        obj = {"id": "r1", "object": "text_completion", "created": 42,
+               "model": "tiny",
+               "choices": [{"index": 0, "text": "a", "logprobs": None,
+                            "finish_reason": None}],
+               "xllm": {"token_ids": [7]}}
+        led.on_payload(json.dumps(obj))
+        frames = led.synthesize_finish(include_usage=True)
+        assert led.done and led.finished
+        finish = json.loads(frames[0].decode()[len("data: "):])
+        assert finish["created"] == 42
+        assert finish["choices"][0]["finish_reason"] == "length"
+        usage = json.loads(frames[1].decode()[len("data: "):])
+        assert usage["usage"]["completion_tokens"] == 1
+        assert frames[-1] == b"data: [DONE]\n\n"
+
+
+# ---------------------------------------------------------------------------
+# In-process chaos: die-after-N-tokens mid-stream, both topologies
+# ---------------------------------------------------------------------------
+def small_engine_cfg() -> EngineConfig:
+    return EngineConfig(page_size=16, num_pages=64, max_model_len=256,
+                        max_batch_size=4, max_prefill_tokens=256,
+                        prefill_buckets=(32, 64, 128))
+
+
+def make_cluster(store, decode_to_service=False, n_workers=2):
+    opts = ServiceOptions(
+        http_port=0, rpc_port=0, num_output_pools=4,
+        load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
+        block_size=16, heartbeat_interval_s=0.2,
+        master_upload_interval_s=0.2,
+        detect_disconnected_instance_interval_s=1.0,
+        enable_decode_response_to_service=decode_to_service)
+    master = Master(opts, store=store).start()
+    workers = []
+    for _ in range(n_workers):
+        wopts = WorkerOptions(
+            port=0, instance_type=InstanceType.DEFAULT,
+            service_addr=master.rpc_address, model="tiny",
+            heartbeat_interval_s=0.2, lease_ttl_s=1.5)
+        workers.append(Worker(wopts, store,
+                              engine_cfg=small_engine_cfg()).start())
+    assert wait_until(
+        lambda: len(master.scheduler.instance_mgr.prefill_instances())
+        == n_workers, timeout=20.0), "workers never registered"
+    if decode_to_service:
+        assert wait_until(
+            lambda: all(w._decode_to_service for w in workers),
+            timeout=5.0), "workers never learned the RPC topology"
+    return master, workers
+
+
+@pytest.fixture()
+def store():
+    s = InMemoryStore(sweep_interval_s=0.02)
+    yield s
+    s.close()
+
+
+PROMPT = "recover me now "
+
+
+def _stream_completion(http_addr, max_tokens=24, include_usage=True,
+                       timeout=120.0):
+    """One streaming completion; returns a dict with the concatenated
+    text, the parsed chunk objects, the finish reason, usage, and
+    whether [DONE] arrived."""
+    body = {"model": "tiny", "prompt": PROMPT,
+            "max_tokens": max_tokens, "temperature": 0.0,
+            "stream": True, "ignore_eos": True}
+    if include_usage:
+        body["stream_options"] = {"include_usage": True}
+    out = {"text": "", "chunks": [], "finish": None, "usage": None,
+           "done": False, "error": None}
+    try:
+        for payload in iter_sse_events(http_stream(
+                "POST", http_addr, "/v1/completions", body,
+                timeout=timeout)):
+            if payload == "[DONE]":
+                out["done"] = True
+                break
+            obj = json.loads(payload)
+            out["chunks"].append(obj)
+            for ch in obj.get("choices") or []:
+                out["text"] += ch.get("text", "")
+                if ch.get("finish_reason"):
+                    out["finish"] = ch["finish_reason"]
+            if obj.get("usage"):
+                out["usage"] = obj["usage"]
+    except Exception as e:  # noqa: BLE001 — the failure mode under test
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _scrape(http_addr):
+    import http.client
+    host, _, port = http_addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    conn.close()
+    return text
+
+
+def _events(http_addr):
+    status, resp = http_json("GET", http_addr, "/admin/events?limit=512",
+                             timeout=30.0)
+    assert status == 200
+    return [e["type"] for e in resp["events"]], resp["events"]
+
+
+def _assert_recovered_exactly_once(streams, baseline, master,
+                                   expect_usage=True):
+    """The exactly-once contract, asserted through the client's eyes:
+    every stream finished, with text byte-identical to the unfailed
+    baseline (temperature=0), the correct finish + usage, and no
+    ledger extension leaking past the relay."""
+    for s in streams:
+        assert s["error"] is None, s
+        assert s["done"] and s["finish"] == "length", s
+        assert s["text"] == baseline["text"], \
+            f"recovered stream diverged:\n {s['text']!r}\n " \
+            f"vs baseline\n {baseline['text']!r}"
+        if expect_usage:
+            assert s["usage"] == baseline["usage"], s["usage"]
+        for obj in s["chunks"]:
+            assert "xllm" not in obj, "ledger extension leaked to client"
+    metrics = _scrape(master.http_address)
+    assert 'xllm_request_recoveries_total{result="success"}' in metrics
+    line = [ln for ln in metrics.splitlines()
+            if ln.startswith('xllm_request_recoveries_total'
+                             '{result="success"}')][0]
+    assert float(line.split()[-1]) >= 1, line
+    types, events = _events(master.http_address)
+    assert "request_recovered" in types, types
+
+
+class TestMidStreamRecovery:
+    def test_relay_stream_recovers_from_mid_stream_death(self, store):
+        """Two in-process workers, relay topology (one cluster for the
+        whole scenario, boots are the expensive part). First the
+        refusal class: refuse-with-503 armed on worker A redispatches
+        cleanly (no recovery involved, trip visible on A's
+        /admin/failpoints). Then the mid-stream class: arm
+        die-after-6-tokens on A via the SERVICE admin proxy, run two
+        concurrent streams (round-robin puts one on each worker); the
+        one on A breaks mid-stream and must resume on B with
+        contiguous exactly-once tokens — byte-identical to an unfailed
+        run at temperature=0."""
+        master, workers = make_cluster(store, n_workers=2)
+        try:
+            baseline = _stream_completion(master.http_address)
+            assert baseline["error"] is None and baseline["done"], baseline
+            assert baseline["finish"] == "length"
+
+            # --- refusal class first (the worker survives it) --------
+            status, _ = http_json(
+                "POST", workers[0].name, "/admin/failpoint",
+                {"name": "worker.refuse_generate", "mode": "count",
+                 "n": 2}, timeout=10.0)
+            assert status == 200
+            for _ in range(2):
+                s = _stream_completion(master.http_address, max_tokens=4)
+                assert s["error"] is None and s["done"], s
+            status, state = http_json(
+                "GET", workers[0].name, "/admin/failpoints",
+                timeout=10.0)
+            assert status == 200
+            assert state["trips"].get("worker.refuse_generate", 0) >= 1
+            # Disarm any unspent refusal charge (round-robin may have
+            # sent both probes to the healthy worker): a leftover 503
+            # would bounce the die-phase stream off the armed worker.
+            status, _ = http_json(
+                "POST", workers[0].name, "/admin/failpoint",
+                {"name": "worker.refuse_generate", "mode": "off"},
+                timeout=10.0)
+            assert status == 200
+
+            # --- mid-stream death + recovery -------------------------
+            status, resp = http_json(
+                "POST", master.http_address, "/admin/failpoint",
+                {"instance": workers[0].name,
+                 "name": "worker.die_after_n_tokens",
+                 "mode": "after", "n": 6}, timeout=10.0)
+            assert status == 200, resp
+
+            # Four concurrent streams: whatever parity the refusal
+            # phase left the round-robin counters in, the armed worker
+            # gets at least one (RR alternates per schedule call).
+            results = [None] * 4
+            threads = [threading.Thread(
+                target=lambda i=i: results.__setitem__(
+                    i, _stream_completion(master.http_address)))
+                for i in range(len(results))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert all(not t.is_alive() for t in threads), \
+                "a client hung after the simulated death"
+
+            assert workers[0]._dead, \
+                "die_after_n_tokens never tripped on the armed worker"
+            _assert_recovered_exactly_once(results, baseline, master)
+            # The span carries the failover story.
+            types, events = _events(master.http_address)
+            rec = [e for e in events if e["type"] == "request_recovered"]
+            assert rec[0]["attrs"]["mode"] == "relay"
+            assert rec[0]["attrs"]["to"] == workers[1].name
+            srid = rec[0]["attrs"]["service_request_id"]
+            status, span = http_json(
+                "GET", master.http_address, f"/admin/trace/{srid}",
+                timeout=10.0)
+            assert status == 200
+            stages = [e["stage"] for e in span["events"]]
+            assert "recovered" in stages and "redispatched" in stages
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
+    @pytest.mark.slow
+    def test_rpc_topology_recovers_after_instance_removal(self, store):
+        """decode-response-to-service topology: tokens arrive at the
+        RPC fan-in, so recovery is driven by fail_requests_on_instance
+        when the dead worker's lease expires — the scheduler's ledger
+        resumes the stream on the survivor into the SAME fan-in queue.
+
+        Slow-marked (a second full 2-worker cluster boot): the tier-1
+        budget carries the relay-topology chaos test above; this one
+        rides the slow suite with the SIGKILL runs."""
+        master, workers = make_cluster(store, decode_to_service=True,
+                                       n_workers=2)
+        try:
+            baseline = _stream_completion(master.http_address,
+                                          max_tokens=16)
+            assert baseline["error"] is None and baseline["done"], baseline
+
+            # Arm directly on the worker's own admin endpoint (the
+            # relay test covers the service proxy).
+            status, resp = http_json(
+                "POST", workers[0].name, "/admin/failpoint",
+                {"name": "worker.die_after_n_tokens",
+                 "mode": "after", "n": 4}, timeout=10.0)
+            assert status == 200, resp
+
+            results = [None, None]
+            threads = [threading.Thread(
+                target=lambda i=i: results.__setitem__(
+                    i, _stream_completion(master.http_address,
+                                          max_tokens=16)))
+                for i in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert all(not t.is_alive() for t in threads), \
+                "a client hung after the simulated death"
+
+            assert workers[0]._dead, \
+                "die_after_n_tokens never tripped on the armed worker"
+            _assert_recovered_exactly_once(results, baseline, master)
+            types, events = _events(master.http_address)
+            rec = [e for e in events if e["type"] == "request_recovered"]
+            assert rec and rec[0]["attrs"]["mode"] == "rpc"
+            # The death was detected through lease expiry — the dead
+            # instance leaves the registry.
+            assert wait_until(
+                lambda: len(master.scheduler.instance_mgr
+                            .prefill_instances()) == 1, timeout=20.0)
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+

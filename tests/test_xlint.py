@@ -170,6 +170,14 @@ class TestPositiveControls:
         # Non-literal type: unverifiable statically — also a finding.
         assert f"{p}::event-nonliteral" in keys
 
+    def test_failpoint_catalog_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "failpoint-catalog")
+        p = "xllm_service_tpu/service/bad_failpoints.py"
+        # Undeclared name: the closed catalog rejects it.
+        assert f"{p}::failpoint::fixture.bogus_failpoint" in keys
+        # Non-literal name: unverifiable statically — also a finding.
+        assert f"{p}::failpoint-nonliteral" in keys
+
 
 class TestNoFalsePositives:
     def test_clean_fixture_is_clean(self):

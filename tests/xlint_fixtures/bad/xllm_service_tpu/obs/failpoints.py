@@ -1,0 +1,5 @@
+"""Fixture catalog for the failpoint-catalog rule (bad tree)."""
+
+FAILPOINTS = (
+    "fixture.ok_failpoint",
+)

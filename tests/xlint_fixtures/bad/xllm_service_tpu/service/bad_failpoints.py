@@ -1,0 +1,15 @@
+"""failpoint-catalog positive controls: fire sites the closed catalog
+must reject — an undeclared name and a non-literal name."""
+
+
+class Worker:
+    def __init__(self, failpoints):
+        self.failpoints = failpoints
+
+    def undeclared(self):
+        # Name not in the fixture FAILPOINTS catalog.
+        self.failpoints.fire("fixture.bogus_failpoint")
+
+    def nonliteral(self, name):
+        # Cannot be verified statically against the catalog.
+        self.failpoints.fire(name)

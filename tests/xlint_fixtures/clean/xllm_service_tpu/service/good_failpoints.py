@@ -1,0 +1,20 @@
+"""failpoint-catalog near-misses that must NOT fire."""
+
+
+class Worker:
+    def __init__(self, failpoints, gun, ops):
+        self.failpoints = failpoints
+        self.gun = gun
+        self.ops = ops
+
+    def fine(self, n):
+        # Declared name: clean.
+        self.failpoints.fire("fixture.ok_failpoint", n=n)
+        # .fire() on receivers that are NOT a failpoint set (event
+        # guns, ops buses) are out of the rule's namespace.
+        self.gun.fire("whatever_shape_it_likes")
+        self.ops.fire(n)
+        # A local variable named like a failpoint set still counts —
+        # and this one uses a declared name, so it stays clean.
+        failpoints = self.failpoints
+        failpoints.fire("fixture.ok_failpoint")

@@ -356,6 +356,7 @@ LOCK_RANK_TABLE: Dict[str, int] = {
     "kvcache_mgr": 35,
     "coordination_net": 60,
     "etcd.watches": 60,
+    "obs.failpoints": 75,
     "obs.slo": 78,
     "obs.watchdog": 79,
     "obs.events": 80,
@@ -1125,16 +1126,18 @@ class MetricsRegistryRule:
 _EVENTS_MODULE = "xllm_service_tpu/obs/events.py"
 
 
-def _load_event_catalog(tree: RepoTree) -> Optional[Set[str]]:
-    """The ``EVENT_TYPES`` literal from obs/events.py — from the linted
-    tree when in scope, else read from disk (subtree runs must judge
-    against the same catalog the full run does). None when the module
-    is missing or the literal can't be found."""
-    mod = tree.get(_EVENTS_MODULE)
+def _load_string_tuple_catalog(tree: RepoTree, module_path: str,
+                               symbol: str) -> Optional[Set[str]]:
+    """A module-level all-string-literal tuple/list/set named ``symbol``
+    from ``module_path`` — from the linted tree when in scope, else read
+    from disk (subtree runs must judge against the same catalog the
+    full run does). None when the module is missing or the literal
+    can't be found."""
+    mod = tree.get(module_path)
     if mod is not None:
         t = mod.tree
     else:
-        src = tree.read_text(_EVENTS_MODULE)
+        src = tree.read_text(module_path)
         if src is None:
             return None
         try:
@@ -1143,7 +1146,7 @@ def _load_event_catalog(tree: RepoTree) -> Optional[Set[str]]:
             return None
     for node in t.body:
         if isinstance(node, ast.Assign) and any(
-                isinstance(x, ast.Name) and x.id == "EVENT_TYPES"
+                isinstance(x, ast.Name) and x.id == symbol
                 for x in node.targets):
             v = node.value
             if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
@@ -1156,6 +1159,12 @@ def _load_event_catalog(tree: RepoTree) -> Optional[Set[str]]:
                         return None
                 return out
     return None
+
+
+def _load_event_catalog(tree: RepoTree) -> Optional[Set[str]]:
+    """The ``EVENT_TYPES`` literal from obs/events.py."""
+    return _load_string_tuple_catalog(tree, _EVENTS_MODULE,
+                                      "EVENT_TYPES")
 
 
 class EventCatalogRule:
@@ -1225,6 +1234,85 @@ class EventCatalogRule:
                                      or name.endswith("_events"))
 
 
+# ---------------------------------------------------------------------------
+# Rule 10: failpoint-catalog
+# ---------------------------------------------------------------------------
+
+_FAILPOINTS_MODULE = "xllm_service_tpu/obs/failpoints.py"
+
+
+def _load_failpoint_catalog(tree: RepoTree) -> Optional[Set[str]]:
+    """The ``FAILPOINTS`` literal from obs/failpoints.py."""
+    return _load_string_tuple_catalog(tree, _FAILPOINTS_MODULE,
+                                      "FAILPOINTS")
+
+
+class FailpointCatalogRule:
+    name = "failpoint-catalog"
+    describe = ("every failpoints.fire(\"<name>\") call site uses a "
+                "name declared in the obs/failpoints.py FAILPOINTS "
+                "catalog (closed taxonomy, like event-catalog)")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        findings: List[Finding] = []
+        catalog = _load_failpoint_catalog(tree)
+        for mod in tree.modules:
+            if mod.path == _FAILPOINTS_MODULE:
+                continue        # the catalog module itself
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "fire"
+                        and self._is_failpoints_receiver(
+                            node.func.value)):
+                    continue
+                if catalog is None:
+                    findings.append(Finding(
+                        rule=self.name, path=mod.path, line=node.lineno,
+                        key=f"{mod.path}::catalog-missing",
+                        message=f"failpoints.fire() call but no "
+                                f"FAILPOINTS literal found in "
+                                f"{_FAILPOINTS_MODULE} — the closed "
+                                f"catalog has nowhere to live"))
+                    continue
+                arg = node.args[0] if node.args else None
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    if arg.value not in catalog:
+                        findings.append(Finding(
+                            rule=self.name, path=mod.path,
+                            line=node.lineno,
+                            key=f"{mod.path}::failpoint::{arg.value}",
+                            message=f"failpoint {arg.value!r} is not "
+                                    f"declared in the "
+                                    f"{_FAILPOINTS_MODULE} FAILPOINTS "
+                                    f"catalog — add it there (and to "
+                                    f"docs/ROBUSTNESS.md) or fix the "
+                                    f"spelling"))
+                else:
+                    findings.append(Finding(
+                        rule=self.name, path=mod.path, line=node.lineno,
+                        key=f"{mod.path}::failpoint-nonliteral",
+                        message="failpoints.fire() with a non-literal "
+                                "name — the static checker cannot "
+                                "verify it against the catalog; spell "
+                                "the name inline"))
+        return findings
+
+    @staticmethod
+    def _is_failpoints_receiver(expr: ast.AST) -> bool:
+        """The receiver looks like a failpoint set: terminal name
+        ``failpoints`` / ``_failpoints`` / ``*_failpoints`` (mirrors
+        EventCatalogRule's name-based namespace)."""
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        return name is not None and (name == "failpoints"
+                                     or name.endswith("_failpoints"))
+
+
 RULES = [
     MosaicCompatRule(),
     DonationCoverageRule(),
@@ -1235,4 +1323,5 @@ RULES = [
     ServiceHygieneRule(),
     MetricsRegistryRule(),
     EventCatalogRule(),
+    FailpointCatalogRule(),
 ]

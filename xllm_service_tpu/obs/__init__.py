@@ -14,6 +14,10 @@ Dependency-free (stdlib only), thread-safe, shared by both planes:
   ``GET /admin/trace/<request_id>``.
 - ``events``: the bounded structured cluster event log (closed
   taxonomy, ``event-catalog`` xlint rule) behind ``GET /admin/events``.
+- ``failpoints``: deterministic fault injection — a closed catalog of
+  named failure sites (``failpoint-catalog`` xlint rule), armed via
+  ``XLLM_FAILPOINTS`` / ``POST /admin/failpoint``; the chaos tests'
+  lever (docs/ROBUSTNESS.md).
 - ``slo``: the judgment layer — multi-window SLO burn-rate engine and
   the watchdog's anomaly detector, behind ``GET /admin/slo`` and the
   ``xllm_slo_*`` / ``xllm_anomaly_active`` series.
@@ -23,6 +27,8 @@ See docs/OBSERVABILITY.md for the full series and stage catalogue.
 
 from xllm_service_tpu.obs.events import (           # noqa: F401
     EVENT_TYPES, EventLog)
+from xllm_service_tpu.obs.failpoints import (       # noqa: F401
+    FAILPOINTS, Failpoints)
 from xllm_service_tpu.obs.expfmt import (           # noqa: F401
     fraction_le_from_buckets, histogram_fraction_le, histogram_quantile,
     parse_exposition, validate_exposition)

@@ -49,6 +49,9 @@ EVENT_TYPES = (
     "slo_breach_close",     # ... and recovered
     "anomaly_open",         # watchdog opened a per-instance anomaly
     "anomaly_close",        # ... and it cleared
+    "request_recovered",    # mid-stream failover resumed a request
+    "recovery_failed",      # ... or exhausted its retry budget
+    "failpoint_tripped",    # an armed fault-injection site fired
 )
 
 DEFAULT_CAPACITY = 1024

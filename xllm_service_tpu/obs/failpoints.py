@@ -1,0 +1,218 @@
+"""Deterministic fault injection: a closed catalog of named failure
+sites threaded through the worker and the service.
+
+The recovery subsystem (docs/ROBUSTNESS.md) is only as trustworthy as
+the failures it was proven against — and SIGKILL-under-load chaos tests
+are slow and nondeterministic. Failpoints make the interesting failure
+modes *injectable*: each named site calls ``failpoints.fire("<name>")``
+on its hot path (a dict lookup when nothing is armed), and an armed
+failpoint fires deterministically (count/threshold modes carry no
+randomness, so a test that arms ``worker.die_after_n_tokens=after:6``
+gets a worker that dies after exactly six dispatched tokens, every
+run).
+
+Design rules (mirroring obs/events.py):
+
+- CLOSED catalog. ``FAILPOINTS`` below is the complete list;
+  ``arm()``/``fire()`` reject anything else at runtime, and the
+  ``failpoint-catalog`` xlint rule rejects unknown or non-literal
+  names statically at every ``*.fire("<name>")`` call site. A failure
+  site nobody declared is a failure mode no chaos test knows to arm.
+- Armed via the ``XLLM_FAILPOINTS`` env at construction (spec grammar
+  below) and at runtime via ``POST /admin/failpoint`` on either plane.
+- Every trip is visible: ``xllm_failpoints_tripped_total{name}`` in the
+  constructing plane's registry, and a ``failpoint_tripped`` event when
+  the plane has an event log (the service plane; workers have metrics
+  only).
+
+Spec grammar (comma-separated entries)::
+
+    name=always[:value]     fire every time (value: site-specific arg,
+                            e.g. worker.slow_response_ms=always:250)
+    name=count:N[:value]    fire the first N times, then auto-disarm
+    name=after:N            fire ONCE when the cumulative units passed
+                            to fire(..., n=...) reach N, then disarm
+                            (die-after-N-tokens)
+    name=prob:P[:value]     fire with probability P (load tests only —
+                            the deterministic modes are for CI)
+    name=off                explicit no-op (override an env arming)
+
+Thread-safe; rank ``obs.failpoints`` in the utils/locks.py table (the
+lock guards arming state only and never calls out).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Any, Dict, Optional
+
+from xllm_service_tpu.utils.locks import make_lock
+
+# The complete failpoint catalog (docs/ROBUSTNESS.md documents each
+# site's semantics). Adding a site means adding it HERE (the
+# failpoint-catalog xlint rule pins every fire() call to this tuple)
+# and documenting it.
+FAILPOINTS = (
+    "worker.drop_heartbeats",    # skip store keepalive + master beat
+    "worker.refuse_generate",    # 503 every new generate (refusal class)
+    "worker.hang_rpc",           # block a generate handler (value: s)
+    "worker.die_after_n_tokens",  # simulate process death mid-stream
+    "worker.slow_response_ms",   # delay a generate handler (value: ms)
+    "worker.fail_kv_transfer",   # PD migration transport failure
+    "service.fail_redispatch",   # service refuses to pick an alternate
+)
+
+_MODES = ("always", "count", "after", "prob", "off")
+
+
+class Failpoints:
+    """Per-plane armed-failpoint registry (one per Worker/HttpService —
+    the co-located test harness arms one in-process worker without
+    touching its twin)."""
+
+    def __init__(self, events=None, obs=None,
+                 env: Optional[str] = None) -> None:
+        self._lock = make_lock("obs.failpoints", 75)
+        self.events = events
+        self.obs = obs
+        # name → {"mode", "n", "value", "fired", "units"}
+        self._armed: Dict[str, Dict[str, Any]] = {}
+        self._trips: Dict[str, int] = {name: 0 for name in FAILPOINTS}
+        spec = os.environ.get("XLLM_FAILPOINTS", "") if env is None \
+            else env
+        if spec:
+            self.arm_from_spec(spec)
+
+    # -- arming ---------------------------------------------------------
+    @staticmethod
+    def _check_name(name: str) -> None:
+        if name not in FAILPOINTS:
+            raise ValueError(
+                f"failpoint {name!r} is not in the obs/failpoints.py "
+                f"catalog {FAILPOINTS}")
+
+    def arm(self, name: str, mode: str = "always", n: float = 0,
+            value: Any = None) -> None:
+        """Arm one failpoint. ``n`` is the count (mode=count), the unit
+        threshold (mode=after), or the probability (mode=prob)."""
+        self._check_name(name)
+        if mode not in _MODES:
+            raise ValueError(f"failpoint mode {mode!r} not in {_MODES}")
+        with self._lock:
+            if mode == "off":
+                self._armed.pop(name, None)
+                return
+            self._armed[name] = {"mode": mode, "n": float(n),
+                                 "value": value, "fired": 0, "units": 0.0}
+
+    def disarm(self, name: str) -> None:
+        self._check_name(name)
+        with self._lock:
+            self._armed.pop(name, None)
+
+    def arm_from_spec(self, spec: str) -> None:
+        """Parse the ``XLLM_FAILPOINTS`` grammar (module docstring) —
+        also the body format of ``POST /admin/failpoint`` ``{"spec"}``."""
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            name, sep, rest = entry.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"failpoint spec entry {entry!r}: expected "
+                    f"name=mode[:arg[:value]]")
+            parts = rest.split(":")
+            mode = parts[0] or "always"
+            n = 0.0
+            value: Any = None
+            if mode in ("count", "after", "prob"):
+                if len(parts) < 2:
+                    raise ValueError(
+                        f"failpoint {name}: mode {mode!r} needs an "
+                        f"argument (e.g. {mode}:3)")
+                n = float(parts[1])
+                if len(parts) > 2:
+                    value = float(parts[2])
+            elif len(parts) > 1:
+                value = float(parts[1])
+            self.arm(name.strip(), mode=mode, n=n, value=value)
+
+    def arm_from_body(self, body: Dict[str, Any]) -> None:
+        """The ``POST /admin/failpoint`` body contract, shared by both
+        planes' handlers (``{"spec": "<grammar>"}`` or
+        ``{"name", "mode", "n", "value"}``). Raises ValueError/TypeError
+        on bad input — handlers map to HTTP 400."""
+        if body.get("spec"):
+            self.arm_from_spec(str(body["spec"]))
+        else:
+            self.arm(str(body.get("name", "")),
+                     mode=str(body.get("mode", "always")),
+                     n=float(body.get("n", 0) or 0),
+                     value=body.get("value"))
+
+    # -- firing ---------------------------------------------------------
+    def fire(self, name: str, n: float = 1) -> Optional[Any]:
+        """One pass through a failure site. Returns the armed value (or
+        ``True`` when none was set) when the failpoint trips, else
+        ``None``. ``n`` is the unit weight of this pass (token count
+        for ``after``-mode sites)."""
+        self._check_name(name)
+        if name not in self._armed:
+            # Unlocked fast path: disarmed sites (production — fire()
+            # runs per engine step) cost one dict probe, no mutex. The
+            # race with a concurrent arm() is benign: a just-armed
+            # point fires on the next pass.
+            return None
+        with self._lock:
+            spec = self._armed.get(name)
+            if spec is None:
+                return None
+            mode = spec["mode"]
+            if mode == "count":
+                if spec["fired"] >= spec["n"]:
+                    self._armed.pop(name, None)
+                    return None
+            elif mode == "after":
+                spec["units"] += n
+                if spec["units"] < spec["n"]:
+                    return None
+                self._armed.pop(name, None)   # fires exactly once
+            elif mode == "prob":
+                if random.random() >= spec["n"]:
+                    return None
+            spec["fired"] += 1
+            self._trips[name] += 1
+            value = spec["value"]
+        self._note_trip(name)
+        return value if value is not None else True
+
+    def _note_trip(self, name: str) -> None:
+        """Visibility, outside the arming lock: the registry counter
+        and (service plane) a cluster event."""
+        if self.obs is not None:
+            self.obs.counter(
+                "xllm_failpoints_tripped_total",
+                "armed failure-injection sites tripped, by name "
+                "(obs/failpoints.py catalog)",
+                labelnames=("name",)).inc(name=name)
+        if self.events is not None:
+            self.events.emit("failpoint_tripped", name=name)
+
+    # -- querying -------------------------------------------------------
+    def trips(self, name: str) -> int:
+        self._check_name(name)
+        with self._lock:
+            return self._trips[name]
+
+    def state(self) -> Dict[str, Any]:
+        """The ``GET /admin/failpoints`` body: what is armed (mode /
+        remaining budget / value) and per-name lifetime trip counts."""
+        with self._lock:
+            armed = {name: dict(spec)
+                     for name, spec in self._armed.items()}
+            trips = {name: count for name, count in self._trips.items()
+                     if count}
+        return {"catalog": list(FAILPOINTS), "armed": armed,
+                "trips": trips}

@@ -43,7 +43,8 @@ from xllm_service_tpu.config import (
     EngineConfig, InstanceType, ModelConfig)
 from xllm_service_tpu.nlp.tokenizer import (
     IncrementalDecoder, Tokenizer, TokenizerFactory)
-from xllm_service_tpu.obs import REQUEST_ID_HEADER, Registry, SpanStore
+from xllm_service_tpu.obs import (
+    Failpoints, REQUEST_ID_HEADER, Registry, SpanStore)
 from xllm_service_tpu.obs.expfmt import quantile_from_buckets
 from xllm_service_tpu.runtime.engine import Engine, EngineRequest, StepOutput
 from xllm_service_tpu.service.coordination import (
@@ -56,6 +57,7 @@ from xllm_service_tpu.service.response_handler import (
     ChatStreamAssembler, CompletionStreamAssembler, ResponseCollector,
     sse_frame, SSE_DONE)
 from xllm_service_tpu.utils.misc import short_uuid
+from xllm_service_tpu.utils.retry import RetryPolicy
 from xllm_service_tpu.utils.wire import check_version, stamp
 from xllm_service_tpu.utils.types import (
     FinishReason, LogProb, RequestOutput, SamplingParams, SequenceOutput,
@@ -67,6 +69,12 @@ logger = logging.getLogger(__name__)
 MODEL_AWAKE = "awake"
 MODEL_ASLEEP = "asleep"
 MODEL_DRAINING = "draining"
+
+# Queue sentinel for a SIMULATED worker death (the die_after_n_tokens
+# failpoint): unlike the graceful None sentinel — which closes a stream
+# with a tidy [DONE] — _ABORT makes the consumer RAISE so the client
+# socket breaks mid-stream, exactly like a SIGKILL'd process.
+_ABORT = object()
 
 
 @dataclasses.dataclass
@@ -360,7 +368,7 @@ class _LiveRequest:
                  "stream_to_service", "service_request_id", "model",
                  "is_chat", "stream", "include_usage", "first_out_time",
                  "sampling", "prompt_tokens", "target_n", "prompt_lps",
-                 "_echo_cache")
+                 "_echo_cache", "emit_token_ids")
 
     def __init__(self, req: EngineRequest, tokenizer: Tokenizer,
                  service_request_id: str, model: str, is_chat: bool,
@@ -386,6 +394,10 @@ class _LiveRequest:
         # best_of: ``n`` above is the CANDIDATE count; target_n is how
         # many survive server-side selection (set by _parse_generate).
         self.target_n = n
+        # Recovery ledger extension (service-set "ledger_tokens" on the
+        # forwarded body): stream assemblers include per-frame token
+        # ids under a top-level "xllm" key the service strips.
+        self.emit_token_ids = False
         # echo+logprobs: prompt-token scores, computed ONCE (candidate 0)
         # and shared by every choice's echo emission.
         self.prompt_lps: Optional[List[Optional[float]]] = None
@@ -472,6 +484,28 @@ class Worker:
         self.obs = Registry()
         self.spans = SpanStore(capacity=int(os.environ.get(
             "XLLM_SPAN_RING", "2048")))
+        # Deterministic fault injection (obs/failpoints.py): per-worker
+        # so the co-located test harness can kill ONE of two in-process
+        # workers; armed via XLLM_FAILPOINTS and POST /admin/failpoint.
+        # Trips surface as xllm_failpoints_tripped_total{name}.
+        self.failpoints = Failpoints(obs=self.obs)
+        # Simulated death (worker.die_after_n_tokens): refuses work,
+        # drops liveness, breaks streams — but the process survives.
+        self._dead = False
+        # Heartbeat backoff against a down master: the loop keeps
+        # ticking (store keepalive must continue — master-down is not
+        # worker-dead) but beat SENDS back off exponentially with full
+        # jitter so a restarting master isn't thundering-herded by the
+        # fleet. Resets on the first acked beat.
+        self._hb_backoff = RetryPolicy(
+            max_attempts=1,     # unused: the loop is unbounded
+            base_delay_s=opts.heartbeat_interval_s,
+            max_delay_s=float(os.environ.get(
+                "XLLM_HB_BACKOFF_CAP_S", "30") or 30),
+            multiplier=2.0, jitter=0.5)
+        # Registration (store write) retry at boot — same policy shape.
+        self._reg_retry = RetryPolicy(max_attempts=5, base_delay_s=0.2,
+                                      max_delay_s=5.0)
         # Serializes heartbeat BUILD+SEND: without it a pre-drain
         # heartbeat still in flight can land after the drain heartbeat
         # and re-mark the models awake at the router.
@@ -526,6 +560,9 @@ class Worker:
         router.route("POST", "/kv/chunk", self._serve_kv_chunk)
         router.route("POST", "/encode", self._serve_encode)
         router.route("POST", "/v1/embeddings", self._serve_embeddings)
+        router.route("POST", "/admin/failpoint", self._serve_failpoint)
+        router.route("GET", "/admin/failpoints",
+                     self._serve_failpoints)
         self._router = router
         # Jitted embedding fns keyed by model name — a multi-model worker
         # must never run model B's params through model A's closed-over
@@ -624,7 +661,20 @@ class Worker:
         _LOCAL_WORKERS[self.name] = self
         if self._should_warmup():
             self._warmup_all()
-        self._register()
+        # Registration writes through the coordination store — retry a
+        # boot-time store hiccup with capped, jittered backoff instead
+        # of crashing the (already warmed) worker on one bad RPC.
+        for attempt in range(self._reg_retry.max_attempts):
+            try:
+                self._register()
+                break
+            except Exception as e:  # noqa: BLE001 — transient store error
+                if attempt + 1 >= self._reg_retry.max_attempts \
+                        or self._stop.is_set():
+                    raise
+                logger.warning("registration attempt %d failed (%s); "
+                               "backing off", attempt + 1, e)
+                self._reg_retry.sleep(attempt, stop_event=self._stop)
         # Failover-follow is only for workers CONFIGURED with a service in
         # front: a deliberately standalone worker sharing the store must
         # not silently adopt the advertised master and start taking
@@ -921,6 +971,15 @@ class Worker:
             to_service: List[RequestOutput] = self._service_push_buffer
             self._service_push_buffer = []
         for out in outs:
+            if not self._dead and self.failpoints.fire(
+                    "worker.die_after_n_tokens",
+                    n=len(out.new_token_ids)) is not None:
+                self._die()
+            if self._dead:
+                # Simulated death: outputs past the trip point — and
+                # anything buffered for the fan-in — are lost, exactly
+                # like a crashed process's socket buffers.
+                return
             with self._live_lock:
                 live = self._live.get(out.request_id)
             if live is None:
@@ -956,16 +1015,7 @@ class Worker:
                 if out.finished:
                     self._drop_live(out.request_id)
         if to_service and self.service_addr:
-            try:
-                status, _ = http_json(
-                    "POST", self.service_addr, "/rpc/generations",
-                    {"outputs": [o.to_json() for o in to_service]},
-                    timeout=30.0)
-                if status != 200:
-                    logger.warning("generations push refused: %d (%d "
-                                   "outputs lost)", status, len(to_service))
-            except Exception as e:  # noqa: BLE001
-                logger.warning("generations push failed: %s", e)
+            self._push_outputs_to_service(to_service)
 
     def _drop_live(self, request_id: str) -> None:
         with self._live_lock:
@@ -1131,6 +1181,51 @@ class Worker:
             self._work_event.set()
 
     # ------------------------------------------------------------------
+    # Fault injection (obs/failpoints.py; docs/ROBUSTNESS.md)
+    # ------------------------------------------------------------------
+    def _die(self) -> None:
+        """``worker.die_after_n_tokens`` tripped: make this worker LOOK
+        dead without killing the (possibly shared) test process —
+        refuse new work, stop liveness (store keepalive + master
+        beats stop via the drop_heartbeats arming, so the lease expires
+        like a crash), break every in-flight stream mid-frame (_ABORT),
+        and stop pushing fan-in outputs."""
+        if self._dead:
+            return
+        self._dead = True
+        self._refuse_new = True
+        logger.warning("failpoint worker.die_after_n_tokens tripped: "
+                       "%s simulating death", self.name)
+        self.failpoints.arm("worker.drop_heartbeats", mode="always")
+        with self._live_lock:
+            lives = list(self._live_srid.values())
+        for live in lives:
+            rt = self.runtimes.get(live.model) or self.primary_runtime()
+            if rt.engine is not None:
+                with self._engine_lock:
+                    for erid in live.engine_rids:
+                        rt.engine.cancel(erid)
+            live.q.put(_ABORT)
+        self._work_event.set()
+
+    def _serve_failpoint(self, req: Request) -> Response:
+        """Arm/disarm one failpoint (or a whole XLLM_FAILPOINTS-grammar
+        spec) at runtime. Closed catalog: unknown names are a 400."""
+        try:
+            body = req.json()
+        except Exception:  # noqa: BLE001
+            return Response.error(400, "invalid JSON body")
+        try:
+            self.failpoints.arm_from_body(body)
+        except (TypeError, ValueError) as e:
+            return Response.error(400, str(e))
+        return Response.json({"ok": True,
+                              "state": self.failpoints.state()})
+
+    def _serve_failpoints(self, req: Request) -> Response:
+        return Response.json(self.failpoints.state())
+
+    # ------------------------------------------------------------------
     # Serving
     # ------------------------------------------------------------------
     def _parse_generate(self, body: Dict[str, Any], is_chat: bool,
@@ -1238,6 +1333,9 @@ class Worker:
             n=n, stops=sampling.stop)
         live.sampling = sampling          # original (pre-pd) params
         live.prompt_tokens = len(token_ids)
+        # Service-armed recovery ledger: emit per-frame token ids so
+        # the relay can resume this stream exactly-once after a death.
+        live.emit_token_ids = bool(body.get("ledger_tokens"))
         if not pd_prefill:
             live.target_n = max(1, sampling.n)
         with self._live_lock:
@@ -1322,6 +1420,23 @@ class Worker:
     def _serve_generate_inner(self, req: Request,
                               is_chat: bool) -> Response:
         t_recv = time.monotonic()
+        # Injected faults first (no-ops unless armed): a delayed, hung,
+        # or refused generate — the degraded-worker modes the service's
+        # retry/redispatch/recovery machinery is tested against.
+        v = self.failpoints.fire("worker.slow_response_ms")
+        if v is not None:
+            self._stop.wait((float(v) if v is not True else 100.0)
+                            / 1000.0)
+        v = self.failpoints.fire("worker.hang_rpc")
+        if v is not None:
+            # Hang for the armed seconds (default: effectively forever)
+            # unless the worker shuts down first; then refuse.
+            self._stop.wait(float(v) if v is not True else 3600.0)
+            return Response.error(503, "hung rpc released (failpoint)",
+                                  "unavailable")
+        if self.failpoints.fire("worker.refuse_generate") is not None:
+            return Response.error(503, "refused by failpoint",
+                                  "unavailable")
         try:
             body = req.json()
         except Exception:  # noqa: BLE001
@@ -1388,7 +1503,8 @@ class Worker:
                     ) -> Iterator[bytes]:
         asm = (ChatStreamAssembler if live.is_chat
                else CompletionStreamAssembler)(
-            live.service_request_id, live.model, live.include_usage)
+            live.service_request_id, live.model, live.include_usage,
+            emit_token_ids=live.emit_token_ids)
         try:
             # The initial frames sit INSIDE the try: a client disconnect
             # while they stream must still run the finalizer.
@@ -1397,6 +1513,10 @@ class Worker:
                     yield frame
             while True:
                 out = live.q.get()
+                if out is _ABORT:
+                    # Simulated death: break the socket mid-stream (no
+                    # [DONE]) so the relay sees what a crash looks like.
+                    raise RuntimeError("worker died (failpoint)")
                 if out is None:
                     yield SSE_DONE
                     return
@@ -1420,6 +1540,8 @@ class Worker:
         try:
             while True:
                 out = live.q.get()
+                if out is _ABORT:
+                    raise RuntimeError("worker died (failpoint)")
                 if out is None:
                     break
                 done = False
@@ -1800,6 +1922,11 @@ class Worker:
             self._drop_live(srid)
             self._finalize_live(live)
             return Response.error(504, "prefill timed out")
+        if first is _ABORT:
+            self._drop_live(srid)
+            self._finalize_live(live)
+            return Response.error(503, "worker died (failpoint)",
+                                  "unavailable")
         self._drop_live(srid)
         if first is None or first.finish_reason == FinishReason.STOP \
                 or first.finish_reason == FinishReason.CANCELLED:
@@ -1820,6 +1947,20 @@ class Worker:
         # relay/migrate streams below are tracked by _relay_streams.
         live.choices[0].finished = True
         self._finalize_live(live)
+        if self.failpoints.fire("worker.fail_kv_transfer") is not None:
+            # Injected transport failure: every migration path is
+            # skipped as if the decode peer were unreachable, proving
+            # the local-decode fallback keeps the request alive.
+            with self._engine_lock:
+                exported = rt.engine.export_held(srid, device=True)
+            if exported is None:
+                return Response.error(500, "prefill KV export failed")
+            tokens, k, v = exported
+            logger.warning("failpoint worker.fail_kv_transfer: decoding "
+                           "%s locally", srid)
+            return self._local_decode_fallback(
+                live, tokens, np.asarray(jax.device_get(k)),
+                np.asarray(jax.device_get(v)))
         peer = (_LOCAL_WORKERS.get(decode_name)
                 if self.opts.pd_direct_kv else None)
         if peer is not None and peer is not self:
@@ -2280,12 +2421,16 @@ class Worker:
         return self._decode_to_service and bool(self.service_addr)
 
     def _push_outputs_to_service(self, outs: List[RequestOutput]) -> None:
-        if not outs:
+        if not outs or self._dead:
             return
         try:
+            # "from" = sender identity: the scheduler's exactly-once
+            # guard drops straggler pushes from a deposed instance
+            # after a mid-stream recovery retargets the request.
             status, _ = http_json(
                 "POST", self.service_addr, "/rpc/generations",
-                stamp({"outputs": [o.to_json() for o in outs]}),
+                stamp({"outputs": [o.to_json() for o in outs],
+                       "from": self.name}),
                 timeout=30.0)
             if status != 200:
                 logger.warning("generations push refused: %d (%d outputs "
@@ -2298,7 +2443,8 @@ class Worker:
         if live.stream:
             asm = (ChatStreamAssembler if live.is_chat
                    else CompletionStreamAssembler)(
-                live.service_request_id, live.model, live.include_usage)
+                live.service_request_id, live.model, live.include_usage,
+                emit_token_ids=live.emit_token_ids)
             frames: List[bytes] = []
             for ro in outs:
                 frames.extend(asm.on_output(ro))
@@ -2322,7 +2468,8 @@ class Worker:
         if live.stream:
             asm = (ChatStreamAssembler if live.is_chat
                    else CompletionStreamAssembler)(
-                live.service_request_id, live.model, live.include_usage)
+                live.service_request_id, live.model, live.include_usage,
+                emit_token_ids=live.emit_token_ids)
 
             def gen() -> Iterator[bytes]:
                 for payload in iter_sse_events(all_chunks()):
@@ -2355,6 +2502,7 @@ class Worker:
             stops=live.sampling.stop)
         new_live.sampling = live.sampling
         new_live.prompt_tokens = len(live.req.token_ids)
+        new_live.emit_token_ids = live.emit_token_ids
         # The migrated first token reaches the client via first_out below,
         # outside _to_request_output — count it here.
         new_live.choices[0].completion_tokens = 1
@@ -2590,6 +2738,8 @@ class Worker:
                             rt.engine.cancel(srid)
                     self._drop_live(srid)
                     return
+                if out is _ABORT:
+                    raise RuntimeError("worker died (failpoint)")
                 if out is None:
                     return
                 done = False
@@ -2628,6 +2778,7 @@ class Worker:
         self._service_config_stale = not self._fetch_service_config() \
             and bool(self.service_addr)
         hb_failures = 0
+        next_hb = 0.0
         while not self._stop.wait(self.opts.heartbeat_interval_s):
             try:
                 # Periodic sweep of orphaned chunked-shuttle staging —
@@ -2635,17 +2786,37 @@ class Worker:
                 # worker, pinning a dead prefill's device KV forever.
                 with self._kv_chunk_mu:
                     self._evict_stale_chunks_locked(time.monotonic())
+                if self.failpoints.fire(
+                        "worker.drop_heartbeats") is not None:
+                    # Simulated crash/partition: no store keepalive, no
+                    # master beat — the lease expires exactly as if the
+                    # process were gone.
+                    continue
                 if self._lease_id is not None:
                     self.store.lease_keepalive(self._lease_id)
                 if self._service_config_stale:
                     self._service_config_stale = not \
                         self._fetch_service_config()
-                if self._send_heartbeat():
-                    hb_failures = 0
-                else:
-                    hb_failures += 1
+                # The loop keeps ticking at the base cadence (the store
+                # keepalive above MUST — a down master is not a dead
+                # worker), but beat SENDS back off exponentially with
+                # full jitter so a restarting master isn't
+                # thundering-herded by its whole fleet at once. The
+                # gate must not skip the advertised-address re-read
+                # below: a NEW master's advertisement has to be adopted
+                # at tick cadence, not at the backoff cadence.
+                if time.monotonic() >= next_hb:
+                    if self._send_heartbeat():
+                        hb_failures = 0
+                        next_hb = 0.0
+                    else:
+                        hb_failures += 1
+                        next_hb = time.monotonic() + \
+                            self._hb_backoff.delay(hb_failures - 1)
             except Exception as e:  # noqa: BLE001
                 hb_failures += 1
+                next_hb = time.monotonic() + \
+                    self._hb_backoff.delay(hb_failures - 1)
                 logger.warning("heartbeat failed: %s", e)
             if hb_failures >= 2 and self.opts.service_addr:
                 # The master may have moved while we missed the watch
@@ -2653,6 +2824,7 @@ class Worker:
                 # advertisement directly.
                 if self._adopt_advertised_addr():
                     hb_failures = 0
+                    next_hb = 0.0
 
     def _send_heartbeat(self) -> bool:
         """→ True when the service acknowledged (HTTP 200) — the drain

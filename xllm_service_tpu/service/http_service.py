@@ -21,6 +21,7 @@ the manual sleep/wakeup surface (service.cpp:510-550).
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import math
@@ -33,22 +34,36 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from xllm_service_tpu.config import ServiceOptions
 from xllm_service_tpu.obs import (
-    REQUEST_ID_HEADER, AnomalyDetector, EventLog, InstanceSignal,
-    Registry, SloConfig, SloEngine, SpanStore)
+    REQUEST_ID_HEADER, AnomalyDetector, EventLog, Failpoints,
+    InstanceSignal, Registry, SloConfig, SloEngine, SpanStore)
 from xllm_service_tpu.obs.expfmt import fraction_le_from_buckets
 from xllm_service_tpu.service.httpd import (
-    Request, Response, Router, http_json, http_stream_status)
+    Request, Response, Router, http_json, http_stream_status,
+    iter_sse_events)
 from xllm_service_tpu.service.instance_types import RequestPhase
+from xllm_service_tpu.service.recovery import RecoveryManager, RelayLedger
 from xllm_service_tpu.service.response_handler import (
-    ChatStreamAssembler, CompletionStreamAssembler, ResponseCollector)
+    SSE_DONE, ChatStreamAssembler, CompletionStreamAssembler,
+    ResponseCollector)
 from xllm_service_tpu.service.scheduler import Scheduler
 from xllm_service_tpu.service.tracer import RequestTracer
 from xllm_service_tpu.utils.misc import short_uuid
+from xllm_service_tpu.utils.retry import RetryPolicy
 from xllm_service_tpu.utils.types import (
     FinishReason, Request as SchedRequest, RequestOutput,
     parse_openai_sampling, validate_sampling)
 
 logger = logging.getLogger(__name__)
+
+# Pre-header transport deaths on a forwarded STREAM: the worker's
+# process (or socket) died before it answered. Safe to re-dispatch in
+# the relay-stream topology even though the worker may have started —
+# its only delivery path is this broken socket, so its eventual write
+# fails and the response cleanup cancels the engine request; the client
+# can never see duplicate work. Timeouts stay excluded: a slow worker's
+# socket is alive and still deliverable.
+_DEAD_TRANSPORT_ERRORS = (ConnectionRefusedError, ConnectionResetError,
+                          BrokenPipeError, http.client.RemoteDisconnected)
 
 
 class _RequestObs:
@@ -173,6 +188,24 @@ class HttpService:
         self._wd_stop = threading.Event()
         self._wd_thread: Optional[threading.Thread] = None
 
+        # --- robustness layer: failpoints + retry + mid-stream recovery
+        # Service-plane fault injection (the "service.*" catalog names;
+        # each worker owns its own set) — POST /admin/failpoint also
+        # proxies worker arming through the instance registry.
+        self.failpoints = Failpoints(events=self.events, obs=self.obs)
+        # The one retry/backoff policy every forward/redispatch loop
+        # shares (utils/retry.py; XLLM_RETRY_* knobs) — replaced the
+        # ad-hoc two-attempt loops that used to live here.
+        self.retry = RetryPolicy.from_env()
+        # Mid-stream failover (service/recovery.py): worker death
+        # becomes a resume, not a client-visible error. Wired onto the
+        # scheduler like spans/obs so fail_requests_on_instance can
+        # hand recoverable requests over instead of cancelling.
+        self.recovery = RecoveryManager(opts, scheduler, self.spans,
+                                        self.events, self.obs,
+                                        self.failpoints)
+        scheduler.recovery = self.recovery
+
     # ------------------------------------------------------------------
     # Watchdog: periodic SLO evaluation + anomaly detection
     # ------------------------------------------------------------------
@@ -258,6 +291,9 @@ class HttpService:
         router.route("GET", "/admin/slo", self._admin_slo)
         router.route("GET", "/admin/events", self._admin_events)
         router.route("GET", "/admin/debug_bundle", self._admin_debug_bundle)
+        router.route("POST", "/admin/failpoint", self._admin_failpoint)
+        router.route("GET", "/admin/failpoints",
+                     self._admin_failpoints_get)
 
     # ------------------------------------------------------------------
     # Request building (generate_request, service.cpp:239-267)
@@ -322,6 +358,8 @@ class HttpService:
         status, routing = self.scheduler.schedule(req)
         if not status.ok:
             self._m_errors.inc()
+            if status.code.name == "UNAVAILABLE":
+                self.scheduler.count_failed("no_instance")
             robs.finished(error=True)
             code = 503 if status.code.name == "UNAVAILABLE" else 400
             return Response.error(code, status.message)
@@ -359,67 +397,76 @@ class HttpService:
         return {REQUEST_ID_HEADER: req.service_request_id}
 
     # -- re-dispatch ------------------------------------------------------
-    def _redispatch(self, req: SchedRequest,
-                    fwd: Dict[str, Any]) -> Optional[str]:
+    def _redispatch(self, req: SchedRequest, fwd: Dict[str, Any],
+                    exclude=()) -> Optional[str]:
         """Pick a new instance for a request its worker PROVABLY never
         worked on — an HTTP 503 refusal (draining/asleep) or a refused
         connection; never timeouts or mid-response failures, which could
-        double-generate. The reference README claims this rescheduling;
-        its code never implements it (SURVEY.md §5.3). Reverses the
-        failed instance's schedule bookkeeping and retargets the request
-        registry so finish metrics drain the instance that actually does
-        the work. Returns the new target address, or None."""
+        double-generate (mid-STREAM failures go through the recovery
+        path instead — service/recovery.py). The reference README
+        claims this rescheduling; its code never implements it
+        (SURVEY.md §5.3). Walks up to K alternates, excluding every
+        already-failed instance (``exclude``); the candidate walk and
+        schedule bookkeeping live in RecoveryManager.reroute (one copy
+        for redispatch and recovery). Returns the new target address,
+        or None."""
         old = req.routing.prefill_name if req.routing else ""
         self.spans.record(req.service_request_id, "redispatch",
                           from_instance=old)
-        status, routing = self.scheduler.schedule(req)
-        if not status.ok or routing.prefill_name == old:
-            if status.ok and old:
-                # Scheduled straight back onto the refuser: undo the
-                # duplicate SCHEDULE it just added; the original one is
-                # drained by the caller's finish/cancel path.
-                self.scheduler.instance_mgr.update_request_metrics(
-                    old, RequestPhase.UNSCHEDULE, len(req.token_ids))
+        name, addr = self.recovery.reroute(req, fwd, exclude)
+        if name is None:
             return None
-        if old:
-            self.scheduler.instance_mgr.update_request_metrics(
-                old, RequestPhase.UNSCHEDULE, len(req.token_ids))
-        self.scheduler.retarget_request(req.service_request_id, routing)
-        fwd["routing"] = routing.to_json()
         self.events.emit("redispatch",
                          service_request_id=req.service_request_id,
-                         from_instance=old, to=routing.prefill_name)
+                         from_instance=old, to=name)
         self.tracer.trace(req.service_request_id,
                           {"stage": "redispatch", "from": old,
-                           "to": routing.prefill_name})
-        return self.scheduler.instance_mgr.address_of(
-            routing.prefill_name)
+                           "to": name})
+        return addr
+
+    @staticmethod
+    def _routed_name(fwd: Dict[str, Any]) -> str:
+        return (fwd.get("routing") or {}).get("prefill_name", "")
 
     def _send_with_redispatch(self, req: SchedRequest,
                               fwd: Dict[str, Any], target: str,
                               path: str):
-        """One JSON forward with at most one re-dispatch, triggered ONLY
-        by refusal-class outcomes (503 status / refused connection) —
-        shared by the non-stream relay and the RPC ack so their retry
-        policies cannot drift apart."""
-        for attempt in (0, 1):
+        """One JSON forward with redispatch on refusal-class outcomes
+        ONLY (503 status / refused connection) — shared by the
+        non-stream relay and the RPC ack so their retry policies cannot
+        drift apart. Walks alternates under the shared retry budget,
+        excluding every instance that already refused; when everything
+        refused, the answer is a CLEAN 503 (a ConnectionRefusedError on
+        a redispatched target no longer escapes raw)."""
+        failed: set = set()
+        last_exc: Optional[Exception] = None
+        attempts = max(self.retry.max_attempts, 1)
+        for attempt in range(attempts):
             try:
                 status, resp = http_json(
                     "POST", target, path, fwd,
                     timeout=self.opts.request_timeout_s,
                     headers=self._fwd_headers(req))
-            except ConnectionRefusedError:
-                new = self._redispatch(req, fwd) if attempt == 0 else None
+            except ConnectionRefusedError as e:
+                last_exc = e
+                failed.add(self._routed_name(fwd))
+                new = self._redispatch(req, fwd, exclude=failed) \
+                    if attempt + 1 < attempts else None
                 if new:
                     target = new
                     continue
-                raise
-            if status == 503 and attempt == 0:
-                new = self._redispatch(req, fwd)
+                break
+            if status == 503 and attempt + 1 < attempts:
+                failed.add(self._routed_name(fwd))
+                new = self._redispatch(req, fwd, exclude=failed)
                 if new:
                     target = new
                     continue
             return status, resp
+        detail = f": {last_exc}" if last_exc else ""
+        return 503, {"error": {
+            "message": f"no reachable instance{detail}",
+            "type": "unavailable"}}
 
     # -- topology 1: HTTP relay (service.cpp:168-236) ---------------------
     def _relay_mode_response(self, req: SchedRequest, fwd: Dict[str, Any],
@@ -427,11 +474,23 @@ class HttpService:
                              robs: _RequestObs) -> Response:
         self.scheduler.record_new_request(req, lambda out: True)
         if req.stream:
+            # Recoverable streams (service/recovery.py policy) forward
+            # with the ledger extension armed: the worker emits token
+            # ids per frame, the relay keeps the delivered ledger, and
+            # a mid-stream worker death becomes a resume on a survivor
+            # instead of a broken stream.
+            recover = self.recovery.recoverable(req)
+            if recover:
+                self.recovery.arm(req, fwd, path, owner="relay")
             # Eager open: the worker's status is known BEFORE any bytes
             # reach the client, so a 503 can be re-dispatched and other
             # errors surface with their real status code instead of
-            # error JSON inside a 200 SSE stream.
-            for attempt in (0, 1):
+            # error JSON inside a 200 SSE stream. Refusals walk
+            # alternates under the shared retry budget, excluding every
+            # instance that already refused.
+            failed: set = set()
+            attempts = max(self.retry.max_attempts, 1)
+            for attempt in range(attempts):
                 robs.dispatched(target)
                 try:
                     status, body = http_stream_status(
@@ -439,35 +498,70 @@ class HttpService:
                         timeout=self.opts.request_timeout_s,
                         headers=self._fwd_headers(req))
                 except Exception as e:  # noqa: BLE001
-                    # Refusal-class failures only (see _redispatch):
-                    # a timeout may mean the worker already started.
-                    new = (self._redispatch(req, fwd)
-                           if attempt == 0
-                           and isinstance(e, ConnectionRefusedError)
-                           else None)
+                    # Refusal-class failures (see _redispatch) — plus,
+                    # for recoverable streams, any pre-header transport
+                    # death (_DEAD_TRANSPORT_ERRORS): a timeout may
+                    # mean the worker already started AND can still
+                    # deliver, so it never re-dispatches.
+                    retryable = isinstance(e, ConnectionRefusedError) \
+                        or (recover and
+                            isinstance(e, _DEAD_TRANSPORT_ERRORS))
+                    new = None
+                    if retryable and attempt + 1 < attempts:
+                        failed.add(self._routed_name(fwd))
+                        new = self._redispatch(req, fwd, exclude=failed)
                     if new:
                         target = new
                         continue
                     self.scheduler.finish_request(req.service_request_id,
                                                   cancelled=True)
                     self._m_errors.inc()
+                    self.scheduler.count_failed("worker_error")
                     robs.finished(error=True)
                     return Response.error(503, f"worker error: {e}")
                 if status == 200:
                     break
                 err = b"".join(body)        # drain + close the conn
-                if status == 503 and attempt == 0:
-                    new = self._redispatch(req, fwd)
+                if status == 503 and attempt + 1 < attempts:
+                    failed.add(self._routed_name(fwd))
+                    new = self._redispatch(req, fwd, exclude=failed)
                     if new:
                         target = new
                         continue
                 self.scheduler.finish_request(req.service_request_id,
                                               cancelled=True)
                 self._m_errors.inc()
+                self.scheduler.count_failed("worker_refused")
                 robs.finished(error=True)
                 return Response(status=status, body=err)
 
             trace_egress = self.tracer.egress_for(req.service_request_id)
+
+            if recover:
+                ledger = RelayLedger(
+                    self.recovery, req,
+                    is_chat=path.endswith("/chat/completions"))
+                resp_obj = Response.sse(self._recoverable_relay(
+                    req, fwd, path, body, ledger, robs, trace_egress,
+                    failed))
+                done = [False]
+                first_body = body
+
+                def on_close_rec() -> None:
+                    # Never-started body backstop (see relay on_close
+                    # below): drop the worker-side connection and drain
+                    # the registry entry.
+                    if done[0]:
+                        return
+                    done[0] = True
+                    try:
+                        first_body.close()
+                    except Exception:  # noqa: BLE001 — worker socket
+                        pass            # may already be dead
+                    robs.finished(error=True)
+                    self.scheduler.finish_request(req.service_request_id)
+                resp_obj.on_close = on_close_rec
+                return resp_obj
 
             def relay() -> Iterator[bytes]:
                 try:
@@ -488,9 +582,11 @@ class HttpService:
                     robs.finished(error=True)
                     raise
                 except Exception:
-                    # Worker died mid-relay: an aborted stream is an
-                    # error, not an e2e/tpot sample.
+                    # Worker died mid-relay (non-recoverable request):
+                    # an aborted stream is an error, not an e2e/tpot
+                    # sample.
                     self._m_errors.inc()
+                    self.scheduler.count_failed("worker_error")
                     robs.finished(error=True)
                     raise
                 finally:
@@ -526,6 +622,7 @@ class HttpService:
             self.scheduler.finish_request(req.service_request_id,
                                           cancelled=True)
             self._m_errors.inc()
+            self.scheduler.count_failed("worker_error")
             robs.finished(error=True)
             return Response.error(503, f"worker error: {e}")
         if isinstance(resp, dict):
@@ -537,10 +634,195 @@ class HttpService:
         robs.finished(error=status != 200)
         if status != 200:
             self._m_errors.inc()
+            self.scheduler.count_failed("worker_refused")
         self.scheduler.finish_request(req.service_request_id)
         self.tracer.trace(req.service_request_id,
                           {"stage": "egress", "body": resp})
         return Response.json(resp, status=status)
+
+    # -- mid-stream recovery: the ledger-aware relay ----------------------
+    def _recoverable_relay(self, req: SchedRequest, fwd: Dict[str, Any],
+                           path: str, body, ledger: RelayLedger,
+                           robs: _RequestObs, trace_egress,
+                           failed: set) -> Iterator[bytes]:
+        """Relay one recoverable SSE stream frame-by-frame. Every frame
+        runs through the RelayLedger (token ids → the scheduler's
+        delivered ledger; the ``"xllm"`` extension stripped before the
+        client sees bytes). A mid-stream worker failure — broken socket
+        or stream ending without its terminator — re-schedules onto a
+        survivor, re-prefills prompt + delivered tokens as forced
+        context, and splices the continuation into this SAME open
+        stream. Exactly-once: the survivor never re-generates delivered
+        tokens (they are its prompt), and the ledger is contiguous by
+        frame order (docs/ROBUSTNESS.md)."""
+        srid = req.service_request_id
+        ctx = self.scheduler.recovery_ctx(srid) or {
+            "budget": 0, "resumes": 0}
+        try:
+            while True:
+                err: Optional[BaseException] = None
+                try:
+                    for payload in iter_sse_events(body):
+                        frame, n_new = ledger.on_payload(payload)
+                        if frame is None:
+                            # Suppressed (dup role chunk / held-back-only
+                            # ledger frame) — its token ids still count.
+                            robs.add_tokens(n_new)
+                            continue
+                        robs.first_token()
+                        robs.add_tokens(n_new)
+                        if trace_egress is not None:
+                            trace_egress(frame)
+                        yield frame
+                except GeneratorExit:
+                    # Client went away mid-stream: a truncated request
+                    # must not pollute the latency histograms (and is
+                    # not a recovery trigger).
+                    robs.finished(error=True)
+                    raise
+                except Exception as e:  # noqa: BLE001 — the worker died
+                    err = e             # mid-relay: the recovery trigger
+                if ledger.done:
+                    return
+                if ledger.finished:
+                    # Finish delta delivered but [DONE] died with the
+                    # worker: the completion is whole — terminate
+                    # cleanly instead of re-prefilling for nothing
+                    # (synthesizing the usage chunk this death window
+                    # may have swallowed from an include_usage client).
+                    for frame in ledger.close_finished(
+                            req.include_usage):
+                        if trace_egress is not None:
+                            trace_egress(frame)
+                        yield frame
+                    return
+                # --- mid-stream failure → resume -----------------------
+                try:
+                    body.close()
+                except Exception:  # noqa: BLE001 — dead worker socket
+                    pass
+                dead = self._routed_name(fwd)
+                if dead:
+                    failed.add(dead)
+                delivered_n = len(self.scheduler.delivered_snapshot(srid))
+                logger.warning(
+                    "stream %s broke mid-relay on %s after %d tokens "
+                    "(%s); attempting recovery", srid, dead, delivered_n,
+                    err)
+                if ledger.content_frames and not ledger.tokens_seen:
+                    # Content reached the client but no frame carried the
+                    # "xllm" token-id extension (version skew: a worker
+                    # that ignores the additive ledger_tokens field) —
+                    # the ledger is blind to what was delivered, so a
+                    # resume would replay the whole completion into the
+                    # open stream. Fail clean instead.
+                    self._m_errors.inc()
+                    self.scheduler.count_failed("recovery_unledgered")
+                    self.recovery.note_failure(
+                        req, dead, "unledgered_stream", mode="relay")
+                    robs.finished(error=True)
+                    raise RuntimeError(
+                        f"worker died mid-stream and the stream carried "
+                        f"no token ledger; not recoverable "
+                        f"(last error: {err})")
+                if delivered_n >= req.sampling.max_tokens:
+                    # Died between the last token and the finish delta.
+                    for frame in ledger.synthesize_finish(
+                            req.include_usage):
+                        if trace_egress is not None:
+                            trace_egress(frame)
+                        yield frame
+                    self.recovery.note_success(
+                        req, ctx, dead, "(synthesized)", delivered_n,
+                        mode="relay")
+                    return
+                # Deadline anchored at THIS failure (not stream start:
+                # a healthy stream may outlive request_timeout_s, and
+                # recovery matters most for exactly those).
+                reopened = self._reopen_stream(
+                    req, fwd, path, ctx, failed, dead, robs,
+                    time.monotonic() + self.opts.request_timeout_s)
+                if reopened is None:
+                    self._m_errors.inc()
+                    self.scheduler.count_failed("recovery_exhausted")
+                    self.recovery.note_failure(
+                        req, dead, "no_surviving_instance", mode="relay")
+                    robs.finished(error=True)
+                    raise RuntimeError(
+                        f"worker died mid-stream and recovery was "
+                        f"exhausted (last error: {err})")
+                body, fwd = reopened
+                ledger.resumed = True
+        finally:
+            try:
+                body.close()    # deterministic worker-conn release
+            except Exception:  # noqa: BLE001 — may already be dead/closed
+                pass
+            robs.finished()
+            self.scheduler.finish_request(srid)
+
+    def _reopen_stream(self, req: SchedRequest, fwd: Dict[str, Any],
+                       path: str, ctx: Dict[str, Any], failed: set,
+                       dead: str, robs: _RequestObs,
+                       deadline: float):
+        """One-or-more resume attempts for a broken recoverable relay:
+        re-schedule excluding every failed instance, forward the
+        forced-context resume body, and eagerly open the continuation
+        stream. Returns ``(body_iterator, resume_fwd)`` or None when
+        the per-request budget / surviving instances / deadline are
+        exhausted."""
+        if ctx["resumes"] >= ctx["budget"]:
+            return None
+        # One budget unit per FAILOVER EVENT (mirrors begin_rpc_resume);
+        # the reroute/dispatch walk below runs under the retry policy's
+        # own attempt budget without burning resume budget — a reroute
+        # that finds no candidate while a replacement boots must not
+        # exhaust the failover allowance.
+        ctx["resumes"] += 1
+        for attempt in range(self.retry.max_attempts):
+            if time.monotonic() > deadline:
+                return None
+            delivered = self.scheduler.resume_ledger(
+                req.service_request_id)
+            fwd2 = self.recovery.resume_fwd(fwd, req, delivered)
+            name, addr = self.recovery.reroute(req, fwd2, failed)
+            if name is None:
+                if not self.retry.sleep(attempt, deadline=deadline):
+                    return None
+                continue
+            robs.dispatched(addr)           # records "redispatched"
+            try:
+                status, new_body = http_stream_status(
+                    "POST", addr, path, fwd2,
+                    timeout=self.opts.request_timeout_s,
+                    headers=self._fwd_headers(req))
+            except Exception as e:  # noqa: BLE001 — survivor gone too:
+                failed.add(name)    # exclude it and walk the next one
+                logger.warning("resume of %s on %s failed: %s",
+                               req.service_request_id, name, e)
+                if not self.retry.sleep(attempt, deadline=deadline):
+                    return None
+                continue
+            if status != 200:
+                b"".join(new_body)          # drain + close
+                failed.add(name)
+                logger.warning("resume of %s on %s refused: %d",
+                               req.service_request_id, name, status)
+                if not self.retry.sleep(attempt, deadline=deadline):
+                    return None
+                continue
+            ctx["fwd"] = fwd2
+            self.recovery.note_success(req, ctx, dead, name,
+                                       len(delivered), mode="relay")
+            self.tracer.trace(req.service_request_id,
+                              {"stage": "recovered", "from": dead,
+                               "to": name,
+                               "delivered": len(delivered)})
+            logger.info("recovered %s: %s -> %s (%d tokens delivered)",
+                        req.service_request_id, dead, name,
+                        len(delivered))
+            return new_body, fwd2
+        return None
 
     # -- topology 2: decode → service RPC fan-in --------------------------
     def _rpc_mode_response(self, req: SchedRequest, fwd: Dict[str, Any],
@@ -555,6 +837,11 @@ class HttpService:
             return True
 
         self.scheduler.record_new_request(req, on_output)
+        # RPC-mode requests are recoverable out of the box: token ids
+        # arrive at the fan-in, so the scheduler's ledger is authoritative
+        # and fail_requests_on_instance resumes instead of cancelling.
+        if self.recovery.recoverable(req):
+            self.recovery.arm(req, fwd, path, owner="rpc")
         robs.dispatched(target)
         try:
             status, ack = self._send_with_redispatch(req, fwd, target,
@@ -565,6 +852,7 @@ class HttpService:
             self.scheduler.finish_request(req.service_request_id,
                                           cancelled=True)
             self._m_errors.inc()
+            self.scheduler.count_failed("worker_error")
             robs.finished(error=True)
             return Response.error(503, f"worker error: {e}")
 
@@ -595,6 +883,7 @@ class HttpService:
                         except queue.Empty:
                             self.scheduler.finish_request(
                                 req.service_request_id, cancelled=True)
+                            self.scheduler.count_failed("timeout")
                             robs.finished(error=True)
                             frame = (b'data: {"error": {"message": '
                                      b'"generation timed out", '
@@ -633,6 +922,7 @@ class HttpService:
                 self.scheduler.finish_request(req.service_request_id,
                                               cancelled=True)
                 self._m_errors.inc()
+                self.scheduler.count_failed("timeout")
                 robs.finished(error=True)
                 self.tracer.trace(req.service_request_id,
                                   {"stage": "egress", "status": 504,
@@ -842,6 +1132,41 @@ class HttpService:
             "metrics": self._render_metrics(),
         }
         return Response.json(bundle)
+
+    # ------------------------------------------------------------------
+    # Fault injection surface: arm failpoints on this plane or (with
+    # {"instance": <name>}) proxy the arming to a worker's own endpoint
+    # — the chaos tests' runtime lever (docs/ROBUSTNESS.md).
+    # ------------------------------------------------------------------
+    def _admin_failpoint(self, http_req: Request) -> Response:
+        try:
+            body = http_req.json()
+        except (ValueError, json.JSONDecodeError):
+            return Response.error(400, "invalid JSON body")
+        if not isinstance(body, dict):
+            return Response.error(400, "body must be a JSON object")
+        instance = body.pop("instance", None)
+        if instance:
+            addr = self.scheduler.instance_mgr.address_of(instance)
+            if addr is None:
+                return Response.error(
+                    404, f"unknown instance {instance}")
+            try:
+                status, resp = http_json("POST", addr,
+                                         "/admin/failpoint", body,
+                                         timeout=10.0)
+            except Exception as e:  # noqa: BLE001 — worker unreachable
+                return Response.error(503, f"worker error: {e}")
+            return Response.json(resp, status=status)
+        try:
+            self.failpoints.arm_from_body(body)
+        except (TypeError, ValueError) as e:
+            return Response.error(400, str(e))
+        return Response.json({"ok": True,
+                              "state": self.failpoints.state()})
+
+    def _admin_failpoints_get(self, http_req: Request) -> Response:
+        return Response.json(self.failpoints.state())
 
     # ------------------------------------------------------------------
     # Manual sleep/wakeup (service.cpp:510-550)

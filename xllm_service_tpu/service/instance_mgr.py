@@ -653,6 +653,22 @@ class InstanceMgr:
                      if s.model_states.get(model) == MODEL_AWAKE]
             return self.least_loaded_instance(cands) if cands else None
 
+    def filter_model_awake(self, pool: List[str], model: str
+                           ) -> List[str]:
+        """Restrict ``pool`` to instances where ``model`` is awake.
+        A pool with no per-model state at all (single-model deployments
+        never populate ``model_states``) passes through unchanged —
+        the filter only bites where model placement is actually
+        tracked, so a model-blind fallback pick can't land on an
+        instance that holds the model asleep or not at all."""
+        with self._lock:
+            states = [(n, self._instances[n].model_states.get(model)
+                       if n in self._instances else None)
+                      for n in pool]
+        if not any(st is not None for _, st in states):
+            return list(pool)
+        return [n for n, st in states if st == MODEL_AWAKE]
+
     def allocate_instance_for_model(self, model: str) -> Optional[str]:
         """Wake ``model`` somewhere, evicting the coldest model subset if
         memory requires (instance_mgr.cpp:1107-1243)."""

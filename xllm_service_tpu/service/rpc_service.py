@@ -66,9 +66,14 @@ class RpcService:
     def generations(self, req: Request) -> Response:
         body = req.json()
         check_version(body, "generations")
+        # Sender identity (additive wire field): the scheduler's
+        # exactly-once guard for recovered requests — a straggler push
+        # from a deposed instance must not duplicate tokens. Absent
+        # from old workers' pushes → accepted (pre-recovery behavior).
+        source = body.get("from", "")
         for d in body.get("outputs", []):
             out = RequestOutput.from_json(d)
-            self.scheduler.handle_generation(out)
+            self.scheduler.handle_generation(out, source=source)
         return Response.json({"ok": True})
 
     # -- Instance queries (rpc_service/service.cpp:81-147) ----------------

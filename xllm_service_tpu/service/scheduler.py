@@ -48,7 +48,7 @@ logger = logging.getLogger(__name__)
 class _TrackedRequest:
     __slots__ = ("request", "output_callback", "created",
                  "prefill_name", "decode_name", "prefill_done",
-                 "num_generated")
+                 "num_generated", "delivered", "pending", "recovery")
 
     def __init__(self, request: Request,
                  output_callback: OutputCallback) -> None:
@@ -59,6 +59,23 @@ class _TrackedRequest:
         self.decode_name = request.routing.decode_name
         self.prefill_done = False
         self.num_generated = 0
+        # Delivered-token ledger: token ids whose TEXT has reached the
+        # client (choice 0), appended by handle_generation (RPC fan-in)
+        # or note_delivered (ledger-aware relay). Mid-stream recovery
+        # re-prefills prompt + this ledger as forced context, so the
+        # continuation is exactly-once by construction
+        # (docs/ROBUSTNESS.md). ``pending`` holds ids the detokenizer
+        # is still holding back (UTF-8 / multi-token grapheme): their
+        # text was NEVER sent, so on recovery they are left OUT of the
+        # forced context and regenerated — counting them as delivered
+        # would silently drop their text at the resume boundary.
+        self.delivered: List[int] = []
+        self.pending: List[int] = []
+        # Recovery context (service/recovery.py arms it): owner
+        # ("relay"|"rpc"), the rewritten forward body + path needed to
+        # resume, the per-request resume budget, and progress flags.
+        # None = not recoverable; fail_requests_on_instance cancels.
+        self.recovery: Optional[Dict[str, Any]] = None
 
 
 class Scheduler:
@@ -79,6 +96,12 @@ class Scheduler:
         self.events = events
         self.spans = None
         self.obs = None
+        # Mid-stream failover (service/recovery.py, wired by HttpService
+        # post-construction like spans/obs): when set,
+        # fail_requests_on_instance hands recoverable RPC-mode requests
+        # to it instead of cancelling, and relay-owned recoverable
+        # requests are left to their relay generator's own resume loop.
+        self.recovery = None
 
         self.tokenizer: Tokenizer = TokenizerFactory.create_tokenizer(
             opts.tokenizer_path)
@@ -328,15 +351,55 @@ class Scheduler:
         # Pin to a fan-in pool up front so ordering starts at token one.
         self._pools.pool_for(request.service_request_id)
 
-    def handle_generation(self, out: RequestOutput) -> None:
-        """Per-token hot path: dispatch to the request's pinned pool."""
+    def handle_generation(self, out: RequestOutput,
+                          source: str = "") -> None:
+        """Per-token hot path: dispatch to the request's pinned pool.
+
+        ``source`` is the pushing worker's name when the output arrived
+        over the RPC fan-in — for recoverable requests it is the
+        exactly-once guard: after a mid-stream resume retargets the
+        request, a straggler push from the dead (or deposed) instance
+        must not splice duplicate tokens into the stream."""
         srid = out.service_request_id or out.request_id
         with self._req_lock:
             tracked = self._requests.get(srid)
         if tracked is None:
             logger.debug("generation for unknown request %s", srid)
             return
+        if tracked.recovery is not None and source and (
+                source in tracked.recovery.get("failed", ())
+                or source not in (tracked.prefill_name,
+                                  tracked.decode_name)):
+            # The failed-set check closes the pre-retarget window: a
+            # resume marks the dead instance failed BEFORE snapshotting
+            # the ledger, so a straggler push landing between snapshot
+            # and retarget cannot be both delivered and regenerated.
+            logger.warning("dropping %d stale output(s) for %s from "
+                           "deposed instance %s",
+                           len(out.outputs), srid, source)
+            if self.obs is not None:
+                self.obs.counter(
+                    "xllm_stale_outputs_dropped_total",
+                    "straggler generation pushes from deposed "
+                    "instances dropped by the recovery source guard "
+                    "(unit: pushes, not requests)").inc()
+            return
         num_tokens = sum(len(s.token_ids) for s in out.outputs)
+        if tracked.recovery is not None:
+            with self._req_lock:
+                for s in out.outputs:
+                    if s.index == 0:
+                        self._ledger_append_locked(
+                            tracked, s.token_ids, bool(s.text))
+                if out.usage is not None and \
+                        tracked.recovery.get("recovered"):
+                    # The resumed worker saw prompt + delivered tokens
+                    # as its prompt and only the continuation as
+                    # completion — restore the client-truthful counts.
+                    out.usage.prompt_tokens = len(
+                        tracked.request.token_ids)
+                    out.usage.completion_tokens = (
+                        len(tracked.delivered) + len(tracked.pending))
         tracked.num_generated += num_tokens
         decode_name = tracked.decode_name
         if decode_name:
@@ -397,22 +460,141 @@ class Scheduler:
                 + tracked.num_generated)
 
     def fail_requests_on_instance(self, instance: str) -> int:
-        """Cancel every tracked request routed to a dead instance so RPC-
-        mode clients get an error instead of hanging (the reference lacks
-        re-dispatch entirely, SURVEY.md §5.3 — here failures at least
-        terminate promptly)."""
+        """Handle every tracked request routed to a dead instance.
+        Recoverable requests (armed by service/recovery.py) are resumed
+        mid-stream instead of cancelled: RPC-mode requests are handed to
+        the recovery manager (re-prefill prompt + delivered ledger on a
+        survivor), relay-owned requests are left alone (their relay
+        generator sees the broken worker socket and runs its own resume
+        loop). Everything else is cancelled promptly so clients get an
+        error instead of hanging (the reference lacks both re-dispatch
+        and recovery entirely, SURVEY.md §5.3)."""
         with self._req_lock:
             victims = [t for t in self._requests.values()
                        if instance in (t.prefill_name, t.decode_name)]
         for tracked in victims:
-            out = RequestOutput(
-                request_id=tracked.request.service_request_id,
-                service_request_id=tracked.request.service_request_id,
-                status=Status(StatusCode.UNAVAILABLE,
-                              f"instance {instance} died"),
-                finished=True, cancelled=True)
-            self.handle_generation(out)
+            ctx = tracked.recovery
+            reason = "instance_died"
+            if ctx is not None and self.recovery is not None:
+                owner = ctx.get("owner")
+                if owner == "relay":
+                    continue
+                if owner == "rpc":
+                    if self.recovery.begin_rpc_resume(tracked, instance):
+                        continue
+                    # Resume budget exhausted: the client sees the
+                    # error — that's the recoveries counter's "failed"
+                    # contract, not a plain instance death.
+                    self.recovery.note_failure(
+                        tracked.request, instance, "budget_exhausted",
+                        mode="rpc")
+                    reason = "recovery_exhausted"
+            self.count_failed(reason)
+            self.cancel_request(
+                tracked.request.service_request_id,
+                f"instance {instance} died")
         return len(victims)
+
+    def cancel_request(self, service_request_id: str,
+                       message: str) -> None:
+        """Deliver a terminal UNAVAILABLE output for one tracked request
+        (the client's definite error; teardown follows through the
+        normal _deliver → finish_request path)."""
+        out = RequestOutput(
+            request_id=service_request_id,
+            service_request_id=service_request_id,
+            status=Status(StatusCode.UNAVAILABLE, message),
+            finished=True, cancelled=True)
+        self.handle_generation(out)
+
+    def count_failed(self, reason: str) -> None:
+        """``xllm_requests_failed_total{reason}`` — failure modes stay
+        countable before and after recovery (standalone schedulers run
+        without a registry)."""
+        if self.obs is not None:
+            self.obs.counter(
+                "xllm_requests_failed_total",
+                "requests that hit a failure mode, by reason (a "
+                "recovered request counts only under the recovery "
+                "series, not here)",
+                labelnames=("reason",)).inc(reason=reason)
+
+    # ------------------------------------------------------------------
+    # Mid-stream recovery support (service/recovery.py drives these)
+    # ------------------------------------------------------------------
+    def arm_recovery(self, service_request_id: str,
+                     ctx: Dict[str, Any]) -> None:
+        """Attach a recovery context (owner/fwd/path/budget) to a
+        tracked request — from then on handle_generation keeps its
+        delivered-token ledger and fail_requests_on_instance recovers
+        instead of cancelling."""
+        with self._req_lock:
+            tracked = self._requests.get(service_request_id)
+            if tracked is not None:
+                tracked.recovery = ctx
+
+    @staticmethod
+    def _ledger_append_locked(tracked: _TrackedRequest,
+                              token_ids: List[int],
+                              has_text: bool) -> None:
+        """One delta into the delivered ledger. A delta WITH text
+        flushes every held-back id first (the detokenizer's emitted
+        text always covers the tokens it was holding); a delta without
+        text parks its ids as pending — not yet client-visible, so not
+        yet resumable-over."""
+        if has_text:
+            if tracked.pending:
+                tracked.delivered.extend(tracked.pending)
+                tracked.pending = []
+            tracked.delivered.extend(token_ids)
+        else:
+            tracked.pending.extend(token_ids)
+
+    def note_delivered(self, service_request_id: str,
+                       token_ids: List[int],
+                       has_text: bool = True) -> int:
+        """Ledger append for the relay topology (the relay parses token
+        ids out of the worker's ledger-extension frames). Returns the
+        total delivered (text-flushed) count."""
+        with self._req_lock:
+            tracked = self._requests.get(service_request_id)
+            if tracked is None:
+                return 0
+            self._ledger_append_locked(tracked, token_ids, has_text)
+            return len(tracked.delivered)
+
+    def delivered_snapshot(self, service_request_id: str) -> List[int]:
+        with self._req_lock:
+            tracked = self._requests.get(service_request_id)
+            return list(tracked.delivered) if tracked is not None else []
+
+    def resume_ledger(self, service_request_id: str) -> List[int]:
+        """The forced-context snapshot for a resume: the delivered
+        (text-flushed) ids. Pending held-back ids are ABANDONED — their
+        text never reached the client, the survivor regenerates them —
+        so they must not double-count when the continuation re-appends
+        the same ids."""
+        with self._req_lock:
+            tracked = self._requests.get(service_request_id)
+            if tracked is None:
+                return []
+            tracked.pending = []
+            return list(tracked.delivered)
+
+    def delivered_total(self, service_request_id: str) -> int:
+        """Client-visible completion length so far: flushed + held ids
+        (the usage-rewrite source for recovered streams)."""
+        with self._req_lock:
+            tracked = self._requests.get(service_request_id)
+            if tracked is None:
+                return 0
+            return len(tracked.delivered) + len(tracked.pending)
+
+    def recovery_ctx(self, service_request_id: str
+                     ) -> Optional[Dict[str, Any]]:
+        with self._req_lock:
+            tracked = self._requests.get(service_request_id)
+            return tracked.recovery if tracked is not None else None
 
     def num_tracked_requests(self) -> int:
         with self._req_lock:
@@ -429,7 +611,14 @@ class Scheduler:
                      "prefill": t.prefill_name,
                      "decode": t.decode_name,
                      "prefill_done": t.prefill_done,
-                     "num_generated": t.num_generated}
+                     "num_generated": t.num_generated,
+                     "delivered_tokens": len(t.delivered),
+                     "recovery": ({"owner": t.recovery.get("owner"),
+                                   "resumes": t.recovery.get("resumes",
+                                                             0),
+                                   "recovered": t.recovery.get(
+                                       "recovered", False)}
+                                  if t.recovery is not None else None)}
                     for srid, t in self._requests.items()]
 
     def _on_instance_removed(self, name: str) -> None:

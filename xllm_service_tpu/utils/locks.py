@@ -21,6 +21,10 @@ increasing):
     50  (reserved: coordination store — uses a Condition-wrapped RLock,
          checked by its own single-class discipline, see coordination.py)
     60  coordination_net, etcd.watches  — store transports
+    75  obs.failpoints                  — armed fault-injection state
+                                          (guards arming only; trip
+                                          visibility — registry 93,
+                                          events 80 — happens outside)
     78  obs.slo                         — SLO burn-rate engine state
                                           (emits events 80, reads
                                           registry 93 while held)

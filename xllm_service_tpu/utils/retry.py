@@ -1,0 +1,96 @@
+"""One retry/backoff policy for every retry loop in the serving path.
+
+Before this module each retrying call site hand-rolled its own policy
+(``for attempt in (0, 1)`` in http_service, a fixed-cadence heartbeat
+tick in the worker) and they drifted: different budgets, no jitter, no
+deadline awareness. ``RetryPolicy`` is the single shape — exponential
+backoff with full jitter (the thundering-herd-safe variant: a fleet of
+workers retrying a restarted master spreads over [0, delay] instead of
+synchronizing on the exact backoff boundary), a per-use attempt budget,
+a delay cap, and deadline-aware sleeping.
+
+Deterministic tests set ``jitter=0`` (delays become the pure
+exponential) — the policy itself adds no other randomness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Optional
+
+
+def _as_float(raw: str, default: float) -> float:
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """``delay(k)`` for attempt k (0-based) = min(base * multiplier**k,
+    max_delay), scaled by ``1 - jitter * U[0,1)``. ``max_attempts``
+    bounds a whole retry loop; ``sleep()`` refuses to wait past an
+    absolute deadline."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5          # fraction of each delay randomized away
+
+    @classmethod
+    def from_env(cls, **defaults) -> "RetryPolicy":
+        """The serving-path policy: ``XLLM_RETRY_ATTEMPTS`` /
+        ``XLLM_RETRY_BASE_MS`` / ``XLLM_RETRY_MAX_MS`` (docs/FLAGS.md)
+        over per-call-site defaults."""
+        base = cls(**defaults)
+        return dataclasses.replace(
+            base,
+            max_attempts=int(_as_float(
+                os.environ.get("XLLM_RETRY_ATTEMPTS", ""),
+                base.max_attempts)),
+            base_delay_s=_as_float(
+                os.environ.get("XLLM_RETRY_BASE_MS", ""),
+                base.base_delay_s * 1e3) / 1e3,
+            max_delay_s=_as_float(
+                os.environ.get("XLLM_RETRY_MAX_MS", ""),
+                base.max_delay_s * 1e3) / 1e3)
+
+    def delay(self, attempt: int) -> float:
+        """The (jittered) delay before retry ``attempt`` (0-based)."""
+        # Multiplicative, not multiplier**attempt: unbounded attempt
+        # counters (a worker heartbeating a master that is down for
+        # hours) would overflow float pow; this saturates at the cap
+        # after ~log(cap/base) steps instead.
+        d = min(self.base_delay_s, self.max_delay_s)
+        if self.multiplier > 1.0:
+            for _ in range(max(attempt, 0)):
+                d *= self.multiplier
+                if d >= self.max_delay_s:
+                    d = self.max_delay_s
+                    break
+        if self.jitter > 0:
+            d *= 1.0 - self.jitter * random.random()
+        return max(d, 0.0)
+
+    def sleep(self, attempt: int, deadline: Optional[float] = None,
+              stop_event=None) -> bool:
+        """Wait out attempt ``attempt``'s backoff. Returns False (without
+        sleeping past it) when ``deadline`` (monotonic) would be
+        exceeded or ``stop_event`` is already set — the caller should
+        abandon the retry loop. ``stop_event.wait`` keeps shutdown
+        responsive when provided."""
+        d = self.delay(attempt)
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            d = min(d, remaining)
+        if stop_event is not None:
+            return not stop_event.wait(d)
+        time.sleep(d)
+        return True
