@@ -338,6 +338,16 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
                                         - t_submit[out.request_id]))
     elapsed = time.monotonic() - t0
 
+    # Prefix-reuse health, through the SAME rendered-exposition path a
+    # live worker exports (scrape-don't-peek: the detail number comes
+    # from parsing the text exposition, so it is the dashboard's number,
+    # not a parallel bookkeeping path).
+    pc = engine.prefix_cache_stats()
+    lat.counter("xllm_worker_prefix_cache_hit_tokens_total").set_total(
+        pc["hit_tokens_total"])
+    lat.counter("xllm_worker_prefix_cache_lookups_total").set_total(
+        pc["lookups_total"])
+
     lat_scrape = lat.render()
 
     def _q(family: str, q: float):
@@ -354,6 +364,19 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     def _attainment(family: str, threshold_ms: float):
         v = histogram_fraction_le(lat_scrape, family, threshold_ms)
         return round(v, 4) if v is not None else None
+
+    def _counter(family: str) -> float:
+        from xllm_service_tpu.obs.expfmt import parse_exposition
+        samples, _types, _errs = parse_exposition(lat_scrape)
+        return sum(v for name, _labels, v in samples if name == family)
+
+    # Fraction of prompt tokens the prefix cache covered this run
+    # (local hits + tier restores + cross-worker fetches over ALL
+    # prompt tokens the run admitted) — scraped back out of the
+    # rendered exposition like the latency percentiles.
+    pc_hit = _counter("xllm_worker_prefix_cache_hit_tokens_total")
+    prefix_cached_token_ratio = (
+        round(pc_hit / prefill_tokens, 4) if prefill_tokens else None)
 
     # "No routed request ever pays a compile", proven per round: the
     # post-warmup recompile counters after the measured run, and the
@@ -457,6 +480,7 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             "tpot_ms_p99": _q("xllm_service_tpot_ms", 0.99),
             "queue_wait_ms_p99": _q("xllm_service_queue_wait_ms", 0.99),
             "e2e_ms_p99": _q("xllm_service_e2e_ms", 0.99),
+            "prefix_cached_token_ratio": prefix_cached_token_ratio,
             "slo_ttft_attainment": _attainment(
                 "xllm_service_ttft_ms", slo_thr["ttft"]),
             "slo_e2e_attainment": _attainment(

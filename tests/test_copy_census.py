@@ -126,3 +126,26 @@ class TestCensusAot:
         compiled = aot_compile(fn, args, donate_argnums=donate, **kw)
         hits = census_pool_copies(compiled.as_text(), pool_shape)
         assert hits == [], hits
+
+    def test_restore_scatter_zero_pool_copies(self, aot):
+        """The spill-tier restore / cross-worker block-adopt scatter
+        (engine ``_kv_scatter``, shared with PD import): donated,
+        deliberately unpinned (see the donation-coverage allowlist
+        justification) — the aliased in-place write must compile with
+        ZERO pool-sized copies, or every prefix restore pays a
+        pool-sized bill that dwarfs what it saved."""
+        aot_compile, sds = aot
+        L, P, ps, Hkv, D = POOL
+        n = 2       # restored blocks per call; structurally identical
+        #             at any count (the engine caches per distinct n)
+
+        def restore(kp, vp, idx, kn, vn):
+            return kp.at[:, idx].set(kn), vp.at[:, idx].set(vn)
+
+        args = (sds(POOL, jnp.bfloat16), sds(POOL, jnp.bfloat16),
+                sds((n,), jnp.int32),
+                sds((L, n, ps, Hkv, D), jnp.bfloat16),
+                sds((L, n, ps, Hkv, D), jnp.bfloat16))
+        compiled = aot_compile(restore, args, donate_argnums=(0, 1))
+        hits = census_pool_copies(compiled.as_text(), POOL)
+        assert hits == [], hits

@@ -352,6 +352,8 @@ LOCK_RANK_TABLE: Dict[str, int] = {
     "scheduler.req": 10,
     "worker.live": 10,
     "worker.engine": 20,
+    "kv_cache.tier": 22,
+    "worker.kvfetch": 25,
     "instance_mgr": 30,
     "kvcache_mgr": 35,
     "coordination_net": 60,

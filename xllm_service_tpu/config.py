@@ -761,6 +761,21 @@ class EngineConfig:
     # decode_steps > 1, off for single-step decode. Env
     # XLLM_DECODE_PIPELINE=0/1 overrides.
     decode_pipeline: Optional[bool] = None
+    # Tiered KV spill (docs/KV_CACHE.md): when > 0, prefix-cache pages
+    # evicted from HBM under allocation pressure are parked in a bounded
+    # host-DRAM tier of this many MB instead of dropped, and restored
+    # through the donated pool scatter on a later prefix hit. 0 = off
+    # (evictions drop content, the pre-tier behavior). Env
+    # XLLM_KV_SPILL_MB overrides.
+    kv_spill_mb: float = 0.0
+    # Optional disk tier behind the DRAM tier: blocks LRU-demoted from
+    # DRAM land as raw header+bytes .kv files under this directory
+    # (cold path; .npz can't round-trip ml_dtypes bfloat16), bounded by
+    # kv_spill_disk_mb. Needs BOTH knobs: an empty dir OR a zero budget
+    # means no disk tier (demotions drop). Env XLLM_KV_SPILL_DIR /
+    # XLLM_KV_SPILL_DISK_MB override.
+    kv_spill_dir: str = ""
+    kv_spill_disk_mb: float = 0.0
 
     def __post_init__(self) -> None:
         if self.max_model_len % self.page_size != 0:
@@ -785,6 +800,21 @@ class EngineConfig:
             self.decode_pipeline = False
         elif env in ("1", "true", "yes"):
             self.decode_pipeline = True
+        env = os.environ.get("XLLM_KV_SPILL_MB", "").strip()
+        if env:
+            try:
+                self.kv_spill_mb = float(env)
+            except ValueError:
+                pass
+        env = os.environ.get("XLLM_KV_SPILL_DIR", "").strip()
+        if env:
+            self.kv_spill_dir = env
+        env = os.environ.get("XLLM_KV_SPILL_DISK_MB", "").strip()
+        if env:
+            try:
+                self.kv_spill_disk_mb = float(env)
+            except ValueError:
+                pass
 
 
 def load_json(path: str) -> Dict[str, Any]:

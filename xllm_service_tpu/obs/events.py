@@ -52,6 +52,9 @@ EVENT_TYPES = (
     "request_recovered",    # mid-stream failover resumed a request
     "recovery_failed",      # ... or exhausted its retry budget
     "failpoint_tripped",    # an armed fault-injection site fired
+    "cache_digest_mismatch",  # worker's block hashing diverges from the
+                              # service's — its prefix digests are
+                              # quarantined (docs/KV_CACHE.md)
 )
 
 DEFAULT_CAPACITY = 1024

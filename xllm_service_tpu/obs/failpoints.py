@@ -60,6 +60,9 @@ FAILPOINTS = (
     "worker.die_after_n_tokens",  # simulate process death mid-stream
     "worker.slow_response_ms",   # delay a generate handler (value: ms)
     "worker.fail_kv_transfer",   # PD migration transport failure
+    "worker.fail_kv_fetch",      # cross-worker cached-block fetch fails
+                                 # (requester side) — prefill recomputes
+                                 # from token zero, correctness intact
     "service.fail_redispatch",   # service refuses to pick an alternate
 )
 

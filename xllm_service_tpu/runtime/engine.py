@@ -46,7 +46,7 @@ from xllm_service_tpu.ops.sampling import (
     SamplingTensors, compute_logprobs, compute_top_logprobs, sample_tokens,
     update_counts)
 from xllm_service_tpu.runtime.kv_cache import (
-    KvCacheEvent, PageAllocator, PrefixCacheIndex)
+    HostKvTier, KvCacheEvent, PageAllocator, PrefixCacheIndex)
 from xllm_service_tpu.utils.types import FinishReason, SamplingParams
 
 logger = logging.getLogger(__name__)
@@ -145,6 +145,10 @@ class StepOutput:
     # echo+logprobs: one entry per PROMPT token (first None), attached to
     # the output that carries the first sampled token.
     prompt_logprobs: Optional[List[Optional[float]]] = None
+    # Prompt tokens served from the prefix cache (local hit, tier
+    # restore or cross-worker fetch) — rides the first prefill output so
+    # the worker can annotate the request span (cache_hit_tokens).
+    num_cached_tokens: int = 0
 
     @property
     def finished(self) -> bool:
@@ -180,6 +184,18 @@ class Engine:
         self.prefix_cache = PrefixCacheIndex(
             self.allocator, engine_cfg.page_size, seed=murmur_seed,
             enable=engine_cfg.enable_prefix_cache)
+        # Tiered spill (docs/KV_CACHE.md): prefix pages evicted from HBM
+        # under allocation pressure park in a bounded host-DRAM tier
+        # (optional disk tier behind it) instead of vanishing; a later
+        # match_prefix hit restores them through the donated pool
+        # scatter. Off (None) unless kv_spill_mb > 0.
+        self.host_tier: Optional[HostKvTier] = None
+        spill_bytes = int(engine_cfg.kv_spill_mb * 1e6)
+        if spill_bytes > 0 and engine_cfg.enable_prefix_cache:
+            self.host_tier = HostKvTier(
+                spill_bytes, disk_dir=engine_cfg.kv_spill_dir,
+                disk_capacity_bytes=int(engine_cfg.kv_spill_disk_mb * 1e6))
+            self.prefix_cache.spill_hook = self._spill_page
 
         self.waiting: List[Sequence] = []
         self.running: List[Sequence] = []
@@ -353,6 +369,13 @@ class Engine:
         # visible). Monotonic per-engine counter of (token, expert)
         # assignments lost to expert capacity; 0 forever on dense models.
         self.moe_dropped_tokens = 0
+        # Prefix-reuse ledger (xllm_worker_prefix_cache_* on /metrics):
+        # how many admits consulted the cache, how many prompt tokens it
+        # covered (local hits, restores and cross-worker fetches alike),
+        # and how many blocks arrived from a remote holder.
+        self.prefix_lookups = 0
+        self.prefix_hit_tokens = 0
+        self.fetched_blocks = 0
 
         # Per-phase wall-time ledger (seconds) + event counts. On the
         # tunneled backend the only trustworthy timings are host-side
@@ -580,6 +603,14 @@ class Engine:
         if seq.req.mm_embeds is None and not seq.req.prompt_logprobs:
             cached_pages, cached_tokens = \
                 self.prefix_cache.match_prefix(seq.req.token_ids)
+            if self.host_tier is not None \
+                    and not self._ring_eligible(seq, 0):
+                # Ring-eligible prompts skip the tier restore outright:
+                # the ring path forgoes cached prefixes anyway, and a
+                # restore it would immediately release wastes the tier
+                # copies and a pool scatter.
+                cached_pages, cached_tokens = self._restore_spilled(
+                    seq.req.token_ids, cached_pages, cached_tokens)
             if cached_tokens and self._ring_preferred(seq, cached_tokens):
                 # A cached prefix forces the chunked-window path (ring
                 # global positions start at 0). For a ring-eligible long
@@ -611,6 +642,13 @@ class Engine:
         seq.pages = list(cached_pages) + new_pages
         seq.num_computed = cached_tokens
         seq.num_cached_tokens = cached_tokens
+        # Count only ADMITTED lookups: a page-pressure refusal leaves
+        # the sequence queued and retrying every step — counting those
+        # would inflate the hit series past the tokens actually served
+        # (bench's prefix_cached_token_ratio could exceed 1.0).
+        if seq.req.mm_embeds is None and not seq.req.prompt_logprobs:
+            self.prefix_lookups += 1
+            self.prefix_hit_tokens += cached_tokens
         seq.slot = slot
         self._slots[slot] = seq
         self._slot_sampling[slot] = seq.req.sampling
@@ -1010,6 +1048,7 @@ class Engine:
                 out = self._append_token(
                     seq, tok, float(logprob[i]),
                     top=self._top_entry(seq, top_ids, top_lps, i))
+                out.num_cached_tokens = seq.num_cached_tokens
                 if seq.prompt_lps is not None:
                     out.prompt_logprobs = seq.prompt_lps
                     seq.prompt_lps = None
@@ -1688,6 +1727,224 @@ class Engine:
         return True
 
     # ------------------------------------------------------------------
+    # Tiered prefix cache + cross-worker cached-block fetch
+    # (docs/KV_CACHE.md; the cluster-scale prefix-reuse loop)
+    # ------------------------------------------------------------------
+    def _spill_page(self, h: bytes, pid: int) -> bool:
+        """PrefixCacheIndex spill hook: park an HBM page about to be
+        reclaimed in the host-DRAM tier. The gather is enqueued before
+        any write the page's next owner can issue (one device stream →
+        program order), so it reads the pre-overwrite content."""
+        if self.host_tier is None:
+            return False
+        k_pages, v_pages = self.kv
+        k_host, v_host = self._read_host(
+            "kv_spill", k_pages[:, pid], v_pages[:, pid])
+        return self.host_tier.put(h, k_host, v_host)
+
+    def _restore_spilled(self, tokens: Sequence[int], pages: List[int],
+                         cached_tokens: int
+                         ) -> Tuple[List[int], int]:
+        """Extend an HBM prefix hit past the point where match_prefix
+        stopped, walking the chain across BOTH lower sources: blocks
+        parked in the host tier scatter back into fresh pages
+        (``_kv_scatter`` — donated, in place, zero pool copies; the
+        restore shape rides the copy census in tests/test_copy_census),
+        and HBM-registered blocks sitting BEHIND a spilled stretch
+        (e.g. blocks adopted from a remote holder while their lead was
+        spilled) are acquired like match_prefix would have. Tier blocks
+        are consumed (popped) before the page allocation so a
+        concurrent spill's LRU overflow cannot evict one mid-restore;
+        an allocation failure puts them back (the spill/restore
+        counters each tick once for that bounce — cosmetic)."""
+        ps = self.ecfg.page_size
+        hashes = self.prefix_cache.block_hashes(tokens)
+        i = len(pages)
+        # ("tier", hash, (k, v)) | ("hbm", hash, pid), in block order.
+        # The first entry is always "tier": an HBM-registered block at
+        # position len(pages) would have been taken by match_prefix.
+        plan: List[Tuple[str, bytes, Any]] = []
+        n_tier = 0
+        # Same never-the-whole-prompt rule as match_prefix: prefill
+        # needs at least one new token to produce logits from.
+        while i < len(hashes) and (i + 1) * ps < len(tokens):
+            blk = self.host_tier.peek(hashes[i])
+            if blk is not None:
+                plan.append(("tier", hashes[i], blk))
+                n_tier += 1
+            else:
+                pid = self.prefix_cache.page_of(hashes[i])
+                if pid is None:
+                    break
+                plan.append(("hbm", hashes[i], pid))
+            i += 1
+        if not n_tier:
+            return pages, cached_tokens
+        hbm_pids = [p[2] for p in plan if p[0] == "hbm"]
+        # Pin the chain's HBM members before the allocation below can
+        # reclaim them, and take the tier members out of LRU reach.
+        self.prefix_cache.acquire_pages(hbm_pids)
+        for kind, h, _ in plan:
+            if kind == "tier":
+                self.host_tier.pop(h)
+        new_pages = self.prefix_cache.alloc(n_tier)
+        if new_pages is None:
+            self.prefix_cache.release_pages(hbm_pids)
+            for kind, h, blk in plan:
+                if kind == "tier":
+                    self.host_tier.put(h, blk[0], blk[1])
+            return pages, cached_tokens
+        with self._phase("kv_restore"):
+            k_pages, v_pages = self.kv
+            idx = jnp.asarray(new_pages, jnp.int32)
+            k_new = np.stack([b[0] for kind, _, b in plan
+                              if kind == "tier"], axis=1)
+            v_new = np.stack([b[1] for kind, _, b in plan
+                              if kind == "tier"], axis=1)
+            self.kv = _kv_scatter(
+                k_pages, v_pages, idx,
+                jnp.asarray(k_new).astype(k_pages.dtype),
+                jnp.asarray(v_new).astype(v_pages.dtype))
+        ti = 0
+        chain: List[int] = []
+        for kind, _, payload in plan:
+            if kind == "tier":
+                chain.append(new_pages[ti])
+                ti += 1
+            else:
+                chain.append(payload)
+        all_pages = list(pages) + chain
+        self.prefix_cache.register_full_pages(tokens[:i * ps], all_pages)
+        return all_pages, i * ps
+
+    def export_blocks(self, hashes: List[bytes], device: bool = False
+                      ) -> Optional[Tuple[int, Any, Any]]:
+        """Holder side of the cross-worker prefix fetch: the KV of a
+        contiguous digest run, gathered out of the HBM pool and extended
+        with blocks parked in the host tier. Returns (n_blocks, k, v)
+        with k/v shaped [L, n, ps, Hkv, Dh], or None when the leading
+        digest is no longer held anywhere.
+
+        ``device=True`` keeps k/v as device arrays for the PJRT wire —
+        only when the whole run is HBM-resident (tier blocks are host
+        arrays; re-uploading them to stage a pull would be wasted
+        motion). The gathered block is a fresh buffer, so the acquired
+        pages are released immediately (export_held's argument)."""
+        pages = self.prefix_cache.pages_for_hashes(hashes)
+        n_hbm = len(pages)
+        k_hbm = v_hbm = None
+        if n_hbm:
+            k_pages, v_pages = self.kv
+            idx = jnp.asarray(pages, jnp.int32)
+            k_dev, v_dev = k_pages[:, idx], v_pages[:, idx]
+            self.prefix_cache.release_pages(pages)
+            if device and n_hbm == len(hashes):
+                return n_hbm, k_dev, v_dev
+            k_hbm, v_hbm = self._read_host("kv_export_blocks",
+                                           k_dev, v_dev)
+        tail_k: List[Any] = []
+        tail_v: List[Any] = []
+        i = n_hbm
+        while self.host_tier is not None and i < len(hashes):
+            blk = self.host_tier.peek(hashes[i])
+            if blk is None:
+                break
+            tail_k.append(blk[0])
+            tail_v.append(blk[1])
+            i += 1
+        parts_k = ([k_hbm] if k_hbm is not None else []) + \
+            ([np.stack(tail_k, axis=1)] if tail_k else [])
+        parts_v = ([v_hbm] if v_hbm is not None else []) + \
+            ([np.stack(tail_v, axis=1)] if tail_v else [])
+        if not parts_k:
+            return None
+        k = parts_k[0] if len(parts_k) == 1 else \
+            np.concatenate(parts_k, axis=1)
+        v = parts_v[0] if len(parts_v) == 1 else \
+            np.concatenate(parts_v, axis=1)
+        return i, k, v
+
+    def adopt_blocks(self, token_ids: Sequence[int], start_block: int,
+                     k: Any, v: Any) -> int:
+        """Register cross-worker-fetched KV blocks content-addressed in
+        this engine's pool: blocks ``start_block..start_block+n-1`` of
+        ``token_ids``' chained digest walk, shaped [L, n, ps, Hkv, Dh].
+        The pages go straight to reclaimable-but-cached, so the
+        requesting prompt's admit hits them like any local prefix.
+        Returns the number of blocks adopted (0 = clean refusal — the
+        caller prefills from token zero, correctness unaffected)."""
+        self.drain_pipeline()
+        k_pages, v_pages = self.kv
+        n = int(k.shape[1]) if hasattr(k, "shape") else 0
+        expect = (k_pages.shape[0], n, k_pages.shape[2],
+                  k_pages.shape[3], k_pages.shape[4])
+        if n <= 0 or tuple(k.shape) != expect or tuple(v.shape) != expect:
+            logger.warning("kv block adopt layout mismatch: got %s "
+                           "expected %s", getattr(k, "shape", None),
+                           expect)
+            return 0
+        hashes = self.prefix_cache.block_hashes(token_ids)
+        if start_block + n > len(hashes):
+            return 0
+        # The chain below the fetched run must resolve locally or the
+        # registered digests would be unreachable (match_prefix walks
+        # from block 0). A lead block parked in the host tier counts —
+        # the admit's restore path brings it back and then picks up
+        # these HBM-registered blocks behind it. Pin the HBM leads
+        # across the alloc: allocation pressure reclaims LRU cached
+        # pages, and evicting the chain's own head while adopting its
+        # tail would orphan the fetch. (A tier lead LRU-evicted later
+        # leaves the adopted pages as unreachable-but-reclaimable —
+        # wasted transfer, never a correctness issue.)
+        lead = []
+        for i in range(start_block):
+            pid = self.prefix_cache.page_of(hashes[i])
+            if pid is not None:
+                lead.append(pid)
+                continue
+            if self.host_tier is None or hashes[i] not in self.host_tier:
+                return 0
+        self.prefix_cache.acquire_pages(lead)
+        try:
+            pages = self.prefix_cache.alloc(n)
+            if pages is None:
+                return 0
+            k_pages, v_pages = self.kv
+            idx = jnp.asarray(pages, jnp.int32)
+            self.kv = _kv_scatter(k_pages, v_pages, idx,
+                                  jnp.asarray(k).astype(k_pages.dtype),
+                                  jnp.asarray(v).astype(v_pages.dtype))
+            # Positional hash→page registration (lead pages may resolve
+            # through the tier, so a full positional lead list does not
+            # exist — register_blocks aligns by the fetched run alone).
+            self.prefix_cache.register_blocks(
+                hashes[start_block:start_block + n], pages)
+            self.prefix_cache.release_pages(pages)
+        finally:
+            self.prefix_cache.release_pages(lead)
+        self.fetched_blocks += n
+        return n
+
+    def kv_block_bytes(self) -> int:
+        """Bytes of one content-addressed KV block (k+v, all layers) —
+        advertised in worker registration for the service's
+        fetch-vs-recompute cost model."""
+        k_pages = jax.tree_util.tree_leaves(self.kv)[0]
+        return 2 * int(k_pages.nbytes) // int(k_pages.shape[1])
+
+    def prefix_cache_stats(self) -> Dict[str, int]:
+        """The xllm_worker_prefix_cache_* series source (worker obs
+        flush): lifetime lookups / hit tokens / spill traffic."""
+        tier = self.host_tier
+        return {
+            "lookups_total": self.prefix_lookups,
+            "hit_tokens_total": self.prefix_hit_tokens,
+            "fetched_blocks_total": self.fetched_blocks,
+            "spilled_pages": tier.spilled_blocks if tier else 0,
+            "restored_pages": tier.restored_blocks if tier else 0,
+        }
+
+    # ------------------------------------------------------------------
     # Warmup / metrics
     # ------------------------------------------------------------------
     def warmup(self, buckets: Optional[Sequence[int]] = None,
@@ -1845,7 +2102,12 @@ class Engine:
         }
 
     def drain_kvcache_event(self) -> KvCacheEvent:
-        return self.prefix_cache.drain_event()
+        ev = self.prefix_cache.drain_event()
+        if self.host_tier is not None:
+            # Tier-internal transitions (DRAM→disk demotions, budget
+            # drops) ride the same heartbeat delta as the HBM events.
+            ev.merge(self.host_tier.drain_event())
+        return ev
 
 
 # ---------------------------------------------------------------------------

@@ -19,28 +19,228 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import logging
+import os
 import time
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from xllm_service_tpu.utils.hashing import prefix_block_hashes
+from xllm_service_tpu.utils.locks import make_lock
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
 class KvCacheEvent:
     """Delta of the worker's prefix-cache content, shipped in heartbeats to
     the service's global index (reference: xllm_rpc_service.proto KvCacheEvent
-    — stored/removed block digests)."""
+    — stored/removed block digests). ``offloaded`` = HBM → host-DRAM spill
+    (the block is still servable from this worker, one tier down);
+    ``offloaded_ssd`` = DRAM → disk demotion."""
 
     stored: List[bytes] = dataclasses.field(default_factory=list)
     removed: List[bytes] = dataclasses.field(default_factory=list)
+    offloaded: List[bytes] = dataclasses.field(default_factory=list)
+    offloaded_ssd: List[bytes] = dataclasses.field(default_factory=list)
 
     def merge(self, other: "KvCacheEvent") -> None:
         self.stored.extend(other.stored)
         self.removed.extend(other.removed)
+        self.offloaded.extend(other.offloaded)
+        self.offloaded_ssd.extend(other.offloaded_ssd)
 
     @property
     def empty(self) -> bool:
-        return not (self.stored or self.removed)
+        return not (self.stored or self.removed or self.offloaded
+                    or self.offloaded_ssd)
+
+
+def encode_kv_block(k, v, extra: Optional[Dict] = None) -> bytes:
+    """One K/V array pair as a meta-line + raw-bytes payload — the ONE
+    codec for every KV byte stream (``/kv/blocks`` responses, the disk
+    spill tier; ``/kv/import``/``/kv/chunk`` decode the same form via
+    ``decode_kv_blob``): a JSON header ``{"shape", "dtype", **extra}``
+    line, then K bytes, then V bytes."""
+    import json
+    head = json.dumps({"shape": list(k.shape), "dtype": str(k.dtype),
+                       **(extra or {})})
+    return head.encode("utf-8") + b"\n" + k.tobytes() + v.tobytes()
+
+
+def decode_kv_blob(meta: Dict, blob: bytes):
+    """Inverse of ``encode_kv_block`` given the parsed header ``meta``:
+    (k, v) numpy views over ``blob``. Raises ValueError on a size
+    mismatch (callers surface it as an HTTP 400 / corrupt-file skip)."""
+    import numpy as np
+    if meta["dtype"] == "bfloat16":
+        import ml_dtypes
+        dtype = np.dtype(ml_dtypes.bfloat16)
+    else:
+        dtype = np.dtype(meta["dtype"])
+    shape = tuple(meta["shape"])
+    nbytes = int(np.prod(shape)) * dtype.itemsize
+    if len(blob) != 2 * nbytes:
+        raise ValueError(
+            f"payload size mismatch: {len(blob)} != {2 * nbytes}")
+    k = np.frombuffer(blob[:nbytes], dtype=dtype).reshape(shape)
+    v = np.frombuffer(blob[nbytes:], dtype=dtype).reshape(shape)
+    return k, v
+
+
+class HostKvTier:
+    """Bounded host-DRAM (plus optional disk) parking lot for spilled KV
+    pages, keyed by the same chained block digest the HBM index uses.
+
+    A page evicted from the HBM pool under allocation pressure lands here
+    instead of vanishing; a later prefix hit restores it through the
+    donated pool scatter (write-then-attend zero-copy path preserved —
+    the restore jit is the same ``_kv_scatter`` program PD import uses).
+    LRU within the byte budget; overflow demotes to the disk tier when
+    one is configured (``XLLM_KV_SPILL_DIR``), else drops the block.
+
+    Thread-safe on its own lock (rank ``kv_cache.tier``): the engine owns
+    the hot paths, but the worker's ``/kv/blocks`` holder endpoint reads
+    blocks from an HTTP thread."""
+
+    def __init__(self, capacity_bytes: int, disk_dir: str = "",
+                 disk_capacity_bytes: int = 0) -> None:
+        self.capacity_bytes = max(int(capacity_bytes), 0)
+        self.disk_dir = disk_dir
+        self.disk_capacity_bytes = max(int(disk_capacity_bytes), 0)
+        self._lock = make_lock("kv_cache.tier", 22)
+        # hash → (k_np, v_np); insertion order ~ LRU.
+        self._blocks: "collections.OrderedDict[bytes, Tuple]" = \
+            collections.OrderedDict()
+        self._bytes = 0
+        # hash → file path (disk tier); insertion order ~ LRU.
+        self._disk: "collections.OrderedDict[bytes, str]" = \
+            collections.OrderedDict()
+        self._disk_bytes = 0
+        self._pending = KvCacheEvent()
+        self.spilled_blocks = 0       # lifetime DRAM admissions
+        self.restored_blocks = 0      # lifetime promotions back to HBM
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+
+    @staticmethod
+    def _nbytes(k, v) -> int:
+        return int(k.nbytes) + int(v.nbytes)
+
+    def put(self, h: bytes, k, v) -> bool:
+        """Park one spilled page (host numpy arrays) under its digest.
+        Returns False when the tier cannot hold it (the caller then
+        reports the block removed, not offloaded)."""
+        with self._lock:
+            if h in self._blocks:
+                self._blocks.move_to_end(h)
+                return True
+            n = self._nbytes(k, v)
+            if n > self.capacity_bytes:
+                return False                # block larger than the tier
+            self._blocks[h] = (k, v)
+            self._bytes += n
+            self.spilled_blocks += 1
+            while self._bytes > self.capacity_bytes and self._blocks:
+                old_h, (ok, ov) = self._blocks.popitem(last=False)
+                self._bytes -= self._nbytes(ok, ov)
+                self._demote_locked(old_h, ok, ov)
+            return True
+
+    def _demote_locked(self, h: bytes, k, v) -> None:
+        """DRAM overflow: write to the disk tier when configured (cold
+        path — a header line + raw K/V bytes on the worker's local
+        disk; .npz can't round-trip the ml_dtypes bfloat16 the pools
+        use), else the block is gone everywhere and the cluster index
+        must forget it. A disk dir WITHOUT a positive budget counts as
+        no disk tier — otherwise every demotion would write a multi-MB
+        file and immediately unlink it, on the admission hot path,
+        retaining nothing."""
+        if not self.disk_dir or self.disk_capacity_bytes <= 0:
+            self._pending.removed.append(h)
+            return
+        n = self._nbytes(k, v)
+        path = os.path.join(self.disk_dir, h.hex() + ".kv")
+        try:
+            with open(path, "wb") as f:
+                f.write(encode_kv_block(k, v))
+        except OSError as e:
+            logger.warning("kv disk spill of %s failed: %s", h.hex(), e)
+            self._pending.removed.append(h)
+            return
+        self._disk[h] = path
+        self._disk_bytes += n
+        self._pending.offloaded_ssd.append(h)
+        while self._disk_bytes > self.disk_capacity_bytes and self._disk:
+            old_h, old_path = self._disk.popitem(last=False)
+            try:
+                self._disk_bytes -= os.path.getsize(old_path)
+                os.unlink(old_path)
+            except OSError:
+                pass
+            self._pending.removed.append(old_h)
+
+    def peek(self, h: bytes) -> Optional[Tuple]:
+        """The block's (k, v) host arrays without consuming it — the
+        restore path peeks first so a failed page allocation leaves the
+        tier untouched. Disk blocks are loaded (and promoted to DRAM
+        accounting stays put: the entry is consumed right after by
+        ``pop`` on the success path)."""
+        with self._lock:
+            blk = self._blocks.get(h)
+            if blk is not None:
+                self._blocks.move_to_end(h)
+                return blk
+            path = self._disk.get(h)
+        if path is None:
+            return None
+        import json
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            nl = raw.index(b"\n")
+            meta = json.loads(raw[:nl].decode("utf-8"))
+            return decode_kv_blob(meta, raw[nl + 1:])
+        except (OSError, ValueError, KeyError) as e:
+            logger.warning("kv disk read of %s failed: %s", h.hex(), e)
+            return None
+
+    def pop(self, h: bytes) -> None:
+        """Consume one block (it was restored to HBM — the HBM `stored`
+        delta supersedes this tier's claim at the cluster index)."""
+        with self._lock:
+            blk = self._blocks.pop(h, None)
+            if blk is not None:
+                self._bytes -= self._nbytes(*blk)
+                self.restored_blocks += 1
+                return
+            path = self._disk.pop(h, None)
+            if path is not None:
+                try:
+                    self._disk_bytes -= os.path.getsize(path)
+                    os.unlink(path)
+                except OSError:
+                    pass
+                self.restored_blocks += 1
+
+    def __contains__(self, h: bytes) -> bool:
+        with self._lock:
+            return h in self._blocks or h in self._disk
+
+    def drain_event(self) -> KvCacheEvent:
+        with self._lock:
+            ev = self._pending
+            self._pending = KvCacheEvent()
+            return ev
+
+    @property
+    def num_blocks(self) -> int:
+        with self._lock:
+            return len(self._blocks) + len(self._disk)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
 
 
 class PageAllocator:
@@ -92,6 +292,13 @@ class PrefixCacheIndex:
         self._reclaimable: "collections.OrderedDict[int, float]" = \
             collections.OrderedDict()
         self._pending_event = KvCacheEvent()
+        # Tiered spill (engine-wired): called with (hash, page) when a
+        # RECLAIMABLE registered page is about to be reused under
+        # allocation pressure — the one eviction class whose content is
+        # still intact in HBM. True = the block was parked in a lower
+        # tier (event: offloaded); False/None-hook = it is gone
+        # (event: removed).
+        self.spill_hook: Optional[Callable[[bytes, int], bool]] = None
 
     # -- hashing ----------------------------------------------------------
     def block_hashes(self, tokens: Sequence[int]) -> List[bytes]:
@@ -183,11 +390,13 @@ class PrefixCacheIndex:
 
     # -- allocation under pressure ---------------------------------------
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Allocate ``n`` pages, reclaiming LRU cached pages if needed."""
+        """Allocate ``n`` pages, reclaiming LRU cached pages if needed.
+        A reclaimed page's content is still intact, so this is the one
+        eviction site that can SPILL it to a lower tier first."""
         need = n - self.allocator.num_free
         while need > 0 and self._reclaimable:
             pid, _ = self._reclaimable.popitem(last=False)
-            self._evict_mapping(pid)
+            self._evict_mapping(pid, spillable=True)
             self.allocator.free([pid])
             need -= 1
         pages = self.allocator.alloc(n)
@@ -196,11 +405,61 @@ class PrefixCacheIndex:
                 self._acquire(pid)
         return pages
 
-    def _evict_mapping(self, pid: int) -> None:
+    def _evict_mapping(self, pid: int, spillable: bool = False) -> None:
         h = self._hash_of.pop(pid, None)
-        if h is not None:
-            self._by_hash.pop(h, None)
-            self._pending_event.removed.append(h)
+        if h is None:
+            return
+        self._by_hash.pop(h, None)
+        if spillable and self.spill_hook is not None:
+            try:
+                if self.spill_hook(h, pid):
+                    self._pending_event.offloaded.append(h)
+                    return
+            except Exception as e:  # noqa: BLE001 — spill is best-effort;
+                # a failed copy degrades to a plain eviction, never an
+                # allocation failure.
+                logger.warning("kv spill of page %d failed: %s", pid, e)
+        self._pending_event.removed.append(h)
+
+    def register_blocks(self, hashes: Sequence[bytes],
+                        pages: Sequence[int]) -> int:
+        """Directly register hash→page mappings, positionally (the
+        cross-worker adoption path, where the chain below may resolve
+        through the spill tier rather than HBM — ``register_full_pages``
+        would need every lead page id). Chain REACHABILITY is the
+        caller's contract. Skips hashes already owned (exactly-once:
+        the redundant page stays unregistered and frees on release).
+        Returns the number registered."""
+        n = 0
+        for h, pid in zip(hashes, pages):
+            if self._hash_of.get(pid) == h or h in self._by_hash:
+                continue
+            self._evict_mapping(pid)
+            self._by_hash[h] = pid
+            self._hash_of[pid] = h
+            self._pending_event.stored.append(h)
+            n += 1
+        return n
+
+    # -- cross-worker fetch (holder side) --------------------------------
+    def pages_for_hashes(self, hashes: Sequence[bytes]) -> List[int]:
+        """HBM pages for a digest run, stopping at the first miss (the
+        fetch contract is a contiguous leading prefix). The returned
+        pages are ACQUIRED for the caller (pinned against reclamation
+        while the export gathers them) and must be released via
+        ``release_pages``."""
+        pages: List[int] = []
+        for h in hashes:
+            pid = self._by_hash.get(h)
+            if pid is None:
+                break
+            pages.append(pid)
+        for pid in pages:
+            self._acquire(pid)
+        return pages
+
+    def page_of(self, h: bytes) -> Optional[int]:
+        return self._by_hash.get(h)
 
     # -- heartbeat plumbing ----------------------------------------------
     def drain_event(self) -> KvCacheEvent:

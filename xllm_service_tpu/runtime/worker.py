@@ -115,20 +115,13 @@ class WorkerOptions:
 
 
 def _decode_kv_blob(meta: Dict[str, Any], blob: bytes):
-    """Decode one KV wire body (monolithic /kv/import or one /kv/chunk):
-    ``blob`` is k-bytes then v-bytes at ``meta``'s shape/dtype. Raises
-    ValueError on a size mismatch (the HTTP 400 text)."""
-    import ml_dtypes
-    dtype = (ml_dtypes.bfloat16 if meta["dtype"] == "bfloat16"
-             else np.dtype(meta["dtype"]))
-    shape = tuple(meta["shape"])
-    nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
-    if len(blob) != 2 * nbytes:
-        raise ValueError(
-            f"payload size mismatch: {len(blob)} != {2 * nbytes}")
-    k = np.frombuffer(blob[:nbytes], dtype=dtype).reshape(shape)
-    v = np.frombuffer(blob[nbytes:], dtype=dtype).reshape(shape)
-    return k, v
+    """Decode one KV wire body (monolithic /kv/import, one /kv/chunk,
+    or a /kv/blocks response): ``blob`` is k-bytes then v-bytes at
+    ``meta``'s shape/dtype. The ONE codec lives in runtime/kv_cache.py
+    (the disk spill tier shares it). Raises ValueError on a size
+    mismatch (the HTTP 400 text)."""
+    from xllm_service_tpu.runtime.kv_cache import decode_kv_blob
+    return decode_kv_blob(meta, blob)
 
 
 def _mm_meta(req) -> Optional[Dict[str, Any]]:
@@ -510,6 +503,9 @@ class Worker:
         # heartbeat still in flight can land after the drain heartbeat
         # and re-mark the models awake at the router.
         self._hb_lock = make_lock("worker.hb", 5)
+        # Undelivered heartbeat cache delta (KvCacheEvent), retried on
+        # the next beat. Touched only under _hb_lock.
+        self._hb_cache_pending = None
         # Last-shipped cumulative step_ms bucket counts per
         # (model, phase): the heartbeat diffs against these so
         # LatencyMetrics.step_ms_p99 is the p99 of the steps since the
@@ -558,6 +554,9 @@ class Worker:
         router.route("POST", "/cancel", self._serve_cancel)
         router.route("POST", "/kv/import", self._serve_kv_import)
         router.route("POST", "/kv/chunk", self._serve_kv_chunk)
+        router.route("POST", "/kv/blocks", self._serve_kv_blocks)
+        router.route("POST", "/kv/blocks_done",
+                     self._serve_kv_blocks_done)
         router.route("POST", "/encode", self._serve_encode)
         router.route("POST", "/v1/embeddings", self._serve_embeddings)
         router.route("POST", "/admin/failpoint", self._serve_failpoint)
@@ -591,6 +590,23 @@ class Worker:
         # Decode peers that proved unable to pull the device wire (424):
         # stop offering and take the host shuttle straight away.
         self._wire_refused: set = set()
+        # Cross-worker cached-block fetch (docs/KV_CACHE.md), holder
+        # side: wire tickets staged for a requester's pull, uuid →
+        # (staged_at, wire). Released by /kv/blocks_done or the
+        # heartbeat loop's TTL sweep (a requester that died mid-pull
+        # must not pin device blocks forever).
+        self._kv_fetch_staged: Dict[int, Tuple[float, Any]] = {}
+        self._kv_fetch_mu = make_lock("worker.kvfetch", 25)
+        # Requester-side fetch book (xllm_worker_kv_fetch_* on
+        # /metrics): outcomes + transferred bytes.
+        self.kv_fetch_attempts = 0
+        self.kv_fetch_failures = 0
+        self.kv_fetch_bytes = 0
+        # Measured prefill throughput for the heartbeat's cost-model
+        # signal: cumulative prompt tokens / wall seconds over prefill
+        # steps (engine-loop thread writes, heartbeat reads — benign).
+        self._prefill_tok_cum = 0
+        self._prefill_s_cum = 0.0
         # Admission guards the ENTRY endpoints (/v1/* generate /
         # embeddings — the ones the service re-dispatches on 503).
         # Control verbs and mid-request continuation traffic are exempt:
@@ -603,7 +619,8 @@ class Worker:
             max_concurrency=lambda: self.opts.max_concurrency,
             admission_exempt=_ADMISSION_EXEMPT + (
                 "/sleep", "/wakeup", "/cancel", "/flip_role",
-                "/fork_master", "/kv/import", "/kv/chunk", "/encode"))
+                "/fork_master", "/kv/import", "/kv/chunk", "/kv/blocks",
+                "/kv/blocks_done", "/encode"))
         self.name = self._srv.address
 
         self._loop_thread = threading.Thread(
@@ -822,6 +839,7 @@ class Worker:
             rt = self.primary_runtime()
             if rt.engine is not None:
                 ttft_prof, tpot_prof = profile_engine(rt.engine)
+        eng = self.primary_runtime().engine
         meta = InstanceMetaInfo(
             name=self.name,
             rpc_address=self.name,
@@ -837,6 +855,14 @@ class Worker:
             v_cache_ids=list(range(
                 self.primary_runtime().model_cfg.num_layers)),
             addrs=[self.name],
+            # Block-hash contract + block weight (docs/KV_CACHE.md):
+            # the service fails loud when page_size/seed diverge from
+            # its (block_size, murmur seed), and prices cross-worker
+            # fetches with kv_block_bytes.
+            page_size=self.engine_cfg.page_size,
+            hash_seed=self.opts.murmur_seed,
+            kv_block_bytes=eng.kv_block_bytes() if eng is not None
+            else 0,
         )
         if self._lease_id is not None:
             # Re-registration (role flip): the old lease must die with the
@@ -904,8 +930,14 @@ class Worker:
             "xllm_worker_step_ms", "wall time of one engine step",
             labelnames=("model", "phase")).observe(
             step_ms, model=m, phase=kind)
+        if kind == "prefill":
+            # Measured prefill tok/s for the heartbeat's cost-model
+            # signal (LatencyMetrics.prefill_tok_s).
+            self._prefill_tok_cum += eng.last_step_tokens
+            self._prefill_s_cum += step_ms / 1e3
         self._flush_phase_ledger(rt)
         self._flush_overlap(rt)
+        self._flush_prefix_cache(rt)
 
     def _flush_overlap(self, rt: ModelRuntime) -> None:
         """Decode-pipeline overlap health: speculative-burst
@@ -935,6 +967,44 @@ class Worker:
             "speculative burst",
             labelnames=("model",)).set(
             om["device_idle_ms_per_burst"], model=m)
+
+    def _flush_prefix_cache(self, rt: ModelRuntime) -> None:
+        """Prefix-reuse health (docs/KV_CACHE.md): lookup/hit-token
+        totals, spill-tier traffic and cross-worker fetched blocks —
+        the series the cluster-scale prefix-reuse loop is judged by."""
+        eng = rt.engine
+        if eng is None:
+            return
+        m = rt.model
+        stats = eng.prefix_cache_stats()
+        c = self.obs.counter(
+            "xllm_worker_prefix_cache_hit_tokens_total",
+            "prompt tokens served from the prefix cache (local hits, "
+            "tier restores and cross-worker fetches alike)",
+            labelnames=("model",))
+        c.set_total(stats["hit_tokens_total"], model=m)
+        self.obs.counter(
+            "xllm_worker_prefix_cache_lookups_total",
+            "admits that consulted the prefix cache",
+            labelnames=("model",)).set_total(
+            stats["lookups_total"], model=m)
+        self.obs.counter(
+            "xllm_worker_prefix_cache_spilled_pages",
+            "HBM prefix pages parked in the host-DRAM tier instead of "
+            "dropped (XLLM_KV_SPILL_MB)",
+            labelnames=("model",)).set_total(
+            stats["spilled_pages"], model=m)
+        self.obs.counter(
+            "xllm_worker_prefix_cache_restored_pages",
+            "spilled pages restored to HBM on a later prefix hit",
+            labelnames=("model",)).set_total(
+            stats["restored_pages"], model=m)
+        self.obs.counter(
+            "xllm_worker_prefix_cache_fetched_blocks_total",
+            "KV blocks adopted from a remote holder (cross-worker "
+            "cached-block fetch)",
+            labelnames=("model",)).set_total(
+            stats["fetched_blocks_total"], model=m)
 
     def _flush_phase_ledger(self, rt: ModelRuntime) -> None:
         """Mirror the engine's phase wall-time ledger + post-warmup
@@ -990,6 +1060,11 @@ class Worker:
                     self._latency.recent_max_ttft_ms, step_ms)
                 self.spans.record(live.service_request_id, "first_token",
                                   plane="worker", t_mono=now)
+                # Per-request prefix-reuse evidence on the span (rides
+                # the heartbeat to /admin/trace/<id>): prompt tokens
+                # whose KV was already resident when prefill started.
+                self.spans.annotate(live.service_request_id,
+                                    cache_hit_tokens=out.num_cached_tokens)
             else:
                 self._latency.recent_max_tbt_ms = max(
                     self._latency.recent_max_tbt_ms, step_ms)
@@ -1245,6 +1320,17 @@ class Worker:
             else:
                 prompt = body.get("prompt", "")
             token_ids = rt.tokenizer.encode(prompt)
+        # Cross-worker cached-block fetch: execute the scheduler's plan
+        # BEFORE admission so the admit's match_prefix hits the adopted
+        # blocks (multimodal prompts never prefix-cache — skip).
+        kvf = (body.get("routing") or {}).get("kv_fetch")
+        if kvf and not body.get("mm_inputs"):
+            try:
+                self._maybe_fetch_blocks(rt, list(token_ids), kvf)
+            except Exception as e:  # noqa: BLE001 — fetch is an
+                # optimization; any surprise degrades to a cold prefill
+                logger.warning("kv block fetch failed (%s); "
+                               "recomputing", e)
         if body.get("sampling"):
             # Service-parsed SamplingParams travel in the rewritten body
             # (like token_ids/routing) — the single source of truth, so
@@ -1581,6 +1667,7 @@ class Worker:
             self._engine_load(rt)
             self._flush_phase_ledger(rt)
             self._flush_overlap(rt)
+            self._flush_prefix_cache(rt)
         # Keep-alive reuse pool, labeled with the exporting plane (the
         # pool is process-global — see the service-side exporter note).
         # In the separate-process deployment this is the worker→service
@@ -1604,6 +1691,16 @@ class Worker:
             self.kv_migration_device_wire)
         obs.counter("xllm_worker_kv_migration_chunked_total").set_total(
             self.kv_migration_chunked)
+        obs.counter("xllm_worker_kv_fetch_attempts_total",
+                    "cross-worker cached-block fetches attempted "
+                    "(requester side)").set_total(self.kv_fetch_attempts)
+        obs.counter("xllm_worker_kv_fetch_failures_total",
+                    "fetch attempts that fell back to recompute "
+                    "(holder refusal, transport, failpoint)").set_total(
+            self.kv_fetch_failures)
+        obs.counter("xllm_worker_kv_fetch_bytes_total",
+                    "KV bytes adopted from remote holders").set_total(
+            self.kv_fetch_bytes)
         from xllm_service_tpu.runtime.kv_wire import peek_device_wire
         wire = peek_device_wire()
         if wire is not None:
@@ -2752,6 +2849,251 @@ class Worker:
             self._finalize_live(live)
 
     # ------------------------------------------------------------------
+    # Cross-worker cached-block fetch (docs/KV_CACHE.md). A worker
+    # placed on a request whose prefix some OTHER worker holds pulls
+    # those KV blocks from the holder and starts prefill at the first
+    # uncached token. Transport mirrors the PD handoff: the PJRT device
+    # wire (kv_wire.stage/pull_block) when both sides can serve it, a
+    # raw meta-line + K/V-bytes response otherwise. Every failure falls
+    # back to prefilling from token zero — the fetch is an optimization,
+    # never a new failure mode.
+    # ------------------------------------------------------------------
+    def _serve_kv_blocks(self, req: Request) -> Response:
+        return self._guarded(self._serve_kv_blocks_inner, req)
+
+    def _serve_kv_blocks_inner(self, req: Request) -> Response:
+        """Holder side: gather a contiguous digest run out of the pool
+        (and/or the spill tier) and hand it to the requester — staged on
+        the device wire ({"status": "staged", "transfer": ...}), or raw
+        octet-stream (meta line + K bytes + V bytes)."""
+        try:
+            body = req.json()
+        except Exception:  # noqa: BLE001
+            return Response.error(400, "invalid JSON body")
+        check_version(body, "kv_blocks")
+        model = body.get("model", self.opts.model)
+        # STRICT model resolution — no primary fallback: digests hash
+        # token ids only, so a wrong-model engine could hold the
+        # requested digests and serve another model's KV as a 200.
+        rt = self.runtimes.get(model)
+        if rt is None:
+            return Response.error(404, f"model {model!r} not served "
+                                       f"here")
+        if rt.engine is None:
+            return Response.error(503, f"model {model!r} asleep")
+        try:
+            hashes = [bytes.fromhex(h) for h in body.get("hashes", [])]
+        except (TypeError, ValueError):
+            return Response.error(400, "bad digest hex")
+        if not hashes:
+            return Response.error(400, "no hashes requested")
+        wire = None
+        if body.get("wire") and self.opts.pd_device_wire:
+            from xllm_service_tpu.runtime.kv_wire import get_device_wire
+            wire = get_device_wire()
+        with self._engine_lock:
+            exported = rt.engine.export_blocks(
+                hashes, device=wire is not None)
+        if exported is None:
+            # Evicted since the cluster index last heard from us —
+            # the requester recomputes; the next heartbeat's removals
+            # catch the index up.
+            return Response.error(404, "blocks no longer held")
+        n, k, v = exported
+        if wire is not None and not isinstance(k, np.ndarray):
+            try:
+                uuid = wire.stage(k, v)
+            except Exception as e:  # noqa: BLE001 — wire broke post-probe
+                logger.warning("kv block staging failed (%s); serving "
+                               "raw", e)
+            else:
+                with self._kv_fetch_mu:
+                    self._kv_fetch_staged[uuid] = (time.monotonic(),
+                                                   wire)
+                return Response.json({
+                    "status": "staged", "blocks": n,
+                    "transfer": {"addr": wire.address, "uuid": uuid,
+                                 "shape": list(k.shape),
+                                 "dtype": str(k.dtype)}})
+        if not isinstance(k, np.ndarray):
+            k = np.asarray(jax.device_get(k))
+            v = np.asarray(jax.device_get(v))
+        from xllm_service_tpu.runtime.kv_cache import encode_kv_block
+        payload = encode_kv_block(k, v, extra=stamp({"blocks": n}))
+        return Response(body=payload,
+                        content_type="application/octet-stream")
+
+    def _serve_kv_blocks_done(self, req: Request) -> Response:
+        """Requester's pull acknowledgment: release the staged wire
+        ticket (drain on a provably-untouched block, count a leak on an
+        ambiguous one — kv_wire release contract)."""
+        try:
+            body = req.json()
+            uuid = int(body.get("uuid"))
+        except Exception:  # noqa: BLE001 — bad JSON / missing uuid
+            return Response.error(400, "invalid body")
+        outcome = body.get("outcome", "pulled")
+        with self._kv_fetch_mu:
+            entry = self._kv_fetch_staged.pop(uuid, None)
+        if entry is None:
+            return Response.json({"ok": True, "known": False})
+        _, wire = entry
+        if outcome == "pulled":
+            wire.release(uuid)
+        elif outcome == "nopull":
+            wire.release(uuid, drain=True)
+        else:
+            wire.release(uuid, leaked=True)
+        return Response.json({"ok": True, "known": True})
+
+    def _sweep_kv_fetch_staged(self, ttl: float = 60.0) -> None:
+        """Heartbeat-cadence TTL sweep of wire tickets whose requester
+        never acknowledged (died mid-pull): transfer state unknown, so
+        the block counts as leaked (kv_wire release contract)."""
+        now = time.monotonic()
+        with self._kv_fetch_mu:
+            stale = [(u, e) for u, e in self._kv_fetch_staged.items()
+                     if now - e[0] > ttl]
+            for u, _ in stale:
+                del self._kv_fetch_staged[u]
+        for u, (_, wire) in stale:
+            wire.release(u, leaked=True)
+
+    def _maybe_fetch_blocks(self, rt: ModelRuntime,
+                            token_ids: List[int],
+                            kvf: Dict[str, Any]) -> None:
+        """Requester side: execute the scheduler's Routing.kv_fetch plan
+        before prefill admission. Pulls the planned leading blocks from
+        the holder, adopts them content-addressed into the local pool,
+        and lets the normal admit path hit them like any local prefix.
+        Best-effort end to end: ANY failure (holder refusal, transport,
+        layout mismatch, armed ``worker.fail_kv_fetch``) degrades to
+        prefilling from token zero."""
+        eng = rt.engine
+        if eng is None or not eng.prefix_cache.enable:
+            return
+        holder = kvf.get("holder") or ""
+        holder_addr = kvf.get("holder_addr") or holder
+        try:
+            end = int(kvf.get("blocks", 0))
+            bs = int(kvf.get("block_size", 0))
+        except (TypeError, ValueError):
+            return
+        if not holder_addr or holder == self.name or end <= 0:
+            return
+        if bs != self.engine_cfg.page_size:
+            # Plan priced on a different block granularity than this
+            # engine's pages — adopted blocks would be mis-keyed.
+            logger.warning("kv fetch plan block_size=%d != engine "
+                           "page_size=%d; recomputing", bs,
+                           self.engine_cfg.page_size)
+            return
+        hashes = eng.prefix_cache.block_hashes(token_ids)
+        end = min(end, len(hashes))
+        with self._engine_lock:
+            start = 0
+            while start < end and (
+                    eng.prefix_cache.page_of(hashes[start]) is not None
+                    or (eng.host_tier is not None
+                        and hashes[start] in eng.host_tier)):
+                start += 1
+        if start >= end:
+            return              # local tiers already cover the plan
+        self.kv_fetch_attempts += 1
+        if self.failpoints.fire("worker.fail_kv_fetch") is not None:
+            self.kv_fetch_failures += 1
+            logger.warning("failpoint worker.fail_kv_fetch: recomputing "
+                           "%d planned blocks", end - start)
+            return
+        from xllm_service_tpu.runtime.kv_wire import (
+            WireNoPull, WireUnsupported, get_device_wire, pull_block)
+        can_pull = bool(self.opts.pd_device_wire
+                        and get_device_wire() is not None)
+        from xllm_service_tpu.service.httpd import http_stream_status
+        # The fetch is an optimization: it must never stall TTFT behind
+        # a hung/partitioned holder for anything like the full request
+        # timeout — recompute is always milliseconds away. Bounded by
+        # its own short deadline.
+        try:
+            fetch_timeout = float(os.environ.get(
+                "XLLM_KV_FETCH_TIMEOUT_S", "15") or 15)
+        except ValueError:
+            fetch_timeout = 15.0
+        t0 = time.monotonic()
+        try:
+            status, body_iter = http_stream_status(
+                "POST", holder_addr, "/kv/blocks",
+                obj=stamp({"model": rt.model, "wire": can_pull,
+                           "hashes": [h.hex()
+                                      for h in hashes[start:end]]}),
+                timeout=fetch_timeout)
+            raw = b"".join(body_iter)
+        except Exception as e:  # noqa: BLE001 — holder unreachable
+            self.kv_fetch_failures += 1
+            logger.warning("kv block fetch from %s failed (%s); "
+                           "recomputing", holder_addr, e)
+            return
+        if status != 200:
+            self.kv_fetch_failures += 1
+            logger.info("kv block fetch refused by %s (HTTP %d); "
+                        "recomputing", holder_addr, status)
+            return
+        k = v = None
+        n = 0
+        if raw.startswith(b"{") and b"\n" not in raw:
+            # JSON verdict: a staged wire ticket.
+            try:
+                head = json.loads(raw.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                head = {}
+            tr = head.get("transfer")
+            if head.get("status") != "staged" or not tr:
+                self.kv_fetch_failures += 1
+                return
+            n = int(head.get("blocks", 0))
+            outcome = "pulled"
+            try:
+                k, v = pull_block(tr)
+            except (WireUnsupported, WireNoPull):
+                outcome = "nopull"
+            except Exception:  # noqa: BLE001 — failed mid-pull
+                outcome = "error"
+            try:
+                http_json("POST", holder_addr, "/kv/blocks_done",
+                          {"uuid": tr.get("uuid"), "outcome": outcome},
+                          timeout=10.0)
+            except Exception:  # noqa: BLE001 — holder TTL-sweeps it
+                pass
+            if k is None:
+                self.kv_fetch_failures += 1
+                logger.info("kv block wire pull from %s failed (%s); "
+                            "recomputing", holder_addr, outcome)
+                return
+        else:
+            nl = raw.find(b"\n")
+            if nl < 0:
+                self.kv_fetch_failures += 1
+                return
+            try:
+                meta = json.loads(raw[:nl].decode("utf-8"))
+                n = int(meta.get("blocks", 0))
+                k, v = _decode_kv_blob(meta, raw[nl + 1:])
+            except (ValueError, UnicodeDecodeError) as e:
+                self.kv_fetch_failures += 1
+                logger.warning("bad kv block payload from %s: %s",
+                               holder_addr, e)
+                return
+        with self._engine_lock:
+            adopted = eng.adopt_blocks(token_ids, start, k, v)
+        if adopted:
+            self.kv_fetch_bytes += 2 * int(k.nbytes)
+            logger.info("adopted %d cached blocks from %s in %.1f ms",
+                        adopted, holder_addr,
+                        1e3 * (time.monotonic() - t0))
+        else:
+            self.kv_fetch_failures += 1
+
+    # ------------------------------------------------------------------
     # Heartbeats
     # ------------------------------------------------------------------
     def _fetch_service_config(self) -> bool:
@@ -2786,6 +3128,7 @@ class Worker:
                 # worker, pinning a dead prefill's device KV forever.
                 with self._kv_chunk_mu:
                     self._evict_stale_chunks_locked(time.monotonic())
+                self._sweep_kv_fetch_staged()
                 if self.failpoints.fire(
                         "worker.drop_heartbeats") is not None:
                     # Simulated crash/partition: no store keepalive, no
@@ -2889,19 +3232,44 @@ class Worker:
         load = LoadMetrics()
         stored: List[str] = []
         removed: List[str] = []
+        offloaded: List[str] = []
+        offloaded_ssd: List[str] = []
         model_states = {
             m: (MODEL_DRAINING if self._draining else r.state)
             for m, r in self.runtimes.items()}
+        cache_ev = None
         if rt.engine is not None:
             load = self._engine_load(rt)
-            ev = rt.engine.drain_kvcache_event()
-            stored = [h.hex() for h in ev.stored]
-            removed = [h.hex() for h in ev.removed]
+            # The engine-side drain is a swap (concurrent appends land
+            # in the old or the new event object, both retained); an
+            # UNDELIVERED delta is kept in this worker-side buffer
+            # (touched only under _hb_lock — the heartbeat must never
+            # block on the engine lock, which is held for whole
+            # compiles) and folded into the next beat's drain.
+            cache_ev = rt.engine.drain_kvcache_event()
+            if self._hb_cache_pending is not None:
+                self._hb_cache_pending.merge(cache_ev)
+                cache_ev = self._hb_cache_pending
+                self._hb_cache_pending = None
+            stored = [h.hex() for h in cache_ev.stored]
+            removed = [h.hex() for h in cache_ev.removed]
+            offloaded = [h.hex() for h in cache_ev.offloaded]
+            offloaded_ssd = [h.hex() for h in cache_ev.offloaded_ssd]
         # Recent step-time p99 rides the existing latency payload so the
         # service watchdog can baseline per-instance step regressions;
         # the bucket baseline commits only on a delivered beat (below).
         self._latency.step_ms_p99, step_baseline = \
             self._recent_step_p99(rt)
+        # Cost-model signals for the service's fetch-vs-recompute
+        # planner (docs/KV_CACHE.md): measured prefill throughput and
+        # measured KV-transfer bandwidth. 0.0 = no signal yet (the
+        # planner falls back to XLLM_KV_FETCH_{TOKS,GBPS}).
+        self._latency.prefill_tok_s = (
+            self._prefill_tok_cum / self._prefill_s_cum
+            if self._prefill_s_cum > 0 else 0.0)
+        self._latency.kv_gbps = (
+            self.kv_migration_bytes / self.kv_migration_seconds / 1e9
+            if self.kv_migration_seconds > 0 else 0.0)
         # Finished request spans ride the heartbeat to the service's
         # span ring (same correlation id); an undelivered batch is
         # requeued so the next beat retries it.
@@ -2910,6 +3278,8 @@ class Worker:
             name=self.name, instance_type=self.instance_type,
             load=load, latency=self._latency,
             cache_stored=stored, cache_removed=removed,
+            cache_offloaded=offloaded,
+            cache_offloaded_ssd=offloaded_ssd,
             model_states=model_states, spans=span_batch)
         self._latency = LatencyMetrics()
         try:
@@ -2918,9 +3288,13 @@ class Worker:
                                   timeout=10.0)
         except Exception:
             self.spans.requeue(span_batch)
+            if cache_ev is not None and not cache_ev.empty:
+                self._hb_cache_pending = cache_ev
             raise
         if status != 200:
             self.spans.requeue(span_batch)
+            if cache_ev is not None and not cache_ev.empty:
+                self._hb_cache_pending = cache_ev
         else:
             self._hb_step_cum = step_baseline
         return status == 200
@@ -2968,6 +3342,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # attention grid cells of 64 (per-cell overhead is first-order at
     # large batch — docs/PERF_NOTES.md round 3).
     parser.add_argument("--page-size", type=int, default=128)
+    # Must equal the service's --murmur-hash3-seed or this worker's
+    # prefix-cache digests are quarantined at registration
+    # (cache_digest_mismatch, docs/KV_CACHE.md).
+    parser.add_argument("--murmur-seed", type=int, default=0)
     parser.add_argument("--num-pages", type=int, default=256)
     parser.add_argument("--max-model-len", type=int, default=2048)
     parser.add_argument("--max-batch-size", type=int, default=8)
@@ -3024,7 +3402,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         model_dir=args.model_dir,
         heartbeat_interval_s=args.heartbeat_interval_s,
         lease_ttl_s=3 * args.heartbeat_interval_s,
-        enable_profiling=args.enable_profiling, warmup=args.warmup)
+        enable_profiling=args.enable_profiling, warmup=args.warmup,
+        murmur_seed=args.murmur_seed)
     worker = Worker(opts, store, engine_cfg=engine_cfg, mesh=mesh).start()
     logger.info("worker %s serving model %s (type %s)",
                 worker.name, args.model, args.instance_type)

@@ -71,6 +71,12 @@ class InstanceState:
         self.model_states: Dict[str, str] = {}
         self.last_heartbeat = time.monotonic()
         self.flipped_from: Optional[InstanceType] = None
+        # Block-hash contract: False when the worker advertised a
+        # page_size/hash_seed pair that diverges from the service's
+        # (block_size, murmur seed) — its cache digests can never match
+        # service-side digests, so the cluster index must not ingest
+        # them and the fetch planner must not elect it a holder.
+        self.digest_compatible = True
 
     @property
     def name(self) -> str:
@@ -236,6 +242,32 @@ class InstanceMgr:
     def _register(self, meta: InstanceMetaInfo,
                   from_bootstrap: bool = False) -> InstanceState:
         inst = InstanceState(meta)
+        # Block-hash single source of truth (docs/KV_CACHE.md): a worker
+        # whose engine page size / murmur seed diverges from the
+        # service's (block_size, seed) reports digests that can NEVER
+        # match service-side digests — cache-aware scores for it would
+        # be garbage and a fetch from it would adopt wrong-keyed blocks.
+        # Fail loud (event + log) and quarantine its cache reporting;
+        # the instance still serves traffic (correctness is unaffected,
+        # only prefix reuse is off for it).
+        if meta.page_size and (
+                meta.page_size != self.opts.block_size
+                or meta.hash_seed != self.opts.murmur_hash3_seed):
+            inst.digest_compatible = False
+            logger.error(
+                "instance %s advertises block hashing (page_size=%d, "
+                "seed=%d) incompatible with the service's (block_size="
+                "%d, seed=%d): its prefix-cache digests are quarantined "
+                "— fix block_size/page_size to re-enable prefix reuse",
+                meta.name, meta.page_size, meta.hash_seed,
+                self.opts.block_size, self.opts.murmur_hash3_seed)
+            if self.events is not None:
+                self.events.emit(
+                    "cache_digest_mismatch", instance=meta.name,
+                    worker_page_size=meta.page_size,
+                    worker_hash_seed=meta.hash_seed,
+                    service_block_size=self.opts.block_size,
+                    service_hash_seed=self.opts.murmur_hash3_seed)
         self._instances[meta.name] = inst
         itype = meta.instance_type
         if itype == InstanceType.MIX:
@@ -359,6 +391,14 @@ class InstanceMgr:
     def get(self, name: str) -> Optional[InstanceState]:
         with self._lock:
             return self._instances.get(name)
+
+    def digest_ok(self, name: str) -> bool:
+        """True when ``name`` is registered AND its block-hash contract
+        matches the service's (see ``_register``). Gates cache-delta
+        ingestion and holder election."""
+        with self._lock:
+            inst = self._instances.get(name)
+            return inst is not None and inst.digest_compatible
 
     def names(self) -> List[str]:
         with self._lock:

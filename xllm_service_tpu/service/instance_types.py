@@ -44,6 +44,18 @@ class InstanceMetaInfo:
         dataclasses.field(default_factory=list)
     # Serverless memory accounting (GB) for the multi-model allocator.
     memory_budget_gb: float = 60.0
+    # Block-hash contract advertisement (docs/KV_CACHE.md): the engine's
+    # KV page size (tokens per content-addressed block) and murmur hash
+    # seed. The service's GlobalKVCacheMgr keys on (block_size, seed) —
+    # if a worker's pair diverges, its reported digests can NEVER match
+    # service-side digests and cache-aware routing scores it on garbage;
+    # InstanceMgr fails loud (event + log) on mismatch. 0 page_size =
+    # not advertised (pre-contract worker).
+    page_size: int = 0
+    hash_seed: int = 0
+    # Bytes of one content-addressed KV block (k+v, all layers) — the
+    # fetch-vs-recompute cost model's bytes term.
+    kv_block_bytes: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -71,6 +83,9 @@ class InstanceMetaInfo:
             tpot_profiling_data=[tuple(x) for x in
                                  d.get("tpot_profiling_data", [])],
             memory_budget_gb=d.get("memory_budget_gb", 60.0),
+            page_size=int(d.get("page_size", 0) or 0),
+            hash_seed=int(d.get("hash_seed", 0) or 0),
+            kv_block_bytes=int(d.get("kv_block_bytes", 0) or 0),
         )
 
 
@@ -110,6 +125,14 @@ class LatencyMetrics:
     recent_max_ttft_ms: float = 0.0
     recent_max_tbt_ms: float = 0.0
     step_ms_p99: float = 0.0
+    # Measured prefill throughput (tokens/s, cumulative over this
+    # worker's prefill steps) — the fetch-vs-recompute cost model's
+    # recompute-rate term. 0.0 = no prefill ran yet (no signal).
+    prefill_tok_s: float = 0.0
+    # Measured KV-transfer bandwidth (GB/s) from this worker's actual
+    # migrations/probes — the cost model's fetch-rate term. 0.0 = never
+    # measured (the service falls back to XLLM_KV_FETCH_GBPS).
+    kv_gbps: float = 0.0
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -165,8 +188,15 @@ class Heartbeat:
     load: LoadMetrics = dataclasses.field(default_factory=LoadMetrics)
     latency: LatencyMetrics = dataclasses.field(default_factory=LatencyMetrics)
     # Prefix-cache delta: hex digests stored/removed since last beat.
+    # ``cache_offloaded`` = spilled HBM→host-DRAM (still servable from
+    # this worker, one tier down); ``cache_offloaded_ssd`` = demoted
+    # DRAM→disk — the deltas that make the cluster index's DRAM/SSD
+    # tier slots real (docs/KV_CACHE.md).
     cache_stored: List[str] = dataclasses.field(default_factory=list)
     cache_removed: List[str] = dataclasses.field(default_factory=list)
+    cache_offloaded: List[str] = dataclasses.field(default_factory=list)
+    cache_offloaded_ssd: List[str] = dataclasses.field(
+        default_factory=list)
     # Per-model sleep/wake state for the serverless layer.
     model_states: Dict[str, str] = dataclasses.field(default_factory=dict)
     # Finished request-span timelines since the last beat
@@ -184,6 +214,8 @@ class Heartbeat:
             "latency": self.latency.to_json(),
             "cache_stored": self.cache_stored,
             "cache_removed": self.cache_removed,
+            "cache_offloaded": self.cache_offloaded,
+            "cache_offloaded_ssd": self.cache_offloaded_ssd,
             "model_states": self.model_states,
             "spans": self.spans,
             "timestamp": self.timestamp,
@@ -202,6 +234,8 @@ class Heartbeat:
             latency=LatencyMetrics.from_json(d.get("latency")),
             cache_stored=list(d.get("cache_stored", [])),
             cache_removed=list(d.get("cache_removed", [])),
+            cache_offloaded=list(d.get("cache_offloaded", [])),
+            cache_offloaded_ssd=list(d.get("cache_offloaded_ssd", [])),
             model_states=dict(d.get("model_states", {})),
             spans=list(d.get("spans", [])),
             timestamp=d.get("timestamp", time.time()),

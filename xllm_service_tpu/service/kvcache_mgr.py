@@ -112,8 +112,20 @@ class GlobalKVCacheMgr:
         blocks). An instance's score counts only its *contiguous* prefix
         blocks — a hole in its copy ends its usable prefix, matching how the
         worker can only reuse contiguous leading pages."""
+        matched, scores, _ = self.match_prefix_tiers(token_ids)
+        return matched, scores
+
+    def match_prefix_tiers(self, token_ids: List[int]
+                           ) -> Tuple[int, Dict[str, float],
+                                      Dict[str, List[str]]]:
+        """``match()`` plus the evidence the fetch-vs-recompute planner
+        needs: per instance, the best storage tier of EVERY block in its
+        contiguous leading run (``holders[inst][i]`` = tier of block i).
+        ``len(holders[inst])`` is the instance's usable prefix in blocks
+        — unweighted, unlike the routing score."""
         hashes = prefix_block_hashes(token_ids, self.block_size, self.seed)
         scores: Dict[str, float] = {}
+        holders: Dict[str, List[str]] = {}
         alive: Dict[str, bool] = {}
         matched = 0
         with self._lock:
@@ -122,22 +134,24 @@ class GlobalKVCacheMgr:
                 if loc is None or loc.empty:
                     break
                 matched += 1
-                block_holders: Dict[str, float] = {}
+                block_holders: Dict[str, Tuple[float, str]] = {}
                 for tier in _TIERS:
                     w = TIER_WEIGHT[tier]
                     for inst in loc.tiers[tier]:
-                        block_holders[inst] = max(
-                            block_holders.get(inst, 0.0), w)
-                for inst, w in block_holders.items():
+                        cur = block_holders.get(inst)
+                        if cur is None or w > cur[0]:
+                            block_holders[inst] = (w, tier)
+                for inst, (w, tier) in block_holders.items():
                     # An instance first seen past block 0 has a hole at the
                     # front — its copy is not a usable leading prefix.
                     if alive.get(inst, idx == 0):
                         scores[inst] = scores.get(inst, 0.0) + w
+                        holders.setdefault(inst, []).append(tier)
                         alive[inst] = True
                 for inst in list(alive):
                     if inst not in block_holders:
                         alive[inst] = False
-        return matched, scores
+        return matched, scores, holders
 
     def num_blocks(self) -> int:
         with self._lock:
@@ -149,21 +163,42 @@ class GlobalKVCacheMgr:
     def record_updated_kvcaches(self, instance: str,
                                 stored: Iterable[bytes] = (),
                                 removed: Iterable[bytes] = (),
-                                offloaded: Iterable[bytes] = ()) -> None:
+                                offloaded: Iterable[bytes] = (),
+                                offloaded_ssd: Iterable[bytes] = ()
+                                ) -> None:
         """Apply one worker's cache delta (global_kvcache_mgr.cpp:175-223).
-        ``offloaded`` demotes HBM→DRAM (the TPU worker's host-RAM offload
-        tier); ``removed`` drops the instance from every tier."""
+        ``stored`` means the block is in HBM *now* — a restore from the
+        worker's spill tier re-stores it, so any DRAM/SSD claim this
+        instance held is superseded (the worker's tier consumed its
+        copy). ``offloaded`` demotes HBM→DRAM (the TPU worker's host-RAM
+        spill tier); ``offloaded_ssd`` demotes DRAM→SSD (disk tier);
+        ``removed`` drops the instance from every tier.
+
+        Cross-list ordering within one delta is lost on the wire, so
+        demotions apply BEFORE ``stored``: a block that spilled and was
+        restored inside one beat (the common compound) ends HBM, which
+        is its true final state."""
         with self._lock:
-            for h in stored:
-                loc = self._index.setdefault(h, CacheLocations())
-                loc.tiers[TIER_HBM].add(instance)
-                self._mark_dirty(h, loc)
             for h in offloaded:
                 loc = self._index.get(h)
                 if loc is None:
                     continue
                 loc.tiers[TIER_HBM].discard(instance)
                 loc.tiers[TIER_DRAM].add(instance)
+                self._mark_dirty(h, loc)
+            for h in offloaded_ssd:
+                loc = self._index.get(h)
+                if loc is None:
+                    continue
+                loc.tiers[TIER_HBM].discard(instance)
+                loc.tiers[TIER_DRAM].discard(instance)
+                loc.tiers[TIER_SSD].add(instance)
+                self._mark_dirty(h, loc)
+            for h in stored:
+                loc = self._index.setdefault(h, CacheLocations())
+                loc.tiers[TIER_HBM].add(instance)
+                loc.tiers[TIER_DRAM].discard(instance)
+                loc.tiers[TIER_SSD].discard(instance)
                 self._mark_dirty(h, loc)
             for h in removed:
                 loc = self._index.get(h)

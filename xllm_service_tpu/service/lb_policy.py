@@ -112,7 +112,14 @@ class CacheAwareRoutingPolicy(LoadBalancePolicy):
 
     def select_instances_pair(self, token_ids, audit=None):
         total_blocks = max(len(token_ids) // self.block_size, 1)
-        _, scores = self.kvcache.match(token_ids)
+        matched, scores, holders = self.kvcache.match_prefix_tiers(
+            token_ids)
+        if audit is not None:
+            # Hand the walk's full evidence to the scheduler's
+            # fetch-vs-recompute planner (it pops this before the audit
+            # reaches the span): ONE prefix match per schedule(), not
+            # one for scoring and another for planning.
+            audit["_match_tiers"] = (matched, holders)
         prefill = self._pick(self.mgr.prefill_instances(), scores,
                              total_blocks, audit=audit, role="prefill")
         decode = self._pick(self.mgr.decode_instances(), scores,

@@ -183,6 +183,16 @@ class RecoveryManager:
                     break
                 last_rejected = name
                 continue
+            if routing.kv_fetch and \
+                    routing.kv_fetch.get("holder") in exclude:
+                # The freshly planned fetch elects an instance this
+                # walk already failed AWAY from (typically the dead
+                # worker, whose published prefix digests outlive it
+                # until lease expiry): executing it would stall the
+                # survivor's recovery TTFT on the fetch timeout before
+                # the recompute fallback. Drop the plan, keep the
+                # placement.
+                routing.kv_fetch = None
             return self._adopt_routing(req, fwd, routing, old, n_prompt)
         # Policy fallback: a deterministic policy can keep electing an
         # excluded instance (e.g. the dead one still prefix-matches the

@@ -21,6 +21,7 @@ Rebuild of ``scheduler/scheduler.{h,cpp}`` (SURVEY.md §2 #4, §3.2-3.5):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 
 
@@ -43,6 +44,13 @@ from xllm_service_tpu.utils.types import (
 from xllm_service_tpu.utils.locks import make_lock
 
 logger = logging.getLogger(__name__)
+
+
+def _env_float(raw: Optional[str], default: float) -> float:
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
 
 
 class _TrackedRequest:
@@ -130,6 +138,26 @@ class Scheduler:
         self.instance_mgr.on_removed = self._on_instance_removed
         self.lb_policy = create_policy(opts, self.instance_mgr,
                                        self.kvcache_mgr)
+
+        # Fetch-vs-recompute cost model knobs (docs/KV_CACHE.md). Read
+        # once at construction — the planner runs per request. Direct
+        # os.environ reads with literal names so the flag-registry
+        # xlint rule sees every one.
+        self.kv_fetch_enabled = os.environ.get(
+            "XLLM_KV_FETCH", "1").strip() not in ("0", "false", "no")
+        # Fallbacks for the measured terms when no signal arrived yet:
+        # per-pair bandwidth (GB/s; 1.0 ≈ the round-6 measured direct
+        # migration rate) and prefill throughput (tok/s).
+        self.kv_fetch_gbps_default = _env_float(
+            os.environ.get("XLLM_KV_FETCH_GBPS"), 1.0)
+        self.kv_fetch_toks_default = _env_float(
+            os.environ.get("XLLM_KV_FETCH_TOKS"), 4000.0)
+        # Fixed per-fetch overhead (handshake + scatter) and the minimum
+        # fetched-block count worth that overhead.
+        self.kv_fetch_overhead_ms = _env_float(
+            os.environ.get("XLLM_KV_FETCH_OVERHEAD_MS"), 5.0)
+        self.kv_fetch_min_blocks = int(_env_float(
+            os.environ.get("XLLM_KV_FETCH_MIN_BLOCKS"), 1))
 
         self._addresses: Optional[Dict[str, str]] = None
         self._requests: Dict[str, _TrackedRequest] = {}
@@ -305,6 +333,16 @@ class Scheduler:
                               "no prefill instance available"), Routing()
             routing = Routing(prefill_name=prefill,
                               decode_name=decode or prefill)
+        # Cross-worker cached-block fetch plan: when the placed prefill
+        # target is not the (best) holder of this prompt's cached
+        # prefix, decide fetch / partial-fetch / recompute on the
+        # measured cost terms; the decision and both terms land in the
+        # routing audit (attrs.schedule_decision) so wins are
+        # attributed, not asserted.
+        if not request.mm_inputs:
+            routing.kv_fetch = self._plan_kv_fetch(
+                request.token_ids, routing.prefill_name, audit,
+                model=request.model)
         self._record_decision(request, audit)
 
         # EPD: route the encode stage to a dedicated ENCODE instance when
@@ -320,6 +358,150 @@ class Scheduler:
             len(request.token_ids))
         return Status(), routing
 
+    # Tier-dependent effective-rate discount on the fetch term: HBM and
+    # DRAM blocks stream at the measured wire rate (the holder gathers /
+    # reads host RAM); SSD blocks pay the holder's disk read first.
+    _FETCH_TIER_RATE = {"hbm": 1.0, "dram": 1.0, "ssd": 0.25}
+
+    def _count_fetch_verdict(self, verdict: str) -> None:
+        if self.obs is not None:
+            self.obs.counter(
+                "xllm_kv_fetch_decisions_total",
+                "fetch-vs-recompute planner outcomes for prompts with a "
+                "nonzero cluster prefix match (docs/KV_CACHE.md)",
+                labelnames=("verdict",)).inc(verdict=verdict)
+
+    def _plan_kv_fetch(self, token_ids: List[int], prefill_name: str,
+                       audit: Dict[str, Any], model: str = ""
+                       ) -> Optional[Dict[str, Any]]:
+        """Fetch-vs-recompute cost model (NetKV-style bandwidth-aware
+        choice; PAPERS.md 2606.03910): matched tokens ÷ measured prefill
+        tok/s (recompute) vs matched bytes ÷ measured per-pair bandwidth
+        (fetch), per block so a tier change mid-prefix can cut the fetch
+        short (partial). Returns the Routing.kv_fetch plan, or None for
+        recompute / local-hit / nothing-cached. Observe-only beyond the
+        plan: the audit gains ``kv_fetch`` with the verdict and both
+        cost terms. Reuses the cache-aware policy's index walk when the
+        audit carries one (``_match_tiers``) — one prefix match per
+        schedule(), not two."""
+        if not self.kv_fetch_enabled or not prefill_name \
+                or not token_ids:
+            return None
+        if not self.instance_mgr.digest_ok(prefill_name):
+            # The TARGET's hashing is quarantined: any plan it executes
+            # computes mismatched digests the holder can never serve —
+            # a guaranteed 404 added to TTFT on every warm prompt.
+            return None
+        pre = audit.pop("_match_tiers", None)
+        if pre is not None:
+            matched, holders = pre
+        else:
+            matched, _scores, holders = \
+                self.kvcache_mgr.match_prefix_tiers(token_ids)
+        if not matched:
+            return None         # cold prompt: no decision to attribute
+        # The digest index is MODEL-BLIND (digests hash token ids only)
+        # while KV bytes are model-specific: a holder is eligible only
+        # when its PRIMARY model — the one whose engine feeds its cache
+        # heartbeats — is the model this request runs (the target's
+        # primary when the request names none). Same-shape fine-tunes
+        # would otherwise swap KV silently.
+        target_inst = self.instance_mgr.get(prefill_name)
+        want_model = model or (
+            target_inst.meta.models[0]
+            if target_inst and target_inst.meta.models else "")
+        if not want_model:
+            return None
+        # Liveness: a dead-but-lease-alive holder stalls the requester
+        # for the whole fetch timeout (the mid-stream-recovery reroute
+        # case) — on the master, whose heartbeat clock is live, skip
+        # holders that stopped beating. Replicas learn load via the
+        # master's uploads, not heartbeats, so their clock would lie.
+        now = time.monotonic()
+        stale_s = 3.0 * max(self.opts.heartbeat_interval_s, 0.1)
+        local_blocks = len(holders.get(prefill_name, ()))
+        best_name: Optional[str] = None
+        best_tiers: List[str] = []
+        for name, tiers in holders.items():
+            if name == prefill_name or len(tiers) <= len(best_tiers):
+                continue
+            inst = self.instance_mgr.get(name)
+            if inst is None or not inst.digest_compatible:
+                continue
+            if not (inst.meta.models
+                    and inst.meta.models[0] == want_model):
+                continue
+            if self.is_master and now - inst.last_heartbeat > stale_s:
+                continue
+            best_name, best_tiers = name, list(tiers)
+        bs = max(self.opts.block_size, 1)
+        plan: Optional[Dict[str, Any]] = None
+        terms: Dict[str, Any] = {
+            "holder": best_name, "holder_blocks": len(best_tiers),
+            "local_blocks": local_blocks, "matched_blocks": matched,
+            "block_size": bs,
+        }
+        if best_name is None or len(best_tiers) <= local_blocks:
+            verdict = "local" if local_blocks else "recompute"
+            if verdict == "recompute":
+                terms["reason"] = "no_remote_holder"
+        else:
+            holder_inst = self.instance_mgr.get(best_name)
+            target_inst = self.instance_mgr.get(prefill_name)
+            holder_addr = self.instance_mgr.address_of(best_name) or ""
+            block_bytes = (holder_inst.meta.kv_block_bytes
+                           if holder_inst else 0)
+            # max() guards both terms: XLLM_KV_FETCH_GBPS=0 (or a
+            # zeroed fallback) must degrade to an absurd fetch price —
+            # i.e. verdict recompute — never a ZeroDivisionError inside
+            # schedule().
+            gbps = max((holder_inst.latency.kv_gbps
+                        if holder_inst else 0.0)
+                       or self.kv_fetch_gbps_default, 1e-9)
+            tok_s = (target_inst.latency.prefill_tok_s
+                     if target_inst else 0.0) or self.kv_fetch_toks_default
+            recompute_ms_per_block = bs / max(tok_s, 1e-6) * 1e3
+            terms.update(bandwidth_gbps=round(gbps, 3),
+                         prefill_tok_s=round(tok_s, 1),
+                         block_bytes=block_bytes)
+            if not block_bytes or not holder_addr:
+                verdict = "recompute"
+                terms["reason"] = ("no_block_bytes" if not block_bytes
+                                   else "holder_unreachable")
+            else:
+                # Walk the holder's surplus blocks; stop at the first
+                # block whose (tier-discounted) fetch cost loses to
+                # recomputing it.
+                fetch_ms = 0.0
+                n_fetch = 0
+                for tier in best_tiers[local_blocks:]:
+                    rate = self._FETCH_TIER_RATE.get(tier, 1.0)
+                    blk_ms = block_bytes / (gbps * 1e9 * rate) * 1e3
+                    if blk_ms >= recompute_ms_per_block:
+                        break
+                    fetch_ms += blk_ms
+                    n_fetch += 1
+                recompute_ms = n_fetch * recompute_ms_per_block
+                terms.update(fetch_ms=round(
+                    fetch_ms + self.kv_fetch_overhead_ms, 3),
+                    recompute_ms=round(recompute_ms, 3))
+                surplus = len(best_tiers) - local_blocks
+                if n_fetch < self.kv_fetch_min_blocks or \
+                        fetch_ms + self.kv_fetch_overhead_ms \
+                        >= recompute_ms:
+                    verdict = "recompute"
+                    terms["reason"] = "fetch_loses"
+                else:
+                    verdict = "fetch" if n_fetch == surplus else "partial"
+                    plan = {"holder": best_name,
+                            "holder_addr": holder_addr,
+                            "blocks": local_blocks + n_fetch,
+                            "block_size": bs}
+        terms["verdict"] = verdict
+        audit["kv_fetch"] = terms
+        self._count_fetch_verdict(verdict)
+        return plan
+
     def _record_decision(self, request: Request,
                          audit: Dict[str, Any]) -> None:
         """Attach the routing audit to the request's span and aggregate
@@ -329,6 +511,9 @@ class Scheduler:
         ``redispatch`` stage event keeps the history)."""
         if not audit:
             return
+        # Planner working state (popped there on the normal path; a
+        # multimodal request skips the planner) — never span material.
+        audit.pop("_match_tiers", None)
         if self.spans is not None:
             self.spans.annotate(request.service_request_id,
                                 schedule_decision=audit)
@@ -630,11 +815,22 @@ class Scheduler:
     # ------------------------------------------------------------------
     def handle_instance_heartbeat(self, hb: Heartbeat) -> bool:
         registered = self.instance_mgr.on_heartbeat(hb)
-        if registered and (hb.cache_stored or hb.cache_removed):
+        if registered and (hb.cache_stored or hb.cache_removed
+                           or hb.cache_offloaded
+                           or hb.cache_offloaded_ssd):
+            if not self.instance_mgr.digest_ok(hb.name):
+                # Quarantined block hashing (cache_digest_mismatch):
+                # digests from this worker can never match service-side
+                # digests — ingesting them would poison match scores.
+                return registered
             self.kvcache_mgr.record_updated_kvcaches(
                 hb.name,
                 stored=[bytes.fromhex(h) for h in hb.cache_stored],
-                removed=[bytes.fromhex(h) for h in hb.cache_removed])
+                removed=[bytes.fromhex(h) for h in hb.cache_removed],
+                offloaded=[bytes.fromhex(h)
+                           for h in hb.cache_offloaded],
+                offloaded_ssd=[bytes.fromhex(h)
+                               for h in hb.cache_offloaded_ssd])
         return registered
 
     # ------------------------------------------------------------------

@@ -16,6 +16,12 @@ increasing):
      5  worker.hb                       — serializes heartbeat build+send
     10  scheduler.req, worker.live      — request registries
     20  worker.engine                   — engine step/submit
+    22  kv_cache.tier                   — host-DRAM/disk KV spill tier
+                                          (never calls out; readable
+                                          under worker.engine)
+    25  worker.kvfetch                  — staged cross-worker fetch
+                                          wire tickets (guards the dict
+                                          only; releases happen outside)
     30  instance_mgr                    — instance books (re-entrant)
     35  kvcache_mgr                     — global prefix index
     50  (reserved: coordination store — uses a Condition-wrapped RLock,

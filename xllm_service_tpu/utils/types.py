@@ -191,18 +191,30 @@ class Routing:
     prefill_name: str = ""
     decode_name: str = ""
     encode_name: str = ""
+    # Cross-worker cached-block fetch plan (docs/KV_CACHE.md): when the
+    # scheduler places a request on a non-holder with a nonzero cluster
+    # prefix match AND the fetch-vs-recompute cost model says fetching
+    # wins, this carries {"holder", "holder_addr", "blocks",
+    # "block_size"} — the prefill worker pulls those leading KV blocks
+    # from the holder and starts prefill at the first uncached token.
+    # None = recompute (the always-correct default).
+    kv_fetch: Optional[Dict[str, Any]] = None
 
     def to_json(self) -> Dict[str, Any]:
-        return {"prefill_name": self.prefill_name,
-                "decode_name": self.decode_name,
-                "encode_name": self.encode_name}
+        out = {"prefill_name": self.prefill_name,
+               "decode_name": self.decode_name,
+               "encode_name": self.encode_name}
+        if self.kv_fetch:
+            out["kv_fetch"] = dict(self.kv_fetch)
+        return out
 
     @classmethod
     def from_json(cls, d: Optional[Dict[str, Any]]) -> "Routing":
         if not d:
             return cls()
         return cls(d.get("prefill_name", ""), d.get("decode_name", ""),
-                   d.get("encode_name", ""))
+                   d.get("encode_name", ""),
+                   kv_fetch=d.get("kv_fetch") or None)
 
 
 @dataclasses.dataclass
