@@ -719,3 +719,129 @@ class TestAdmissionControl:
         finally:
             gate.set()
             srv.stop()
+
+
+class TestConcurrencyFindings:
+    """Regression pins for the true findings the interprocedural xlint
+    concurrency passes (rules 11–13) surfaced in this tree — see
+    docs/STATIC_ANALYSIS.md §11–13 and docs/CONCURRENCY.md.
+
+    - XLINT13-002: ``InstanceMgr._bootstrap`` registered instances with
+      NO lock while the store watches (registered first, no event gap)
+      could already be dispatching ``_on_instance_event`` on the watch
+      thread — corrupting ``_instances``/``_mix_names``/role arrays.
+    - XLINT13-003: same shape for ``GlobalKVCacheMgr._bootstrap``
+      writing ``_index`` against ``_on_watch``.
+    - XLINT12-001: ``on_heartbeat``'s store read-through (network I/O
+      on the etcd/remote stores) ran INSIDE the instance lock on the
+      RPC fan-in path, stalling every routing thread behind a store
+      RPC.
+    """
+
+    def test_instance_bootstrap_registers_under_lock(self, store,
+                                                     monkeypatch):
+        from xllm_service_tpu.utils import locks
+        register_worker(store, "w1", InstanceType.PREFILL)
+        seen = []
+        orig = InstanceMgr._register
+
+        def spy(self, meta, from_bootstrap=False):
+            seen.append([n for n, _r in locks._held()])
+            return orig(self, meta, from_bootstrap=from_bootstrap)
+
+        monkeypatch.setattr(InstanceMgr, "_register", spy)
+        mgr = InstanceMgr(opts_(), store, control=FakeControl())
+        try:
+            assert seen, "bootstrap did not adopt the stored instance"
+            assert all("instance_mgr" in held for held in seen), \
+                f"bootstrap registration outside the lock: {seen}"
+            assert mgr.prefill_instances() == ["w1"]
+        finally:
+            mgr.close()
+
+    def test_kvcache_bootstrap_applies_under_lock(self, store,
+                                                  monkeypatch):
+        from xllm_service_tpu.utils import locks
+        tokens = list(range(8))
+        h = prefix_block_hashes(tokens, 4)
+        master = GlobalKVCacheMgr(store, block_size=4, is_master=True)
+        master.record_updated_kvcaches("w1", stored=h)
+        master.upload_kvcache()
+        seen = []
+        orig = GlobalKVCacheMgr._apply_locations
+
+        def spy(self, digest, val):
+            seen.append([n for n, _r in locks._held()])
+            return orig(self, digest, val)
+
+        monkeypatch.setattr(GlobalKVCacheMgr, "_apply_locations", spy)
+        replica = GlobalKVCacheMgr(store, block_size=4, is_master=False)
+        assert seen, "bootstrap did not load the persisted index"
+        assert all("kvcache_mgr" in held for held in seen), \
+            f"bootstrap index write outside the lock: {seen}"
+        assert replica.match(tokens)[0] == 2
+
+    def test_serverless_staging_runs_outside_lock(self, store):
+        """XLINT12-002: the serverless /fork_master staging control
+        call (up to the 120 s control timeout) ran inside the instance
+        lock via _register on the heartbeat path — every routing
+        thread would stall behind one slow worker. The control round
+        trip must run unlocked; only the state flip goes back under
+        the lock."""
+        from xllm_service_tpu.utils import locks
+        held_at_control = []
+
+        def control(address, path, body):
+            held_at_control.append(
+                [n for n, _r in locks._held()])
+            return 200, {"ok": True}
+
+        mgr = InstanceMgr(opts_(), store, control=control,
+                          serverless_models=["aux-model"])
+        try:
+            register_worker(store, "w1", InstanceType.PREFILL)
+            assert wait_until(lambda: "w1" in mgr._pending)
+            assert mgr.on_heartbeat(Heartbeat(
+                name="w1", instance_type=InstanceType.PREFILL))
+            assert held_at_control, "staging control call never ran"
+            assert all("instance_mgr" not in held
+                       for held in held_at_control), \
+                f"control I/O under the instance lock: {held_at_control}"
+            assert mgr.get("w1").model_states["aux-model"] == \
+                MODEL_ASLEEP
+        finally:
+            mgr.close()
+
+    def test_heartbeat_readthrough_runs_outside_lock(self, store,
+                                                     monkeypatch):
+        from xllm_service_tpu.utils import locks
+        mgr = InstanceMgr(opts_(), store, control=FakeControl())
+        try:
+            register_worker(store, "w9", InstanceType.PREFILL)
+            assert wait_until(lambda: "w9" in mgr._pending)
+            # Simulate the heartbeat-raced-ahead-of-the-watch window:
+            # nothing pending, nothing registered → read-through path.
+            with mgr._lock:
+                mgr._pending.pop("w9")
+            held_at_read = []
+            real = store.get_json
+
+            def spy(key):
+                held_at_read.append([n for n, _r in locks._held()])
+                return real(key)
+
+            monkeypatch.setattr(store, "get_json", spy)
+            assert mgr.on_heartbeat(Heartbeat(
+                name="w9", instance_type=InstanceType.PREFILL))
+            assert held_at_read, "read-through did not happen"
+            assert all("instance_mgr" not in held
+                       for held in held_at_read), \
+                f"store I/O under the instance lock: {held_at_read}"
+            assert mgr.prefill_instances() == ["w9"]
+            # A REMOVED instance must still be refused (the read-through
+            # restructure keeps the removed re-check under the lock).
+            mgr.remove_instance("w9")
+            assert not mgr.on_heartbeat(Heartbeat(
+                name="w9", instance_type=InstanceType.PREFILL))
+        finally:
+            mgr.close()

@@ -136,3 +136,60 @@ finally:
             outs[tp] = line[len("TEXT:"):]
         assert outs[1], "empty completion — parity would be vacuous"
         assert outs[1] == outs[2], outs
+
+
+class TestRetargetRaceRegression:
+    """XLINT13-001 (xlint thread-root-race): the (service_addr,
+    config_stale) pair is written from BOTH the store watch thread
+    (_on_master_addr → _retarget) and the heartbeat thread. Before the
+    worker.addr lock, the hb loop's `stale = not fetched` could clobber
+    a retarget's stale=True landing mid-fetch — the worker then never
+    re-fetched the NEW master's /rpc/config."""
+
+    def _bare_worker(self):
+        from xllm_service_tpu.runtime.worker import Worker
+        from xllm_service_tpu.utils.locks import make_lock
+        w = Worker.__new__(Worker)
+        w._addr_mu = make_lock("worker.addr", 89)
+        w._service_addr = "a:1"
+        w._service_config_stale = False
+        return Worker, w
+
+    def test_retarget_is_compare_and_swap(self):
+        Worker, w = self._bare_worker()
+        assert Worker._retarget(w, {"rpc": "b:2", "service_id": "s"})
+        assert w._service_addr == "b:2"
+        assert w._service_config_stale is True
+        # same address again: no-op, stale untouched
+        w._service_config_stale = False
+        assert not Worker._retarget(w, {"rpc": "b:2"})
+        assert w._service_config_stale is False
+        assert not Worker._retarget(w, {})        # no rpc key
+        assert not Worker._retarget(w, None)      # no advert at all
+
+    def test_mid_fetch_retarget_keeps_stale(self):
+        """The exact lost-update: fetch succeeds for the OLD address
+        while a takeover retargets mid-flight — the retarget's
+        stale=True must survive the fetch result."""
+        Worker, w = self._bare_worker()
+
+        def fetch_with_concurrent_takeover():
+            Worker._retarget(w, {"rpc": "c:3"})   # lands mid-fetch
+            return True                            # fetch of a:1 "succeeded"
+
+        w._fetch_service_config = fetch_with_concurrent_takeover
+        Worker._refresh_service_config(w)
+        assert w._service_addr == "c:3"
+        assert w._service_config_stale is True, \
+            "retarget's stale flag was clobbered by the stale fetch"
+
+    def test_refresh_clears_stale_when_stable(self):
+        Worker, w = self._bare_worker()
+        w._service_config_stale = True
+        w._fetch_service_config = lambda: True
+        Worker._refresh_service_config(w)
+        assert w._service_config_stale is False
+        # failed fetch for a live address re-arms the flag
+        w._fetch_service_config = lambda: False
+        Worker._refresh_service_config(w)
+        assert w._service_config_stale is True

@@ -1,0 +1,67 @@
+"""Positive controls for rules 11–13: a deep call-mediated rank
+inversion, blocking ops (direct + transitive) under a ranked lock, and
+attributes mutated from two thread roots without a common guard (plus a
+bad `# guarded-by:` annotation). Never imported."""
+
+import socket
+import threading
+import time
+
+from xllm_service_tpu.utils.locks import make_lock
+
+
+class DeepInversion:
+    def __init__(self):
+        self._hb = make_lock("worker.hb", 5)
+        self._engine = make_lock("worker.engine", 20)
+
+    def root(self):
+        with self._engine:               # rank 20
+            self._mid()                  # …reaches rank 5, two calls deep
+
+    def _mid(self):
+        self._leaf()
+
+    def _leaf(self):
+        with self._hb:
+            pass
+
+
+class BlockingUnderLock:
+    def __init__(self):
+        self._req = make_lock("scheduler.req", 10)
+
+    def direct_sleep(self):
+        with self._req:
+            time.sleep(0.1)              # sleep under a ranked lock
+
+    def transitive_net(self):
+        with self._req:
+            self._do_net()               # reaches network I/O
+
+    def _do_net(self):
+        socket.create_connection(("127.0.0.1", 1))
+
+    def unbounded_result(self, fut):
+        with self._req:
+            fut.result()                 # no timeout under a ranked lock
+
+
+class RaceyCounters:
+    def __init__(self):
+        self._lock = make_lock("worker.live", 10)
+        self._count = 0
+        self._badly_annotated = 0        # guarded-by: no.such.lock
+
+    def start(self):
+        threading.Thread(target=self._loop_a, daemon=True).start()
+        threading.Thread(target=self._loop_b, daemon=True).start()
+
+    def _loop_a(self):
+        self._count += 1                 # bare RMW on one root…
+        self._badly_annotated += 1
+
+    def _loop_b(self):
+        with self._lock:
+            self._count += 1             # …locked on the other: no COMMON guard
+        self._badly_annotated += 1

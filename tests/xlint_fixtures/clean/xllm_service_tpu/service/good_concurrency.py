@@ -1,0 +1,129 @@
+"""Near-misses for rules 11–13 — every pattern here is legal and must
+produce ZERO findings (the false-positive pin). Never imported."""
+
+import queue
+import socket
+import threading
+import time
+
+from xllm_service_tpu.utils.locks import make_lock, make_rlock
+
+
+class IncreasingDepth:
+    """Call-mediated acquisition in INCREASING rank order is the
+    sanctioned pattern."""
+
+    def __init__(self):
+        self._hb = make_lock("worker.hb", 5)
+        self._engine = make_lock("worker.engine", 20)
+        self._leaf_lock = make_lock("misc.pool", 90)
+
+    def root(self):
+        with self._hb:                    # 5
+            self._mid()                   # → 20 → 90: increasing
+
+    def _mid(self):
+        with self._engine:
+            self._leaf()
+
+    def _leaf(self):
+        with self._leaf_lock:
+            pass
+
+
+class ReentrantInterleave:
+    """Re-entering an rlock the thread already owns is legal even with
+    another lock acquired in between — the runtime checker
+    short-circuits before the rank comparison, so neither rule 11 nor
+    the cycle proof may flag it (and no books↔cache cycle may be
+    fabricated from the re-entry)."""
+
+    def __init__(self):
+        self._books = make_rlock("instance_mgr", 30)
+        self._cache = make_lock("kvcache_mgr", 35)
+
+    def outer(self):
+        with self._books:                 # 30 (re-entrant)
+            with self._cache:             # 35: increasing, fine
+                self._reenter()           # re-enters 30: LEGAL
+
+    def _reenter(self):
+        with self._books:
+            pass
+
+    def lexical_form(self):
+        with self._books:
+            with self._cache:
+                with self._books:         # same, spelled lexically
+                    pass
+
+
+class BlockingOutsideLock:
+    def __init__(self):
+        self._req = make_lock("scheduler.req", 10)
+        self._engine = make_lock("worker.engine", 20)
+
+    def sleep_after_release(self):
+        with self._req:
+            x = 1
+        time.sleep(0.01)                  # after release: fine
+        return x
+
+    def net_never_under_lock(self):
+        self._do_net()                    # caller holds nothing
+
+    def _do_net(self):
+        socket.create_connection(("127.0.0.1", 1))
+
+    def bounded_result(self, fut):
+        with self._req:
+            return fut.result(timeout=5)  # bounded: fine
+
+    def device_sync_under_engine(self, arr):
+        with self._engine:
+            # the engine lock's DESIGN is serializing device compute
+            return self._read_host(arr)
+
+    def _read_host(self, arr):
+        return arr
+
+
+class GuardedCounters:
+    """Mutations from two roots with a common guard, a valid
+    `# guarded-by:` declaration, a single-root mutation, and a
+    thread-safe queue — all clean."""
+
+    def __init__(self):
+        self._lock = make_lock("worker.live", 10)
+        self._count = 0
+        self._flag = False                # guarded-by: worker.live
+        self._solo = 0
+        self._q = queue.Queue()
+
+    def start(self):
+        threading.Thread(target=self._loop_a, daemon=True).start()
+        threading.Thread(target=self._loop_b, daemon=True).start()
+
+    def _loop_a(self):
+        with self._lock:
+            self._count += 1
+        self._flag = True                 # declared design: annotation
+        self._solo += 1                   # only THIS root mutates it
+        self._q.put(1)                    # queue.Queue is thread-safe
+
+    def _loop_b(self):
+        self._bump()                      # guard on the CALL PATH
+        self._flag = False
+        self._q.put(2)
+
+    def _bump(self):
+        with self._lock:
+            self._count += 1
+
+    def dynamic(self, fn):
+        fn()                              # unresolvable: pinned, not flagged
+
+    def closure_holder(self):
+        def later():
+            self._solo += 1               # nested def ≠ this scope's locks
+        return later

@@ -14,6 +14,9 @@ Usage::
 
     python -m tools.xlint                 # lint xllm_service_tpu/
     python -m tools.xlint --json          # machine-readable findings
+    python -m tools.xlint --sarif         # SARIF 2.1.0 for CI/editors
+    python -m tools.xlint --changed HEAD~1  # report only changed files
+    python -m tools.xlint --concurrency-report  # roots/lock-sets/proof
     python -m tools.xlint --rule lock-rank path/  # one rule, one subtree
 
 Exit status: 0 clean, 1 findings, 2 usage/config error.
@@ -32,7 +35,7 @@ import ast
 import dataclasses
 import json
 import os
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -212,6 +215,73 @@ def apply_allowlist(findings: List[Finding], rule_name: str,
 
 
 # ---------------------------------------------------------------------------
+# Changed-file resolution (--changed)
+# ---------------------------------------------------------------------------
+
+def changed_files(ref: str, root: str = REPO_ROOT) -> Optional[Set[str]]:
+    """Repo-relative paths differing from ``ref`` (committed diff +
+    untracked). None when git fails (bad ref, not a repo) — the caller
+    turns that into a usage error, not a silently-empty lint."""
+    import subprocess
+    out: Set[str] = set()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    for line in diff.stdout.splitlines():
+        if line.strip():
+            out.add(line.strip())
+    if untracked.returncode == 0:
+        for line in untracked.stdout.splitlines():
+            if line.strip():
+                out.add(line.strip())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SARIF rendering (--sarif)
+# ---------------------------------------------------------------------------
+
+def to_sarif(findings: Sequence[Finding]) -> Dict[str, object]:
+    """SARIF 2.1.0 — one run, findings keyed by the stable allowlist
+    key in partialFingerprints so CI/editor integrations can dedupe
+    across line drift exactly like the allowlists do."""
+    from tools.xlint.rules import RULES
+    return {
+        "version": "2.1.0",
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "xlint",
+                "informationUri":
+                    "docs/STATIC_ANALYSIS.md",
+                "rules": [{
+                    "id": r.name,
+                    "shortDescription": {"text": r.describe},
+                } for r in RULES],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line)},
+                }}],
+                "partialFingerprints": {"xlintKey": f.key},
+            } for f in findings],
+        }],
+    }
+
+
+# ---------------------------------------------------------------------------
 # Runner / CLI
 # ---------------------------------------------------------------------------
 
@@ -250,11 +320,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "(default: xllm_service_tpu)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit one JSON object instead of text lines")
+    ap.add_argument("--sarif", action="store_true",
+                    help="emit SARIF 2.1.0 (CI/editor ingestion)")
+    ap.add_argument("--changed", metavar="REF", default=None,
+                    help="report only findings in files differing from "
+                         "this git ref (analysis still runs "
+                         "whole-program; interprocedural findings — "
+                         "lock cycles, races — are never filtered)")
     ap.add_argument("--rule", action="append", dest="rules",
                     metavar="NAME",
                     help="run only this rule (repeatable)")
     ap.add_argument("--list-rules", action="store_true",
                     help="list rules and exit")
+    ap.add_argument("--concurrency-report", action="store_true",
+                    help="print the whole-program concurrency summary "
+                         "(thread roots, transitive lock-sets, "
+                         "acquires-while-holding edges, acyclicity) "
+                         "as JSON and exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -262,11 +344,45 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"{r.name}: {r.describe}")
         return 0
 
+    if args.concurrency_report:
+        from tools.xlint.concurrency import report
+        tree, errors = load_tree(args.paths)
+        rep = report(tree)
+        rep["parse_errors"] = [f.as_dict() for f in errors]
+        print(json.dumps(rep, indent=2))
+        return 0
+
+    changed: Optional[Set[str]] = None
+    if args.changed is not None:
+        changed = changed_files(args.changed)
+        if changed is None:
+            print(f"xlint: cannot resolve --changed {args.changed!r} "
+                  f"(bad ref or not a git checkout)")
+            return 2
+
     try:
         findings = run(args.paths, rule_names=args.rules)
     except ValueError as e:
         print(f"xlint: {e}")
         return 2
+    if changed is not None:
+        # Whole-program analysis, scoped REPORTING: a finding counts
+        # only if its file (or the allowlist/doc it lives in) changed —
+        # EXCEPT whole-program findings: a lock cycle is attributed to
+        # utils/locks.py, a race to the class's defining module, and a
+        # stale-allowlist finding to the allowlist file — but the edit
+        # that introduces any of them can live in ANY file, so
+        # diff-scoping them would let a deadlock-introducing (or
+        # hygiene-breaking) change pass the CI gate.
+        whole_program = {"lock-order-interprocedural",
+                         "blocking-under-lock", "thread-root-race",
+                         "allowlist"}
+        findings = [f for f in findings
+                    if f.path in changed or f.rule in whole_program]
+
+    if args.sarif:
+        print(json.dumps(to_sarif(findings), indent=2))
+        return 1 if findings else 0
 
     if args.as_json:
         print(json.dumps({

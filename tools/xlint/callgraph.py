@@ -1,0 +1,1253 @@
+"""Whole-program call graph for the concurrency rules (11–13).
+
+One pass over the ``RepoTree`` builds, per function, a *summary* of the
+facts the interprocedural rules consume:
+
+- resolved call edges (module functions, ``self.`` methods over the
+  package's classes, attribute-typed receivers like ``self.engine.step``
+  where ``self.engine = Engine(...)`` in the class, imported names,
+  properties), each with the lexical lock-hold context at the call site;
+- unresolved calls, each pinned with a *reason* (dynamic dispatch
+  through a parameter, external library, unknown receiver) so coverage
+  holes are visible, never silent;
+- lock acquisitions (``with self._lock:`` over ``make_lock`` /
+  ``make_rlock`` declarations) with the held stack at the acquire;
+- ``self.<attr>`` reads and mutations with the held stack at the site;
+- thread roots (``threading.Thread(target=...)``, executor/pool
+  ``.submit(...)`` callables, lambdas passed to either).
+
+Resolution is deliberately *under*-approximate, mirroring the rest of
+xlint: an edge exists only when the target is statically unambiguous.
+A miss is a recorded coverage hole (``CallGraph.unresolved``), not a
+guessed edge — guessed edges would turn the lock-order proof into
+noise.
+
+``transitive_lock_sets`` closes the per-function direct acquisitions
+over the edge set, keeping a shortest witness call chain per
+(function, lock) so findings can print *how* a deep acquisition is
+reached.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.xlint import Module, RepoTree
+
+_PACKAGE = "xllm_service_tpu"
+
+# Lock-hold context: innermost-last tuple of (lockname, rank, reentrant).
+HeldStack = Tuple[Tuple[str, int, bool], ...]
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z0-9_.\-]+)")
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One resolved call edge."""
+
+    callee: str                # FuncInfo id
+    line: int
+    held: HeldStack
+
+
+@dataclasses.dataclass
+class Unresolved:
+    """A call the builder declined to resolve, with the reason — the
+    pinned coverage hole the call-graph tests assert on."""
+
+    desc: str                  # e.g. "fn(...)" or "x.flush(...)"
+    line: int
+    reason: str                # "param-dynamic-dispatch" | "external" |
+    held: HeldStack            # "unknown-receiver" | "unknown-name"
+
+
+@dataclasses.dataclass
+class AcquireSite:
+    lock: Tuple[str, int, bool]     # (name, rank, reentrant)
+    line: int
+    held: HeldStack                 # held BEFORE this acquire
+
+
+@dataclasses.dataclass
+class AttrSite:
+    """A ``self.<attr>`` access inside a method of ``cls``."""
+
+    cls: str                   # class key (see ClassInfo.key)
+    attr: str
+    line: int
+    held: HeldStack
+    kind: str                  # "write" | "read"
+    # True: in-place mutation of the bound object (subscript store,
+    # augassign, container-mutator call, del). False: plain rebind.
+    mutating: bool = False
+
+
+@dataclasses.dataclass
+class RawCall:
+    """Every call expression, resolved or not, for client rules that
+    classify by shape (blocking-op detection)."""
+
+    node: ast.Call
+    line: int
+    held: HeldStack
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    fid: str                   # "<path>::<qualname>"
+    path: str
+    qualname: str
+    name: str
+    cls: Optional[str]         # enclosing class key, if a method
+    node: ast.AST
+    module: Module
+    # summaries (filled by the walker)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    unresolved: List[Unresolved] = dataclasses.field(default_factory=list)
+    acquires: List[AcquireSite] = dataclasses.field(default_factory=list)
+    attrs: List[AttrSite] = dataclasses.field(default_factory=list)
+    raw_calls: List[RawCall] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    key: str                   # "<path>::<ClassName>"
+    name: str
+    path: str
+    module: Module
+    node: ast.ClassDef
+    methods: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    bases: List[str] = dataclasses.field(default_factory=list)  # raw names
+    properties: Set[str] = dataclasses.field(default_factory=set)
+    # self.<attr> -> class key, inferred from `self.x = ClassName(...)`
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # self.<attr> -> (lockname, rank, reentrant) from make_lock declns
+    lock_attrs: Dict[str, Tuple[str, int, bool]] = \
+        dataclasses.field(default_factory=dict)
+    # self.<attr> -> guard spec string from `# guarded-by:` annotations
+    guarded_by: Dict[str, Tuple[str, int]] = \
+        dataclasses.field(default_factory=dict)   # attr -> (spec, line)
+    # attrs bound to inherently-synchronized stdlib objects
+    # (queue.Queue, threading.Event/Condition/Semaphore/Barrier):
+    # their mutator methods are designed for cross-thread use
+    sync_attrs: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class ThreadRoot:
+    """One entry point that runs concurrently with other roots.
+
+    ``entries`` is the list of (fid, locks-held-at-entry) seeds; a
+    plain thread target has one seed with an empty hold set. The
+    ``init-tail`` pseudo-root models construction-time concurrency:
+    once ``__init__`` registers a watch callback or starts a thread,
+    the REST of the constructor races that activity — its remaining
+    calls become seeds and its remaining attribute writes
+    ``extra_sites``."""
+
+    rid: str                   # display id, e.g. worker.py::Worker._engine_loop
+    fid: Optional[str]         # resolved FuncInfo id (None: dynamic)
+    via: str                   # "Thread" | "Timer" | "submit" | "lambda"
+    path: str                  # | "route" | "watch" | "init-tail"
+    line: int
+    entries: List[Tuple[str, HeldStack]] = \
+        dataclasses.field(default_factory=list)
+    extra_sites: List[AttrSite] = dataclasses.field(default_factory=list)
+
+
+class CallGraph:
+    def __init__(self) -> None:
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        # per-module import environments (path -> _ModuleEnv)
+        self.envs: Dict[str, "_ModuleEnv"] = {}
+        # direct subclass index: class key -> [subclass keys]
+        self._children: Dict[str, List[str]] = {}
+        # class NAME -> [class keys] (for cross-module type inference)
+        self.class_names: Dict[str, List[str]] = {}
+        # module-level lock vars: (path, varname) -> lock tuple
+        self.module_locks: Dict[Tuple[str, str], Tuple[str, int, bool]] = {}
+        self.roots: List[ThreadRoot] = []
+
+    # -- queries --------------------------------------------------------
+    def unresolved_calls(self) -> List[Tuple[str, Unresolved]]:
+        out = []
+        for f in self.functions.values():
+            for u in f.unresolved:
+                out.append((f.fid, u))
+        return out
+
+    def subclasses(self, cls_key: str) -> List[str]:
+        """Transitive subclass closure (name-based base resolution)."""
+        out: List[str] = []
+        seen: Set[str] = {cls_key}
+        work = [cls_key]
+        while work:
+            key = work.pop()
+            for child in self._children.get(key, ()):
+                if child not in seen:
+                    seen.add(child)
+                    out.append(child)
+                    work.append(child)
+        return out
+
+    def method_targets(self, cls_key: str, name: str) -> List[FuncInfo]:
+        """Dispatch targets for ``obj.name()`` where obj is statically
+        a ``cls_key``. A concrete method is a single target; an
+        abstract/stub method (ABC `...` body) dispatches to the UNION
+        of subclass overrides — the sound over-approximation for
+        transitive lock/blocking sets through e.g. the
+        CoordinationStore protocol."""
+        m = self.method(cls_key, name)
+        if m is None:
+            return []
+        if not _is_stub_method(m.node):
+            return [m]
+        targets: List[FuncInfo] = []
+        for sub in self.subclasses(cls_key):
+            ci = self.classes.get(sub)
+            if ci is not None and name in ci.methods:
+                targets.append(ci.methods[name])
+        return targets or [m]
+
+    def method(self, cls_key: str, name: str) -> Optional[FuncInfo]:
+        """Method lookup with single-inheritance walk over repo
+        classes (name-based base resolution)."""
+        seen: Set[str] = set()
+        work = [cls_key]
+        while work:
+            key = work.pop(0)
+            if key in seen:
+                continue
+            seen.add(key)
+            ci = self.classes.get(key)
+            if ci is None:
+                continue
+            if name in ci.methods:
+                return ci.methods[name]
+            for b in ci.bases:
+                for cand in self.class_names.get(b, ()):
+                    work.append(cand)
+        return None
+
+    def lock_attr(self, cls_key: str, attr: str
+                  ) -> Optional[Tuple[str, int, bool]]:
+        seen: Set[str] = set()
+        work = [cls_key]
+        while work:
+            key = work.pop(0)
+            if key in seen:
+                continue
+            seen.add(key)
+            ci = self.classes.get(key)
+            if ci is None:
+                continue
+            if attr in ci.lock_attrs:
+                return ci.lock_attrs[attr]
+            for b in ci.bases:
+                for cand in self.class_names.get(b, ()):
+                    work.append(cand)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Per-module import environment
+# ---------------------------------------------------------------------------
+
+
+class _ModuleEnv:
+    """What a module's top-level names mean, as far as the repo goes."""
+
+    def __init__(self, mod: Module, tree: RepoTree) -> None:
+        self.mod = mod
+        self.tree = tree
+        # alias -> repo module path ("import pkg.a.b as x" / "from pkg.a
+        # import b")
+        self.mod_alias: Dict[str, str] = {}
+        # name -> (repo module path, symbol) ("from pkg.a.b import f")
+        self.sym_import: Dict[str, Tuple[str, str]] = {}
+        # std aliases xlint rules already track
+        self.time_alias: Set[str] = set()
+        self.subprocess_alias: Set[str] = set()
+        self.socket_alias: Set[str] = set()
+        self.jax_alias: Set[str] = set()
+        self.threading_alias: Set[str] = set()
+        # "from time import sleep" style direct symbol imports
+        self.sleep_names: Set[str] = set()
+        self.urlopen_names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    target = a.asname or a.name
+                    p = self._module_path(a.name)
+                    if p is not None and a.asname:
+                        self.mod_alias[a.asname] = p
+                    elif p is not None and "." not in a.name:
+                        self.mod_alias[a.name] = p
+                    if a.name == "time":
+                        self.time_alias.add(bound if not a.asname
+                                            else a.asname)
+                    elif a.name == "subprocess":
+                        self.subprocess_alias.add(target)
+                    elif a.name == "socket":
+                        self.socket_alias.add(target)
+                    elif a.name == "jax":
+                        self.jax_alias.add(target)
+                    elif a.name == "threading":
+                        self.threading_alias.add(target)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:     # relative imports unused in this repo
+                    continue
+                base = node.module or ""
+                for a in node.names:
+                    bound = a.asname or a.name
+                    sub = self._module_path(f"{base}.{a.name}")
+                    if sub is not None:
+                        self.mod_alias[bound] = sub
+                        continue
+                    p = self._module_path(base)
+                    if p is not None:
+                        self.sym_import[bound] = (p, a.name)
+                    if base == "time" and a.name == "sleep":
+                        self.sleep_names.add(bound)
+                    if base in ("urllib.request",) and a.name == "urlopen":
+                        self.urlopen_names.add(bound)
+
+    def _module_path(self, dotted: str) -> Optional[str]:
+        if not dotted.startswith(_PACKAGE):
+            return None
+        rel = dotted.replace(".", "/")
+        for cand in (rel + ".py", rel + "/__init__.py"):
+            if self.tree.get(cand) is not None:
+                return cand
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Builder
+# ---------------------------------------------------------------------------
+
+
+def _is_stub_method(node: ast.AST) -> bool:
+    """An ``@abstractmethod`` or a body that is only a docstring plus
+    ``...``/``pass`` — a dispatch point, not an implementation."""
+    for dec in getattr(node, "decorator_list", ()):
+        name = None
+        if isinstance(dec, ast.Name):
+            name = dec.id
+        elif isinstance(dec, ast.Attribute):
+            name = dec.attr
+        if name == "abstractmethod":
+            return True
+    body = list(getattr(node, "body", ()))
+    if body and isinstance(body[0], ast.Expr) and \
+            isinstance(body[0].value, ast.Constant) and \
+            isinstance(body[0].value.value, str):
+        body = body[1:]
+    return bool(body) and all(
+        isinstance(s, ast.Pass)
+        or (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant)
+            and s.value.value is Ellipsis)
+        for s in body)
+
+
+def _is_make_lock(call: ast.AST) -> Optional[Tuple[str, int, bool]]:
+    if isinstance(call, ast.Call) and isinstance(call.func, ast.Name) \
+            and call.func.id in ("make_lock", "make_rlock") \
+            and len(call.args) >= 2 \
+            and all(isinstance(a, ast.Constant) for a in call.args[:2]) \
+            and isinstance(call.args[0].value, str) \
+            and isinstance(call.args[1].value, int):
+        return (call.args[0].value, call.args[1].value,
+                call.func.id == "make_rlock")
+    return None
+
+
+def build(tree: RepoTree) -> CallGraph:
+    cg = CallGraph()
+    envs: Dict[str, _ModuleEnv] = {}
+
+    # ---- pass 1: index classes, methods, module functions, locks ------
+    for mod in tree.modules:
+        envs[mod.path] = _ModuleEnv(mod, tree)
+        cg.envs[mod.path] = envs[mod.path]
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _index_function(cg, mod, node, cls=None,
+                                prefix="")
+            elif isinstance(node, ast.ClassDef):
+                _index_class(cg, mod, node)
+            elif isinstance(node, ast.Assign):
+                lk = _is_make_lock(node.value)
+                if lk:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            cg.module_locks[(mod.path, t.id)] = lk
+
+    # ---- pass 2: per-class attribute types and guarded-by notes -------
+    for ci in cg.classes.values():
+        for b in ci.bases:
+            for parent in cg.class_names.get(b, ()):
+                cg._children.setdefault(parent, []).append(ci.key)
+    for ci in cg.classes.values():
+        _infer_class_attrs(cg, ci, envs[ci.path])
+
+    # ---- pass 3: walk every function body -----------------------------
+    walkers: Dict[str, _Walker] = {}
+    for fi in list(cg.functions.values()):
+        w = _Walker(cg, fi, envs[fi.path])
+        w.walk()
+        walkers[fi.fid] = w
+
+    # ---- pass 4: thread roots (reuses pass 3's walkers — their
+    # construction re-scans the whole function body) -------------------
+    _collect_roots(cg, envs, walkers)
+    return cg
+
+
+def _index_function(cg: CallGraph, mod: Module, node, cls: Optional[str],
+                    prefix: str) -> None:
+    qual = f"{prefix}{node.name}"
+    fid = f"{mod.path}::{qual}"
+    fi = FuncInfo(fid=fid, path=mod.path, qualname=qual, name=node.name,
+                  cls=cls, node=node, module=mod)
+    cg.functions[fid] = fi
+    if cls is not None and "." not in qual.split(".", 1)[-1] \
+            and qual.count(".") == 1:
+        cg.classes[cls].methods[node.name] = fi
+    # nested defs become their own nodes (they run when called, possibly
+    # on another thread)
+    for child in ast.walk(node):
+        if child is node:
+            continue
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _direct_parent_fn(node, child):
+            _index_function(cg, mod, child, cls=cls,
+                            prefix=f"{qual}.")
+
+
+def _direct_parent_fn(parent, child) -> bool:
+    """child is nested (at any statement depth) directly inside parent,
+    not inside a deeper function."""
+    work: List[ast.AST] = list(ast.iter_child_nodes(parent))
+    while work:
+        n = work.pop()
+        if n is child:
+            return True
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            work.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _index_class(cg: CallGraph, mod: Module, node: ast.ClassDef) -> None:
+    key = f"{mod.path}::{node.name}"
+    ci = ClassInfo(key=key, name=node.name, path=mod.path, module=mod,
+                   node=node)
+    for b in node.bases:
+        if isinstance(b, ast.Name):
+            ci.bases.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            ci.bases.append(b.attr)
+    cg.classes[key] = ci
+    cg.class_names.setdefault(node.name, []).append(key)
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in item.decorator_list:
+                if isinstance(dec, ast.Name) and dec.id == "property":
+                    ci.properties.add(item.name)
+            _index_function(cg, mod, item, cls=key,
+                            prefix=f"{node.name}.")
+
+
+def _class_from_annotation(cg: CallGraph, env: _ModuleEnv,
+                           ann: Optional[ast.AST]) -> Optional[str]:
+    """Type annotation → repo class key: ``Scheduler``,
+    ``"Scheduler"`` (string form), ``Optional[Scheduler]``,
+    ``mod.Scheduler``."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value.strip('"')
+        cands = cg.class_names.get(name, [])
+        key = f"{env.mod.path}::{name}"
+        if key in cg.classes:
+            return key
+        return cands[0] if len(cands) == 1 else None
+    if isinstance(ann, ast.Subscript):
+        # Optional[X] / "Optional[X]" — only the single-arg wrappers
+        base = ann.value
+        if isinstance(base, ast.Name) and base.id == "Optional":
+            return _class_from_annotation(cg, env, ann.slice)
+        return None
+    if isinstance(ann, (ast.Name, ast.Attribute)):
+        return _resolve_class(cg, env, ann)
+    return None
+
+
+def _infer_class_attrs(cg: CallGraph, ci: ClassInfo,
+                       env: _ModuleEnv) -> None:
+    """self.x = ClassName(...) / self.x = <param annotated ClassName> /
+    self.x: ClassName = ... → attr type; self.x = make_lock(...) →
+    lock attr; trailing `# guarded-by:` comments on self.x assignments
+    anywhere in the class → declared guard."""
+    conflicting: Set[str] = set()
+
+    def record_type(attr: str, cls_key: Optional[str]) -> None:
+        if cls_key is None:
+            return
+        prev = ci.attr_types.get(attr)
+        if prev is not None and prev != cls_key:
+            conflicting.add(attr)
+        else:
+            ci.attr_types[attr] = cls_key
+
+    # dataclass-style class-body annotations
+    for item in ci.node.body:
+        if isinstance(item, ast.AnnAssign) and \
+                isinstance(item.target, ast.Name):
+            record_type(item.target.id,
+                        _class_from_annotation(cg, env, item.annotation))
+    for m in ci.methods.values():
+        args = m.node.args
+        param_ann = {p.arg: p.annotation
+                     for p in (*args.posonlyargs, *args.args,
+                               *args.kwonlyargs)
+                     if p.annotation is not None}
+        for node in ast.walk(m.node):
+            if isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Attribute) and \
+                    isinstance(node.target.value, ast.Name) and \
+                    node.target.value.id == "self":
+                record_type(node.target.attr,
+                            _class_from_annotation(cg, env,
+                                                   node.annotation))
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Attribute) \
+                    and isinstance(node.targets[0].value, ast.Name) \
+                    and node.targets[0].value.id == "self":
+                attr = node.targets[0].attr
+                lk = _is_make_lock(node.value)
+                if lk:
+                    ci.lock_attrs[attr] = lk
+                elif isinstance(node.value, ast.Call) and \
+                        _is_guard_ctor(node.value.func):
+                    # unranked guard: usable as a rule-13 guard, invisible
+                    # to the rank rules
+                    ci.lock_attrs[attr] = (f"{ci.name}.{attr}", None, True)
+                elif isinstance(node.value, ast.Call):
+                    if _is_sync_ctor(node.value.func):
+                        ci.sync_attrs.add(attr)
+                    record_type(attr,
+                                _resolve_class(cg, env, node.value.func))
+                elif isinstance(node.value, ast.Name) and \
+                        node.value.id in param_ann:
+                    record_type(attr,
+                                _class_from_annotation(
+                                    cg, env, param_ann[node.value.id]))
+            # guarded-by annotations are allowed on ANY self.x
+            # statement line (assign, augassign, ann-assign)
+            target = None
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                t = node.targets[0] if isinstance(node, ast.Assign) \
+                    else node.target
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    target = t.attr
+            if target is not None and target not in ci.guarded_by:
+                # the annotation may trail any line of a multi-line
+                # assignment
+                end = getattr(node, "end_lineno", node.lineno) \
+                    or node.lineno
+                for ln in range(node.lineno,
+                                min(end, len(ci.module.lines)) + 1):
+                    m_ = _GUARDED_BY_RE.search(ci.module.lines[ln - 1])
+                    if m_:
+                        ci.guarded_by[target] = (m_.group(1), ln)
+                        break
+    for attr in conflicting:
+        ci.attr_types.pop(attr, None)
+
+
+_SYNC_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+               "Event", "Condition", "Semaphore", "BoundedSemaphore",
+               "Barrier"}
+# Raw mutex constructors OUTSIDE the make_lock discipline: the
+# coordination store's Condition-wrapped RLock (utils/locks.py table,
+# rank-50 note). They guard state (rule 13) but carry no rank (rules
+# 11/12 skip them).
+_GUARD_CTORS = {"Condition", "Lock", "RLock"}
+
+
+def _is_guard_ctor(func: ast.AST) -> bool:
+    name = None
+    if isinstance(func, ast.Name):
+        name = func.id
+    elif isinstance(func, ast.Attribute):
+        name = func.attr
+    return name in _GUARD_CTORS
+
+
+def _is_sync_ctor(func: ast.AST) -> bool:
+    name = None
+    if isinstance(func, ast.Name):
+        name = func.id
+    elif isinstance(func, ast.Attribute):
+        name = func.attr
+    return name in _SYNC_CTORS
+
+
+def _resolve_class(cg: CallGraph, env: _ModuleEnv, func: ast.AST
+                   ) -> Optional[str]:
+    """ClassName(...) / mod.ClassName(...) → class key, when the name
+    resolves to exactly one repo class."""
+    if isinstance(func, ast.Name):
+        sym = env.sym_import.get(func.id)
+        if sym is not None:
+            key = f"{sym[0]}::{sym[1]}"
+            if key in cg.classes:
+                return key
+        key = f"{env.mod.path}::{func.id}"
+        if key in cg.classes:
+            return key
+        cands = cg.class_names.get(func.id, [])
+        if len(cands) == 1:
+            return cands[0]
+    elif isinstance(func, ast.Attribute) and \
+            isinstance(func.value, ast.Name):
+        mp = env.mod_alias.get(func.value.id)
+        if mp is not None:
+            key = f"{mp}::{func.attr}"
+            if key in cg.classes:
+                return key
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Function-body walker
+# ---------------------------------------------------------------------------
+
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "insert", "add", "remove",
+    "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "put", "put_nowait",
+}
+
+# Methods that are overwhelmingly builtin container/string ops: calls
+# to these on an UNRESOLVED receiver are ignored rather than recorded
+# as coverage holes (they would drown the real dynamic-dispatch holes
+# in dict.get noise).
+_CONTAINER_METHODS = {
+    "get", "items", "values", "keys", "pop", "append", "add", "update",
+    "extend", "remove", "discard", "clear", "setdefault", "popitem",
+    "join", "split", "strip", "startswith", "endswith", "encode",
+    "decode", "format", "copy", "sort", "reverse", "index", "count",
+    "lower", "upper", "replace", "rsplit", "partition", "rpartition",
+    "hex", "to_json", "wait", "set", "is_set", "release", "acquire",
+}
+
+
+class _Walker:
+    """Single pass over one function body tracking the lexical lock
+    stack, emitting the summaries. Does NOT descend into nested function
+    definitions (they are their own nodes, entered with an empty held
+    stack — a closure runs when called, often on another thread)."""
+
+    def __init__(self, cg: CallGraph, fi: FuncInfo,
+                 env: _ModuleEnv) -> None:
+        self.cg = cg
+        self.fi = fi
+        self.env = env
+        self.held: List[Tuple[str, int, bool]] = []
+        # local nested defs visible by bare name
+        self.local_defs: Dict[str, str] = {}
+        for child in ast.walk(fi.node):
+            if child is not fi.node and \
+                    isinstance(child, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) \
+                    and _direct_parent_fn(fi.node, child):
+                self.local_defs[child.name] = \
+                    f"{fi.path}::{fi.qualname}.{child.name}"
+        # local variable types: x = ClassName(...) and annotated params
+        self.var_types: Dict[str, str] = {}
+        args = fi.node.args
+        for p in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+            if p.annotation is not None:
+                key = _class_from_annotation(cg, env, p.annotation)
+                if key is not None:
+                    self.var_types[p.arg] = key
+        # every locally-assigned name (for dynamic-dispatch pinning)
+        self.local_names: Set[str] = set()
+        for child in ast.walk(fi.node):
+            if isinstance(child, ast.Name) and \
+                    isinstance(child.ctx, ast.Store):
+                self.local_names.add(child.id)
+        bad: Set[str] = set()
+        for child in ast.walk(fi.node):
+            if isinstance(child, ast.Assign) and len(child.targets) == 1 \
+                    and isinstance(child.targets[0], ast.Name) \
+                    and isinstance(child.value, ast.Call):
+                nm = child.targets[0].id
+                key = _resolve_class(cg, env, child.value.func)
+                if key is not None:
+                    if nm in self.var_types and self.var_types[nm] != key:
+                        bad.add(nm)
+                    else:
+                        self.var_types[nm] = key
+            elif isinstance(child, ast.Assign):
+                for t in child.targets:
+                    if isinstance(t, ast.Name) and \
+                            not isinstance(child.value, ast.Call):
+                        bad.add(t.id)
+        for nm in bad:
+            self.var_types.pop(nm, None)
+
+    # -- lock resolution ------------------------------------------------
+    def _lock_of(self, expr: ast.AST) -> Optional[Tuple[str, int, bool]]:
+        # self._lock
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and self.fi.cls is not None:
+                return self.cg.lock_attr(self.fi.cls, expr.attr)
+            # module-level lock imported or local
+            mp = self.env.mod_alias.get(expr.value.id)
+            if mp is not None:
+                return self.cg.module_locks.get((mp, expr.attr))
+            # localvar._lock where localvar: ClassName
+            key = self.var_types.get(expr.value.id)
+            if key is not None:
+                return self.cg.lock_attr(key, expr.attr)
+        # self.obj._lock
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Attribute) and \
+                isinstance(expr.value.value, ast.Name) and \
+                expr.value.value.id == "self" and self.fi.cls is not None:
+            ci = self.cg.classes.get(self.fi.cls)
+            if ci is not None:
+                tkey = ci.attr_types.get(expr.value.attr)
+                if tkey is not None:
+                    return self.cg.lock_attr(tkey, expr.attr)
+        # bare module-level name
+        if isinstance(expr, ast.Name):
+            lk = self.cg.module_locks.get((self.fi.path, expr.id))
+            if lk is not None:
+                return lk
+            sym = self.env.sym_import.get(expr.id)
+            if sym is not None:
+                return self.cg.module_locks.get((sym[0], sym[1]))
+        return None
+
+    # -- callee resolution ----------------------------------------------
+    def resolve_callee(self, func: ast.AST
+                       ) -> Tuple[Optional[str], Optional[str]]:
+        """Single-target convenience (root extraction): → (fid,
+        reason). Multi-target dispatch is ``resolve_callees``."""
+        fids, reason = self.resolve_callees(func)
+        return (fids[0] if fids else None), reason
+
+    def resolve_callees(self, func: ast.AST
+                        ) -> Tuple[List[str], Optional[str]]:
+        """→ (fids, unresolved_reason). fids may carry several targets
+        when the static type dispatches through an abstract method
+        (union of overrides). Empty fids + None reason = a call we
+        deliberately ignore (builtins, external libs)."""
+        if isinstance(func, ast.Name):
+            nm = func.id
+            if nm in self.local_defs:
+                return [self.local_defs[nm]], None
+            fid = f"{self.fi.path}::{nm}"
+            if fid in self.cg.functions:
+                return [fid], None
+            sym = self.env.sym_import.get(nm)
+            if sym is not None:
+                fid = f"{sym[0]}::{sym[1]}"
+                if fid in self.cg.functions:
+                    return [fid], None
+                ckey = f"{sym[0]}::{sym[1]}"
+                if ckey in self.cg.classes:
+                    init = self.cg.method(ckey, "__init__")
+                    return ([init.fid], None) if init else ([], None)
+            ckey = f"{self.fi.path}::{nm}"
+            if ckey in self.cg.classes:
+                init = self.cg.method(ckey, "__init__")
+                return ([init.fid], None) if init else ([], None)
+            if self._is_param(nm):
+                return [], "param-dynamic-dispatch"
+            if nm in self.local_names:
+                return [], "local-dynamic-dispatch"
+            return [], None      # builtin / stdlib name
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and self.fi.cls is not None:
+                    ms = self.cg.method_targets(self.fi.cls, func.attr)
+                    if ms:
+                        return [m.fid for m in ms], None
+                    return [], "unknown-method"
+                mp = self.env.mod_alias.get(base.id)
+                if mp is not None:
+                    fid = f"{mp}::{func.attr}"
+                    if fid in self.cg.functions:
+                        return [fid], None
+                    ckey = f"{mp}::{func.attr}"
+                    if ckey in self.cg.classes:
+                        init = self.cg.method(ckey, "__init__")
+                        return ([init.fid], None) if init else ([], None)
+                    return [], None    # module attr we don't model
+                key = self.var_types.get(base.id)
+                if key is not None:
+                    ms = self.cg.method_targets(key, func.attr)
+                    if ms:
+                        return [m.fid for m in ms], None
+                    return [], "unknown-method"
+                if func.attr in _CONTAINER_METHODS:
+                    return [], None   # builtin container/string op
+                if self._is_param(base.id):
+                    return [], "param-dynamic-dispatch"
+                return [], None       # external receiver
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and self.fi.cls is not None:
+                ci = self.cg.classes.get(self.fi.cls)
+                if ci is not None:
+                    tkey = ci.attr_types.get(base.attr)
+                    if tkey is not None:
+                        ms = self.cg.method_targets(tkey, func.attr)
+                        if ms:
+                            return [m.fid for m in ms], None
+                        return [], "unknown-method"
+                    if base.attr in ci.sync_attrs or \
+                            func.attr in _CONTAINER_METHODS:
+                        return [], None  # stdlib container/sync object
+                return [], "unknown-receiver"
+            return [], None
+        return [], None
+
+    def _is_param(self, name: str) -> bool:
+        a = self.fi.node.args
+        params = {p.arg for p in (*a.posonlyargs, *a.args,
+                                  *a.kwonlyargs)}
+        for extra in (a.vararg, a.kwarg):
+            if extra is not None:
+                params.add(extra.arg)
+        return name in params
+
+    # -- the walk -------------------------------------------------------
+    def walk(self) -> None:
+        for stmt in ast.iter_child_nodes(self.fi.node):
+            self._visit(stmt)
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return                  # separate node / separate thread
+        if isinstance(node, ast.With):
+            self._visit_with(node)
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node)
+            # still descend: nested calls in args
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._visit_store(node)
+        if isinstance(node, ast.Delete) and self.fi.cls is not None:
+            for t in node.targets:
+                tgt = None
+                if isinstance(t, ast.Attribute):
+                    tgt = t
+                elif isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Attribute):
+                    tgt = t.value
+                if tgt is not None and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    self.fi.attrs.append(AttrSite(
+                        cls=self.fi.cls, attr=tgt.attr,
+                        line=node.lineno, held=tuple(self.held),
+                        kind="write", mutating=True))
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and self.fi.cls is not None and \
+                isinstance(node.ctx, ast.Load):
+            self._visit_self_load(node)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    def _visit_with(self, node: ast.With) -> None:
+        added = 0
+        for item in node.items:
+            lk = self._lock_of(item.context_expr)
+            if lk is not None:
+                self.fi.acquires.append(AcquireSite(
+                    lock=lk, line=node.lineno,
+                    held=tuple(self.held)))
+                self.held.append(lk)
+                added += 1
+            else:
+                self._visit(item.context_expr)
+        for stmt in node.body:
+            self._visit(stmt)
+        for _ in range(added):
+            self.held.pop()
+
+    def _visit_call(self, node: ast.Call) -> None:
+        self.fi.raw_calls.append(RawCall(
+            node=node, line=node.lineno, held=tuple(self.held)))
+        fids, reason = self.resolve_callees(node.func)
+        for fid in fids:
+            self.fi.calls.append(CallSite(
+                callee=fid, line=node.lineno, held=tuple(self.held)))
+        if not fids and reason is not None:
+            self.fi.unresolved.append(Unresolved(
+                desc=_call_desc(node), line=node.lineno,
+                reason=reason, held=tuple(self.held)))
+        # container mutation through a method: self.x.append(...) —
+        # but not method calls on repo-class attrs (those are edges)
+        # nor on inherently-synchronized stdlib objects (queue.Queue,
+        # threading.Event — their mutators are the cross-thread API)
+        f = node.func
+        if isinstance(f, ast.Attribute) and \
+                f.attr in _MUTATOR_METHODS and \
+                isinstance(f.value, ast.Attribute) and \
+                isinstance(f.value.value, ast.Name) and \
+                f.value.value.id == "self" and self.fi.cls is not None:
+            ci = self.cg.classes.get(self.fi.cls)
+            attr = f.value.attr
+            if ci is None or (attr not in ci.attr_types
+                              and attr not in ci.sync_attrs):
+                self.fi.attrs.append(AttrSite(
+                    cls=self.fi.cls, attr=attr, line=node.lineno,
+                    held=tuple(self.held), kind="write", mutating=True))
+
+    def _visit_store(self, node) -> None:
+        if self.fi.cls is None:
+            return
+        aug = isinstance(node, ast.AugAssign)
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            # self.x = / self.x += ... (+= is read-modify-write)
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                self.fi.attrs.append(AttrSite(
+                    cls=self.fi.cls, attr=t.attr, line=node.lineno,
+                    held=tuple(self.held), kind="write", mutating=aug))
+            # self.x[k] = ... (mutates the container bound to self.x)
+            elif isinstance(t, ast.Subscript) and \
+                    isinstance(t.value, ast.Attribute) and \
+                    isinstance(t.value.value, ast.Name) and \
+                    t.value.value.id == "self":
+                self.fi.attrs.append(AttrSite(
+                    cls=self.fi.cls, attr=t.value.attr, line=node.lineno,
+                    held=tuple(self.held), kind="write", mutating=True))
+            elif isinstance(t, ast.Tuple):
+                for el in t.elts:
+                    if isinstance(el, ast.Attribute) and \
+                            isinstance(el.value, ast.Name) and \
+                            el.value.id == "self":
+                        self.fi.attrs.append(AttrSite(
+                            cls=self.fi.cls, attr=el.attr,
+                            line=node.lineno, held=tuple(self.held),
+                            kind="write", mutating=aug))
+
+    def _visit_self_load(self, node: ast.Attribute) -> None:
+        self.fi.attrs.append(AttrSite(
+            cls=self.fi.cls, attr=node.attr, line=node.lineno,
+            held=tuple(self.held), kind="read"))
+        # property access is a call to the getter
+        ci = self.cg.classes.get(self.fi.cls)
+        if ci is not None and node.attr in ci.properties:
+            m = self.cg.method(self.fi.cls, node.attr)
+            if m is not None:
+                self.fi.calls.append(CallSite(
+                    callee=m.fid, line=node.lineno,
+                    held=tuple(self.held)))
+
+
+def _call_desc(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f"{f.id}(...)"
+    if isinstance(f, ast.Attribute):
+        base = f.value
+        if isinstance(base, ast.Name):
+            return f"{base.id}.{f.attr}(...)"
+        return f"<expr>.{f.attr}(...)"
+    return "<dynamic>(...)"
+
+
+# ---------------------------------------------------------------------------
+# Thread roots
+# ---------------------------------------------------------------------------
+
+
+def _collect_roots(cg: CallGraph, envs: Dict[str, _ModuleEnv],
+                   walkers: Dict[str, "_Walker"]) -> None:
+    seen: Set[Tuple[str, Optional[str]]] = set()
+    for fi in cg.functions.values():
+        env = envs[fi.path]
+        walker = walkers[fi.fid]
+        for rc in fi.raw_calls:
+            node = rc.node
+            f = node.func
+            is_thread = False
+            via = ""
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in ("Thread", "Timer") and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in env.threading_alias:
+                is_thread = True
+                via = f.attr
+            elif isinstance(f, ast.Name) and f.id in ("Thread", "Timer") \
+                    and _has_from_threading(fi.module, f.id):
+                is_thread = True
+                via = f.id
+            if is_thread:
+                resolved = 0
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        resolved += _register_root(
+                            cg, walker, fi, kw.value, via,
+                            node.lineno, seen)
+                if not resolved:
+                    _dynamic_root(cg, fi, via, node.lineno, seen)
+                continue
+            # executor / fan-in pool submission
+            if isinstance(f, ast.Attribute) and f.attr == "submit":
+                resolved = 0
+                for arg in node.args:
+                    resolved += _register_root(cg, walker, fi, arg,
+                                               "submit", node.lineno,
+                                               seen)
+                if not resolved:
+                    _dynamic_root(cg, fi, "submit", node.lineno, seen)
+            # HTTP route handlers run on request-pool threads
+            # (Router.route / route_prefix): each handler is a root.
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in ("route", "route_prefix"):
+                for arg in node.args:
+                    _register_root(cg, walker, fi, arg, "route",
+                                   node.lineno, seen)
+            # Watch callbacks run on the store's watch/dispatch thread.
+            if isinstance(f, ast.Attribute) and f.attr == "add_watch":
+                for arg in node.args:
+                    _register_root(cg, walker, fi, arg, "watch",
+                                   node.lineno, seen)
+        if fi.name == "__init__" and fi.cls is not None:
+            _init_tail_root(cg, fi, seen)
+
+
+def _has_from_threading(mod: Module, name: str) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and \
+                node.module == "threading" and \
+                any(a.name == name or a.asname == name
+                    for a in node.names):
+            return True
+    return False
+
+
+def _register_root(cg: CallGraph, walker: _Walker, fi: FuncInfo,
+                   expr: ast.AST, via: str, line: int,
+                   seen: Set[Tuple[str, Optional[str]]]) -> int:
+    """→ number of resolvable roots registered for this expression."""
+    # functools.partial(f, ...) → f
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if ((isinstance(f, ast.Attribute) and f.attr == "partial")
+                or (isinstance(f, ast.Name) and f.id == "partial")) \
+                and expr.args:
+            return _register_root(cg, walker, fi, expr.args[0], via,
+                                  line, seen)
+        return 0
+    if isinstance(expr, ast.Lambda):
+        # every resolvable call inside the lambda becomes a root
+        n = 0
+        for node in ast.walk(expr.body):
+            if isinstance(node, ast.Call):
+                fid, _ = walker.resolve_callee(node.func)
+                if fid is not None:
+                    n += 1
+                    key = (fi.path, fid)
+                    if key not in seen:
+                        seen.add(key)
+                        cg.roots.append(ThreadRoot(
+                            rid=fid, fid=fid, via="lambda",
+                            path=fi.path, line=line,
+                            entries=[(fid, ())]))
+        return n
+    if isinstance(expr, (ast.Name, ast.Attribute)):
+        fid, _ = walker.resolve_callee(expr)
+        if fid is not None:
+            key = (fi.path, fid)
+            if key not in seen:
+                seen.add(key)
+                cg.roots.append(ThreadRoot(
+                    rid=fid, fid=fid, via=via, path=fi.path, line=line,
+                    entries=[(fid, ())]))
+            return 1
+    return 0
+
+
+def _dynamic_root(cg: CallGraph, fi: FuncInfo, via: str, line: int,
+                  seen: Set[Tuple[str, Optional[str]]]) -> None:
+    """A thread-spawn site whose target nothing resolved — recorded so
+    the coverage hole is visible in the concurrency report, never
+    silently dropped."""
+    rid = f"{fi.path}:{line}::<dynamic {via} target>"
+    key = (fi.path, rid)
+    if key not in seen:
+        seen.add(key)
+        cg.roots.append(ThreadRoot(
+            rid=rid, fid=None, via=via, path=fi.path, line=line))
+
+
+def _init_tail_root(cg: CallGraph, fi: FuncInfo,
+                    seen: Set[Tuple[str, Optional[str]]]) -> None:
+    """Construction-time concurrency: once ``__init__`` registers a
+    watch callback or starts a thread it created, the rest of the
+    constructor runs CONCURRENTLY with that activity — model the tail
+    as its own root (this is how the InstanceMgr/GlobalKVCacheMgr
+    bootstrap-vs-watch races are surfaced; see docs/CONCURRENCY.md)."""
+    # attrs/locals assigned threading.Thread(...) inside this __init__
+    thread_vars: Set[str] = set()
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            vf = node.value.func
+            is_thread_ctor = (
+                (isinstance(vf, ast.Attribute) and vf.attr == "Thread")
+                or (isinstance(vf, ast.Name) and vf.id == "Thread"))
+            if is_thread_ctor:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        thread_vars.add(t.attr)
+                    elif isinstance(t, ast.Name):
+                        thread_vars.add(t.id)
+    spawn_line: Optional[int] = None
+    for rc in fi.raw_calls:
+        f = rc.node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        spawns = f.attr == "add_watch" or (
+            f.attr == "start"
+            and ((isinstance(f.value, ast.Attribute)
+                  and f.value.attr in thread_vars)
+                 or (isinstance(f.value, ast.Name)
+                     and f.value.id in thread_vars)
+                 or (isinstance(f.value, ast.Call))))
+        if spawns:
+            spawn_line = rc.line if spawn_line is None \
+                else min(spawn_line, rc.line)
+    if spawn_line is None:
+        return
+    entries = [(cs.callee, cs.held) for cs in fi.calls
+               if cs.line > spawn_line]
+    # Plain rebinds in the tail are attribute *initializations* (fresh
+    # objects); only in-place mutations can corrupt state the spawned
+    # activity also reaches.
+    extra = [s for s in fi.attrs
+             if s.kind == "write" and s.mutating and s.line > spawn_line]
+    if not entries and not extra:
+        return
+    rid = f"{fi.path}::{fi.qualname}[init-tail]"
+    key = (fi.path, rid)
+    if key in seen:
+        return
+    seen.add(key)
+    cg.roots.append(ThreadRoot(
+        rid=rid, fid=None, via="init-tail", path=fi.path,
+        line=spawn_line, entries=entries, extra_sites=extra))
+
+
+# ---------------------------------------------------------------------------
+# Transitive closures
+# ---------------------------------------------------------------------------
+
+
+def transitive_lock_sets(cg: CallGraph
+                         ) -> Dict[str, Dict[str, Tuple[str, ...]]]:
+    """fid → {lockname: shortest witness chain (fids, caller→acquirer)}.
+    The chain's last element is the function containing the literal
+    ``with`` acquisition."""
+    # direct (ranked locks only — unranked Condition guards are rule
+    # 13's business, not the rank order's)
+    out: Dict[str, Dict[str, Tuple[str, ...]]] = {}
+    for fid, fi in cg.functions.items():
+        d: Dict[str, Tuple[str, ...]] = {}
+        for acq in fi.acquires:
+            name, rank, _reentrant = acq.lock
+            if rank is None:
+                continue
+            d.setdefault(name, (fid,))
+        out[fid] = d
+    # reverse edges
+    callers: Dict[str, List[str]] = {}
+    for fid, fi in cg.functions.items():
+        for cs in fi.calls:
+            callers.setdefault(cs.callee, []).append(fid)
+    # worklist propagation (shortest chain wins → termination)
+    work = [fid for fid, d in out.items() if d]
+    while work:
+        fid = work.pop()
+        d = out[fid]
+        for caller in callers.get(fid, ()):
+            cd = out.setdefault(caller, {})
+            changed = False
+            for lock, chain in d.items():
+                new_chain = (caller,) + chain
+                old = cd.get(lock)
+                if old is None or len(new_chain) < len(old):
+                    cd[lock] = new_chain
+                    changed = True
+            if changed:
+                work.append(caller)
+    return out
+
+
+def reachable_from(cg: CallGraph, seeds: Sequence[str]) -> Set[str]:
+    seen: Set[str] = set()
+    work = list(seeds)
+    while work:
+        fid = work.pop()
+        if fid in seen or fid not in cg.functions:
+            continue
+        seen.add(fid)
+        for cs in cg.functions[fid].calls:
+            work.append(cs.callee)
+    return seen
+
+
+def context_guards(cg: CallGraph,
+                   seeds: Sequence[Tuple[str, frozenset]]
+                   ) -> Dict[str, frozenset]:
+    """For every function reachable from the seeds: the set of lock
+    NAMES held on *every* call path from a root entry to that function.
+    Each seed is (fid, locks-held-at-entry). Monotone-decreasing
+    intersection → terminates."""
+    guards: Dict[str, frozenset] = {}
+    work: List[str] = []
+    for fid, held in seeds:
+        old = guards.get(fid)
+        g = frozenset(held)
+        guards[fid] = g if old is None else (old & g)
+        work.append(fid)
+    while work:
+        fid = work.pop()
+        g = guards.get(fid)
+        if g is None or fid not in cg.functions:
+            continue
+        for cs in cg.functions[fid].calls:
+            at_site = g | frozenset(h[0] for h in cs.held)
+            old = guards.get(cs.callee)
+            new = at_site if old is None else (old & at_site)
+            if old is None or new != old:
+                guards[cs.callee] = new
+                work.append(cs.callee)
+    return guards

@@ -1,0 +1,591 @@
+"""Rules 11–13: whole-program concurrency analysis over the call graph.
+
+Rule 11 ``lock-order-interprocedural`` — transitive lock-acquisition
+sets replace LockRankRule's one-hop approximation: a call made while a
+ranked lock is held must not *reach* (at any depth) an acquisition of a
+lock whose rank is ≤ the held one. The same pass collects the
+acquires-while-holding edge set and proves it acyclic — any cycle is a
+finding, so the canonical rank table in utils/locks.py is *proven*
+deadlock-free on every tier-1 run, not assumed.
+
+Rule 12 ``blocking-under-lock`` — network I/O, ``time.sleep``,
+unbounded ``.result()``, subprocess spawns, and device syncs
+(``_read_host``, ``block_until_ready``, ``jax.device_get``) reachable
+while a ranked lock is held. The PR-7 incident class: the
+undelivered-beat retry draining under the ENGINE lock blocked
+heartbeats behind whole first-serve compiles and expired the lease.
+A small per-lock policy table (``BLOCKING_ALLOWED``) encodes the
+by-design cases (the engine lock exists to serialize device compute);
+everything else needs a justified allowlist entry.
+
+Rule 13 ``thread-root-race`` — every ``threading.Thread`` target,
+executor/fan-in ``submit`` callable, and HTTP route handler is a thread
+root. Per root, the pass computes the reachable function set and the
+``self.<attr>`` write set with the lock context at each site (lexical
+``with`` nesting plus locks held on *every* call path from the root).
+An attribute mutated from ≥2 roots with no common guarding lock is a
+race finding unless its declaration carries a
+``# guarded-by: <lock>`` annotation (validated against the rank table /
+the class's lock attributes — an annotation naming a lock that does not
+exist is itself a finding).
+
+All three rules share one memoized analysis per lint run (the pass is
+the expensive part; tier-1 budgets the full 13-rule run at < 30 s).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.xlint import Finding, RepoTree
+from tools.xlint import callgraph as cgm
+
+# ---------------------------------------------------------------------------
+# Blocking-op classification
+# ---------------------------------------------------------------------------
+
+# Method names that mean "this call can block on the network" on any
+# receiver. Name-based on purpose (xlint is under-approximate but must
+# not miss the repo's raw-socket and http.client idioms).
+_NET_METHODS = {
+    "connect", "create_connection", "sendall", "recv", "recv_into",
+    "accept", "getresponse", "urlopen",
+}
+_SUBPROCESS_FNS = {"run", "Popen", "call", "check_call", "check_output"}
+# Device syncs: the engine's sanctioned readback helper and jax's
+# blocking primitives. np.asarray readbacks are rule 5b's business.
+_DEVICE_SYNC_METHODS = {"_read_host", "block_until_ready"}
+
+# Which blocking categories a given lock tolerates BY DESIGN. Everything
+# not listed here is deny-by-default (allowlist individual sites with a
+# justification instead of widening this table).
+BLOCKING_ALLOWED: Dict[str, Set[str]] = {
+    # The engine lock exists to serialize engine compute: device
+    # dispatch + readback under it is the design, not a hazard
+    # (utils/locks.py rank 20).
+    "worker.engine": {"device_sync"},
+    # The hb lock serializes heartbeat BUILD+SEND by design (rank 5 —
+    # nothing else may be held around it, so the send can't starve
+    # another lock's waiters; see utils/locks.py).
+    "worker.hb": {"net"},
+}
+
+
+def classify_blocking(node: ast.Call, env) -> Optional[Tuple[str, str]]:
+    """→ (category, description) when ``node`` is a blocking call."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        base = f.value
+        attr = f.attr
+        if attr == "sleep" and isinstance(base, ast.Name) and \
+                base.id in env.time_alias:
+            return "sleep", "time.sleep"
+        if attr == "result" and not node.args and \
+                not any(kw.arg == "timeout" for kw in node.keywords):
+            return "result", ".result() [no timeout]"
+        if attr in _DEVICE_SYNC_METHODS:
+            return "device_sync", f".{attr}()"
+        if attr == "device_get" and isinstance(base, ast.Name) and \
+                base.id in env.jax_alias:
+            return "device_sync", "jax.device_get"
+        if isinstance(base, ast.Name) and \
+                base.id in env.subprocess_alias and \
+                attr in _SUBPROCESS_FNS:
+            return "subprocess", f"subprocess.{attr}"
+        if attr in _NET_METHODS:
+            return "net", f".{attr}()"
+    elif isinstance(f, ast.Name):
+        if f.id in env.sleep_names:
+            return "sleep", "time.sleep"
+        if f.id in env.urlopen_names:
+            return "net", "urlopen"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The shared analysis (memoized per RepoTree)
+# ---------------------------------------------------------------------------
+
+
+class Analysis:
+    def __init__(self, tree: RepoTree) -> None:
+        self.tree = tree
+        self.cg = cgm.build(tree)
+        # fid -> {lockname: witness chain of fids, last = acquirer}
+        self.trans_locks = cgm.transitive_lock_sets(self.cg)
+        # lockname -> (rank, reentrant), from the literal declarations
+        self.lock_meta: Dict[str, Tuple[int, bool]] = {}
+        for fi in self.cg.functions.values():
+            for acq in fi.acquires:
+                name, rank, reentrant = acq.lock
+                if rank is not None:
+                    self.lock_meta[name] = (rank, reentrant)
+        self.trans_blocking = self._transitive_blocking()
+        self.edges, self.edge_witness = self._awh_edges()
+        self.cycles = _find_cycles(self.edges)
+
+    # -- blocking closure ----------------------------------------------
+    def _direct_blocking(self) -> Dict[str, List[Tuple[str, str, int]]]:
+        out: Dict[str, List[Tuple[str, str, int]]] = {}
+        for fid, fi in self.cg.functions.items():
+            env = self.cg.envs[fi.path]
+            sites = []
+            for rc in fi.raw_calls:
+                hit = classify_blocking(rc.node, env)
+                if hit is not None:
+                    sites.append((hit[0], hit[1], rc.line))
+            if sites:
+                out[fid] = sites
+        return out
+
+    def _transitive_blocking(self
+                             ) -> Dict[str, Dict[Tuple[str, str],
+                                                 Tuple[str, ...]]]:
+        """fid → {(category, desc): shortest witness chain of fids}."""
+        direct = self._direct_blocking()
+        out: Dict[str, Dict[Tuple[str, str], Tuple[str, ...]]] = {}
+        for fid in self.cg.functions:
+            d: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+            for cat, desc, _line in direct.get(fid, ()):  # noqa: B007
+                d.setdefault((cat, desc), (fid,))
+            out[fid] = d
+        callers: Dict[str, List[str]] = {}
+        for fid, fi in self.cg.functions.items():
+            for cs in fi.calls:
+                callers.setdefault(cs.callee, []).append(fid)
+        work = [fid for fid, d in out.items() if d]
+        while work:
+            fid = work.pop()
+            d = out[fid]
+            for caller in callers.get(fid, ()):
+                cd = out[caller]
+                changed = False
+                for key, chain in d.items():
+                    new_chain = (caller,) + chain
+                    old = cd.get(key)
+                    if old is None or len(new_chain) < len(old):
+                        cd[key] = new_chain
+                        changed = True
+                if changed:
+                    work.append(caller)
+        return out
+
+    # -- acquires-while-holding edges ----------------------------------
+    def _awh_edges(self) -> Tuple[Set[Tuple[str, str]],
+                                  Dict[Tuple[str, str], str]]:
+        """Every (held, acquired) lock pair observable in the program —
+        lexical nesting AND call-mediated at any depth — plus one
+        human-readable witness per edge."""
+        edges: Set[Tuple[str, str]] = set()
+        witness: Dict[Tuple[str, str], str] = {}
+        for fid, fi in self.cg.functions.items():
+            for acq in fi.acquires:
+                name, rank, reentrant = acq.lock
+                if rank is None:
+                    continue
+                if reentrant and any(h[0] == name for h in acq.held):
+                    continue    # legal re-entrant re-acquire, even with
+                    # other locks acquired in between (runtime
+                    # short-circuits before the rank check)
+                for held in acq.held:
+                    if held[0] == name or held[1] is None:
+                        continue        # unranked guard
+                    e = (held[0], name)
+                    edges.add(e)
+                    witness.setdefault(
+                        e, f"{fi.qualname} ({fi.path}:{acq.line})")
+            for cs in fi.calls:
+                if not cs.held:
+                    continue
+                for lock, chain in self.trans_locks.get(
+                        cs.callee, {}).items():
+                    _rank, reentrant = self.lock_meta.get(lock,
+                                                          (None, False))
+                    if reentrant and any(h[0] == lock for h in cs.held):
+                        continue    # callee re-enters a lock we own
+                    for held in cs.held:
+                        if held[0] == lock or held[1] is None:
+                            continue
+                        e = (held[0], lock)
+                        edges.add(e)
+                        witness.setdefault(
+                            e, f"{fi.qualname} → "
+                               f"{_chain_str(self.cg, chain)} "
+                               f"({fi.path}:{cs.line})")
+        return edges, witness
+
+
+def _chain_str(cg: cgm.CallGraph, chain: Sequence[str]) -> str:
+    return " → ".join(
+        cg.functions[f].qualname if f in cg.functions else f
+        for f in chain)
+
+
+def _find_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    """Cycles in the lock-name digraph (iterative DFS; returns each
+    cycle once, as the node list along the back edge)."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in sorted(edges):
+        adj.setdefault(a, []).append(b)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    for start in sorted(adj):
+        if color.get(start, WHITE) != WHITE:
+            continue
+        stack: List[Tuple[str, int]] = [(start, 0)]
+        path: List[str] = []
+        while stack:
+            node, idx = stack[-1]
+            if idx == 0:
+                color[node] = GREY
+                path.append(node)
+            succs = adj.get(node, [])
+            if idx < len(succs):
+                stack[-1] = (node, idx + 1)
+                nxt = succs[idx]
+                c = color.get(nxt, WHITE)
+                if c == GREY:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    norm = tuple(sorted(set(cyc)))
+                    if norm not in seen_cycles:
+                        seen_cycles.add(norm)
+                        cycles.append(cyc)
+                elif c == WHITE:
+                    stack.append((nxt, 0))
+            else:
+                color[node] = BLACK
+                stack.pop()
+                path.pop()
+    return cycles
+
+
+_CACHE_ATTR = "_xlint_concurrency_analysis"
+
+
+def analyze(tree: RepoTree) -> Analysis:
+    """One Analysis per RepoTree instance — rules 11–13 and the report
+    share it (the build is the expensive part of the 30 s budget)."""
+    a = getattr(tree, _CACHE_ATTR, None)
+    if a is None:
+        a = Analysis(tree)
+        setattr(tree, _CACHE_ATTR, a)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Rule 11: lock-order-interprocedural
+# ---------------------------------------------------------------------------
+
+
+class LockOrderInterproceduralRule:
+    name = "lock-order-interprocedural"
+    describe = ("calls made while holding a ranked lock must not reach "
+                "(at any depth) an acquisition of an equal-or-lower "
+                "rank; the acquires-while-holding graph must be acyclic")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        a = analyze(tree)
+        findings: List[Finding] = []
+        emitted: Set[str] = set()
+        for fid, fi in a.cg.functions.items():
+            for cs in fi.calls:
+                ranked = [h for h in cs.held if h[1] is not None]
+                if not ranked:
+                    continue
+                top_name, top_rank, top_re = ranked[-1]
+                callee = a.cg.functions.get(cs.callee)
+                if callee is None:
+                    continue
+                for lock, chain in a.trans_locks.get(
+                        cs.callee, {}).items():
+                    rank, reentrant = a.lock_meta.get(lock, (None, False))
+                    if rank is None:
+                        continue
+                    # Re-entrant re-acquisition is legal no matter what
+                    # else was acquired in between: the runtime checker
+                    # short-circuits before the rank check when the
+                    # thread already owns the lock (CheckedLock.acquire).
+                    if reentrant and any(h[0] == lock for h in cs.held):
+                        continue
+                    if rank > top_rank:
+                        continue
+                    key = (f"{fi.path}::{fi.qualname}::"
+                           f"call:{callee.name}::{top_name}<{lock}")
+                    if key in emitted:
+                        continue
+                    emitted.add(key)
+                    depth = len(chain)
+                    findings.append(Finding(
+                        rule=self.name, path=fi.path, line=cs.line,
+                        key=key,
+                        message=f"calls {callee.name}() while holding "
+                                f"{top_name!r} (rank {top_rank}) — "
+                                f"which reaches an acquisition of "
+                                f"{lock!r} (rank {rank}) "
+                                f"{depth} call(s) deep via "
+                                f"{_chain_str(a.cg, chain)}; lock order "
+                                f"must be strictly increasing "
+                                f"(utils/locks.py)"))
+        for cyc in a.cycles:
+            key = "lock-cycle::" + "->".join(cyc)
+            findings.append(Finding(
+                rule=self.name, path="xllm_service_tpu/utils/locks.py",
+                line=0, key=key,
+                message=f"acquires-while-holding cycle "
+                        f"{' -> '.join(cyc)} — the rank table is no "
+                        f"longer deadlock-free; witnesses: "
+                        + "; ".join(
+                            a.edge_witness.get((cyc[i], cyc[i + 1]), "?")
+                            for i in range(len(cyc) - 1))))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule 12: blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+def _blocked_by_policy(held, category: str) -> Optional[str]:
+    """→ the first held RANKED lock name that does NOT tolerate
+    ``category`` (None: every held lock allows it). Unranked Condition
+    guards are skipped — blocking under a Condition is the wait
+    pattern, governed by that class's own discipline."""
+    for name, rank, _re in held:
+        if rank is None:
+            continue
+        if category not in BLOCKING_ALLOWED.get(name, ()):
+            return name
+    return None
+
+
+class BlockingUnderLockRule:
+    name = "blocking-under-lock"
+    describe = ("network I/O, time.sleep, unbounded .result(), "
+                "subprocess, and device syncs must not be reachable "
+                "while a ranked lock is held (per-lock design "
+                "exceptions in BLOCKING_ALLOWED; site exceptions need "
+                "a justified allowlist entry)")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        a = analyze(tree)
+        findings: List[Finding] = []
+        emitted: Set[str] = set()
+        for fid, fi in a.cg.functions.items():
+            env = a.cg.envs[fi.path]
+            # direct blocking ops under a held lock
+            for rc in fi.raw_calls:
+                if not rc.held:
+                    continue
+                hit = classify_blocking(rc.node, env)
+                if hit is None:
+                    continue
+                cat, desc = hit
+                lock = _blocked_by_policy(rc.held, cat)
+                if lock is None:
+                    continue
+                key = f"{fi.path}::{fi.qualname}::{lock}::{cat}"
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                findings.append(Finding(
+                    rule=self.name, path=fi.path, line=rc.line,
+                    key=key,
+                    message=f"{desc} while holding {lock!r} — a "
+                            f"{cat} wait under a ranked lock starves "
+                            f"every contender (the PR-7 "
+                            f"beats-behind-compiles class); move it "
+                            f"outside the lock or allowlist with a "
+                            f"justification"))
+            # blocking reachable through calls made under a held lock
+            for cs in fi.calls:
+                if not cs.held:
+                    continue
+                callee = a.cg.functions.get(cs.callee)
+                if callee is None:
+                    continue
+                for (cat, desc), chain in a.trans_blocking.get(
+                        cs.callee, {}).items():
+                    lock = _blocked_by_policy(cs.held, cat)
+                    if lock is None:
+                        continue
+                    terminal = chain[-1]
+                    tname = a.cg.functions[terminal].name \
+                        if terminal in a.cg.functions else terminal
+                    key = (f"{fi.path}::{fi.qualname}::{lock}::{cat}::"
+                           f"via:{tname}")
+                    if key in emitted:
+                        continue
+                    emitted.add(key)
+                    findings.append(Finding(
+                        rule=self.name, path=fi.path, line=cs.line,
+                        key=key,
+                        message=f"calls {callee.name}() while holding "
+                                f"{lock!r} — reaches {desc} ({cat}) "
+                                f"via {_chain_str(a.cg, chain)}; a "
+                                f"blocking wait under a ranked lock "
+                                f"starves every contender; restructure "
+                                f"or allowlist with a justification"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule 13: thread-root-race
+# ---------------------------------------------------------------------------
+
+# Attributes whose writes are synchronization-free by design on CPython:
+# none. The rule is deliberately strict; per-attribute design decisions
+# are declared in source via `# guarded-by:` annotations instead of
+# hidden here.
+
+
+class ThreadRootRaceRule:
+    """``rank_table`` is injected (tools/xlint/rules.py passes its
+    canonical LOCK_RANK_TABLE) so guard annotations can be validated
+    without a circular import."""
+
+    name = "thread-root-race"
+    describe = ("attributes mutated from ≥2 thread roots need a common "
+                "guarding lock (inferred from `with` context on every "
+                "mutation path) or a `# guarded-by: <lock>` "
+                "annotation on their declaration")
+
+    def __init__(self, rank_table: Optional[Dict[str, int]] = None
+                 ) -> None:
+        self.rank_table = rank_table or {}
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        a = analyze(tree)
+        cg = a.cg
+        findings: List[Finding] = []
+        # (cls_key, attr) -> root rid -> list of (fid, line, guards)
+        muts: Dict[Tuple[str, str],
+                   Dict[str, List[Tuple[str, int, frozenset]]]] = {}
+        for root in cg.roots:
+            entries = [(fid, frozenset(h[0] for h in held))
+                       for fid, held in root.entries
+                       if fid in cg.functions]
+            if not entries and not root.extra_sites:
+                continue
+            ctx = cgm.context_guards(cg, entries)
+
+            def record(site, base_guards, rid=root.rid):
+                ci = cg.classes.get(site.cls)
+                if ci is not None and (site.attr in ci.lock_attrs
+                                       or site.attr in ci.sync_attrs):
+                    return      # lock objects / synchronized stdlib
+                guards = base_guards | frozenset(
+                    h[0] for h in site.held)
+                muts.setdefault((site.cls, site.attr), {}) \
+                    .setdefault(rid, []) \
+                    .append((site.line, guards))
+
+            # the init-tail's own writes (after the spawn point)
+            for site in root.extra_sites:
+                if site.kind == "write":
+                    record(site, frozenset())
+            for fid in cgm.reachable_from(cg, [e[0] for e in entries]):
+                fi = cg.functions[fid]
+                if fi.name == "__init__":
+                    continue    # constructor writes are instance-fresh
+                base_guards = ctx.get(fid, frozenset())
+                for site in fi.attrs:
+                    if site.kind == "write":
+                        record(site, base_guards)
+        for (cls_key, attr), by_root in sorted(muts.items()):
+            if len(by_root) < 2:
+                continue
+            all_sites = [s for sites in by_root.values() for s in sites]
+            common = frozenset.intersection(
+                *[g for _l, g in all_sites])
+            if common:
+                continue
+            ci = cg.classes.get(cls_key)
+            if ci is None:
+                continue
+            ann = ci.guarded_by.get(attr)
+            if ann is not None:
+                spec, ann_line = ann
+                if self._guard_valid(cg, ci, spec):
+                    continue
+                findings.append(Finding(
+                    rule=self.name, path=ci.path, line=ann_line,
+                    key=f"{ci.path}::{ci.name}.{attr}::bad-guard",
+                    message=f"`# guarded-by: {spec}` on "
+                            f"{ci.name}.{attr} names no known lock — "
+                            f"use a rank-table name (utils/locks.py) "
+                            f"or a `self._<lock attr>` of the class"))
+                continue
+            roots_desc = ", ".join(_short_root(r)
+                                   for r in sorted(by_root))
+            wline, _g = all_sites[0]
+            findings.append(Finding(
+                rule=self.name, path=ci.path, line=wline,
+                key=f"{ci.path}::{ci.name}.{attr}::race",
+                message=f"{ci.name}.{attr} is mutated from "
+                        f"{len(by_root)} thread roots ({roots_desc}) "
+                        f"with no common guarding lock — guard every "
+                        f"mutation site with one lock, or declare the "
+                        f"design with `# guarded-by: <lock>` on the "
+                        f"attribute's declaration"))
+        return findings
+
+    def _guard_valid(self, cg: cgm.CallGraph, ci, spec: str) -> bool:
+        if spec.startswith("self."):
+            return cg.lock_attr(ci.key, spec[len("self."):]) is not None
+        if spec in self.rank_table:
+            return True
+        # a lock name declared anywhere in the linted tree (fixture
+        # trees carry their own tables)
+        names = getattr(cg, "_lock_names", None)
+        if names is None:
+            names = {lk[0] for lk in cg.module_locks.values()}
+            for c in cg.classes.values():
+                names.update(lk[0] for lk in c.lock_attrs.values())
+            cg._lock_names = names
+        return spec in names
+
+
+def _short_root(rid: str) -> str:
+    return rid.rsplit("::", 1)[-1]
+
+
+# ---------------------------------------------------------------------------
+# Concurrency report (docs/CONCURRENCY.md backing data + CLI)
+# ---------------------------------------------------------------------------
+
+
+def report(tree: RepoTree) -> Dict[str, object]:
+    """The machine-readable whole-program concurrency summary: thread
+    roots with transitive lock-sets, the acquires-while-holding edge
+    set, the acyclicity verdict, and the pinned coverage holes."""
+    a = analyze(tree)
+    cg = a.cg
+    roots = []
+    for r in sorted(cg.roots, key=lambda r: r.rid):
+        seeds = [fid for fid, _held in r.entries if fid in cg.functions]
+        locks: List[str] = []
+        if seeds:
+            names = set()
+            for fid in cgm.reachable_from(cg, seeds):
+                names.update(a.trans_locks.get(fid, {}).keys())
+            locks = sorted(names)
+        roots.append({
+            "root": r.rid, "via": r.via,
+            "resolved": bool(seeds),
+            "locks": locks,
+        })
+    reasons: Dict[str, int] = {}
+    for _fid, u in cg.unresolved_calls():
+        reasons[u.reason] = reasons.get(u.reason, 0) + 1
+    return {
+        "roots": roots,
+        "edges": sorted([list(e) for e in a.edges]),
+        "acyclic": not a.cycles,
+        "cycles": a.cycles,
+        "functions": len(cg.functions),
+        "unresolved_calls": reasons,
+    }

@@ -1,6 +1,8 @@
-"""The six xlint rules. Each proves one invariant the serving/perf work
-depends on; docs/STATIC_ANALYSIS.md records the incident that motivated
-each. All analysis is stdlib ``ast`` — name/alias based, intentionally
+"""The xlint rules (1–10 here; the interprocedural rules 11–13 live in
+tools/xlint/concurrency.py and are registered into ``RULES`` below).
+Each proves one invariant the serving/perf work depends on;
+docs/STATIC_ANALYSIS.md records the incident that motivated each. All
+analysis is stdlib ``ast`` — name/alias based, intentionally
 under-approximate: a rule must never crash on odd code, and a miss is a
 gap to close later, not a reason to over-report.
 """
@@ -75,19 +77,6 @@ def _qualname_of(stack: Sequence[ast.AST]) -> str:
              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
                                ast.ClassDef))]
     return ".".join(parts) or "<module>"
-
-
-def _walk_same_scope(fndef: ast.AST):
-    """Walk a function body WITHOUT descending into nested function
-    definitions — a closure's body runs when the closure runs (often on
-    another thread), not when the enclosing function is called."""
-    work = list(ast.iter_child_nodes(fndef))
-    while work:
-        node = work.pop()
-        yield node
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)):
-            work.extend(ast.iter_child_nodes(node))
 
 
 class _ScopedVisitor(ast.NodeVisitor):
@@ -362,6 +351,7 @@ LOCK_RANK_TABLE: Dict[str, int] = {
     "obs.slo": 78,
     "obs.watchdog": 79,
     "obs.events": 80,
+    "worker.addr": 89,
     "tracer": 90,
     "misc.pool": 90,
     "worker.vision": 90,
@@ -462,24 +452,10 @@ class LockRankRule:
 
     def _check_nesting(self, mod: Module, decls,
                        findings: List[Finding]) -> None:
+        # Call-mediated inversions (any depth) are rule 11's job
+        # (tools/xlint/concurrency.py) — this rule keeps the
+        # declaration check and the static nested-``with`` check only.
         rule = self
-        # First pass: per class, which locks does each method acquire
-        # lexically anywhere inside it (for the one-hop call check).
-        meth_acquires: Dict[Tuple[str, str], List[Tuple[str, int, bool]]]\
-            = {}
-        for cls in [n for n in ast.walk(mod.tree)
-                    if isinstance(n, ast.ClassDef)]:
-            for m in [n for n in cls.body
-                      if isinstance(n, ast.FunctionDef)]:
-                acq = []
-                for w in _walk_same_scope(m):
-                    if isinstance(w, ast.With):
-                        for item in w.items:
-                            ent = self._lock_of(mod.path, cls.name,
-                                                item.context_expr, decls)
-                            if ent:
-                                acq.append(ent)
-                meth_acquires[(cls.name, m.name)] = acq
 
         class V(_ScopedVisitor):
             def __init__(self) -> None:
@@ -511,8 +487,12 @@ class LockRankRule:
                     lockname, rank, reentrant = ent
                     if self.held:
                         top_name, top_rank, top_re = self.held[-1]
-                        same_reentrant = (reentrant and top_re
-                                          and lockname == top_name)
+                        # Re-entering a re-entrant lock the thread
+                        # already holds is legal even with other locks
+                        # acquired in between (the runtime checker
+                        # short-circuits before the rank comparison).
+                        same_reentrant = reentrant and any(
+                            h[0] == lockname for h in self.held)
                         if top_rank >= rank and not same_reentrant:
                             findings.append(Finding(
                                 rule=rule.name, path=mod.path,
@@ -533,34 +513,6 @@ class LockRankRule:
                 for _ in range(added):
                     self.held.pop()
 
-            def visit_Call(self, node: ast.Call) -> None:
-                # One-hop: calling a same-class method that itself
-                # acquires a rank ≤ the one we hold is the same
-                # inversion, one frame deeper.
-                f = node.func
-                if self.held and isinstance(f, ast.Attribute) and \
-                        isinstance(f.value, ast.Name) and \
-                        f.value.id == "self":
-                    cls = self._cls()
-                    top_name, top_rank, top_re = self.held[-1]
-                    for (lockname, rank, reentrant) in \
-                            meth_acquires.get((cls, f.attr), ()):
-                        same_re = (reentrant and top_re
-                                   and lockname == top_name)
-                        if top_rank >= rank and not same_re:
-                            findings.append(Finding(
-                                rule=rule.name, path=mod.path,
-                                line=node.lineno,
-                                key=f"{mod.path}::"
-                                    f"{_qualname_of(self.stack)}::"
-                                    f"call:{f.attr}::"
-                                    f"{top_name}<{lockname}",
-                                message=f"calls self.{f.attr}() — "
-                                        f"which acquires {lockname!r} "
-                                        f"(rank {rank}) — while "
-                                        f"holding {top_name!r} (rank "
-                                        f"{top_rank})"))
-                self.generic_visit(node)
         V().visit(mod.tree)
 
 
@@ -1315,6 +1267,10 @@ class FailpointCatalogRule:
                                      or name.endswith("_failpoints"))
 
 
+from tools.xlint.concurrency import (         # noqa: E402 — rules 11–13
+    BlockingUnderLockRule, LockOrderInterproceduralRule,
+    ThreadRootRaceRule)
+
 RULES = [
     MosaicCompatRule(),
     DonationCoverageRule(),
@@ -1326,4 +1282,7 @@ RULES = [
     MetricsRegistryRule(),
     EventCatalogRule(),
     FailpointCatalogRule(),
+    LockOrderInterproceduralRule(),
+    BlockingUnderLockRule(),
+    ThreadRootRaceRule(LOCK_RANK_TABLE),
 ]

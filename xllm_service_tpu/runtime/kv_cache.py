@@ -285,12 +285,16 @@ class PrefixCacheIndex:
         self.page_size = page_size
         self.seed = seed
         self.enable = enable
-        self._by_hash: Dict[bytes, int] = {}
-        self._hash_of: Dict[int, bytes] = {}
-        self._ref: Dict[int, int] = collections.defaultdict(int)
+        # The index is engine-internal state: every caller path runs
+        # inside an Engine method serialized by the worker's engine
+        # lock (there is deliberately no lock here — adding one would
+        # double-lock the hot admit path).
+        self._by_hash: Dict[bytes, int] = {}    # guarded-by: worker.engine
+        self._hash_of: Dict[int, bytes] = {}    # guarded-by: worker.engine
+        self._ref: Dict[int, int] = collections.defaultdict(int)  # guarded-by: worker.engine
         # page id → last-release time; insertion order ~ LRU.
         self._reclaimable: "collections.OrderedDict[int, float]" = \
-            collections.OrderedDict()
+            collections.OrderedDict()           # guarded-by: worker.engine
         self._pending_event = KvCacheEvent()
         # Tiered spill (engine-wired): called with (hash, page) when a
         # RECLAIMABLE registered page is about to be reused under

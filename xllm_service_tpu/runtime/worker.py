@@ -505,24 +505,32 @@ class Worker:
         self._hb_lock = make_lock("worker.hb", 5)
         # Undelivered heartbeat cache delta (KvCacheEvent), retried on
         # the next beat. Touched only under _hb_lock.
-        self._hb_cache_pending = None
+        self._hb_cache_pending = None           # guarded-by: worker.hb
         # Last-shipped cumulative step_ms bucket counts per
         # (model, phase): the heartbeat diffs against these so
         # LatencyMetrics.step_ms_p99 is the p99 of the steps since the
         # PREVIOUS beat (a recent signal the service watchdog can
         # baseline), not a boot-cumulative average that dampens
         # regressions. Touched only under _hb_lock.
-        self._hb_step_cum: Dict[Any, List[Any]] = {}
+        self._hb_step_cum: Dict[Any, List[Any]] = {}  # guarded-by: worker.hb
         self._decode_to_service = False
         # Heartbeat / generation-push target. Starts at the configured
         # address and FOLLOWS the store's master advertisement
         # (KEY_MASTER_ADDR): after a service-replica takeover the worker
         # retargets instead of orphaning on the dead master's address.
-        self._service_addr = opts.service_addr
+        # The (addr, stale) PAIR is written from two threads — the
+        # store's watch dispatcher (_on_master_addr) and the heartbeat
+        # loop (_adopt_advertised_addr / _refresh_service_config) — so
+        # it gets its own innermost mutex: without it the hb loop's
+        # "stale = not fetched" could clobber a concurrent retarget's
+        # stale=True and never re-fetch the new master's config (xlint
+        # thread-root-race finding XLINT13-001).
+        self._addr_mu = make_lock("worker.addr", 89)
+        self._service_addr = opts.service_addr  # guarded-by: worker.addr
         self._addr_watch: Optional[int] = None
         # Set on retarget; the heartbeat loop re-fetches /rpc/config so
         # the decode-response topology follows the new master's mode.
-        self._service_config_stale = False
+        self._service_config_stale = False      # guarded-by: worker.addr
         # Graceful shutdown: while draining, heartbeats advertise every
         # model as "draining" (the router neither routes to nor wakes
         # those), new generate calls get 503, and stop() waits for
@@ -716,15 +724,34 @@ class Worker:
         """Adopt an advertised master address if it differs from the
         current target. Marks the service config stale — the heartbeat
         loop re-fetches /rpc/config (never HTTP from the watch thread,
-        it must stay responsive to further events)."""
+        it must stay responsive to further events). Compare-and-swap
+        under worker.addr: this runs on BOTH the watch thread and the
+        hb thread (XLINT13-001)."""
         rpc = (info or {}).get("rpc")
-        if not rpc or rpc == self._service_addr:
+        if not rpc:
             return False
+        with self._addr_mu:
+            if rpc == self._service_addr:
+                return False
+            old = self._service_addr
+            self._service_addr = rpc
+            self._service_config_stale = True
         logger.info("service master moved %s -> %s (takeover by %s)",
-                    self._service_addr, rpc, (info or {}).get("service_id"))
-        self._service_addr = rpc
-        self._service_config_stale = True
+                    old, rpc, (info or {}).get("service_id"))
         return True
+
+    def _refresh_service_config(self) -> None:
+        """Fetch /rpc/config for the CURRENT target and update the
+        stale flag atomically with respect to retargets: the flag is
+        cleared only if no retarget landed while the fetch (network
+        I/O, outside the lock) was in flight — otherwise the
+        retarget's stale=True must survive so the NEW master's config
+        is fetched next tick (XLINT13-001 regression shape)."""
+        addr = self.service_addr
+        ok = self._fetch_service_config()
+        with self._addr_mu:
+            if self._service_addr == addr:
+                self._service_config_stale = bool(addr) and not ok
 
     def _adopt_advertised_addr(self) -> bool:
         """Re-read ``KEY_MASTER_ADDR`` and retarget if it moved. The
@@ -3117,8 +3144,7 @@ class Worker:
         return False
 
     def _heartbeat_loop(self) -> None:
-        self._service_config_stale = not self._fetch_service_config() \
-            and bool(self.service_addr)
+        self._refresh_service_config()
         hb_failures = 0
         next_hb = 0.0
         while not self._stop.wait(self.opts.heartbeat_interval_s):
@@ -3138,8 +3164,7 @@ class Worker:
                 if self._lease_id is not None:
                     self.store.lease_keepalive(self._lease_id)
                 if self._service_config_stale:
-                    self._service_config_stale = not \
-                        self._fetch_service_config()
+                    self._refresh_service_config()
                 # The loop keeps ticking at the base cadence (the store
                 # keepalive above MUST — a down master is not a dead
                 # worker), but beat SENDS back off exponentially with

@@ -144,13 +144,24 @@ class InstanceMgr:
     def _bootstrap(self) -> None:
         """Adopt instances already registered in the store
         (instance_mgr.cpp:68-154). They are live by definition of their
-        lease still existing, so they skip the pending phase."""
+        lease still existing, so they skip the pending phase.
+
+        The watches are registered BEFORE this runs (no event gap), so
+        ``_on_instance_event`` can already be firing on the store's
+        dispatch thread — registration must happen under the (re-entrant)
+        lock (xlint thread-root-race finding XLINT13-002:
+        ``_instances``/``_mix_names``/role arrays mutated from the init
+        tail and the watch root with no common guard). The store reads
+        stay OUTSIDE the lock: they are network I/O for the etcd/remote
+        stores (blocking-under-lock)."""
         for itype in InstanceType:
-            for key, val in self.store.get_prefix_json(
-                    instance_prefix(itype.value)).items():
-                meta = InstanceMetaInfo.from_json(val)
-                if meta.name:
-                    self._register(meta, from_bootstrap=True)
+            items = self.store.get_prefix_json(
+                instance_prefix(itype.value))
+            with self._lock:
+                for key, val in items.items():
+                    meta = InstanceMetaInfo.from_json(val)
+                    if meta.name:
+                        self._register(meta, from_bootstrap=True)
 
     def _on_instance_event(self, event) -> None:
         ev_type, key, value = event
@@ -217,26 +228,52 @@ class InstanceMgr:
         """First heartbeat of a pending instance completes registration
         (instance_mgr.cpp:423-439). Returns True if the instance is (now)
         registered."""
+        if self._heartbeat_locked(hb, None):
+            return True
+        # Unknown instance with nothing pending: the heartbeat raced
+        # ahead of the watch's PUT. Read through to the store OUTSIDE
+        # the lock — it is network I/O on the etcd/remote stores, and
+        # on_heartbeat runs on the RPC fan-in path where every
+        # scheduler/route thread contends for this lock (xlint
+        # blocking-under-lock finding XLINT12-001) — then retry with
+        # the fetched meta. _heartbeat_locked re-checks _removed under
+        # the lock, so a removal landing mid-read still wins.
+        with self._lock:
+            if hb.name in self._removed:
+                return False
+        val = self.store.get_json(
+            instance_prefix(hb.instance_type.value) + hb.name)
+        if not val:
+            return False
+        return self._heartbeat_locked(hb, InstanceMetaInfo.from_json(val))
+
+    def _heartbeat_locked(self, hb: Heartbeat,
+                          fallback_meta: Optional[InstanceMetaInfo]
+                          ) -> bool:
+        """One locked heartbeat-apply attempt; ``fallback_meta`` is the
+        out-of-lock store read-through result (None on the first try)."""
+        stage: Optional[InstanceState] = None
         with self._lock:
             inst = self._instances.get(hb.name)
             if inst is None:
                 meta = self._pending.pop(hb.name, None)
+                if meta is None and hb.name not in self._removed:
+                    meta = fallback_meta
                 if meta is None:
-                    if hb.name not in self._removed:
-                        # Heartbeat before the watch delivered the PUT:
-                        # read-through to the store.
-                        val = self.store.get_json(
-                            instance_prefix(hb.instance_type.value) + hb.name)
-                        if val:
-                            meta = InstanceMetaInfo.from_json(val)
-                    if meta is None:
-                        return False
+                    return False
                 inst = self._register(meta)
+                if self.serverless_models and self.is_master:
+                    stage = inst
             inst.last_heartbeat = time.monotonic()
             inst.load = hb.load
             inst.latency = hb.latency
             if hb.model_states:
                 inst.model_states.update(hb.model_states)
+        if stage is not None:
+            # Control I/O OUTSIDE the lock (XLINT12-002): the staging
+            # round trip can take up to the control timeout, and every
+            # routing thread contends for the instance lock.
+            self._fork_master_and_sleep(stage)
         return True
 
     def _register(self, meta: InstanceMetaInfo,
@@ -285,8 +322,11 @@ class InstanceMgr:
             self._set_role(meta.name, itype)
         for m in meta.models:
             inst.model_states[m] = MODEL_AWAKE
-        if self.serverless_models and not from_bootstrap and self.is_master:
-            self._fork_master_and_sleep(inst)
+        # Serverless staging (_fork_master_and_sleep) is the CALLER's
+        # job after releasing the lock: it is an up-to-120 s control
+        # HTTP round trip, the same blocking-under-lock class as
+        # XLINT12-001 (finding XLINT12-002). _register is always
+        # invoked under self._lock, so it must never do I/O.
         if self.events is not None:
             self.events.emit(
                 "instance_confirm", instance=meta.name,
@@ -301,16 +341,20 @@ class InstanceMgr:
         (weights parked in host RAM, compiled executables cached) —
         the TPU translation of /fork_master + /sleep per model
         (instance_mgr.cpp:229-260, SURVEY.md §7.1)."""
-        extra = [m for m in self.serverless_models
-                 if m not in inst.model_states]
+        with self._lock:
+            extra = [m for m in self.serverless_models
+                     if m not in inst.model_states]
         if not extra:
             return
         try:
+            # The control round trip runs UNLOCKED (XLINT12-002); only
+            # the resulting state flip goes back under the lock.
             status, _ = self.control(inst.meta.rpc_address, "/fork_master",
                                      {"models": extra})
             if status == 200:
-                for m in extra:
-                    inst.model_states[m] = MODEL_ASLEEP
+                with self._lock:
+                    for m in extra:
+                        inst.model_states[m] = MODEL_ASLEEP
         except Exception as e:  # noqa: BLE001
             logger.warning("fork_master_and_sleep(%s) failed: %s",
                            inst.name, e)

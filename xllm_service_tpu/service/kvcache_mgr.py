@@ -63,10 +63,10 @@ class GlobalKVCacheMgr:
         self.seed = seed
         self.is_master = is_master
         self._lock = make_lock("kvcache_mgr", 35)
-        self._index: Dict[bytes, CacheLocations] = {}
+        self._index: Dict[bytes, CacheLocations] = {}  # guarded-by: kvcache_mgr
         # Deltas accumulated since the last master upload, keyed by digest:
         # value None → block gone everywhere (delete the store key).
-        self._dirty: Dict[bytes, Optional[Dict[str, List[str]]]] = {}
+        self._dirty: Dict[bytes, Optional[Dict[str, List[str]]]] = {}  # guarded-by: kvcache_mgr
         self._watch_id: Optional[int] = None
         if not is_master:
             self._watch_id = store.add_watch(KEY_CACHE, self._on_watch)
@@ -76,10 +76,20 @@ class GlobalKVCacheMgr:
     # Bootstrap / replication
     # ------------------------------------------------------------------
     def _bootstrap(self) -> None:
-        """Load the persisted index (global_kvcache_mgr.cpp:45-49)."""
-        for key, val in self.store.get_prefix_json(KEY_CACHE).items():
-            digest = bytes.fromhex(key[len(KEY_CACHE):])
-            self._apply_locations(digest, val)
+        """Load the persisted index (global_kvcache_mgr.cpp:45-49).
+
+        The watch is registered BEFORE this runs (no event gap), so
+        ``_on_watch`` can already be firing on the store's dispatch
+        thread — the index writes must happen under the lock (xlint
+        thread-root-race finding XLINT13-003: ``GlobalKVCacheMgr._index``
+        mutated from the init tail and the watch root with no common
+        guard). The store read stays OUTSIDE the lock: it is network
+        I/O for the etcd/remote stores (blocking-under-lock)."""
+        items = self.store.get_prefix_json(KEY_CACHE)
+        with self._lock:
+            for key, val in items.items():
+                digest = bytes.fromhex(key[len(KEY_CACHE):])
+                self._apply_locations(digest, val)
 
     def _on_watch(self, event) -> None:
         ev_type, key, value = event

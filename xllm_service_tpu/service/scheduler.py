@@ -160,7 +160,9 @@ class Scheduler:
             os.environ.get("XLLM_KV_FETCH_MIN_BLOCKS"), 1))
 
         self._addresses: Optional[Dict[str, str]] = None
-        self._requests: Dict[str, _TrackedRequest] = {}
+        # Tracked-request registry: every mutation site (admission,
+        # fan-in delivery, finish, recovery retarget) holds _req_lock.
+        self._requests: Dict[str, _TrackedRequest] = {}  # guarded-by: scheduler.req
         self._req_lock = make_lock("scheduler.req", 10)
         self._pools = OrderedFanInPools(opts.num_output_pools)
 

@@ -39,6 +39,12 @@ increasing):
     80  obs.events                      — cluster event ring (never
                                           calls out; safe under every
                                           serving-path lock)
+    89  worker.addr                     — master-address + config-stale
+                                          pair (innermost CAS, never
+                                          calls out; written from the
+                                          watch dispatcher AND the hb
+                                          loop, acquirable while any
+                                          serving-path lock is held)
     90  leaves: tracer, misc.pool (fan-in), worker.vision
     91  misc.counter                    — may be bumped under any leaf
     92  httpd.connpool                  — guards the keep-alive dict only
@@ -55,7 +61,15 @@ This table is machine-checked: ``tools/xlint`` (rule ``lock-rank``)
 verifies every ``make_lock``/``make_rlock`` declaration against its
 mirror copy (``LOCK_RANK_TABLE`` in tools/xlint/rules.py) and statically
 rejects nested ``with``-lock scopes that acquire out of rank order —
-update BOTH tables when adding a lock.
+update BOTH tables when adding a lock. Beyond the lexical check, rule
+``lock-order-interprocedural`` closes lock acquisition over the
+whole-program call graph and PROVES the acquires-while-holding edge set
+acyclic on every tier-1 run
+(tests/test_xlint.py::test_rank_table_proven_acyclic): the table is
+deadlock-free by construction, not by convention. The observed edge set
+and every thread root's transitive lock-set are catalogued in
+docs/CONCURRENCY.md (regenerate with
+``python -m tools.xlint --concurrency-report``).
 """
 
 from __future__ import annotations
