@@ -457,6 +457,59 @@ class TestEngineSpillRestore:
         ev = eng.drain_kvcache_event()
         assert ev.stored
 
+    @pytest.mark.slow  # two pressure runs (~35 s); the standing
+    # tier-1 gate for this class is xlint rule 15 (resource-leak),
+    # which pins the try/finally shape statically on every run
+    def test_failed_restore_releases_pins_pages_and_reparks_tier(
+            self, monkeypatch):
+        """xlint rule-15 finding (PR 9): a restore scatter that raises
+        must unpin the chain's HBM members, send the freshly-alloc'd
+        pages back to the allocator, and re-park the popped tier blocks
+        — then the SAME prefix must still restore cleanly once the
+        fault clears (byte-identical)."""
+        import xllm_service_tpu.runtime.engine as engine_mod
+        eng = _tiny_engine()
+        p1 = [7] * 5 + list(range(40))
+        out1 = _run(eng, p1, "a")
+        _run(eng, list(range(100, 330, 1))[:230], "b")   # force spill
+        assert eng.prefix_cache_stats()["spilled_pages"] > 0
+        idx = eng.prefix_cache
+
+        def accounted_pages():
+            # every page is free, referenced, or reclaimable-cached;
+            # a leak shows up as a page in NONE of the three
+            return (idx.allocator.num_free + len(idx._ref)
+                    + len(idx._reclaimable))
+
+        free_before = idx.allocator.num_free
+        refs_before = dict(idx._ref)
+        total_before = accounted_pages()
+        hashes = idx.block_hashes(p1)
+        tier_before = [h for h in hashes if h in eng.host_tier]
+        assert tier_before, "pressure run never spilled p1's lead"
+
+        real_scatter = engine_mod._kv_scatter
+
+        def exploding_scatter(*a, **kw):
+            raise RuntimeError("injected scatter failure")
+
+        monkeypatch.setattr(engine_mod, "_kv_scatter",
+                            exploding_scatter)
+        with pytest.raises(RuntimeError, match="injected scatter"):
+            eng._restore_spilled(p1, [], 0)
+        # no page vanished (the alloc's pressure-reclaim may have
+        # legitimately evicted a reclaimable mapping — more free pages
+        # are fine, fewer accounted ones are the leak)
+        assert accounted_pages() == total_before
+        assert idx.allocator.num_free >= free_before
+        # no pins left behind: the ref book is exactly as before
+        assert dict(idx._ref) == refs_before
+        # every tier block the restore popped is re-parked
+        assert all(h in eng.host_tier for h in tier_before)
+        # fault cleared: the prefix restores and decodes byte-identical
+        monkeypatch.setattr(engine_mod, "_kv_scatter", real_scatter)
+        assert _run(eng, p1, "c") == out1
+
     def test_spill_off_by_default(self):
         eng = _tiny_engine(num_pages=8, spill_mb=0.0)
         assert eng.host_tier is None

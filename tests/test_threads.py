@@ -1,0 +1,261 @@
+"""utils/threads.py — the supervised-thread runtime and the
+crash-safety contract (docs/ROBUSTNESS.md).
+
+Three layers: units on spawn's handler (log + count + event, bounded-
+backoff restart, stop-interruptible backoff, BaseException pass-
+through); regression tests pinning the telemetry the dispatch-path
+swallow fixes added (fan-in pool and store watch dispatcher survive a
+crashing callback AND count it); and the acceptance e2e — an injected
+`worker.crash_heartbeat` failpoint crashes a live worker's heartbeat
+loop, which restarts under supervision, increments
+`xllm_thread_crashes_total{root="worker.hb_loop"}`, and emits
+`thread_crashed`, without killing the worker or expiring its lease.
+"""
+
+import threading
+import time
+
+import pytest
+
+from xllm_service_tpu.obs import EventLog, Registry
+from xllm_service_tpu.utils import threads
+from xllm_service_tpu.utils.misc import OrderedFanInPools
+from xllm_service_tpu.utils.retry import RetryPolicy
+from xllm_service_tpu.utils.threads import spawn
+
+
+def wait_until(cond, timeout=15.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+def _crashes(root):
+    return threads.crash_counts().get(root, 0)
+
+
+def _cb_errors(root):
+    return threads.callback_error_counts().get(root, 0)
+
+
+class TestSpawn:
+    def test_crash_logs_counts_and_emits(self):
+        events = EventLog(capacity=16)
+        before = _crashes("t.crash")
+
+        def boom():
+            raise RuntimeError("kaboom")
+
+        t = spawn("t.crash", boom, events=events)
+        t.start()
+        t.join(5)
+        assert not t.is_alive()
+        assert _crashes("t.crash") == before + 1
+        evs = [e for e in events.since() if e["type"] == "thread_crashed"]
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["root"] == "t.crash"
+        assert evs[0]["attrs"]["restarting"] is False
+        assert "kaboom" in evs[0]["attrs"]["error"]
+
+    def test_restart_reruns_target_until_clean_exit(self):
+        runs = [0]
+        stop = threading.Event()
+
+        def flaky():
+            runs[0] += 1
+            if runs[0] < 3:
+                raise ValueError("transient")
+            stop.set()          # third run ends cleanly
+
+        before = _crashes("t.restart")
+        t = spawn("t.restart", flaky,
+                  restart=RetryPolicy(base_delay_s=0.01,
+                                      max_delay_s=0.05, jitter=0),
+                  stop=stop)
+        t.start()
+        t.join(10)
+        assert runs[0] == 3
+        assert _crashes("t.restart") == before + 2
+
+    def test_stop_interrupts_restart_backoff(self):
+        stop = threading.Event()
+
+        def always():
+            raise RuntimeError("dead again")
+
+        t = spawn("t.stopper", always,
+                  restart=RetryPolicy(base_delay_s=30.0,
+                                      max_delay_s=30.0, jitter=0),
+                  stop=stop)
+        t.start()
+        assert wait_until(lambda: _crashes("t.stopper") >= 1)
+        stop.set()
+        t.join(5)
+        assert not t.is_alive()
+
+    def test_base_exception_recorded_not_restarted(self):
+        before = _crashes("t.sysexit")
+
+        def die():
+            raise SystemExit(3)
+
+        t = spawn("t.sysexit", die,
+                  restart=RetryPolicy(base_delay_s=0.01, jitter=0))
+        t.start()
+        t.join(5)
+        assert not t.is_alive()
+        assert _crashes("t.sysexit") == before + 1
+
+    def test_events_lazy_provider_resolved_at_crash_time(self):
+        holder = {"log": None}
+
+        def boom():
+            raise RuntimeError("late-bound sink")
+
+        t = spawn("t.lazy", boom, events=lambda: holder["log"])
+        holder["log"] = EventLog(capacity=4)   # attached after spawn
+        t.start()
+        t.join(5)
+        assert any(e["type"] == "thread_crashed"
+                   for e in holder["log"].since())
+
+    def test_flush_metrics_mirrors_both_books(self):
+        def boom():
+            raise RuntimeError("for the books")
+
+        t = spawn("t.metrics", boom)
+        t.start()
+        t.join(5)
+        threads.record_callback_error("t.cb", RuntimeError("cb"))
+        reg = Registry()
+        threads.flush_metrics(reg)
+        text = reg.render()
+        assert 'xllm_thread_crashes_total{root="t.metrics"}' in text
+        assert 'xllm_callback_errors_total{root="t.cb"}' in text
+
+
+class TestPoolTelemetryRegressions:
+    """The rule-16 dispatch-path fixes: a crashing callback must leave
+    the pool alive AND leave a count behind (not a stderr print)."""
+
+    def test_fanin_pool_survives_and_counts(self):
+        pools = OrderedFanInPools(num_pools=2)
+        try:
+            before = _cb_errors("misc.fanin")
+            done = threading.Event()
+
+            def bad():
+                raise RuntimeError("bad fan-in callback")
+
+            pools.submit("req-1", bad)
+            pools.submit("req-1", done.set)   # same pool: runs after
+            assert done.wait(5), "pool died after a bad callback"
+            assert wait_until(
+                lambda: _cb_errors("misc.fanin") == before + 1)
+        finally:
+            pools.stop()
+
+    def test_store_dispatch_survives_and_counts(self):
+        from xllm_service_tpu.service.coordination import InMemoryStore
+        store = InMemoryStore(sweep_interval_s=5.0)
+        try:
+            before = _cb_errors("coord.dispatch")
+            seen = []
+
+            def bad_cb(ev):
+                raise RuntimeError("bad watch callback")
+
+            store.add_watch("K:", bad_cb)
+            store.add_watch("K:", lambda ev: seen.append(ev))
+            store.put("K:one", "1")
+            store.put("K:two", "2")
+            # the recorder sees BOTH events: the dispatcher survived
+            # the raising sibling both times, and counted both
+            assert wait_until(lambda: len(seen) == 2)
+            assert wait_until(
+                lambda: _cb_errors("coord.dispatch") == before + 2)
+        finally:
+            store.close()
+
+    def test_etcd_safe_callback_counts(self):
+        from xllm_service_tpu.service.etcd_store import _safe_callback
+        before = _cb_errors("etcd.watch_loop")
+
+        def bad_cb(ev):
+            raise RuntimeError("bad etcd callback")
+
+        _safe_callback(bad_cb, ("PUT", "k", "v"))   # must not raise
+        assert _cb_errors("etcd.watch_loop") == before + 1
+
+
+class TestHeartbeatCrashRestart:
+    """Acceptance (ISSUE 9): an injected exception crashes the live
+    worker's heartbeat loop; supervision restarts it with backoff; the
+    crash is counted on /metrics and emitted as thread_crashed; the
+    worker keeps serving and its lease never expires."""
+
+    def test_crashed_heartbeat_restarts_without_killing_worker(self):
+        from xllm_service_tpu.config import (
+            EngineConfig, InstanceType, LoadBalancePolicyType,
+            ServiceOptions)
+        from xllm_service_tpu.runtime.worker import Worker, WorkerOptions
+        from xllm_service_tpu.service.coordination import InMemoryStore
+        from xllm_service_tpu.service.master import Master
+
+        store = InMemoryStore(sweep_interval_s=0.02)
+        opts = ServiceOptions(
+            http_port=0, rpc_port=0, num_output_pools=2,
+            load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
+            block_size=16, heartbeat_interval_s=0.2,
+            master_upload_interval_s=0.2,
+            detect_disconnected_instance_interval_s=1.0)
+        master = Master(opts, store=store).start()
+        worker = None
+        try:
+            wopts = WorkerOptions(
+                port=0, instance_type=InstanceType.DEFAULT,
+                service_addr=master.rpc_address, model="tiny",
+                heartbeat_interval_s=0.1, lease_ttl_s=1.5)
+            worker = Worker(wopts, store, engine_cfg=EngineConfig(
+                page_size=16, num_pages=64, max_model_len=256,
+                max_batch_size=4, max_prefill_tokens=256,
+                prefill_buckets=(32, 64))).start()
+            mgr = master.scheduler.instance_mgr
+            assert wait_until(
+                lambda: len(mgr.prefill_instances()) == 1,
+                timeout=20.0), "worker never registered"
+
+            before = _crashes("worker.hb_loop")
+            worker.failpoints.arm("worker.crash_heartbeat",
+                                  mode="count", n=1)
+            # the loop crashes exactly once, supervision restarts it
+            assert wait_until(
+                lambda: _crashes("worker.hb_loop") == before + 1,
+                timeout=10.0), "injected crash never recorded"
+            crashed = [e for e in worker.events.since()
+                       if e["type"] == "thread_crashed"]
+            assert crashed and \
+                crashed[-1]["attrs"]["root"] == "worker.hb_loop"
+            assert crashed[-1]["attrs"]["restarting"] is True
+            assert wait_until(lambda: worker._hb_thread.is_alive(),
+                              timeout=5.0)
+
+            # the worker OUTLIVES the crash: its lease (1.5 s) would
+            # have expired on a dead beat loop well inside this window
+            time.sleep(3.0)
+            assert len(mgr.prefill_instances()) == 1, \
+                "lease expired — the heartbeat loop stayed dead"
+            assert _crashes("worker.hb_loop") == before + 1, \
+                "count:1 failpoint must crash exactly once"
+            # and the crash is scrape-visible on the worker's /metrics
+            body = worker._serve_metrics(None).body.decode()
+            assert ('xllm_thread_crashes_total{'
+                    'root="worker.hb_loop"}') in body
+        finally:
+            if worker is not None:
+                worker.stop()
+            master.stop()
+            store.close()

@@ -1,0 +1,68 @@
+"""Positive controls for rules 14-16 (lifecycle): an escaping-raise
+thread root, a leak on an exception edge, a leak on a branch, a
+discarded handle, and a telemetry-free broad swallow. Never imported."""
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+_POOL = None            # stands in for the process-global conn pool
+
+
+class CrashyRoots:
+    """Rule 14: _beat_loop lets RuntimeError escape through _tick —
+    silent thread death. _handled_loop (broad handler + telemetry) must
+    NOT fire."""
+
+    def start(self):
+        threading.Thread(target=self._beat_loop, daemon=True).start()
+        threading.Thread(target=self._handled_loop, daemon=True).start()
+
+    def _beat_loop(self):
+        while True:
+            self._tick()
+
+    def _tick(self):
+        raise RuntimeError("boom")
+
+    def _handled_loop(self):
+        while True:
+            try:
+                self._tick()
+            except Exception:
+                logger.exception("tick failed")   # logs AND counts
+                self.crash_counter.inc()
+
+
+class LeakyResources:
+    """Rule 15: acquires that do not reach their release on every
+    path."""
+
+    def leak_on_exception_edge(self, pages):
+        # compute() between acquire and release can raise: the pins
+        # leak on that edge (no try/finally).
+        self.prefix_cache.acquire_pages(pages)
+        self.compute(pages)
+        self.prefix_cache.release_pages(pages)
+
+    def leak_on_branch(self, addr):
+        conn, reused = _POOL.get(addr, 5.0)
+        if reused:
+            _POOL.put(addr, conn)
+        return reused             # fresh-conn path never returns it
+
+    def discarded_handle(self, path):
+        open(path)                # nothing can ever close it
+        return True
+
+
+class Swallower:
+    """Rule 16: a broad except that neither re-raises nor reaches any
+    telemetry, with no inline justification."""
+
+    def drop(self, req):
+        try:
+            return req.handle()
+        except Exception:
+            return None

@@ -1,0 +1,93 @@
+"""Near-miss patterns for rules 14-16 — none may fire.
+
+Rule 14: a supervised spawn root (escapes irrelevant — the wrapper
+handles them) and a bare-Thread root whose body is fully handled with
+telemetry. Rule 15: acquire/release under try/finally, a straight-line
+pair with nothing raising in between, a ``with`` handle, and a declared
+ownership transfer. Rule 16: a re-raising handler, an inline-justified
+swallow, and telemetry reached THROUGH a callee (the interprocedural
+credit lexical checkers can't give)."""
+
+import logging
+import threading
+
+from xllm_service_tpu.utils.threads import spawn
+
+logger = logging.getLogger(__name__)
+
+_POOL = None
+
+
+class SupervisedRoot:
+    def start(self):
+        self._t = spawn("clean.loop", self._loop, restart=None)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            self.work()          # supervised: the spawn handler covers it
+
+
+class HandledRoot:
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                self.work()
+            except Exception:
+                logger.exception("work failed")
+                self.failures.inc()
+
+
+class CleanResources:
+    def pin_under_finally(self, pages):
+        self.prefix_cache.acquire_pages(pages)
+        try:
+            self.scatter(pages)
+        finally:
+            self.prefix_cache.release_pages(pages)
+
+    def straightline_pair(self, pages):
+        self.prefix_cache.acquire_pages(pages)
+        self.prefix_cache.release_pages(pages)
+
+    def with_handle(self, path):
+        with open(path, "r") as f:
+            return f.read()
+
+    def declared_transfer(self, pages):
+        self.prefix_cache.acquire_pages(pages)  # xlint: transfer — pins ride the returned chain, released at seq finish
+        return pages
+
+    def pooled_exchange(self, addr):
+        conn, reused = _POOL.get(addr, 5.0)
+        try:
+            self.exchange(conn)
+        finally:
+            _POOL.put(addr, conn)
+
+
+class DeliberateHandlers:
+    def reraises(self, req):
+        try:
+            return req.handle()
+        except Exception:
+            raise
+
+    def justified(self, req):
+        try:
+            return req.handle()
+        except Exception:  # noqa: BLE001 — fallback value is the contract
+            return None
+
+    def telemetry_via_helper(self, req):
+        try:
+            return req.handle()
+        except Exception:
+            return self._fallback()
+
+    def _fallback(self):
+        logger.warning("request fell back to the default answer")
+        return None

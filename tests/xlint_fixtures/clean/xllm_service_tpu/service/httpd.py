@@ -1,8 +1,11 @@
 """Clean fixture: thread-target sleeps, bounded waits, narrow excepts,
 and a justified swallow — none may fire."""
 
+import logging
 import threading
 import time
+
+logger = logging.getLogger(__name__)
 
 
 class Server:
@@ -11,9 +14,14 @@ class Server:
 
     def _sweep_loop(self):
         while True:
-            time.sleep(1.0)      # dedicated background thread: legal
-            fut = self.next_job()
-            fut.result()         # blocking here is the thread's job
+            try:
+                time.sleep(1.0)  # dedicated background thread: legal
+                fut = self.next_job()
+                fut.result()     # blocking here is the thread's job
+            except Exception:
+                # crash-handled bare-Thread root: logs AND counts
+                logger.exception("sweep failed")
+                self.sweep_failures.inc()
 
     def dispatch(self, req):
         fut = req.submit()

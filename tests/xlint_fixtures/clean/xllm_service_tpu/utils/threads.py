@@ -1,0 +1,8 @@
+"""Fixture mirror of the real utils/threads.py — just enough surface
+for the clean tree's spawn call sites to resolve (the callgraph matches
+any ``utils/threads.py::spawn``)."""
+
+
+def spawn(name, target, *, args=(), kwargs=None, daemon=True,
+          restart=None, events=None, stop=None, thread_name=None):
+    return None

@@ -374,9 +374,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # that introduces any of them can live in ANY file, so
         # diff-scoping them would let a deadlock-introducing (or
         # hygiene-breaking) change pass the CI gate.
+        # Rules 14–16 join 11–13 here: a crash-prone root, a leak, or a
+        # telemetry-free swallow is attributed to the defining module,
+        # but the edit that introduces it (a new callee that raises, a
+        # removed release in a helper) can live in any file.
         whole_program = {"lock-order-interprocedural",
                          "blocking-under-lock", "thread-root-race",
-                         "allowlist"}
+                         "thread-root-crash", "resource-leak",
+                         "swallow-telemetry", "allowlist"}
         findings = [f for f in findings
                     if f.path in changed or f.rule in whole_program]
 

@@ -151,12 +151,20 @@ class ThreadRoot:
 
     rid: str                   # display id, e.g. worker.py::Worker._engine_loop
     fid: Optional[str]         # resolved FuncInfo id (None: dynamic)
-    via: str                   # "Thread" | "Timer" | "submit" | "lambda"
-    path: str                  # | "route" | "watch" | "init-tail"
+    # via values: "Thread" | "Timer" | "spawn" | "submit" | "lambda"
+    # | "route" | "watch" | "init-tail"
+    via: str
+    path: str
     line: int
     entries: List[Tuple[str, HeldStack]] = \
         dataclasses.field(default_factory=list)
     extra_sites: List[AttrSite] = dataclasses.field(default_factory=list)
+    # True when the root was registered through utils/threads.spawn —
+    # the supervised top-level handler (log + count + event, optional
+    # restart) is installed by construction (rule 14's pass condition).
+    supervised: bool = False
+    # True when the spawn site passed a restart= policy.
+    restart: bool = False
 
 
 class CallGraph:
@@ -403,6 +411,9 @@ def build(tree: RepoTree) -> CallGraph:
         w = _Walker(cg, fi, envs[fi.path])
         w.walk()
         walkers[fi.fid] = w
+    # kept for clients that need per-function resolution again without
+    # re-scanning every body (the lifecycle rules' exception-flow pass)
+    cg._walkers = walkers
 
     # ---- pass 4: thread roots (reuses pass 3's walkers — their
     # construction re-scans the whole function body) -------------------
@@ -1018,13 +1029,46 @@ def _collect_roots(cg: CallGraph, envs: Dict[str, _ModuleEnv],
                 if not resolved:
                     _dynamic_root(cg, fi, via, node.lineno, seen)
                 continue
-            # executor / fan-in pool submission
-            if isinstance(f, ast.Attribute) and f.attr == "submit":
+            # utils/threads.spawn(name, target, ...) — the supervised
+            # constructor: still a thread root (rules 11-13 analyze it
+            # like any other), but marked supervised so rule 14 knows
+            # the crash handler is installed by construction.
+            if _is_spawn_call(walker, node):
+                target = node.args[1] if len(node.args) >= 2 else None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+                has_restart = any(
+                    kw.arg == "restart"
+                    and not (isinstance(kw.value, ast.Constant)
+                             and kw.value.value is None)
+                    for kw in node.keywords)
+                resolved = 0
+                if target is not None:
+                    resolved = _register_root(
+                        cg, walker, fi, target, "spawn", node.lineno,
+                        seen, supervised=True, restart=has_restart)
+                if not resolved:
+                    _dynamic_root(cg, fi, "spawn", node.lineno, seen,
+                                  supervised=True, restart=has_restart)
+                continue
+            # executor / fan-in pool submission (an ARGLESS .submit()
+            # carries no callable — not a spawn site). A lambda handed
+            # to a REPO-side pool (the receiver's .submit resolves to a
+            # repo method, e.g. OrderedFanInPools) runs under that
+            # dispatcher — a checked root itself — and stays "lambda";
+            # a lambda handed to an EXTERNAL executor
+            # (concurrent.futures) lands in a never-result()ed Future,
+            # so it keeps via "submit" and rule 14 checks it.
+            if isinstance(f, ast.Attribute) and f.attr == "submit" \
+                    and node.args:
+                repo_pool = bool(walker.resolve_callees(f)[0])
                 resolved = 0
                 for arg in node.args:
-                    resolved += _register_root(cg, walker, fi, arg,
-                                               "submit", node.lineno,
-                                               seen)
+                    resolved += _register_root(
+                        cg, walker, fi, arg, "submit", node.lineno,
+                        seen,
+                        lam_via="lambda" if repo_pool else "submit")
                 if not resolved:
                     _dynamic_root(cg, fi, "submit", node.lineno, seen)
             # HTTP route handlers run on request-pool threads
@@ -1043,6 +1087,13 @@ def _collect_roots(cg: CallGraph, envs: Dict[str, _ModuleEnv],
             _init_tail_root(cg, fi, seen)
 
 
+def _is_spawn_call(walker: "_Walker", node: ast.Call) -> bool:
+    """The call resolves to a ``spawn`` defined in a ``utils/threads``
+    module (the real package's, or a fixture tree's mirror)."""
+    fids, _reason = walker.resolve_callees(node.func)
+    return any(fid.endswith("utils/threads.py::spawn") for fid in fids)
+
+
 def _has_from_threading(mod: Module, name: str) -> bool:
     for node in ast.walk(mod.tree):
         if isinstance(node, ast.ImportFrom) and \
@@ -1055,8 +1106,22 @@ def _has_from_threading(mod: Module, name: str) -> bool:
 
 def _register_root(cg: CallGraph, walker: _Walker, fi: FuncInfo,
                    expr: ast.AST, via: str, line: int,
-                   seen: Set[Tuple[str, Optional[str]]]) -> int:
-    """→ number of resolvable roots registered for this expression."""
+                   seen: Set[Tuple[str, Optional[str]]],
+                   supervised: bool = False,
+                   restart: bool = False,
+                   lam_via: Optional[str] = None) -> int:
+    """→ number of resolvable roots registered for this expression.
+
+    ``lam_via`` is the via lambdas receive: dedicated-thread
+    constructors keep their own via (`Thread(target=lambda: f())` runs
+    f on its own thread — relabeling it "lambda" would exempt it from
+    rule 14's dedicated-root check), external-executor submits pass
+    "submit" (a dropped Future is silent death), and pool/route/watch
+    callables default to "lambda" (their dispatcher is the checked
+    root)."""
+    if lam_via is None:
+        lam_via = via if via in ("Thread", "Timer", "spawn") \
+            else "lambda"
     # functools.partial(f, ...) → f
     if isinstance(expr, ast.Call):
         f = expr.func
@@ -1064,7 +1129,8 @@ def _register_root(cg: CallGraph, walker: _Walker, fi: FuncInfo,
                 or (isinstance(f, ast.Name) and f.id == "partial")) \
                 and expr.args:
             return _register_root(cg, walker, fi, expr.args[0], via,
-                                  line, seen)
+                                  line, seen, supervised=supervised,
+                                  restart=restart, lam_via=lam_via)
         return 0
     if isinstance(expr, ast.Lambda):
         # every resolvable call inside the lambda becomes a root
@@ -1078,9 +1144,10 @@ def _register_root(cg: CallGraph, walker: _Walker, fi: FuncInfo,
                     if key not in seen:
                         seen.add(key)
                         cg.roots.append(ThreadRoot(
-                            rid=fid, fid=fid, via="lambda",
+                            rid=fid, fid=fid, via=lam_via,
                             path=fi.path, line=line,
-                            entries=[(fid, ())]))
+                            entries=[(fid, ())],
+                            supervised=supervised, restart=restart))
         return n
     if isinstance(expr, (ast.Name, ast.Attribute)):
         fid, _ = walker.resolve_callee(expr)
@@ -1090,13 +1157,26 @@ def _register_root(cg: CallGraph, walker: _Walker, fi: FuncInfo,
                 seen.add(key)
                 cg.roots.append(ThreadRoot(
                     rid=fid, fid=fid, via=via, path=fi.path, line=line,
-                    entries=[(fid, ())]))
+                    entries=[(fid, ())],
+                    supervised=supervised, restart=restart))
+            elif not supervised:
+                # The same target is ALSO started through an
+                # unsupervised constructor: neither supervision nor a
+                # restart policy may be claimed for a root that can
+                # run bare.
+                for r in cg.roots:
+                    if r.path == fi.path and r.fid == fid:
+                        r.supervised = False
+                        r.restart = False
+                        break
             return 1
     return 0
 
 
 def _dynamic_root(cg: CallGraph, fi: FuncInfo, via: str, line: int,
-                  seen: Set[Tuple[str, Optional[str]]]) -> None:
+                  seen: Set[Tuple[str, Optional[str]]],
+                  supervised: bool = False,
+                  restart: bool = False) -> None:
     """A thread-spawn site whose target nothing resolved — recorded so
     the coverage hole is visible in the concurrency report, never
     silently dropped."""
@@ -1105,7 +1185,8 @@ def _dynamic_root(cg: CallGraph, fi: FuncInfo, via: str, line: int,
     if key not in seen:
         seen.add(key)
         cg.roots.append(ThreadRoot(
-            rid=rid, fid=None, via=via, path=fi.path, line=line))
+            rid=rid, fid=None, via=via, path=fi.path, line=line,
+            supervised=supervised, restart=restart))
 
 
 def _init_tail_root(cg: CallGraph, fi: FuncInfo,
@@ -1122,8 +1203,10 @@ def _init_tail_root(cg: CallGraph, fi: FuncInfo,
                 isinstance(node.value, ast.Call):
             vf = node.value.func
             is_thread_ctor = (
-                (isinstance(vf, ast.Attribute) and vf.attr == "Thread")
-                or (isinstance(vf, ast.Name) and vf.id == "Thread"))
+                (isinstance(vf, ast.Attribute)
+                 and vf.attr in ("Thread", "spawn"))
+                or (isinstance(vf, ast.Name)
+                    and vf.id in ("Thread", "spawn")))
             if is_thread_ctor:
                 for t in node.targets:
                     if isinstance(t, ast.Attribute):

@@ -560,9 +560,12 @@ def _short_root(rid: str) -> str:
 
 def report(tree: RepoTree) -> Dict[str, object]:
     """The machine-readable whole-program concurrency summary: thread
-    roots with transitive lock-sets, the acquires-while-holding edge
+    roots with transitive lock-sets (plus each root's crash-handling
+    verdict from the rule-14 analysis), the acquires-while-holding edge
     set, the acyclicity verdict, and the pinned coverage holes."""
+    from tools.xlint.lifecycle import lifecycle_analyze
     a = analyze(tree)
+    la = lifecycle_analyze(tree)
     cg = a.cg
     roots = []
     for r in sorted(cg.roots, key=lambda r: r.rid):
@@ -573,10 +576,28 @@ def report(tree: RepoTree) -> Dict[str, object]:
             for fid in cgm.reachable_from(cg, seeds):
                 names.update(a.trans_locks.get(fid, {}).keys())
             locks = sorted(names)
+        # Crash-handling verdict (docs/CONCURRENCY.md's supervision
+        # column): supervised spawn (± restart), an escape-free body,
+        # pool-handled (route/watch/lambda callables whose dispatcher
+        # is itself a checked root), or unhandled (rule 14 findings /
+        # allowlist territory).
+        if r.supervised:
+            crash = "spawn+restart" if r.restart else "spawn"
+        elif r.fid is not None and not la.escapes.get(r.fid, {}):
+            crash = "no-escape"
+        elif r.via == "init-tail":
+            crash = "caller-thread"   # runs on the constructing thread
+        elif r.via in ("route", "watch", "lambda"):
+            crash = "pool-handled"
+        else:
+            crash = "unhandled"
         roots.append({
             "root": r.rid, "via": r.via,
             "resolved": bool(seeds),
             "locks": locks,
+            "supervised": r.supervised,
+            "restart": r.restart,
+            "crash_handling": crash,
         })
     reasons: Dict[str, int] = {}
     for _fid, u in cg.unresolved_calls():

@@ -1,0 +1,1117 @@
+"""Rules 14–16: whole-program exception-flow and resource-lifecycle
+analysis over the call graph (the PR-8 machinery pointed at crashes and
+leaks the way rules 11–13 pointed it at locks).
+
+Rule 14 ``thread-root-crash`` — for every thread root (Thread/Timer
+target, ``utils/threads.spawn`` target, executor ``submit`` callable)
+the pass computes the set of exception types that can ESCAPE the root
+body: raises reachable through the call graph, minus handlers on the
+path, with unresolved calls treated as may-raise (they ride PR 8's
+pinned-coverage-hole machinery — a hole is a reason string, never a
+silent pass). A root where an exception escapes with no supervised
+handler is a finding: silent thread death becomes statically
+impossible. Roots spawned via ``utils/threads.spawn`` are supervised by
+construction (the wrapper installs the logging+counting handler and the
+optional bounded-backoff restart).
+
+Rule 15 ``resource-leak`` — a declared acquire/release protocol
+registry (KV page refcount pin/unpin, host-tier block pop vs re-add,
+``_ConnPool`` get/put, span drain/requeue, file handles outside
+``with``, failpoint arm/disarm in tests) checked per function with
+exception edges: every acquire must reach its paired release on ALL
+paths — including the path where a statement between acquire and
+release raises — or sit under try/finally / a broad releasing handler /
+a ``with`` form. Witness paths are printed. Deliberate ownership
+transfer (pins that ride the returned page chain) is declared IN SOURCE
+with a trailing ``# xlint: transfer — <why>`` on the acquire line.
+
+Rule 16 ``swallow-telemetry`` — the interprocedural upgrade of the old
+service-hygiene broad-swallow check, now over the WHOLE package: every
+``except`` broader than the benign set (anything narrower than
+``Exception``) must re-raise, or emit telemetry — a logger call, a
+catalogued ``events.emit``, a metric ``.inc()``/``.observe()``, or the
+``utils/threads`` crash/callback books — somewhere on its handler path,
+checked THROUGH the call graph, not lexically. The inline
+``# noqa: BLE001 — <why>`` justification convention (rule 6's) is still
+honored as the declared-benign escape hatch.
+
+All three rules share the memoized concurrency analysis (the call
+graph is the expensive part; tier-1 budgets the full 16-rule run at
+< 30 s).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from tools.xlint import Finding, Module, RepoTree
+from tools.xlint import callgraph as cgm
+from tools.xlint.concurrency import analyze as _conc_analyze
+
+ANY = "<any>"                   # "some exception we can't type statically"
+_BROAD = "<broad>"              # mask sentinel: catches everything
+_BROAD_NAMES = {"Exception", "BaseException"}
+
+# Minimal builtin exception ancestry for handler matching (child →
+# ancestors). Everything is implicitly under Exception/BaseException,
+# which the _BROAD sentinel already covers.
+_BUILTIN_ANCESTORS: Dict[str, Set[str]] = {
+    "ConnectionError": {"OSError"},
+    "ConnectionResetError": {"ConnectionError", "OSError"},
+    "ConnectionRefusedError": {"ConnectionError", "OSError"},
+    "ConnectionAbortedError": {"ConnectionError", "OSError"},
+    "BrokenPipeError": {"ConnectionError", "OSError"},
+    "TimeoutError": {"OSError"},
+    "FileNotFoundError": {"OSError"},
+    "FileExistsError": {"OSError"},
+    "PermissionError": {"OSError"},
+    "InterruptedError": {"OSError"},
+    "IsADirectoryError": {"OSError"},
+    "NotADirectoryError": {"OSError"},
+    "IndexError": {"LookupError"},
+    "KeyError": {"LookupError"},
+    "UnicodeDecodeError": {"UnicodeError", "ValueError"},
+    "UnicodeEncodeError": {"UnicodeError", "ValueError"},
+    "UnicodeError": {"ValueError"},
+    "OverflowError": {"ArithmeticError"},
+    "ZeroDivisionError": {"ArithmeticError"},
+    "FloatingPointError": {"ArithmeticError"},
+    "ModuleNotFoundError": {"ImportError"},
+    "RecursionError": {"RuntimeError"},
+    "NotImplementedError": {"RuntimeError"},
+    "JSONDecodeError": {"ValueError"},
+}
+
+# External calls the escape analysis treats as non-raising. Everything
+# else unmodeled is may-raise — that strictness is the point of rule 14
+# (any Python call can raise), but synchronization waits, time reads,
+# logging, and simple container bookkeeping would otherwise drown the
+# signal at every loop head.
+_NO_RAISE_BUILTINS = {
+    "len", "min", "max", "sorted", "list", "dict", "set", "tuple",
+    "str", "repr", "bool", "isinstance", "issubclass", "hasattr",
+    "id", "print", "enumerate", "zip", "range", "abs", "sum", "any",
+    "all", "callable", "vars", "round", "frozenset", "bytes", "type",
+}
+_NO_RAISE_METHODS = {
+    "wait", "is_set", "set", "clear", "notify", "notify_all",
+    "is_alive", "monotonic", "time", "perf_counter", "sleep",
+    "get", "items", "keys", "values", "copy", "append", "appendleft",
+    "add", "discard", "extend", "update", "setdefault",
+    "startswith", "endswith", "lower", "upper", "strip", "split",
+    "rsplit", "join", "format", "count",
+    "debug", "info", "warning", "warn", "error", "exception",
+    "critical", "log", "getLogger",
+    "put", "put_nowait", "task_done", "qsize", "empty", "full",
+    "hexdigest", "digest", "release",
+    "format_exception", "format_exc",
+    # telemetry sinks are designed not to raise (registry counters;
+    # events.emit's only raise is an un-catalogued type, which rule 8
+    # rejects statically for every literal-typed call site)
+    "inc", "observe", "set_total", "emit",
+}
+_NO_RAISE_RECEIVERS = {"logger", "logging"}
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error",
+                "exception", "critical", "log"}
+_COUNT_METHODS = {"inc", "observe"}
+_BOOK_FNS = {"record_crash", "record_callback_error"}
+
+_TRANSFER_RE = re.compile(r"#\s*xlint:\s*transfer\b")
+
+
+def _justified(comment: str) -> bool:
+    """``# noqa: BLE001 — <prose>``: a noqa WITH a prose justification
+    (mirrors rule 6's convention — the bare code alone is not one)."""
+    m = re.search(r"noqa\s*:?\s*([A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)?",
+                  comment)
+    if m is None:
+        return False
+    rest = comment[m.end():]
+    return len(re.findall(r"\w", rest)) >= 3
+
+
+def _is_events_receiver(expr: ast.AST) -> bool:
+    name = None
+    if isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    return name is not None and (name == "events"
+                                 or name.endswith("_events"))
+
+
+def _terminal_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _is_telemetry_call(node: ast.Call) -> bool:
+    """A call that makes a swallowed error VISIBLE: logger output, a
+    catalogued event, a metric bump, or the utils/threads books."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in _BOOK_FNS
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr in _BOOK_FNS:
+        return True
+    recv = _terminal_name(f.value)
+    if f.attr in _LOG_METHODS and recv in _NO_RAISE_RECEIVERS:
+        return True
+    if f.attr == "emit" and _is_events_receiver(f.value):
+        return True
+    if f.attr in _COUNT_METHODS:
+        return True
+    return False
+
+
+def _walk_no_nested(node: ast.AST):
+    """ast.walk that does not descend into nested function/lambda
+    bodies (they run later, possibly on another thread)."""
+    work = [node]
+    while work:
+        n = work.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            work.append(child)
+
+
+# ---------------------------------------------------------------------------
+# Exception-flow summaries
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _EffectSite:
+    line: int
+    masks: Tuple[FrozenSet[str], ...]   # enclosing handler catch-sets
+    kind: str                           # "raise" | "call" | "may"
+    # raise: tuple of type names; call: callee fid; may: description
+    payload: object
+
+
+class _BodyScanner:
+    """One pass over a function body extracting the exception-relevant
+    effect sites with their handler-mask context, plus the direct
+    telemetry flag rule 16's closure consumes."""
+
+    def __init__(self, fi: cgm.FuncInfo, walker) -> None:
+        self.fi = fi
+        self.walker = walker
+        self.sites: List[_EffectSite] = []
+        self.has_telemetry = False
+
+    def scan(self) -> "_BodyScanner":
+        self._visit_stmts(list(ast.iter_child_nodes(self.fi.node)),
+                          masks=(), handler_catch=None,
+                          handler_var=None)
+        return self
+
+    # -- helpers --------------------------------------------------------
+    def _handler_catch_set(self, handlers) -> FrozenSet[str]:
+        names: Set[str] = set()
+        for h in handlers:
+            if h.type is None:
+                return frozenset({_BROAD})
+            types = h.type.elts if isinstance(h.type, ast.Tuple) \
+                else [h.type]
+            for t in types:
+                nm = _terminal_name(t)
+                if nm is None or nm in _BROAD_NAMES:
+                    return frozenset({_BROAD})
+                names.add(nm)
+        return frozenset(names)
+
+    def _scan_expr(self, node: Optional[ast.AST], masks) -> None:
+        if node is None:
+            return
+        for sub in _walk_no_nested(node):
+            if isinstance(sub, ast.Call):
+                self._classify_call(sub, masks)
+
+    def _classify_call(self, node: ast.Call, masks) -> None:
+        if _is_telemetry_call(node):
+            self.has_telemetry = True
+        fids, reason = self.walker.resolve_callees(node.func)
+        if fids:
+            for fid in fids:
+                self.sites.append(_EffectSite(
+                    line=node.lineno, masks=masks, kind="call",
+                    payload=fid))
+            return
+        # The no-raise whitelist applies by NAME, resolved or not: a
+        # counter bump on an untyped attribute (`self.failures.inc()`)
+        # is the same designed-not-to-raise sink as a typed one.
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in _NO_RAISE_BUILTINS:
+                return
+        elif isinstance(f, ast.Attribute):
+            if f.attr in _NO_RAISE_METHODS:
+                return
+            if _terminal_name(f.value) in _NO_RAISE_RECEIVERS:
+                return
+        if reason is not None:
+            self.sites.append(_EffectSite(
+                line=node.lineno, masks=masks, kind="may",
+                payload=f"{cgm._call_desc(node)} "
+                        f"[unresolved: {reason}]"))
+            return
+        self.sites.append(_EffectSite(
+            line=node.lineno, masks=masks, kind="may",
+            payload=f"{cgm._call_desc(node)} [external]"))
+
+    # -- the structural walk --------------------------------------------
+    def _visit_stmts(self, stmts, masks, handler_catch,
+                     handler_var) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.Try):
+                catch = self._handler_catch_set(st.handlers)
+                self._visit_stmts(st.body, masks + (catch,),
+                                  handler_catch, handler_var)
+                for h in st.handlers:
+                    hc = self._handler_catch_set([h])
+                    self._visit_stmts(h.body, masks, hc, h.name)
+                self._visit_stmts(st.orelse, masks, handler_catch,
+                                  handler_var)
+                self._visit_stmts(st.finalbody, masks, handler_catch,
+                                  handler_var)
+                continue
+            if isinstance(st, ast.Raise):
+                # The constructor call in `raise X(...)` is the raise
+                # itself, not an extra may-raise edge — scan only its
+                # arguments for embedded calls.
+                if isinstance(st.exc, ast.Call):
+                    for a in (*st.exc.args, *st.exc.keywords):
+                        self._scan_expr(
+                            a.value if isinstance(a, ast.keyword)
+                            else a, masks)
+                self._scan_expr(st.cause, masks)
+                names: Tuple[str, ...]
+                if st.exc is None or (
+                        isinstance(st.exc, ast.Name)
+                        and handler_var is not None
+                        and st.exc.id == handler_var):
+                    # bare re-raise (or `raise e` of the caught var):
+                    # re-raises what the enclosing handler caught
+                    if handler_catch is None:
+                        names = (ANY,)
+                    elif _BROAD in handler_catch:
+                        names = (ANY,)
+                    else:
+                        names = tuple(sorted(handler_catch))
+                else:
+                    exc = st.exc
+                    if isinstance(exc, ast.Call):
+                        exc = exc.func
+                    nm = _terminal_name(exc)
+                    names = (nm,) if nm else (ANY,)
+                self.sites.append(_EffectSite(
+                    line=st.lineno, masks=masks, kind="raise",
+                    payload=names))
+                continue
+            if isinstance(st, ast.If):
+                self._scan_expr(st.test, masks)
+                self._visit_stmts(st.body, masks, handler_catch,
+                                  handler_var)
+                self._visit_stmts(st.orelse, masks, handler_catch,
+                                  handler_var)
+                continue
+            if isinstance(st, ast.While):
+                self._scan_expr(st.test, masks)
+                self._visit_stmts(st.body, masks, handler_catch,
+                                  handler_var)
+                self._visit_stmts(st.orelse, masks, handler_catch,
+                                  handler_var)
+                continue
+            if isinstance(st, ast.For):
+                self._scan_expr(st.iter, masks)
+                self._visit_stmts(st.body, masks, handler_catch,
+                                  handler_var)
+                self._visit_stmts(st.orelse, masks, handler_catch,
+                                  handler_var)
+                continue
+            if isinstance(st, ast.With):
+                for item in st.items:
+                    self._scan_expr(item.context_expr, masks)
+                self._visit_stmts(st.body, masks, handler_catch,
+                                  handler_var)
+                continue
+            if isinstance(st, ast.Assert):
+                self._scan_expr(st.test, masks)
+                self._scan_expr(st.msg, masks)
+                self.sites.append(_EffectSite(
+                    line=st.lineno, masks=masks, kind="raise",
+                    payload=("AssertionError",)))
+                continue
+            # simple statement: scan every call in it
+            self._scan_expr(st, masks)
+
+
+class LifecycleAnalysis:
+    """Memoized per RepoTree alongside the concurrency analysis."""
+
+    def __init__(self, tree: RepoTree) -> None:
+        self.conc = _conc_analyze(tree)
+        self.cg = self.conc.cg
+        self._ancestors = self._build_ancestors()
+        walkers = getattr(self.cg, "_walkers", {})
+        self.scanners: Dict[str, _BodyScanner] = {}
+        for fid, fi in self.cg.functions.items():
+            w = walkers.get(fid)
+            if w is None:
+                w = cgm._Walker(self.cg, fi, self.cg.envs[fi.path])
+            self.scanners[fid] = _BodyScanner(fi, w).scan()
+        # fid -> {escaping type name: witness string}
+        self.escapes = self._escape_fixpoint()
+        # fid -> bool: a telemetry call is reachable from this function
+        self.telemetry = self._telemetry_fixpoint()
+
+    # -- exception ancestry ---------------------------------------------
+    def _build_ancestors(self) -> Dict[str, Set[str]]:
+        anc: Dict[str, Set[str]] = {k: set(v)
+                                    for k, v in
+                                    _BUILTIN_ANCESTORS.items()}
+        # repo classes, by name: Child(Base) → Base is an ancestor
+        for ci in self.cg.classes.values():
+            s = anc.setdefault(ci.name, set())
+            work = list(ci.bases)
+            seen: Set[str] = set()
+            while work:
+                b = work.pop()
+                if b in seen:
+                    continue
+                seen.add(b)
+                s.add(b)
+                s.update(_BUILTIN_ANCESTORS.get(b, ()))
+                for key in self.cg.class_names.get(b, ()):
+                    parent = self.cg.classes.get(key)
+                    if parent is not None:
+                        work.extend(parent.bases)
+        return anc
+
+    def _caught(self, name: str, masks) -> bool:
+        for mask in masks:
+            if _BROAD in mask:
+                return True
+            if name == ANY:
+                continue
+            if name in mask:
+                return True
+            if self._ancestors.get(name, frozenset()) & mask:
+                return True
+        return False
+
+    # -- escape fixpoint ------------------------------------------------
+    def _escape_fixpoint(self) -> Dict[str, Dict[str, str]]:
+        cg = self.cg
+        escapes: Dict[str, Dict[str, str]] = {f: {} for f in
+                                              cg.functions}
+        deps: Dict[str, Set[str]] = {}
+        for fid, sc in self.scanners.items():
+            for site in sc.sites:
+                if site.kind == "call":
+                    deps.setdefault(site.payload, set()).add(fid)
+
+        def qual(fid: str) -> str:
+            fi = cg.functions.get(fid)
+            return fi.qualname if fi else fid
+
+        work = list(cg.functions)
+        in_work = set(work)
+        while work:
+            fid = work.pop()
+            in_work.discard(fid)
+            new: Dict[str, str] = {}
+            for site in self.scanners[fid].sites:
+                if site.kind == "raise":
+                    contrib = {n: f"raise at line {site.line}"
+                               for n in site.payload}
+                elif site.kind == "may":
+                    contrib = {ANY: f"{site.payload} at line "
+                                    f"{site.line} may raise"}
+                else:
+                    callee = site.payload
+                    contrib = {n: f"call to {qual(callee)}() at line "
+                                  f"{site.line} can raise {n}"
+                               for n in escapes.get(callee, ())}
+                for name, witness in contrib.items():
+                    if not self._caught(name, site.masks):
+                        new.setdefault(name, witness)
+            if set(new) != set(escapes[fid]):
+                escapes[fid] = new
+                for caller in deps.get(fid, ()):
+                    if caller not in in_work:
+                        in_work.add(caller)
+                        work.append(caller)
+        return escapes
+
+    # -- telemetry closure ----------------------------------------------
+    def _telemetry_fixpoint(self) -> Dict[str, bool]:
+        cg = self.cg
+        telem = {fid: sc.has_telemetry
+                 for fid, sc in self.scanners.items()}
+        callers: Dict[str, List[str]] = {}
+        for fid, fi in cg.functions.items():
+            for cs in fi.calls:
+                callers.setdefault(cs.callee, []).append(fid)
+        work = [fid for fid, t in telem.items() if t]
+        while work:
+            fid = work.pop()
+            for caller in callers.get(fid, ()):
+                if not telem.get(caller):
+                    telem[caller] = True
+                    work.append(caller)
+        return telem
+
+
+_CACHE_ATTR = "_xlint_lifecycle_analysis"
+
+
+def lifecycle_analyze(tree: RepoTree) -> LifecycleAnalysis:
+    a = getattr(tree, _CACHE_ATTR, None)
+    if a is None:
+        a = LifecycleAnalysis(tree)
+        setattr(tree, _CACHE_ATTR, a)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Rule 14: thread-root-crash
+# ---------------------------------------------------------------------------
+
+
+class ThreadRootCrashRule:
+    """Dedicated threads (Thread/Timer/spawn) and executor ``submit``
+    callables: an escape there is silent death (or a dropped Future).
+    Route handlers and watch callbacks escape INTO their dispatcher —
+    which is itself a Thread root this rule checks — so they are
+    covered at the dispatcher, not per callable."""
+
+    name = "thread-root-crash"
+    describe = ("every Thread/Timer/submit thread root must be "
+                "supervised (utils/threads.spawn) or provably let no "
+                "exception escape its body — silent thread death is "
+                "statically impossible")
+
+    CHECKED_VIAS = ("Thread", "Timer", "spawn", "submit")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        la = lifecycle_analyze(tree)
+        cg = la.cg
+        findings: List[Finding] = []
+        emitted: Set[str] = set()
+        for root in cg.roots:
+            if root.via not in self.CHECKED_VIAS:
+                continue
+            if root.supervised:
+                continue
+            if root.fid is None or root.fid not in cg.functions:
+                key = f"{root.path}::dynamic-{root.via}-target"
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                findings.append(Finding(
+                    rule=self.name, path=root.path, line=root.line,
+                    key=key,
+                    message=f"dynamic {root.via} target — "
+                            f"crash-handling cannot be proven for a "
+                            f"thread whose body the analysis cannot "
+                            f"see; start it via utils/threads.spawn "
+                            f"(supervised by construction) or "
+                            f"allowlist with a justification"))
+                continue
+            esc = la.escapes.get(root.fid, {})
+            if not esc:
+                continue
+            fi = cg.functions[root.fid]
+            key = f"{fi.path}::{fi.qualname}::crash"
+            if key in emitted:
+                continue
+            emitted.add(key)
+            shown = sorted(esc)[:3]
+            detail = "; ".join(f"{n}: {esc[n]}" for n in shown)
+            more = f" (+{len(esc) - 3} more)" if len(esc) > 3 else ""
+            findings.append(Finding(
+                rule=self.name, path=fi.path, line=root.line,
+                key=key,
+                message=f"thread root {fi.qualname} (via {root.via}) "
+                        f"can die silently — escaping exceptions: "
+                        f"{detail}{more}. Start it via "
+                        f"utils/threads.spawn (logs + counts "
+                        f"xllm_thread_crashes_total + emits "
+                        f"thread_crashed, optional restart), or wrap "
+                        f"the body in a top-level handler that logs "
+                        f"AND counts, or allowlist with a written "
+                        f"justification"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule 15: resource-leak
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Protocol:
+    """One declared acquire/release pairing.
+
+    ``binding``: the acquire's value is bound to a variable which must
+    later be released (``conn = pool.get()`` → ``pool.put(conn)`` /
+    ``conn.close()``). Non-binding (paired) protocols match on the
+    RECEIVER (``x.acquire_pages(...)`` → ``x.release_pages(...)``)."""
+
+    name: str
+    acquire_methods: FrozenSet[str] = frozenset()
+    acquire_names: FrozenSet[str] = frozenset()   # bare-name calls
+    release_methods: FrozenSet[str] = frozenset()
+    # release via method called ON the bound variable (binding only)
+    close_methods: FrozenSet[str] = frozenset()
+    binding: bool = False
+    # terminal receiver-name substrings that must match for the
+    # acquire/release methods to count (None = any receiver)
+    receiver_hints: Optional[Tuple[str, ...]] = None
+    # only count acquires whose receiver is rooted at a function
+    # parameter (the tests' shared-fixture failpoint case)
+    param_receiver_only: bool = False
+
+
+PROTOCOLS: Tuple[Protocol, ...] = (
+    Protocol(name="kv-pin",
+             acquire_methods=frozenset({"acquire_pages",
+                                        "pages_for_hashes"}),
+             release_methods=frozenset({"release_pages"})),
+    Protocol(name="host-tier",
+             acquire_methods=frozenset({"pop"}),
+             release_methods=frozenset({"put"}),
+             receiver_hints=("tier",)),
+    Protocol(name="conn-pool",
+             acquire_methods=frozenset({"get"}),
+             release_methods=frozenset({"put"}),
+             close_methods=frozenset({"close"}),
+             binding=True,
+             receiver_hints=("_POOL", "conn_pool")),
+    Protocol(name="file-handle",
+             acquire_names=frozenset({"open"}),
+             close_methods=frozenset({"close"}),
+             binding=True),
+    Protocol(name="span-drain",
+             acquire_methods=frozenset({"drain_finished"}),
+             release_methods=frozenset({"requeue"}),
+             binding=True,
+             receiver_hints=("spans",)),
+    Protocol(name="failpoint-arm",
+             acquire_methods=frozenset({"arm", "arm_from_spec"}),
+             release_methods=frozenset({"disarm"}),
+             receiver_hints=("failpoints",),
+             param_receiver_only=True),
+)
+
+
+def _recv_matches(proto: Protocol, recv: Optional[ast.AST]) -> bool:
+    if proto.receiver_hints is None:
+        return True
+    nm = _terminal_name(recv) if recv is not None else None
+    if nm is None:
+        return False
+    return any(h in nm for h in proto.receiver_hints)
+
+
+def _recv_repr(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return f"{_recv_repr(expr.value)}.{expr.attr}"
+    return "<expr>"
+
+
+def _recv_root(expr: ast.AST) -> Optional[str]:
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+@dataclasses.dataclass
+class _Held:
+    proto: Protocol
+    token: str                 # var name (binding) or receiver repr
+    line: int
+    desc: str
+
+
+class _FlowChecker:
+    """Per-function path walk with exception edges for rule 15."""
+
+    def __init__(self, mod: Module, qualname: str, fndef: ast.AST,
+                 protocols: Sequence[Protocol],
+                 params: Set[str]) -> None:
+        self.mod = mod
+        self.qualname = qualname
+        self.fndef = fndef
+        self.protocols = protocols
+        self.params = params
+        self.violations: Dict[str, Finding] = {}
+
+    def check(self) -> List[Finding]:
+        # Generators manage cleanup through their own close()/finally
+        # machinery — out of scope for the path walk.
+        for n in _walk_no_nested(self.fndef):
+            if isinstance(n, (ast.Yield, ast.YieldFrom)):
+                return []
+        held: Dict[str, _Held] = {}
+        self._walk(list(ast.iter_child_nodes(self.fndef)), held, ())
+        for h in held.values():
+            self._violate(h, self.fndef.body[-1].lineno if
+                          self.fndef.body else h.line,
+                          "function exits without releasing it")
+        return list(self.violations.values())
+
+    # -- classification -------------------------------------------------
+    def _line_has_transfer(self, line: int) -> bool:
+        if 1 <= line <= len(self.mod.lines):
+            return bool(_TRANSFER_RE.search(self.mod.lines[line - 1]))
+        return False
+
+    def _match_acquire(self, call: ast.Call
+                       ) -> Optional[Tuple[Protocol, Optional[ast.AST]]]:
+        f = call.func
+        for proto in self.protocols:
+            if isinstance(f, ast.Attribute):
+                if f.attr in proto.acquire_methods and \
+                        _recv_matches(proto, f.value):
+                    if proto.param_receiver_only:
+                        root = _recv_root(f.value)
+                        if root is None or root == "self" or \
+                                root not in self.params:
+                            continue
+                    return proto, f.value
+            if isinstance(f, ast.Name) and f.id in proto.acquire_names:
+                return proto, None
+        return None
+
+    def _release_tokens(self, call: ast.Call) -> Set[str]:
+        """Tokens this call releases (var names and/or paired receiver
+        tokens)."""
+        out: Set[str] = set()
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            for proto in self.protocols:
+                if f.attr in proto.release_methods and \
+                        _recv_matches(proto, f.value):
+                    if proto.binding:
+                        # pool.put(addr, conn) — any Name arg releases
+                        for a in call.args:
+                            if isinstance(a, ast.Name):
+                                out.add(a.id)
+                    else:
+                        out.add(f"{proto.name}:{_recv_repr(f.value)}")
+                if f.attr in proto.close_methods and \
+                        isinstance(f.value, ast.Name):
+                    out.add(f.value.id)
+                # failpoint arm(..., mode="off") disarms
+                if proto.name == "failpoint-arm" and f.attr == "arm":
+                    for kw in call.keywords:
+                        if kw.arg == "mode" and \
+                                isinstance(kw.value, ast.Constant) and \
+                                kw.value.value == "off":
+                            out.add(f"{proto.name}:"
+                                    f"{_recv_repr(f.value)}")
+        return out
+
+    def _stmt_release_shapes(self, stmts) -> Set[str]:
+        out: Set[str] = set()
+        for st in stmts:
+            for n in _walk_no_nested(st):
+                if isinstance(n, ast.Call):
+                    out.update(self._release_tokens(n))
+        return out
+
+    def _may_raise(self, node: ast.AST, skip: Set[int]) -> Optional[str]:
+        """First call/raise in this statement that can raise (excluding
+        call nodes in ``skip``)."""
+        for n in _walk_no_nested(node):
+            if isinstance(n, ast.Raise):
+                return f"raise at line {n.lineno}"
+            if isinstance(n, ast.Call) and id(n) not in skip:
+                f = n.func
+                if isinstance(f, ast.Name) and \
+                        f.id in _NO_RAISE_BUILTINS:
+                    continue
+                if isinstance(f, ast.Attribute):
+                    if f.attr in _NO_RAISE_METHODS:
+                        continue
+                    if _terminal_name(f.value) in _NO_RAISE_RECEIVERS:
+                        continue
+                return f"{cgm._call_desc(n)} at line {n.lineno}"
+        return None
+
+    def _violate(self, h: _Held, line: int, why: str) -> None:
+        key = (f"{self.mod.path}::{self.qualname}::"
+               f"{h.proto.name}:{h.token}")
+        if key in self.violations:
+            return
+        self.violations[key] = Finding(
+            rule="resource-leak", path=self.mod.path, line=h.line,
+            key=key,
+            message=f"{h.proto.name}: {h.desc} acquired at line "
+                    f"{h.line} — {why} (witness: line {line}); every "
+                    f"acquire must reach its release on ALL paths "
+                    f"including exception edges (use with/try-finally, "
+                    f"release in a broad handler, or declare ownership "
+                    f"transfer with `# xlint: transfer — <why>` on the "
+                    f"acquire line)")
+
+    # -- the walk -------------------------------------------------------
+    def _walk(self, stmts, held: Dict[str, _Held],
+              protections: Tuple[FrozenSet[str], ...]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.Try):
+                shapes: Set[str] = set(
+                    self._stmt_release_shapes(st.finalbody))
+                for hdl in st.handlers:
+                    broad = hdl.type is None or (
+                        _terminal_name(hdl.type) in _BROAD_NAMES)
+                    if broad:
+                        shapes.update(
+                            self._stmt_release_shapes(hdl.body))
+                self._walk(st.body, held,
+                           protections + (frozenset(shapes),))
+                for hdl in st.handlers:
+                    self._walk(hdl.body, held, protections)
+                self._walk(st.orelse, held, protections)
+                self._walk(st.finalbody, held, protections)
+                # A token whose release appears in this try's finally
+                # (or a broad releasing handler) is DISCHARGED at try
+                # exit: the structured release point is declared, and
+                # conditional logic inside the finally (release-only-
+                # on-failure for a success-path ownership transfer) is
+                # the author's design, not a leak.
+                for t in list(held):
+                    if held[t].token in shapes or t in shapes:
+                        held.pop(t)
+                continue
+            if isinstance(st, ast.With):
+                for item in st.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Call) and \
+                            self._match_acquire(ce) is not None:
+                        continue    # the with IS the release contract
+                    self._exception_edge(item.context_expr, held,
+                                         protections, skip=set())
+                self._walk(st.body, held, protections)
+                continue
+            if isinstance(st, (ast.If,)):
+                self._exception_edge(st.test, held, protections,
+                                     skip=set())
+                h1 = dict(held)
+                h2 = dict(held)
+                self._walk(st.body, h1, protections)
+                self._walk(st.orelse, h2, protections)
+                held.clear()
+                held.update(h2)
+                held.update(h1)     # superset merge: held-on-any-path
+                continue
+            if isinstance(st, (ast.While, ast.For)):
+                hdr = st.test if isinstance(st, ast.While) else st.iter
+                self._exception_edge(hdr, held, protections, skip=set())
+                hb = dict(held)
+                self._walk(st.body, hb, protections)
+                self._walk(st.orelse, held, protections)
+                held.update(hb)
+                continue
+            if isinstance(st, ast.Return):
+                skip: Set[int] = set()
+                returned: Set[str] = set()
+                if st.value is not None:
+                    for n in _walk_no_nested(st.value):
+                        if isinstance(n, ast.Name):
+                            returned.add(n.id)
+                    self._exception_edge(st.value, held, protections,
+                                         skip=skip)
+                for tok in list(held):
+                    if held[tok].token in returned:
+                        held.pop(tok)   # ownership transferred out
+                for key, h in list(held.items()):
+                    if not self._protected(key, h, protections):
+                        self._violate(h, st.lineno,
+                                      "returns without releasing it")
+                held.clear()
+                continue
+            if isinstance(st, ast.Raise):
+                for key, h in list(held.items()):
+                    if not self._protected(key, h, protections):
+                        self._violate(h, st.lineno,
+                                      "raises without releasing it")
+                held.clear()
+                continue
+            # ---- simple statement -------------------------------------
+            skip = set()
+            # releases first (the release call itself is not an edge)
+            for n in _walk_no_nested(st):
+                if isinstance(n, ast.Call):
+                    toks = self._release_tokens(n)
+                    if toks:
+                        skip.add(id(n))
+                        for t in list(held):
+                            hh = held[t]
+                            if hh.token in toks or t in toks:
+                                held.pop(t)
+            # acquires
+            acq = None
+            if isinstance(st, ast.Assign) and \
+                    isinstance(st.value, ast.Call):
+                acq = self._match_acquire(st.value)
+                if acq is not None:
+                    proto, recv = acq
+                    skip.add(id(st.value))
+                    if self._line_has_transfer(st.lineno):
+                        acq = None
+                    elif proto.binding:
+                        tgt = st.targets[0]
+                        if isinstance(tgt, ast.Tuple) and tgt.elts:
+                            tgt = tgt.elts[0]
+                        if isinstance(tgt, ast.Name):
+                            held[tgt.id] = _Held(
+                                proto, tgt.id, st.lineno,
+                                f"{tgt.id} = "
+                                f"...{proto.name} acquire...")
+                        # bound to self.attr / subscript: ownership
+                        # stored — transfer by construction
+                    else:
+                        rr = _recv_repr(recv)
+                        held[f"{proto.name}:{rr}"] = _Held(
+                            proto, rr, st.lineno,
+                            f"{rr}."
+                            f"{'/'.join(sorted(proto.acquire_methods))}")
+            elif isinstance(st, ast.Expr) and \
+                    isinstance(st.value, ast.Call):
+                acq = self._match_acquire(st.value)
+                if acq is not None:
+                    proto, recv = acq
+                    skip.add(id(st.value))
+                    if self._line_has_transfer(st.lineno):
+                        acq = None
+                    elif not proto.binding:
+                        rr = _recv_repr(recv)
+                        held[f"{proto.name}:{rr}"] = _Held(
+                            proto, rr, st.lineno,
+                            f"{rr}."
+                            f"{'/'.join(sorted(proto.acquire_methods))}")
+                    # a binding protocol with a discarded result leaks
+                    # by construction — but open(...) as a bare Expr is
+                    # vanishingly rare; treat as immediate violation
+                    else:
+                        h = _Held(proto, "<discarded>", st.lineno,
+                                  "acquire with discarded result")
+                        self._violate(h, st.lineno,
+                                      "the handle is discarded — "
+                                      "nothing can ever release it")
+            # exception edge across everything else in the statement
+            self._exception_edge(st, held, protections, skip=skip)
+
+    def _protected(self, key: str, h: _Held,
+                   protections: Tuple[FrozenSet[str], ...]) -> bool:
+        for shapes in protections:
+            if h.token in shapes or key in shapes:
+                return True
+        return False
+
+    def _exception_edge(self, node: Optional[ast.AST],
+                        held: Dict[str, _Held],
+                        protections, skip: Set[int]) -> None:
+        if node is None or not held:
+            return
+        why = self._may_raise(node, skip)
+        if why is None:
+            return
+        for key, h in list(held.items()):
+            if not self._protected(key, h, protections):
+                self._violate(
+                    h, getattr(node, "lineno", h.line),
+                    f"{why} can raise with it still held and no "
+                    f"try/finally (or broad releasing handler) covers "
+                    f"that edge")
+
+
+class ResourceLeakRule:
+    name = "resource-leak"
+    describe = ("declared acquire/release protocols (KV pin/unpin, "
+                "host-tier pop/re-add, conn-pool get/put, span "
+                "drain/requeue, open files, failpoint arm/disarm in "
+                "tests) must release on every path incl. exception "
+                "edges, or sit under with/try-finally; ownership "
+                "transfer is declared with `# xlint: transfer`")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        findings: List[Finding] = []
+        package_protocols = [p for p in PROTOCOLS
+                             if not p.param_receiver_only]
+        for mod in tree.modules:
+            findings.extend(self._check_module(mod, package_protocols))
+        # failpoint arm/disarm discipline in tests/ (full scope only —
+        # the protocol targets shared fixtures armed through a test
+        # function's parameters)
+        if tree.covers_package():
+            findings.extend(self._check_tests(tree))
+        return findings
+
+    def _check_module(self, mod: Module,
+                      protocols: Sequence[Protocol]) -> List[Finding]:
+        out: List[Finding] = []
+        stack: List[str] = []
+
+        def visit(node) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    stack.append(child.name)
+                    qual = ".".join(stack)
+                    a = child.args
+                    params = {p.arg for p in (*a.posonlyargs, *a.args,
+                                              *a.kwonlyargs)}
+                    out.extend(_FlowChecker(
+                        mod, qual, child, protocols, params).check())
+                    visit(child)
+                    stack.pop()
+                elif isinstance(child, ast.ClassDef):
+                    stack.append(child.name)
+                    visit(child)
+                    stack.pop()
+                else:
+                    visit(child)
+
+        visit(mod.tree)
+        return out
+
+    def _check_tests(self, tree: RepoTree) -> List[Finding]:
+        out: List[Finding] = []
+        tests_dir = os.path.join(tree.root, "tests")
+        if not os.path.isdir(tests_dir):
+            return out
+        fp = [p for p in PROTOCOLS if p.param_receiver_only]
+        for fn in sorted(os.listdir(tests_dir)):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(tests_dir, fn)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+                t = ast.parse(src)
+            except (OSError, SyntaxError, ValueError):
+                continue        # broken test files are pytest's problem
+            mod = Module(path=f"tests/{fn}", abspath=path, source=src,
+                         lines=src.splitlines(), tree=t)
+            out.extend(self._check_module(mod, fp))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Rule 16: swallow-telemetry
+# ---------------------------------------------------------------------------
+
+
+class SwallowTelemetryRule:
+    name = "swallow-telemetry"
+    describe = ("every except broader than the benign set (bare / "
+                "Exception / BaseException) anywhere in the package "
+                "must re-raise or reach telemetry (logger / "
+                "events.emit / metric inc / utils-threads books) on "
+                "its handler path — checked through the call graph; "
+                "`# noqa: BLE001 — <why>` declares a vetted swallow")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        la = lifecycle_analyze(tree)
+        cg = la.cg
+        findings: List[Finding] = []
+        for fid, fi in cg.functions.items():
+            mod = fi.module
+            handlers = self._broad_handlers(fi)
+            if not handlers:
+                continue
+            fn_has_raise = any(isinstance(n, ast.Raise)
+                               for n in _walk_no_nested(fi.node))
+            call_lines: Dict[int, List[str]] = {}
+            for cs in fi.calls:
+                call_lines.setdefault(cs.line, []).append(cs.callee)
+            for idx, h in enumerate(handlers):
+                if self._handled(la, fi, mod, h, fn_has_raise,
+                                 call_lines):
+                    continue
+                findings.append(Finding(
+                    rule=self.name, path=fi.path, line=h.lineno,
+                    key=f"{fi.path}::{fi.qualname}::swallow@{idx}",
+                    message=f"broad except in {fi.qualname} neither "
+                            f"re-raises nor reaches telemetry on its "
+                            f"handler path (no logger / events.emit / "
+                            f"metric / crash-book call, directly or "
+                            f"through callees) — a swallowed error "
+                            f"nobody can see; log+count it, re-raise, "
+                            f"or annotate `# noqa: BLE001 — <why this "
+                            f"is safe to drop>`"))
+        return findings
+
+    @staticmethod
+    def _broad_handlers(fi: cgm.FuncInfo) -> List[ast.ExceptHandler]:
+        out = []
+        for n in _walk_no_nested(fi.node):
+            if not isinstance(n, ast.ExceptHandler):
+                continue
+            types = [] if n.type is None else (
+                n.type.elts if isinstance(n.type, ast.Tuple)
+                else [n.type])
+            broad = n.type is None or any(
+                _terminal_name(t) in _BROAD_NAMES for t in types)
+            if broad:
+                out.append(n)
+        return out
+
+    def _handled(self, la: LifecycleAnalysis, fi: cgm.FuncInfo,
+                 mod: Module, h: ast.ExceptHandler,
+                 fn_has_raise: bool,
+                 call_lines: Dict[int, List[str]]) -> bool:
+        # 1. re-raise anywhere in the handler body
+        body_nodes = [n for st in h.body for n in _walk_no_nested(st)]
+        if any(isinstance(n, ast.Raise) for n in body_nodes):
+            return True
+        # 2. inline justification on the except line
+        if h.lineno <= len(mod.lines):
+            comment = mod.lines[h.lineno - 1].partition("#")[2]
+            if _justified(comment):
+                return True
+        # 3. direct telemetry in the handler body
+        if any(isinstance(n, ast.Call) and _is_telemetry_call(n)
+               for n in body_nodes):
+            return True
+        # 4. the handler stashes the exception and the function raises
+        #    elsewhere (the retry-loop pattern: `err = e; continue` …
+        #    `raise err` after the loop)
+        if h.name is not None and fn_has_raise:
+            for n in body_nodes:
+                if isinstance(n, ast.Assign) and \
+                        isinstance(n.value, ast.Name) and \
+                        n.value.id == h.name:
+                    return True
+        # 5. telemetry reachable through a call made in the handler
+        end = getattr(h, "end_lineno", h.lineno) or h.lineno
+        for line in range(h.lineno, end + 1):
+            for callee in call_lines.get(line, ()):
+                if la.telemetry.get(callee):
+                    return True
+        return False

@@ -1,5 +1,7 @@
-"""The xlint rules (1–10 here; the interprocedural rules 11–13 live in
-tools/xlint/concurrency.py and are registered into ``RULES`` below).
+"""The xlint rules (1–10 here; the interprocedural concurrency rules
+11–13 live in tools/xlint/concurrency.py and the exception-flow /
+resource-lifecycle rules 14–16 in tools/xlint/lifecycle.py — all
+registered into ``RULES`` below).
 Each proves one invariant the serving/perf work depends on;
 docs/STATIC_ANALYSIS.md records the incident that motivated each. All
 analysis is stdlib ``ast`` — name/alias based, intentionally
@@ -359,6 +361,7 @@ LOCK_RANK_TABLE: Dict[str, int] = {
     "httpd.connpool": 92,
     "obs.registry": 93,
     "obs.spans": 94,
+    "threads.book": 94,
     "hashing.native": 95,
     "native_httpd.lib": 96,
     "etcd_native.build": 97,
@@ -905,26 +908,16 @@ _SERVICE_FILES = (
     "xllm_service_tpu/service/response_handler.py",
     "xllm_service_tpu/service/rpc_service.py",
 )
-_BROAD_EXC = ("Exception", "BaseException")
-
-
-def _noqa_justified(comment: str) -> bool:
-    """True when the except line's comment carries a noqa AND a prose
-    justification beyond the bare code (``# noqa: BLE001`` alone is not
-    a justification — ``# noqa: BLE001 — close is best-effort`` is)."""
-    m = re.search(r"noqa\s*:?\s*([A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)?",
-                  comment)
-    if m is None:
-        return False
-    rest = comment[m.end():]
-    return len(re.findall(r"\w", rest)) >= 3
 
 
 class ServiceHygieneRule:
+    """The broad-swallow check this rule used to carry moved to rule 16
+    (``swallow-telemetry``, tools/xlint/lifecycle.py) — interprocedural
+    and package-wide instead of lexical over five files."""
+
     name = "service-hygiene"
-    describe = ("no blocking sleeps / unbounded .result() / "
-                "unjustified exception swallows on the httpd dispatch "
-                "path")
+    describe = ("no blocking sleeps / unbounded .result() on the httpd "
+                "dispatch path")
 
     def check(self, tree: RepoTree) -> List[Finding]:
         findings: List[Finding] = []
@@ -974,46 +967,31 @@ class ServiceHygieneRule:
                                         "future pins the thread "
                                         "forever"))
                     self.generic_visit(node)
-
-                def visit_ExceptHandler(self,
-                                        node: ast.ExceptHandler) -> None:
-                    broad = node.type is None or (
-                        isinstance(node.type, ast.Name)
-                        and node.type.id in _BROAD_EXC)
-                    swallows = all(isinstance(s, ast.Pass)
-                                   for s in node.body)
-                    if broad and swallows:
-                        line = mod.lines[node.lineno - 1] \
-                            if node.lineno <= len(mod.lines) else ""
-                        comment = line.partition("#")[2]
-                        if not _noqa_justified(comment):
-                            findings.append(Finding(
-                                rule=rule.name, path=mod.path,
-                                line=node.lineno,
-                                key=f"{mod.path}::"
-                                    f"{_qualname_of(self.stack)}::"
-                                    f"swallow",
-                                message="broad except swallowing all "
-                                        "errors with no justification "
-                                        "— narrow it, or annotate "
-                                        "'# noqa: BLE001 — <why this "
-                                        "is safe to drop>'"))
-                    self.generic_visit(node)
             V().visit(mod.tree)
         return findings
 
     @staticmethod
     def _thread_targets(mod: Module) -> Set[str]:
         targets: Set[str] = set()
+
+        def record(v: ast.AST) -> None:
+            if isinstance(v, ast.Attribute):
+                targets.add(v.attr)
+            elif isinstance(v, ast.Name):
+                targets.add(v.id)
+
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Call):
                 for kw in node.keywords:
                     if kw.arg == "target":
-                        v = kw.value
-                        if isinstance(v, ast.Attribute):
-                            targets.add(v.attr)
-                        elif isinstance(v, ast.Name):
-                            targets.add(v.id)
+                        record(kw.value)
+                # utils/threads.spawn(name, target, ...) — positional
+                f = node.func
+                if ((isinstance(f, ast.Name) and f.id == "spawn")
+                        or (isinstance(f, ast.Attribute)
+                            and f.attr == "spawn")) \
+                        and len(node.args) >= 2:
+                    record(node.args[1])
         return targets
 
 
@@ -1270,6 +1248,8 @@ class FailpointCatalogRule:
 from tools.xlint.concurrency import (         # noqa: E402 — rules 11–13
     BlockingUnderLockRule, LockOrderInterproceduralRule,
     ThreadRootRaceRule)
+from tools.xlint.lifecycle import (           # noqa: E402 — rules 14–16
+    ResourceLeakRule, SwallowTelemetryRule, ThreadRootCrashRule)
 
 RULES = [
     MosaicCompatRule(),
@@ -1285,4 +1265,7 @@ RULES = [
     LockOrderInterproceduralRule(),
     BlockingUnderLockRule(),
     ThreadRootRaceRule(LOCK_RANK_TABLE),
+    ThreadRootCrashRule(),
+    ResourceLeakRule(),
+    SwallowTelemetryRule(),
 ]

@@ -55,6 +55,9 @@ EVENT_TYPES = (
     "cache_digest_mismatch",  # worker's block hashing diverges from the
                               # service's — its prefix digests are
                               # quarantined (docs/KV_CACHE.md)
+    "thread_crashed",       # an uncaught exception escaped a supervised
+                            # thread root (utils/threads.py spawn);
+                            # attrs say whether it restarted
 )
 
 DEFAULT_CAPACITY = 1024

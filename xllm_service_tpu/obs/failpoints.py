@@ -64,6 +64,10 @@ FAILPOINTS = (
                                  # (requester side) — prefill recomputes
                                  # from token zero, correctness intact
     "service.fail_redispatch",   # service refuses to pick an alternate
+    "worker.crash_heartbeat",    # raise OUTSIDE the heartbeat loop's
+                                 # try — an injected thread crash, for
+                                 # proving the supervised restart path
+                                 # (utils/threads.py, docs/ROBUSTNESS.md)
 )
 
 _MODES = ("always", "count", "after", "prob", "off")

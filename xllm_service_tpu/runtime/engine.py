@@ -394,8 +394,8 @@ class Engine:
                                                  Layout)
             return Layout(DeviceLocalLayout((0,)),
                           jax.tree_util.tree_leaves(self.kv)[0].sharding)
-        except Exception:  # noqa: BLE001
-            return None
+        except Exception:  # noqa: BLE001 — this jax has no layout API;
+            return None     # None means "don't pin", the sound fallback
 
     def _kv_default_layouts(self):
         """Default major-to-minor Layout pair for the KV pools (None =
@@ -1783,28 +1783,48 @@ class Engine:
         hbm_pids = [p[2] for p in plan if p[0] == "hbm"]
         # Pin the chain's HBM members before the allocation below can
         # reclaim them, and take the tier members out of LRU reach.
+        # The try/finally is the exception-edge contract (xlint rule
+        # resource-leak): a failed alloc OR a scatter that raises must
+        # unpin the HBM chain and re-park the popped tier blocks — a
+        # leaked pin under memory pressure pins forever, and a popped-
+        # but-never-scattered block simply vanishes. On success the
+        # pins transfer: they ride the returned page chain, released at
+        # sequence finish like any admitted prefix.
         self.prefix_cache.acquire_pages(hbm_pids)
-        for kind, h, _ in plan:
-            if kind == "tier":
-                self.host_tier.pop(h)
-        new_pages = self.prefix_cache.alloc(n_tier)
-        if new_pages is None:
-            self.prefix_cache.release_pages(hbm_pids)
-            for kind, h, blk in plan:
+        restored = False
+        new_pages = None
+        try:
+            for kind, h, _ in plan:
                 if kind == "tier":
-                    self.host_tier.put(h, blk[0], blk[1])
+                    self.host_tier.pop(h)
+            new_pages = self.prefix_cache.alloc(n_tier)
+            if new_pages is not None:
+                with self._phase("kv_restore"):
+                    k_pages, v_pages = self.kv
+                    idx = jnp.asarray(new_pages, jnp.int32)
+                    k_new = np.stack([b[0] for kind, _, b in plan
+                                      if kind == "tier"], axis=1)
+                    v_new = np.stack([b[1] for kind, _, b in plan
+                                      if kind == "tier"], axis=1)
+                    self.kv = _kv_scatter(
+                        k_pages, v_pages, idx,
+                        jnp.asarray(k_new).astype(k_pages.dtype),
+                        jnp.asarray(v_new).astype(v_pages.dtype))
+                restored = True
+        finally:
+            if not restored:
+                self.prefix_cache.release_pages(hbm_pids)
+                if new_pages is not None:
+                    # alloc succeeded but the restore didn't land: the
+                    # fresh pages are pinned and unmapped — releasing
+                    # sends them straight back to the allocator (an
+                    # unregistered page has no hash to park under).
+                    self.prefix_cache.release_pages(new_pages)
+                for kind, h, blk in plan:
+                    if kind == "tier":
+                        self.host_tier.put(h, blk[0], blk[1])
+        if new_pages is None:
             return pages, cached_tokens
-        with self._phase("kv_restore"):
-            k_pages, v_pages = self.kv
-            idx = jnp.asarray(new_pages, jnp.int32)
-            k_new = np.stack([b[0] for kind, _, b in plan
-                              if kind == "tier"], axis=1)
-            v_new = np.stack([b[1] for kind, _, b in plan
-                              if kind == "tier"], axis=1)
-            self.kv = _kv_scatter(
-                k_pages, v_pages, idx,
-                jnp.asarray(k_new).astype(k_pages.dtype),
-                jnp.asarray(v_new).astype(v_pages.dtype))
         ti = 0
         chain: List[int] = []
         for kind, _, payload in plan:
@@ -1833,11 +1853,22 @@ class Engine:
         pages = self.prefix_cache.pages_for_hashes(hashes)
         n_hbm = len(pages)
         k_hbm = v_hbm = None
-        if n_hbm:
-            k_pages, v_pages = self.kv
-            idx = jnp.asarray(pages, jnp.int32)
-            k_dev, v_dev = k_pages[:, idx], v_pages[:, idx]
+        k_dev = v_dev = None
+        # pages_for_hashes returns the run REFCOUNT-PINNED (a reclaim
+        # racing the gather would hand the requester another prompt's
+        # KV). The gather lands in a fresh buffer, so the pins drop the
+        # moment the slice is taken — and the try/finally drops them on
+        # the gather's exception edge too (a holder serving /kv/blocks
+        # must not leak pins when a malformed run makes the index
+        # gather raise; xlint rule resource-leak pins this shape).
+        try:
+            if n_hbm:
+                k_pages, v_pages = self.kv
+                idx = jnp.asarray(pages, jnp.int32)
+                k_dev, v_dev = k_pages[:, idx], v_pages[:, idx]
+        finally:
             self.prefix_cache.release_pages(pages)
+        if n_hbm:
             if device and n_hbm == len(hashes):
                 return n_hbm, k_dev, v_dev
             k_hbm, v_hbm = self._read_host("kv_export_blocks",

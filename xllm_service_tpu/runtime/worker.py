@@ -45,6 +45,7 @@ from xllm_service_tpu.nlp.tokenizer import (
     IncrementalDecoder, Tokenizer, TokenizerFactory)
 from xllm_service_tpu.obs import (
     Failpoints, REQUEST_ID_HEADER, Registry, SpanStore)
+from xllm_service_tpu.obs.events import EventLog
 from xllm_service_tpu.obs.expfmt import quantile_from_buckets
 from xllm_service_tpu.runtime.engine import Engine, EngineRequest, StepOutput
 from xllm_service_tpu.service.coordination import (
@@ -58,6 +59,8 @@ from xllm_service_tpu.service.response_handler import (
     sse_frame, SSE_DONE)
 from xllm_service_tpu.utils.misc import short_uuid
 from xllm_service_tpu.utils.retry import RetryPolicy
+from xllm_service_tpu.utils import threads
+from xllm_service_tpu.utils.threads import spawn
 from xllm_service_tpu.utils.wire import check_version, stamp
 from xllm_service_tpu.utils.types import (
     FinishReason, LogProb, RequestOutput, SamplingParams, SequenceOutput,
@@ -477,6 +480,12 @@ class Worker:
         self.obs = Registry()
         self.spans = SpanStore(capacity=int(os.environ.get(
             "XLLM_SPAN_RING", "2048")))
+        # Worker-plane event ring: thread crashes (and any future
+        # worker-local lifecycle events) land here so a supervised
+        # restart is an EVENT, not just a log line. Small — the service
+        # plane's ring is the cluster's memory; this one is the
+        # worker's own black box.
+        self.events = EventLog(capacity=256)
         # Deterministic fault injection (obs/failpoints.py): per-worker
         # so the co-located test harness can kill ONE of two in-process
         # workers; armed via XLLM_FAILPOINTS and POST /admin/failpoint.
@@ -631,12 +640,24 @@ class Worker:
                 "/kv/blocks_done", "/encode"))
         self.name = self._srv.address
 
-        self._loop_thread = threading.Thread(
-            target=self._engine_loop, name=f"worker-loop-{self.name}",
-            daemon=True)
-        self._hb_thread = threading.Thread(
-            target=self._heartbeat_loop, name=f"worker-hb-{self.name}",
-            daemon=True)
+        # Supervised roots (utils/threads.py): an uncaught exception
+        # logs + counts (xllm_thread_crashes_total) + emits
+        # thread_crashed instead of killing the thread silently. The
+        # heartbeat loop RESTARTS with jittered backoff — a dead beat
+        # loop is indistinguishable from a dead worker to the master
+        # (lease expiry) — while the engine loop stays down on a crash:
+        # engine state may be mid-step-corrupt and a supervised death
+        # is visible (metrics/event) where a restart could silently
+        # serve from a broken pool.
+        self._loop_thread = spawn(
+            "worker.engine_loop", self._engine_loop,
+            thread_name=f"worker-loop-{self.name}",
+            events=self.events, stop=self._stop)
+        self._hb_thread = spawn(
+            "worker.hb_loop", self._heartbeat_loop,
+            thread_name=f"worker-hb-{self.name}",
+            restart=threads.RESTART_POLICY,
+            events=self.events, stop=self._stop)
         self._lease_id: Optional[int] = None
 
     # ------------------------------------------------------------------
@@ -791,8 +812,8 @@ class Worker:
                 try:
                     if self._send_heartbeat():   # ack == HTTP 200, not
                         break                    # "the POST didn't raise"
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — push retried above;
+                    pass            # the drain proceeds either way
                 time.sleep(0.2)
             else:
                 # Could not tell the router; give its next poll a beat.
@@ -824,8 +845,8 @@ class Worker:
         if self._addr_watch is not None:
             try:
                 self.store.cancel_watch(self._addr_watch)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — shutdown cleanup is
+                pass            # best-effort; the store may be gone
             self._addr_watch = None
         # Release consumer threads blocked on live.q.get(): the engine
         # loop is about to exit, so no further outputs (or cancel
@@ -850,8 +871,8 @@ class Worker:
         if self._lease_id is not None:
             try:
                 self.store.lease_revoke(self._lease_id)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — best-effort: the lease
+                pass            # TTL expires it anyway
         self._loop_thread.join(timeout=5)
         self._hb_thread.join(timeout=5)
 
@@ -896,8 +917,8 @@ class Worker:
             # old key or every flip leaks a live lease in the store.
             try:
                 self.store.lease_revoke(self._lease_id)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — best-effort: the old
+                pass            # lease's TTL expires it anyway
             self._lease_id = None
         self._lease_id = self.store.lease_grant(self.opts.lease_ttl_s)
         self.store.put_json(
@@ -1315,7 +1336,8 @@ class Worker:
         spec) at runtime. Closed catalog: unknown names are a 400."""
         try:
             body = req.json()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — the 400 carries the
+            # verdict straight back to the caller
             return Response.error(400, "invalid JSON body")
         try:
             self.failpoints.arm_from_body(body)
@@ -1509,8 +1531,12 @@ class Worker:
             for c in cleanups:
                 try:
                     c()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:
+                    # Every cleanup must run even when one fails — but
+                    # the failure is counted, not dropped (a leaking
+                    # cleanup here is a leaked live-request slot).
+                    threads.record_callback_error(
+                        "worker.stream_close", e)
         resp = Response.sse(stream)
         resp.on_close = on_close
         return resp
@@ -1552,7 +1578,8 @@ class Worker:
                                   "unavailable")
         try:
             body = req.json()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — the 400 carries the
+            # verdict straight back to the caller
             return Response.error(400, "invalid JSON body")
         srid_hint = body.get("service_request_id") or ""
         if srid_hint:
@@ -1695,6 +1722,9 @@ class Worker:
             self._flush_phase_ledger(rt)
             self._flush_overlap(rt)
             self._flush_prefix_cache(rt)
+        # Supervised-thread crash / swallowed-callback books
+        # (utils/threads.py — process-global, root-labeled).
+        threads.flush_metrics(obs)
         # Keep-alive reuse pool, labeled with the exporting plane (the
         # pool is process-global — see the service-side exporter note).
         # In the separate-process deployment this is the worker→service
@@ -2895,7 +2925,8 @@ class Worker:
         octet-stream (meta line + K bytes + V bytes)."""
         try:
             body = req.json()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — the 400 carries the
+            # verdict straight back to the caller
             return Response.error(400, "invalid JSON body")
         check_version(body, "kv_blocks")
         model = body.get("model", self.opts.model)
@@ -3135,7 +3166,11 @@ class Worker:
             return False
         try:
             status, cfg = http_json("GET", addr, "/rpc/config", timeout=5.0)
-        except Exception:  # noqa: BLE001
+        except Exception as e:
+            # Transient by design (the hb loop re-tries via the stale
+            # flag) — but debug-visible, not silent.
+            logger.debug("service config fetch from %s failed: %s",
+                         addr, e)
             return False
         if status == 200 and cfg is not None and addr == self.service_addr:
             self._decode_to_service = bool(
@@ -3148,6 +3183,14 @@ class Worker:
         hb_failures = 0
         next_hb = 0.0
         while not self._stop.wait(self.opts.heartbeat_interval_s):
+            # Injected thread crash, deliberately OUTSIDE the try below:
+            # proves the supervised-restart path end to end (the spawn
+            # handler must log + count + emit thread_crashed, then
+            # restart this loop with backoff — docs/ROBUSTNESS.md).
+            if self.failpoints.fire("worker.crash_heartbeat") is not None:
+                raise RuntimeError(
+                    "injected heartbeat-loop crash "
+                    "(failpoint worker.crash_heartbeat)")
             try:
                 # Periodic sweep of orphaned chunked-shuttle staging —
                 # lazy eviction alone never fires on an idle decode
@@ -3299,15 +3342,20 @@ class Worker:
         # span ring (same correlation id); an undelivered batch is
         # requeued so the next beat retries it.
         span_batch = self.spans.drain_finished()
-        hb = Heartbeat(
-            name=self.name, instance_type=self.instance_type,
-            load=load, latency=self._latency,
-            cache_stored=stored, cache_removed=removed,
-            cache_offloaded=offloaded,
-            cache_offloaded_ssd=offloaded_ssd,
-            model_states=model_states, spans=span_batch)
-        self._latency = LatencyMetrics()
+        # EVERYTHING between the drain and a delivered beat sits inside
+        # the try: a Heartbeat construction or serialization that
+        # raises must requeue the drained batch exactly like a failed
+        # send, or those finished spans silently vanish (xlint rule
+        # resource-leak pins the drain→requeue pairing).
         try:
+            hb = Heartbeat(
+                name=self.name, instance_type=self.instance_type,
+                load=load, latency=self._latency,
+                cache_stored=stored, cache_removed=removed,
+                cache_offloaded=offloaded,
+                cache_offloaded_ssd=offloaded_ssd,
+                model_states=model_states, spans=span_batch)
+            self._latency = LatencyMetrics()
             status, _ = http_json("POST", self.service_addr,
                                   "/rpc/heartbeat", stamp(hb.to_json()),
                                   timeout=10.0)

@@ -29,6 +29,9 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from xllm_service_tpu.utils import threads
+from xllm_service_tpu.utils.threads import spawn
+
 # Watch event: ("PUT" | "DELETE", key, value-or-None)
 WatchEvent = Tuple[str, str, Optional[str]]
 WatchCallback = Callable[[WatchEvent], None]
@@ -134,12 +137,19 @@ class InMemoryStore(CoordinationStore):
         # wedge registration state downstream).
         import queue as _queue
         self._dispatch_q: "_queue.Queue" = _queue.Queue()
-        self._dispatcher = threading.Thread(
-            target=self._dispatch_loop, name="coord-dispatch", daemon=True)
+        # Supervised + restarted (utils/threads.py): the dispatcher and
+        # the lease sweeper are the store's pulse — a crash restarts
+        # them with backoff (the queue and lease books persist across a
+        # restart) instead of silently wedging every watcher.
+        self._dispatcher = spawn(
+            "coord.dispatch", self._dispatch_loop,
+            thread_name="coord-dispatch",
+            restart=threads.RESTART_POLICY)
         self._dispatcher.start()
-        self._sweeper = threading.Thread(
-            target=self._sweep_loop, args=(sweep_interval_s,),
-            name="coord-sweeper", daemon=True)
+        self._sweeper = spawn(
+            "coord.sweep", self._sweep_loop, args=(sweep_interval_s,),
+            thread_name="coord-sweeper",
+            restart=threads.RESTART_POLICY)
         self._sweeper.start()
 
     # -- internal ---------------------------------------------------------
@@ -165,9 +175,14 @@ class InMemoryStore(CoordinationStore):
             for cb in callbacks:
                 try:
                     cb(ev)
-                except Exception:  # noqa: BLE001
-                    import traceback
-                    traceback.print_exc()
+                except Exception as e:
+                    # A broken watch callback must not kill the
+                    # dispatcher (every other watcher starves) — but
+                    # the drop is TELEMETRY, not silence: logged with
+                    # traceback + counted as
+                    # xllm_callback_errors_total{root="coord.dispatch"}
+                    # (xlint rule 16 verifies this path).
+                    threads.record_callback_error("coord.dispatch", e)
 
     def _delete_locked(self, key: str) -> bool:
         if key not in self._data:

@@ -20,6 +20,8 @@ from xllm_service_tpu.service.coordination import (
 from xllm_service_tpu.service.httpd import (
     HttpServer, Request, Response, Router, http_json)
 from xllm_service_tpu.utils.locks import make_lock
+from xllm_service_tpu.utils import threads
+from xllm_service_tpu.utils.threads import spawn
 
 
 class StoreServer:
@@ -170,9 +172,13 @@ class RemoteStore(CoordinationStore):
             self._next_watch += 1
             stop = threading.Event()
             self._watches[wid] = stop
-        threading.Thread(target=self._watch_loop,
-                         args=(prefix, callback, stop),
-                         name=f"remote-watch-{wid}", daemon=True).start()
+        # Supervised + restarted: the long-poll loop already absorbs
+        # transport failures; the supervised restart absorbs crashes
+        # outside its try blocks so a remote watcher can't die silently.
+        spawn("coordination_net.watch_loop", self._watch_loop,
+              args=(prefix, callback, stop),
+              thread_name=f"remote-watch-{wid}",
+              restart=threads.RESTART_POLICY, stop=stop).start()
         return wid
 
     def _watch_loop(self, prefix: str, callback: WatchCallback,
@@ -187,8 +193,8 @@ class RemoteStore(CoordinationStore):
                                          timeout=self.timeout)
                 if status == 200:
                     rev = resp["rev"]
-            except Exception:  # noqa: BLE001
-                stop.wait(1.0)
+            except Exception:  # noqa: BLE001 — store still booting or
+                stop.wait(1.0)  # unreachable; this loop IS the retry
         while not stop.is_set():
             try:
                 status, resp = http_json(
@@ -210,9 +216,12 @@ class RemoteStore(CoordinationStore):
                         return
                     try:
                         callback((ev_type, key, value))
-                    except Exception:  # noqa: BLE001
-                        import traceback
-                        traceback.print_exc()
+                    except Exception as e:
+                        # A broken callback must not kill (or stall)
+                        # the watch loop — logged + counted, never
+                        # silently printed to a stderr nobody tails.
+                        threads.record_callback_error(
+                            "coordination_net.watch_loop", e)
             except Exception:  # noqa: BLE001 — store restarting/unreachable
                 stop.wait(1.0)
 

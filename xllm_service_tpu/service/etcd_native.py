@@ -52,7 +52,9 @@ def build_binary() -> Optional[str]:
             subprocess.run(cmd, check=True, capture_output=True,
                            timeout=180)
             os.replace(tmp, binary)
-        except Exception:
+        except Exception:  # noqa: BLE001 — no toolchain / compile
+            # failure: None falls back to the in-process store, which
+            # the caller reports
             try:
                 os.unlink(tmp)
             except OSError:

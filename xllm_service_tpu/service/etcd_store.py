@@ -34,8 +34,24 @@ from typing import Dict, Optional, Tuple
 from xllm_service_tpu.service.coordination import (
     CoordinationStore, InMemoryStore, WatchCallback)
 from xllm_service_tpu.utils.locks import make_lock
+from xllm_service_tpu.utils import threads
+from xllm_service_tpu.utils.threads import spawn
 
 logger = logging.getLogger(__name__)
+
+
+def _safe_callback(callback: WatchCallback, ev) -> None:
+    """Deliver one watch event, swallowing (with telemetry) a crashing
+    CALLBACK: before this, a callback exception fell into the watch
+    loop's reconnect handler, which re-fetched the same revision and
+    re-crashed — an infinite redelivery loop visible only at DEBUG.
+    The event is dropped for that callback (watchers are
+    resync-tolerant by contract); the error is logged + counted as
+    ``xllm_callback_errors_total{root="etcd.watch_loop"}``."""
+    try:
+        callback(ev)
+    except Exception as e:
+        threads.record_callback_error("etcd.watch_loop", e)
 
 
 def _b64(s: str) -> str:
@@ -164,9 +180,14 @@ class EtcdStore(CoordinationStore):
             wid = self._watch_seq
             stop = threading.Event()
             self._watches[wid] = (stop, None)
-        t = threading.Thread(target=self._watch_loop,
-                             args=(wid, prefix, callback, stop),
-                             name=f"etcd-watch-{wid}", daemon=True)
+        # Supervised + restarted: a watch loop that dies silently means
+        # instance books that never update again (the degradation class
+        # rule 14 exists for); the loop's own reconnect handles stream
+        # failures, the supervised restart handles crashes outside it.
+        t = spawn("etcd.watch_loop", self._watch_loop,
+                  args=(wid, prefix, callback, stop),
+                  thread_name=f"etcd-watch-{wid}",
+                  restart=threads.RESTART_POLICY, stop=stop)
         t.start()
         return wid
 
@@ -219,11 +240,13 @@ class EtcdStore(CoordinationStore):
                         key = _ub64(kv.get("key", ""))
                         if ev.get("type") == "DELETE":
                             known.pop(key, None)
-                            callback(("DELETE", key, None))
+                            _safe_callback(callback,
+                                           ("DELETE", key, None))
                         else:
                             value = _ub64(kv.get("value", ""))
                             known[key] = value
-                            callback(("PUT", key, value))
+                            _safe_callback(callback,
+                                           ("PUT", key, value))
             except Exception as e:  # noqa: BLE001 — reconnect from next_rev
                 if not stop.is_set():
                     logger.debug("etcd watch %d reconnecting: %s", wid, e)
@@ -243,11 +266,11 @@ class EtcdStore(CoordinationStore):
         for key in list(known):
             if key not in current:
                 known.pop(key)
-                callback(("DELETE", key, None))
+                _safe_callback(callback, ("DELETE", key, None))
         for key, value in current.items():
             if known.get(key) != value:
                 known[key] = value
-                callback(("PUT", key, value))
+                _safe_callback(callback, ("PUT", key, value))
 
     def cancel_watch(self, watch_id: int) -> None:
         with self._lock:
@@ -258,8 +281,9 @@ class EtcdStore(CoordinationStore):
             if conn is not None:
                 try:
                     conn.sock and conn.sock.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — a dead socket is
+                    pass            # the goal state of cancel
+
 
     def close(self) -> None:
         with self._lock:

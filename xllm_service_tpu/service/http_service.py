@@ -42,6 +42,8 @@ from xllm_service_tpu.service.httpd import (
     iter_sse_events)
 from xllm_service_tpu.service.instance_types import RequestPhase
 from xllm_service_tpu.service.recovery import RecoveryManager, RelayLedger
+from xllm_service_tpu.utils import threads
+from xllm_service_tpu.utils.threads import spawn
 from xllm_service_tpu.service.response_handler import (
     SSE_DONE, ChatStreamAssembler, CompletionStreamAssembler,
     ResponseCollector)
@@ -263,8 +265,15 @@ class HttpService:
     def start_watchdog(self) -> None:
         if self._wd_thread is not None:
             return
-        self._wd_thread = threading.Thread(
-            target=self._watchdog_loop, name="obs-watchdog", daemon=True)
+        # Supervised + restarted: the watchdog is the judgment layer's
+        # pulse — its per-tick try/except already survives a bad tick,
+        # and the supervised restart survives a crash in the wait
+        # machinery itself.
+        self._wd_thread = spawn(
+            "obs.watchdog_loop", self._watchdog_loop,
+            thread_name="obs-watchdog",
+            restart=threads.RESTART_POLICY,
+            events=self.events, stop=self._wd_stop)
         self._wd_thread.start()
 
     def close(self) -> None:
@@ -957,7 +966,8 @@ class HttpService:
         try:
             status, resp = http_json("POST", target, "/v1/embeddings",
                                      body, timeout=300.0)
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 — the 503 carries the
+            # error straight back to the client
             return Response.error(503, f"worker error: {e}")
         return Response.json(resp, status=status)
 
@@ -1012,6 +1022,9 @@ class HttpService:
         # same series under distinct labels instead of colliding.
         from xllm_service_tpu.service.httpd import flush_conn_pool_metrics
         flush_conn_pool_metrics(obs, plane="service")
+        # Supervised-thread crash / swallowed-callback books
+        # (utils/threads.py — process-global, root-labeled).
+        threads.flush_metrics(obs)
         # Admission pressure (set by Master after server construction):
         # active slots + total 503-rejected per server.
         for srv_name, adm in (self.admissions or {}).items():
@@ -1192,8 +1205,8 @@ class HttpService:
                     inst.model_states[model] = (
                         "asleep" if action == "sleep" else "awake")
                 results[name] = status
-            except Exception as e:  # noqa: BLE001
-                results[name] = str(e)
+            except Exception as e:  # noqa: BLE001 — the error rides the
+                results[name] = str(e)  # per-instance results payload
         if not results:
             return Response.error(404,
                                   f"model {model} not found on any instance")

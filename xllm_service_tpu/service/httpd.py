@@ -25,6 +25,7 @@ from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
 from urllib.parse import parse_qs, urlparse
 
 from xllm_service_tpu.utils.locks import make_lock
+from xllm_service_tpu.utils.threads import spawn
 
 
 class Request:
@@ -319,9 +320,9 @@ class PyHttpServer:
         return f"{self.host}:{self.port}"
 
     def start(self) -> "HttpServer":
-        self._thread = threading.Thread(
-            target=self._srv.serve_forever, name=f"httpd-{self.port}",
-            daemon=True)
+        self._thread = spawn(
+            "httpd.serve", self._srv.serve_forever,
+            thread_name=f"httpd-{self.port}")
         self._thread.start()
         return self
 

@@ -43,6 +43,7 @@ from xllm_service_tpu.service.instance_types import (
     RequestPhase)
 from xllm_service_tpu.service.time_predictor import TimePredictor
 from xllm_service_tpu.utils.locks import make_rlock
+from xllm_service_tpu.utils.threads import spawn
 
 logger = logging.getLogger(__name__)
 
@@ -704,7 +705,8 @@ class InstanceMgr:
                              {"instance_type": to_type.value})
             except Exception as e:  # noqa: BLE001
                 logger.warning("flip notify %s failed: %s", name, e)
-        threading.Thread(target=notify, daemon=True).start()
+        spawn("instance_mgr.flip_notify", notify,
+              events=lambda: self.events).start()
         return True
 
     # ------------------------------------------------------------------

@@ -27,6 +27,8 @@ from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs
 
 from xllm_service_tpu.utils.locks import make_lock
+from xllm_service_tpu.utils import threads
+from xllm_service_tpu.utils.threads import spawn
 
 # The headers blob is "key\0value\0...": it MUST cross as pointer+length
 # (c_void_p + c_int64) — a c_char_p conversion would truncate it at the
@@ -67,7 +69,8 @@ def _build() -> Optional[str]:
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, so)
-    except Exception:
+    except Exception:  # noqa: BLE001 — no toolchain / compile failure:
+        # None falls back to the stdlib ThreadingHTTPServer path
         try:
             os.unlink(tmp)
         except OSError:
@@ -312,10 +315,10 @@ class NativeHttpServer:
                     # polls, or a live limit raise): fall back to the
                     # old per-request Thread so nothing queues behind a
                     # 30 s watcher or an SSE stream.
-                    threading.Thread(
-                        target=self._run, args=(rid, req, counted),
-                        daemon=True,
-                        name=f"httpd-native-{self.port}-ovf").start()
+                    spawn("native_httpd.overflow", self._run,
+                          args=(rid, req, counted),
+                          thread_name=(f"httpd-native-{self.port}-ovf")
+                          ).start()
                 else:
                     try:
                         fut = self._pool.submit(self._run_pooled,
@@ -349,6 +352,12 @@ class NativeHttpServer:
     def _run_pooled(self, rid: int, req, counted: bool) -> None:
         try:
             self._run(rid, req, counted)
+        except Exception as e:
+            # _run answers its own 500s; anything still escaping here
+            # would vanish into the executor's never-result()ed Future
+            # — the silent-death class xlint rule 14 forbids. Logged +
+            # counted; the pool thread survives for the next request.
+            threads.record_callback_error("native_httpd.pool", e)
         finally:
             with self._pool_lock:
                 self._pool_busy -= 1

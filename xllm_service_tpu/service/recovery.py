@@ -50,6 +50,7 @@ from xllm_service_tpu.obs.spans import REQUEST_ID_HEADER
 from xllm_service_tpu.service.instance_types import RequestPhase
 from xllm_service_tpu.service.response_handler import SSE_DONE, sse_frame
 from xllm_service_tpu.utils.retry import RetryPolicy
+from xllm_service_tpu.utils.threads import spawn
 from xllm_service_tpu.utils.types import (
     Request as SchedRequest, Routing, Usage)
 
@@ -269,10 +270,11 @@ class RecoveryManager:
                 return False
             ctx["resuming"] = True
             ctx["resumes"] += 1
-        threading.Thread(
-            target=self._resume_rpc, args=(tracked, dead),
-            name=f"recovery-{tracked.request.service_request_id}",
-            daemon=True).start()
+        spawn("recovery.resume_rpc", self._resume_rpc,
+              args=(tracked, dead),
+              thread_name=(f"recovery-"
+                           f"{tracked.request.service_request_id}")
+              ).start()
         return True
 
     def _resume_rpc(self, tracked, dead: str) -> None:

@@ -39,6 +39,8 @@ from xllm_service_tpu.service.instance_types import (
 from xllm_service_tpu.service.kvcache_mgr import GlobalKVCacheMgr
 from xllm_service_tpu.service.lb_policy import create_policy
 from xllm_service_tpu.utils.misc import OrderedFanInPools, short_uuid
+from xllm_service_tpu.utils import threads
+from xllm_service_tpu.utils.threads import spawn
 from xllm_service_tpu.utils.types import (
     OutputCallback, Request, RequestOutput, Routing, Status, StatusCode)
 from xllm_service_tpu.utils.locks import make_lock
@@ -167,9 +169,15 @@ class Scheduler:
         self._pools = OrderedFanInPools(opts.num_output_pools)
 
         self._stop = threading.Event()
-        self._hb_thread = threading.Thread(
-            target=self._master_loop, name="scheduler-master-loop",
-            daemon=True)
+        # Supervised + restarted: the master keepalive loop IS the
+        # replica's claim to the master lease — a crashed loop means a
+        # spurious failover. events resolves lazily (the EventLog is
+        # attached by Master post-construction).
+        self._hb_thread = spawn(
+            "scheduler.master_loop", self._master_loop,
+            thread_name="scheduler-master-loop",
+            restart=threads.RESTART_POLICY,
+            events=lambda: self.events, stop=self._stop)
         self._hb_thread.start()
 
     # ------------------------------------------------------------------

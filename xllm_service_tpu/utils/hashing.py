@@ -132,7 +132,8 @@ def _build_native() -> Optional[str]:
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, so)
-    except Exception:
+    except Exception:  # noqa: BLE001 — no toolchain / compile failure:
+        # None falls back to the pure-python murmur path
         try:
             os.unlink(tmp)
         except OSError:

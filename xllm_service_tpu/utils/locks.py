@@ -50,6 +50,12 @@ increasing):
     92  httpd.connpool                  — guards the keep-alive dict only
     93  obs.registry                    — metrics families (never calls out)
     94  obs.spans                       — span ring buffer (never calls out)
+    94  threads.book                    — supervised-thread crash /
+                                          callback-error books
+                                          (utils/threads.py; guards two
+                                          dicts, never calls out; equal
+                                          rank with obs.spans = the two
+                                          are never held together)
     95  hashing.native                  — innermost (C call guard)
     96  native_httpd.lib                — one-shot native-library load
     97  etcd_native.build               — one-shot etcd-client build

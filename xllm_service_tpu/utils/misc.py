@@ -16,6 +16,8 @@ import threading
 
 from typing import Any, Callable, Dict, List, Optional
 from xllm_service_tpu.utils.locks import make_lock
+from xllm_service_tpu.utils import threads
+from xllm_service_tpu.utils.threads import spawn
 
 _ALPHABET = "23456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
 
@@ -65,7 +67,10 @@ class _SerialWorker:
 
     def __init__(self, name: str) -> None:
         self._q: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue()
-        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        # Supervised (utils/threads.py): the per-callback handler below
+        # protects siblings from a bad callback; the spawn handler makes
+        # a crash of the drain loop itself visible instead of silent.
+        self._thread = spawn("misc.fanin", self._run, thread_name=name)
         self._thread.start()
 
     def submit(self, fn: Callable[[], None]) -> None:
@@ -78,9 +83,12 @@ class _SerialWorker:
                 return
             try:
                 fn()
-            except Exception:  # noqa: BLE001 — a bad callback must not kill the pool
-                import traceback
-                traceback.print_exc()
+            except Exception as e:
+                # A bad callback must not kill the pool (its siblings'
+                # token streams ride the same thread) — but the drop is
+                # logged + counted (xllm_callback_errors_total), not
+                # printed to an untailed stderr (xlint rule 16).
+                threads.record_callback_error("misc.fanin", e)
 
     def stop(self) -> None:
         self._q.put(None)
