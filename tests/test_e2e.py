@@ -7,6 +7,7 @@ store lease + heartbeat, both response topologies.
 """
 
 import json
+import re
 import time
 from typing import Optional
 
@@ -172,6 +173,14 @@ class TestEndToEnd:
             conn.close()
             assert 'xllm_worker_phase_seconds_total' in wtext
             assert 'phase="prefill.dispatch"' in wtext
+            # ...and the jit compile census: warmup plus the completion
+            # above must have compiled at least one prefill variant.
+            assert 'xllm_worker_jit_compiles_total' in wtext
+            m_compiles = re.search(
+                r'xllm_worker_jit_compiles_total\{model="tiny",'
+                r'program="prefill"\} (\d+)', wtext)
+            assert m_compiles, wtext
+            assert int(m_compiles.group(1)) >= 1
 
             # Keep-alive reuse pool counters (service→worker transport)
             # surface on /metrics so transport regressions are visible

@@ -46,7 +46,7 @@ def real_tree():
 
 @pytest.fixture(scope="module")
 def timed_full_run():
-    """ONE cold full-tree 16-rule run, timed, shared by the clean gate
+    """ONE cold full-tree 19-rule run, timed, shared by the clean gate
     and the budget gate — running it twice would double-bill the
     callgraph build against the 870 s tier-1 budget."""
     import time
@@ -57,7 +57,7 @@ def timed_full_run():
 
 class TestRealTree:
     def test_real_tree_is_clean(self, timed_full_run):
-        """The acceptance gate: all sixteen rules over
+        """The acceptance gate: all nineteen rules over
         xllm_service_tpu/, checked-in allowlists applied, zero
         findings."""
         findings, _t = timed_full_run
@@ -107,9 +107,9 @@ class TestRealTree:
                 f"utils/locks.py docstring table"
 
     def test_full_run_fits_runtime_budget(self, timed_full_run):
-        """All 16 rules (the whole-program concurrency pass AND the
-        exception-flow/lifecycle pass, callgraph memoized per run)
-        over the real tree in < 30 s — the interprocedural analysis
+        """All 19 rules (the whole-program concurrency pass, the
+        exception-flow/lifecycle pass, AND the device-plane tracewalk,
+        callgraph memoized per run) over the real tree in < 30 s — the interprocedural analysis
         must never eat the 870 s tier-1 budget. Typical: ~5 s; the
         margin absorbs slow containers. (Timed on the same cold run
         the clean gate consumes.)"""
@@ -318,6 +318,40 @@ class TestPositiveControls:
         # ...and the old rule-6 control, now owned by rule 16.
         assert "xllm_service_tpu/service/httpd.py::" \
                "Handler.dispatch::swallow@0" in keys
+
+    def test_recompile_hazard_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "recompile-hazard")
+        p = "xllm_service_tpu/runtime/bad_steps.py"
+        # A static arg fed from len() of a runtime collection: every
+        # distinct batch size triggers a fresh compile.
+        assert f"{p}::StepEngine.step::_jit_step::static-n" in keys
+        # A bare Python list as a *traced* arg retraces per call.
+        assert f"{p}::StepEngine.step::_jit_upload::traced-ids" in keys
+        # The bucketed static in the clean fixture must not appear
+        # anywhere in the bad run either (different tree, but pin the
+        # key shape).
+        assert not any("static-T" in k for k in keys)
+
+    def test_sharded_donation_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "sharded-donation")
+        p = "xllm_service_tpu/parallel/bad_sharded.py"
+        # Mesh-partitioned program carrying a KV pool, nothing donated.
+        assert f"{p}::_jit_undonated_sharded::sharded-donate" in keys
+        # Donates but pins no layouts and proves no committed carry.
+        assert f"{p}::_jit_unpinned_sharded::sharded-pin" in keys
+
+    def test_transfer_discipline_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "transfer-discipline")
+        p = "xllm_service_tpu/runtime/bad_steps.py"
+        # Per-call comprehension crossing the boundary on the step path.
+        assert f"{p}::StepEngine.step::_jit_upload::host-ids" in keys
+        # Host-side attr mirror passed raw.
+        assert f"{p}::StepEngine.step::_jit_upload::host-extra" in keys
+        # Host-only local + inline np build, one call-graph hop down.
+        assert f"{p}::StepEngine._dispatch::_jit_upload::host-ids" \
+               in keys
+        assert f"{p}::StepEngine._dispatch::_jit_upload::host-extra" \
+               in keys
 
 
 class TestNoFalsePositives:
@@ -724,6 +758,169 @@ class TestLifecycle:
         assert not any("test_local_scope" in k for k in keys)
 
 
+class TestTracewalk:
+    """The device-plane enumerator itself: every jit spelling the real
+    tree uses must resolve to a program with its contract, and every
+    site it cannot resolve must be recorded as a hole WITH a pinned
+    reason — never silently skipped."""
+
+    def _tw(self, tmp_path, source):
+        from tools.xlint import load_tree
+        from tools.xlint.tracewalk import tracewalk_analyze
+        pkg = tmp_path / "xllm_service_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(source)
+        tree, errors = load_tree(["xllm_service_tpu"],
+                                 root=str(tmp_path))
+        assert errors == []
+        return tracewalk_analyze(tree)
+
+    def test_decorator_form_and_site(self, tmp_path):
+        tw = self._tw(tmp_path, (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x\n"
+            "def g(x):\n"
+            "    return f(x)\n"))
+        [p] = tw.programs
+        assert p.binding[0] == "fid"
+        assert p.params == ["x"]
+        assert [(s.qualname, s.program) for s in tw.sites] == [("g", p)]
+
+    def test_partial_offsets_params(self, tmp_path):
+        """Positionally-bound partial args shift the post-partial
+        signature the contract indices refer to."""
+        tw = self._tw(tmp_path, (
+            "import functools\n"
+            "import jax\n"
+            "def step(params, x, kv, n):\n"
+            "    return x\n"
+            "_j = jax.jit(functools.partial(step, None),\n"
+            "             donate_argnums=(1,), static_argnums=(2,))\n"))
+        [p] = tw.programs
+        assert p.params == ["x", "kv", "n"]
+        assert p.donate_argnums == {1}
+        assert p.static_argnums == {2}
+        assert p.kv_positions() == [1]
+
+    def test_static_argnames_and_kwarg_binding(self, tmp_path):
+        tw = self._tw(tmp_path, (
+            "import functools\n"
+            "import jax\n"
+            "def step(x, kv, *, t_len=None, cfg=None):\n"
+            "    return x\n"
+            "_j = jax.jit(functools.partial(step, cfg=None),\n"
+            "             static_argnames=('t_len',))\n"))
+        [p] = tw.programs
+        assert p.static_argnames == {"t_len"}
+        assert p.kw_bound == {"cfg"}
+        assert p.params == ["x", "kv"]
+
+    def test_pin_splat_resolves(self, tmp_path):
+        """**_pin(...) splats prove layout pinning without evaluating
+        the helper."""
+        tw = self._tw(tmp_path, (
+            "import jax\n"
+            "def _pin(n_in, kv_in):\n"
+            "    return {}\n"
+            "def step(params, x, kv):\n"
+            "    return x\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._j = jax.jit(step, donate_argnums=(2,),\n"
+            "                          **_pin(1, 2))\n"))
+        [p] = tw.programs
+        assert p.binding == ("attr", "_j")
+        assert p.pinned and "_pin" in p.pin_via
+        assert p.donate_argnums == {2}
+
+    def test_sharded_factory_marks_mesh(self, tmp_path):
+        """A *_sharded factory resolves through `return <nested def>`
+        and marks the program mesh-partitioned."""
+        tw = self._tw(tmp_path, (
+            "import jax\n"
+            "def make_sharded(mesh):\n"
+            "    def inner(params, x, kv):\n"
+            "        return x\n"
+            "    return inner\n"
+            "_j = jax.jit(make_sharded(None), donate_argnums=(2,))\n"))
+        [p] = tw.programs
+        assert p.mesh_bound
+        assert p.params == ["params", "x", "kv"]
+
+    def test_unresolved_callable_is_pinned_hole(self, tmp_path):
+        tw = self._tw(tmp_path, (
+            "import jax\n"
+            "_fns = {}\n"
+            "_j = jax.jit(_fns['decode'])\n"))
+        # The program is kept (its contract kwargs are still readable)
+        # but its signature is unknown — and that gap is a recorded
+        # hole, not a silent pass.
+        [p] = tw.programs
+        assert p.params is None
+        assert tw.holes
+        for h in tw.holes:
+            assert h.reason, f"hole without a pinned reason: {h}"
+
+    def test_unbound_program_is_pinned_hole(self, tmp_path):
+        """A jit(...) whose result is neither bound nor immediately
+        invoked cannot be tracked to call sites — recorded, not
+        skipped."""
+        tw = self._tw(tmp_path, (
+            "import jax\n"
+            "def f(x):\n"
+            "    return x\n"
+            "jax.jit(f)\n"))
+        assert any("unbound" in h.desc or "unbound" in h.reason
+                   for h in tw.holes)
+
+    def test_nonliteral_contract_is_recorded(self, tmp_path):
+        """donate_argnums fed from a variable can't be read statically
+        — the program is flagged unresolved rather than assumed
+        donated."""
+        tw = self._tw(tmp_path, (
+            "import jax\n"
+            "_D = (2,)\n"
+            "def step(params, x, kv):\n"
+            "    return x\n"
+            "_j = jax.jit(step, donate_argnums=_D)\n"))
+        [p] = tw.programs
+        assert p.donate_unresolved
+        assert p.donate_argnums == set()
+
+
+class TestDevicePlaneRegressions:
+    """The two true findings the device-plane rules surfaced on the
+    real tree, pinned fixed."""
+
+    def test_dryrun_harness_donates_kv_pool(self, real_tree):
+        """__graft_entry__.py dryrun jits rebind the sharded pool from
+        each step's output — without donate_argnums=(4,) every step
+        paid a pool-sized copy per shard (found by sharded-donation)."""
+        from tools.xlint.tracewalk import tracewalk_analyze
+        tw = tracewalk_analyze(real_tree)
+        ext = [p for p in tw.programs
+               if p.extern and p.kv_positions()]
+        assert ext, "dryrun harness jit programs not enumerated?"
+        for p in ext:
+            assert not p.donate_unresolved, p.label
+            assert set(p.kv_positions()) <= p.donate_argnums, \
+                f"{p.label}@{p.line}: kv at {p.kv_positions()} not " \
+                f"in donate_argnums={sorted(p.donate_argnums)}"
+
+    def test_pallas_qblock_default_read_at_import(self, real_tree):
+        """The prefill kernel's q_block static was fed from an env
+        read PER CALL — an avoidable host syscall on the hot path and
+        a recompile hazard if the env ever changes mid-run (found by
+        recompile-hazard). The default is now hoisted to import time."""
+        p = "xllm_service_tpu/ops/pallas/prefill_attention.py"
+        src = real_tree.read_text(p)
+        assert "_QBLOCK_DEFAULT" in src
+        findings = run([p], rule_names=["recompile-hazard"])
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
 class TestChangedAndSarif:
     def test_sarif_shape(self, capsys):
         rc = main(["--sarif", "--rule", "mosaic-compat",
@@ -789,6 +986,18 @@ class TestChangedAndSarif:
         assert rc == 1
         assert "CrashyRoots._beat_loop" in out
 
+    def test_changed_never_filters_device_plane(self, capsys):
+        """Rules 17-19 attribute findings to the program's defining
+        module, but the hazard-introducing edit can be a call site (or
+        a partial/factory) anywhere — they ride --changed unfiltered
+        like 11-16."""
+        rel = os.path.relpath(BAD, REPO_ROOT)
+        rc = main(["--changed", "HEAD", "--rule", "sharded-donation",
+                   os.path.join(rel, "xllm_service_tpu")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "sharded-donate" in out
+
     def test_concurrency_report_cli(self, capsys):
         # subtree scope: CLI shape only — the full-tree report is
         # covered via the shared fixture in TestRealTree/TestCallGraph
@@ -822,3 +1031,24 @@ class TestCli:
         assert rc == 0
         for r in RULES:
             assert r.name in out
+
+    def test_explain_every_rule_documented(self, capsys):
+        """--explain RULE prints the contract, escape hatches, and
+        fixture examples from the rule's docstring — asserted
+        substantive for all nineteen rules."""
+        import inspect
+        for r in RULES:
+            assert inspect.getdoc(type(r)), \
+                f"rule {r.name} has no docstring for --explain"
+            rc = main(["--explain", r.name])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert r.name in out
+            assert len(out.strip().splitlines()) >= 4, \
+                f"--explain {r.name} output too thin"
+
+    def test_explain_unknown_rule_is_usage_error(self, capsys):
+        rc = main(["--explain", "no-such-rule"])
+        out = capsys.readouterr().out
+        assert rc == 2
+        assert "unknown rule" in out

@@ -1,0 +1,28 @@
+"""Positive controls for rule 18 (sharded-donation): mesh-partitioned
+jit programs carrying a KV pool without donation / without a pinned or
+committed carry. Never imported — parsed only."""
+
+import functools
+
+import jax
+
+_MESH = None   # stands in for a jax Mesh at lint time
+
+
+def _sharded_step(params, x, kv, *, mesh=None):
+    return x, kv
+
+
+def _sharded_half(params, x, kv, *, mesh=None):
+    return x, kv
+
+
+# Fires ::sharded-donate — the partial binds mesh= (mesh-partitioned),
+# the KV pool rides position 2, and nothing is donated.
+_jit_undonated_sharded = jax.jit(
+    functools.partial(_sharded_step, mesh=_MESH))
+
+# Fires ::sharded-pin — donates, but pins no layouts and no call site
+# proves a shard_*-committed carry.
+_jit_unpinned_sharded = jax.jit(
+    functools.partial(_sharded_half, mesh=_MESH), donate_argnums=(2,))

@@ -1,0 +1,56 @@
+"""Positive controls for rules 17 (recompile-hazard) and 19
+(transfer-discipline): an engine-loop-reachable step path feeding jit
+programs Python-varying statics and raw host arrays. Never imported —
+parsed only."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _step(x, n, cfg=None):
+    return x
+
+
+def _upload(params, ids, extra):
+    return ids
+
+
+class StepEngine:
+    """Rule 19 seeds on ``_engine_loop``; ``step`` and ``_dispatch``
+    are reachable from it through the call graph."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.pending = []
+        self.params = jnp.zeros((2,))
+        self._mirror = np.zeros((4,), np.int32)   # host-side mirror
+        self._running = True
+        self._jit_step = jax.jit(
+            functools.partial(_step, cfg=cfg), static_argnums=(1,))
+        self._jit_upload = jax.jit(_upload)
+
+    def _engine_loop(self):
+        while self._running:
+            self.step()
+
+    def step(self):
+        # recompile-hazard: static arg fed from len() of a runtime
+        # collection — every distinct batch size compiles.
+        n = len(self.pending)
+        out = self._jit_step(self.params, n)
+        # recompile-hazard (traced) + transfer-discipline: a per-call
+        # comprehension as a non-static arg.
+        out = self._jit_upload(
+            self.params, [float(t) for t in self.pending], out)
+        self._dispatch(out)
+        # transfer-discipline: a host-side attr mirror passed raw.
+        self._jit_upload(self.params, out, self._mirror)
+
+    def _dispatch(self, out):
+        # transfer-discipline: a host-only local and an inline np build
+        # flowing raw into a jit on a per-step path.
+        ids = np.asarray(self.pending)
+        self._jit_upload(self.params, ids, np.zeros((2,), np.float32))

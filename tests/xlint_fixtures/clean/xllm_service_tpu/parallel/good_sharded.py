@@ -1,0 +1,36 @@
+"""Rule 18 near-misses that must NOT fire: mesh-partitioned programs
+that donate the pool and either pin layouts or flow a committed carry.
+Never imported — parsed only."""
+
+import functools
+
+import jax
+
+from xllm_service_tpu.parallel.sharding import shard_kv_cache
+
+_MESH = None
+
+
+def _gstep(params, x, kv, *, mesh=None):
+    return x, kv
+
+
+def _gstep2(params, x, kv, *, mesh=None):
+    return x, kv
+
+
+# Donated AND pinned — must not fire.
+_jit_pinned_sharded = jax.jit(
+    functools.partial(_gstep, mesh=_MESH), donate_argnums=(2,),
+    in_shardings=None, out_shardings=None)
+
+
+def run_committed(mesh, params, x):
+    # Donated, unpinned — but the only call site flows a carry
+    # committed by shard_kv_cache, so per-call resharding is proven
+    # absent.
+    kv = shard_kv_cache({}, mesh, None)
+    step = jax.jit(functools.partial(_gstep2, mesh=mesh),
+                   donate_argnums=(2,))
+    x, kv = step(params, x, kv)
+    return x, kv

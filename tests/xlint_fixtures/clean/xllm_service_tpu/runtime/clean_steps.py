@@ -1,0 +1,48 @@
+"""Rule 17/19 near-misses that must NOT fire: bucketed statics, staged
+uploads, and the declared host-arg escape hatch. Never imported —
+parsed only."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _cstep(x, n, cfg=None):
+    return x
+
+
+def _cupload(params, ids, extra):
+    return ids
+
+
+class StepEngine:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.pending = []
+        self.params = jnp.zeros((2,))
+        self._mirror = np.zeros((4,), np.int32)
+        self._running = True
+        self._jit_step = jax.jit(
+            functools.partial(_cstep, cfg=cfg), static_argnums=(1,))
+        self._jit_upload = jax.jit(_cupload)
+
+    def _bucket(self, n):
+        return 1 << max(3, n)
+
+    def _engine_loop(self):
+        while self._running:
+            self.step()
+
+    def step(self):
+        # Bounded static: bucketed shape (rule 17 near-miss).
+        T = self._bucket(len(self.pending))
+        out = self._jit_step(self.params, T)
+        # Staged upload: the host build is re-bound through
+        # jnp.asarray before crossing the jit boundary (rule 19).
+        ids = np.ascontiguousarray(self.pending)
+        ids = jnp.asarray(ids)
+        out = self._jit_upload(self.params, ids, out)
+        # Declared host arg: the annotation escape hatch (rule 19).
+        return self._jit_upload(self.params, self._mirror, out)  # xlint: host-arg — fixture: cold path, one upload per run

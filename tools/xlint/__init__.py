@@ -18,6 +18,10 @@ Usage::
     python -m tools.xlint --changed HEAD~1  # report only changed files
     python -m tools.xlint --concurrency-report  # roots/lock-sets/proof
     python -m tools.xlint --rule lock-rank path/  # one rule, one subtree
+    python -m tools.xlint --explain recompile-hazard  # rule contract
+
+A pre-commit hook running the ``--changed HEAD`` gate ships in
+``tools/hooks/pre-commit`` (symlink it into ``.git/hooks/``).
 
 Exit status: 0 clean, 1 findings, 2 usage/config error.
 
@@ -308,6 +312,35 @@ def run(paths: Sequence[str], rule_names: Optional[Sequence[str]] = None,
     return findings
 
 
+def explain(rule_name: str) -> int:
+    """--explain: print one rule's contract card — its one-line
+    describe plus the class docstring (contract, escape hatches,
+    fixture examples) and where its allowlist lives. Docstrings are the
+    single source; test_xlint asserts every rule has one."""
+    import inspect
+    from tools.xlint.rules import RULES
+    by_name = {r.name: r for r in RULES}
+    rule = by_name.get(rule_name)
+    if rule is None:
+        print(f"xlint: unknown rule {rule_name!r}; "
+              f"available: {sorted(by_name)}")
+        return 2
+    doc = inspect.getdoc(type(rule)) or ""
+    print(f"{rule.name}: {rule.describe}")
+    print()
+    if doc:
+        print(doc)
+        print()
+    allow = os.path.join(ALLOWLIST_DIR, f"{rule.name}.txt")
+    rel = os.path.relpath(allow, REPO_ROOT).replace(os.sep, "/")
+    if os.path.exists(allow):
+        print(f"allowlist: {rel} (one 'key  # justification' per line)")
+    else:
+        print(f"allowlist: {rel} (none yet — create it to vet an "
+              f"exception, justification comment required)")
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
     from tools.xlint.rules import RULES
@@ -332,6 +365,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="run only this rule (repeatable)")
     ap.add_argument("--list-rules", action="store_true",
                     help="list rules and exit")
+    ap.add_argument("--explain", metavar="RULE", default=None,
+                    help="print one rule's contract, escape hatches, "
+                         "and fixture examples (from its docstring) "
+                         "and exit")
     ap.add_argument("--concurrency-report", action="store_true",
                     help="print the whole-program concurrency summary "
                          "(thread roots, transitive lock-sets, "
@@ -343,6 +380,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for r in RULES:
             print(f"{r.name}: {r.describe}")
         return 0
+
+    if args.explain is not None:
+        return explain(args.explain)
 
     if args.concurrency_report:
         from tools.xlint.concurrency import report
@@ -378,10 +418,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # telemetry-free swallow is attributed to the defining module,
         # but the edit that introduces it (a new callee that raises, a
         # removed release in a helper) can live in any file.
+        # Rules 17–19 likewise: a jit-boundary finding is attributed to
+        # the call site or the program definition, but the edit that
+        # introduces it (a signature change in models/, a removed
+        # staging assignment, a new engine-loop callee) can live in any
+        # file the call graph crosses.
         whole_program = {"lock-order-interprocedural",
                          "blocking-under-lock", "thread-root-race",
                          "thread-root-crash", "resource-leak",
-                         "swallow-telemetry", "allowlist"}
+                         "swallow-telemetry", "allowlist",
+                         "recompile-hazard", "sharded-donation",
+                         "transfer-discipline"}
         findings = [f for f in findings
                     if f.path in changed or f.rule in whole_program]
 

@@ -30,7 +30,7 @@ the class's lock attributes — an annotation naming a lock that does not
 exist is itself a finding).
 
 All three rules share one memoized analysis per lint run (the pass is
-the expensive part; tier-1 budgets the full 13-rule run at < 30 s).
+the expensive part; tier-1 budgets the full 19-rule run at < 30 s).
 """
 
 from __future__ import annotations
@@ -282,6 +282,19 @@ def analyze(tree: RepoTree) -> Analysis:
 
 
 class LockOrderInterproceduralRule:
+    """Contract: the acquires-while-holding edge set observed over the
+    WHOLE program — lexical nesting plus call-mediated acquisition at
+    any depth through the call graph — respects the canonical rank
+    table and is acyclic. A cycle is a provable deadlock; rank
+    violations through helpers are what the lexical rule 3 cannot see.
+
+    Escape hatch: the allowlist, for edges proven unreachable (e.g. a
+    path gated on mutually exclusive modes — justify the gate).
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_concurrency.py.
+    Findings are attributed to utils/locks.py (the cycle, not one
+    edit), so --changed never filters this rule."""
+
     name = "lock-order-interprocedural"
     describe = ("calls made while holding a ranked lock must not reach "
                 "(at any depth) an acquisition of an equal-or-lower "
@@ -363,6 +376,17 @@ def _blocked_by_policy(held, category: str) -> Optional[str]:
 
 
 class BlockingUnderLockRule:
+    """Contract: no blocking operation — network I/O, time.sleep,
+    unbounded Future.result()/Queue.get() — executes while a ranked
+    lock is held, directly or through any callee. A block under a hot
+    lock stalls every thread contending for it.
+
+    Escape hatch: bounded waits (a timeout argument) pass; the
+    allowlist covers sites where the bound is enforced by the callee
+    (justify where).
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_concurrency.py."""
+
     name = "blocking-under-lock"
     describe = ("network I/O, time.sleep, unbounded .result(), "
                 "subprocess, and device syncs must not be reachable "

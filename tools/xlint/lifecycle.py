@@ -36,7 +36,7 @@ checked THROUGH the call graph, not lexically. The inline
 honored as the declared-benign escape hatch.
 
 All three rules share the memoized concurrency analysis (the call
-graph is the expensive part; tier-1 budgets the full 16-rule run at
+graph is the expensive part; tier-1 budgets the full 19-rule run at
 < 30 s).
 """
 
@@ -951,6 +951,20 @@ class _FlowChecker:
 
 
 class ResourceLeakRule:
+    """Contract: every declared acquire/release protocol (KV
+    pin/unpin, connection checkout/return, file handles) releases on
+    EVERY flow edge out of the acquiring function — including the
+    exception edges of calls made between acquire and release, and
+    including branches. A handle whose acquire result is discarded can
+    never be released and is flagged immediately.
+
+    Escape hatch: ownership transfer — returning the live handle (or
+    storing it on self with a registered finalizer) ends this
+    function's obligation; the allowlist covers intentional
+    process-lifetime acquisitions (justify the lifetime).
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_lifecycle.py."""
+
     name = "resource-leak"
     describe = ("declared acquire/release protocols (KV pin/unpin, "
                 "host-tier pop/re-add, conn-pool get/put, span "
@@ -1028,6 +1042,18 @@ class ResourceLeakRule:
 
 
 class SwallowTelemetryRule:
+    """Contract: every ``except`` broader than the benign set (a
+    specific non-Exception class, or a re-raising handler) must emit
+    telemetry — a logger call, events.emit, or a metrics increment —
+    before continuing. A silent broad swallow turns crashes into
+    hangs nobody can diagnose.
+
+    Escape hatch: handlers that re-raise or return an error value
+    pass; the allowlist covers hot-path handlers whose telemetry
+    lives one frame up (justify the frame).
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_lifecycle.py."""
+
     name = "swallow-telemetry"
     describe = ("every except broader than the benign set (bare / "
                 "Exception / BaseException) anywhere in the package "

@@ -1,7 +1,8 @@
 """The xlint rules (1–10 here; the interprocedural concurrency rules
-11–13 live in tools/xlint/concurrency.py and the exception-flow /
-resource-lifecycle rules 14–16 in tools/xlint/lifecycle.py — all
-registered into ``RULES`` below).
+11–13 live in tools/xlint/concurrency.py, the exception-flow /
+resource-lifecycle rules 14–16 in tools/xlint/lifecycle.py, and the
+device-plane jit-boundary rules 17–19 in tools/xlint/tracewalk.py —
+all registered into ``RULES`` below).
 Each proves one invariant the serving/perf work depends on;
 docs/STATIC_ANALYSIS.md records the incident that motivated each. All
 analysis is stdlib ``ast`` — name/alias based, intentionally
@@ -124,6 +125,16 @@ _FORBIDDEN_FROM_IMPORTS = {
 
 
 class MosaicCompatRule:
+    """Contract: kernel code uses only the pallas/jax API names the
+    pinned toolchain ships — names that moved or were renamed across
+    versions (the mosaic breakage class) are called out at lint time
+    instead of at first trace on hardware.
+
+    Escape hatch: the per-rule allowlist for a deliberately
+    version-gated call site (justify with the gating mechanism).
+
+    Fixture: tests/xlint_fixtures/bad/.../ops/bad_mosaic.py."""
+
     name = "mosaic-compat"
     describe = ("version-sensitive pallas/jax API names "
                 "(CompilerParams/HBM/shard_map/set_mesh) only via "
@@ -197,6 +208,18 @@ def _positional_params(fndef: ast.AST) -> List[str]:
 
 
 class DonationCoverageRule:
+    """Contract: a runtime/ jax.jit entry point that takes a KV-pool
+    array (param named kv/kv_pages/k_pages/v_pages/kv_cache) must
+    donate it via donate_argnums — an undonated pool doubles peak HBM
+    for the step. The device-plane generalisation (mesh-partitioned
+    programs, partial/factory spellings, call-site dataflow) is rule
+    18, ``sharded-donation`` in tools/xlint/tracewalk.py.
+
+    Escape hatch: the allowlist, for pools genuinely read-only across
+    the call (justify why no aliasing write exists).
+
+    Fixture: tests/xlint_fixtures/bad/.../runtime/engine.py."""
+
     name = "donation-coverage"
     describe = ("runtime/ jax.jit entry points carrying KV-pool arrays "
                 "must donate them and pin layouts")
@@ -369,6 +392,17 @@ LOCK_RANK_TABLE: Dict[str, int] = {
 
 
 class LockRankRule:
+    """Contract: every lock is created through make_lock with a rank
+    from the canonical table (LOCK_RANK_TABLE here, mirrored in
+    utils/locks.py), and lexically nested ``with`` acquisitions go
+    strictly rank-upward. The interprocedural generalisation (cycles
+    through call chains) is rule 11, ``lock-order-interprocedural``.
+
+    Escape hatch: none for unranked locks; rank-order exceptions need
+    a table change, not an allowlist entry.
+
+    Fixture: tests/xlint_fixtures/bad/.../utils/bad_locks.py."""
+
     name = "lock-rank"
     describe = ("make_lock declarations match the rank table; nested "
                 "lock scopes acquire in strictly increasing rank")
@@ -528,6 +562,16 @@ _FLAGS_DOC = "docs/FLAGS.md"
 
 
 class FlagRegistryRule:
+    """Contract: every XLLM_* environment read in the package appears
+    in docs/FLAGS.md, and (on whole-package runs) every documented
+    flag is still read somewhere — the flag surface cannot silently
+    drift from its documentation in either direction.
+
+    Escape hatch: none — undocumented flags get documented, dead
+    documentation gets deleted.
+
+    Fixture: tests/xlint_fixtures/bad/.../flags.py."""
+
     name = "flag-registry"
     describe = ("every XLLM_* env read appears in docs/FLAGS.md (and "
                 "every documented flag is actually read)")
@@ -634,6 +678,17 @@ _STATIC_PARAM_NAMES = {"cfg", "config", "mesh", "axis_name",
 
 
 class TracedHostSyncRule:
+    """Contract: code inside a jit-traced function (decorated, or
+    named ``_traced_*``/``*_kernel``) never calls host-sync primitives
+    — .item(), float()/int() on arrays, np.asarray, device_get. Under
+    trace these either fail or silently insert a device→host sync per
+    step.
+
+    Escape hatch: the allowlist, for debug-only branches proven dead
+    under trace (justify with the guard).
+
+    Fixture: tests/xlint_fixtures/bad/.../models/bad_sync.py."""
+
     name = "traced-host-sync"
     describe = (".item()/np.asarray/device_get/host casts inside "
                 "jit- or scan-traced bodies in models/, ops/, engine")
@@ -841,6 +896,18 @@ _READBACK_HELPER = "_read_host"
 
 
 class HotLoopBlockingReadbackRule:
+    """Contract: Engine methods on the decode hot loop
+    (runtime/engine.py) perform blocking device→host readbacks
+    (np.asarray / np.array / device_get / .item / float-casts) only
+    inside the dedicated ``_read_host`` chokepoint, where the
+    double-buffered overlap hides the sync — a stray readback
+    serialises the pipeline.
+
+    Escape hatch: route through ``_read_host``; the allowlist is for
+    cold-path methods misclassified as hot (justify the call rate).
+
+    Fixture: tests/xlint_fixtures/bad/.../runtime/engine.py."""
+
     name = "hot-loop-blocking-readback"
     describe = ("blocking device→host readbacks (np.asarray / np.array "
                 "/ jax.device_get) inside Engine methods must go "
@@ -1014,6 +1081,16 @@ _EXPO_RE = re.compile(
 
 
 class MetricsRegistryRule:
+    """Contract: Prometheus exposition is produced only by the
+    obs/metrics.py registry — no hand-rolled ``# TYPE``/``# HELP``
+    f-strings elsewhere — and every metric name referenced in tests or
+    docs exists in the registry. Hand-rolled lines drift from the
+    validated exposition format and break scrapers silently.
+
+    Escape hatch: none — new metrics go through the registry.
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_metrics.py."""
+
     name = "metrics-registry"
     describe = ("no hand-rolled Prometheus exposition f-strings "
                 "('name{...} value') outside xllm_service_tpu/obs/ — "
@@ -1100,6 +1177,15 @@ def _load_event_catalog(tree: RepoTree) -> Optional[Set[str]]:
 
 
 class EventCatalogRule:
+    """Contract: every ``events.emit("<type>", ...)`` call site names
+    a type from the obs/events.py catalog constant — free-string event
+    types fragment the stream consumers key on.
+
+    Escape hatch: none — new event types are added to the catalog
+    first.
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_events.py."""
+
     name = "event-catalog"
     describe = ("every events.emit(\"<type>\", ...) call site uses a "
                 "type declared in the obs/events.py EVENT_TYPES catalog "
@@ -1180,6 +1266,15 @@ def _load_failpoint_catalog(tree: RepoTree) -> Optional[Set[str]]:
 
 
 class FailpointCatalogRule:
+    """Contract: every ``failpoints.fire("<name>")`` site names a
+    registered failpoint, and (whole-package runs) every registered
+    failpoint is armed by at least one test — an unfired failpoint is
+    untested recovery code.
+
+    Escape hatch: none — register the failpoint and arm it in a test.
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_failpoints.py."""
+
     name = "failpoint-catalog"
     describe = ("every failpoints.fire(\"<name>\") call site uses a "
                 "name declared in the obs/failpoints.py FAILPOINTS "
@@ -1250,6 +1345,8 @@ from tools.xlint.concurrency import (         # noqa: E402 — rules 11–13
     ThreadRootRaceRule)
 from tools.xlint.lifecycle import (           # noqa: E402 — rules 14–16
     ResourceLeakRule, SwallowTelemetryRule, ThreadRootCrashRule)
+from tools.xlint.tracewalk import (           # noqa: E402 — rules 17–19
+    RecompileHazardRule, ShardedDonationRule, TransferDisciplineRule)
 
 RULES = [
     MosaicCompatRule(),
@@ -1268,4 +1365,7 @@ RULES = [
     ThreadRootCrashRule(),
     ResourceLeakRule(),
     SwallowTelemetryRule(),
+    RecompileHazardRule(),
+    ShardedDonationRule(),
+    TransferDisciplineRule(),
 ]

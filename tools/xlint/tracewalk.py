@@ -1,0 +1,1385 @@
+"""Rules 17–19: jit-boundary contract analysis over the device plane.
+
+Rules 1–16 prove the *service* plane (locks, threads, exception flow).
+This module points the same whole-program machinery at the part that
+actually runs on TPU: every ``jax.jit`` program in the package is
+enumerated — decorator forms (bare ``@jax.jit``, ``@jax.jit(...)``,
+``@functools.partial(jax.jit, ...)``), call forms (``self.x =
+jax.jit(functools.partial(f, ...), ...)``, factory-built callables like
+``jax.jit(_prefill_fn(cfg))``, immediately-invoked ``jax.jit(ring)(...)``)
+— together with its jit contract (``static_argnums``/``static_argnames``,
+``donate_argnums``, layout pins incl. the ``**_pin(...)`` splat spelling
+in runtime/engine.py), and every call site is resolved through the PR-8
+call graph so dataflow can walk from each argument expression back to
+its sources.
+
+Rule 17 ``recompile-hazard`` — every static argument at every call site
+must be provably bounded-cardinality (literal, bucketed shape via a
+``*bucket*`` helper, process-constant config attribute chain, bool /
+comparison), and non-static positionals must not be fed straight from
+Python-varying sources (``len()`` of runtime collections, env/time
+reads, per-call container literals). This catches the class of bug
+behind the post-warmup recompile counters before a chip session.
+
+Rule 18 ``sharded-donation`` — extends the runtime/ donation rule
+through the mesh: a program classified mesh-partitioned (a ``partial``
+binding ``mesh=``, a ``*_sharded`` factory, or call sites feeding
+buffers committed via ``shard_params``/``shard_kv_cache``/
+``jax.device_put``) whose signature carries KV-pool parameters must
+donate them, and an unpinned donation must flow a committed
+(sharding-carrying) buffer at every call site. The ``__graft_entry__``
+``dryrun_multichip`` path is analyzed from disk the way flag reverse
+drift reads docs/FLAGS.md.
+
+Rule 19 ``transfer-discipline`` — generalizes hot-loop-blocking-readback
+from readbacks to uploads: host arrays (``np.*`` builds, list/dict
+literals, comprehensions) flowing RAW into a jit call site reachable
+from the engine loop are findings unless staged through
+``jnp.asarray``/``device_put`` or a device-resident carry, or annotated
+``# xlint: host-arg — <why>`` on the call or argument line.
+
+Every site the enumerator cannot resolve is recorded as a
+:class:`JitHole` with a pinned reason string — the PR-8
+no-silent-holes convention; a hole is a visible gap, never a silent
+pass. The analysis is memoized per RepoTree on top of the shared
+concurrency call graph (tier-1 budgets the full 19-rule run < 30 s).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.xlint import Finding, Module, RepoTree
+from tools.xlint import callgraph as cgm
+from tools.xlint.concurrency import analyze as _conc_analyze
+
+# Kept in sync with tools/xlint/rules.py:_KV_PARAM_NAMES (duplicated —
+# rules.py imports this module at its bottom, so importing back would
+# make the import order matter).
+_KV_PARAM_NAMES = {"kv", "kv_pages", "k_pages", "v_pages", "kv_cache"}
+
+# Terminal callee names that commit a buffer to a mesh sharding (the
+# parallel/sharding.py spec builders + raw device_put).
+_COMMIT_CALLS = {"shard_params", "shard_kv_cache", "device_put"}
+
+_HOST_ARG_RE = re.compile(r"#\s*xlint:\s*host-arg\b")
+
+# The out-of-package harness whose dryrun_multichip path rule 18 must
+# cover (read from disk like docs/FLAGS.md, only on whole-package runs).
+_EXTERN_HARNESS = "__graft_entry__.py"
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers (mirrors of tools/xlint/rules.py, extended with the
+# jnp/os aliases this module additionally needs — same sync note as
+# _KV_PARAM_NAMES above)
+# ---------------------------------------------------------------------------
+
+
+def _aliases(mod_tree: ast.AST) -> Dict[str, Set[str]]:
+    out: Dict[str, Set[str]] = {
+        "jax": set(), "np": set(), "jnp": set(), "functools": set(),
+        "time": set(), "os": set()}
+    for node in ast.walk(mod_tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                if a.name == "jax":
+                    out["jax"].add(bound)
+                elif a.name == "jax.numpy":
+                    out["jnp"].add(a.asname or "jax")
+                elif a.name == "numpy":
+                    out["np"].add(bound)
+                elif a.name == "functools":
+                    out["functools"].add(bound)
+                elif a.name == "time":
+                    out["time"].add(bound)
+                elif a.name == "os":
+                    out["os"].add(bound)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax" and any(a.name == "numpy"
+                                            for a in node.names):
+                for a in node.names:
+                    if a.name == "numpy":
+                        out["jnp"].add(a.asname or "numpy")
+    return out
+
+
+def _is_call_to(node: ast.Call, aliases: Set[str], attr: str) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == attr
+            and isinstance(f.value, ast.Name) and f.value.id in aliases)
+
+
+def _const_int_set(node: Optional[ast.AST]) -> Optional[Set[int]]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.add(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _const_str_set(node: Optional[ast.AST]) -> Optional[Set[str]]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.add(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _positional_params(fndef: ast.AST) -> List[str]:
+    a = fndef.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def _terminal_name(expr: ast.AST) -> Optional[str]:
+    """``f`` / ``a.b.f`` → ``f``; None for anything else."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _is_self_attr(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+def _is_pure_attr_chain(expr: ast.AST) -> bool:
+    """``a.b.c`` with a plain Name root — treated as a process-constant
+    read by repo convention (config objects, mesh shape, ``self._sp``);
+    mutated per-request state never rides bare attribute chains here."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return isinstance(expr, ast.Name)
+
+
+# ---------------------------------------------------------------------------
+# Data model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class JitHole:
+    """One site the enumerator could not resolve, with a PINNED reason
+    (the PR-8 convention: coverage gaps are visible strings, never
+    silent passes)."""
+
+    path: str
+    line: int
+    desc: str
+    reason: str
+
+
+@dataclasses.dataclass
+class JitProgram:
+    """One enumerated jit program and its statically-read contract."""
+
+    path: str                      # module defining the jit
+    line: int
+    label: str                     # attr/name/qualname the program binds to
+    binding: Tuple                 # ("attr", X) | ("name", path, X) |
+    #                                ("fid", fid) | ("inline",)
+    params: Optional[List[str]]    # post-partial positional params
+    static_argnums: Set[int] = dataclasses.field(default_factory=set)
+    static_argnames: Set[str] = dataclasses.field(default_factory=set)
+    donate_argnums: Set[int] = dataclasses.field(default_factory=set)
+    donate_unresolved: bool = False
+    static_unresolved: bool = False
+    pinned: bool = False
+    pin_via: str = ""              # how the pin was proven (for reports)
+    mesh_bound: bool = False       # partial binds mesh= / *_sharded factory
+    kw_bound: Set[str] = dataclasses.field(default_factory=set)
+    extern: bool = False           # defined in the out-of-package harness
+
+    def kv_positions(self) -> List[int]:
+        if not self.params:
+            return []
+        return [i for i, p in enumerate(self.params)
+                if p in _KV_PARAM_NAMES]
+
+
+@dataclasses.dataclass
+class JitCallSite:
+    """One resolved invocation of a JitProgram."""
+
+    program: JitProgram
+    path: str
+    line: int
+    call: ast.Call
+    fid: str                       # enclosing cg function id; "" = extern
+    qualname: str
+    starred: bool                  # positional mapping stops at a *args
+
+
+# ---------------------------------------------------------------------------
+# Program enumeration (per module)
+# ---------------------------------------------------------------------------
+
+
+def _qualname_chain(node: ast.AST, parent: Dict[ast.AST, ast.AST]) -> str:
+    parts: List[str] = []
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = parent.get(cur)
+    return ".".join(reversed(parts)) or "<module>"
+
+
+class _Enumerator:
+    """Walks one module, producing programs + holes + inline call
+    sites. ``fn_index`` is the repo-wide {name: [FunctionDef]} map used
+    to resolve wrapped callables imported from other modules."""
+
+    def __init__(self, mod: Module, fn_index: Dict[str, List[ast.AST]],
+                 extern: bool = False) -> None:
+        self.mod = mod
+        self.fn_index = fn_index
+        self.extern = extern
+        self.al = _aliases(mod.tree)
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        for p in ast.walk(mod.tree):
+            for c in ast.iter_child_nodes(p):
+                self.parent[c] = p
+        self.local_fns = {n.name: n for n in ast.walk(mod.tree)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))}
+        self.programs: List[JitProgram] = []
+        self.holes: List[JitHole] = []
+        self.inline_sites: List[Tuple[JitProgram, ast.Call]] = []
+
+    def hole(self, line: int, desc: str, reason: str) -> None:
+        self.holes.append(JitHole(self.mod.path, line, desc, reason))
+
+    def run(self) -> "_Enumerator":
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Call) and \
+                    _is_call_to(node, self.al["jax"], "jit"):
+                par = self.parent.get(node)
+                if isinstance(par, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and \
+                        node in par.decorator_list:
+                    continue       # handled in the decorator scan
+                self._call_form(node, par)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._decorator_forms(node)
+        return self
+
+    # -- decorator spellings --------------------------------------------
+    def _decorator_forms(self, fndef: ast.AST) -> None:
+        for dec in fndef.decorator_list:
+            keywords = None
+            if isinstance(dec, ast.Attribute) and dec.attr == "jit" and \
+                    isinstance(dec.value, ast.Name) and \
+                    dec.value.id in self.al["jax"]:
+                keywords = []                        # bare @jax.jit
+            elif isinstance(dec, ast.Call) and \
+                    _is_call_to(dec, self.al["jax"], "jit"):
+                keywords = dec.keywords              # @jax.jit(...)
+            elif isinstance(dec, ast.Call) and \
+                    _is_call_to(dec, self.al["functools"], "partial") and \
+                    dec.args and \
+                    isinstance(dec.args[0], ast.Attribute) and \
+                    dec.args[0].attr == "jit" and \
+                    isinstance(dec.args[0].value, ast.Name) and \
+                    dec.args[0].value.id in self.al["jax"]:
+                keywords = dec.keywords              # @partial(jax.jit, …)
+            if keywords is None:
+                continue
+            qual = _qualname_chain(fndef, self.parent)
+            prog = JitProgram(
+                path=self.mod.path, line=fndef.lineno, label=fndef.name,
+                binding=("fid", f"{self.mod.path}::{qual}"),
+                params=_positional_params(fndef), extern=self.extern)
+            self._read_contract(prog, keywords, fndef)
+            self.programs.append(prog)
+
+    # -- call spellings -------------------------------------------------
+    def _call_form(self, node: ast.Call, par: Optional[ast.AST]) -> None:
+        wrapped = node.args[0] if node.args else None
+        if wrapped is None:
+            self.hole(node.lineno, "jax.jit()",
+                      "jit-without-target: no positional callable to "
+                      "resolve a signature from")
+            return
+        prog = JitProgram(path=self.mod.path, line=node.lineno,
+                          label="", binding=("inline",), params=None,
+                          extern=self.extern)
+        self._resolve_wrapped(prog, wrapped, node)
+        enclosing = self._enclosing_fn(node)
+        self._read_contract(prog, node.keywords, enclosing)
+        # Binding classification via the parent node.
+        tgt = par
+        if isinstance(tgt, ast.IfExp):
+            tgt = self.parent.get(tgt)
+        if isinstance(tgt, ast.Assign) and len(tgt.targets) == 1:
+            t = tgt.targets[0]
+            attr = _is_self_attr(t)
+            if attr is not None:
+                prog.binding, prog.label = ("attr", attr), attr
+            elif isinstance(t, ast.Name):
+                prog.binding = ("name", self.mod.path, t.id)
+                prog.label = t.id
+            else:
+                self.hole(node.lineno, "jax.jit(...)",
+                          "unbound-jit-program: assignment target is "
+                          "neither a name nor a self attribute")
+                return
+        elif isinstance(par, ast.Call) and par.func is node:
+            prog.label = prog.label or f"<jit@L{node.lineno}>"
+            self.inline_sites.append((prog, par))
+        else:
+            self.hole(node.lineno, "jax.jit(...)",
+                      "unbound-jit-program: result neither bound to a "
+                      "name/attr nor invoked inline — call sites cannot "
+                      "be matched")
+            return
+        self.programs.append(prog)
+
+    def _enclosing_fn(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parent.get(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cur = self.parent.get(cur)
+        return cur
+
+    # -- wrapped-callable resolution ------------------------------------
+    def _resolve_wrapped(self, prog: JitProgram, wrapped: ast.AST,
+                         site: ast.Call) -> None:
+        n_bound = 0
+        if isinstance(wrapped, ast.Call):
+            f = wrapped.func
+            is_partial = (
+                (isinstance(f, ast.Attribute) and f.attr == "partial"
+                 and isinstance(f.value, ast.Name)
+                 and f.value.id in self.al["functools"])
+                or (isinstance(f, ast.Name) and f.id == "partial"))
+            if is_partial and wrapped.args:
+                n_bound = len(wrapped.args) - 1
+                prog.kw_bound = {k.arg for k in wrapped.keywords
+                                 if k.arg is not None}
+                if "mesh" in prog.kw_bound:
+                    prog.mesh_bound = True
+                wrapped = wrapped.args[0]
+            elif not is_partial:
+                self._resolve_factory(prog, wrapped, site)
+                return
+            else:
+                self.hole(site.lineno, "jax.jit(partial())",
+                          "partial-without-target: nothing to unwrap")
+                return
+        self._resolve_terminal(prog, wrapped, site, n_bound)
+
+    def _resolve_terminal(self, prog: JitProgram, wrapped: ast.AST,
+                          site: ast.Call, n_bound: int) -> None:
+        if isinstance(wrapped, ast.Lambda):
+            prog.params = [a.arg for a in (*wrapped.args.posonlyargs,
+                                           *wrapped.args.args)][n_bound:]
+            prog.label = prog.label or "<lambda>"
+            return
+        if isinstance(wrapped, ast.Name):
+            fndef = self.local_fns.get(wrapped.id)
+            if fndef is None:
+                cands = self.fn_index.get(wrapped.id, [])
+                fndef = cands[0] if len(cands) == 1 else None
+            if fndef is not None:
+                if wrapped.id.endswith("_sharded"):
+                    prog.mesh_bound = True
+                prog.params = _positional_params(fndef)[n_bound:]
+                prog.label = prog.label or wrapped.id
+                return
+            # A name bound by a local factory call, e.g.
+            # ring = ring_attention_sharded(mesh); jax.jit(ring)(...)
+            factory = self._local_factory_value(wrapped.id)
+            if factory is not None:
+                self._resolve_factory(prog, factory, site,
+                                      shift=n_bound)
+                prog.label = prog.label or wrapped.id
+                return
+            self.hole(site.lineno, f"jax.jit({wrapped.id})",
+                      f"unresolved-callable: {wrapped.id!r} has no "
+                      f"unique def in the linted tree")
+            prog.label = prog.label or wrapped.id
+            return
+        self.hole(site.lineno, "jax.jit(<expr>)",
+                  "unresolved-callable: wrapped expression is neither a "
+                  "name, lambda, partial, nor factory call")
+
+    def _local_factory_value(self, name: str) -> Optional[ast.Call]:
+        found: List[ast.Call] = []
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == name \
+                    and isinstance(node.value, ast.Call):
+                found.append(node.value)
+        return found[0] if len(found) == 1 else None
+
+    def _resolve_factory(self, prog: JitProgram, call: ast.Call,
+                         site: ast.Call, shift: int = 0) -> None:
+        """``jax.jit(make_fn(cfg))`` — resolve make_fn, find the nested
+        def it returns, use its params. ``*_sharded`` factories mark the
+        program mesh-partitioned."""
+        fname = _terminal_name(call.func)
+        if fname is None:
+            self.hole(site.lineno, "jax.jit(<factory>())",
+                      "factory-unresolved: factory callee is not a "
+                      "dotted name")
+            return
+        if fname.endswith("_sharded"):
+            prog.mesh_bound = True
+        fndef = self.local_fns.get(fname)
+        if fndef is None:
+            cands = self.fn_index.get(fname, [])
+            fndef = cands[0] if len(cands) == 1 else None
+        if fndef is None:
+            self.hole(site.lineno, f"jax.jit({fname}())",
+                      f"factory-unresolved: no unique def for factory "
+                      f"{fname!r} in the linted tree")
+            prog.label = prog.label or fname
+            return
+        inner = self._returned_nested_def(fndef)
+        if inner is None:
+            self.hole(site.lineno, f"jax.jit({fname}())",
+                      f"factory-unresolved: {fname!r} does not return "
+                      f"a nested def the walker can see")
+            prog.label = prog.label or fname
+            return
+        prog.params = _positional_params(inner)[shift:]
+        prog.label = prog.label or inner.name
+
+    @staticmethod
+    def _returned_nested_def(fndef: ast.AST) -> Optional[ast.AST]:
+        nested = {n.name: n for n in ast.walk(fndef)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and n is not fndef}
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Return) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in nested:
+                return nested[node.value.id]
+        return None
+
+    # -- jit keyword contract -------------------------------------------
+    def _read_contract(self, prog: JitProgram, keywords,
+                       enclosing: Optional[ast.AST]) -> None:
+        kw = {k.arg: k.value for k in keywords if k.arg is not None}
+        splats = [k.value for k in keywords if k.arg is None]
+        nums = _const_int_set(kw.get("static_argnums"))
+        if "static_argnums" in kw and nums is None:
+            prog.static_unresolved = True
+            self.hole(prog.line, f"jit {prog.label or '<anon>'}",
+                      "static-nonliteral: static_argnums is not a "
+                      "literal int/tuple — bounded-cardinality cannot "
+                      "be checked")
+        prog.static_argnums = nums or set()
+        names = _const_str_set(kw.get("static_argnames"))
+        if "static_argnames" in kw and names is None:
+            prog.static_unresolved = True
+            self.hole(prog.line, f"jit {prog.label or '<anon>'}",
+                      "static-nonliteral: static_argnames is not a "
+                      "literal str/tuple")
+        prog.static_argnames = names or set()
+        donated = _const_int_set(kw.get("donate_argnums"))
+        if "donate_argnums" in kw and donated is None:
+            prog.donate_unresolved = True
+        prog.donate_argnums = donated or set()
+        if "in_shardings" in kw or "out_shardings" in kw:
+            prog.pinned = True
+            prog.pin_via = "explicit in_/out_shardings"
+        for sp in splats:
+            via = self._splat_pin(sp, enclosing)
+            if via:
+                prog.pinned, prog.pin_via = True, via
+            else:
+                self.hole(prog.line, f"jit {prog.label or '<anon>'}",
+                          "splat-unresolved: **kwargs splat is not a "
+                          "recognizable layout-pin builder")
+
+    def _splat_pin(self, sp: ast.AST,
+                   enclosing: Optional[ast.AST]) -> str:
+        """``**_pin(...)`` or ``**multi_pin`` where multi_pin was built
+        by a *pin* call or a dict literal carrying sharding keys."""
+        if isinstance(sp, ast.Call):
+            n = _terminal_name(sp.func) or ""
+            if "pin" in n:
+                return f"**{n}(...) splat"
+        if isinstance(sp, ast.Name) and enclosing is not None:
+            for node in ast.walk(enclosing):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        node.targets[0].id == sp.id:
+                    v = node.value
+                    if isinstance(v, ast.Call) and \
+                            "pin" in (_terminal_name(v.func) or ""):
+                        return f"**{sp.id} ← pin-builder call"
+                    if isinstance(v, ast.Dict):
+                        keys = {k.value for k in v.keys
+                                if isinstance(k, ast.Constant)}
+                        if keys & {"in_shardings", "out_shardings"}:
+                            return f"**{sp.id} ← sharding dict literal"
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# Whole-tree analysis (memoized like lifecycle.py)
+# ---------------------------------------------------------------------------
+
+
+class TracewalkAnalysis:
+    """Memoized per RepoTree on the shared concurrency call graph."""
+
+    def __init__(self, tree: RepoTree) -> None:
+        self.tree = tree
+        self.conc = _conc_analyze(tree)
+        self.cg = self.conc.cg
+        self.programs: List[JitProgram] = []
+        self.holes: List[JitHole] = []
+        self.sites: List[JitCallSite] = []
+        self._mods: Dict[str, Module] = {m.path: m for m in tree.modules}
+
+        fn_index: Dict[str, List[ast.AST]] = {}
+        for mod in tree.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    fn_index.setdefault(node.name, []).append(node)
+        self.fn_index = fn_index
+
+        attr_bindings: Dict[str, List[JitProgram]] = {}
+        name_bindings: Dict[Tuple[str, str], JitProgram] = {}
+        fid_bindings: Dict[str, JitProgram] = {}
+        inline: List[Tuple[Module, JitProgram, ast.Call]] = []
+        for mod in tree.modules:
+            en = _Enumerator(mod, fn_index).run()
+            self.programs.extend(en.programs)
+            self.holes.extend(en.holes)
+            for prog, call in en.inline_sites:
+                inline.append((mod, prog, call))
+        # Extern harness: parsed from disk, whole-package runs only.
+        self.extern_mod = self._load_extern()
+        if self.extern_mod is not None:
+            en = _Enumerator(self.extern_mod, fn_index,
+                             extern=True).run()
+            for p in en.programs:
+                p.extern = True
+            self.programs.extend(en.programs)
+            self.holes.extend(en.holes)
+            for prog, call in en.inline_sites:
+                prog.extern = True
+                inline.append((self.extern_mod, prog, call))
+            self._mods[self.extern_mod.path] = self.extern_mod
+
+        for prog in self.programs:
+            kind = prog.binding[0]
+            if kind == "attr":
+                attr_bindings.setdefault(prog.binding[1],
+                                         []).append(prog)
+            elif kind == "name":
+                name_bindings[(prog.binding[1], prog.binding[2])] = prog
+            elif kind == "fid":
+                fid_bindings[prog.binding[1]] = prog
+        self.attr_bindings = attr_bindings
+        self.name_bindings = name_bindings
+        self.fid_bindings = fid_bindings
+        # fndef-name → program for decorated jits (call sites name the
+        # function, not the fid).
+        self.decorated_by_name: Dict[str, List[JitProgram]] = {}
+        for fid, prog in fid_bindings.items():
+            self.decorated_by_name.setdefault(
+                fid.rsplit(".", 1)[-1].rsplit("::", 1)[-1],
+                []).append(prog)
+
+        for mod, prog, call in inline:
+            self._add_site(prog, mod.path, call,
+                           fid="", qualname="<module>")
+        self._collect_sites()
+        if self.extern_mod is not None:
+            self._collect_extern_sites(self.extern_mod)
+        self.attr_kinds = self._class_attr_kinds()
+        self.step_reachable = self._step_reachable()
+
+    # -- extern harness --------------------------------------------------
+    def _load_extern(self) -> Optional[Module]:
+        if not self.tree.covers_package():
+            return None
+        if self.tree.get(_EXTERN_HARNESS) is not None:
+            return None           # already in scope as a real module
+        src = self.tree.read_text(_EXTERN_HARNESS)
+        if src is None:
+            return None
+        try:
+            t = ast.parse(src, filename=_EXTERN_HARNESS)
+        except (SyntaxError, ValueError):
+            self.holes.append(JitHole(
+                _EXTERN_HARNESS, 0, _EXTERN_HARNESS,
+                "extern-unparseable: harness exists but does not parse"))
+            return None
+        return Module(path=_EXTERN_HARNESS, abspath=_EXTERN_HARNESS,
+                      source=src, lines=src.splitlines(), tree=t)
+
+    # -- call-site collection (in-package, rides the call graph) ---------
+    def _add_site(self, prog: JitProgram, path: str, call: ast.Call,
+                  fid: str, qualname: str) -> None:
+        starred = any(isinstance(a, ast.Starred) for a in call.args)
+        self.sites.append(JitCallSite(
+            program=prog, path=path, line=call.lineno, call=call,
+            fid=fid, qualname=qualname, starred=starred))
+
+    def _collect_sites(self) -> None:
+        for fid, fi in self.cg.functions.items():
+            for rc in fi.raw_calls:
+                f = rc.node.func
+                attr = _is_self_attr(f)
+                if attr is not None:
+                    self._site_for_attr(attr, fi, rc.node)
+                    continue
+                if isinstance(f, ast.Name):
+                    prog = self.name_bindings.get((fi.path, f.id))
+                    if prog is not None:
+                        self._add_site(prog, fi.path, rc.node, fid,
+                                       fi.qualname)
+                        continue
+                    # a local `jitted = self._x if c else self._y`
+                    for p in self._local_jit_aliases(fi, f.id):
+                        self._add_site(p, fi.path, rc.node, fid,
+                                       fi.qualname)
+                    cands = self.decorated_by_name.get(f.id, [])
+                    if len(cands) == 1:
+                        self._add_site(cands[0], fi.path, rc.node,
+                                       fid, fi.qualname)
+                    elif len(cands) > 1:
+                        self.holes.append(JitHole(
+                            fi.path, rc.node.lineno, f.id,
+                            f"ambiguous-program: {len(cands)} decorated "
+                            f"jit programs named {f.id!r}"))
+
+    def _site_for_attr(self, attr: str, fi, call: ast.Call) -> None:
+        progs = self.attr_bindings.get(attr, [])
+        if len(progs) == 1:
+            self._add_site(progs[0], fi.path, call, fi.fid,
+                           fi.qualname)
+        elif len(progs) > 1:
+            self.holes.append(JitHole(
+                fi.path, call.lineno, f"self.{attr}(...)",
+                f"ambiguous-attr-binding: {len(progs)} jit programs "
+                f"bind self.{attr} across the tree"))
+
+    def _local_jit_aliases(self, fi, name: str) -> List[JitProgram]:
+        """``jitted = self._a if flag else self._b`` → both programs."""
+        out: List[JitProgram] = []
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == name:
+                v = node.value
+                exprs = [v.body, v.orelse] if isinstance(v, ast.IfExp) \
+                    else [v]
+                for e in exprs:
+                    a = _is_self_attr(e)
+                    if a is not None:
+                        out.extend(p for p in
+                                   self.attr_bindings.get(a, []))
+        return out
+
+    def _collect_extern_sites(self, mod: Module) -> None:
+        """Local (per-function) matching in the harness — its functions
+        are outside the call graph."""
+        local_names: Dict[str, JitProgram] = {
+            b[2]: p for b, p in
+            ((pr.binding, pr) for pr in self.programs
+             if pr.extern and pr.binding[0] == "name")
+            }
+        parent: Dict[ast.AST, ast.AST] = {}
+        for p in ast.walk(mod.tree):
+            for c in ast.iter_child_nodes(p):
+                parent[c] = p
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in local_names:
+                qual = _qualname_chain(node, parent)
+                self._add_site(local_names[node.func.id], mod.path,
+                               node, fid="", qualname=qual)
+
+    # -- class attribute kinds (device-committed / host-mirror) ----------
+    def _class_attr_kinds(self) -> Dict[Tuple[str, str],
+                                        Dict[str, Set[str]]]:
+        """(path, class) → attr → set of assignment kinds seen across
+        the class's methods: "commit" (shard_*/device_put), "dev"
+        (jnp build), "np" (numpy build), "other"."""
+        out: Dict[Tuple[str, str], Dict[str, Set[str]]] = {}
+        mod_aliases: Dict[str, Dict[str, Set[str]]] = {}
+        for fi in self.cg.functions.values():
+            if fi.cls is None:
+                continue
+            mod = self._mods.get(fi.path)
+            if mod is None:
+                continue
+            al = mod_aliases.get(fi.path)
+            if al is None:
+                al = mod_aliases[fi.path] = _aliases(mod.tree)
+            attrs = out.setdefault((fi.path, fi.cls), {})
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    a = _is_self_attr(t)
+                    if a is None:
+                        continue
+                    attrs.setdefault(a, set()).add(
+                        self._value_kind(node.value, al))
+        return out
+
+    @staticmethod
+    def _value_kind(v: ast.AST, al: Dict[str, Set[str]]) -> str:
+        if isinstance(v, ast.Call):
+            n = _terminal_name(v.func) or ""
+            if n in _COMMIT_CALLS:
+                return "commit"
+            f = v.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name):
+                if f.value.id in al["np"]:
+                    return "np"
+                if f.value.id in al["jnp"] or f.value.id in al["jax"]:
+                    return "dev"
+        if isinstance(v, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+            return "np"            # host container, same hazard class
+        return "other"
+
+    # -- engine-loop reachability (rule 19 scope) ------------------------
+    def _step_reachable(self) -> Set[str]:
+        seeds = [fid for fid, fi in self.cg.functions.items()
+                 if fi.name == "_engine_loop"
+                 or (fi.cls == "Engine"
+                     and (fi.name.startswith("step")
+                          or fi.name.startswith("_run_")))]
+        return cgm.reachable_from(self.cg, seeds)
+
+    # -- commitment evidence (rule 18) -----------------------------------
+    def arg_committed(self, site: JitCallSite, arg: ast.AST) -> bool:
+        """True when the argument expression carries a mesh-committed
+        buffer: a local name (or self attribute) with an assignment from
+        shard_params/shard_kv_cache/device_put anywhere in scope."""
+        while isinstance(arg, ast.Subscript):
+            arg = arg.value
+        a = _is_self_attr(arg)
+        if a is not None:
+            fi = self.cg.functions.get(site.fid)
+            if fi is None or fi.cls is None:
+                return False
+            kinds = self.attr_kinds.get((site.path, fi.cls), {})
+            return "commit" in kinds.get(a, set())
+        if isinstance(arg, ast.Name):
+            scope = None
+            if site.fid:
+                fi = self.cg.functions.get(site.fid)
+                scope = fi.node if fi is not None else None
+            else:
+                scope = self._extern_scope(site)
+            if scope is None:
+                return False
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign):
+                    names = set()
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+                        elif isinstance(t, ast.Tuple):
+                            names.update(e.id for e in t.elts
+                                         if isinstance(e, ast.Name))
+                    if arg.id in names and \
+                            isinstance(node.value, ast.Call) and \
+                            (_terminal_name(node.value.func) or "") \
+                            in _COMMIT_CALLS:
+                        return True
+        return False
+
+    def _extern_scope(self, site: JitCallSite) -> Optional[ast.AST]:
+        mod = self._mods.get(site.path)
+        if mod is None:
+            return None
+        best: Optional[ast.AST] = None
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.lineno <= site.line and \
+                    (best is None or node.lineno > best.lineno):
+                end = getattr(node, "end_lineno", None)
+                if end is None or site.line <= end:
+                    best = node
+        return best
+
+    def module(self, path: str) -> Optional[Module]:
+        return self._mods.get(path)
+
+
+_CACHE_ATTR = "_xlint_tracewalk_analysis"
+
+
+def tracewalk_analyze(tree: RepoTree) -> TracewalkAnalysis:
+    a = getattr(tree, _CACHE_ATTR, None)
+    if a is None:
+        a = TracewalkAnalysis(tree)
+        setattr(tree, _CACHE_ATTR, a)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Cardinality classifier (rule 17)
+# ---------------------------------------------------------------------------
+
+_BOUNDED, _VARYING, _OPAQUE = "bounded", "varying", "opaque"
+_CFG_SEGMENTS = {"cfg", "config", "ecfg", "model_cfg", "mcfg"}
+_COMBINE_CALLS = {"max", "min", "int", "bool", "abs", "round"}
+
+
+def _attr_segments(expr: ast.AST) -> List[str]:
+    segs: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        segs.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        segs.append(expr.id)
+    return segs
+
+
+def _varying_source(expr: ast.AST,
+                    al: Dict[str, Set[str]]) -> Optional[str]:
+    """A reason string when ``expr`` is a *provably* Python-varying
+    source; None otherwise (under-approximate on purpose)."""
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Name) and f.id == "len" and expr.args:
+            segs = _attr_segments(expr.args[0])
+            if segs and not (set(s.strip("_") for s in segs)
+                             & _CFG_SEGMENTS):
+                return "len() of a runtime collection"
+            return None
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name):
+            if f.value.id in al["time"]:
+                return f"time.{f.attr}() read"
+            if f.value.id in al["os"] and f.attr in (
+                    "getenv", "environ"):
+                return f"os.{f.attr} read on the hot path"
+            if isinstance(f.value, ast.Name) and \
+                    f.value.id == "environ":
+                return "environ read on the hot path"
+        # os.environ.get(...)
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Attribute) and \
+                f.value.attr == "environ" and \
+                isinstance(f.value.value, ast.Name) and \
+                f.value.value.id in al["os"]:
+            return "os.environ read on the hot path"
+    if isinstance(expr, ast.Subscript):
+        v = expr.value
+        if isinstance(v, ast.Attribute) and v.attr == "environ" and \
+                isinstance(v.value, ast.Name) and v.value.id in al["os"]:
+            return "os.environ read on the hot path"
+    if isinstance(expr, (ast.ListComp, ast.SetComp, ast.DictComp,
+                         ast.GeneratorExp)):
+        return "comprehension built per call"
+    if isinstance(expr, (ast.List, ast.Set, ast.Dict)) and \
+            (getattr(expr, "elts", None) or getattr(expr, "keys", None)):
+        return "per-call container literal"
+    return None
+
+
+def _combine(verdicts: Sequence[Tuple[str, str]]) -> Tuple[str, str]:
+    for v in verdicts:
+        if v[0] == _VARYING:
+            return v
+    for v in verdicts:
+        if v[0] == _OPAQUE:
+            return v
+    return (_BOUNDED, "all inputs bounded")
+
+
+def _classify_static(expr: ast.AST, scope: Optional[ast.AST],
+                     al: Dict[str, Set[str]],
+                     seen: Optional[Set[str]] = None
+                     ) -> Tuple[str, str]:
+    """→ ("bounded"|"varying"|"opaque", reason). Bounded means the
+    value set is provably small across the process lifetime: literals,
+    bools/comparisons, process-constant attribute chains (config, mesh
+    shape), and anything passed through a ``*bucket*`` helper."""
+    seen = seen or set()
+    if isinstance(expr, ast.Constant):
+        return (_BOUNDED, "literal")
+    if isinstance(expr, (ast.BoolOp, ast.Compare)):
+        return (_BOUNDED, "boolean — cardinality 2")
+    vs = _varying_source(expr, al)
+    if vs is not None:
+        return (_VARYING, vs)
+    if isinstance(expr, ast.Attribute):
+        if _is_pure_attr_chain(expr):
+            return (_BOUNDED, "process-constant attribute chain")
+        return (_OPAQUE, "attribute on a computed object")
+    if isinstance(expr, ast.Call):
+        n = _terminal_name(expr.func) or ""
+        if "bucket" in n:
+            return (_BOUNDED, f"bucketed via {n}()")
+        if n in _COMBINE_CALLS and expr.args:
+            v, r = _combine([_classify_static(a, scope, al, seen)
+                             for a in expr.args])
+            if v == _BOUNDED:
+                return (v, f"{n}() of bounded inputs")
+            return (v, r)
+        return (_OPAQUE, f"call to {n or '<expr>'}() not statically "
+                         f"bounded")
+    if isinstance(expr, ast.UnaryOp):
+        return _classify_static(expr.operand, scope, al, seen)
+    if isinstance(expr, ast.BinOp):
+        return _combine([_classify_static(expr.left, scope, al, seen),
+                         _classify_static(expr.right, scope, al, seen)])
+    if isinstance(expr, ast.IfExp):
+        return _combine([_classify_static(expr.body, scope, al, seen),
+                         _classify_static(expr.orelse, scope, al,
+                                          seen)])
+    if isinstance(expr, ast.Subscript):
+        return _classify_static(expr.value, scope, al, seen)
+    if isinstance(expr, ast.Tuple):
+        if not expr.elts:
+            return (_BOUNDED, "empty tuple")
+        return _combine([_classify_static(e, scope, al, seen)
+                         for e in expr.elts])
+    if isinstance(expr, ast.Name):
+        if expr.id in seen:
+            return (_OPAQUE, f"cyclic binding of {expr.id!r}")
+        if scope is None:
+            return (_OPAQUE, f"{expr.id!r} has no visible binding")
+        seen = seen | {expr.id}
+        verdicts: List[Tuple[str, str]] = []
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == expr.id:
+                        verdicts.append(_classify_static(
+                            node.value, scope, al, seen))
+                    elif isinstance(t, ast.Tuple) and any(
+                            isinstance(e, ast.Name) and e.id == expr.id
+                            for e in t.elts):
+                        verdicts.append((_OPAQUE,
+                                         f"{expr.id!r} bound by tuple "
+                                         f"unpacking"))
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name) and \
+                    node.target.id == expr.id and node.value is not None:
+                verdicts.append(_classify_static(node.value, scope, al,
+                                                 seen))
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name) and \
+                    node.target.id == expr.id:
+                verdicts.append((_OPAQUE,
+                                 f"{expr.id!r} mutated by augmented "
+                                 f"assignment"))
+            elif isinstance(node, ast.For):
+                tnames = []
+                if isinstance(node.target, ast.Name):
+                    tnames = [node.target.id]
+                elif isinstance(node.target, ast.Tuple):
+                    tnames = [e.id for e in node.target.elts
+                              if isinstance(e, ast.Name)]
+                if expr.id in tnames:
+                    verdicts.append((_OPAQUE,
+                                     f"{expr.id!r} is a loop target — "
+                                     f"iterable cardinality unknown"))
+        if not verdicts:
+            return (_OPAQUE, f"{expr.id!r} has no local binding "
+                             f"(parameter or free variable)")
+        v, r = _combine(verdicts)
+        if v == _BOUNDED:
+            return (v, f"{expr.id!r} only bound to bounded values")
+        return (v, f"{expr.id!r}: {r}")
+    return (_OPAQUE, "expression form not classified")
+
+
+# ---------------------------------------------------------------------------
+# Rule 17: recompile-hazard
+# ---------------------------------------------------------------------------
+
+
+class RecompileHazardRule:
+    """Contract: at every call site of every jit program, (a) each
+    ``static_argnums``/``static_argnames`` argument must be provably
+    bounded-cardinality — a literal, a bool/comparison, a
+    process-constant config attribute chain (``self._sp``,
+    ``cfg.prefill_buckets[-1]``), or a value passed through a
+    ``*bucket*`` helper (``self._bucket(max(windows))``) — because every
+    distinct static value is a distinct compiled executable; and (b)
+    non-static positional arguments must not be fed straight from
+    Python-varying sources: ``len()`` of a runtime collection,
+    ``os.environ``/``os.getenv``/``time.*`` reads on the hot path, or
+    per-call list/set/dict literals and comprehensions (each changes
+    the traced pytree structure and recompiles).
+
+    Escape hatches: none inline — route a vetted exception through
+    ``tools/xlint/allowlists/recompile-hazard.txt`` with a
+    justification. Sites the dataflow cannot classify are recorded as
+    holes (``--explain`` shows them via the analysis), not findings.
+
+    Bad-fixture example (fires)::
+
+        B = len(self.pending)                  # runtime collection
+        self._jit_step(x, B)                   # B is static_argnums=(1,)
+
+    Clean example (passes)::
+
+        T = self._bucket(max(windows))         # bucketed shape
+        self._jit_step(x, T)
+    """
+
+    name = "recompile-hazard"
+    describe = ("jit static args must be provably bounded-cardinality "
+                "(literal/bool/config-chain/bucketed) and non-static "
+                "positionals must not come straight from "
+                "Python-varying sources (len()/env/time/per-call "
+                "containers) — every distinct static value is a "
+                "compile")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        tw = tracewalk_analyze(tree)
+        out: Dict[str, Finding] = {}
+        for site in tw.sites:
+            prog = site.program
+            mod = tw.module(site.path)
+            if mod is None:
+                continue
+            al = _aliases(mod.tree)
+            scope = None
+            if site.fid:
+                fi = tw.cg.functions.get(site.fid)
+                scope = fi.node if fi is not None else None
+            else:
+                scope = tw._extern_scope(site)
+            static_pos = set(prog.static_argnums)
+            if prog.params:
+                static_pos |= {i for i, p in enumerate(prog.params)
+                               if p in prog.static_argnames}
+            args = site.call.args
+            for i, a in enumerate(args):
+                if isinstance(a, ast.Starred):
+                    break          # positional mapping ends here
+                argdesc = (prog.params[i]
+                           if prog.params and i < len(prog.params)
+                           else f"arg{i}")
+                if i in static_pos:
+                    v, r = _classify_static(a, scope, al)
+                    if v == _VARYING:
+                        key = (f"{site.path}::{site.qualname}::"
+                               f"{prog.label}::static-{argdesc}")
+                        out.setdefault(key, Finding(
+                            rule=self.name, path=site.path,
+                            line=site.line, key=key,
+                            message=f"static arg {argdesc!r} of jit "
+                                    f"program {prog.label} is "
+                                    f"Python-varying ({r}) — every "
+                                    f"distinct value compiles a new "
+                                    f"executable"))
+                else:
+                    vs = _varying_source(a, al)
+                    if vs is not None:
+                        key = (f"{site.path}::{site.qualname}::"
+                               f"{prog.label}::traced-{argdesc}")
+                        out.setdefault(key, Finding(
+                            rule=self.name, path=site.path,
+                            line=site.line, key=key,
+                            message=f"non-static arg {argdesc!r} of "
+                                    f"jit program {prog.label} is fed "
+                                    f"from a Python-varying source "
+                                    f"({vs}) — structure/dtype drift "
+                                    f"recompiles per call"))
+            # static_argnames passed as keywords at the site
+            for kw in site.call.keywords:
+                if kw.arg is None or kw.arg not in prog.static_argnames:
+                    continue
+                v, r = _classify_static(kw.value, scope, al)
+                if v == _VARYING:
+                    key = (f"{site.path}::{site.qualname}::"
+                           f"{prog.label}::static-{kw.arg}")
+                    out.setdefault(key, Finding(
+                        rule=self.name, path=site.path, line=site.line,
+                        key=key,
+                        message=f"static arg {kw.arg!r} of jit program "
+                                f"{prog.label} is Python-varying ({r})"
+                                f" — every distinct value compiles a "
+                                f"new executable"))
+        return list(out.values())
+
+
+# ---------------------------------------------------------------------------
+# Rule 18: sharded-donation
+# ---------------------------------------------------------------------------
+
+
+class ShardedDonationRule:
+    """Contract: a jit program classified *mesh-partitioned* — its
+    ``functools.partial`` binds ``mesh=``, it is built by a
+    ``*_sharded`` factory, or a call site feeds it a buffer committed
+    via ``shard_params``/``shard_kv_cache``/``jax.device_put`` — whose
+    signature carries KV-pool parameters (``kv``/``kv_pages``/
+    ``k_pages``/``v_pages``/``kv_cache``) must (a) donate every KV
+    position via a literal ``donate_argnums``, and (b) when the
+    donation is not layout-pinned (no in_/out_shardings, no ``**pin``
+    splat), flow a *committed* sharded buffer at every call site — an
+    unsharded donated pool entering a mesh program pays a cross-device
+    resharding copy per call. Extends the runtime/ donation rule
+    through shard_map/NamedSharding, including the out-of-package
+    ``__graft_entry__`` dryrun_multichip harness, which is read from
+    disk on whole-package runs.
+
+    Escape hatch: a justified entry in
+    ``tools/xlint/allowlists/sharded-donation.txt``.
+
+    Bad-fixture example (fires)::
+
+        step = jax.jit(functools.partial(_step, mesh=mesh))  # kv param,
+        step(params, x, kv)                                  # no donate
+
+    Clean example (passes)::
+
+        step = jax.jit(functools.partial(_step, mesh=mesh),
+                       donate_argnums=(2,), **_pin(3, 2, 1))
+    """
+
+    name = "sharded-donation"
+    describe = ("mesh-partitioned jit programs carrying KV-pool args "
+                "must donate them (literal donate_argnums) and either "
+                "pin layouts or flow shard_*-committed buffers at "
+                "every call site — incl. the __graft_entry__ "
+                "dryrun path")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        tw = tracewalk_analyze(tree)
+        sites_by_prog: Dict[int, List[JitCallSite]] = {}
+        for s in tw.sites:
+            sites_by_prog.setdefault(id(s.program), []).append(s)
+        out: Dict[str, Finding] = {}
+        for prog in tw.programs:
+            kv_idx = prog.kv_positions()
+            if not kv_idx:
+                continue
+            sites = sites_by_prog.get(id(prog), [])
+            mesh = prog.mesh_bound or any(
+                self._kv_arg_committed(tw, s, kv_idx) for s in sites)
+            if not mesh:
+                continue
+            if prog.donate_unresolved or \
+                    any(i not in prog.donate_argnums for i in kv_idx):
+                key = f"{prog.path}::{prog.label}::sharded-donate"
+                out.setdefault(key, Finding(
+                    rule=self.name, path=prog.path, line=prog.line,
+                    key=key,
+                    message=f"mesh-partitioned jit program "
+                            f"{prog.label} carries KV-pool args at "
+                            f"positions {kv_idx} but donate_argnums "
+                            f"{'is not a literal' if prog.donate_unresolved else f'covers only {sorted(prog.donate_argnums)}'}"
+                            f" — every call pays a pool-sized copy "
+                            f"per shard"))
+                continue
+            if prog.pinned:
+                continue
+            bad = [s for s in sites
+                   if not self._all_kv_committed(tw, s, kv_idx)]
+            if bad or not sites:
+                where = (f"call at line {bad[0].line}" if bad
+                         else "no resolvable call site proves a "
+                              "committed carry")
+                key = f"{prog.path}::{prog.label}::sharded-pin"
+                out.setdefault(key, Finding(
+                    rule=self.name, path=prog.path, line=prog.line,
+                    key=key,
+                    message=f"mesh-partitioned jit program "
+                            f"{prog.label} donates KV-pool args but "
+                            f"pins no layouts and does not provably "
+                            f"flow a shard_*-committed buffer "
+                            f"({where}) — layout assignment can "
+                            f"reshard the pool per call"))
+        return list(out.values())
+
+    @staticmethod
+    def _kv_arg_committed(tw: TracewalkAnalysis, site: JitCallSite,
+                          kv_idx: List[int]) -> bool:
+        args = site.call.args
+        for i in kv_idx:
+            if i < len(args) and not isinstance(args[i], ast.Starred) \
+                    and tw.arg_committed(site, args[i]):
+                return True
+        return False
+
+    @staticmethod
+    def _all_kv_committed(tw: TracewalkAnalysis, site: JitCallSite,
+                          kv_idx: List[int]) -> bool:
+        args = site.call.args
+        for i in kv_idx:
+            if i >= len(args) or isinstance(args[i], ast.Starred):
+                return True        # starred/short call: out of reach
+            if not tw.arg_committed(site, args[i]):
+                return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Rule 19: transfer-discipline
+# ---------------------------------------------------------------------------
+
+
+class TransferDisciplineRule:
+    """Contract: on per-step code paths — functions reachable (per the
+    call graph) from the worker's ``_engine_loop`` or an ``Engine``
+    ``step*``/``_run_*`` method — host-built values must not flow RAW
+    into a jit call: an inline ``np.*`` build, a list/dict/set literal
+    or comprehension, a local whose only builds are host-side, or a
+    ``self.*`` attribute whose every assignment is a numpy build. Each
+    such upload blocks the step on a host→device transfer outside the
+    planned single staged upload (the generalization of
+    hot-loop-blocking-readback from readbacks to uploads). Staging
+    through ``jnp.asarray(...)`` / ``jax.device_put(...)`` — at the
+    argument, or anywhere on the local's def-chain — passes; static
+    args are exempt (they are Python values by contract).
+
+    Escape hatch: annotate the call or argument line with
+    ``# xlint: host-arg — <why>`` (e.g. a cold path behind a rare
+    flag), or a justified entry in
+    ``tools/xlint/allowlists/transfer-discipline.txt``.
+
+    Bad-fixture example (fires)::
+
+        def step(self):
+            ids = np.asarray(self._pending)    # host build
+            self._jit_step(self.params, ids)   # raw upload per step
+
+    Clean example (passes)::
+
+        ids = jnp.asarray(np.asarray(self._pending))  # staged once
+        self._jit_step(self.params, ids)
+    """
+
+    name = "transfer-discipline"
+    describe = ("host arrays (np builds, container literals, host-only "
+                "locals/attrs) must not flow raw into jit calls on "
+                "engine-loop-reachable paths — stage via jnp.asarray/"
+                "device_put or annotate '# xlint: host-arg — <why>'")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        tw = tracewalk_analyze(tree)
+        out: Dict[str, Finding] = {}
+        for site in tw.sites:
+            if not site.fid or site.fid not in tw.step_reachable:
+                continue
+            fi = tw.cg.functions.get(site.fid)
+            mod = tw.module(site.path)
+            if fi is None or mod is None:
+                continue
+            al = _aliases(mod.tree)
+            prog = site.program
+            static_pos = set(prog.static_argnums)
+            if prog.params:
+                static_pos |= {i for i, p in enumerate(prog.params)
+                               if p in prog.static_argnames}
+            for i, a in enumerate(site.call.args):
+                if isinstance(a, ast.Starred):
+                    break
+                if i in static_pos:
+                    continue
+                why = self._host_verdict(tw, site, fi, a, al)
+                if why is None:
+                    continue
+                if self._annotated(mod, site.line) or \
+                        self._annotated(mod, a.lineno):
+                    continue
+                argdesc = (prog.params[i]
+                           if prog.params and i < len(prog.params)
+                           else f"arg{i}")
+                key = (f"{site.path}::{site.qualname}::{prog.label}"
+                       f"::host-{argdesc}")
+                out.setdefault(key, Finding(
+                    rule=self.name, path=site.path, line=site.line,
+                    key=key,
+                    message=f"host value flows raw into jit program "
+                            f"{prog.label} arg {argdesc!r} on a "
+                            f"per-step path ({why}) — stage it via "
+                            f"jnp.asarray/device_put or annotate "
+                            f"'# xlint: host-arg — <why>'"))
+        return list(out.values())
+
+    @staticmethod
+    def _annotated(mod: Module, line: int) -> bool:
+        if 1 <= line <= len(mod.lines):
+            return bool(_HOST_ARG_RE.search(mod.lines[line - 1]))
+        return False
+
+    def _host_verdict(self, tw: TracewalkAnalysis, site: JitCallSite,
+                      fi, arg: ast.AST,
+                      al: Dict[str, Set[str]]) -> Optional[str]:
+        if isinstance(arg, ast.Subscript):
+            return self._host_verdict(tw, site, fi, arg.value, al)
+        if isinstance(arg, ast.Call):
+            f = arg.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in al["np"]:
+                return f"inline np.{f.attr}() build"
+            return None            # jnp/device_put/other: staged/opaque
+        if isinstance(arg, (ast.List, ast.Set, ast.Dict)) and \
+                (getattr(arg, "elts", None)
+                 or getattr(arg, "keys", None)):
+            return "container literal uploaded per call"
+        if isinstance(arg, (ast.ListComp, ast.SetComp, ast.DictComp)):
+            return "comprehension uploaded per call"
+        if isinstance(arg, ast.Name):
+            host = None
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                hit = any(
+                    (isinstance(t, ast.Name) and t.id == arg.id)
+                    or (isinstance(t, ast.Tuple)
+                        and any(isinstance(e, ast.Name)
+                                and e.id == arg.id for e in t.elts))
+                    for t in node.targets)
+                if not hit:
+                    continue
+                kind = TracewalkAnalysis._value_kind(node.value, al)
+                if kind in ("dev", "commit"):
+                    return None    # staged somewhere on the def-chain
+                if kind == "np":
+                    host = (f"local {arg.id!r} built host-side and "
+                            f"never staged")
+            return host
+        a = _is_self_attr(arg)
+        if a is not None and fi.cls is not None:
+            kinds = tw.attr_kinds.get((site.path, fi.cls), {}).get(
+                a, set())
+            if kinds and kinds <= {"np"}:
+                return (f"self.{a} is a host-side mirror (every "
+                        f"assignment is a numpy build)")
+        return None

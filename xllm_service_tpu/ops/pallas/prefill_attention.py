@@ -69,6 +69,21 @@ from xllm_service_tpu.ops.pallas._compat import (
 from xllm_service_tpu.ops.attention import FULL_WINDOW
 
 _NEG_INF = -1e30
+
+# Read ONCE at import: this feeds the jit static arg q_block, and an
+# env read per call is both hot-path overhead and a recompile hazard if
+# the variable ever changes mid-run (xlint recompile-hazard). 64 is the
+# shape-safe default — the offline v5e AOT envelope
+# (tools/aot_kernel_probes.py, round 5) showed q_block=128 blowing
+# XLA's default scoped-VMEM budget at several serving shapes (incl.
+# B=32/64 with T=128 — the bench prefill shape) while 64 compiles
+# everywhere tested (T 128-2048, B 1-64). Override for on-chip A/Bs;
+# 128 also works with --xla_tpu_scoped_vmem_limit_kib=32768.
+try:
+    _QBLOCK_DEFAULT = int(os.environ.get(
+        "XLLM_PALLAS_PREFILL_QBLOCK", "64"))
+except ValueError:
+    _QBLOCK_DEFAULT = 64
 # Larger than any context: a window of 0 (= disabled) is normalized to
 # this so the mask arithmetic stays branch-free in-kernel. A plain int
 # (not a jnp constant — module-level jax arrays would be captured as
@@ -294,18 +309,7 @@ def paged_prefill_attention_pallas(q: jnp.ndarray, k_fresh: jnp.ndarray,
         from xllm_service_tpu.ops import pallas
         interpret = pallas.default_interpret()
     if q_block is None:
-        # 64 is the shape-safe default: the offline v5e AOT envelope
-        # (tools/aot_kernel_probes.py, round 5) showed q_block=128
-        # blowing XLA's default scoped-VMEM budget at several serving
-        # shapes (incl. B=32/64 with T=128 — the bench prefill shape)
-        # while 64 compiles everywhere tested (T 128-2048, B 1-64).
-        # Override for on-chip A/Bs; 128 also works with
-        # --xla_tpu_scoped_vmem_limit_kib=32768.
-        try:
-            q_block = int(os.environ.get(
-                "XLLM_PALLAS_PREFILL_QBLOCK", "64"))
-        except ValueError:
-            q_block = 64
+        q_block = _QBLOCK_DEFAULT
     win = jnp.asarray(sliding_window, jnp.int32).reshape(1)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])

@@ -449,6 +449,23 @@ class Engine:
                 out[name] = cnt
         return out
 
+    def compile_report(self) -> Dict[str, int]:
+        """Total compiled-variant count per jit program — the whole
+        cache, warmup included (the ``*.recompile`` phase counters
+        only cover post-warmup growth). A program whose count keeps
+        climbing under steady traffic has an unbucketed shape or a
+        Python-varying static leaking into its signature."""
+        report: Dict[str, int] = {}
+        for name, jitted in (("prefill", self._jit_prefill),
+                             ("prefill_plp", self._jit_prefill_plp),
+                             ("prefill_ring", self._jit_prefill_ring),
+                             ("decode", self._jit_decode),
+                             ("decode_multi", self._jit_decode_multi),
+                             ("kv_scatter", _kv_scatter)):
+            if jitted is not None:
+                report[name] = self._jit_cache_size(jitted)
+        return report
+
     def _read_host(self, phase: str, *arrays):
         """Blocking device→host readback with split attribution.
 

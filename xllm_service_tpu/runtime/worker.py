@@ -1081,6 +1081,13 @@ class Worker:
             else:   # "<prog>.recompile" counters
                 c_rec.set_total(entry, model=m,
                                 program=name.rsplit(".", 1)[0])
+        c_compiles = self.obs.counter(
+            "xllm_worker_jit_compiles_total",
+            "compiled variants per jit program, warmup included "
+            "(steady growth = unbucketed shape / leaking static)",
+            labelnames=("model", "program"))
+        for name, total in eng.compile_report().items():
+            c_compiles.set_total(total, model=m, program=name)
 
     def _dispatch_outputs(self, rt: ModelRuntime,
                           outs: List[StepOutput], step_ms: float) -> None:
