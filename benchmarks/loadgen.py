@@ -12,7 +12,11 @@ Usage:
       --num-requests 64 --request-rate 8 --max-tokens 32
 
 Prints one JSON summary: req/s, p50/p99 TTFT, p50/p99 TPOT, SLO
-attainment vs --target-ttft/--target-tpot.
+attainment vs --target-ttft/--target-tpot, and goodput-under-SLO
+(completed req/s meeting BOTH targets). ``--closed-loop`` switches to
+the concurrency-ramp harness (``run_closed_loop``): per-stage closed
+loops with heavy-tailed prompt/output lengths whose last stage is the
+burst, reporting burst-mode ``ttft_ms_p99``/``tpot_ms_p99_under_burst``.
 """
 
 from __future__ import annotations
@@ -23,7 +27,7 @@ import json
 import random
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 from xllm_service_tpu.service.httpd import http_stream, iter_sse_events
 
@@ -37,6 +41,9 @@ class RequestResult:
     num_tokens: int = 0
     offline: bool = False
     error: str = ""
+    # Per-request SLO verdict, stamped by summarize_results: online,
+    # completed, and met BOTH the TTFT and TPOT targets.
+    slo_ok: bool = False
 
 
 def _percentile(vals: List[float], p: float) -> float:
@@ -56,6 +63,71 @@ def sample_prompt_lens(n: int, seed: int = 0,
         ln = int(rng.lognormvariate(0, 0.6) * mean)
         out.append(max(4, min(ln, cap)))
     return out
+
+
+def sample_gen_lens(n: int, seed: int = 0,
+                    mean: int = 32, cap: int = 512) -> List[int]:
+    """Heavy-tailed output lengths (heavier than the prompt mix: replies
+    vary more than prompts in real traces)."""
+    rng = random.Random(seed ^ 0x5EED)
+    out = []
+    for _ in range(n):
+        ln = int(rng.lognormvariate(0, 0.9) * mean)
+        out.append(max(2, min(ln, cap)))
+    return out
+
+
+def summarize_results(results: List[Optional[RequestResult]],
+                      wall_s: float, *, target_ttft_ms: float,
+                      target_tpot_ms: float,
+                      num_requests: Optional[int] = None) -> dict:
+    """One summary dict from a batch of per-request results — the single
+    summarization path shared by open-loop ``run_load``, the closed-loop
+    ramp, and bench.py's engine-level burst section, so goodput and the
+    percentile arithmetic cannot drift between harnesses.
+
+    ``goodput_under_slo`` is completed req/s meeting BOTH the TTFT and
+    TPOT targets (online tier only — offline is best-effort by design);
+    a single-token reply has no TPOT and passes on TTFT alone."""
+    done = [r for r in results if r is not None]
+    ok = [r for r in done if r.ok]
+    online = [r for r in ok if not r.offline]
+    ttfts = [r.ttft_ms for r in ok]
+    tpots = [r.tpot_ms for r in ok if r.tpot_ms > 0]
+    for r in done:
+        r.slo_ok = (r.ok and not r.offline
+                    and r.ttft_ms <= target_ttft_ms
+                    and (r.tpot_ms == 0.0
+                         or r.tpot_ms <= target_tpot_ms))
+    good = sum(1 for r in done if r.slo_ok)
+    return {
+        "num_requests": (num_requests if num_requests is not None
+                         else len(done)),
+        "num_ok": len(ok),
+        "num_errors": len(done) - len(ok),
+        "wall_s": round(wall_s, 3),
+        "req_per_s": round(len(ok) / wall_s, 3) if wall_s > 0 else 0.0,
+        "tokens_per_s": round(sum(r.num_tokens for r in ok)
+                              / wall_s, 2) if wall_s > 0 else 0.0,
+        "goodput_under_slo": round(good / wall_s, 3) if wall_s > 0
+        else 0.0,
+        "ttft_ms": {"p50": round(_percentile(ttfts, 50), 2),
+                    "p99": round(_percentile(ttfts, 99), 2)},
+        "tpot_ms": {"p50": round(_percentile(tpots, 50), 2),
+                    "p99": round(_percentile(tpots, 99), 2)},
+        # SLA attainment of the ONLINE tier only (offline requests are
+        # best-effort by design — reference target_ttft/target_tpot
+        # flags).
+        "online_slo": {
+            "ttft": round(sum(1 for r in online
+                              if r.ttft_ms <= target_ttft_ms)
+                          / max(len(online), 1), 4),
+            "tpot": round(sum(1 for r in online if r.tpot_ms > 0
+                              and r.tpot_ms <= target_tpot_ms)
+                          / max(sum(1 for r in online if r.tpot_ms > 0),
+                                1), 4),
+        },
+    }
 
 
 def load_sharegpt(path: str, num_requests: int, seed: int = 0,
@@ -177,34 +249,77 @@ def run_load(target: str, model: str, num_requests: int,
         th.join(timeout=timeout)
     wall = time.monotonic() - t_start
 
-    done = [r for r in results if r is not None]
-    ok = [r for r in done if r.ok]
-    online = [r for r in ok if not r.offline]
-    ttfts = [r.ttft_ms for r in ok]
-    tpots = [r.tpot_ms for r in ok if r.tpot_ms > 0]
-    return {
-        "num_requests": num_requests,
-        "num_ok": len(ok),
-        "num_errors": len(done) - len(ok),
-        "wall_s": round(wall, 3),
-        "req_per_s": round(len(ok) / wall, 3) if wall > 0 else 0.0,
-        "tokens_per_s": round(sum(r.num_tokens for r in ok) / wall, 2),
-        "ttft_ms": {"p50": round(_percentile(ttfts, 50), 2),
-                    "p99": round(_percentile(ttfts, 99), 2)},
-        "tpot_ms": {"p50": round(_percentile(tpots, 50), 2),
-                    "p99": round(_percentile(tpots, 99), 2)},
-        # SLA attainment of the ONLINE tier only (offline requests are
-        # best-effort by design — reference target_ttft/target_tpot flags).
-        "online_slo": {
-            "ttft": round(sum(1 for r in online
-                              if r.ttft_ms <= target_ttft_ms)
-                          / max(len(online), 1), 4),
-            "tpot": round(sum(1 for r in online if r.tpot_ms > 0
-                              and r.tpot_ms <= target_tpot_ms)
-                          / max(sum(1 for r in online if r.tpot_ms > 0),
-                                1), 4),
-        },
-    }
+    return summarize_results(results, wall,
+                             target_ttft_ms=target_ttft_ms,
+                             target_tpot_ms=target_tpot_ms,
+                             num_requests=num_requests)
+
+
+def run_closed_loop(target: str, model: str, *,
+                    stages: Sequence[int] = (1, 2, 4),
+                    requests_per_stage: int = 8,
+                    mean_prompt_len: int = 64,
+                    mean_output_len: int = 32, seed: int = 0,
+                    target_ttft_ms: float = 1000.0,
+                    target_tpot_ms: float = 50.0,
+                    timeout: float = 600.0) -> dict:
+    """Closed-loop goodput-under-SLO harness.
+
+    Each stage holds ``concurrency`` requests in flight — a worker fires
+    its next request the moment the previous one completes — and the
+    stage list ramps concurrency, so offered load tracks what the stack
+    actually absorbs instead of an open-loop arrival rate it may never
+    keep up with. Prompt AND output lengths are heavy-tailed. The last
+    (highest-concurrency) stage is the burst: its percentiles become
+    the summary's ``ttft_ms_p99`` / ``tpot_ms_p99_under_burst``, the
+    numbers a TPOT-bounding interleaver is supposed to hold down while
+    the burst's prompts prefill."""
+    stage_summaries: List[dict] = []
+    all_results: List[RequestResult] = []
+    t0 = time.monotonic()
+    for si, conc in enumerate(stages):
+        plan = list(zip(
+            sample_prompt_lens(requests_per_stage, seed + si,
+                               mean=mean_prompt_len),
+            sample_gen_lens(requests_per_stage, seed + si,
+                            mean=mean_output_len)))
+        results: List[RequestResult] = []
+        lock = threading.Lock()
+
+        def worker() -> None:
+            while True:
+                with lock:
+                    if not plan:
+                        return
+                    plen, glen = plan.pop()
+                r = run_one(target, model, plen, glen, False, timeout)
+                with lock:
+                    results.append(r)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(conc)]
+        st0 = time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=timeout)
+        s = summarize_results(results, time.monotonic() - st0,
+                              target_ttft_ms=target_ttft_ms,
+                              target_tpot_ms=target_tpot_ms)
+        s["concurrency"] = conc
+        stage_summaries.append(s)
+        all_results.extend(results)
+    overall = summarize_results(all_results, time.monotonic() - t0,
+                                target_ttft_ms=target_ttft_ms,
+                                target_tpot_ms=target_tpot_ms)
+    burst = stage_summaries[-1]
+    overall.update(
+        mode="closed_loop",
+        stages=stage_summaries,
+        ttft_ms_p99=burst["ttft_ms"]["p99"],
+        tpot_ms_p99_under_burst=burst["tpot_ms"]["p99"],
+    )
+    return overall
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -223,15 +338,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="path to a ShareGPT-format JSON dump to replay "
                          "(real prompts + output-length mix)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--closed-loop", action="store_true",
+                    help="concurrency-ramp closed loop (goodput-under-"
+                         "SLO harness) instead of open-loop arrivals")
+    ap.add_argument("--stages", default="1,2,4",
+                    help="closed-loop concurrency ramp; the last stage "
+                         "is the burst")
+    ap.add_argument("--requests-per-stage", type=int, default=8)
+    ap.add_argument("--mean-output-len", type=int, default=32)
     args = ap.parse_args(argv)
 
-    summary = run_load(
-        args.target, args.model, args.num_requests, args.request_rate,
-        args.max_tokens, args.offline_fraction, args.seed,
-        mean_prompt_len=args.mean_prompt_len,
-        target_ttft_ms=args.target_ttft_ms,
-        target_tpot_ms=args.target_tpot_ms,
-        sharegpt_path=args.sharegpt or None)
+    if args.closed_loop:
+        summary = run_closed_loop(
+            args.target, args.model,
+            stages=tuple(int(x) for x in args.stages.split(",") if x),
+            requests_per_stage=args.requests_per_stage,
+            mean_prompt_len=args.mean_prompt_len,
+            mean_output_len=args.mean_output_len, seed=args.seed,
+            target_ttft_ms=args.target_ttft_ms,
+            target_tpot_ms=args.target_tpot_ms)
+    else:
+        summary = run_load(
+            args.target, args.model, args.num_requests,
+            args.request_rate, args.max_tokens, args.offline_fraction,
+            args.seed, mean_prompt_len=args.mean_prompt_len,
+            target_ttft_ms=args.target_ttft_ms,
+            target_tpot_ms=args.target_tpot_ms,
+            sharegpt_path=args.sharegpt or None)
     print(json.dumps(summary))
     return 0
 

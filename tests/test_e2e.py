@@ -38,7 +38,7 @@ def small_engine_cfg() -> EngineConfig:
 
 
 def make_cluster(store, decode_to_service: bool = False,
-                 n_workers: int = 1):
+                 n_workers: int = 1, engine_cfg: Optional[EngineConfig] = None):
     opts = ServiceOptions(
         http_port=0, rpc_port=0, num_output_pools=4,
         load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
@@ -52,8 +52,9 @@ def make_cluster(store, decode_to_service: bool = False,
             port=0, instance_type=InstanceType.DEFAULT,
             service_addr=master.rpc_address, model="tiny",
             heartbeat_interval_s=0.2, lease_ttl_s=2.0)
-        workers.append(Worker(wopts, store,
-                              engine_cfg=small_engine_cfg()).start())
+        workers.append(Worker(
+            wopts, store,
+            engine_cfg=engine_cfg or small_engine_cfg()).start())
     assert wait_until(
         lambda: len(master.scheduler.instance_mgr.prefill_instances())
         == n_workers, timeout=15.0), "workers never registered"

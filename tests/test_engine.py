@@ -818,10 +818,15 @@ class TestDecodePipeline:
 
     @staticmethod
     def _ecfg(pipeline, **kw):
+        # interleave=False pins the legacy prefill-first routing this
+        # matrix was written against (admission drains the speculative
+        # burst). The interleaver plans ahead instead — an admission
+        # becomes a spec HIT followed by the prefill — and its own
+        # matrix lives in tests/test_interleave.py.
         d = dict(page_size=32, num_pages=16, max_model_len=64,
                  max_batch_size=2, max_prefill_tokens=64,
                  prefill_buckets=(8, 16, 32), decode_steps=4,
-                 decode_pipeline=pipeline)
+                 decode_pipeline=pipeline, interleave=False)
         d.update(kw)
         return EngineConfig(**d)
 

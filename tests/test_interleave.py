@@ -1,0 +1,257 @@
+"""Token-budget prefill/decode interleaving (staggered admission).
+
+The deterministic tentpole e2e: time is measured in ENGINE STEPS, not
+wall clock, so the pins hold on any CPU. Unloaded, a decode stream
+receives tokens every iteration (gap 1); the interleaver keeps that
+true under a burst of long prompts (TPOT bounded by construction),
+while the prefill-first control shows the decode stall. Token streams
+are byte-identical interleave on vs off at temperature=0.
+"""
+
+import dataclasses
+
+import pytest
+
+from xllm_service_tpu.config import EngineConfig, ModelConfig
+from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+from xllm_service_tpu.utils.types import SamplingParams
+
+MCFG = ModelConfig.tiny(vocab_size=64)
+
+
+def _ecfg(**kw):
+    d = dict(page_size=4, num_pages=128, max_model_len=128,
+             max_batch_size=4, max_prefill_tokens=32,
+             prefill_buckets=(8, 16, 32), decode_steps=1)
+    d.update(kw)
+    return EngineConfig(**d)
+
+
+def _req(rid, toks, max_tokens, **kw):
+    return EngineRequest(
+        request_id=rid, token_ids=list(toks),
+        sampling=SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                                ignore_eos=True), **kw)
+
+
+def _drive(eng, feed=None, max_steps=300):
+    """Drive to idle; returns (tokens-per-rid, steps-delivering-per-rid).
+    ``feed`` = {step_number: [EngineRequest, ...]} applied before that
+    step runs, so both interleave settings see the same arrival points
+    in step time."""
+    toks, deliver = {}, {}
+    fed = set()
+    step = 0
+    while eng.has_work() or (feed and len(fed) < len(feed)):
+        step += 1
+        if feed and step in feed and step not in fed:
+            for r in feed[step]:
+                eng.add_request(dataclasses.replace(r))
+            fed.add(step)
+        for out in eng.step():
+            if out.new_token_ids:
+                toks.setdefault(out.request_id, []).extend(
+                    out.new_token_ids)
+                deliver.setdefault(out.request_id, []).append(step)
+        assert step < max_steps, "engine did not drain"
+    return toks, deliver
+
+
+def _gaps(steps):
+    return [b - a for a, b in zip(steps, steps[1:])]
+
+
+class TestInterleaver:
+    STREAMS = [_req("s0", range(1, 9), 30), _req("s1", range(3, 11), 30)]
+    BURST = [_req("b0", range(2, 102), 4), _req("b1", range(5, 105), 4)]
+    BURST_STEP = 4
+
+    def _run(self, interleave):
+        eng = Engine(MCFG, _ecfg(interleave=interleave), seed=0)
+        for r in self.STREAMS:
+            eng.add_request(dataclasses.replace(r))
+        toks, deliver = _drive(eng, feed={self.BURST_STEP: self.BURST})
+        return eng, toks, deliver
+
+    @pytest.fixture(scope="class")
+    def runs(self):
+        return {il: self._run(il) for il in (True, False)}
+
+    def test_streams_byte_identical_on_vs_off(self, runs):
+        _, on, _ = runs[True]
+        _, off, _ = runs[False]
+        assert on == off
+        assert set(on) == {"s0", "s1", "b0", "b1"}
+        assert len(on["s0"]) == 30 and len(on["b0"]) == 4
+
+    def test_decode_gap_bounded_under_burst(self, runs):
+        """With interleave on, running streams receive a token EVERY
+        iteration even while 200 prompt tokens prefill — gap p99 == 1,
+        within 2x the unloaded gap of 1. The prefill-first control
+        stalls decode for the whole burst prefill."""
+        _, _, d_on = runs[True]
+        _, _, d_off = runs[False]
+        for rid in ("s0", "s1"):
+            gaps_on = _gaps(d_on[rid])
+            assert gaps_on and max(gaps_on) == 1, (rid, d_on[rid])
+        # Control: the same burst defers decode for several consecutive
+        # prefill-first iterations (the stall the interleaver removes).
+        stall = max(max(_gaps(d_off[r])) for r in ("s0", "s1"))
+        assert stall >= 3, d_off
+
+    def test_burst_ttft_meets_staggered_bound(self, runs):
+        """Each burst prompt's first token lands within the analytic
+        bound: the front waiting prompt is guaranteed a quantum of the
+        largest bucket <= residual budget (32 - 2 decode = 30 -> 16)
+        every iteration, so 200 burst tokens drain within ceil(200/16)
+        steps, plus one step of arrival slack and one of admission
+        order."""
+        _, _, d_on = runs[True]
+        bound = self.BURST_STEP + -(-200 // 16) + 2
+        for rid in ("b0", "b1"):
+            assert d_on[rid][0] <= bound, (rid, d_on[rid], bound)
+
+    def test_mixed_step_ledger_and_backlog(self):
+        """The interleaved iteration reports the split the worker's obs
+        flush exports: kind "mixed", per-phase token counts, shrunken
+        quantum windows, and the waiting_prefill_tokens backlog the
+        heartbeat advertises."""
+        eng = Engine(MCFG, _ecfg(), seed=0)
+        for r in self.STREAMS:
+            eng.add_request(dataclasses.replace(r))
+        for _ in range(3):
+            eng.step()
+        for r in self.BURST:
+            eng.add_request(dataclasses.replace(r))
+        assert eng.waiting_prefill_tokens() == 200
+        assert eng.load_metrics()["waiting_prefill_tokens"] == 200
+        outs = eng.step()
+        assert eng.last_step_kind == "mixed"
+        assert eng.last_step_decode_tokens == 2
+        assert eng.last_step_prefill_tokens > 0
+        assert eng.last_step_prefill_windows
+        # The quantum shrank below the 32 cap: snapped DOWN to the
+        # largest bucket <= residual budget (32 - 2 decode tokens = 30
+        # -> bucket 16), so windows stay compiled-program shaped.
+        assert max(eng.last_step_prefill_windows) <= 16
+        assert eng.last_step_tokens == (eng.last_step_prefill_tokens
+                                        + eng.last_step_decode_tokens)
+        assert not eng.last_step_decode_deferred
+        assert eng.waiting_prefill_tokens() == 200 - \
+            eng.last_step_prefill_tokens
+        assert outs
+
+
+def test_env_and_default_resolution(monkeypatch):
+    # Env overrides land on EngineConfig in __post_init__ (cheap to
+    # pin); one Engine covers the engine-side default resolution.
+    monkeypatch.setenv("XLLM_INTERLEAVE", "0")
+    assert _ecfg().interleave is False
+    monkeypatch.setenv("XLLM_INTERLEAVE", "1")
+    assert _ecfg(interleave=False).interleave is True
+    monkeypatch.setenv("XLLM_STEP_TOKEN_BUDGET", "16")
+    monkeypatch.setenv("XLLM_PREFILL_DEADLINE_MS", "125")
+    assert _ecfg().step_token_budget == 16
+    assert _ecfg().prefill_deadline_ms == 125.0
+    monkeypatch.delenv("XLLM_INTERLEAVE")
+    monkeypatch.delenv("XLLM_STEP_TOKEN_BUDGET")
+    monkeypatch.delenv("XLLM_PREFILL_DEADLINE_MS")
+    eng = Engine(MCFG, _ecfg(), seed=0)
+    assert eng.interleave is True            # None = auto ON
+    assert eng.step_token_budget == 32       # 0 = max_prefill_tokens
+    assert eng.prefill_deadline_ms == 500.0
+
+
+def test_skip_ahead_admits_small_prompt_behind_page_starved_giant():
+    """Head-of-line fix: a giant whose pages don't fit must not block a
+    small prompt behind it from admitting this step; queue order is
+    untouched so the giant admits as soon as pages free up."""
+    eng = Engine(MCFG, _ecfg(num_pages=16, max_model_len=64,
+                             max_prefill_tokens=64,
+                             prefill_buckets=(8, 16, 32, 64)), seed=0)
+    # Blocker holds 10 of the 15 pages; the giant's first 32-token
+    # window needs 8 > 5 free pages, the small prompt only 3.
+    eng.add_request(_req("blocker", range(1, 37), 12))
+    early = list(eng.step())
+    eng.add_request(_req("giant", range(2, 42), 2))
+    eng.add_request(_req("small", range(4, 12), 2))
+    outs = eng.step()
+    early += outs
+    got = {o.request_id for o in outs if o.new_token_ids}
+    assert "small" in got, outs       # admitted past the stuck giant
+    assert any(s.req.request_id == "giant" for s in eng.waiting)
+    # Sort contract: the giant keeps queue priority and still finishes
+    # once the blocker's pages free.
+    toks, _ = _drive(eng)
+    for o in early:
+        if o.new_token_ids:
+            toks[o.request_id] = (list(o.new_token_ids)
+                                  + toks.get(o.request_id, []))
+    assert len(toks["giant"]) == 2
+    assert len(toks["small"]) == 2
+    assert len(toks["blocker"]) == 12
+
+
+def test_starvation_deadline_grants_quantum():
+    """With the budget fully consumed by decode, a waiting prompt
+    starves until the TTFT-derived deadline passes — then it is
+    guaranteed a minimum quantum per iteration."""
+    # Budget 8 admits the stream's 8-token prompt unloaded; once the
+    # stream decodes, the residual (8 - 1 = 7) is below the smallest
+    # bucket, so no prefill window fits and the prompt waits.
+    eng = Engine(MCFG, _ecfg(step_token_budget=8,
+                             prefill_deadline_ms=1e9), seed=0)
+    eng.add_request(_req("s", range(1, 9), 24))
+    eng.step()
+    eng.add_request(_req("p", range(2, 18), 2))
+    starved = [eng.step() for _ in range(6)]
+    assert all(o.request_id == "s" for outs in starved for o in outs)
+    assert eng.waiting_prefill_tokens() == 16
+    # Deadline elapses (engine-side knob is live per-iteration): the
+    # prompt now gets one minimum-bucket quantum per step and reaches
+    # its first token in ceil(16/8) = 2 iterations.
+    eng.prefill_deadline_ms = 0.0
+    outs = [o for _ in range(2) for o in eng.step()]
+    assert any(o.request_id == "p" and o.new_token_ids for o in outs)
+
+
+class TestInterleavePipelineMatrix:
+    """Satellite to the PR-5 rollback matrix: pipeline on/off and
+    interleave on/off produce byte-identical streams when a prefill
+    lands mid-speculation. With interleave ON the arrival is planned
+    ahead — the in-flight speculative burst is consumed as a HIT and
+    the pipeline drains only when the prefill actually lands — where
+    the legacy prefill-first path rolls the burst back on admission."""
+
+    @staticmethod
+    def _ecfg(pipeline, interleave):
+        return EngineConfig(
+            page_size=32, num_pages=16, max_model_len=64,
+            max_batch_size=2, max_prefill_tokens=64,
+            prefill_buckets=(8, 16, 32), decode_steps=4,
+            decode_pipeline=pipeline, interleave=interleave)
+
+    def _run(self, pipeline, interleave):
+        eng = Engine(MCFG, self._ecfg(pipeline, interleave), seed=0)
+        eng.add_request(_req("a", range(1, 9), 16))
+        toks, _ = _drive(eng, feed={3: [_req("b", range(3, 11), 16)]})
+        return toks, eng.overlap_metrics()
+
+    def test_matrix_byte_identical_and_plan_ahead(self):
+        results = {(p, il): self._run(p, il)
+                   for p in (True, False) for il in (True, False)}
+        streams = [r[0] for r in results.values()]
+        assert all(s == streams[0] for s in streams[1:]), results
+        assert len(streams[0]["a"]) == 16 and len(streams[0]["b"]) == 16
+        om_on = results[(True, True)][1]
+        om_legacy = results[(True, False)][1]
+        # Legacy: the admission drains the in-flight speculation.
+        assert om_legacy["spec_rollbacks"] >= 1, om_legacy
+        # Interleaver: the same arrival is planned ahead — consumed as
+        # a hit, zero wasted bursts, speculation still engaged.
+        assert om_on["spec_dispatches"] >= 1, om_on
+        assert om_on["spec_hits"] >= 1, om_on
+        assert om_on["spec_rollbacks"] == 0, om_on
+        # Pipeline-off runs never speculate, any interleave setting.
+        assert results[(False, True)][1]["spec_dispatches"] == 0
+        assert results[(False, False)][1]["spec_dispatches"] == 0

@@ -4,8 +4,9 @@ import time
 
 import pytest
 
-from benchmarks.loadgen import run_load, sample_prompt_lens
-from tests.test_e2e import make_cluster
+from benchmarks.loadgen import (
+    run_closed_loop, run_load, sample_gen_lens, sample_prompt_lens)
+from tests.test_e2e import _get_text, make_cluster, wait_until
 from xllm_service_tpu.service.coordination import InMemoryStore
 
 
@@ -29,6 +30,47 @@ def test_loadgen_against_cluster():
         assert summary["req_per_s"] > 0
         assert summary["ttft_ms"]["p50"] > 0
         assert 0.0 <= summary["online_slo"]["ttft"] <= 1.0
+    finally:
+        for w in workers:
+            w.stop()
+        master.stop()
+        store.close()
+
+
+def test_sample_gen_lens_heavy_tailed_deterministic():
+    a = sample_gen_lens(32, seed=7, mean=16)
+    assert a == sample_gen_lens(32, seed=7, mean=16)
+    assert all(2 <= x <= 512 for x in a)
+    assert len(set(a)) > 4      # a mix, not a constant
+
+
+def test_closed_loop_goodput_and_interleave_metrics():
+    """Closed-loop concurrency ramp against a live cluster: the summary
+    reports nonzero goodput-under-SLO (generous CPU targets) plus the
+    burst-mode percentile keys, and the worker plane exports the
+    interleaver's new series (satellite obs, scraped not just unit-
+    tested)."""
+    store = InMemoryStore(sweep_interval_s=0.02)
+    master, workers = make_cluster(store)
+    try:
+        summary = run_closed_loop(
+            master.http_address, "tiny", stages=(1, 2),
+            requests_per_stage=3, mean_prompt_len=16, mean_output_len=6,
+            target_ttft_ms=60_000.0, target_tpot_ms=60_000.0,
+            timeout=120.0)
+        assert summary["num_ok"] == 6, summary
+        assert summary["num_errors"] == 0
+        assert summary["goodput_under_slo"] > 0, summary
+        assert summary["ttft_ms_p99"] > 0
+        assert summary["tpot_ms_p99_under_burst"] >= 0
+        assert [s["concurrency"] for s in summary["stages"]] == [1, 2]
+        assert all(s["goodput_under_slo"] > 0 for s in summary["stages"])
+        # The interleaver's worker-plane series, flushed with the step
+        # ledger on the heartbeat cadence.
+        assert wait_until(lambda: "xllm_worker_interleave_mix" in
+                          _get_text(workers[0].name, "/metrics"))
+        wm = _get_text(workers[0].name, "/metrics")
+        assert "xllm_worker_prefill_quantum_tokens_bucket" in wm
     finally:
         for w in workers:
             w.stop()

@@ -761,6 +761,28 @@ class EngineConfig:
     # decode_steps > 1, off for single-step decode. Env
     # XLLM_DECODE_PIPELINE=0/1 overrides.
     decode_pipeline: Optional[bool] = None
+    # Token-budget prefill/decode interleaving (staggered admission,
+    # arxiv 2512.16134): every engine iteration decodes the running set
+    # FIRST (bounding TPOT by construction), then spends the residual of
+    # the per-iteration token budget on chunked-prefill windows — the
+    # prefill quantum shrinks under decode load instead of the engine
+    # running prompt-priority steps that stall every live stream.
+    # None = auto (on). Off restores the pre-interleaver prefill-first
+    # routing (the control that shows the decode stall). Env
+    # XLLM_INTERLEAVE=0/1 overrides.
+    interleave: Optional[bool] = None
+    # Per-iteration token budget the interleaver splits between the
+    # decode burst and prefill windows. 0 = default from
+    # max_prefill_tokens. Env XLLM_STEP_TOKEN_BUDGET overrides.
+    step_token_budget: int = 0
+    # Anti-starvation deadline (ms): once the oldest waiting prompt has
+    # queued past this, the iteration's prefill budget is floored at one
+    # minimum quantum (smallest prefill bucket) even if decode consumed
+    # the whole token budget. Derived from the service plane's default
+    # TTFT target (1000 ms): half the budget reserved for queueing
+    # leaves the other half for the prefill itself. 0 = the floor
+    # applies every iteration. Env XLLM_PREFILL_DEADLINE_MS overrides.
+    prefill_deadline_ms: float = 500.0
     # Tiered KV spill (docs/KV_CACHE.md): when > 0, prefix-cache pages
     # evicted from HBM under allocation pressure are parked in a bounded
     # host-DRAM tier of this many MB instead of dropped, and restored
@@ -800,6 +822,23 @@ class EngineConfig:
             self.decode_pipeline = False
         elif env in ("1", "true", "yes"):
             self.decode_pipeline = True
+        env = os.environ.get("XLLM_INTERLEAVE", "").strip()
+        if env in ("0", "false", "no"):
+            self.interleave = False
+        elif env in ("1", "true", "yes"):
+            self.interleave = True
+        env = os.environ.get("XLLM_STEP_TOKEN_BUDGET", "").strip()
+        if env:
+            try:
+                self.step_token_budget = int(env)
+            except ValueError:
+                pass
+        env = os.environ.get("XLLM_PREFILL_DEADLINE_MS", "").strip()
+        if env:
+            try:
+                self.prefill_deadline_ms = float(env)
+            except ValueError:
+                pass
         env = os.environ.get("XLLM_KV_SPILL_MB", "").strip()
         if env:
             try:

@@ -79,6 +79,13 @@ MODEL_DRAINING = "draining"
 # socket breaks mid-stream, exactly like a SIGKILL'd process.
 _ABORT = object()
 
+# Token-count buckets for the prefill-quantum histogram (pow2 — window
+# sizes are bucketed prompt chunks, not latencies, so the default ms
+# buckets would be meaningless here).
+_PREFILL_QUANTUM_BUCKETS = (
+    4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0,
+    4096.0, 8192.0)
+
 
 @dataclasses.dataclass
 class WorkerOptions:
@@ -964,25 +971,61 @@ class Worker:
         if kind == "idle":
             return
         m = rt.model
+        pf = eng.last_step_prefill_tokens
+        dc = eng.last_step_decode_tokens
         self.obs.counter(
             "xllm_worker_steps_total",
-            "engine iterations by phase",
+            "engine iterations by phase "
+            "(mixed = interleaved decode+prefill)",
             labelnames=("model", "phase")).inc(1, model=m, phase=kind)
-        self.obs.counter(
+        tok = self.obs.counter(
             "xllm_worker_step_tokens_total",
             "batch token occupancy: prompt tokens computed (prefill) / "
-            "tokens sampled (decode)",
-            labelnames=("model", "phase")).inc(
-            eng.last_step_tokens, model=m, phase=kind)
+            "tokens sampled (decode); mixed iterations split per phase",
+            labelnames=("model", "phase"))
+        if pf:
+            tok.inc(pf, model=m, phase="prefill")
+        if dc:
+            tok.inc(dc, model=m, phase="decode")
         self.obs.histogram(
             "xllm_worker_step_ms", "wall time of one engine step",
             labelnames=("model", "phase")).observe(
             step_ms, model=m, phase=kind)
-        if kind == "prefill":
+        if pf:
             # Measured prefill tok/s for the heartbeat's cost-model
-            # signal (LatencyMetrics.prefill_tok_s).
-            self._prefill_tok_cum += eng.last_step_tokens
-            self._prefill_s_cum += step_ms / 1e3
+            # signal (LatencyMetrics.prefill_tok_s). The engine times
+            # the prefill section itself so mixed iterations don't
+            # charge decode time to the prefill rate.
+            self._prefill_tok_cum += pf
+            self._prefill_s_cum += eng.last_step_prefill_s
+        if pf or dc:
+            # Prefill-token share of the iteration: 1.0 = prompt-only,
+            # 0.0 = decode-only; in between is the interleaver at work.
+            self.obs.gauge(
+                "xllm_worker_interleave_mix",
+                "prefill-token share of the last engine iteration",
+                labelnames=("model",)).set(pf / (pf + dc), model=m)
+        # Materialized at 0 so a scrape can tell "no stalls" from "not
+        # exported" — it stays 0 while interleaving is on.
+        stall = self.obs.counter(
+            "xllm_worker_decode_stall_ms_total",
+            "wall ms of prefill-first iterations that deferred live "
+            "decode streams (zero while interleaving is on)",
+            labelnames=("model",))
+        stall.inc(0, model=m)
+        if eng.last_step_decode_deferred:
+            # Prefill-first control path ran a prompt step while decode
+            # streams were live — the stall the interleaver removes.
+            stall.inc(step_ms, model=m)
+        if eng.last_step_prefill_windows:
+            h = self.obs.histogram(
+                "xllm_worker_prefill_quantum_tokens",
+                "scheduled prefill window sizes (the staggered-admission "
+                "quantum shrinks under decode load)",
+                labelnames=("model",),
+                buckets=_PREFILL_QUANTUM_BUCKETS)
+            for w in eng.last_step_prefill_windows:
+                h.observe(w, model=m)
         self._flush_phase_ledger(rt)
         self._flush_overlap(rt)
         self._flush_prefix_cache(rt)
@@ -3288,7 +3331,7 @@ class Worker:
             labelnames=("model", "phase"))
         pending: Dict[Any, List[Any]] = dict(self._hb_step_cum)
         merged: Optional[List[Any]] = None
-        for phase in ("prefill", "decode"):
+        for phase in ("prefill", "decode", "mixed"):
             cur = h.cumulative(model=rt.model, phase=phase)
             if cur is None:
                 continue
@@ -3345,6 +3388,13 @@ class Worker:
         self._latency.kv_gbps = (
             self.kv_migration_bytes / self.kv_migration_seconds / 1e9
             if self.kv_migration_seconds > 0 else 0.0)
+        # Prefill backlog (prompt tokens queued, not yet computed): the
+        # SLO-aware policy's predicted-TTFT term consumes this so
+        # admission staggers across workers instead of piling prompts
+        # onto one already-deep queue (P/D-Serve backlog awareness).
+        if rt.engine is not None:
+            self._latency.waiting_prefill_tokens = \
+                int(rt.engine.waiting_prefill_tokens())
         # Finished request spans ride the heartbeat to the service's
         # span ring (same correlation id); an undelivered batch is
         # requeued so the next beat retries it.

@@ -600,21 +600,45 @@ class InstanceMgr:
     # ------------------------------------------------------------------
     # SLO-aware selection + dynamic PD flips (instance_mgr.cpp:819-970)
     # ------------------------------------------------------------------
-    def select_instance_pair_on_slo(self, num_prompt_tokens: int
+    def _backlog_ms(self, inst) -> float:
+        """Heartbeat-advertised prefill backlog converted to time: the
+        worker's queued-but-uncomputed prompt tokens over its measured
+        prefill throughput (falling back to the planner's 4000 tok/s
+        default until a measurement arrives). This is the P/D-Serve
+        term: the service-side in-flight ledger alone misses prompts
+        already sitting in a worker's own queue."""
+        toks = getattr(inst.latency, "waiting_prefill_tokens", 0) or 0
+        if toks <= 0:
+            return 0.0
+        rate = inst.latency.prefill_tok_s or 4000.0
+        return 1000.0 * toks / rate
+
+    def select_instance_pair_on_slo(self, num_prompt_tokens: int,
+                                    audit: Optional[Dict[str, Any]] = None
                                     ) -> Tuple[Optional[str], Optional[str],
                                                float]:
-        """Returns (prefill, decode, estimated_ttft_ms)."""
+        """Returns (prefill, decode, estimated_ttft_ms). ``audit``, when
+        given, gains the prefill winner's backlog term so the routing
+        decision stays explainable (attrs.schedule_decision)."""
         with self._lock:
-            # Prefill: argmin of estimated prefill backlog (falling back to
+            # Prefill: argmin of estimated prefill backlog — the
+            # service-side ledger estimate PLUS the worker-advertised
+            # queue converted to ms (falling back to
             # the decode pool when no dedicated prefill instance exists).
-            best_p, best_p_time = None, float("inf")
+            best_p, best_p_time, best_p_backlog = None, float("inf"), 0.0
             for name in (self._prefill_idx or self._decode_idx):
                 if self._is_draining_locked(name):
                     continue
                 inst = self._instances[name]
-                t = inst.req_metrics.estimated_prefill_time_ms
+                backlog = self._backlog_ms(inst)
+                t = inst.req_metrics.estimated_prefill_time_ms + backlog
                 if t < best_p_time:
-                    best_p, best_p_time = name, t
+                    best_p, best_p_time, best_p_backlog = name, t, backlog
+            if audit is not None and best_p is not None:
+                audit["backlog_ms"] = round(best_p_backlog, 3)
+                audit["waiting_prefill_tokens"] = int(getattr(
+                    self._instances[best_p].latency,
+                    "waiting_prefill_tokens", 0) or 0)
 
             # Decode: first instance whose predicted TPOT meets the target,
             # else argmin predicted TPOT.

@@ -133,6 +133,12 @@ class LatencyMetrics:
     # migrations/probes — the cost model's fetch-rate term. 0.0 = never
     # measured (the service falls back to XLLM_KV_FETCH_GBPS).
     kv_gbps: float = 0.0
+    # Prefill backlog at heartbeat time: prompt tokens queued on the
+    # worker but not yet computed. The SLO-aware policy converts this
+    # to milliseconds (via prefill_tok_s) inside its predicted-TTFT
+    # term so prefill queueing can't hide behind a single global queue
+    # (P/D-Serve backlog awareness).
+    waiting_prefill_tokens: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

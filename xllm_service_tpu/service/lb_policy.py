@@ -149,20 +149,27 @@ class SloAwarePolicy(LoadBalancePolicy):
                              prefill={"winner": prefill},
                              decode={"winner": decode})
             return prefill, decode
+        backlog_terms: dict = {}
         prefill, decode, est_ttft = self.mgr.select_instance_pair_on_slo(
-            len(token_ids))
+            len(token_ids), audit=backlog_terms)
         reason = "slo"
         if prefill is None:
             prefill, rr_decode = self.mgr.get_next_instance_pair()
             decode = decode or rr_decode
             reason = "fallback" if prefill else "no_instance"
         if audit is not None:
+            # The winner's heartbeat-advertised prefill backlog rides
+            # the audit (attrs.schedule_decision) so a routing decision
+            # shaped by worker-side queueing is explainable after the
+            # fact, not just the ledger-estimated TTFT.
+            prefill_terms = {"winner": prefill,
+                             "estimated_ttft_ms":
+                                 round(est_ttft, 3)
+                                 if math.isfinite(est_ttft)
+                                 else None}
+            prefill_terms.update(backlog_terms)
             audit.update(policy=self.policy_name, reason=reason,
-                         prefill={"winner": prefill,
-                                  "estimated_ttft_ms":
-                                      round(est_ttft, 3)
-                                      if math.isfinite(est_ttft)
-                                      else None},
+                         prefill=prefill_terms,
                          decode={"winner": decode})
         return prefill, decode
 
