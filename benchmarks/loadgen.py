@@ -29,7 +29,8 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
-from xllm_service_tpu.service.httpd import http_stream, iter_sse_events
+from xllm_service_tpu.service.httpd import (
+    http_json, http_stream_status, iter_sse_events)
 
 
 @dataclasses.dataclass
@@ -41,6 +42,13 @@ class RequestResult:
     num_tokens: int = 0
     offline: bool = False
     error: str = ""
+    # Shed by bounded admission (HTTP 429 + Retry-After): reported
+    # separately from errors — the service refusing load under a cap is
+    # policy, not failure.
+    shed: bool = False
+    # Start offset (s) from the harness epoch; lets --chaos split
+    # results into pre/during/post stages after the fact.
+    started_s: float = 0.0
     # Per-request SLO verdict, stamped by summarize_results: online,
     # completed, and met BOTH the TTFT and TPOT targets.
     slo_ok: bool = False
@@ -91,6 +99,7 @@ def summarize_results(results: List[Optional[RequestResult]],
     a single-token reply has no TPOT and passes on TTFT alone."""
     done = [r for r in results if r is not None]
     ok = [r for r in done if r.ok]
+    shed = [r for r in done if r.shed]
     online = [r for r in ok if not r.offline]
     ttfts = [r.ttft_ms for r in ok]
     tpots = [r.tpot_ms for r in ok if r.tpot_ms > 0]
@@ -104,7 +113,9 @@ def summarize_results(results: List[Optional[RequestResult]],
         "num_requests": (num_requests if num_requests is not None
                          else len(done)),
         "num_ok": len(ok),
-        "num_errors": len(done) - len(ok),
+        "num_shed": len(shed),
+        "shed_rate": round(len(shed) / max(len(done), 1), 4),
+        "num_errors": len(done) - len(ok) - len(shed),
         "wall_s": round(wall_s, 3),
         "req_per_s": round(len(ok) / wall_s, 3) if wall_s > 0 else 0.0,
         "tokens_per_s": round(sum(r.num_tokens for r in ok)
@@ -182,8 +193,18 @@ def run_one(target: str, model: str, prompt_len: int, max_tokens: int,
     first = last = 0.0
     tokens = 0
     try:
-        for payload in iter_sse_events(http_stream(
-                "POST", target, "/v1/completions", body, timeout=timeout)):
+        status, body_iter = http_stream_status(
+            "POST", target, "/v1/completions", body, timeout=timeout)
+        if status != 200:
+            # Eager status lets shed (429 + Retry-After, bounded
+            # admission) be counted apart from real failures.
+            raw = b"".join(body_iter)
+            res.shed = status == 429
+            res.error = ("shed (429)" if res.shed else
+                         f"HTTP {status}: "
+                         f"{raw[:200].decode('utf-8', 'replace')}")
+            return res
+        for payload in iter_sse_events(body_iter):
             if payload == "[DONE]":
                 break
             now = time.monotonic()
@@ -212,13 +233,110 @@ def run_one(target: str, model: str, prompt_len: int, max_tokens: int,
     return res
 
 
+def parse_chaos(spec: str) -> List[tuple]:
+    """Parse a ``--chaos`` schedule: ``name@start+duration[,...]`` —
+    e.g. ``store.partition@10+15`` arms the ``store.partition``
+    failpoint 10 s into the run and disarms it 15 s later. Returns
+    ``(name, start_s, duration_s)`` tuples sorted by start."""
+    stages: List[tuple] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, when = part.partition("@")
+        start_s, _, dur_s = when.partition("+")
+        if not name or not start_s or not dur_s:
+            raise ValueError(
+                f"bad chaos stage {part!r}; want name@start+duration")
+        stages.append((name, float(start_s), float(dur_s)))
+    return sorted(stages, key=lambda s: s[1])
+
+
+def _arm_failpoint(target: str, spec: str) -> None:
+    status, resp = http_json("POST", target, "/admin/failpoint",
+                             {"spec": spec}, timeout=5.0)
+    if status != 200:
+        raise RuntimeError(f"failpoint {spec!r} -> {status}: {resp}")
+
+
+def run_chaos_schedule(target: str, stages: List[tuple], t_start: float,
+                       stop: threading.Event) -> None:
+    """Arm each scheduled failpoint against the live service's admin
+    plane at its start offset, disarm at start+duration. Disarms are
+    best-effort even on abort so a cancelled run can't leave the
+    service blacked out."""
+    for name, start_s, dur_s in stages:
+        if stop.wait(max(0.0, t_start + start_s - time.monotonic())):
+            return
+        try:
+            _arm_failpoint(target, f"{name}=always")
+        except Exception as e:  # noqa: BLE001 — a dead target ends the
+            print(f"chaos: arming {name} failed: {e}")  # schedule only
+            continue
+        try:
+            stop.wait(max(0.0, t_start + start_s + dur_s
+                          - time.monotonic()))
+        finally:
+            try:
+                _arm_failpoint(target, f"{name}=off")
+            except Exception as e:  # noqa: BLE001
+                print(f"chaos: disarming {name} failed: {e}")
+        if stop.is_set():
+            return
+
+
+def chaos_stage_summaries(results: List[Optional[RequestResult]],
+                          chaos: List[tuple], wall_s: float, *,
+                          target_ttft_ms: float,
+                          target_tpot_ms: float) -> dict:
+    """Split results into pre/during/post stages by each request's
+    START offset against the chaos windows, and push every stage
+    through the one shared ``summarize_results`` path so the blackout
+    stage's goodput/shed numbers are computed exactly like the
+    steady-state ones. ``recovery_s`` is the gap between the last
+    window closing and the first post-stage request completing."""
+    windows = [(s, s + d) for _, s, d in chaos]
+    first_start = windows[0][0]
+    last_end = max(e for _, e in windows)
+    pre: List[RequestResult] = []
+    during: List[RequestResult] = []
+    post: List[RequestResult] = []
+    for r in results:
+        if r is None:
+            continue
+        if any(a <= r.started_s < b for a, b in windows):
+            during.append(r)
+        elif r.started_s < first_start:
+            pre.append(r)
+        else:
+            post.append(r)
+
+    def summ(rs: List[RequestResult], span_s: float) -> dict:
+        return summarize_results(list(rs), max(span_s, 1e-9),
+                                 target_ttft_ms=target_ttft_ms,
+                                 target_tpot_ms=target_tpot_ms)
+
+    recoveries = [r.started_s + r.total_ms / 1000.0 - last_end
+                  for r in post if r.ok]
+    return {
+        "schedule": [{"name": n, "start_s": s, "duration_s": d}
+                     for n, s, d in chaos],
+        "pre": summ(pre, first_start),
+        "during": summ(during, sum(d for _, _, d in chaos)),
+        "post": summ(post, max(wall_s - last_end, 1e-9)),
+        "recovery_s": (round(min(recoveries), 3) if recoveries
+                       else None),
+    }
+
+
 def run_load(target: str, model: str, num_requests: int,
              request_rate: float, max_tokens: int,
              offline_fraction: float = 0.0, seed: int = 0,
              timeout: float = 600.0, mean_prompt_len: int = 64,
              target_ttft_ms: float = 1000.0,
              target_tpot_ms: float = 50.0,
-             sharegpt_path: Optional[str] = None) -> dict:
+             sharegpt_path: Optional[str] = None,
+             chaos: Optional[List[tuple]] = None) -> dict:
     if sharegpt_path:
         # Trace replay: real prompts + real per-request output lengths.
         plan = [(None, text, out_len) for text, out_len in
@@ -231,10 +349,20 @@ def run_load(target: str, model: str, num_requests: int,
     results: List[Optional[RequestResult]] = [None] * num_requests
     threads: List[threading.Thread] = []
     t_start = time.monotonic()
+    chaos_stop = threading.Event()
+    chaos_th: Optional[threading.Thread] = None
+    if chaos:
+        chaos_th = threading.Thread(
+            target=run_chaos_schedule,
+            args=(target, chaos, t_start, chaos_stop), daemon=True)
+        chaos_th.start()
 
     def fire(i: int, plen, text, mt: int, off: bool) -> None:
-        results[i] = run_one(target, model, plen or 0, mt, off, timeout,
-                             prompt_text=text)
+        started = time.monotonic() - t_start
+        r = run_one(target, model, plen or 0, mt, off, timeout,
+                    prompt_text=text)
+        r.started_s = started
+        results[i] = r
 
     for i, (plen, text, mt) in enumerate(plan):
         off = rng.random() < offline_fraction
@@ -248,11 +376,19 @@ def run_load(target: str, model: str, num_requests: int,
     for th in threads:
         th.join(timeout=timeout)
     wall = time.monotonic() - t_start
+    if chaos_th is not None:
+        chaos_stop.set()
+        chaos_th.join(timeout=10.0)
 
-    return summarize_results(results, wall,
-                             target_ttft_ms=target_ttft_ms,
-                             target_tpot_ms=target_tpot_ms,
-                             num_requests=num_requests)
+    summary = summarize_results(results, wall,
+                                target_ttft_ms=target_ttft_ms,
+                                target_tpot_ms=target_tpot_ms,
+                                num_requests=num_requests)
+    if chaos:
+        summary["chaos"] = chaos_stage_summaries(
+            results, chaos, wall, target_ttft_ms=target_ttft_ms,
+            target_tpot_ms=target_tpot_ms)
+    return summary
 
 
 def run_closed_loop(target: str, model: str, *,
@@ -346,7 +482,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "is the burst")
     ap.add_argument("--requests-per-stage", type=int, default=8)
     ap.add_argument("--mean-output-len", type=int, default=32)
+    ap.add_argument("--chaos", default="",
+                    help="failpoint schedule armed mid-run against the "
+                         "target's admin plane: 'name@start+duration"
+                         "[,...]', e.g. 'store.partition@10+15' "
+                         "(open-loop only); summary gains per-stage "
+                         "pre/during/post goodput + shed + recovery_s")
     args = ap.parse_args(argv)
+
+    if args.chaos and args.closed_loop:
+        ap.error("--chaos requires the open-loop harness")
 
     if args.closed_loop:
         summary = run_closed_loop(
@@ -364,7 +509,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.seed, mean_prompt_len=args.mean_prompt_len,
             target_ttft_ms=args.target_ttft_ms,
             target_tpot_ms=args.target_tpot_ms,
-            sharegpt_path=args.sharegpt or None)
+            sharegpt_path=args.sharegpt or None,
+            chaos=parse_chaos(args.chaos) if args.chaos else None)
     print(json.dumps(summary))
     return 0
 

@@ -1,0 +1,622 @@
+"""Control-plane blackout tolerance (tier-1 + one slow e2e).
+
+The coordination store is the cluster's one shared dependency; the
+outage contract (docs/ROBUSTNESS.md) says losing it must degrade
+discovery, never serving. These tests drive the contract through the
+closed-catalog ``store.*`` failpoints so a blackout is a deterministic
+event: the guard's health state machine, degraded-mode serving across
+an outage longer than the worker lease TTL (zero ``instance_remove``,
+byte-identical answers), registration queueing until heal, fenced
+master epochs deposing a stale master, and bounded admission shedding.
+The slow twin at the bottom SIGKILLs a real out-of-process store and
+heals against a *wiped* replacement.
+"""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from xllm_service_tpu.config import (
+    EngineConfig, InstanceType, LoadBalancePolicyType, ServiceOptions)
+from xllm_service_tpu.obs import EventLog, Failpoints
+from xllm_service_tpu.runtime.worker import Worker, WorkerOptions
+from xllm_service_tpu.service.coordination import (
+    KEY_MASTER, InMemoryStore, instance_prefix)
+from xllm_service_tpu.service.httpd import http_json
+from xllm_service_tpu.service.master import Master
+from xllm_service_tpu.service.store_guard import (
+    DOWN, FLAKY, HEALTHY, EpochFencedError, StoreGuard, StoreOutageError)
+
+
+def wait_until(cond, timeout=15.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+@pytest.fixture()
+def store():
+    s = InMemoryStore(sweep_interval_s=0.02)
+    yield s
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# Units: the store guard's health state machine, deadline, fence,
+# partition suppression
+# ---------------------------------------------------------------------------
+class TestStoreGuard:
+    def _guard(self, store, **kw):
+        events = EventLog(capacity=64)
+        fp = Failpoints(events=events, env="")
+        return StoreGuard(store, failpoints=fp, events=events), fp, events
+
+    def test_health_state_machine_and_heal_callbacks(self, store):
+        g, fp, events = self._guard(store)
+        healed = []
+        g.on_heal(lambda: healed.append(g.health))
+        assert g.health == HEALTHY
+        fp.arm("store.fail_rpc", mode="always")
+        for i in range(3):
+            with pytest.raises(StoreOutageError):
+                g.get("K")
+            # healthy -> flaky on the first failure, down on the third
+            assert g.health == (FLAKY if i < 2 else DOWN)
+        assert g.is_down
+        types = [e["type"] for e in events.since(0)]
+        assert types.count("store_outage_open") == 1
+        assert "store_outage_close" not in types
+        assert not healed
+        # One success snaps straight back to healthy; the heal callback
+        # ran synchronously (health already HEALTHY when it fired).
+        fp.arm("store.fail_rpc", mode="off")
+        assert g.get("K") is None
+        assert g.health == HEALTHY
+        assert healed == [HEALTHY]
+        types = [e["type"] for e in events.since(0)]
+        assert types.count("store_outage_close") == 1
+
+    def test_flaky_recovers_without_outage_event(self, store):
+        g, fp, events = self._guard(store)
+        fp.arm("store.fail_rpc", mode="count", n=2)
+        for _ in range(2):
+            with pytest.raises(StoreOutageError):
+                g.get("K")
+        assert g.health == FLAKY
+        assert g.get("K") is None
+        assert g.health == HEALTHY
+        assert g.outages_opened == 0
+        assert "store_outage_open" not in [
+            e["type"] for e in events.since(0)]
+
+    def test_deadline_slow_call_degrades_but_returns(self, store):
+        class SlowStore:
+            delay = 0.08
+
+            def get(self, key):
+                time.sleep(self.delay)
+                return "v"
+
+        slow = SlowStore()
+        g, fp, _ = self._guard(slow)
+        g.deadline_s = 0.02
+        # The answer still comes back, but health pays for the latency.
+        for _ in range(g.down_threshold):
+            assert g.get("K") == "v"
+        assert g.is_down
+        slow.delay = 0.0
+        assert g.get("K") == "v"
+        assert g.health == HEALTHY
+
+    def test_hang_failpoint_times_out_against_deadline(self, store):
+        g, fp, _ = self._guard(store)
+        g.deadline_s = 0.2
+        fp.arm("store.hang", mode="always", value=0.05)
+        t0 = time.monotonic()
+        with pytest.raises(StoreOutageError):
+            g.get("K")
+        assert 0.04 <= time.monotonic() - t0 < 2.0
+
+    def test_epoch_fence_rejects_writes_allows_reads(self, store):
+        g, fp, _ = self._guard(store)
+        store.put("K", "old")
+        g.fence_check = lambda: True
+        for op in (lambda: g.put("K", "new"),
+                   lambda: g.delete("K"),
+                   lambda: g.delete_prefix("K"),
+                   lambda: g.compare_create("K2", "x")):
+            with pytest.raises(EpochFencedError):
+                op()
+        # Fenced writes never reached the backend, and reads still work.
+        assert g.get("K") == "old"
+        assert store.get("K2") is None
+        g.fence_check = lambda: False
+        g.put("K", "new")
+        assert store.get("K") == "new"
+
+    def test_partition_suppresses_watch_events(self, store):
+        g, fp, _ = self._guard(store)
+        got = []
+        g.add_watch("P:", got.append)
+        store.put("P:a", "1")
+        assert wait_until(lambda: ("PUT", "P:a", "1") in got, 5.0)
+        fp.arm("store.partition", mode="always")
+        store.put("P:b", "2")
+        time.sleep(0.3)
+        assert not any(e[1] == "P:b" for e in got)
+        assert g.state()["suppressed_watch_events"] >= 1
+        # Calls fail too: a partitioned client is cut off both ways.
+        with pytest.raises(StoreOutageError):
+            g.get("P:a")
+        fp.arm("store.partition", mode="off")
+        store.put("P:c", "3")
+        assert wait_until(lambda: ("PUT", "P:c", "3") in got, 5.0)
+
+
+# ---------------------------------------------------------------------------
+# Cluster harness (test_failpoints.py idiom, blackout-tuned timings)
+# ---------------------------------------------------------------------------
+def small_engine_cfg() -> EngineConfig:
+    return EngineConfig(page_size=16, num_pages=64, max_model_len=256,
+                        max_batch_size=4, max_prefill_tokens=256,
+                        prefill_buckets=(32, 64, 128))
+
+
+def _service_opts(**kw) -> ServiceOptions:
+    base = dict(
+        http_port=0, rpc_port=0, num_output_pools=4,
+        load_balance_policy=LoadBalancePolicyType.ROUND_ROBIN,
+        block_size=16, heartbeat_interval_s=0.2,
+        master_upload_interval_s=0.1,
+        detect_disconnected_instance_interval_s=1.0)
+    base.update(kw)
+    return ServiceOptions(**base)
+
+
+def _worker(store, rpc_addr, lease_ttl=0.8, hb=0.15) -> Worker:
+    wopts = WorkerOptions(
+        port=0, instance_type=InstanceType.DEFAULT,
+        service_addr=rpc_addr, model="tiny",
+        heartbeat_interval_s=hb, lease_ttl_s=lease_ttl)
+    return Worker(wopts, store, engine_cfg=small_engine_cfg())
+
+
+def make_cluster(store, lease_ttl=0.8, hb=0.15):
+    master = Master(_service_opts(), store=store).start()
+    w = _worker(store, master.rpc_address, lease_ttl, hb).start()
+    assert wait_until(
+        lambda: len(master.scheduler.instance_mgr.prefill_instances())
+        == 1, timeout=20.0), "worker never registered"
+    return master, w
+
+
+PROMPT = "blackout survivor "
+
+
+def _complete(http_addr, max_tokens=8, model="tiny", timeout=60.0):
+    status, resp = http_json(
+        "POST", http_addr, "/v1/completions",
+        {"model": model, "prompt": PROMPT, "max_tokens": max_tokens,
+         "temperature": 0.0, "ignore_eos": True}, timeout=timeout)
+    return status, resp
+
+
+def _scrape(http_addr):
+    host, _, port = http_addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    conn.close()
+    return text
+
+
+def _events(http_addr):
+    status, resp = http_json("GET", http_addr, "/admin/events?limit=512",
+                             timeout=30.0)
+    assert status == 200
+    return [e["type"] for e in resp["events"]], resp["events"]
+
+
+# ---------------------------------------------------------------------------
+# Degraded-mode serving: outage shorter AND longer than the lease TTL
+# ---------------------------------------------------------------------------
+def test_blackout_shorter_and_longer_than_lease_ttl(store):
+    lease_ttl = 0.8
+    master, w = make_cluster(store, lease_ttl=lease_ttl)
+    reg_prefix = instance_prefix(InstanceType.DEFAULT.value)
+    try:
+        status, base = _complete(master.http_address)
+        assert status == 200
+        base_text = base["choices"][0]["text"]
+
+        # -- Phase 1: outage SHORTER than the lease TTL (worker plane
+        # only). Two failed keepalives at 0.15s cadence stay under the
+        # 0.8s TTL and under the down threshold: the lease survives,
+        # no outage opens, nothing is re-established.
+        lease_before = w._lease_id
+        w.failpoints.arm("store.fail_rpc", mode="count", n=2)
+        assert wait_until(
+            lambda: w.failpoints.trips("store.fail_rpc") == 2, 10.0)
+        assert wait_until(lambda: w.store.health == HEALTHY, 10.0)
+        assert w.store.outages_opened == 0
+        assert w._lease_id == lease_before
+        assert reg_prefix + w.name in store.get_prefix(reg_prefix)
+        assert not master.scheduler.degraded
+
+        # -- Phase 2: full blackout (both planes partitioned) LONGER
+        # than 3x the worker lease TTL but shorter than the master's
+        # 3.0s election-lease floor.
+        t0 = time.monotonic()
+        master.failpoints.arm("store.partition", mode="always")
+        w.failpoints.arm("store.partition", mode="always")
+        assert wait_until(lambda: master.scheduler.degraded, 10.0)
+        assert wait_until(lambda: w.store.is_down, 10.0)
+        # The worker's lease really expires in the raw store...
+        assert wait_until(
+            lambda: reg_prefix + w.name not in store.get_prefix(reg_prefix),
+            10.0)
+        # ...but the lease-expiry DELETE never reaches the partitioned
+        # master: the last-known-good instance table stays frozen.
+        assert len(master.scheduler.instance_mgr.prefill_instances()) == 1
+        remaining = (t0 + 3 * lease_ttl) - time.monotonic()
+        if remaining > 0:
+            time.sleep(remaining)
+        # Mid-blackout serving: same request, byte-identical answer.
+        status, resp = _complete(master.http_address)
+        assert status == 200
+        assert resp["choices"][0]["text"] == base_text
+        metrics = _scrape(master.http_address)
+        assert "xllm_store_health 0" in metrics
+        assert "xllm_service_degraded 1" in metrics
+        types, _ = _events(master.http_address)
+        assert "store_outage_open" in types
+        assert "instance_remove" not in types
+        assert master.scheduler.is_master  # 3*0.8s < 3.0s election TTL
+
+        # -- Heal: both planes reconnect; the worker re-establishes its
+        # lease + registration idempotently, the master resyncs.
+        master.failpoints.arm("store.partition", mode="off")
+        w.failpoints.arm("store.partition", mode="off")
+        assert wait_until(lambda: not master.scheduler.degraded, 10.0)
+        assert wait_until(lambda: w.store.health == HEALTHY, 10.0)
+        assert wait_until(
+            lambda: reg_prefix + w.name in store.get_prefix(reg_prefix),
+            10.0)
+        assert wait_until(
+            lambda: "store_outage_close"
+            in _events(master.http_address)[0], 10.0)
+        types, _ = _events(master.http_address)
+        assert "instance_remove" not in types
+        assert len(master.scheduler.instance_mgr.prefill_instances()) == 1
+        metrics = _scrape(master.http_address)
+        assert "xllm_store_health 2" in metrics
+        assert "xllm_service_degraded 0" in metrics
+        status, resp = _complete(master.http_address)
+        assert status == 200
+        assert resp["choices"][0]["text"] == base_text
+    finally:
+        w.stop()
+        master.stop()
+
+
+# ---------------------------------------------------------------------------
+# Registration queues until heal (boot during an outage)
+# ---------------------------------------------------------------------------
+def test_registration_queues_until_store_heals(store):
+    master = Master(_service_opts(), store=store).start()
+    w = _worker(store, master.rpc_address)
+    reg_prefix = instance_prefix(InstanceType.DEFAULT.value)
+    try:
+        w.failpoints.arm("store.fail_rpc", mode="always")
+        booted = threading.Event()
+        th = threading.Thread(
+            target=lambda: (w.start(), booted.set()), daemon=True)
+        th.start()
+        assert wait_until(lambda: w.store.is_down, 10.0)
+        time.sleep(0.3)
+        # Queued, not crashed: no registration landed, boot not done.
+        assert reg_prefix + w.name not in store.get_prefix(reg_prefix)
+        assert not booted.is_set()
+        w.failpoints.arm("store.fail_rpc", mode="off")
+        assert wait_until(booted.is_set, 15.0)
+        assert wait_until(
+            lambda: reg_prefix + w.name in store.get_prefix(reg_prefix),
+            10.0)
+        assert wait_until(
+            lambda: len(
+                master.scheduler.instance_mgr.prefill_instances()) == 1,
+            15.0)
+        status, _ = _complete(master.http_address)
+        assert status == 200
+        th.join(10.0)
+    finally:
+        w.stop()
+        master.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fenced master epochs: a deposed master's acks are rejected and it
+# self-demotes on heal
+# ---------------------------------------------------------------------------
+def test_deposed_master_is_fenced_and_self_demotes(store):
+    master_a = Master(_service_opts(), store=store).start()
+    master_b = Master(_service_opts(), store=store).start()
+    w = None
+    try:
+        assert wait_until(lambda: master_a.scheduler.is_master, 10.0)
+        assert not master_b.scheduler.is_master
+        epoch_a = master_a.scheduler.current_epoch()
+        assert epoch_a >= 1
+
+        w = _worker(store, master_a.rpc_address).start()
+        assert wait_until(
+            lambda: len(
+                master_a.scheduler.instance_mgr.prefill_instances())
+            == 1, 20.0)
+        assert wait_until(lambda: w._master_epoch == epoch_a, 10.0)
+
+        # Black out A's store plane, then expire its election key the
+        # way a real lease expiry would (A can't keep it alive and
+        # can't see the DELETE — it still believes it is master).
+        master_a.failpoints.arm("store.partition", mode="always")
+        assert wait_until(lambda: master_a.scheduler.degraded, 10.0)
+        store.delete(KEY_MASTER)
+        assert wait_until(lambda: master_b.scheduler.is_master, 15.0)
+        epoch_b = master_b.scheduler.current_epoch()
+        assert epoch_b > epoch_a
+        assert master_a.scheduler.is_master  # split brain, by design
+
+        # The worker follows the new advertisement and the new epoch.
+        assert wait_until(
+            lambda: w.service_addr == master_b.rpc_address, 15.0)
+        assert wait_until(lambda: w._master_epoch == epoch_b, 15.0)
+        assert wait_until(
+            lambda: len(
+                master_b.scheduler.instance_mgr.prefill_instances())
+            == 1, 15.0)
+
+        # The deposed master still answers with its stale epoch at the
+        # wire level...
+        status, cfg = http_json("GET", master_a.rpc_address,
+                                "/rpc/config", timeout=10.0)
+        assert status == 200
+        assert cfg["epoch"] == epoch_a
+        # ...and the worker REJECTS its beat-ack instead of regressing.
+        assert w._retarget({"rpc": master_a.rpc_address,
+                            "service_id": "test"})
+        assert w._send_heartbeat() is False
+        assert w._master_epoch == epoch_b
+        assert w._retarget({"rpc": master_b.rpc_address,
+                            "service_id": "test"})
+
+        # Heal A: the guard's heal callback reads the cluster epoch,
+        # sees it is behind, and demotes BEFORE any stale write lands.
+        master_a.failpoints.arm("store.partition", mode="off")
+        assert wait_until(
+            lambda: not master_a.scheduler.is_master, 15.0)
+        types, _ = _events(master_a.http_address)
+        assert "master_demoted" in types
+        assert master_b.scheduler.is_master
+        # A's acks now carry the cluster epoch it follows.
+        assert wait_until(
+            lambda: master_a.scheduler.current_epoch() == epoch_b, 10.0)
+        status, _ = _complete(master_b.http_address)
+        assert status == 200
+    finally:
+        if w is not None:
+            w.stop()
+        master_b.stop()
+        master_a.stop()
+
+
+# ---------------------------------------------------------------------------
+# Bounded admission: 429 + Retry-After at the in-flight cap
+# ---------------------------------------------------------------------------
+def _raw_post_completion(http_addr, model="tiny"):
+    host, _, port = http_addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    body = json.dumps({"model": model, "prompt": PROMPT,
+                       "max_tokens": 4, "temperature": 0.0,
+                       "ignore_eos": True}).encode()
+    conn.request("POST", "/v1/completions", body,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = (resp.status, dict(resp.getheaders()), resp.read())
+    conn.close()
+    return out
+
+
+def test_bounded_admission_sheds_with_429(store, monkeypatch):
+    monkeypatch.setenv("XLLM_MAX_INFLIGHT", "1")
+    master, w = make_cluster(store)
+    try:
+        assert master.http_service.max_inflight == 1
+        # Hold the only slot: the worker sleeps before generating, so
+        # the occupying request stays tracked for a deterministic
+        # window.
+        w.failpoints.arm("worker.slow_response_ms", mode="always",
+                         value=1200.0)
+        occ = {}
+        th = threading.Thread(
+            target=lambda: occ.update(
+                dict(zip(("status", "resp"),
+                         _complete(master.http_address, max_tokens=4)))),
+            daemon=True)
+        th.start()
+        assert wait_until(
+            lambda: master.scheduler.num_tracked_requests() >= 1, 10.0)
+
+        status, headers, raw = _raw_post_completion(master.http_address)
+        assert status == 429
+        assert headers.get("Retry-After") == "1"
+        assert json.loads(raw)["error"]["type"] == "overloaded_error"
+
+        # The load harness classifies the same refusal as shed.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks"))
+        try:
+            import loadgen
+        finally:
+            sys.path.pop(0)
+        res = loadgen.run_one(master.http_address, "tiny", 16, 4,
+                              offline=False, timeout=30.0)
+        assert res.shed and not res.ok
+        summary = loadgen.summarize_results(
+            [res], wall_s=1.0, target_ttft_ms=1000, target_tpot_ms=1000)
+        assert summary["num_shed"] == 1 and summary["shed_rate"] == 1.0
+
+        metrics = _scrape(master.http_address)
+        assert 'xllm_requests_shed_total{reason="inflight"}' in metrics
+
+        th.join(30.0)
+        assert occ.get("status") == 200  # the occupant was never shed
+
+        # Per-model cap uses its own reason label.
+        master.http_service.max_inflight = 0
+        master.http_service.max_inflight_per_model = 1
+        th2 = threading.Thread(
+            target=lambda: _complete(master.http_address, max_tokens=4),
+            daemon=True)
+        th2.start()
+        assert wait_until(
+            lambda: master.scheduler.num_tracked_requests("tiny") >= 1,
+            10.0)
+        status, headers, raw = _raw_post_completion(master.http_address)
+        assert status == 429
+        metrics = _scrape(master.http_address)
+        assert 'xllm_requests_shed_total{reason="model_inflight"}' \
+            in metrics
+        th2.join(30.0)
+
+        # Admission recovers once the population drains.
+        w.failpoints.arm("worker.slow_response_ms", mode="off")
+        assert wait_until(
+            lambda: master.scheduler.num_tracked_requests() == 0, 15.0)
+        status, _ = _complete(master.http_address)
+        assert status == 200
+    finally:
+        w.stop()
+        master.stop()
+
+
+# ---------------------------------------------------------------------------
+# Slow twin: SIGKILL a real out-of-process store, heal against a wiped
+# replacement on the same port
+# ---------------------------------------------------------------------------
+pytestmark_slow = pytest.mark.skipif(
+    os.environ.get("XLLM_SKIP_SLOW") == "1",
+    reason="XLLM_SKIP_SLOW=1")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_store(port: int) -> subprocess.Popen:
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "xllm_service_tpu.service.coordination_net", "--port",
+         str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    line = proc.stdout.readline()
+    assert "coordination store serving on" in line, line
+    return proc
+
+
+@pytest.mark.slow
+@pytestmark_slow
+def test_store_sigkill_blackout_and_wiped_restart():
+    from xllm_service_tpu.service.coordination_net import connect_store
+
+    port = _free_port()
+    store_proc = _spawn_store(port)
+    addr = f"127.0.0.1:{port}"
+    lease_ttl = 0.6
+    master = Master(_service_opts(), store=connect_store(addr)).start()
+    w = _worker(connect_store(addr), master.rpc_address,
+                lease_ttl=lease_ttl).start()
+    probe = connect_store(addr)  # raw client for assertions
+    reg_prefix = instance_prefix(InstanceType.DEFAULT.value)
+    try:
+        assert wait_until(
+            lambda: len(
+                master.scheduler.instance_mgr.prefill_instances()) == 1,
+            30.0)
+        status, base = _complete(master.http_address, max_tokens=24)
+        assert status == 200
+        base_text = base["choices"][0]["text"]
+
+        # Open a stream, then SIGKILL the store mid-flight.
+        stream = {}
+        th = threading.Thread(
+            target=lambda: stream.update(
+                dict(zip(("status", "resp"),
+                         _complete(master.http_address,
+                                   max_tokens=24, timeout=120.0)))),
+            daemon=True)
+        th.start()
+        time.sleep(0.05)
+        store_proc.send_signal(signal.SIGKILL)
+        store_proc.wait(10)
+        t_kill = time.monotonic()
+
+        # The open request completes byte-identical during the outage.
+        th.join(60.0)
+        assert stream.get("status") == 200
+        assert stream["resp"]["choices"][0]["text"] == base_text
+
+        assert wait_until(lambda: master.scheduler.degraded, 20.0)
+        remaining = (t_kill + 3 * lease_ttl) - time.monotonic()
+        if remaining > 0:
+            time.sleep(remaining)
+        # New requests serve degraded; the frozen book kept the worker.
+        assert len(master.scheduler.instance_mgr.prefill_instances()) == 1
+        status, resp = _complete(master.http_address, max_tokens=24)
+        assert status == 200
+        assert resp["choices"][0]["text"] == base_text
+        types, _ = _events(master.http_address)
+        assert "store_outage_open" in types
+        assert "instance_remove" not in types
+
+        # Restart the store on the SAME port — fresh and EMPTY: every
+        # lease, registration, and the election key are gone.
+        store_proc = _spawn_store(port)
+        assert wait_until(lambda: not master.scheduler.degraded, 30.0)
+        # Re-established from scratch: master re-elected itself, the
+        # worker re-registered, and serving continues.
+        assert wait_until(lambda: master.scheduler.is_master, 30.0)
+        assert wait_until(
+            lambda: reg_prefix + w.name in probe.get_prefix(reg_prefix),
+            30.0)
+        assert wait_until(
+            lambda: probe.get(KEY_MASTER) is not None, 30.0)
+        status, resp = _complete(master.http_address, max_tokens=24)
+        assert status == 200
+        assert resp["choices"][0]["text"] == base_text
+        types, _ = _events(master.http_address)
+        assert "store_outage_close" in types
+        assert "instance_remove" not in types
+    finally:
+        w.stop()
+        master.stop()
+        probe.close()
+        if store_proc.poll() is None:
+            store_proc.kill()
+            store_proc.wait(10)

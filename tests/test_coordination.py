@@ -299,3 +299,130 @@ class TestNativeEtcdServer:
             assert int(canceled["compact_revision"]) > 0
         finally:
             server.stop()
+
+
+class TestWatchCompaction:
+    """The compaction-recovery contract on every watch transport: a
+    watcher that reconnects OLDER than ``oldest_retained_revision``
+    must fall back to a full prefix re-bootstrap (state diff:
+    synthetic DELETEs for vanished keys, PUTs for new/changed) instead
+    of silently missing deletes or looping forever on a dead resume
+    revision."""
+
+    def test_store_server_flags_compacted_resume(self):
+        srv = StoreServer().start()
+        try:
+            srv.store._max_events = 4
+            for i in range(12):   # trim the bounded log past rev 1
+                srv.store.put(f"K:{i}", str(i))
+            from xllm_service_tpu.service.httpd import http_json
+            status, resp = http_json(
+                "GET", srv.address, "/watch?prefix=K:&rev=0&timeout=0.1",
+                timeout=5.0)
+            assert status == 200
+            assert resp["compacted"] is True
+            # A current-revision resume is NOT compacted.
+            status, resp2 = http_json(
+                "GET", srv.address,
+                f"/watch?prefix=K:&rev={resp['rev']}&timeout=0.1",
+                timeout=5.0)
+            assert status == 200
+            assert resp2["compacted"] is False
+        finally:
+            srv.stop()
+
+    def test_remote_resync_delivers_state_diff(self):
+        srv = StoreServer().start()
+        rs = RemoteStore(srv.address)
+        try:
+            srv.store.put("K:same", "1")
+            srv.store.put("K:changed", "new")
+            srv.store.put("K:added", "3")
+            # The watcher's stale view: saw K:gone (now deleted),
+            # K:changed at an old value, K:same at the current one.
+            known = {"K:gone": "x", "K:changed": "old", "K:same": "1"}
+            got = []
+            rs._resync("K:", known, got.append, threading.Event())
+            assert ("DELETE", "K:gone", None) in got
+            assert ("PUT", "K:changed", "new") in got
+            assert ("PUT", "K:added", "3") in got
+            assert all(ev[1] != "K:same" for ev in got)
+            assert known == {"K:same": "1", "K:changed": "new",
+                             "K:added": "3"}
+        finally:
+            rs.close()
+            srv.stop()
+
+    def test_remote_watch_falls_behind_and_rebootstraps(self):
+        """End to end on the long-poll transport: hold the watch loop
+        hostage in a slow callback while the bounded event log trims
+        past its resume revision; on release the loop must hit the
+        server's ``compacted`` flag and converge via re-bootstrap —
+        including the DELETE it never saw as an event."""
+        srv = StoreServer().start()
+        rs = RemoteStore(srv.address)
+        delivered = {}
+        seen = []
+        first = threading.Event()
+        gate = threading.Event()
+
+        def cb(ev):
+            t, k, v = ev
+            seen.append(ev)
+            if t == "DELETE":
+                delivered.pop(k, None)
+            else:
+                delivered[k] = v
+            if not first.is_set():
+                first.set()
+                gate.wait(20.0)
+
+        try:
+            srv.store._max_events = 4
+            rs.add_watch("K:", cb)
+            time.sleep(0.2)   # watch loop bootstraps its revision
+            srv.store.put("K:a", "1")
+            assert first.wait(5.0), "first event never delivered"
+            # While the loop is hostage: delete the delivered key and
+            # blow the bounded log well past the loop's resume point.
+            srv.store.delete("K:a")
+            for i in range(12):
+                srv.store.put(f"K:b{i}", str(i))
+            gate.set()
+            deadline = time.monotonic() + 10.0
+            want = srv.store.get_prefix("K:")
+            while time.monotonic() < deadline and delivered != want:
+                time.sleep(0.05)
+            assert delivered == want
+            # The missed delete arrived as a SYNTHETIC event.
+            assert ("DELETE", "K:a", None) in seen
+        finally:
+            gate.set()
+            rs.close()
+            srv.stop()
+
+    def test_etcd_resync_delivers_state_diff(self):
+        """Same diff contract on the etcd reconnect path (the
+        ``canceled + compact_revision`` answer the server-side test
+        above pins routes into ``EtcdStore._resync``)."""
+        from xllm_service_tpu.service.etcd_native import (
+            NativeEtcdServer, build_binary)
+        from xllm_service_tpu.service.etcd_store import EtcdStore
+        if build_binary() is None:
+            pytest.skip("no C++ toolchain for xllm_etcd")
+        server = NativeEtcdServer().start()
+        client = EtcdStore(server.address)
+        try:
+            client.put("R:same", "1")
+            client.put("R:changed", "new")
+            client.put("R:added", "3")
+            known = {"R:gone": "x", "R:changed": "old", "R:same": "1"}
+            got = []
+            client._resync("R:", known, got.append)
+            assert ("DELETE", "R:gone", None) in got
+            assert ("PUT", "R:changed", "new") in got
+            assert ("PUT", "R:added", "3") in got
+            assert all(ev[1] != "R:same" for ev in got)
+        finally:
+            client.close()
+            server.stop()

@@ -123,6 +123,58 @@ def test_sharegpt_replay(tmp_path):
         store.close()
 
 
+def test_parse_chaos_schedule():
+    from benchmarks.loadgen import parse_chaos
+    assert parse_chaos("store.partition@10+15, store.fail_rpc@40+5") == [
+        ("store.partition", 10.0, 15.0), ("store.fail_rpc", 40.0, 5.0)]
+    # Sorted by start regardless of spec order.
+    assert [s[0] for s in parse_chaos("b@20+1,a@5+2")] == ["a", "b"]
+    for bad in ("store.partition", "x@10", "x@10+", "x@+5", "@1+2"):
+        with pytest.raises(ValueError):
+            parse_chaos(bad)
+
+
+def test_summarize_counts_shed_separately():
+    from benchmarks.loadgen import RequestResult, summarize_results
+    done = [
+        RequestResult(ok=True, ttft_ms=10, tpot_ms=1, total_ms=20,
+                      num_tokens=4),
+        RequestResult(ok=False, shed=True, error="shed (429)"),
+        RequestResult(ok=False, error="HTTP 500: boom"),
+    ]
+    s = summarize_results(done, wall_s=1.0, target_ttft_ms=1000,
+                          target_tpot_ms=1000)
+    assert s["num_ok"] == 1
+    assert s["num_shed"] == 1
+    assert s["num_errors"] == 1          # shed is policy, not failure
+    assert s["shed_rate"] == pytest.approx(1 / 3, abs=1e-3)
+
+
+def test_chaos_stage_summaries_split_and_recovery():
+    from benchmarks.loadgen import RequestResult, chaos_stage_summaries
+
+    def r(started_s, ok=True, shed=False, total_ms=100.0):
+        return RequestResult(ok=ok, shed=shed, ttft_ms=10.0,
+                             tpot_ms=1.0, total_ms=total_ms,
+                             num_tokens=4, started_s=started_s)
+
+    chaos = [("store.partition", 2.0, 3.0)]   # window [2, 5)
+    results = [r(0.5), r(1.0),                # pre
+               r(2.5), r(4.0, ok=False, shed=True),   # during
+               r(5.5), None]                  # post (+ a skipped slot)
+    out = chaos_stage_summaries(results, chaos, wall_s=8.0,
+                                target_ttft_ms=1000,
+                                target_tpot_ms=1000)
+    assert out["pre"]["num_ok"] == 2
+    assert out["during"]["num_ok"] == 1
+    assert out["during"]["num_shed"] == 1
+    assert out["post"]["num_ok"] == 1
+    # First post completion at 5.5 + 0.1s, window closed at 5.0.
+    assert out["recovery_s"] == pytest.approx(0.6, abs=1e-3)
+    assert out["schedule"] == [
+        {"name": "store.partition", "start_s": 2.0, "duration_s": 3.0}]
+
+
 def test_service_bench_smoke():
     """The service-layer benchmark (fake instant workers, no model) runs
     end to end and reports sane numbers."""

@@ -363,6 +363,7 @@ class DonationCoverageRule:
 # both (that is the point: the table is reviewed, not accreted).
 LOCK_RANK_TABLE: Dict[str, int] = {
     "worker.hb": 5,
+    "worker.reg": 8,
     "scheduler.req": 10,
     "worker.live": 10,
     "worker.engine": 20,
@@ -372,10 +373,12 @@ LOCK_RANK_TABLE: Dict[str, int] = {
     "kvcache_mgr": 35,
     "coordination_net": 60,
     "etcd.watches": 60,
+    "store_guard": 74,
     "obs.failpoints": 75,
     "obs.slo": 78,
     "obs.watchdog": 79,
     "obs.events": 80,
+    "scheduler.elect": 88,
     "worker.addr": 89,
     "tracer": 90,
     "misc.pool": 90,

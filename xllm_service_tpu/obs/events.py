@@ -58,6 +58,14 @@ EVENT_TYPES = (
     "thread_crashed",       # an uncaught exception escaped a supervised
                             # thread root (utils/threads.py spawn);
                             # attrs say whether it restarted
+    "store_outage_open",    # the coordination-store guard declared the
+                            # store down (service/store_guard.py);
+                            # degraded-mode serving begins
+    "store_outage_close",   # the store healed; leases/registrations
+                            # re-establish and the books resync
+    "master_demoted",       # this replica stopped being master: a
+                            # higher-epoch master exists (fenced
+                            # split-brain) or re-election was lost
 )
 
 DEFAULT_CAPACITY = 1024

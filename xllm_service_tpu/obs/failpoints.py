@@ -68,6 +68,17 @@ FAILPOINTS = (
                                  # try — an injected thread crash, for
                                  # proving the supervised restart path
                                  # (utils/threads.py, docs/ROBUSTNESS.md)
+    "store.fail_rpc",            # every coordination-store call raises
+                                 # (service/store_guard.py — one-plane
+                                 # store outage, deterministic)
+    "store.hang",                # a store call blocks for the armed
+                                 # value (s) then times out — the
+                                 # deadline'd-guard slow-outage shape
+    "store.partition",           # store calls raise AND incoming watch
+                                 # events are suppressed — a full
+                                 # network partition from the store
+                                 # (lease expiry invisible, exactly like
+                                 # a real blackout)
 )
 
 _MODES = ("always", "count", "after", "prob", "off")

@@ -50,6 +50,8 @@ from xllm_service_tpu.obs.expfmt import quantile_from_buckets
 from xllm_service_tpu.runtime.engine import Engine, EngineRequest, StepOutput
 from xllm_service_tpu.service.coordination import (
     KEY_MASTER_ADDR, CoordinationStore, instance_prefix)
+from xllm_service_tpu.service.store_guard import (
+    StoreGuard, StoreOutageError)
 from xllm_service_tpu.service.httpd import (
     HttpServer, Request, Response, Router, http_json)
 from xllm_service_tpu.service.instance_types import (
@@ -498,6 +500,16 @@ class Worker:
         # workers; armed via XLLM_FAILPOINTS and POST /admin/failpoint.
         # Trips surface as xllm_failpoints_tripped_total{name}.
         self.failpoints = Failpoints(obs=self.obs)
+        # Store guard (service/store_guard.py): this worker's own view
+        # of coordination-store health, wired to ITS failpoints so the
+        # co-located harness blacks out one plane without touching its
+        # twin. On heal the worker idempotently re-establishes lease +
+        # registration instead of self-fencing over a store-only outage.
+        if not isinstance(self.store, StoreGuard):
+            self.store = StoreGuard(self.store,
+                                    failpoints=self.failpoints,
+                                    events=self.events)
+        self.store.on_heal(self._on_store_heal)
         # Simulated death (worker.die_after_n_tokens): refuses work,
         # drops liveness, breaks streams — but the process survives.
         self._dead = False
@@ -522,6 +534,12 @@ class Worker:
         # Undelivered heartbeat cache delta (KvCacheEvent), retried on
         # the next beat. Touched only under _hb_lock.
         self._hb_cache_pending = None           # guarded-by: worker.hb
+        # Highest master epoch this worker has acked (fenced elections,
+        # docs/ROBUSTNESS.md): a beat-ack carrying a LOWER epoch comes
+        # from a deposed master and is rejected like a failed beat, so
+        # the backoff + advertised-address re-read retarget us to the
+        # real master. Touched only under _hb_lock.
+        self._master_epoch = 0                  # guarded-by: worker.hb
         # Last-shipped cumulative step_ms bucket counts per
         # (model, phase): the heartbeat diffs against these so
         # LatencyMetrics.step_ms_p99 is the p99 of the steps since the
@@ -665,7 +683,18 @@ class Worker:
             thread_name=f"worker-hb-{self.name}",
             restart=threads.RESTART_POLICY,
             events=self.events, stop=self._stop)
-        self._lease_id: Optional[int] = None
+        # Registration plane: one lock serializes every revoke→grant→put
+        # re-registration (boot retry, hb-loop lease re-establishment,
+        # role flip) so racing registrars can't interleave lease grants
+        # and leak one.
+        self._reg_mu = make_lock("worker.reg", 8)
+        self._lease_id: Optional[int] = None  # guarded-by: worker.reg
+        # Set by the store-guard heal callback; the hb loop performs
+        # the actual re-registration. A heal callback must never call
+        # _register itself: its own lease_revoke/lease_grant may be the
+        # very call that healed the guard, and re-entering _register
+        # under worker.reg would deadlock.
+        self._heal_pending = threading.Event()
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -716,18 +745,34 @@ class Worker:
             self._warmup_all()
         # Registration writes through the coordination store — retry a
         # boot-time store hiccup with capped, jittered backoff instead
-        # of crashing the (already warmed) worker on one bad RPC.
-        for attempt in range(self._reg_retry.max_attempts):
+        # of crashing the (already warmed) worker on one bad RPC. A
+        # store OUTAGE (guard-classed) is not a hiccup: the registration
+        # queues until the store heals (docs/ROBUSTNESS.md outage
+        # contract) — outage waits don't burn the finite retry budget.
+        attempt = 0
+        outage_waits = 0
+        while not self._stop.is_set():
             try:
                 self._register()
                 break
+            except StoreOutageError as e:
+                outage_waits += 1
+                if outage_waits == 1 or outage_waits % 10 == 0:
+                    logger.warning("store outage at boot (%s); "
+                                   "registration queued until heal", e)
+                self._reg_retry.sleep(min(outage_waits - 1, 4),
+                                      stop_event=self._stop)
             except Exception as e:  # noqa: BLE001 — transient store error
-                if attempt + 1 >= self._reg_retry.max_attempts \
+                attempt += 1
+                if attempt >= self._reg_retry.max_attempts \
                         or self._stop.is_set():
                     raise
                 logger.warning("registration attempt %d failed (%s); "
-                               "backing off", attempt + 1, e)
-                self._reg_retry.sleep(attempt, stop_event=self._stop)
+                               "backing off", attempt, e)
+                self._reg_retry.sleep(attempt - 1, stop_event=self._stop)
+        # A heal that fired during the boot retry loop is satisfied by
+        # the successful registration above.
+        self._heal_pending.clear()
         # Failover-follow is only for workers CONFIGURED with a service in
         # front: a deliberately standalone worker sharing the store must
         # not silently adopt the advertised master and start taking
@@ -919,18 +964,32 @@ class Worker:
             kv_block_bytes=eng.kv_block_bytes() if eng is not None
             else 0,
         )
-        if self._lease_id is not None:
-            # Re-registration (role flip): the old lease must die with the
-            # old key or every flip leaks a live lease in the store.
-            try:
-                self.store.lease_revoke(self._lease_id)
-            except Exception:  # noqa: BLE001 — best-effort: the old
-                pass            # lease's TTL expires it anyway
-            self._lease_id = None
-        self._lease_id = self.store.lease_grant(self.opts.lease_ttl_s)
-        self.store.put_json(
-            instance_prefix(self.instance_type.value) + self.name,
-            stamp(meta.to_json()), self._lease_id)
+        with self._reg_mu:
+            if self._lease_id is not None:
+                # Re-registration (role flip): the old lease must die with
+                # the old key or every flip leaks a live lease in the store.
+                try:
+                    self.store.lease_revoke(self._lease_id)
+                except Exception:  # noqa: BLE001 — best-effort: the old
+                    pass            # lease's TTL expires it anyway
+                self._lease_id = None
+            self._lease_id = self.store.lease_grant(self.opts.lease_ttl_s)
+            self.store.put_json(
+                instance_prefix(self.instance_type.value) + self.name,
+                stamp(meta.to_json()), self._lease_id)
+
+    def _on_store_heal(self) -> None:
+        """Store-guard heal callback: the blackout ended — flag the hb
+        loop to re-establish lease + registration idempotently (the
+        lease almost certainly expired while the store was unreachable)
+        and re-read the master advertisement we may have missed. The
+        callback itself only sets the flag: it runs on whichever
+        thread's store call healed the guard — possibly inside
+        ``_register`` itself — so calling ``_register`` here would
+        re-enter worker.reg and deadlock."""
+        if self._stop.is_set() or self._dead:
+            return
+        self._heal_pending.set()
 
     def primary_runtime(self) -> ModelRuntime:
         return self.runtimes[self.opts.model]
@@ -1781,6 +1840,13 @@ class Worker:
         # fan-in transport.
         from xllm_service_tpu.service.httpd import flush_conn_pool_metrics
         flush_conn_pool_metrics(obs, plane="worker")
+        # This plane's view of the coordination store (store guard) —
+        # the worker twin of the service-plane gauge; raw in-memory
+        # stores report healthy.
+        obs.gauge("xllm_store_health",
+                  "coordination-store health as seen by this plane "
+                  "(2 healthy / 1 flaky / 0 down)").set(
+            int(getattr(self.store, "health", 2)))
         obs.counter("xllm_worker_encode_seconds_total").set_total(
             self.encode_seconds)
         obs.counter("xllm_worker_encode_calls_total").set_total(
@@ -3254,8 +3320,52 @@ class Worker:
                     # master beat — the lease expires exactly as if the
                     # process were gone.
                     continue
-                if self._lease_id is not None:
-                    self.store.lease_keepalive(self._lease_id)
+                # Store heal (guard callback set the flag): re-register
+                # BEFORE the keepalive check so the keepalive below
+                # runs against the fresh lease instead of double-
+                # registering off its own False.
+                if self._heal_pending.is_set():
+                    self._heal_pending.clear()
+                    try:
+                        self._register()
+                        logger.info("store healed: lease + registration "
+                                    "re-established for %s", self.name)
+                    except Exception as e:  # noqa: BLE001 — store
+                        # flapping; retry next tick
+                        self._heal_pending.set()
+                        logger.warning("post-heal re-registration "
+                                       "failed: %s", e)
+                    else:
+                        if self.opts.service_addr:
+                            self._adopt_advertised_addr()
+                # Keepalive isolated from beat accounting: a store
+                # EXCEPTION is a store outage — the worker keeps
+                # serving and keeps beating the master directly (the
+                # degraded-mode liveness signal) instead of
+                # self-fencing; the guard re-registers us on heal. A
+                # clean False means the store is reachable and the
+                # lease is dead (it expired during an outage shorter
+                # than detection): re-establish it NOW, idempotently.
+                lease_id = self._lease_id
+                if lease_id is not None:
+                    try:
+                        lease_alive = self.store.lease_keepalive(lease_id)
+                    except StoreOutageError as e:
+                        logger.debug("store keepalive unreachable "
+                                     "(outage?): %s", e)
+                        lease_alive = True   # frozen — not a beat failure
+                    if not lease_alive and lease_id == self._lease_id:
+                        try:
+                            self._register()
+                            logger.warning(
+                                "lease %d expired under a live worker; "
+                                "re-registered with a fresh lease",
+                                lease_id)
+                        except Exception as e:  # noqa: BLE001 — store
+                            # flapping; the next tick (or the guard's
+                            # heal callback) retries
+                            logger.warning("lease re-establishment "
+                                           "failed: %s", e)
                 if self._service_config_stale:
                     self._refresh_service_config()
                 # The loop keeps ticking at the base cadence (the store
@@ -3413,14 +3523,33 @@ class Worker:
                 cache_offloaded_ssd=offloaded_ssd,
                 model_states=model_states, spans=span_batch)
             self._latency = LatencyMetrics()
-            status, _ = http_json("POST", self.service_addr,
-                                  "/rpc/heartbeat", stamp(hb.to_json()),
-                                  timeout=10.0)
+            status, ack = http_json("POST", self.service_addr,
+                                    "/rpc/heartbeat", stamp(hb.to_json()),
+                                    timeout=10.0)
         except Exception:
             self.spans.requeue(span_batch)
             if cache_ev is not None and not cache_ev.empty:
                 self._hb_cache_pending = cache_ev
             raise
+        if status == 200 and isinstance(ack, dict):
+            ack_epoch = int(ack.get("epoch", 0) or 0)
+            if ack_epoch < self._master_epoch:
+                # A deposed master is still answering on this address:
+                # its ack is REJECTED (fenced epochs, docs/ROBUSTNESS.md)
+                # and counts as a failed beat, so the backoff + the
+                # advertised-address re-read retarget us to the real
+                # master. Requeue the payload — delivery to a stale
+                # master's books is not delivery.
+                self.spans.requeue(span_batch)
+                if cache_ev is not None and not cache_ev.empty:
+                    self._hb_cache_pending = cache_ev
+                logger.warning(
+                    "rejected beat-ack from deposed master at %s "
+                    "(epoch %d < acked %d)", self.service_addr,
+                    ack_epoch, self._master_epoch)
+                return False
+            if ack_epoch > self._master_epoch:
+                self._master_epoch = ack_epoch
         if status != 200:
             self.spans.requeue(span_batch)
             if cache_ev is not None and not cache_ev.empty:

@@ -45,6 +45,12 @@ KEY_MASTER = "XLLM:SERVICE:MASTER"
 KEY_MASTER_ADDR = "XLLM:SERVICE:ADDR"
 KEY_LOADMETRICS = "XLLM:LOADMETRICS:"
 KEY_CACHE = "XLLM:CACHE:"
+# Fenced master epochs (docs/ROBUSTNESS.md, control-plane outage
+# contract): each election mints XLLM:SERVICE:EPOCH:<n> via
+# compare_create with NO lease — the keys are a monotonic ledger that
+# survives master death, so a deposed master healing from a partition
+# discovers a higher epoch and self-demotes instead of dual-serving.
+KEY_EPOCH_PREFIX = "XLLM:SERVICE:EPOCH:"
 
 
 def instance_prefix(instance_type: str) -> str:

@@ -105,6 +105,14 @@ class StoreServer:
     def _watch(self, req: Request) -> Response:
         rev = int(req.param("rev", "0"))
         timeout = min(float(req.param("timeout", "10")), 30.0)
+        if rev > self.store.revision:
+            # A resume revision from the FUTURE: the client watched a
+            # previous incarnation of this store (we restarted, wiped).
+            # Answer immediately with the real revision so the client's
+            # regression handling can re-bootstrap, instead of blocking
+            # the long-poll until the new log catches up.
+            return Response.json({"rev": self.store.revision,
+                                  "compacted": False, "events": []})
         # Events older than the bounded log's head are gone; tell the
         # watcher so it can resync instead of silently missing deletes.
         compacted = rev + 1 < self.store.oldest_retained_revision
@@ -195,6 +203,14 @@ class RemoteStore(CoordinationStore):
                     rev = resp["rev"]
             except Exception:  # noqa: BLE001 — store still booting or
                 stop.wait(1.0)  # unreachable; this loop IS the retry
+        # Last state this watcher DELIVERED per key — the compaction
+        # fallback's baseline. When the server says our revision was
+        # compacted away (we reconnected older than
+        # oldest_retained_revision), retrying that revision would loop
+        # forever: instead re-bootstrap from get_prefix and deliver the
+        # STATE DIFF (synthetic DELETEs for vanished keys, PUTs for
+        # new/changed) — same contract as EtcdStore._resync.
+        known: Dict[str, str] = {}
         while not stop.is_set():
             try:
                 status, resp = http_json(
@@ -204,16 +220,37 @@ class RemoteStore(CoordinationStore):
                 if status != 200:
                     stop.wait(1.0)
                     continue
+                if resp["rev"] < rev:
+                    # The server restarted with a YOUNGER event log (the
+                    # memory-backed store was killed and rebooted): our
+                    # resume revision is from a dead timeline and would
+                    # leave this watcher deaf until the new log catches
+                    # up to it. Adopt the new timeline and state-diff,
+                    # exactly like a compaction.
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "watch on %r saw the store's revision regress "
+                        "(%d -> %d): store restarted; re-bootstrapping",
+                        prefix, rev, resp["rev"])
+                    rev = resp["rev"]
+                    self._resync(prefix, known, callback, stop)
+                    continue
+                rev = resp["rev"]
                 if resp.get("compacted"):
                     import logging
                     logging.getLogger(__name__).warning(
-                        "watch on %r fell behind the event log; some "
-                        "events were compacted away — resync state from "
-                        "get_prefix", prefix)
-                rev = resp["rev"]
+                        "watch on %r fell behind the event log "
+                        "(compacted); re-bootstrapping from get_prefix",
+                        prefix)
+                    self._resync(prefix, known, callback, stop)
+                    continue
                 for ev_type, key, value in resp["events"]:
                     if stop.is_set():
                         return
+                    if ev_type == "DELETE":
+                        known.pop(key, None)
+                    else:
+                        known[key] = value
                     try:
                         callback((ev_type, key, value))
                     except Exception as e:
@@ -224,6 +261,40 @@ class RemoteStore(CoordinationStore):
                             "coordination_net.watch_loop", e)
             except Exception:  # noqa: BLE001 — store restarting/unreachable
                 stop.wait(1.0)
+
+    def _resync(self, prefix: str, known: Dict[str, str],
+                callback: WatchCallback, stop: threading.Event) -> None:
+        """Replace compacted-away events with a state diff (the
+        EtcdStore._resync contract): synthetic DELETEs for keys that
+        vanished while we were behind, PUTs for new/changed values."""
+        try:
+            current = self.get_prefix(prefix)
+        except Exception as e:  # noqa: BLE001 — next long-poll round
+            # hits compacted again and retries the resync
+            import logging
+            logging.getLogger(__name__).warning(
+                "watch resync of %r failed: %s", prefix, e)
+            return
+        for key in list(known):
+            if stop.is_set():
+                return
+            if key not in current:
+                known.pop(key)
+                try:
+                    callback(("DELETE", key, None))
+                except Exception as e:  # noqa: BLE001
+                    threads.record_callback_error(
+                        "coordination_net.watch_loop", e)
+        for key, value in current.items():
+            if stop.is_set():
+                return
+            if known.get(key) != value:
+                known[key] = value
+                try:
+                    callback(("PUT", key, value))
+                except Exception as e:  # noqa: BLE001
+                    threads.record_callback_error(
+                        "coordination_net.watch_loop", e)
 
     def cancel_watch(self, watch_id: int) -> None:
         with self._lock:

@@ -139,7 +139,8 @@ class _RequestObs:
 
 class HttpService:
     def __init__(self, opts: ServiceOptions, scheduler: Scheduler,
-                 events: Optional[EventLog] = None) -> None:
+                 events: Optional[EventLog] = None,
+                 failpoints: Optional[Failpoints] = None) -> None:
         self.opts = opts
         self.scheduler = scheduler
         self.tracer = RequestTracer(opts.trace_path,
@@ -191,10 +192,32 @@ class HttpService:
         self._wd_thread: Optional[threading.Thread] = None
 
         # --- robustness layer: failpoints + retry + mid-stream recovery
-        # Service-plane fault injection (the "service.*" catalog names;
-        # each worker owns its own set) — POST /admin/failpoint also
-        # proxies worker arming through the instance registry.
-        self.failpoints = Failpoints(events=self.events, obs=self.obs)
+        # Service-plane fault injection (the "service.*" and "store.*"
+        # catalog names; each worker owns its own set) — POST
+        # /admin/failpoint also proxies worker arming through the
+        # instance registry. Master passes ITS registry (created before
+        # the store guard so `store.*` covers even boot-time election);
+        # we late-bind our registry for the trip counters. A standalone
+        # HttpService owns its own.
+        if failpoints is not None:
+            self.failpoints = failpoints
+            self.failpoints.obs = self.obs
+        else:
+            self.failpoints = Failpoints(events=self.events, obs=self.obs)
+        # Bounded service-plane admission (docs/ROBUSTNESS.md): beyond
+        # XLLM_MAX_INFLIGHT tracked requests (0 = unbounded) — or
+        # XLLM_MAX_INFLIGHT_PER_MODEL for one model — new work is SHED
+        # with 429 + Retry-After instead of queueing unboundedly, so
+        # goodput-under-SLO stays honest at overload. Literal env reads
+        # for the flag-registry xlint rule.
+        self.max_inflight = int(os.environ.get(
+            "XLLM_MAX_INFLIGHT", "0") or 0)
+        self.max_inflight_per_model = int(os.environ.get(
+            "XLLM_MAX_INFLIGHT_PER_MODEL", "0") or 0)
+        self._m_shed = self.obs.counter(
+            "xllm_requests_shed_total",
+            "requests shed by bounded admission, by reason",
+            labelnames=("reason",))
         # The one retry/backoff policy every forward/redispatch loop
         # shares (utils/retry.py; XLLM_RETRY_* knobs) — replaced the
         # ad-hoc two-attempt loops that used to live here.
@@ -338,6 +361,29 @@ class HttpService:
     # ------------------------------------------------------------------
     # Completions / ChatCompletions (service.cpp:338-475)
     # ------------------------------------------------------------------
+    def _admission_shed(self, model: str) -> Optional[Response]:
+        """Bounded admission (docs/ROBUSTNESS.md): 429 + ``Retry-After``
+        when the tracked in-flight population (global or per-model) is
+        at its cap — shed BEFORE tokenization/scheduling, so an
+        overloaded plane never pays preprocess cost for work it
+        refuses. Counted by reason in xllm_requests_shed_total."""
+        if self.max_inflight > 0 and \
+                self.scheduler.num_tracked_requests() >= self.max_inflight:
+            reason = "inflight"
+        elif self.max_inflight_per_model > 0 and model \
+                and self.scheduler.num_tracked_requests(model) >= \
+                self.max_inflight_per_model:
+            reason = "model_inflight"
+        else:
+            return None
+        self._m_shed.inc(reason=reason)
+        resp = Response.error(
+            429, f"overloaded: in-flight cap reached ({reason}) — "
+                 f"retry after the interval in Retry-After",
+            err_type="overloaded_error")
+        resp.headers["Retry-After"] = "1"
+        return resp
+
     def _completions(self, http_req: Request, is_chat: bool) -> Response:
         self._m_requests.inc()
         try:
@@ -350,6 +396,9 @@ class HttpService:
         if not is_chat and not (body.get("prompt")
                                 or body.get("token_ids")):
             return Response.error(400, "prompt is required")
+        shed = self._admission_shed(body.get("model", ""))
+        if shed is not None:
+            return shed
 
         try:
             # Both the body parse (e.g. a non-numeric best_of/n) and the
@@ -1013,6 +1062,21 @@ class HttpService:
             self.scheduler.kvcache_mgr.num_blocks())
         obs.gauge("xllm_service_is_master").set(
             1 if self.scheduler.is_master else 0)
+        # Control-plane outage visibility (service/store_guard.py +
+        # fenced epochs, docs/ROBUSTNESS.md): store health 2/1/0
+        # (healthy/flaky/down), whether this plane is serving from the
+        # frozen last-known-good table, and the current master epoch.
+        obs.gauge("xllm_store_health",
+                  "coordination-store health as seen by this plane "
+                  "(2 healthy / 1 flaky / 0 down)").set(
+            self.scheduler.store_health())
+        obs.gauge("xllm_service_degraded",
+                  "1 while serving from the frozen instance table "
+                  "during a store outage").set(
+            1 if self.scheduler.degraded else 0)
+        obs.gauge("xllm_service_epoch",
+                  "fenced master epoch this replica carries").set(
+            self.scheduler.current_epoch())
         # Keep-alive reuse pool: regressions show here as hit:miss
         # decay / overflow growth before they show as service_bench
         # latency. The pool is PROCESS-global (httpd._POOL), so the

@@ -130,6 +130,14 @@ class InstanceMgr:
         # Removal hook: the scheduler fails in-flight requests routed to a
         # dead instance (set post-construction to avoid a ctor cycle).
         self.on_removed: Optional[Callable[[str], None]] = None
+        # Post-heal settle window (guarded-by: instance_mgr): while
+        # time.monotonic() < _delete_thaw_at, watch DELETEs are still
+        # deferred — the watch replays blackout bookkeeping (or a
+        # wiped-store resync's synthetic DELETEs) right after the guard
+        # heals, before live workers have re-registered. The follow-up
+        # resync at _post_heal_resync_at reconciles anything deferred.
+        self._delete_thaw_at = 0.0
+        self._post_heal_resync_at = 0.0
 
         for itype in InstanceType:
             self._watch_ids.append(store.add_watch(
@@ -205,7 +213,94 @@ class InstanceMgr:
                     self._removed.discard(name)
                     self._register(meta, from_bootstrap=True)
         elif ev_type == "DELETE":
+            if getattr(self.store, "is_down", False):
+                # Control-plane outage (service/store_guard.py): lease
+                # expiry is frozen — a DELETE arriving while the store
+                # is DOWN is bookkeeping fallout from the outage, not
+                # evidence the worker died. Liveness is judged by the
+                # direct worker→master heartbeats until the store
+                # heals; resync_from_store reconciles afterwards
+                # (docs/ROBUSTNESS.md outage contract).
+                logger.warning("store outage: freezing DELETE for "
+                               "instance %s (lease expiry ignored)", name)
+                return
+            with self._lock:
+                settling = time.monotonic() < self._delete_thaw_at
+            if settling:
+                # The outage just healed: DELETEs arriving now are the
+                # watch catching up on blackout bookkeeping (leases
+                # that expired while we were blind) or a wiped-store
+                # resync's synthetic DELETEs — live workers re-register
+                # within a heartbeat, and the scheduled follow-up
+                # resync removes the ones that really went silent.
+                logger.warning("post-heal settle: deferring DELETE for "
+                               "instance %s until the follow-up resync",
+                               name)
+                return
             self.remove_instance(name)
+
+    def resync_from_store(self, settle: bool = True) -> None:
+        """Post-outage reconciliation (docs/ROBUSTNESS.md outage
+        contract), run from the store guard's heal callback:
+        registrations that landed in the store while this plane was
+        blind become live instances, and book entries whose store key
+        vanished are dropped ONLY if their direct heartbeats also went
+        silent — a worker whose lease expired during the blackout but
+        that kept beating re-registers itself on ITS OWN heal path and
+        must not be bounced here. Store reads stay outside the lock
+        (same rationale as _bootstrap); re-runnable at any time.
+
+        ``settle=True`` (the heal-callback invocation) also opens the
+        post-heal settle window: watch DELETEs stay deferred while the
+        watch replays blackout bookkeeping, and a follow-up
+        ``resync_from_store(settle=False)`` is scheduled (driven by the
+        master loop via :meth:`post_heal_resync_due`) to reconcile
+        whatever the deferral skipped."""
+        stale_deadline = max(3 * self.opts.heartbeat_interval_s, 3.0)
+        if settle:
+            # Long enough to cover one full remote watch long-poll
+            # round (5s) plus the beat-staleness bound, so by the time
+            # the follow-up resync runs, every synthetic DELETE has
+            # been seen and a dead worker's beats HAVE gone stale.
+            grace = 5.0 + stale_deadline
+            with self._lock:
+                self._delete_thaw_at = time.monotonic() + grace
+                self._post_heal_resync_at = self._delete_thaw_at
+        else:
+            with self._lock:
+                self._post_heal_resync_at = 0.0
+        in_store: Set[str] = set()
+        for itype in InstanceType:
+            items = self.store.get_prefix_json(
+                instance_prefix(itype.value))
+            with self._lock:
+                for _key, val in items.items():
+                    meta = InstanceMetaInfo.from_json(val)
+                    if not meta.name:
+                        continue
+                    in_store.add(meta.name)
+                    if meta.name in self._instances:
+                        continue
+                    self._pending.pop(meta.name, None)
+                    self._removed.discard(meta.name)
+                    self._register(meta, from_bootstrap=True)
+        now = time.monotonic()
+        with self._lock:
+            silent = [n for n, s in self._instances.items()
+                      if n not in in_store
+                      and now - s.last_heartbeat > stale_deadline]
+        for name in silent:
+            logger.warning("post-heal resync: %s absent from the store "
+                           "and silent for > %.1fs of beats, removing",
+                           name, stale_deadline)
+            self.remove_instance(name)
+
+    def post_heal_resync_due(self) -> bool:
+        """True once the post-heal settle window has elapsed and the
+        follow-up reconciliation hasn't run yet (master-loop driven)."""
+        with self._lock:
+            return self._post_heal_resync_at > 0.0 and \
+                time.monotonic() >= self._post_heal_resync_at
 
     def _on_loadmetrics_event(self, event) -> None:
         """Replica path: learn load metrics from the master's uploads
@@ -870,4 +965,8 @@ class InstanceMgr:
 
     def close(self) -> None:
         for wid in self._watch_ids:
-            self.store.cancel_watch(wid)
+            try:
+                self.store.cancel_watch(wid)
+            except Exception:  # noqa: BLE001 — store may be mid-outage
+                # at shutdown; the watch dies with the process anyway
+                pass

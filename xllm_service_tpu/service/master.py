@@ -21,12 +21,14 @@ from typing import Dict, List, Optional
 from xllm_service_tpu.config import (
     LoadBalancePolicyType, ServiceOptions, options_from_env)
 from xllm_service_tpu.obs import EventLog
+from xllm_service_tpu.obs.failpoints import Failpoints
 from xllm_service_tpu.service.coordination import CoordinationStore
 from xllm_service_tpu.service.coordination_net import connect_store
 from xllm_service_tpu.service.http_service import HttpService
 from xllm_service_tpu.service.httpd import HttpServer, Router
 from xllm_service_tpu.service.rpc_service import RpcService
 from xllm_service_tpu.service.scheduler import Scheduler
+from xllm_service_tpu.service.store_guard import StoreGuard
 
 logger = logging.getLogger(__name__)
 
@@ -45,12 +47,25 @@ class Master:
         # first thing it records (ring size: XLLM_EVENT_RING).
         self.events = EventLog(
             capacity=int(os.environ.get("XLLM_EVENT_RING", "1024")))
+        # One failpoint registry for the service plane, created before
+        # the store guard so the `store.*` sites can black out even the
+        # scheduler's boot-time election; HttpService adopts it (and
+        # late-binds its registry for trip counters).
+        self.failpoints = Failpoints(events=self.events)
+        # Every coordination call routes through the guard
+        # (service/store_guard.py): health state machine, store.*
+        # failpoints, epoch write fence, heal-triggered resync.
+        if not isinstance(self.store, StoreGuard):
+            self.store = StoreGuard(self.store,
+                                    failpoints=self.failpoints,
+                                    events=self.events)
         self.scheduler = Scheduler(
             opts, self.store, control=control,
             model_memory_gb=model_memory_gb,
             serverless_models=serverless_models, events=self.events)
         self.http_service = HttpService(opts, self.scheduler,
-                                        events=self.events)
+                                        events=self.events,
+                                        failpoints=self.failpoints)
         self.rpc_service = RpcService(opts, self.scheduler)
         # Worker span stages arrive on the RPC plane (heartbeats) but
         # are queried on the HTTP plane (/admin/trace/<id>): one store.

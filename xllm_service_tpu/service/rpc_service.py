@@ -60,7 +60,14 @@ class RpcService:
                         rid, plane="worker",
                         events=rec.get("events", []), source=hb.name,
                         attrs=rec.get("attrs") or None)
-        return Response.json({"ok": True, "registered": registered})
+        # The ack carries the master epoch (fenced elections) — workers
+        # reject an ack whose epoch regresses below one they've already
+        # acked (a deposed master still answering) — and the degraded
+        # flag so a worker knows its lease-keepalive failures are a
+        # store outage, not its own death (docs/ROBUSTNESS.md).
+        return Response.json({"ok": True, "registered": registered,
+                              "epoch": self.scheduler.current_epoch(),
+                              "degraded": self.scheduler.degraded})
 
     # -- Generations fan-in (rpc_service/service.cpp:149-213) -------------
     def generations(self, req: Request) -> Response:
@@ -99,4 +106,5 @@ class RpcService:
                 self.opts.enable_decode_response_to_service,
             "block_size": self.opts.block_size,
             "murmur_hash3_seed": self.opts.murmur_hash3_seed,
+            "epoch": self.scheduler.current_epoch(),
         })

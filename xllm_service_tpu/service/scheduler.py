@@ -32,8 +32,10 @@ from xllm_service_tpu.config import ServiceOptions
 from xllm_service_tpu.nlp.chat_template import ChatTemplate
 from xllm_service_tpu.nlp.tokenizer import Tokenizer, TokenizerFactory
 from xllm_service_tpu.service.coordination import (
-    KEY_MASTER, KEY_MASTER_ADDR, CoordinationStore)
+    KEY_EPOCH_PREFIX, KEY_MASTER, KEY_MASTER_ADDR, CoordinationStore)
 from xllm_service_tpu.service.instance_mgr import InstanceMgr
+from xllm_service_tpu.service.store_guard import (
+    EpochFencedError, StoreGuard)
 from xllm_service_tpu.service.instance_types import (
     Heartbeat, RequestPhase)
 from xllm_service_tpu.service.kvcache_mgr import GlobalKVCacheMgr
@@ -118,17 +120,42 @@ class Scheduler:
         self.chat_template = ChatTemplate.from_model_dir(opts.tokenizer_path)
 
         # --- leader election (scheduler.cpp:25-66) -----------------------
+        # Election triple: the role flag plus the fenced epochs
+        # (docs/ROBUSTNESS.md). ``epoch`` is the monotonic epoch THIS
+        # replica minted when it last won an election (0 = never won);
+        # ``_cluster_epoch`` is the highest epoch observed anywhere. A
+        # master whose epoch trails the cluster's has been deposed and
+        # must demote, never write.
+        self._elect_mu = make_lock("scheduler.elect", 88)
+        self.is_master = False       # guarded-by: scheduler.elect
+        self.epoch = 0               # guarded-by: scheduler.elect
+        self._cluster_epoch = 0      # guarded-by: scheduler.elect
         self._lease_id = store.lease_grant(
             max(3 * opts.heartbeat_interval_s, 3.0))
-        self.is_master = store.compare_create(
+        won = store.compare_create(
             KEY_MASTER, self.service_id, self._lease_id)
-        self._master_watch: Optional[int] = None
-        if not self.is_master:
+        epoch = self._mint_epoch() if won else self._read_cluster_epoch()
+        with self._elect_mu:
+            self.is_master = won
+            if won:
+                self.epoch = epoch
+            self._cluster_epoch = max(self._cluster_epoch, epoch)
+        self._master_watch: Optional[int] = None  # guarded-by: scheduler.elect
+        self._epoch_watch: Optional[int] = store.add_watch(
+            KEY_EPOCH_PREFIX, self._on_epoch_event)
+        if not won:
             self._master_watch = store.add_watch(
                 KEY_MASTER, self._on_master_event)
         elif self.events is not None:
             self.events.emit("master_elected", service_id=self.service_id,
-                             how="boot")
+                             how="boot", epoch=epoch)
+        # Store-guard integration (service/store_guard.py): fence every
+        # master-authored write against a higher observed epoch, and
+        # resync + maybe self-demote the moment an outage heals. Raw
+        # stores (standalone schedulers in unit tests) skip both.
+        if isinstance(store, StoreGuard):
+            store.fence_check = self._fenced
+            store.on_heal(self._on_store_heal)
 
         self.instance_mgr = InstanceMgr(
             opts, store, is_master=self.is_master, control=control,
@@ -183,22 +210,175 @@ class Scheduler:
     # ------------------------------------------------------------------
     # Election / master loop
     # ------------------------------------------------------------------
+    def _mint_epoch(self) -> int:
+        """Mint the next monotonic master epoch: compare_create on
+        ``XLLM:SERVICE:EPOCH:<n>`` (no lease — the ledger outlives every
+        master) one past the highest existing entry. Loses the race →
+        reads again and tries the next slot."""
+        for _ in range(64):
+            n = self._read_cluster_epoch() + 1
+            if self.store.compare_create(KEY_EPOCH_PREFIX + str(n),
+                                         self.service_id):
+                return n
+        raise RuntimeError("could not mint a master epoch in 64 tries "
+                           "(epoch ledger churning?)")
+
+    def _read_cluster_epoch(self) -> int:
+        """Highest epoch in the store's ledger (0 when empty)."""
+        best = 0
+        for key in self.store.get_prefix(KEY_EPOCH_PREFIX):
+            try:
+                best = max(best, int(key[len(KEY_EPOCH_PREFIX):]))
+            except ValueError:
+                continue
+        return best
+
+    def current_epoch(self) -> int:
+        """The epoch stamped on beat-acks and ``/rpc/config`` — workers
+        reject acks that regress (runtime/worker.py)."""
+        with self._elect_mu:
+            return self.epoch if self.is_master else self._cluster_epoch
+
+    def _fenced(self) -> bool:
+        """Store-guard write fence: True = this replica believes it is
+        master but a higher epoch exists → every write must be rejected
+        (EpochFencedError) until it demotes."""
+        with self._elect_mu:
+            return self.is_master and self._cluster_epoch > self.epoch
+
+    def _become_master(self, how: str) -> None:
+        """Post-``compare_create``-win bookkeeping: mint the fencing
+        epoch, then flip the role triple under the elect lock (store
+        ops first, lock second — the lock never spans a store call)."""
+        epoch = self._mint_epoch()
+        with self._elect_mu:
+            self.is_master = True
+            self.epoch = epoch
+            self._cluster_epoch = max(self._cluster_epoch, epoch)
+            self.instance_mgr.is_master = True
+            self.kvcache_mgr.is_master = True
+        self._publish_addresses()
+        if self.events is not None:
+            self.events.emit("master_elected", service_id=self.service_id,
+                             how=how, epoch=epoch)
+
+    def _demote(self, how: str, cluster_epoch: Optional[int] = None) -> bool:
+        """Stop being master (lost re-election, or fenced by a higher
+        epoch). Returns False if we already weren't."""
+        with self._elect_mu:
+            if cluster_epoch is not None:
+                self._cluster_epoch = max(self._cluster_epoch,
+                                          cluster_epoch)
+            if not self.is_master:
+                return False
+            my_epoch = self.epoch
+            observed = self._cluster_epoch
+            self.is_master = False
+            self.instance_mgr.is_master = False
+            self.kvcache_mgr.is_master = False
+        if self.events is not None:
+            self.events.emit("master_demoted", service_id=self.service_id,
+                             how=how, epoch=my_epoch,
+                             cluster_epoch=observed)
+        logger.warning("%s demoted (%s): epoch %d vs cluster %d",
+                       self.service_id, how, my_epoch, observed)
+        try:
+            self._ensure_master_watch()
+        except Exception as e:  # noqa: BLE001 — store flapping; the next
+            # heal/takeover path re-adds the watch
+            logger.warning("re-adding master watch failed: %s", e)
+        return True
+
+    def _ensure_master_watch(self) -> None:
+        """Re-add the KEY_MASTER vacancy watch if absent. The
+        ``add_watch`` store call runs OUTSIDE scheduler.elect (store
+        locks rank below it); the double-check under the lock cancels
+        the loser when two demote paths race (epoch-watch thread vs
+        master loop)."""
+        with self._elect_mu:
+            if self._master_watch is not None:
+                return
+        wid: Optional[int] = self.store.add_watch(
+            KEY_MASTER, self._on_master_event)
+        with self._elect_mu:
+            if self._master_watch is None:
+                self._master_watch = wid
+                wid = None
+        if wid is not None:
+            try:
+                self.store.cancel_watch(wid)
+            except Exception:  # noqa: BLE001 — duplicate watch is benign
+                pass
+
+    def _on_epoch_event(self, event) -> None:
+        """Epoch-ledger watch (all replicas): track the cluster's
+        highest epoch; a master seeing a HIGHER one has been deposed
+        (another replica won an election it couldn't see) and
+        self-demotes instead of dual-serving."""
+        ev_type, key, _value = event
+        if ev_type != "PUT":
+            return
+        try:
+            n = int(key[len(KEY_EPOCH_PREFIX):])
+        except ValueError:
+            return
+        with self._elect_mu:
+            self._cluster_epoch = max(self._cluster_epoch, n)
+            deposed = self.is_master and self._cluster_epoch > self.epoch
+        if deposed:
+            self._demote(how="higher-epoch")
+
+    def _on_store_heal(self) -> None:
+        """Store-guard heal callback, run synchronously on the thread
+        whose call healed the outage and BEFORE that call returns: a
+        deposed master demotes before it can author a single stale
+        write, and the instance books resync against what actually
+        happened in the store while we were blind."""
+        if self._stop.is_set():
+            return
+        try:
+            cluster = self._read_cluster_epoch()
+        except Exception as e:  # noqa: BLE001 — store flapping mid-heal;
+            # the next successful call re-runs this path
+            logger.warning("post-heal epoch read failed: %s", e)
+            return
+        with self._elect_mu:
+            self._cluster_epoch = max(self._cluster_epoch, cluster)
+            deposed = self.is_master and self._cluster_epoch > self.epoch
+        if deposed:
+            self._demote(how="healed-behind")
+        try:
+            self.instance_mgr.resync_from_store()
+        except Exception as e:  # noqa: BLE001 — resync is re-runnable;
+            # heartbeats keep the books converging meanwhile
+            logger.warning("post-heal instance resync failed: %s", e)
+
+    @property
+    def degraded(self) -> bool:
+        """True while the coordination store is DOWN and this replica is
+        serving from the frozen last-known-good instance table."""
+        return bool(getattr(self.store, "is_down", False))
+
+    def store_health(self) -> int:
+        """The ``xllm_store_health`` gauge value (2/1/0; raw stores
+        report healthy)."""
+        h = getattr(self.store, "health", None)
+        return 2 if h is None else int(h)
+
     def _on_master_event(self, event) -> None:
         ev_type, _key, _value = event
         if ev_type != "DELETE" or self._stop.is_set():
             return
         # Master lease expired → try to take over (scheduler.cpp:158-175).
-        if self.store.compare_create(KEY_MASTER, self.service_id,
-                                     self._lease_id):
-            self.is_master = True
-            self.instance_mgr.is_master = True
-            self.kvcache_mgr.is_master = True
-            self._publish_addresses()
-            if self.events is not None:
-                self.events.emit("master_elected",
-                                 service_id=self.service_id,
-                                 how="takeover")
-            logger.info("%s took over as master", self.service_id)
+        try:
+            won = self.store.compare_create(KEY_MASTER, self.service_id,
+                                            self._lease_id)
+            if won:
+                self._become_master(how="takeover")
+                logger.info("%s took over as master", self.service_id)
+        except Exception as e:  # noqa: BLE001 — store outage mid-takeover;
+            # the next master-key DELETE (or heal) retries the election
+            logger.warning("master takeover attempt failed: %s", e)
 
     def announce(self, rpc_addr: str, http_addr: str) -> None:
         """Record this replica's reachable addresses; the current master
@@ -212,8 +392,12 @@ class Scheduler:
     def _publish_addresses(self) -> None:
         if getattr(self, "_addresses", None):
             try:
-                self.store.put_json(KEY_MASTER_ADDR, self._addresses,
-                                    self._lease_id)
+                # Epoch-stamped master-authored write: workers ignore an
+                # advert regressing below the epoch they've acked.
+                self.store.put_json(
+                    KEY_MASTER_ADDR,
+                    dict(self._addresses, epoch=self.current_epoch()),
+                    self._lease_id)
             except Exception as e:  # noqa: BLE001 — store hiccup; retried
                 logger.warning("publish master addr failed: %s", e)
 
@@ -240,37 +424,64 @@ class Scheduler:
             max(3 * self.opts.heartbeat_interval_s, 3.0))
         if self.store.compare_create(KEY_MASTER, self.service_id,
                                      self._lease_id):
-            self.is_master = True
-            self.instance_mgr.is_master = True
-            self.kvcache_mgr.is_master = True
-            self._publish_addresses()   # old advert died with the lease
-            if self.events is not None:
-                self.events.emit("master_elected",
-                                 service_id=self.service_id,
-                                 how="re-elected")
+            # Winning mints a FRESH epoch even when we were master
+            # before the expiry — any replica that took over in between
+            # sits at a lower epoch now and fences itself out.
+            self._become_master(how="re-elected")
             if was_master:
                 logger.warning("%s lease expired but election was vacant; "
                                "re-elected with a fresh lease",
                                self.service_id)
         else:
-            self.is_master = False
-            self.instance_mgr.is_master = False
-            self.kvcache_mgr.is_master = False
-            if self._master_watch is None:
-                self._master_watch = self.store.add_watch(
-                    KEY_MASTER, self._on_master_event)
+            if not self._demote(how="lost-re-election"):
+                # Already a replica (watch may have died with a store
+                # reconnect) — just make sure we hear the next vacancy
+                # (_demote re-adds it itself on a real demotion).
+                self._ensure_master_watch()
             if was_master:
                 logger.warning(
                     "%s demoted: lease expired and %s took over",
                     self.service_id, self.store.get(KEY_MASTER))
 
+    def _degraded_tick(self) -> None:
+        """One master-loop tick while the store is DOWN: keep serving
+        from the frozen last-known-good table, with liveness judged by
+        the direct worker→master heartbeats that still flow during a
+        store-only outage. Only an instance that stopped BEATING for a
+        full lease TTL is dropped — lease expiry is frozen and is not
+        evidence of death (docs/ROBUSTNESS.md outage contract)."""
+        if not self.is_master:
+            return
+        ttl = max(3 * self.opts.heartbeat_interval_s, 3.0)
+        for name in self.instance_mgr.stale_instances(ttl):
+            logger.warning("degraded mode: %s silent for > %.1fs of "
+                           "direct beats, removing", name, ttl)
+            self.instance_mgr.remove_instance(name)
+
     def _master_loop(self) -> None:
         """Keepalive + periodic state upload (scheduler.cpp:138-146)."""
         interval = self.opts.master_upload_interval_s
         while not self._stop.wait(interval):
+            # The keepalive runs in its own try: an EXCEPTION means the
+            # store is unreachable (outage — hold the role, freeze the
+            # table, serve degraded), while a clean False means the
+            # store is healthy and says the lease is dead (expiry —
+            # re-run the election). Collapsing the two is how a store
+            # hiccup used to turn into a spurious failover.
             try:
-                if not self.store.lease_keepalive(self._lease_id):
+                lease_alive = self.store.lease_keepalive(self._lease_id)
+            except Exception as e:  # noqa: BLE001 — outage; the guard
+                # tracks health and fires the heal callback later
+                logger.debug("keepalive unreachable (store outage?): %s", e)
+                self._degraded_tick()
+                continue
+            try:
+                if not lease_alive:
                     self._on_lease_lost()
+                if self.instance_mgr.post_heal_resync_due():
+                    # Settle window over: reconcile the DELETEs the
+                    # post-heal deferral skipped (instance_mgr).
+                    self.instance_mgr.resync_from_store(settle=False)
                 if self.is_master:
                     self.instance_mgr.upload_load_metrics()
                     self.kvcache_mgr.upload_kvcache()
@@ -279,6 +490,10 @@ class Scheduler:
                     if self._addresses is not None \
                             and self.store.get(KEY_MASTER_ADDR) is None:
                         self._publish_addresses()
+            except EpochFencedError:
+                # The guard refused a write because a higher epoch
+                # exists: we are deposed — demote NOW, don't retry.
+                self._demote(how="fenced-write")
             except Exception as e:  # noqa: BLE001 — store hiccup, retry next tick
                 logger.warning("master loop error: %s", e)
 
@@ -791,9 +1006,14 @@ class Scheduler:
             tracked = self._requests.get(service_request_id)
             return tracked.recovery if tracked is not None else None
 
-    def num_tracked_requests(self) -> int:
+    def num_tracked_requests(self, model: Optional[str] = None) -> int:
+        """Tracked in-flight requests — optionally for one model (the
+        bounded-admission per-model cap, http_service.py)."""
         with self._req_lock:
-            return len(self._requests)
+            if model is None:
+                return len(self._requests)
+            return sum(1 for t in self._requests.values()
+                       if t.request.model == model)
 
     def tracked_requests_info(self) -> List[Dict[str, Any]]:
         """Flight-recorder view of the live request registry (the debug
@@ -855,8 +1075,12 @@ class Scheduler:
         self._hb_thread.join(timeout=5)
         self.instance_mgr.close()
         self.kvcache_mgr.close()
-        if self._master_watch is not None:
-            self.store.cancel_watch(self._master_watch)
+        for watch_id in (self._master_watch, self._epoch_watch):
+            if watch_id is not None:
+                try:
+                    self.store.cancel_watch(watch_id)
+                except Exception:  # noqa: BLE001 — store may already be gone
+                    pass
         try:
             self.store.lease_revoke(self._lease_id)
         except Exception:  # noqa: BLE001 — store may already be gone

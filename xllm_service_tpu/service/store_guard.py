@@ -1,0 +1,297 @@
+"""Store guard: deadline'd health tracking around the coordination store.
+
+The reference's headline fault-tolerance story is *etcd-based* discovery
+— which makes the store the cluster's one single point of failure. This
+wrapper separates the control plane into its own fault domain (the
+P/D-Serve argument, PAPERS.md 2408.08147): every ``CoordinationStore``
+call routes through ``_call``, which
+
+- injects the closed-catalog ``store.*`` failpoints so a blackout is a
+  deterministic tier-1 event, not a SIGKILL race;
+- times the call against a deadline (``XLLM_STORE_DEADLINE_S``) so a
+  hung store surfaces as a failure instead of wedging the caller;
+- tracks consecutive failures through a healthy→flaky→down state
+  machine (``XLLM_STORE_DOWN_THRESHOLD`` consecutive failures = down),
+  visible as the ``xllm_store_health`` gauge (2/1/0) and the
+  ``store_outage_open``/``store_outage_close`` events;
+- while *partitioned* (``store.partition`` armed) suppresses incoming
+  watch events — a client cut off from the store receives no watch
+  traffic, so lease-expiry DELETEs never reach the instance books and
+  the last-known-good table stays frozen, exactly like a real blackout;
+- on the down→healthy transition runs registered heal callbacks
+  *synchronously, before the healing call returns* — the scheduler's
+  callback re-reads the fenced-epoch keys and self-demotes a deposed
+  master before a single stale master-authored write can land;
+- fences master-authored writes: when the owner installed a fence
+  check (``fence_check``) and it returns True (local epoch behind the
+  cluster epoch), every write raises ``EpochFencedError`` instead of
+  dual-serving the store (docs/ROBUSTNESS.md, control-plane outage
+  contract).
+
+Liveness during an outage is judged by the direct worker→master
+heartbeats that keep flowing — the guard only decides what the STORE
+is allowed to tell us, never who is alive.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from xllm_service_tpu.service.coordination import (
+    CoordinationStore, WatchCallback)
+from xllm_service_tpu.utils import threads
+from xllm_service_tpu.utils.locks import make_lock
+
+logger = logging.getLogger(__name__)
+
+HEALTHY, FLAKY, DOWN = 2, 1, 0
+_HEALTH_NAMES = {HEALTHY: "healthy", FLAKY: "flaky", DOWN: "down"}
+
+
+class StoreOutageError(RuntimeError):
+    """A coordination-store call failed because the store is
+    unreachable (injected or real). Callers treat it as 'the control
+    plane is gone', never as 'the answer is no'."""
+
+
+class EpochFencedError(RuntimeError):
+    """A master-authored store write was rejected because a
+    higher-epoch master exists — the writer must self-demote, not
+    retry (split-brain fence, docs/ROBUSTNESS.md)."""
+
+
+def _as_float(raw: Optional[str], default: float) -> float:
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+class StoreGuard(CoordinationStore):
+    """Health-tracking, failpoint-injecting, epoch-fencing wrapper
+    around any ``CoordinationStore`` backend. One guard per plane
+    (service process / worker) — health is the CLIENT's view of the
+    store, and the co-located test harness blacks out one plane
+    without touching its twin."""
+
+    def __init__(self, store: CoordinationStore, failpoints=None,
+                 events=None) -> None:
+        self.inner = store
+        self.failpoints = failpoints
+        self.events = events
+        # Guards health-state + callback books only; never held across
+        # an inner store call or a heal/watch callback.
+        self._mu = make_lock("store_guard", 74)
+        self._consecutive_failures = 0
+        self._health = HEALTHY
+        self._outage_since: Optional[float] = None
+        self.outages_opened = 0
+        # Deadline for one store call: a store slower than this is a
+        # failure, not a wait (the hang failpoint proves the path).
+        self.deadline_s = _as_float(
+            os.environ.get("XLLM_STORE_DEADLINE_S"), 5.0)
+        # Consecutive failures before healthy→down (the single step
+        # in between is flaky).
+        self.down_threshold = max(1, int(_as_float(
+            os.environ.get("XLLM_STORE_DOWN_THRESHOLD"), 3)))
+        # Epoch fence: installed by the scheduler on the master plane.
+        # Returns True when this process believes it is master but its
+        # epoch is behind the cluster's → writes must be rejected.
+        self.fence_check: Optional[Callable[[], bool]] = None
+        # Down→healthy transition listeners (scheduler resync/demote,
+        # worker re-registration). Run synchronously on the thread
+        # that observed the heal, BEFORE its store call returns.
+        self._heal_cbs: List[Callable[[], None]] = []
+        # watch_id → wrapped callback (for partition suppression).
+        self._suppressed_events = 0
+
+    # -- health state machine -------------------------------------------
+    @property
+    def health(self) -> int:
+        """2 = healthy, 1 = flaky, 0 = down (the gauge value)."""
+        with self._mu:
+            return self._health
+
+    @property
+    def is_down(self) -> bool:
+        with self._mu:
+            return self._health == DOWN
+
+    def on_heal(self, cb: Callable[[], None]) -> None:
+        with self._mu:
+            self._heal_cbs.append(cb)
+
+    def state(self) -> Dict[str, Any]:
+        with self._mu:
+            return {"health": _HEALTH_NAMES[self._health],
+                    "consecutive_failures": self._consecutive_failures,
+                    "outages_opened": self.outages_opened,
+                    "outage_open_s": (
+                        round(time.monotonic() - self._outage_since, 3)
+                        if self._outage_since is not None else 0.0),
+                    "suppressed_watch_events": self._suppressed_events}
+
+    def _partitioned(self) -> bool:
+        """Watch-event suppression predicate: while ``store.partition``
+        is armed this client hears NOTHING from the store — checked
+        per event, outside the guard lock (failpoints has its own)."""
+        if self.failpoints is None:
+            return False
+        return self.failpoints.fire("store.partition") is not None
+
+    def _record_failure(self, op: str, exc: Exception) -> None:
+        opened = False
+        with self._mu:
+            self._consecutive_failures += 1
+            if self._consecutive_failures >= self.down_threshold:
+                if self._health != DOWN:
+                    opened = True
+                    self._outage_since = time.monotonic()
+                    self.outages_opened += 1
+                self._health = DOWN
+            else:
+                self._health = min(self._health, FLAKY)
+        if opened:
+            logger.warning("coordination store declared DOWN after %d "
+                           "consecutive failures (last: %s on %s)",
+                           self.down_threshold, exc, op)
+            if self.events is not None:
+                self.events.emit("store_outage_open", op=op,
+                                 error=str(exc))
+
+    def _record_success(self) -> None:
+        with self._mu:
+            was = self._health
+            self._consecutive_failures = 0
+            self._health = HEALTHY
+            if was != DOWN:
+                return
+            healed_after = (time.monotonic() - self._outage_since
+                            if self._outage_since is not None else 0.0)
+            self._outage_since = None
+            cbs = list(self._heal_cbs)
+        logger.info("coordination store healed after %.3fs outage",
+                    healed_after)
+        if self.events is not None:
+            self.events.emit("store_outage_close",
+                             outage_s=round(healed_after, 3))
+        # Synchronous, pre-return: a deposed master's heal callback
+        # demotes it before the caller can issue a stale write.
+        for cb in cbs:
+            try:
+                cb()
+            except Exception as e:  # noqa: BLE001 — one broken heal
+                # hook must not mask the heal from the others
+                threads.record_callback_error("store_guard.heal", e)
+
+    # -- the guarded call ------------------------------------------------
+    def _call(self, op: str, fn: Callable, *args: Any) -> Any:
+        fp = self.failpoints
+        if fp is not None:
+            if fp.fire("store.partition") is not None:
+                exc: Exception = StoreOutageError(
+                    f"store partitioned (failpoint store.partition): {op}")
+                self._record_failure(op, exc)
+                raise exc
+            if fp.fire("store.fail_rpc") is not None:
+                exc = StoreOutageError(
+                    f"store rpc failed (failpoint store.fail_rpc): {op}")
+                self._record_failure(op, exc)
+                raise exc
+            hang = fp.fire("store.hang")
+            if hang is not None:
+                # Deterministic slow-store: sleep the armed value (s),
+                # capped by the guard deadline, then fail like a
+                # timed-out call would.
+                delay = float(hang) if hang is not True else self.deadline_s
+                time.sleep(min(delay, self.deadline_s))
+                exc = StoreOutageError(
+                    f"store call deadline exceeded (failpoint "
+                    f"store.hang, {self.deadline_s}s): {op}")
+                self._record_failure(op, exc)
+                raise exc
+        t0 = time.monotonic()
+        try:
+            out = fn(*args)
+        except Exception as e:  # noqa: BLE001 — ANY backend failure is a
+            # health event; the caller sees the original error class via
+            # the StoreOutageError chain
+            self._record_failure(op, e)
+            raise StoreOutageError(f"store {op} failed: {e}") from e
+        took = time.monotonic() - t0
+        if took > self.deadline_s:
+            # The call returned, but past the deadline: count it against
+            # health (a store this slow is an outage in progress) while
+            # still handing the caller its answer.
+            self._record_failure(op, TimeoutError(
+                f"{op} took {took:.3f}s > {self.deadline_s}s"))
+            return out
+        self._record_success()
+        return out
+
+    def _write(self, op: str, fn: Callable, *args: Any) -> Any:
+        fence = self.fence_check
+        if fence is not None and fence():
+            raise EpochFencedError(
+                f"store write {op} rejected: a higher-epoch master "
+                f"exists — self-demote instead of dual-serving")
+        return self._call(op, fn, *args)
+
+    # -- CoordinationStore surface ---------------------------------------
+    def put(self, key: str, value: str,
+            lease_id: Optional[int] = None) -> None:
+        self._write("put", self.inner.put, key, value, lease_id)
+
+    def get(self, key: str) -> Optional[str]:
+        return self._call("get", self.inner.get, key)
+
+    def get_prefix(self, prefix: str) -> Dict[str, str]:
+        return self._call("get_prefix", self.inner.get_prefix, prefix)
+
+    def delete(self, key: str) -> bool:
+        return self._write("delete", self.inner.delete, key)
+
+    def delete_prefix(self, prefix: str) -> int:
+        return self._write("delete_prefix", self.inner.delete_prefix,
+                           prefix)
+
+    def lease_grant(self, ttl_s: float) -> int:
+        return self._call("lease_grant", self.inner.lease_grant, ttl_s)
+
+    def lease_keepalive(self, lease_id: int) -> bool:
+        return self._call("lease_keepalive", self.inner.lease_keepalive,
+                          lease_id)
+
+    def lease_revoke(self, lease_id: int) -> None:
+        self._call("lease_revoke", self.inner.lease_revoke, lease_id)
+
+    def compare_create(self, key: str, value: str,
+                       lease_id: Optional[int] = None) -> bool:
+        # Election txn: fenced like a write — a deposed master must not
+        # be able to re-grab ANY key while behind the cluster epoch.
+        return self._write("compare_create", self.inner.compare_create,
+                           key, value, lease_id)
+
+    def add_watch(self, prefix: str, callback: WatchCallback) -> int:
+        def guarded(event) -> None:
+            if self._partitioned():
+                # A partitioned client hears nothing: the DELETE from a
+                # lease expiring mid-blackout must NOT reach the books
+                # (that is the freeze). Healing resyncs from get_prefix.
+                with self._mu:
+                    self._suppressed_events += 1
+                return
+            callback(event)
+        # Registering the watch is itself a store call on remote
+        # backends — guard it too.
+        return self._call("add_watch", self.inner.add_watch, prefix,
+                          guarded)
+
+    def cancel_watch(self, watch_id: int) -> None:
+        self._call("cancel_watch", self.inner.cancel_watch, watch_id)
+
+    def close(self) -> None:
+        self.inner.close()

@@ -14,6 +14,9 @@ Rank table (acquire order low → high; a thread's held ranks are strictly
 increasing):
 
      5  worker.hb                       — serializes heartbeat build+send
+     8  worker.reg                      — registration revoke→grant→put
+                                          (store calls run UNDER it —
+                                          store locks rank above)
     10  scheduler.req, worker.live      — request registries
     20  worker.engine                   — engine step/submit
     22  kv_cache.tier                   — host-DRAM/disk KV spill tier
@@ -27,6 +30,13 @@ increasing):
     50  (reserved: coordination store — uses a Condition-wrapped RLock,
          checked by its own single-class discipline, see coordination.py)
     60  coordination_net, etcd.watches  — store transports
+    74  store_guard                     — store-health state machine +
+                                          heal-callback book
+                                          (service/store_guard.py;
+                                          guards counters only — never
+                                          held across an inner store
+                                          call, a heal callback, or an
+                                          event emit)
     75  obs.failpoints                  — armed fault-injection state
                                           (guards arming only; trip
                                           visibility — registry 93,
@@ -39,6 +49,12 @@ increasing):
     80  obs.events                      — cluster event ring (never
                                           calls out; safe under every
                                           serving-path lock)
+    88  scheduler.elect                 — election triple (is_master,
+                                          epoch, cluster epoch); store
+                                          ops complete BEFORE the lock
+                                          is taken, so it nests inside
+                                          any serving-path lock and
+                                          never calls out
     89  worker.addr                     — master-address + config-stale
                                           pair (innermost CAS, never
                                           calls out; written from the
