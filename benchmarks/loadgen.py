@@ -52,6 +52,12 @@ class RequestResult:
     # Per-request SLO verdict, stamped by summarize_results: online,
     # completed, and met BOTH the TTFT and TPOT targets.
     slo_ok: bool = False
+    # Multimodal request (--mm-ratio): encode_ms is the server-side
+    # "encoded" span duration pulled from /admin/trace/<id> after the
+    # stream finishes — the per-stage latency of the EPD encode plane,
+    # 0.0 when the trace was unavailable.
+    mm: bool = False
+    encode_ms: float = 0.0
 
 
 def _percentile(vals: List[float], p: float) -> float:
@@ -109,7 +115,20 @@ def summarize_results(results: List[Optional[RequestResult]],
                     and (r.tpot_ms == 0.0
                          or r.tpot_ms <= target_tpot_ms))
     good = sum(1 for r in done if r.slo_ok)
+    mm_done = [r for r in ok if r.mm]
+    enc = [r.encode_ms for r in mm_done if r.encode_ms > 0]
+    extra = {}
+    if mm_done:
+        # Per-stage encode latency of the mixed tier (--mm-ratio): the
+        # server-side "encoded" span, so it reflects the EPD stage the
+        # scheduler priced, not client-visible TTFT.
+        extra["mm"] = {
+            "num_ok": len(mm_done),
+            "encode_ms": {"p50": round(_percentile(enc, 50), 2),
+                          "p99": round(_percentile(enc, 99), 2)},
+        }
     return {
+        **extra,
         "num_requests": (num_requests if num_requests is not None
                          else len(done)),
         "num_ok": len(ok),
@@ -180,21 +199,40 @@ def load_sharegpt(path: str, num_requests: int, seed: int = 0,
 
 def run_one(target: str, model: str, prompt_len: int, max_tokens: int,
             offline: bool, timeout: float,
-            prompt_text: Optional[str] = None) -> RequestResult:
-    res = RequestResult(offline=offline)
+            prompt_text: Optional[str] = None,
+            mm_image: Optional[str] = None) -> RequestResult:
+    res = RequestResult(offline=offline, mm=mm_image is not None)
     prompt = prompt_text if prompt_text is not None else \
         " ".join("tok" for _ in range(max(prompt_len // 4, 1)))
-    body = {
-        "model": model, "prompt": prompt, "max_tokens": max_tokens,
-        "temperature": 0.0, "ignore_eos": True, "stream": True,
-        "offline": offline,
-    }
+    if mm_image is not None:
+        # Mixed-traffic tier (--mm-ratio): a chat completion carrying
+        # one image, exercising the EPD encode plane end to end.
+        path = "/v1/chat/completions"
+        body = {
+            "model": model, "messages": [{
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": prompt},
+                    {"type": "image_url",
+                     "image_url": {"url": mm_image}},
+                ]}],
+            "max_tokens": max_tokens, "temperature": 0.0,
+            "ignore_eos": True, "stream": True, "offline": offline,
+        }
+    else:
+        path = "/v1/completions"
+        body = {
+            "model": model, "prompt": prompt, "max_tokens": max_tokens,
+            "temperature": 0.0, "ignore_eos": True, "stream": True,
+            "offline": offline,
+        }
+    rid = ""
     t0 = time.monotonic()
     first = last = 0.0
     tokens = 0
     try:
         status, body_iter = http_stream_status(
-            "POST", target, "/v1/completions", body, timeout=timeout)
+            "POST", target, path, body, timeout=timeout)
         if status != 200:
             # Eager status lets shed (429 + Retry-After, bounded
             # admission) be counted apart from real failures.
@@ -212,6 +250,8 @@ def run_one(target: str, model: str, prompt_len: int, max_tokens: int,
             if obj.get("error"):
                 res.error = str(obj["error"])
                 return res
+            if not rid:
+                rid = str(obj.get("id", ""))
             if not obj.get("choices"):
                 continue
             if first == 0.0:
@@ -230,6 +270,24 @@ def run_one(target: str, model: str, prompt_len: int, max_tokens: int,
     res.num_tokens = tokens
     if tokens > 1:
         res.tpot_ms = 1000.0 * (last - first) / (tokens - 1)
+    if res.mm and rid:
+        # Pull the server-side "encoded" span for this request — the
+        # per-stage encode latency report. Best-effort: the worker
+        # stage rides a heartbeat, so give it one short retry.
+        for _ in range(2):
+            try:
+                status, span = http_json(
+                    "GET", target, f"/admin/trace/{rid}", None,
+                    timeout=10.0)
+            except Exception:  # noqa: BLE001 — report stays 0.0
+                break
+            if status == 200:
+                enc = [e for e in span.get("events", [])
+                       if e.get("stage") == "encoded"]
+                if enc:
+                    res.encode_ms = float(enc[0].get("ms", 0.0) or 0.0)
+                    break
+            time.sleep(0.5)
     return res
 
 
@@ -336,7 +394,8 @@ def run_load(target: str, model: str, num_requests: int,
              target_ttft_ms: float = 1000.0,
              target_tpot_ms: float = 50.0,
              sharegpt_path: Optional[str] = None,
-             chaos: Optional[List[tuple]] = None) -> dict:
+             chaos: Optional[List[tuple]] = None,
+             mm_ratio: float = 0.0) -> dict:
     if sharegpt_path:
         # Trace replay: real prompts + real per-request output lengths.
         plan = [(None, text, out_len) for text, out_len in
@@ -357,16 +416,22 @@ def run_load(target: str, model: str, num_requests: int,
             args=(target, chaos, t_start, chaos_stop), daemon=True)
         chaos_th.start()
 
-    def fire(i: int, plen, text, mt: int, off: bool) -> None:
+    def fire(i: int, plen, text, mt: int, off: bool,
+             image: Optional[str]) -> None:
         started = time.monotonic() - t_start
         r = run_one(target, model, plen or 0, mt, off, timeout,
-                    prompt_text=text)
+                    prompt_text=text, mm_image=image)
         r.started_s = started
         results[i] = r
 
     for i, (plen, text, mt) in enumerate(plan):
         off = rng.random() < offline_fraction
-        th = threading.Thread(target=fire, args=(i, plen, text, mt, off),
+        # Mixed text/image traffic: a small seed pool so repeat images
+        # exercise the encode plane's embedding cache, not only misses.
+        image = (f"random:{rng.randrange(8)}"
+                 if rng.random() < mm_ratio else None)
+        th = threading.Thread(target=fire,
+                              args=(i, plen, text, mt, off, image),
                               daemon=True)
         threads.append(th)
         th.start()
@@ -468,6 +533,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--max-tokens", type=int, default=32)
     ap.add_argument("--mean-prompt-len", type=int, default=64)
     ap.add_argument("--offline-fraction", type=float, default=0.0)
+    ap.add_argument("--mm-ratio", type=float, default=0.0,
+                    help="fraction of requests carrying an image "
+                         "(chat-completion tier through the EPD encode "
+                         "plane); summary gains mm.encode_ms "
+                         "percentiles from the server-side encoded "
+                         "span (open-loop only)")
     ap.add_argument("--target-ttft-ms", type=float, default=1000.0)
     ap.add_argument("--target-tpot-ms", type=float, default=50.0)
     ap.add_argument("--sharegpt", default="",
@@ -492,6 +563,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.chaos and args.closed_loop:
         ap.error("--chaos requires the open-loop harness")
+    if args.mm_ratio and args.closed_loop:
+        ap.error("--mm-ratio requires the open-loop harness")
 
     if args.closed_loop:
         summary = run_closed_loop(
@@ -510,7 +583,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             target_ttft_ms=args.target_ttft_ms,
             target_tpot_ms=args.target_tpot_ms,
             sharegpt_path=args.sharegpt or None,
-            chaos=parse_chaos(args.chaos) if args.chaos else None)
+            chaos=parse_chaos(args.chaos) if args.chaos else None,
+            mm_ratio=args.mm_ratio)
     print(json.dumps(summary))
     return 0
 

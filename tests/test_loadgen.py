@@ -123,6 +123,30 @@ def test_sharegpt_replay(tmp_path):
         store.close()
 
 
+@pytest.mark.slow  # full EPD cluster (~25 s); the encode-plane e2e in
+# test_multimodal.py already pins the span/cache behavior in tier 1.
+def test_loadgen_mm_ratio_reports_encode_latency():
+    """--mm-ratio traffic against an EPD cluster: image requests complete
+    and the summary's mm block carries per-stage encode latency read from
+    the server-side `encoded` span."""
+    from tests.test_multimodal import make_epd_cluster
+    store = InMemoryStore(sweep_interval_s=0.02)
+    master, workers = make_epd_cluster(store)
+    try:
+        summary = run_load(
+            master.http_address, "tiny", num_requests=4,
+            request_rate=0.0, max_tokens=4, mean_prompt_len=16,
+            timeout=120.0, mm_ratio=1.0)
+        assert summary["num_ok"] == 4, summary
+        assert summary["mm"]["num_ok"] == 4, summary
+        assert summary["mm"]["encode_ms"]["p50"] > 0, summary
+    finally:
+        for w in workers:
+            w.stop()
+        master.stop()
+        store.close()
+
+
 def test_parse_chaos_schedule():
     from benchmarks.loadgen import parse_chaos
     assert parse_chaos("store.partition@10+15, store.fail_rpc@40+5") == [

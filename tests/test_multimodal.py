@@ -163,6 +163,79 @@ class TestEpdEndToEnd:
             master2.stop()
             store2.close()
 
+    def test_encode_plane_span_cache_and_death_degradation(self, store):
+        """Acceptance (docs/EPD.md), one cluster, three phases: (1) the
+        encode stage shows up as the request's "encoded" span at
+        /admin/trace/<id>; (2) a second identical image is served from
+        the encode worker's content-addressed embedding cache,
+        byte-identical at temperature 0; (3) with worker.fail_encode
+        armed (count mode) on the dedicated encode worker the request
+        still completes byte-identically (local-encode degradation —
+        never a client error), the hop is COUNTED in
+        xllm_encode_fallback_total and an encode_fallback event fires
+        on the requester."""
+        import http.client
+        import json as _json
+        master, workers = make_epd_cluster(store)
+        try:
+            status, resp = self._request(master)
+            assert status == 200, resp
+            srid = resp["id"]
+
+            def fetch_stages():
+                conn = http.client.HTTPConnection(master.http_address,
+                                                  timeout=10)
+                conn.request("GET", f"/admin/trace/{srid}")
+                r = conn.getresponse()
+                body = r.read().decode()
+                conn.close()
+                if r.status != 200:
+                    return set()
+                return {(e["plane"], e["stage"])
+                        for e in _json.loads(body)["events"]}
+
+            # The worker-side "encoded" stage rides the next heartbeat.
+            assert wait_until(
+                lambda: ("worker", "encoded") in fetch_stages(),
+                timeout=15.0), "encoded span never merged into the trace"
+
+            enc = next(w for w in workers
+                       if w.instance_type == InstanceType.ENCODE)
+            req_w = next(w for w in workers
+                         if w.instance_type != InstanceType.ENCODE)
+            assert enc.encode_cache_misses > 0
+            hits_before = enc.encode_cache_hits
+            status, resp2 = self._request(master)
+            assert status == 200, resp2
+            assert enc.encode_cache_hits > hits_before
+            # Same image + temperature 0 → identical bytes either way.
+            assert resp2["choices"][0]["message"]["content"] == \
+                resp["choices"][0]["message"]["content"]
+
+            enc.failpoints.arm("worker.fail_encode", mode="count", n=8)
+            try:
+                status, degraded = self._request(master)
+            finally:
+                enc.failpoints.disarm("worker.fail_encode")
+            assert status == 200, degraded
+            assert degraded["choices"][0]["message"]["content"] == \
+                resp["choices"][0]["message"]["content"]
+            fb = [e for e in req_w.events.since(0)
+                  if e["type"] == "encode_fallback"]
+            assert fb, "no encode_fallback event on the requester"
+            assert fb[0]["attrs"]["target"] == "local"
+            conn = http.client.HTTPConnection(req_w.name, timeout=10)
+            conn.request("GET", "/metrics")
+            r = conn.getresponse()
+            text = r.read().decode()
+            conn.close()
+            assert r.status == 200
+            assert "xllm_encode_fallback_total" in text
+        finally:
+            for w in workers:
+                w.stop()
+            master.stop()
+
     def test_different_images_different_kv(self, store):
         """Two prompts with identical tokens but different images must not
         share prefix-cache KV (mm sequences bypass the content cache)."""

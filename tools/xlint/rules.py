@@ -369,6 +369,7 @@ LOCK_RANK_TABLE: Dict[str, int] = {
     "worker.engine": 20,
     "kv_cache.tier": 22,
     "worker.kvfetch": 25,
+    "worker.encstage": 26,
     "instance_mgr": 30,
     "kvcache_mgr": 35,
     "coordination_net": 60,
@@ -378,6 +379,7 @@ LOCK_RANK_TABLE: Dict[str, int] = {
     "obs.slo": 78,
     "obs.watchdog": 79,
     "obs.events": 80,
+    "worker.embedcache": 87,
     "scheduler.elect": 88,
     "worker.addr": 89,
     "tracer": 90,

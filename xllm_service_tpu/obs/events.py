@@ -66,6 +66,10 @@ EVENT_TYPES = (
     "master_demoted",       # this replica stopped being master: a
                             # higher-epoch master exists (fenced
                             # split-brain) or re-election was lost
+    "encode_fallback",      # a routed encode stage was not served by
+                            # its chosen instance — rerouted to a
+                            # survivor or degraded to local encode
+                            # (attrs: reason, from, to)
 )
 
 DEFAULT_CAPACITY = 1024

@@ -79,6 +79,13 @@ FAILPOINTS = (
                                  # network partition from the store
                                  # (lease expiry invisible, exactly like
                                  # a real blackout)
+    "worker.fail_encode",        # /encode raises on the encode worker —
+                                 # the requester walks its fallback
+                                 # chain (survivor reroute, then local
+                                 # encode), never a client error
+    "worker.hang_encode",        # /encode blocks for the armed value
+                                 # (s) — exercises the
+                                 # XLLM_ENCODE_TIMEOUT_S deadline path
 )
 
 _MODES = ("always", "count", "after", "prob", "off")

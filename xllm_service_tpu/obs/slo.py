@@ -65,6 +65,7 @@ Snapshot = Dict[str, Tuple[float, float]]
 DEFAULT_TTFT_MS = 1000.0        # mirrors ServiceOptions.target_ttft_ms
 DEFAULT_E2E_MS = 30000.0
 DEFAULT_QUEUE_WAIT_MS = 5000.0
+DEFAULT_ENCODE_MS = 2000.0      # EPD per-call vision-encode bound
 DEFAULT_OBJECTIVE = 0.99        # 99% of requests good
 DEFAULT_AVAILABILITY = 0.999
 DEFAULT_FAST_WINDOW_S = 300.0
@@ -121,6 +122,14 @@ class SloConfig:
                              _env_f(os.environ.get(
                                  "XLLM_SLO_QUEUE_WAIT_MS"),
                                  DEFAULT_QUEUE_WAIT_MS)),
+                # EPD encode latency (docs/EPD.md): judged from the
+                # per-call tower durations workers ship in heartbeats
+                # (xllm_service_encode_ms). No encode traffic → no
+                # samples → the objective is vacuously green.
+                SloObjective("encode", obj,
+                             _env_f(os.environ.get(
+                                 "XLLM_SLO_ENCODE_MS"),
+                                 DEFAULT_ENCODE_MS)),
                 SloObjective("availability",
                              _env_f(os.environ.get(
                                  "XLLM_SLO_AVAILABILITY"),

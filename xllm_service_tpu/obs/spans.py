@@ -31,7 +31,12 @@ from xllm_service_tpu.utils.locks import make_lock
 # occurrence per (stage, plane) wins (see record()).
 SERVICE_STAGES = ("received", "admitted", "scheduled", "dispatched",
                   "first_token", "finished")
-WORKER_STAGES = ("received", "scheduled", "first_token", "finished")
+# "encoded" appears only on multimodal requests: the prefill worker
+# records it once the EPD encode stage resolved (attrs say whether a
+# remote ENCODE instance, a cache hit, or local fallback produced the
+# embeddings — docs/EPD.md).
+WORKER_STAGES = ("received", "encoded", "scheduled", "first_token",
+                 "finished")
 
 DEFAULT_CAPACITY = 2048
 

@@ -98,6 +98,17 @@ class DeviceWire:
         self._server.await_pull(uuid, [k, v])
         return uuid
 
+    def stage_one(self, arr: Any) -> int:
+        """Offer a SINGLE device array for one remote pull (the EPD
+        embedding handoff — docs/EPD.md). Same lifecycle contract as
+        :meth:`stage`; release() handles the 1-tuple arity."""
+        with self._mu:
+            uuid = self._next_uuid
+            self._next_uuid += 1
+            self._staged[uuid] = (arr,)
+        self._server.await_pull(uuid, [arr])
+        return uuid
+
     def release(self, uuid: int, drain: bool = False,
                 leaked: bool = False) -> None:
         """Drop the staged pair. ``await_pull`` has no cancel, so the
@@ -115,11 +126,12 @@ class DeviceWire:
         if entry is None:
             return
         if drain:
-            k, _ = entry
+            k = entry[0]
             try:
                 _pull_via(self._server, {
                     "addr": self.address, "uuid": uuid,
-                    "shape": list(k.shape), "dtype": str(k.dtype)})
+                    "shape": list(k.shape), "dtype": str(k.dtype)},
+                    arity=len(entry))
             except Exception as e:  # noqa: BLE001 — drain is best effort
                 logger.warning("device-wire drain of uuid %d failed (%s);"
                                " block stays pinned", uuid, e)
@@ -155,10 +167,12 @@ def get_device_wire() -> Optional[DeviceWire]:
         return _wire
 
 
-def _pull_via(server: Any, tr: Dict[str, Any]) -> Tuple[Any, Any]:
-    """Pull the staged (k, v) pair described by the ``transfer``
+def _pull_via(server: Any, tr: Dict[str, Any], arity: int = 2) -> Tuple:
+    """Pull the staged array tuple described by the ``transfer``
     handshake dict into this process's devices, via ``server``'s
-    connection pool."""
+    connection pool. ``arity`` matches the staged tuple: 2 for K/V
+    pairs, 1 for single-array (embedding) tickets — the avals presented
+    to pull() must agree with what await_pull registered."""
     import jax
     import jax.numpy as jnp
 
@@ -167,8 +181,7 @@ def _pull_via(server: Any, tr: Dict[str, Any]) -> Tuple[Any, Any]:
     dtype = jnp.dtype(str(tr["dtype"]))
     sharding = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
     aval = jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
-    k, v = conn.pull(int(tr["uuid"]), [aval, aval])
-    return k, v
+    return tuple(conn.pull(int(tr["uuid"]), [aval] * arity))
 
 
 def peek_device_wire() -> Optional["DeviceWire"]:
@@ -203,3 +216,28 @@ def pull_block(tr: Dict[str, Any]) -> Tuple[Any, Any]:
         raise WireNoPull(f"bad transfer ticket: {e}")
     k, v = conn.pull(int(tr["uuid"]), [aval, aval])
     return k, v
+
+
+def pull_one(tr: Dict[str, Any]) -> Any:
+    """Requester side of a single-array (embedding) ticket: same
+    exception contract as :func:`pull_block`, one array back."""
+    wire = get_device_wire()
+    if wire is None:
+        raise WireUnsupported("device wire disabled on this backend")
+    try:
+        conn = wire._server.connect(tr["addr"])
+    except Exception as e:  # noqa: BLE001 — no transfer started yet
+        raise WireNoPull(f"connect to {tr.get('addr')} failed: {e}")
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        shape = tuple(int(s) for s in tr["shape"])
+        dtype = jnp.dtype(str(tr["dtype"]))
+        sharding = jax.sharding.SingleDeviceSharding(
+            jax.local_devices()[0])
+        aval = jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+    except Exception as e:  # noqa: BLE001 — still before the pull
+        raise WireNoPull(f"bad transfer ticket: {e}")
+    (arr,) = conn.pull(int(tr["uuid"]), [aval])
+    return arr

@@ -138,6 +138,38 @@ def mrope_positions(token_ids: Sequence[int], image_token: int,
     return out, base - T
 
 
+def image_digest(spec: Any, seed: int = 0) -> str:
+    """Content digest of one mm_inputs descriptor (hex, 128-bit murmur3
+    over a canonical byte form). Keys the encode plane's
+    content-addressed embedding cache and the scheduler's cache-hit
+    cost term — both sides must derive the SAME digest from the same
+    request descriptor, so this hashes the descriptor bytes, not the
+    decoded pixels (no image decode on the service plane)."""
+    from xllm_service_tpu.utils.hashing import murmur3_x64_128
+    if isinstance(spec, dict) and spec.get("type") in ("image", "video"):
+        spec = spec.get("data")
+    if isinstance(spec, str):
+        payload = spec.encode("utf-8")
+    elif isinstance(spec, dict) and "pixels_b64" in spec:
+        payload = (str(spec.get("shape")).encode("ascii") + b"|"
+                   + spec["pixels_b64"].encode("ascii"))
+    else:
+        payload = repr(spec).encode("utf-8", "replace")
+    return murmur3_x64_128(payload, seed).hex()
+
+
+def embeds_raw_meta(embeds: np.ndarray) -> Dict[str, Any]:
+    """Meta line for the raw-bytes embedding wire (mirrors the
+    /kv/blocks octet-stream: one JSON meta line, then the float32
+    payload)."""
+    arr = np.ascontiguousarray(embeds, dtype=np.float32)
+    return {"shape": list(arr.shape), "dtype": "float32"}
+
+
+def embeds_from_raw(meta: Dict[str, Any], payload: bytes) -> np.ndarray:
+    return np.frombuffer(payload, np.float32).reshape(meta["shape"]).copy()
+
+
 def embeds_to_wire(embeds: np.ndarray) -> Dict[str, Any]:
     arr = np.ascontiguousarray(embeds, dtype=np.float32)
     return {"embeds_b64": base64.b64encode(arr.tobytes()).decode("ascii"),

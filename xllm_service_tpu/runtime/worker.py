@@ -124,6 +124,12 @@ class WorkerOptions:
     warmup: Optional[bool] = None
     seed: int = 0
     murmur_seed: int = 0
+    # EPD dedicated encode mode (``--role encode``, docs/EPD.md): the
+    # vision tower is this worker's ONLY compiled graph — the LM
+    # runtime starts asleep (no Engine, no params, no KV pool), the
+    # worker registers as ENCODE advertising encode capability + image
+    # grid, and generate traffic can never route here.
+    encode_only: bool = False
 
 
 def _decode_kv_blob(meta: Dict[str, Any], blob: bytes):
@@ -458,12 +464,19 @@ class Worker:
         self.engine_cfg = engine_cfg or EngineConfig()
         self.tokenizer = TokenizerFactory.create_tokenizer(opts.model_dir)
 
+        if opts.encode_only:
+            self.instance_type = InstanceType.ENCODE
+            self.opts.instance_type = InstanceType.ENCODE
         self.runtimes: Dict[str, ModelRuntime] = {}
         primary_cfg = resolve_model_config(opts.model, opts.model_dir)
+        # Encode-only mode: the LM runtime starts asleep — engine=None,
+        # no params, no KV pool. Every heartbeat/metrics/registration
+        # path already handles an asleep runtime; the vision tower
+        # below is this worker's only XLA program.
         self.runtimes[opts.model] = ModelRuntime(
             opts.model, primary_cfg, self.engine_cfg, self.tokenizer,
             mesh=mesh, seed=opts.seed, murmur_seed=opts.murmur_seed,
-            model_dir=opts.model_dir)
+            model_dir=opts.model_dir, start_asleep=opts.encode_only)
 
         self._live: Dict[str, _LiveRequest] = {}        # engine rid → live
         self._live_srid: Dict[str, _LiveRequest] = {}   # srid → live
@@ -600,6 +613,7 @@ class Worker:
         router.route("POST", "/kv/blocks_done",
                      self._serve_kv_blocks_done)
         router.route("POST", "/encode", self._serve_encode)
+        router.route("POST", "/encode_done", self._serve_encode_done)
         router.route("POST", "/v1/embeddings", self._serve_embeddings)
         router.route("POST", "/admin/failpoint", self._serve_failpoint)
         router.route("GET", "/admin/failpoints",
@@ -618,6 +632,43 @@ class Worker:
         self.encode_seconds = 0.0
         self.encode_calls = 0
         self.encode_images_total = 0
+        # --- EPD encode plane (docs/EPD.md) ---------------------------
+        # Batched encode queue: every tower invocation on this worker —
+        # remote /encode calls AND the local-fallback path — goes
+        # through one queue drained by the supervised encode loop, so
+        # concurrent requests batch into one tower step and the queue
+        # depth in heartbeats is an honest pressure signal.
+        self._encode_q: "queue.Queue" = queue.Queue()
+        # Content-addressed embedding cache keyed by image digest
+        # (multimodal.image_digest — same spirit as the PR-7 prefix
+        # index): repeated images skip the tower. LRU, bounded by
+        # XLLM_EMBED_CACHE_CAP entries (literal env read for the
+        # flag-registry xlint rule).
+        import collections as _collections
+        self._embed_cache: "_collections.OrderedDict[str, np.ndarray]" \
+            = _collections.OrderedDict()
+        self._embed_cache_cap = int(os.environ.get(
+            "XLLM_EMBED_CACHE_CAP", "256") or 256)
+        self._embed_mu = make_lock("worker.embedcache", 87)
+        # Heartbeat delta of cache digests (stored/evicted since the
+        # last delivered beat) + recent per-step tower durations (ms)
+        # for the service-side encode SLO. All guarded-by:
+        # worker.embedcache; the heartbeat drains them under worker.hb
+        # → worker.embedcache (ranks 5 → 87, increasing).
+        self._embed_stored_pending: List[str] = []
+        self._embed_removed_pending: List[str] = []
+        self._encode_recent_ms: List[float] = []
+        # Encode step ledger (mirrors the engine's step books): steps
+        # run, images per step, cache outcomes.
+        self.encode_steps = 0
+        self.encode_cache_hits = 0
+        self.encode_cache_misses = 0
+        # Device-wire embedding handoff, holder side (mirrors
+        # _kv_fetch_staged): tickets staged for a requester's pull,
+        # uuid → (staged_at, wire). Released by /encode_done or the
+        # heartbeat loop's TTL sweep.
+        self._encode_staged: Dict[int, Tuple[float, Any]] = {}
+        self._encode_staged_mu = make_lock("worker.encstage", 26)
         # KV-migration throughput book (BASELINE.md north-star metric).
         self.kv_migration_bytes = 0
         self.kv_migration_seconds = 0.0
@@ -662,7 +713,7 @@ class Worker:
             admission_exempt=_ADMISSION_EXEMPT + (
                 "/sleep", "/wakeup", "/cancel", "/flip_role",
                 "/fork_master", "/kv/import", "/kv/chunk", "/kv/blocks",
-                "/kv/blocks_done", "/encode"))
+                "/kv/blocks_done", "/encode", "/encode_done"))
         self.name = self._srv.address
 
         # Supervised roots (utils/threads.py): an uncaught exception
@@ -681,6 +732,17 @@ class Worker:
         self._hb_thread = spawn(
             "worker.hb_loop", self._heartbeat_loop,
             thread_name=f"worker-hb-{self.name}",
+            restart=threads.RESTART_POLICY,
+            events=self.events, stop=self._stop)
+        # EPD encode loop (docs/EPD.md): drains the batched encode
+        # queue, one tower step per drain. RESTARTS on a crash — a
+        # silently dead encode loop would hang every queued /encode
+        # call until its deadline instead of failing visibly (per-job
+        # errors are caught inside the step; a restart only fires on a
+        # bug escaping the step harness).
+        self._encode_thread = spawn(
+            "worker.encode_loop", self._encode_loop,
+            thread_name=f"worker-encode-{self.name}",
             restart=threads.RESTART_POLICY,
             events=self.events, stop=self._stop)
         # Registration plane: one lock serializes every revoke→grant→put
@@ -786,6 +848,7 @@ class Worker:
                 KEY_MASTER_ADDR, self._on_master_addr)
         self._loop_thread.start()
         self._hb_thread.start()
+        self._encode_thread.start()
         return self
 
     @property
@@ -927,6 +990,8 @@ class Worker:
                 pass            # TTL expires it anyway
         self._loop_thread.join(timeout=5)
         self._hb_thread.join(timeout=5)
+        if self._encode_thread.ident is not None:
+            self._encode_thread.join(timeout=5)
 
     def _register(self) -> None:
         """Write the registration key under a TTL lease
@@ -963,6 +1028,13 @@ class Worker:
             hash_seed=self.opts.murmur_seed,
             kv_block_bytes=eng.kv_block_bytes() if eng is not None
             else 0,
+            # EPD encode-plane advertisement (docs/EPD.md): ENCODE
+            # workers (and encode-only mode) serve the vision tower as
+            # a first-class stage; the grid is the compiled serve-time
+            # image side.
+            encode_capable=(self.instance_type == InstanceType.ENCODE
+                            or self.opts.encode_only),
+            encode_image_size=self._encode_image_size(),
         )
         with self._reg_mu:
             if self._lease_id is not None:
@@ -1511,7 +1583,8 @@ class Worker:
                 expand_image_placeholders, image_token_id)
             routing = body.get("routing") or {}
             embeds = self._resolve_mm_embeds(
-                mm_inputs, routing.get("encode_name", ""))
+                mm_inputs, routing.get("encode_name", ""),
+                routing.get("encode_fallbacks", []), srid)
             n_img, tpi, _ = embeds.shape
             img_tok = image_token_id(rt.model_cfg.vocab_size)
             token_ids, mm_positions = expand_image_placeholders(
@@ -1853,6 +1926,30 @@ class Worker:
             self.encode_calls)
         obs.counter("xllm_worker_encode_images_total").set_total(
             self.encode_images_total)
+        # Encode-plane books (docs/EPD.md): step ledger, embedding-cache
+        # effectiveness, queue depth, staged-handoff tickets.
+        obs.counter("xllm_worker_encode_steps_total",
+                    "batched encode steps executed").set_total(
+            self.encode_steps)
+        obs.counter("xllm_encode_cache_hits_total",
+                    "images served from the content-addressed "
+                    "embedding cache").set_total(self.encode_cache_hits)
+        obs.counter("xllm_encode_cache_misses_total",
+                    "images that required a tower run").set_total(
+            self.encode_cache_misses)
+        obs.gauge("xllm_worker_encode_queue_depth",
+                  "encode jobs waiting for the batched encode "
+                  "loop").set(self._encode_q.qsize())
+        with self._embed_mu:
+            cache_len = len(self._embed_cache)
+        obs.gauge("xllm_worker_embed_cache_entries",
+                  "embeddings resident in the content-addressed "
+                  "cache").set(cache_len)
+        with self._encode_staged_mu:
+            enc_staged = len(self._encode_staged)
+        obs.gauge("xllm_worker_encode_staged",
+                  "embedding tickets staged on the device wire "
+                  "awaiting a requester pull").set(enc_staged)
         obs.counter("xllm_worker_kv_migration_bytes_total").set_total(
             self.kv_migration_bytes)
         obs.counter("xllm_worker_kv_migration_seconds_total").set_total(
@@ -2129,40 +2226,353 @@ class Worker:
         self.encode_images_total += len(mm_inputs)
         return out
 
+    def _encode_image_size(self) -> int:
+        """Advertised serve-time image grid (registration): the
+        compiled tower's side when it exists, 0 otherwise — peeks, never
+        builds the tower (registration must not compile anything the
+        deployment doesn't need)."""
+        with self._vision_lock:
+            if self._vision is None:
+                return 0
+            _kind, vcfg, _fn = self._vision
+            return int(getattr(vcfg, "image_size", 0) or 0)
+
+    # -- batched encode queue + step ledger (docs/EPD.md) --------------
+    def _encode_loop(self) -> None:
+        """Supervised root: drain the encode queue, one tower step per
+        drain. Per-job failures (bad image specs) are attached to the
+        job, never escape — a crash here means a bug, and the spawn
+        harness restarts the loop so queued callers aren't stranded."""
+        while not self._stop.is_set():
+            try:
+                job = self._encode_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            jobs = [job]
+            while len(jobs) < 64:
+                try:
+                    jobs.append(self._encode_q.get_nowait())
+                except queue.Empty:
+                    break
+            self._encode_step(jobs)
+
+    def _encode_step(self, jobs: List[Dict[str, Any]]) -> None:
+        """One encode step: resolve every job's digests against the
+        embedding cache, run the tower ONCE over all missed images
+        across jobs, fill the cache (recording the heartbeat delta),
+        and hand each job its [N, tokens_per_image, hidden] result."""
+        t0 = time.monotonic()
+        # Cache lookups first (never hold the cache lock across the
+        # tower call).
+        need: List[Tuple[int, int]] = []     # (job idx, image idx)
+        rows: List[List[Optional[np.ndarray]]] = []
+        with self._embed_mu:
+            for ji, job in enumerate(jobs):
+                jrows: List[Optional[np.ndarray]] = []
+                for ii, dig in enumerate(job["digests"]):
+                    hit = self._embed_cache.get(dig)
+                    if hit is not None:
+                        self._embed_cache.move_to_end(dig)
+                        self.encode_cache_hits += 1
+                        jrows.append(hit)
+                    else:
+                        self.encode_cache_misses += 1
+                        jrows.append(None)
+                        need.append((ji, ii))
+                rows.append(jrows)
+        fresh: Dict[Tuple[int, int], np.ndarray] = {}
+        if need:
+            try:
+                batch = [jobs[ji]["mm"][ii] for ji, ii in need]
+                out = self.encode_images(batch)
+            except Exception as e:  # noqa: BLE001 — per-job verdict:
+                # a bad image spec is the CALLER's 400, not an encode-
+                # loop crash stranding every queued job.
+                for job in jobs:
+                    job["err"] = e
+                    job["ev"].set()
+                return
+            stored: List[str] = []
+            evicted: List[str] = []
+            with self._embed_mu:
+                for pos, (ji, ii) in enumerate(need):
+                    emb = np.asarray(out[pos], np.float32)
+                    fresh[(ji, ii)] = emb
+                    dig = jobs[ji]["digests"][ii]
+                    if dig not in self._embed_cache:
+                        self._embed_cache[dig] = emb
+                        stored.append(dig)
+                        while len(self._embed_cache) > \
+                                self._embed_cache_cap:
+                            old, _ = self._embed_cache.popitem(last=False)
+                            evicted.append(old)
+                self._embed_stored_pending.extend(stored)
+                self._embed_removed_pending.extend(evicted)
+        step_ms = 1000.0 * (time.monotonic() - t0)
+        self.encode_steps += 1
+        self.obs.histogram(
+            "xllm_worker_encode_step_ms",
+            "wall time of one batched encode step").observe(step_ms)
+        with self._embed_mu:
+            self._encode_recent_ms.append(step_ms)
+            del self._encode_recent_ms[:-64]
+        for ji, job in enumerate(jobs):
+            try:
+                emb_rows = [r if r is not None else fresh[(ji, ii)]
+                            for ii, r in enumerate(rows[ji])]
+                job["out"] = np.stack(emb_rows)
+                job["hits"] = sum(1 for r in rows[ji] if r is not None)
+            except Exception as e:  # noqa: BLE001 — shape mismatch
+                job["err"] = e      # across cached towers is a verdict,
+            job["ev"].set()         # not a loop crash
+
+    def encode_via_queue(self, mm_inputs: List[Any],
+                         timeout: Optional[float] = None
+                         ) -> Tuple[np.ndarray, int]:
+        """Encode through the batched queue + embedding cache. Returns
+        (embeds, cache_hits). Raises the per-job error (bad specs) or
+        TimeoutError when the loop couldn't serve within ``timeout``."""
+        from xllm_service_tpu.runtime.multimodal import image_digest
+        job: Dict[str, Any] = {
+            "mm": list(mm_inputs),
+            "digests": [image_digest(m, self.opts.murmur_seed)
+                        for m in mm_inputs],
+            "ev": threading.Event()}
+        self._encode_q.put(job)
+        if not job["ev"].wait(timeout if timeout and timeout > 0
+                              else 300.0):
+            raise TimeoutError("encode queue did not serve the job "
+                               "in time")
+        if "err" in job:
+            raise job["err"]
+        return job["out"], int(job.get("hits", 0))
+
     def _serve_encode(self, req: Request) -> Response:
         return self._guarded(self._serve_encode_inner, req)
 
     def _serve_encode_inner(self, req: Request) -> Response:
-        from xllm_service_tpu.runtime.multimodal import embeds_to_wire
+        from xllm_service_tpu.runtime.multimodal import (
+            embeds_raw_meta, embeds_to_wire)
+        # Chaos sites (docs/ROBUSTNESS.md): fail → the requester walks
+        # its fallback chain; hang → exercises the requester's
+        # XLLM_ENCODE_TIMEOUT_S deadline.
+        hang = self.failpoints.fire("worker.hang_encode")
+        if hang is not None:
+            self._stop.wait(float(hang) if hang is not True else 30.0)
+        if self.failpoints.fire("worker.fail_encode") is not None:
+            return Response.error(
+                500, "injected encode failure "
+                     "(failpoint worker.fail_encode)")
         body = req.json()
         images = body.get("images") or body.get("mm_inputs") or []
         if not images:
             return Response.error(400, "no images")
         try:
-            embeds = self.encode_images(images)
+            embeds, hits = self.encode_via_queue(images)
         except ValueError as e:
             return Response.error(400, str(e))
-        return Response.json(embeds_to_wire(embeds))
+        except TimeoutError as e:
+            return Response.error(503, str(e), "unavailable")
+        # Embedding handoff (mirrors /kv/blocks): device-wire staged
+        # ticket when the requester can pull, raw octet-stream (meta
+        # line + float32 payload) otherwise; legacy base64-JSON only
+        # for callers that asked for neither.
+        if body.get("wire") and self.opts.pd_device_wire:
+            from xllm_service_tpu.runtime.kv_wire import get_device_wire
+            wire = get_device_wire()
+            if wire is not None:
+                try:
+                    dev = jnp.asarray(embeds)
+                    uuid = wire.stage_one(dev)
+                except Exception as e:  # noqa: BLE001 — wire broke
+                    logger.warning("embed staging failed (%s); serving "
+                                   "raw", e)
+                else:
+                    with self._encode_staged_mu:
+                        self._encode_staged[uuid] = (time.monotonic(),
+                                                     wire)
+                    return Response.json({
+                        "status": "staged", "cache_hits": hits,
+                        "transfer": {"addr": wire.address, "uuid": uuid,
+                                     "shape": list(embeds.shape),
+                                     "dtype": "float32"}})
+        if body.get("raw"):
+            meta = embeds_raw_meta(embeds)
+            meta["cache_hits"] = hits
+            payload = (json.dumps(stamp(meta)).encode("utf-8") + b"\n"
+                       + np.ascontiguousarray(
+                           embeds, dtype=np.float32).tobytes())
+            return Response(body=payload,
+                            content_type="application/octet-stream")
+        out = embeds_to_wire(embeds)
+        out["cache_hits"] = hits
+        return Response.json(out)
+
+    def _serve_encode_done(self, req: Request) -> Response:
+        """Requester's pull acknowledgment for a staged embedding
+        ticket — same release contract as /kv/blocks_done."""
+        try:
+            body = req.json()
+            uuid = int(body.get("uuid"))
+        except Exception:  # noqa: BLE001 — bad JSON / missing uuid
+            return Response.error(400, "invalid body")
+        outcome = body.get("outcome", "pulled")
+        with self._encode_staged_mu:
+            entry = self._encode_staged.pop(uuid, None)
+        if entry is None:
+            return Response.json({"ok": True, "known": False})
+        _, wire = entry
+        if outcome == "pulled":
+            wire.release(uuid)
+        elif outcome == "nopull":
+            wire.release(uuid, drain=True)
+        else:
+            wire.release(uuid, leaked=True)
+        return Response.json({"ok": True, "known": True})
+
+    def _sweep_encode_staged(self, ttl: float = 60.0) -> None:
+        """Heartbeat-cadence TTL sweep of embedding tickets whose
+        requester never acknowledged (died mid-pull) — transfer state
+        unknown, count the pin as leaked (kv_wire release contract)."""
+        now = time.monotonic()
+        with self._encode_staged_mu:
+            stale = [(u, e) for u, e in self._encode_staged.items()
+                     if now - e[0] > ttl]
+            for u, _ in stale:
+                del self._encode_staged[u]
+        for u, (_, wire) in stale:
+            wire.release(u, leaked=True)
+
+    def _count_encode_fallback(self, reason: str, from_name: str,
+                               to_name: str) -> None:
+        """Satellite telemetry (docs/EPD.md): a routed encode stage not
+        served by its chosen instance is COUNTED and an event — never
+        just a log line."""
+        self.obs.counter(
+            "xllm_encode_fallback_total",
+            "routed encode stages rerouted to a survivor or degraded "
+            "to local encode, by reason",
+            labelnames=("reason",)).inc(reason=reason)
+        self.events.emit("encode_fallback", reason=reason,
+                         source=from_name, target=to_name)
+        logger.warning("encode fallback (%s): %s -> %s", reason,
+                       from_name, to_name or "local")
+
+    def _fetch_remote_embeds(self, target: str, mm_inputs: List[Any],
+                             timeout: float
+                             ) -> Tuple[np.ndarray, int]:
+        """One remote /encode attempt against ``target``; understands
+        all three response forms (staged wire ticket, raw octet-stream,
+        legacy base64 JSON). Raises on any failure — the caller owns
+        the fallback walk."""
+        from xllm_service_tpu.runtime.kv_wire import (
+            WireNoPull, WireUnsupported, get_device_wire, pull_one)
+        from xllm_service_tpu.runtime.multimodal import (
+            embeds_from_raw, embeds_from_wire)
+        from xllm_service_tpu.service.httpd import http_stream_status
+        can_pull = bool(self.opts.pd_device_wire
+                        and target not in self._wire_refused
+                        and get_device_wire() is not None)
+        status, body_iter = http_stream_status(
+            "POST", target, "/encode",
+            obj=stamp({"images": mm_inputs, "raw": True,
+                       "wire": can_pull}),
+            timeout=timeout)
+        raw = b"".join(body_iter)
+        if status != 200:
+            raise RuntimeError(f"/encode returned HTTP {status}")
+        if raw.startswith(b"{") and b"\n" not in raw:
+            head = json.loads(raw.decode("utf-8"))
+            tr = head.get("transfer")
+            if head.get("status") == "staged" and tr:
+                outcome = "pulled"
+                arr = None
+                try:
+                    arr = np.asarray(jax.device_get(pull_one(tr)),
+                                     np.float32)
+                except (WireUnsupported, WireNoPull):
+                    outcome = "nopull"
+                except Exception:  # noqa: BLE001 — failed mid-pull
+                    outcome = "error"
+                try:
+                    http_json("POST", target, "/encode_done",
+                              {"uuid": tr.get("uuid"),
+                               "outcome": outcome}, timeout=10.0)
+                except Exception:  # noqa: BLE001 — holder TTL-sweeps it
+                    pass
+                if arr is None:
+                    raise RuntimeError(
+                        f"embed wire pull failed ({outcome})")
+                return arr, int(head.get("cache_hits", 0))
+            # Legacy base64-JSON body.
+            return embeds_from_wire(head), int(head.get("cache_hits", 0))
+        nl = raw.find(b"\n")
+        if nl < 0:
+            raise ValueError("malformed raw embed payload")
+        meta = json.loads(raw[:nl].decode("utf-8"))
+        return (embeds_from_raw(meta, raw[nl + 1:]),
+                int(meta.get("cache_hits", 0)))
 
     def _resolve_mm_embeds(self, mm_inputs: List[Any],
-                           encode_name: str) -> np.ndarray:
-        """EPD encode stage: remote ENCODE worker when routed, local
-        fallback otherwise (the reference's EPD routing reuses the PD
-        machinery with a third role — SURVEY.md §7.1)."""
-        from xllm_service_tpu.runtime.multimodal import embeds_from_wire
-        if encode_name and encode_name != self.name:
+                           encode_name: str,
+                           fallbacks: Optional[List[str]] = None,
+                           srid: str = "") -> np.ndarray:
+        """EPD encode stage (docs/EPD.md): walk the routed encode
+        instance then its ranked survivors under one
+        XLLM_ENCODE_TIMEOUT_S deadline (jittered RetryPolicy pacing
+        between attempts), then degrade to LOCAL encode — an encode-
+        worker death is never a client-visible error. Every hop off the
+        routed instance counts xllm_encode_fallback_total{reason} and
+        emits an encode_fallback event; the resolved stage is recorded
+        as the request's "encoded" span."""
+        t_start = time.monotonic()
+        try:
+            total = float(os.environ.get(
+                "XLLM_ENCODE_TIMEOUT_S", "120") or 120)
+        except ValueError:
+            total = 120.0
+        deadline = t_start + total
+        policy = RetryPolicy(max_attempts=1, base_delay_s=0.05,
+                             max_delay_s=2.0, multiplier=2.0,
+                             jitter=0.5)
+        targets: List[str] = []
+        for t in [encode_name] + list(fallbacks or []):
+            if t and t != self.name and t not in targets:
+                targets.append(t)
+        for attempt, target in enumerate(targets):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0.05:
+                self._count_encode_fallback("deadline", target, "local")
+                break
             try:
-                status, resp = http_json(
-                    "POST", encode_name, "/encode",
-                    {"images": mm_inputs}, timeout=120.0)
-                if status == 200:
-                    return embeds_from_wire(resp)
-                logger.warning("encode worker %s returned %s; encoding "
-                               "locally", encode_name, status)
-            except Exception as e:  # noqa: BLE001
-                logger.warning("encode worker %s unreachable (%s); "
-                               "encoding locally", encode_name, e)
-        return self.encode_images(mm_inputs)
+                embeds, hits = self._fetch_remote_embeds(
+                    target, mm_inputs, timeout=remaining)
+            except Exception as e:  # noqa: BLE001 — any transport /
+                # holder failure walks the chain; the reason label
+                # keeps the classes distinguishable.
+                nxt = targets[attempt + 1] \
+                    if attempt + 1 < len(targets) else "local"
+                self._count_encode_fallback(
+                    "unreachable" if isinstance(e, (OSError,
+                                                    ConnectionError))
+                    else "error", target, nxt)
+                policy.sleep(attempt, deadline=deadline,
+                             stop_event=self._stop)
+                continue
+            if srid:
+                self.spans.record(
+                    srid, "encoded", plane="worker", remote=target,
+                    cache_hits=hits, images=len(mm_inputs),
+                    ms=round(1000.0 * (time.monotonic() - t_start), 3))
+            return embeds
+        embeds, hits = self.encode_via_queue(
+            mm_inputs, timeout=max(deadline - time.monotonic(), 5.0))
+        if srid:
+            self.spans.record(
+                srid, "encoded", plane="worker", remote="",
+                cache_hits=hits, images=len(mm_inputs),
+                ms=round(1000.0 * (time.monotonic() - t_start), 3))
+        return embeds
 
     # ------------------------------------------------------------------
     # PD disaggregation (SURVEY.md §7.2 step 7): prefill here, decode on
@@ -3314,6 +3724,7 @@ class Worker:
                 with self._kv_chunk_mu:
                     self._evict_stale_chunks_locked(time.monotonic())
                 self._sweep_kv_fetch_staged()
+                self._sweep_encode_staged()
                 if self.failpoints.fire(
                         "worker.drop_heartbeats") is not None:
                     # Simulated crash/partition: no store keepalive, no
@@ -3509,6 +3920,21 @@ class Worker:
         # span ring (same correlation id); an undelivered batch is
         # requeued so the next beat retries it.
         span_batch = self.spans.drain_finished()
+        # Encode-plane beat payload (docs/EPD.md): queue depth + step
+        # latency feed the scheduler's cost-aware encode pick; the
+        # embedding-cache digest delta feeds its hit estimator. Same
+        # delivery contract as spans — an undelivered delta is requeued.
+        with self._embed_mu:
+            embed_stored = self._embed_stored_pending
+            embed_removed = self._embed_removed_pending
+            enc_ms = self._encode_recent_ms
+            self._embed_stored_pending = []
+            self._embed_removed_pending = []
+            self._encode_recent_ms = []
+        load.encode_queue_depth = self._encode_q.qsize()
+        if enc_ms:
+            self._latency.encode_ms = sum(enc_ms) / len(enc_ms)
+            self._latency.encode_ms_samples = list(enc_ms)
         # EVERYTHING between the drain and a delivered beat sits inside
         # the try: a Heartbeat construction or serialization that
         # raises must requeue the drained batch exactly like a failed
@@ -3521,7 +3947,8 @@ class Worker:
                 cache_stored=stored, cache_removed=removed,
                 cache_offloaded=offloaded,
                 cache_offloaded_ssd=offloaded_ssd,
-                model_states=model_states, spans=span_batch)
+                model_states=model_states, spans=span_batch,
+                embed_stored=embed_stored, embed_removed=embed_removed)
             self._latency = LatencyMetrics()
             status, ack = http_json("POST", self.service_addr,
                                     "/rpc/heartbeat", stamp(hb.to_json()),
@@ -3530,6 +3957,7 @@ class Worker:
             self.spans.requeue(span_batch)
             if cache_ev is not None and not cache_ev.empty:
                 self._hb_cache_pending = cache_ev
+            self._requeue_encode_hb(embed_stored, embed_removed, enc_ms)
             raise
         if status == 200 and isinstance(ack, dict):
             ack_epoch = int(ack.get("epoch", 0) or 0)
@@ -3543,6 +3971,8 @@ class Worker:
                 self.spans.requeue(span_batch)
                 if cache_ev is not None and not cache_ev.empty:
                     self._hb_cache_pending = cache_ev
+                self._requeue_encode_hb(embed_stored, embed_removed,
+                                        enc_ms)
                 logger.warning(
                     "rejected beat-ack from deposed master at %s "
                     "(epoch %d < acked %d)", self.service_addr,
@@ -3554,9 +3984,23 @@ class Worker:
             self.spans.requeue(span_batch)
             if cache_ev is not None and not cache_ev.empty:
                 self._hb_cache_pending = cache_ev
+            self._requeue_encode_hb(embed_stored, embed_removed, enc_ms)
         else:
             self._hb_step_cum = step_baseline
         return status == 200
+
+    def _requeue_encode_hb(self, stored: List[str], removed: List[str],
+                           ms: List[float]) -> None:
+        """Fold an undelivered encode-plane beat payload back into the
+        pending buffers (front, preserving delta order) so the next
+        beat retries it — the service's digest set would silently drift
+        from the cache otherwise."""
+        if not (stored or removed or ms):
+            return
+        with self._embed_mu:
+            self._embed_stored_pending[:0] = stored
+            self._embed_removed_pending[:0] = removed
+            self._encode_recent_ms[:0] = ms
 
     def heartbeat_once(self) -> None:
         """Test helper: one synchronous heartbeat."""
@@ -3588,6 +4032,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--instance-type", default="MIX",
                         choices=[t.value for t in InstanceType])
+    parser.add_argument("--role", default="",
+                        choices=["", "encode"],
+                        help="'encode' = dedicated encode worker: the "
+                             "vision tower is the only compiled graph, "
+                             "no LM runtime is built (docs/EPD.md)")
     parser.add_argument("--service-addr", default="",
                         help="service RPC host:port for heartbeats")
     parser.add_argument("--store-addr", default="",
@@ -3662,7 +4111,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         heartbeat_interval_s=args.heartbeat_interval_s,
         lease_ttl_s=3 * args.heartbeat_interval_s,
         enable_profiling=args.enable_profiling, warmup=args.warmup,
-        murmur_seed=args.murmur_seed)
+        murmur_seed=args.murmur_seed,
+        encode_only=(args.role == "encode"))
     worker = Worker(opts, store, engine_cfg=engine_cfg, mesh=mesh).start()
     logger.info("worker %s serving model %s (type %s)",
                 worker.name, args.model, args.instance_type)

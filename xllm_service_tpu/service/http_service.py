@@ -176,6 +176,15 @@ class HttpService:
             "xllm_service_queue_wait_ms",
             "received -> dispatched to a worker (schedule + rewrite + "
             "redispatch time)")
+        # EPD encode stage (docs/EPD.md): per-call vision-encode
+        # durations shipped in worker heartbeats
+        # (LatencyMetrics.encode_ms_samples) — observed by the
+        # scheduler's heartbeat path into this same registry, judged
+        # here by the "encode" SLO objective.
+        self.h_encode = self.obs.histogram(
+            "xllm_service_encode_ms",
+            "per-call vision-encode duration across the worker fleet "
+            "(heartbeat-shipped samples)")
 
         # --- the judgment layer (SLO engine + event log + watchdog) ----
         # Shared event log (Master passes the cluster-wide one so the
@@ -245,7 +254,8 @@ class HttpService:
                       for o in self.slo_cfg.objectives}
         out: Dict[str, Any] = {}
         for name, hist in (("ttft", self.h_ttft), ("e2e", self.h_e2e),
-                           ("queue_wait", self.h_queue_wait)):
+                           ("queue_wait", self.h_queue_wait),
+                           ("encode", self.h_encode)):
             bs = hist.cumulative()
             if bs is None:
                 out[name] = (0.0, 0.0)

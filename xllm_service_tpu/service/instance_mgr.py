@@ -78,6 +78,12 @@ class InstanceState:
         # service-side digests, so the cluster index must not ingest
         # them and the fetch planner must not elect it a holder.
         self.digest_compatible = True
+        # EPD embedding-cache advertisement (docs/EPD.md): hex image
+        # digests this worker's embed cache currently holds, folded
+        # from heartbeat deltas (embed_stored/embed_removed). Bounded
+        # by the worker's own cache cap; the cost-aware encode pick
+        # credits hits against it.
+        self.embed_digests: Set[str] = set()
 
     @property
     def name(self) -> str:
@@ -363,6 +369,14 @@ class InstanceMgr:
             inst.last_heartbeat = time.monotonic()
             inst.load = hb.load
             inst.latency = hb.latency
+            if hb.embed_stored or hb.embed_removed:
+                inst.embed_digests.difference_update(hb.embed_removed)
+                inst.embed_digests.update(hb.embed_stored)
+                # Defensive bound: a worker that never reports
+                # evictions must not grow this set without limit.
+                if len(inst.embed_digests) > 4096:
+                    inst.embed_digests = set(
+                        list(inst.embed_digests)[-4096:])
             if hb.model_states:
                 inst.model_states.update(hb.model_states)
         if stage is not None:
@@ -580,6 +594,51 @@ class InstanceMgr:
             name = pool[self._rr_encode % len(pool)]
             self._rr_encode += 1
             return name
+
+    # Prior for the per-image encode cost before a worker has reported
+    # a measured value (LatencyMetrics.encode_ms == 0.0).
+    _ENCODE_MS_PRIOR = 50.0
+
+    def select_encode_instance(self, digests: List[str],
+                               audit: Optional[Dict[str, Any]] = None
+                               ) -> Tuple[Optional[str], List[str]]:
+        """Cost-aware EPD encode pick (docs/EPD.md): score every live
+        ENCODE instance on measured per-image encode ms × the work it
+        would actually do — queued jobs ahead plus THIS request's
+        cache-missed images (heartbeat-advertised embed digests credit
+        the hits). Returns (winner, ranked survivors); the survivors
+        ride ``Routing.encode_fallbacks`` so the prefill worker's
+        reroute on encode death is deterministic. (None, []) when no
+        encode pool exists — the prefill worker encodes locally."""
+        n_img = max(1, len(digests))
+        scored: List[Tuple[float, str, Dict[str, Any]]] = []
+        with self._lock:
+            for name, s in self._instances.items():
+                if s.instance_type != InstanceType.ENCODE \
+                        or self._is_draining_locked(name):
+                    continue
+                queue = int(getattr(s.load, "encode_queue_depth", 0))
+                enc_ms = float(getattr(s.latency, "encode_ms", 0.0)) \
+                    or self._ENCODE_MS_PRIOR
+                hits = sum(1 for d in digests if d in s.embed_digests)
+                misses = len(digests) - hits
+                # Queued jobs ahead are priced at one image each (the
+                # queue ships depth, not image count); cache-hit images
+                # skip the tower entirely.
+                est_ms = enc_ms * (queue + misses)
+                scored.append((est_ms, name, {
+                    "queue": queue, "encode_ms": round(enc_ms, 3),
+                    "cache_hits": hits, "est_ms": round(est_ms, 3)}))
+        scored.sort(key=lambda t: (t[0], t[1]))
+        if audit is not None:
+            audit["encode"] = {
+                "policy": "cost", "images": n_img,
+                "candidates": {name: terms for _, name, terms in scored},
+                "winner": scored[0][1] if scored else None,
+            }
+        if not scored:
+            return None, []
+        return scored[0][1], [name for _, name, _ in scored[1:]]
 
     def address_of(self, name: str) -> Optional[str]:
         inst = self.get(name)

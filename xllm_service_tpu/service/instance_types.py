@@ -56,6 +56,14 @@ class InstanceMetaInfo:
     # Bytes of one content-addressed KV block (k+v, all layers) — the
     # fetch-vs-recompute cost model's bytes term.
     kv_block_bytes: int = 0
+    # EPD encode-plane advertisement (docs/EPD.md): True when this
+    # worker serves the vision tower as its own stage (dedicated ENCODE
+    # workers, and encode-capable MIX workers). ``encode_image_size`` is
+    # the fixed serve-time image grid side the tower was compiled for —
+    # the requester needs it only for diagnostics (the mrope grid is
+    # derived from the returned embeds), 0 = not advertised.
+    encode_capable: bool = False
+    encode_image_size: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -86,6 +94,8 @@ class InstanceMetaInfo:
             page_size=int(d.get("page_size", 0) or 0),
             hash_seed=int(d.get("hash_seed", 0) or 0),
             kv_block_bytes=int(d.get("kv_block_bytes", 0) or 0),
+            encode_capable=bool(d.get("encode_capable", False)),
+            encode_image_size=int(d.get("encode_image_size", 0) or 0),
         )
 
 
@@ -100,6 +110,10 @@ class LoadMetrics:
     # MoE capacity-dropped (token, expert) assignments since engine boot
     # (0 on dense models) — routing/ops visibility into quality pressure.
     moe_dropped_tokens: int = 0
+    # EPD encode-plane pressure (docs/EPD.md): jobs waiting in the
+    # worker's batched encode queue at heartbeat time — the cost-aware
+    # encode pick's queue-depth term.
+    encode_queue_depth: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -139,6 +153,14 @@ class LatencyMetrics:
     # term so prefill queueing can't hide behind a single global queue
     # (P/D-Serve backlog awareness).
     waiting_prefill_tokens: int = 0
+    # EPD encode stage (docs/EPD.md): mean per-image encode ms over the
+    # tower calls since the previous beat (0.0 = no encodes ran — the
+    # cost-aware pick falls back to its prior), plus the raw per-call
+    # durations (ms, bounded) the service observes into its
+    # ``xllm_service_encode_ms`` histogram for the encode SLO objective.
+    encode_ms: float = 0.0
+    encode_ms_samples: List[float] = dataclasses.field(
+        default_factory=list)
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -203,6 +225,12 @@ class Heartbeat:
     cache_offloaded: List[str] = dataclasses.field(default_factory=list)
     cache_offloaded_ssd: List[str] = dataclasses.field(
         default_factory=list)
+    # EPD embedding-cache delta (docs/EPD.md): hex image digests whose
+    # encoded embeddings this worker gained/evicted since the last
+    # beat. The instance manager folds them into its per-instance
+    # digest books so the cost-aware encode pick can credit cache hits.
+    embed_stored: List[str] = dataclasses.field(default_factory=list)
+    embed_removed: List[str] = dataclasses.field(default_factory=list)
     # Per-model sleep/wake state for the serverless layer.
     model_states: Dict[str, str] = dataclasses.field(default_factory=dict)
     # Finished request-span timelines since the last beat
@@ -222,6 +250,8 @@ class Heartbeat:
             "cache_removed": self.cache_removed,
             "cache_offloaded": self.cache_offloaded,
             "cache_offloaded_ssd": self.cache_offloaded_ssd,
+            "embed_stored": self.embed_stored,
+            "embed_removed": self.embed_removed,
             "model_states": self.model_states,
             "spans": self.spans,
             "timestamp": self.timestamp,
@@ -242,6 +272,8 @@ class Heartbeat:
             cache_removed=list(d.get("cache_removed", [])),
             cache_offloaded=list(d.get("cache_offloaded", [])),
             cache_offloaded_ssd=list(d.get("cache_offloaded_ssd", [])),
+            embed_stored=list(d.get("embed_stored", [])),
+            embed_removed=list(d.get("embed_removed", [])),
             model_states=dict(d.get("model_states", {})),
             spans=list(d.get("spans", [])),
             timestamp=d.get("timestamp", time.time()),

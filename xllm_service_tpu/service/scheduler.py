@@ -568,14 +568,23 @@ class Scheduler:
             routing.kv_fetch = self._plan_kv_fetch(
                 request.token_ids, routing.prefill_name, audit,
                 model=request.model)
-        self._record_decision(request, audit)
-
-        # EPD: route the encode stage to a dedicated ENCODE instance when
-        # one exists (the prefill worker falls back to local encode).
-        if request.mm_inputs:
-            enc = self.instance_mgr.get_next_encode_instance()
+        else:
+            # EPD: cost-aware encode pick (queue depth + measured encode
+            # ms + embed-cache hit credit from heartbeats — docs/EPD.md).
+            # BEFORE _record_decision so the pick's terms land in the
+            # schedule_decision audit like every other routing choice.
+            from xllm_service_tpu.runtime.multimodal import image_digest
+            # Same seed as the workers' embed caches — a seed mismatch
+            # only mis-estimates cache hits, never correctness (the
+            # worker re-digests with its own seed).
+            digests = [image_digest(m, self.opts.murmur_hash3_seed)
+                       for m in request.mm_inputs]
+            enc, fallbacks = self.instance_mgr.select_encode_instance(
+                digests, audit=audit)
             if enc:
                 routing.encode_name = enc
+                routing.encode_fallbacks = fallbacks
+        self._record_decision(request, audit)
 
         request.routing = routing
         self.instance_mgr.update_request_metrics(
@@ -1045,6 +1054,18 @@ class Scheduler:
     # ------------------------------------------------------------------
     def handle_instance_heartbeat(self, hb: Heartbeat) -> bool:
         registered = self.instance_mgr.on_heartbeat(hb)
+        if registered and hb.latency.encode_ms_samples \
+                and self.obs is not None:
+            # EPD encode SLO feed (docs/EPD.md): per-call tower
+            # durations ride the beat; the service observes them into
+            # the same histogram /metrics exports and the "encode"
+            # objective judges (http_service._slo_snapshot).
+            h = self.obs.histogram("xllm_service_encode_ms")
+            for ms in hb.latency.encode_ms_samples[:64]:
+                try:
+                    h.observe(float(ms))
+                except (TypeError, ValueError):
+                    continue
         if registered and (hb.cache_stored or hb.cache_removed
                            or hb.cache_offloaded
                            or hb.cache_offloaded_ssd):

@@ -25,6 +25,10 @@ increasing):
     25  worker.kvfetch                  — staged cross-worker fetch
                                           wire tickets (guards the dict
                                           only; releases happen outside)
+    26  worker.encstage                 — staged embedding-handoff wire
+                                          tickets (same discipline as
+                                          worker.kvfetch: dict only,
+                                          releases outside)
     30  instance_mgr                    — instance books (re-entrant)
     35  kvcache_mgr                     — global prefix index
     50  (reserved: coordination store — uses a Condition-wrapped RLock,
@@ -49,6 +53,10 @@ increasing):
     80  obs.events                      — cluster event ring (never
                                           calls out; safe under every
                                           serving-path lock)
+    87  worker.embedcache               — content-addressed embedding
+                                          cache + heartbeat digest-delta
+                                          buffers (never calls out; the
+                                          tower runs OUTSIDE the lock)
     88  scheduler.elect                 — election triple (is_master,
                                           epoch, cluster epoch); store
                                           ops complete BEFORE the lock

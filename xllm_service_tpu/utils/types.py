@@ -191,6 +191,12 @@ class Routing:
     prefill_name: str = ""
     decode_name: str = ""
     encode_name: str = ""
+    # Ranked encode survivors (docs/EPD.md): the scheduler's cost-aware
+    # encode pick emits the remaining candidates in score order; the
+    # prefill worker walks them when ``encode_name`` fails, so an
+    # encode-worker death reroutes deterministically (the same list on
+    # retry) before degrading to local encode.
+    encode_fallbacks: List[str] = dataclasses.field(default_factory=list)
     # Cross-worker cached-block fetch plan (docs/KV_CACHE.md): when the
     # scheduler places a request on a non-holder with a nonzero cluster
     # prefix match AND the fetch-vs-recompute cost model says fetching
@@ -204,6 +210,8 @@ class Routing:
         out = {"prefill_name": self.prefill_name,
                "decode_name": self.decode_name,
                "encode_name": self.encode_name}
+        if self.encode_fallbacks:
+            out["encode_fallbacks"] = list(self.encode_fallbacks)
         if self.kv_fetch:
             out["kv_fetch"] = dict(self.kv_fetch)
         return out
@@ -214,6 +222,7 @@ class Routing:
             return cls()
         return cls(d.get("prefill_name", ""), d.get("decode_name", ""),
                    d.get("encode_name", ""),
+                   encode_fallbacks=list(d.get("encode_fallbacks", [])),
                    kv_fetch=d.get("kv_fetch") or None)
 
 
