@@ -292,10 +292,17 @@ def run_one(target: str, model: str, prompt_len: int, max_tokens: int,
 
 
 def parse_chaos(spec: str) -> List[tuple]:
-    """Parse a ``--chaos`` schedule: ``name@start+duration[,...]`` —
-    e.g. ``store.partition@10+15`` arms the ``store.partition``
-    failpoint 10 s into the run and disarms it 15 s later. Returns
-    ``(name, start_s, duration_s)`` tuples sorted by start."""
+    """Parse a ``--chaos`` schedule:
+    ``name[=mode[:arg[:value]]]@start+duration[,...]`` — e.g.
+    ``store.partition@10+15`` arms the ``store.partition`` failpoint
+    (mode ``always``) 10 s into the run and disarms it 15 s later;
+    ``worker.fault_step=prob:0.2@5+10`` makes ~1 in 5 engine steps
+    fault for 10 s, and ``worker.fault_step_req=always:POISON@5+10``
+    faults every step whose batch holds a prompt containing "POISON"
+    (the poison-pill drill — docs/ROBUSTNESS.md device-plane fault
+    contract). ``worker.*`` names broadcast to every registered worker
+    via the admin proxy's ``{"instance": "*"}``. Returns
+    ``(name_or_spec, start_s, duration_s)`` tuples sorted by start."""
     stages: List[tuple] = []
     for part in spec.split(","):
         part = part.strip()
@@ -305,16 +312,47 @@ def parse_chaos(spec: str) -> List[tuple]:
         start_s, _, dur_s = when.partition("+")
         if not name or not start_s or not dur_s:
             raise ValueError(
-                f"bad chaos stage {part!r}; want name@start+duration")
+                f"bad chaos stage {part!r}; want "
+                f"name[=mode[:arg]]@start+duration")
         stages.append((name, float(start_s), float(dur_s)))
     return sorted(stages, key=lambda s: s[1])
 
 
 def _arm_failpoint(target: str, spec: str) -> None:
+    body: dict = {"spec": spec}
+    if spec.startswith("worker."):
+        # Worker-plane sites live behind the admin proxy; "*" asks the
+        # service to arm every registered worker.
+        body["instance"] = "*"
     status, resp = http_json("POST", target, "/admin/failpoint",
-                             {"spec": spec}, timeout=5.0)
+                             body, timeout=5.0)
     if status != 200:
         raise RuntimeError(f"failpoint {spec!r} -> {status}: {resp}")
+
+
+def _fault_counters(target: str) -> dict:
+    """Scrape the service /metrics for the device-plane fault ledger:
+    contained engine faults (``xllm_events_total{type="engine_fault"}``
+    — one per blame verdict struck at the fan-in) and poisoned
+    requests (``xllm_requests_poisoned_total``). Best-effort: a target
+    mid-blackout reports zeros."""
+    import http.client
+    host, _, port = target.partition(":")
+    out = {"engine_fault_events": 0.0, "poisoned_requests": 0.0}
+    try:
+        conn = http.client.HTTPConnection(host, int(port or 80),
+                                          timeout=5.0)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode("utf-8", "replace")
+        conn.close()
+    except Exception:  # noqa: BLE001 — scrape is advisory
+        return out
+    for line in text.splitlines():
+        if line.startswith('xllm_events_total{type="engine_fault"}'):
+            out["engine_fault_events"] = float(line.rsplit(" ", 1)[-1])
+        elif line.startswith("xllm_requests_poisoned_total"):
+            out["poisoned_requests"] = float(line.rsplit(" ", 1)[-1])
+    return out
 
 
 def run_chaos_schedule(target: str, stages: List[tuple], t_start: float,
@@ -326,8 +364,10 @@ def run_chaos_schedule(target: str, stages: List[tuple], t_start: float,
     for name, start_s, dur_s in stages:
         if stop.wait(max(0.0, t_start + start_s - time.monotonic())):
             return
+        base = name.split("=", 1)[0]
         try:
-            _arm_failpoint(target, f"{name}=always")
+            _arm_failpoint(target,
+                           name if "=" in name else f"{name}=always")
         except Exception as e:  # noqa: BLE001 — a dead target ends the
             print(f"chaos: arming {name} failed: {e}")  # schedule only
             continue
@@ -336,7 +376,7 @@ def run_chaos_schedule(target: str, stages: List[tuple], t_start: float,
                           - time.monotonic()))
         finally:
             try:
-                _arm_failpoint(target, f"{name}=off")
+                _arm_failpoint(target, f"{base}=off")
             except Exception as e:  # noqa: BLE001
                 print(f"chaos: disarming {name} failed: {e}")
         if stop.is_set():
@@ -410,7 +450,9 @@ def run_load(target: str, model: str, num_requests: int,
     t_start = time.monotonic()
     chaos_stop = threading.Event()
     chaos_th: Optional[threading.Thread] = None
+    faults_before: Optional[dict] = None
     if chaos:
+        faults_before = _fault_counters(target)
         chaos_th = threading.Thread(
             target=run_chaos_schedule,
             args=(target, chaos, t_start, chaos_stop), daemon=True)
@@ -453,6 +495,16 @@ def run_load(target: str, model: str, num_requests: int,
         summary["chaos"] = chaos_stage_summaries(
             results, chaos, wall, target_ttft_ms=target_ttft_ms,
             target_tpot_ms=target_tpot_ms)
+        # Device-plane fault ledger across the run (delta of the
+        # service counters — docs/ROBUSTNESS.md): blame verdicts
+        # struck and requests failed as poison pills.
+        after = _fault_counters(target)
+        summary["chaos"]["contained_faults"] = int(
+            after["engine_fault_events"]
+            - (faults_before or {}).get("engine_fault_events", 0.0))
+        summary["chaos"]["poisoned_requests"] = int(
+            after["poisoned_requests"]
+            - (faults_before or {}).get("poisoned_requests", 0.0))
     return summary
 
 

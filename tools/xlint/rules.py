@@ -366,6 +366,7 @@ LOCK_RANK_TABLE: Dict[str, int] = {
     "worker.reg": 8,
     "scheduler.req": 10,
     "worker.live": 10,
+    "service.poison": 11,
     "worker.engine": 20,
     "kv_cache.tier": 22,
     "worker.kvfetch": 25,

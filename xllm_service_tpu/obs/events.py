@@ -70,6 +70,15 @@ EVENT_TYPES = (
                             # its chosen instance — rerouted to a
                             # survivor or degraded to local encode
                             # (attrs: reason, from, to)
+    "engine_fault",         # a worker contained a device-plane step
+                            # fault and blamed this request — one poison
+                            # strike (attrs: service_request_id,
+                            # instance, verdict, strikes)
+    "request_quarantined",  # the poison ledger hit XLLM_POISON_STRIKES:
+                            # request failed to the client, its prompt
+                            # digest quarantined for XLLM_POISON_TTL_S
+                            # (attrs: service_request_id, digest,
+                            # strikes, ttl_s)
 )
 
 DEFAULT_CAPACITY = 1024

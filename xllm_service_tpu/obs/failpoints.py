@@ -86,6 +86,13 @@ FAILPOINTS = (
     "worker.hang_encode",        # /encode blocks for the armed value
                                  # (s) — exercises the
                                  # XLLM_ENCODE_TIMEOUT_S deadline path
+    "worker.fault_step",         # raise inside the engine step fault
+                                 # boundary — a device-plane step fault
+                                 # (count/after/prob choose which step)
+    "worker.fault_step_req",     # raise only while a MARKED request is
+                                 # in the step's batch (value: prompt
+                                 # substring to mark; no value marks
+                                 # all) — the poison-pill simulator
 )
 
 _MODES = ("always", "count", "after", "prob", "off")
@@ -224,6 +231,21 @@ class Failpoints:
                 labelnames=("name",)).inc(name=name)
         if self.events is not None:
             self.events.emit("failpoint_tripped", name=name)
+
+    def armed_value(self, name: str) -> Optional[Any]:
+        """Non-firing peek at an armed site: the armed value (``True``
+        when none was set), ``None`` when disarmed. For sites whose
+        *setup* needs the arming (worker.fault_step_req marks requests
+        at admission) without consuming the fire budget."""
+        self._check_name(name)
+        if name not in self._armed:
+            return None                 # same benign race as fire()
+        with self._lock:
+            spec = self._armed.get(name)
+            if spec is None:
+                return None
+            value = spec["value"]
+        return value if value is not None else True
 
     # -- querying -------------------------------------------------------
     def trips(self, name: str) -> int:

@@ -336,9 +336,13 @@ class InstanceSignal:
     heartbeat_deadline_s: float
     step_ms_p99: Optional[float] = None     # recent, worker-shipped
     kv_usage: float = 0.0                   # [0, 1]
+    # Heartbeat-carried LoadMetrics.engine_alive: 0 once the worker's
+    # engine fault breaker let its loop die (docs/ROBUSTNESS.md).
+    engine_alive: int = 1
 
 
-ANOMALY_TYPES = ("heartbeat_gap", "step_ms_regression", "kv_saturation")
+ANOMALY_TYPES = ("heartbeat_gap", "step_ms_regression", "kv_saturation",
+                 "engine_dead")
 
 
 class AnomalyDetector:
@@ -404,6 +408,15 @@ class AnomalyDetector:
             open_=sig.kv_usage >= self.kv_sat,
             attrs={"kv_usage": round(sig.kv_usage, 4),
                    "threshold": self.kv_sat},
+            transitions=transitions)
+        # Dead engine loop (the fault breaker opened): the worker still
+        # heartbeats — store keepalive continues — but serves nothing;
+        # without this signal the gap between thread_crashed and lease
+        # expiry is invisible to the service plane.
+        self._set_locked(
+            "engine_dead", sig.name,
+            open_=sig.engine_alive == 0,
+            attrs={"engine_alive": sig.engine_alive},
             transitions=transitions)
         # Step-time p99 regression vs. the rolling baseline. The
         # baseline only learns from non-anomalous samples — folding the

@@ -34,9 +34,11 @@ SERVICE_STAGES = ("received", "admitted", "scheduled", "dispatched",
 # "encoded" appears only on multimodal requests: the prefill worker
 # records it once the EPD encode stage resolved (attrs say whether a
 # remote ENCODE instance, a cache hit, or local fallback produced the
-# embeddings — docs/EPD.md).
+# embeddings — docs/EPD.md). "faulted" appears only on requests the
+# engine-step fault boundary blamed and evicted (docs/ROBUSTNESS.md
+# device-plane fault contract).
 WORKER_STAGES = ("received", "encoded", "scheduled", "first_token",
-                 "finished")
+                 "faulted", "finished")
 
 DEFAULT_CAPACITY = 2048
 

@@ -410,6 +410,22 @@ class Engine:
         self.prefix_hit_tokens = 0
         self.fetched_blocks = 0
 
+        # Device-plane fault containment (docs/ROBUSTNESS.md): the
+        # worker's step fault boundary reads ``step_members`` (the
+        # request ids of the section a fault escaped from) to attribute
+        # blame, reads ``last_step_partial_outs`` to salvage the
+        # committed outputs of the iteration's completed sections, and
+        # calls ``fault_reset``/``isolate`` to recover. ``fault_hook``
+        # is the worker-installed injection point for the
+        # worker.fault_step* failpoints — called with each section's
+        # membership, it may raise.
+        self.fault_hook: Optional[Callable[[Tuple[str, ...]], None]] = \
+            None
+        self.step_members: Tuple[str, ...] = ()
+        self.last_step_partial_outs: List[StepOutput] = []
+        self._fault_isolated = False
+        self._parked: List[Sequence] = []
+
         # Per-phase wall-time ledger (seconds) + event counts. On the
         # tunneled backend the only trustworthy timings are host-side
         # (docs/PERF_NOTES.md): "dispatch" is the async jit call (tracing
@@ -600,6 +616,11 @@ class Engine:
             self.drain_pipeline()
         seq = Sequence(req=req, tokens=list(req.token_ids))
         self._by_id[req.request_id] = seq
+        if self._fault_isolated:
+            # Mid-bisection arrival: park it so a fault probe stays
+            # confined to the suspect half (fault_reset/isolate below).
+            self._parked.append(seq)
+            return
         self.waiting.append(seq)
         self._sort_waiting()
 
@@ -897,6 +918,12 @@ class Engine:
         stall under prompt bursts."""
         self.step_count += 1
         outs = self._drain_cancelled()
+        # The same list every section extends in place: on a step fault
+        # the worker salvages the completed sections' outputs from here
+        # (a committed decode's tokens are already on the sequences —
+        # losing their StepOutputs would silently drop stream tokens).
+        self.last_step_partial_outs = outs
+        self.step_members = ()
         self.last_step_prefill_tokens = 0
         self.last_step_decode_tokens = 0
         self.last_step_prefill_s = 0.0
@@ -955,6 +982,7 @@ class Engine:
         burst's batch snapshot) and keeping the step's prefill token /
         window / wall-time ledger."""
         self.drain_pipeline()
+        self._note_members(batch)
         # Occupancy is the PROMPT tokens this batch computes (the
         # scheduled windows), not the one sampled token per window.
         self.last_step_prefill_windows = tuple(
@@ -965,6 +993,7 @@ class Engine:
         self.last_step_prefill_s = time.monotonic() - t0
 
     def _decode_once(self) -> List[StepOutput]:
+        self._note_members(self.running)
         N = self.ecfg.decode_steps
         # The fused scan writes KV at positions up to len+N-2; any
         # sequence that would cross max_model_len must take single
@@ -1603,6 +1632,99 @@ class Engine:
         pending, self._pending = self._pending, None
         if pending is not None:
             self._discard_spec(pending)
+
+    # ------------------------------------------------------------------
+    # Device-plane fault containment (docs/ROBUSTNESS.md): the worker's
+    # step fault boundary drives these. All run under the worker's
+    # engine lock, same as step().
+    # ------------------------------------------------------------------
+    def _note_members(self, seqs: List["Sequence"]) -> None:
+        """Record a section's batch membership for fault attribution,
+        then give the worker's injection hook a chance to raise."""
+        self.step_members = tuple(s.req.request_id for s in seqs)
+        if self.fault_hook is not None:
+            self.fault_hook(self.step_members)
+
+    def live_request_ids(self) -> Tuple[str, ...]:
+        """Every request the engine still owns (``_by_id`` is ground
+        truth — a mid-step exception can orphan a sequence from both
+        the running and waiting lists)."""
+        return tuple(self._by_id)
+
+    def isolate(self, keep_rids: Sequence[str]) -> None:
+        """Confine the next step to ``keep_rids``: every other live
+        sequence is preempted out of running (its KV is from a
+        known-good point, so normal recompute preemption applies) and
+        parked out of waiting. New admissions park too, so a bisection
+        probe can never pick up a bystander."""
+        keep = set(keep_rids)
+        self._fault_isolated = True
+        for seq in [s for s in self.running
+                    if s.req.request_id not in keep]:
+            self._preempt_seq(seq)
+        parked = [s for s in self.waiting
+                  if s.req.request_id not in keep]
+        for seq in parked:
+            self.waiting.remove(seq)
+        self._parked.extend(parked)
+
+    def release_isolation(self) -> None:
+        """Undo ``isolate``: parked sequences rejoin the waiting queue
+        (probe survivors keep their progress)."""
+        self._fault_isolated = False
+        parked, self._parked = self._parked, []
+        for seq in parked:
+            if seq.status != SeqStatus.FINISHED \
+                    and seq not in self.waiting:
+                self.waiting.append(seq)
+        if parked:
+            self._sort_waiting()
+
+    def fault_reset(self, evict_rids: Sequence[str] = ()) -> List[str]:
+        """Contained recovery from a step fault: restore the engine to
+        a known-good point with ``evict_rids`` gone and every survivor
+        requeued for re-prefill (recompute keeps generated tokens —
+        the same resume shape as preemption). Device KV touched by the
+        faulted step is suspect, so pages are released WITHOUT being
+        content-addressed into the prefix cache, and any speculative
+        carry is dropped cold. Returns the ids actually evicted."""
+        self.release_isolation()
+        try:
+            self.drain_pipeline()
+        except Exception:  # noqa: BLE001 — the carry itself may be the
+            self._pending = None  # corrupt state; drop it unconsumed
+        evict = set(evict_rids)
+        evicted: List[str] = []
+        for seq in list(self._by_id.values()):
+            self._release_seq_slot(seq)
+            self.prefix_cache.release_pages([p for p in seq.pages if p])
+            seq.pages = []
+            seq.num_trimmed = 0
+            seq.num_computed = 0
+            seq.sched_window = 0
+            seq.prompt_lps = None
+            if seq in self.running:
+                self.running.remove(seq)
+            if seq.req.request_id in evict:
+                seq.status = SeqStatus.FINISHED
+                if seq in self.waiting:
+                    self.waiting.remove(seq)
+                self._by_id.pop(seq.req.request_id, None)
+                self._cancelled.discard(seq.req.request_id)
+                evicted.append(seq.req.request_id)
+            else:
+                seq.status = SeqStatus.WAITING
+                seq.preemptions += 1
+                self.num_preemptions += 1
+                if seq not in self.waiting:
+                    self.waiting.append(seq)
+        self._sort_waiting()
+        # Batched device state is rebuilt from host truth on the next
+        # step; stale copies must not survive the fault.
+        self._counts = None
+        self._slot_st = None
+        self._bias = None
+        return evicted
 
     def _note_burst_gap(self, overlapped: bool) -> None:
         """Device-idle attribution per burst boundary: host time between

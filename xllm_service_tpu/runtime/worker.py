@@ -33,6 +33,7 @@ import threading
 
 import time
 import weakref
+from collections import deque
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -80,6 +81,39 @@ MODEL_DRAINING = "draining"
 # with a tidy [DONE] — _ABORT makes the consumer RAISE so the client
 # socket breaks mid-stream, exactly like a SIGKILL'd process.
 _ABORT = object()
+
+
+class StepFaultInjected(Exception):
+    """Raised by the worker.fault_step* failpoints inside the engine's
+    step fault boundary — a deterministic device-plane fault for chaos
+    tests (docs/ROBUSTNESS.md, device-plane fault contract)."""
+
+
+class _EngineFault:
+    """Queue sentinel for a request blamed by the step fault boundary:
+    the consumer emits the typed ``engine_fault`` error (500 / error
+    frame carrying the blame verdict) instead of a generic broken
+    stream, so the service can count a poison strike."""
+
+    __slots__ = ("verdict",)
+
+    def __init__(self, verdict: str) -> None:
+        self.verdict = verdict
+
+
+def _classify_step_fault(exc: BaseException) -> str:
+    """Transient device faults (a flaky transport, a device timeout)
+    are retried in place with no one blamed; anything else is treated
+    as deterministic and attributed by bisection. Matched by type NAME
+    for the XLA runtime error so the classification needs no jaxlib
+    import at module scope."""
+    if isinstance(exc, (TimeoutError, ConnectionError)):
+        return "transient"
+    if type(exc).__name__ == "XlaRuntimeError" and any(
+            tag in str(exc) for tag in
+            ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED", "CANCELLED")):
+        return "transient"
+    return "deterministic"
 
 # Token-count buckets for the prefill-quantum histogram (pow2 — window
 # sizes are bucketed prompt chunks, not latencies, so the default ms
@@ -513,6 +547,32 @@ class Worker:
         # workers; armed via XLLM_FAILPOINTS and POST /admin/failpoint.
         # Trips surface as xllm_failpoints_tripped_total{name}.
         self.failpoints = Failpoints(obs=self.obs)
+        # Device-plane fault containment (docs/ROBUSTNESS.md): the
+        # engine loop's step dispatch runs inside a fault boundary that
+        # evicts the blamed request set (attributed by bisection under
+        # _fault_bisect_budget extra probe steps) and resumes, instead
+        # of dying. The crash-loop breaker falls back to today's
+        # visible engine death once _fault_times exceeds the limit
+        # inside the window — containment can never loop forever on
+        # corrupt state.
+        self._fault_bisect_budget = int(os.environ.get(
+            "XLLM_FAULT_BISECT_BUDGET", "4") or 4)
+        self._fault_limit = int(os.environ.get(
+            "XLLM_ENGINE_FAULT_LIMIT", "5") or 5)
+        self._fault_window_s = float(os.environ.get(
+            "XLLM_ENGINE_FAULT_WINDOW_S", "60") or 60)
+        # Contained-fault timestamps inside the breaker window; engine-
+        # loop thread only.
+        self._fault_times: "deque[float]" = deque()
+        # Engine request ids marked as poison pills by the
+        # worker.fault_step_req failpoint. guarded-by: worker.engine
+        self._fault_marked: set = set()
+        # Liveness flag behind xllm_worker_engine_alive and the
+        # heartbeat's LoadMetrics.engine_alive: True while the engine
+        # loop serves, False once the breaker let it die. Plain bool —
+        # written by the engine-loop thread, read by heartbeat/scrape
+        # (benign race).
+        self._engine_loop_alive = True
         # Store guard (service/store_guard.py): this worker's own view
         # of coordination-store health, wired to ITS failpoints so the
         # co-located harness blacks out one plane without touching its
@@ -721,10 +781,16 @@ class Worker:
         # thread_crashed instead of killing the thread silently. The
         # heartbeat loop RESTARTS with jittered backoff — a dead beat
         # loop is indistinguishable from a dead worker to the master
-        # (lease expiry) — while the engine loop stays down on a crash:
-        # engine state may be mid-step-corrupt and a supervised death
-        # is visible (metrics/event) where a restart could silently
-        # serve from a broken pool.
+        # (lease expiry) — while the engine loop stays DELIBERATELY
+        # non-restarting: step faults are already contained INSIDE the
+        # loop by the fault boundary (_contain_engine_fault — classify,
+        # bisect blame, fault_reset, resume; docs/ROBUSTNESS.md
+        # device-plane fault contract), so an exception that still
+        # escapes means containment itself failed (crash-loop breaker
+        # or boundary bug) and device state is unknown — a supervised
+        # visible death (engine_alive gauge 0 → engine_dead anomaly →
+        # lease-expiry recovery) is correct where a blind restart could
+        # silently serve from a broken pool.
         self._loop_thread = spawn(
             "worker.engine_loop", self._engine_loop,
             thread_name=f"worker-loop-{self.name}",
@@ -1074,31 +1140,213 @@ class Worker:
             busy = False
             for rt in list(self.runtimes.values()):
                 eng = rt.engine
-                if eng is None or not eng.has_work():
+                if eng is None:
+                    continue
+                if eng.fault_hook is None:
+                    # (Re)installed lazily: wakeup builds a fresh Engine.
+                    eng.fault_hook = self._step_fault_hook
+                if not eng.has_work():
                     continue
                 busy = True
                 t0 = time.monotonic()
-                with self._engine_lock:
-                    outs = eng.step()
+                try:
+                    with self._engine_lock:
+                        outs = eng.step()
+                except Exception as exc:  # noqa: BLE001 — the step
+                    # fault boundary (docs/ROBUSTNESS.md): contain,
+                    # attribute, resume — or re-raise through the
+                    # breaker into today's visible engine death.
+                    step_ms = 1000.0 * (time.monotonic() - t0)
+                    if not self._contain_engine_fault(rt, exc, step_ms):
+                        self._engine_loop_alive = False
+                        self._engine_alive_gauge().set(0, model=rt.model)
+                        raise
+                    continue
                 step_ms = 1000.0 * (time.monotonic() - t0)
                 self._dispatch_outputs(rt, outs, step_ms)
                 self._flush_engine_obs(rt, step_ms)
+                self._engine_alive_gauge().set(1, model=rt.model)
             if not busy:
                 self._work_event.wait(timeout=0.05)
                 self._work_event.clear()
 
-    def _flush_engine_obs(self, rt: ModelRuntime, step_ms: float) -> None:
+    def _engine_alive_gauge(self):
+        return self.obs.gauge(
+            "xllm_worker_engine_alive",
+            "1 while the engine loop serves this model; 0 once the "
+            "fault breaker let it die (docs/ROBUSTNESS.md) — the "
+            "anomaly watchdog opens engine_dead on the heartbeat copy",
+            labelnames=("model",))
+
+    def _step_fault_hook(self, member_rids: Tuple[str, ...]) -> None:
+        """Installed as Engine.fault_hook — called (under the engine
+        lock) with each step section's batch membership. The injection
+        point for the two chaos failpoints."""
+        if self.failpoints.fire("worker.fault_step") is not None:
+            raise StepFaultInjected("worker.fault_step")
+        if self._fault_marked \
+                and self._fault_marked.intersection(member_rids) \
+                and self.failpoints.fire(
+                    "worker.fault_step_req") is not None:
+            raise StepFaultInjected("worker.fault_step_req")
+
+    def _contain_engine_fault(self, rt: ModelRuntime,
+                              exc: BaseException,
+                              step_ms: float) -> bool:
+        """The step fault boundary's recovery path. Returns True when
+        the fault was contained (loop resumes), False when the
+        crash-loop breaker is open (caller re-raises into the
+        supervised death path — lease-expiry recovery, as before this
+        boundary existed)."""
+        eng = rt.engine
+        # Satellite fix: the faulted iteration's obs flush used to be
+        # lost entirely (the exception skipped _flush_engine_obs) —
+        # flush it with its own phase label before anything else.
+        self._flush_engine_obs(rt, step_ms, phase="fault")
+        faults = self.obs.counter(
+            "xllm_engine_faults_total",
+            "engine step faults seen by the fault boundary, by "
+            "containment outcome (docs/ROBUSTNESS.md)",
+            labelnames=("model", "outcome"))
+        now = time.monotonic()
+        self._fault_times.append(now)
+        while self._fault_times and \
+                now - self._fault_times[0] > self._fault_window_s:
+            self._fault_times.popleft()
+        if len(self._fault_times) > self._fault_limit:
+            faults.inc(model=rt.model, outcome="uncontained")
+            logger.error(
+                "engine fault breaker open (%d faults in %.0fs window) "
+                "— falling back to engine death: %s",
+                len(self._fault_times), self._fault_window_s, exc)
+            return False
+        kind = _classify_step_fault(exc)
+        probe_outs: List[Tuple[List[Any], float]] = []
+        with self._engine_lock:
+            live_ids = set(eng.live_request_ids())
+            suspects = [r for r in eng.step_members if r in live_ids] \
+                or sorted(live_ids)
+            # Committed outputs of the iteration's COMPLETED sections
+            # (e.g. the decode that ran before a faulting prefill):
+            # their tokens are already on the sequences, so dropping
+            # the StepOutputs would silently lose stream tokens.
+            salvaged = list(eng.last_step_partial_outs)
+            if kind == "transient":
+                blamed: List[str] = []
+                eng.fault_reset(())
+            else:
+                blamed, probe_outs = self._bisect_step_fault(
+                    eng, suspects)
+                eng.fault_reset(blamed)
+            self._fault_marked.difference_update(blamed)
+        outcome = ("transient_retry" if kind == "transient" else
+                   "culprit" if len(blamed) == 1 else
+                   "whole_batch" if blamed else
+                   # Deterministic fault that no probe could reproduce:
+                   # nobody blamed, retry in place like a transient.
+                   "transient_retry")
+        verdict = (f"{outcome} [{type(exc).__name__}: {exc}] "
+                   f"on {self.name}")
+        logger.warning("engine step fault contained (%s): blamed %s",
+                       outcome, blamed or "nobody")
+        blamed_set = set(blamed)
+        salvaged = [o for o in salvaged
+                    if o.request_id not in blamed_set]
+        if salvaged:
+            self._dispatch_outputs(rt, salvaged, step_ms)
+        for outs, ms in probe_outs:
+            kept = [o for o in outs if o.request_id not in blamed_set]
+            if kept:
+                self._dispatch_outputs(rt, kept, ms)
+        faults.inc(model=rt.model, outcome=outcome)
+        if blamed:
+            self._fail_lives_engine_fault(blamed, verdict)
+        self._work_event.set()
+        return True
+
+    def _bisect_step_fault(self, eng, suspects: List[str]
+                           ) -> Tuple[List[str],
+                                      List[Tuple[List[Any], float]]]:
+        """Blame attribution: retry halves of the faulting batch in
+        isolation under the XLLM_FAULT_BISECT_BUDGET probe-step budget.
+        A faulting half narrows the suspect set; a clean half is
+        exonerated (its probe outputs are returned for dispatch — the
+        probe made real progress). On budget exhaustion the whole
+        remaining suspect set is blamed. Runs under the engine lock."""
+        probe_outs: List[Tuple[List[Any], float]] = []
+        budget = self._fault_bisect_budget
+        if len(suspects) <= 1 or budget <= 0:
+            return list(suspects), probe_outs
+        eng.fault_reset(())      # known-good point before probing
+        while len(suspects) > 1 and budget > 0:
+            half = suspects[:max(1, len(suspects) // 2)]
+            budget -= 1
+            t0 = time.monotonic()
+            outs: List[Any] = []
+            faulted = False
+            try:
+                eng.isolate(half)
+                outs = eng.step()
+            except Exception:  # noqa: BLE001 — the probe reproduced
+                faulted = True  # the fault: suspects narrow to this half
+            finally:
+                eng.release_isolation()
+            if faulted:
+                eng.fault_reset(())
+                suspects = list(half)
+            else:
+                probe_outs.append(
+                    (outs, 1000.0 * (time.monotonic() - t0)))
+                suspects = [r for r in suspects if r not in half]
+        return list(suspects), probe_outs
+
+    def _fail_lives_engine_fault(self, rids: List[str],
+                                 verdict: str) -> None:
+        """Surface blamed-and-evicted requests to their consumers as
+        the typed engine_fault failure (not a generic stream break):
+        relay consumers get the _EngineFault sentinel, RPC fan-in gets
+        a finished RequestOutput with an INTERNAL engine_fault status
+        carrying the blame verdict."""
+        to_service: List[RequestOutput] = []
+        for rid in rids:
+            with self._live_lock:
+                live = self._live.get(rid)
+            if live is None:
+                continue
+            self.spans.record(live.service_request_id, "faulted",
+                              plane="worker")
+            if live.stream_to_service:
+                to_service.append(RequestOutput(
+                    request_id=rid,
+                    service_request_id=live.service_request_id,
+                    status=Status(StatusCode.INTERNAL,
+                                  f"engine_fault: {verdict}"),
+                    finished=True))
+            else:
+                live.q.put(_EngineFault(verdict))
+            # Cancels sibling choices still in the engine and clears
+            # the live maps; the blamed rid itself is already evicted
+            # (a cancel on it is benign).
+            self._finalize_live(live)
+        if to_service and self.service_addr:
+            self._push_outputs_to_service(to_service)
+
+    def _flush_engine_obs(self, rt: ModelRuntime, step_ms: float,
+                          phase: Optional[str] = None) -> None:
         """Per-iteration flush of step-level engine stats into the
         registry: queue depths / KV utilization / preemptions (via
         ``_engine_load``, the single load_metrics assembly point), batch
         token occupancy split prefill vs decode, per-step wall time, and
         the phase/recompile ledger. Runs on the engine-loop thread right
-        after ``step()`` — ``last_step_*`` are only written there."""
+        after ``step()`` — ``last_step_*`` are only written there.
+        ``phase`` overrides the step-kind label: the fault boundary
+        flushes the faulted iteration with ``phase="fault"`` (the flush
+        used to be lost entirely when an exception skipped it)."""
         eng = rt.engine
         if eng is None:
             return
         self._engine_load(rt)
-        kind = eng.last_step_kind
+        kind = phase or eng.last_step_kind
         if kind == "idle":
             return
         m = rt.model
@@ -1351,6 +1599,9 @@ class Worker:
                     for erid in unfinished:
                         rt.engine.cancel(erid)
                 self._work_event.set()
+        if self._fault_marked:          # unguarded peek is benign: a
+            with self._engine_lock:     # stale mark only re-marks
+                self._fault_marked.difference_update(live.engine_rids)
 
     def _process_step_output(self, live: _LiveRequest,
                              out: StepOutput) -> List[RequestOutput]:
@@ -1659,7 +1910,25 @@ class Worker:
             self._live_srid[srid] = live
             for erid in live.engine_rids:
                 self._live[erid] = live
+        # Poison-pill marking (worker.fault_step_req failpoint): a
+        # non-firing peek at the armed value decides which requests
+        # are marked. A string value marks prompts CONTAINING it (the
+        # token ids are decoded — service relays ship ids, not text);
+        # any other armed value marks every request.
+        marked_rids: List[str] = []
+        mark = self.failpoints.armed_value("worker.fault_step_req")
+        if mark is not None:
+            if isinstance(mark, str):
+                try:
+                    text = rt.tokenizer.decode(list(token_ids))
+                except Exception:  # noqa: BLE001 — marking is chaos
+                    text = ""      # plumbing, never a serving error
+                if mark in text:
+                    marked_rids = list(live.engine_rids)
+            else:
+                marked_rids = list(live.engine_rids)
         with self._engine_lock:
+            self._fault_marked.update(marked_rids)
             for k, erid in enumerate(live.engine_rids):
                 esp = engine_sampling
                 if n > 1:
@@ -1839,6 +2108,15 @@ class Worker:
                     # Simulated death: break the socket mid-stream (no
                     # [DONE]) so the relay sees what a crash looks like.
                     raise RuntimeError("worker died (failpoint)")
+                if isinstance(out, _EngineFault):
+                    # Blamed by the step fault boundary: a TYPED error
+                    # frame (not a broken socket) so the relay can
+                    # strike the poison ledger and reroute or fail
+                    # clean (docs/ROBUSTNESS.md).
+                    yield sse_frame({"error": {
+                        "message": f"engine_fault: {out.verdict}",
+                        "type": "engine_fault", "code": 500}})
+                    return
                 if out is None:
                     yield SSE_DONE
                     return
@@ -1864,6 +2142,12 @@ class Worker:
                 out = live.q.get()
                 if out is _ABORT:
                     raise RuntimeError("worker died (failpoint)")
+                if isinstance(out, _EngineFault):
+                    # Typed 500: the service's redispatch path reads
+                    # the engine_fault error type for its strike.
+                    return Response.error(
+                        500, f"engine_fault: {out.verdict}",
+                        "engine_fault")
                 if out is None:
                     break
                 done = False
@@ -3835,7 +4119,8 @@ class Worker:
             running_requests=lm["running_requests"],
             kv_cache_usage=lm["kv_cache_usage"],
             num_preemptions=lm["num_preemptions"],
-            moe_dropped_tokens=lm.get("moe_dropped_tokens", 0))
+            moe_dropped_tokens=lm.get("moe_dropped_tokens", 0),
+            engine_alive=int(self._engine_loop_alive))
 
     def _recent_step_p99(self, rt: ModelRuntime):
         """p99 of ``xllm_worker_step_ms`` over the samples recorded

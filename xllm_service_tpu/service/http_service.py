@@ -52,7 +52,7 @@ from xllm_service_tpu.service.tracer import RequestTracer
 from xllm_service_tpu.utils.misc import short_uuid
 from xllm_service_tpu.utils.retry import RetryPolicy
 from xllm_service_tpu.utils.types import (
-    FinishReason, Request as SchedRequest, RequestOutput,
+    FinishReason, Request as SchedRequest, RequestOutput, StatusCode,
     parse_openai_sampling, validate_sampling)
 
 logger = logging.getLogger(__name__)
@@ -66,6 +66,49 @@ logger = logging.getLogger(__name__)
 # socket is alive and still deliverable.
 _DEAD_TRANSPORT_ERRORS = (ConnectionRefusedError, ConnectionResetError,
                           BrokenPipeError, http.client.RemoteDisconnected)
+
+
+class _EngineFaultResume(Exception):
+    """Internal relay control flow: a worker's in-stream engine-fault
+    frame (device-plane fault boundary, docs/ROBUSTNESS.md) below the
+    poison threshold — routed into the mid-stream resume machinery
+    WITHOUT forwarding the fault frame to the client."""
+
+    def __init__(self, verdict: str) -> None:
+        super().__init__(verdict)
+        self.verdict = verdict
+
+
+def _engine_fault_error(obj: Any) -> Optional[str]:
+    """The verdict string when ``obj`` is a worker engine-fault error
+    envelope (``{"error": {"type": "engine_fault", ...}}``), else
+    None."""
+    if not isinstance(obj, dict):
+        return None
+    err = obj.get("error")
+    if isinstance(err, dict) and err.get("type") == "engine_fault":
+        return str(err.get("message") or "engine_fault")
+    return None
+
+
+def _engine_fault_status(out: RequestOutput) -> Optional[str]:
+    """The verdict string when ``out`` is the worker's typed
+    engine-fault terminal output (INTERNAL status whose message names
+    the blame verdict), else None."""
+    st = out.status
+    if st is not None and st.code == StatusCode.INTERNAL \
+            and (st.message or "").startswith("engine_fault"):
+        return st.message
+    return None
+
+
+def _engine_fault_frame(verdict: str) -> bytes:
+    """The typed in-stream error frame a client sees when its request
+    is failed as a poison pill mid-stream (the non-stream paths return
+    a clean 500 with the same envelope)."""
+    return (b"data: " + json.dumps(
+        {"error": {"message": verdict, "type": "engine_fault",
+                   "code": 500}}).encode("utf-8") + b"\n\n")
 
 
 class _RequestObs:
@@ -284,7 +327,8 @@ class HttpService:
                 heartbeat_age_s=row["heartbeat_age_s"],
                 heartbeat_deadline_s=deadline,
                 step_ms_p99=row["latency"].get("step_ms_p99") or None,
-                kv_usage=row["load"].get("kv_cache_usage", 0.0))
+                kv_usage=row["load"].get("kv_cache_usage", 0.0),
+                engine_alive=int(row["load"].get("engine_alive", 1)))
             for row in mgr.instance_table()]
         self.watch.observe(signals)
 
@@ -426,6 +470,15 @@ class HttpService:
         status, routing = self.scheduler.schedule(req)
         if not status.ok:
             self._m_errors.inc()
+            if status.code == StatusCode.INTERNAL and \
+                    status.message.startswith("request quarantined"):
+                # The scheduler's poison-pill quarantine gate
+                # (docs/ROBUSTNESS.md): surfaced as the same typed
+                # engine_fault 500 the poisoning itself returned.
+                self.scheduler.count_failed("quarantined")
+                robs.finished(error=True)
+                return Response.error(500, status.message,
+                                      "engine_fault")
             if status.code.name == "UNAVAILABLE":
                 self.scheduler.count_failed("no_instance")
             robs.finished(error=True)
@@ -530,6 +583,24 @@ class HttpService:
                 if new:
                     target = new
                     continue
+            verdict = _engine_fault_error(resp) if status == 500 \
+                else None
+            if verdict is not None:
+                # Device-plane fault blamed on this request. The worker
+                # already evicted it (fault boundary), so a re-dispatch
+                # cannot double-generate — below the poison threshold
+                # it hops to a survivor; at the threshold the typed 500
+                # goes to the client as-is.
+                name = self._routed_name(fwd)
+                poisoned = self.scheduler.note_engine_fault(
+                    req.service_request_id, req.token_ids, name,
+                    verdict)
+                if not poisoned and attempt + 1 < attempts:
+                    failed.add(name)
+                    new = self._redispatch(req, fwd, exclude=failed)
+                    if new:
+                        target = new
+                        continue
             return status, resp
         detail = f": {last_exc}" if last_exc else ""
         return 503, {"error": {
@@ -702,7 +773,9 @@ class HttpService:
         robs.finished(error=status != 200)
         if status != 200:
             self._m_errors.inc()
-            self.scheduler.count_failed("worker_refused")
+            self.scheduler.count_failed(
+                "engine_fault" if _engine_fault_error(resp) is not None
+                else "worker_refused")
         self.scheduler.finish_request(req.service_request_id)
         self.tracer.trace(req.service_request_id,
                           {"stage": "egress", "body": resp})
@@ -731,6 +804,35 @@ class HttpService:
                 err: Optional[BaseException] = None
                 try:
                     for payload in iter_sse_events(body):
+                        if '"engine_fault"' in payload:
+                            # Worker fault boundary blamed THIS request
+                            # (typed in-stream error frame). Strike the
+                            # poison ledger; below the threshold the
+                            # frame is withheld and the request resumes
+                            # on a survivor like any mid-stream death —
+                            # at the threshold the client sees the
+                            # typed fault.
+                            try:
+                                obj = json.loads(payload)
+                            except ValueError:
+                                obj = None
+                            verdict = _engine_fault_error(obj)
+                            if verdict is not None:
+                                poisoned = \
+                                    self.scheduler.note_engine_fault(
+                                        srid, req.token_ids,
+                                        self._routed_name(fwd), verdict)
+                                if poisoned:
+                                    self._m_errors.inc()
+                                    self.scheduler.count_failed(
+                                        "engine_fault")
+                                    robs.finished(error=True)
+                                    frame = _engine_fault_frame(verdict)
+                                    if trace_egress is not None:
+                                        trace_egress(frame)
+                                    yield frame
+                                    return
+                                raise _EngineFaultResume(verdict)
                         frame, n_new = ledger.on_payload(payload)
                         if frame is None:
                             # Suppressed (dup role chunk / held-back-only
@@ -816,6 +918,15 @@ class HttpService:
                     self.recovery.note_failure(
                         req, dead, "no_surviving_instance", mode="relay")
                     robs.finished(error=True)
+                    if isinstance(err, _EngineFaultResume):
+                        # The withheld fault frame was pending a resume
+                        # that never came — surface the typed error
+                        # instead of an opaque broken stream.
+                        frame = _engine_fault_frame(err.verdict)
+                        if trace_egress is not None:
+                            trace_egress(frame)
+                        yield frame
+                        return
                     raise RuntimeError(
                         f"worker died mid-stream and recovery was "
                         f"exhausted (last error: {err})")
@@ -962,6 +1073,19 @@ class HttpService:
                             return
                         if out is None:
                             return
+                        verdict = _engine_fault_status(out)
+                        if verdict is not None:
+                            # Poisoned at the fan-in (the scheduler
+                            # swallows below-threshold faults into RPC
+                            # resumes; only terminal verdicts reach
+                            # this queue).
+                            self._m_errors.inc()
+                            robs.finished(error=True)
+                            frame = _engine_fault_frame(verdict)
+                            if trace_egress is not None:
+                                trace_egress(frame)
+                            yield frame
+                            return
                         for frame in asm.on_output(out):
                             if trace_egress is not None:
                                 trace_egress(frame)
@@ -999,6 +1123,14 @@ class HttpService:
                                       "timeout")
             if out is None:
                 break
+            verdict = _engine_fault_status(out)
+            if verdict is not None:
+                self._m_errors.inc()
+                robs.finished(error=True)
+                self.tracer.trace(req.service_request_id,
+                                  {"stage": "egress", "status": 500,
+                                   "error": verdict})
+                return Response.error(500, verdict, "engine_fault")
             coll.add(out)
         final = coll.body()
         robs.finished()
@@ -1233,6 +1365,24 @@ class HttpService:
         if not isinstance(body, dict):
             return Response.error(400, "body must be a JSON object")
         instance = body.pop("instance", None)
+        if instance == "*":
+            # Broadcast arming (chaos harness): every registered worker
+            # gets the same spec; per-instance results ride the payload
+            # so a partially reachable fleet is visible to the caller.
+            results: Dict[str, Any] = {}
+            for name in self.scheduler.instance_mgr.names():
+                addr = self.scheduler.instance_mgr.address_of(name)
+                if addr is None:
+                    results[name] = "unknown address"
+                    continue
+                try:
+                    status, resp = http_json("POST", addr,
+                                             "/admin/failpoint",
+                                             dict(body), timeout=10.0)
+                    results[name] = status
+                except Exception as e:  # noqa: BLE001 — worker
+                    results[name] = str(e)   # unreachable: report it
+            return Response.json({"ok": True, "results": results})
         if instance:
             addr = self.scheduler.instance_mgr.address_of(instance)
             if addr is None:

@@ -114,6 +114,11 @@ class LoadMetrics:
     # worker's batched encode queue at heartbeat time — the cost-aware
     # encode pick's queue-depth term.
     encode_queue_depth: int = 0
+    # Engine-loop liveness (docs/ROBUSTNESS.md, device-plane fault
+    # contract): 1 while the worker's engine loop serves, 0 once the
+    # fault breaker let it die — the watchdog opens an ``engine_dead``
+    # anomaly on 0 instead of waiting for lease expiry.
+    engine_alive: int = 1
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -49,6 +49,7 @@ from xllm_service_tpu.config import ServiceOptions
 from xllm_service_tpu.obs.spans import REQUEST_ID_HEADER
 from xllm_service_tpu.service.instance_types import RequestPhase
 from xllm_service_tpu.service.response_handler import SSE_DONE, sse_frame
+from xllm_service_tpu.utils.locks import make_lock
 from xllm_service_tpu.utils.retry import RetryPolicy
 from xllm_service_tpu.utils.threads import spawn
 from xllm_service_tpu.utils.types import (
@@ -506,3 +507,88 @@ class RelayLedger:
         self.finished = True
         self.done = True
         return frames
+
+
+class PoisonLedger:
+    """Cluster-wide strike ledger bounding a poison request's blast
+    radius (docs/ROBUSTNESS.md, device-plane fault contract).
+
+    Keyed by BOTH the service request id and the whole-prompt digest
+    (``utils/hashing.prompt_digest``): each engine-fault blame from a
+    worker's step fault boundary is one strike; at
+    ``XLLM_POISON_STRIKES`` the request is failed to the client with
+    the typed ``engine_fault`` error instead of re-scheduled, and the
+    digest is quarantined for ``XLLM_POISON_TTL_S`` so an immediately
+    retried identical prompt doesn't restart the rampage worker by
+    worker. Pure state — events/metrics are emitted by the scheduler's
+    ``note_engine_fault``, outside this lock."""
+
+    MAX_ENTRIES = 4096      # strike-book bound; oldest entries drop
+
+    def __init__(self, strikes: Optional[int] = None,
+                 ttl_s: Optional[float] = None) -> None:
+        self._lock = make_lock("service.poison", 11)
+        if strikes is None:
+            try:
+                strikes = int(
+                    os.environ.get("XLLM_POISON_STRIKES", "") or 2)
+            except ValueError:
+                strikes = 2
+        if ttl_s is None:
+            try:
+                ttl_s = float(
+                    os.environ.get("XLLM_POISON_TTL_S", "") or 300.0)
+            except ValueError:
+                ttl_s = 300.0
+        self.max_strikes = max(1, strikes)
+        self.ttl_s = ttl_s
+        # srid-or-digest -> strikes (insertion-ordered for the bound).
+        self._strikes: Dict[str, int] = {}
+        self._quarantine: Dict[str, float] = {}   # digest -> expiry
+
+    def strike(self, srid: str, digest: str) -> Tuple[int, bool]:
+        """One engine-fault blame against a request. Returns
+        ``(strikes, poisoned)``; when poisoned the digest enters
+        quarantine."""
+        now = time.monotonic()
+        with self._lock:
+            n = max(self._strikes.get(srid, 0),
+                    self._strikes.get(digest, 0)) + 1
+            for key in (srid, digest):
+                self._strikes.pop(key, None)    # re-insert at the tail
+                self._strikes[key] = n
+            while len(self._strikes) > self.MAX_ENTRIES:
+                self._strikes.pop(next(iter(self._strikes)))
+            poisoned = n >= self.max_strikes
+            if poisoned:
+                self._quarantine[digest] = now + self.ttl_s
+        return n, poisoned
+
+    def quarantined(self, digest: str) -> bool:
+        """Admission gate: True while ``digest`` is inside its
+        quarantine TTL (expired entries clean up lazily, strikes
+        included — a post-TTL retry starts from a clean slate)."""
+        now = time.monotonic()
+        with self._lock:
+            expiry = self._quarantine.get(digest)
+            if expiry is None:
+                return False
+            if now >= expiry:
+                self._quarantine.pop(digest, None)
+                self._strikes.pop(digest, None)
+                return False
+            return True
+
+    def state(self) -> Dict[str, Any]:
+        """Debug-bundle snapshot: live strike counts and quarantined
+        digests with remaining TTL."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "strikes": dict(self._strikes),
+                "quarantined": {
+                    d: round(exp - now, 3)
+                    for d, exp in self._quarantine.items()
+                    if exp > now},
+                "max_strikes": self.max_strikes,
+                "ttl_s": self.ttl_s}

@@ -40,6 +40,8 @@ from xllm_service_tpu.service.instance_types import (
     Heartbeat, RequestPhase)
 from xllm_service_tpu.service.kvcache_mgr import GlobalKVCacheMgr
 from xllm_service_tpu.service.lb_policy import create_policy
+from xllm_service_tpu.service.recovery import PoisonLedger
+from xllm_service_tpu.utils.hashing import prompt_digest
 from xllm_service_tpu.utils.misc import OrderedFanInPools, short_uuid
 from xllm_service_tpu.utils import threads
 from xllm_service_tpu.utils.threads import spawn
@@ -114,6 +116,11 @@ class Scheduler:
         # to it instead of cancelling, and relay-owned recoverable
         # requests are left to their relay generator's own resume loop.
         self.recovery = None
+        # Poison ledger (service/recovery.py PoisonLedger): cluster-wide
+        # engine-fault strikes keyed by request id AND prompt digest.
+        # note_engine_fault() is the single strike point for every
+        # response topology (docs/ROBUSTNESS.md).
+        self.poison = PoisonLedger()
 
         self.tokenizer: Tokenizer = TokenizerFactory.create_tokenizer(
             opts.tokenizer_path)
@@ -520,6 +527,17 @@ class Scheduler:
         if not request.token_ids:
             return Status(StatusCode.INVALID_ARGUMENT,
                           "empty prompt"), Routing()
+        # Poison-pill quarantine (docs/ROBUSTNESS.md, device-plane
+        # fault contract): an identical prompt already crossed
+        # XLLM_POISON_STRIKES engine-fault blames — refuse here, AFTER
+        # preprocess (the digest is over the post-template token ids,
+        # the same ids note_engine_fault strikes on), instead of
+        # letting a retry restart the rampage worker by worker.
+        if self.quarantined_digest(request.token_ids):
+            return Status(StatusCode.INTERNAL,
+                          "request quarantined: an identical prompt "
+                          "repeatedly faulted the engine "
+                          "(engine_fault, XLLM_POISON_TTL_S)"), Routing()
 
         if request.model:
             self.instance_mgr.update_model_heat(request.model)
@@ -803,6 +821,28 @@ class Scheduler:
                     "instances dropped by the recovery source guard "
                     "(unit: pushes, not requests)").inc()
             return
+        if out.status is not None \
+                and out.status.code == StatusCode.INTERNAL \
+                and (out.status.message or "").startswith("engine_fault"):
+            # Device-plane fault verdict (worker fault boundary,
+            # docs/ROBUSTNESS.md): strike the poison ledger. Below the
+            # strike threshold an RPC-recoverable request is resumed on
+            # a survivor instead of surfacing the fault; at the
+            # threshold (or when not recoverable) the typed terminal
+            # output falls through to the client.
+            instance = source or tracked.decode_name \
+                or tracked.prefill_name
+            poisoned = self.note_engine_fault(
+                srid, tracked.request.token_ids, instance,
+                out.status.message)
+            ctx = tracked.recovery
+            if not poisoned and ctx is not None \
+                    and self.recovery is not None \
+                    and ctx.get("owner") == "rpc" \
+                    and self.recovery.begin_rpc_resume(
+                        tracked, instance):
+                return
+            self.count_failed("engine_fault")
         num_tokens = sum(len(s.token_ids) for s in out.outputs)
         if tracked.recovery is not None:
             with self._req_lock:
@@ -937,6 +977,48 @@ class Scheduler:
                 "recovered request counts only under the recovery "
                 "series, not here)",
                 labelnames=("reason",)).inc(reason=reason)
+
+    # ------------------------------------------------------------------
+    # Poison-pill quarantine (docs/ROBUSTNESS.md device-plane faults)
+    # ------------------------------------------------------------------
+    def note_engine_fault(self, service_request_id: str,
+                          token_ids: List[int], instance: str,
+                          verdict: str) -> bool:
+        """Record one engine-fault blame verdict against a request.
+
+        Single strike point for every response topology (RPC push,
+        relay stream, redispatch loop). Returns True when the request
+        crossed ``XLLM_POISON_STRIKES`` and is now poisoned — callers
+        must then fail it to the client instead of re-scheduling.
+        Events/metrics are emitted outside the ledger lock."""
+        digest = prompt_digest(token_ids, self.opts.murmur_hash3_seed)
+        strikes, poisoned = self.poison.strike(
+            service_request_id, digest)
+        if self.events is not None:
+            self.events.emit(
+                "engine_fault", service_request_id=service_request_id,
+                instance=instance, verdict=verdict, strikes=strikes)
+        if poisoned:
+            if self.obs is not None:
+                self.obs.counter(
+                    "xllm_requests_poisoned_total",
+                    "requests failed to the client as poison pills "
+                    "after repeated engine-fault blame verdicts "
+                    "(strikes >= XLLM_POISON_STRIKES)").inc()
+            if self.events is not None:
+                self.events.emit(
+                    "request_quarantined",
+                    service_request_id=service_request_id,
+                    digest=digest, strikes=strikes,
+                    ttl_s=self.poison.ttl_s)
+        return poisoned
+
+    def quarantined_digest(self, token_ids: List[int]) -> bool:
+        """True when the prompt's content digest is under quarantine —
+        the admission gate refuses such requests outright for
+        ``XLLM_POISON_TTL_S`` after a poisoning."""
+        return self.poison.quarantined(
+            prompt_digest(token_ids, self.opts.murmur_hash3_seed))
 
     # ------------------------------------------------------------------
     # Mid-stream recovery support (service/recovery.py drives these)

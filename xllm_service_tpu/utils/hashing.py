@@ -220,3 +220,13 @@ def prefix_block_hashes(tokens: Sequence[int], block_size: int,
                                  seed & 0xFFFFFFFF, buf)
     raw = buf.raw
     return [raw[i * 16:(i + 1) * 16] for i in range(n_blocks)]
+
+
+def prompt_digest(tokens: Sequence[int], seed: int = 0) -> str:
+    """Whole-prompt content digest (hex) for the poison ledger
+    (docs/ROBUSTNESS.md): unlike ``prefix_block_hashes`` it covers the
+    trailing partial block too — two prompts quarantine together iff
+    they are token-identical. Same int32 packing as the block hashes,
+    so the digest is stable across the native and Python paths."""
+    data = struct.pack(f"<{len(tokens)}i", *[_as_i32(t) for t in tokens])
+    return murmur3_x64_128(data, seed).hex()

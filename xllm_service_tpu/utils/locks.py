@@ -18,6 +18,9 @@ increasing):
                                           (store calls run UNDER it —
                                           store locks rank above)
     10  scheduler.req, worker.live      — request registries
+    11  service.poison                  — engine-fault strike ledger
+                                          (strikeable while holding
+                                          scheduler.req)
     20  worker.engine                   — engine step/submit
     22  kv_cache.tier                   — host-DRAM/disk KV spill tier
                                           (never calls out; readable
