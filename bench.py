@@ -445,6 +445,11 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             engine, cfg, ecfg, prompt_len, gen_len,
             target_ttft_ms=slo_thr["ttft"])
 
+    mixed = None
+    if tiny or os.environ.get("BENCH_MIXED") == "1":
+        _STAGE["name"] = "mixed-step"
+        mixed = _mixed_step_section(cfg, ecfg, prompt_len, gen_len)
+
     kv_probe = None
     if not tiny and platform != "cpu":
         # BASELINE.md north-star row: KV-migration GB/s on the real chip,
@@ -471,9 +476,8 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             # would claim a feature-off run for a feature-on number.
             "kernel_flags": {
                 **{k: os.environ.get(k, "0") for k in
-                   ("XLLM_PALLAS", "XLLM_PALLAS_DECODE_V2",
-                    "XLLM_PALLAS_DECODE_V3", "XLLM_PALLAS_DECODE_V4",
-                    "XLLM_PALLAS_DECODE_V5", "XLLM_PALLAS_PREFILL")},
+                   ("XLLM_PALLAS", "XLLM_PALLAS_PREFILL",
+                    "XLLM_RAGGED_ATTN")},
                 **{k: os.environ.get(k, "auto") for k in
                    ("XLLM_PALLAS_KV", "XLLM_WRITE_THEN_ATTEND",
                     "XLLM_DECODE_PIPELINE")}},
@@ -538,6 +542,13 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             # interleaver is accountable for.
             **({"goodput_under_slo": burst["goodput_under_slo"],
                 "burst": burst} if burst else {}),
+            # One-dispatch mixed-iteration A/B (XLLM_RAGGED_ATTN);
+            # dispatches_per_mixed_step is the headline pair — 1.0 on
+            # the ragged path vs >=2 on the split per-phase path.
+            **({"mixed_step": mixed,
+                "dispatches_per_mixed_step":
+                    mixed["dispatches_per_mixed_step"]}
+               if mixed else {}),
             **({"kv_migration": kv_probe} if kv_probe else {}),
             "reference_baseline": "target_tpot=50ms SLO default "
                                   "(no published numbers)",
@@ -615,6 +626,94 @@ def _burst_goodput_section(engine, cfg, ecfg, prompt_len: int,
             "num_ok": s["num_ok"],
             "ttft_ms_p99": s["ttft_ms"]["p99"],
             "tpot_ms_p99_under_burst": s["tpot_ms"]["p99"]}
+
+
+def _mixed_step_section(cfg, ecfg, prompt_len: int,
+                        gen_len: int) -> dict:
+    """One-dispatch ragged mixed iterations vs the split per-phase
+    path, at the engine level (XLLM_RAGGED_ATTN A/B).
+
+    Two fresh engines — identical except ``ragged_attn`` — each drive
+    decode streams and land a prompt mid-decode, and every MIXED
+    iteration logs its attention-dispatch count
+    (``last_step_attn_dispatches``) and wall ms. The ragged leg must
+    average exactly 1.0 dispatches per mixed step; the split leg pays
+    one decode program plus one prefill program (>= 2). Tiny/CPU runs
+    only by default (BENCH_MIXED=1 forces): like the burst section,
+    its small shapes sit outside a hardware run's scoped warmup."""
+    import dataclasses
+
+    from xllm_service_tpu.runtime.engine import Engine, EngineRequest
+    from xllm_service_tpu.utils.types import SamplingParams
+
+    vocab = cfg.vocab_size - 1
+    plen = max(prompt_len // 4, 4)
+
+    def drive(ragged: bool) -> dict:
+        e2 = dataclasses.replace(ecfg, ragged_attn=ragged)
+        # Defeat any XLLM_RAGGED_ATTN env override __post_init__
+        # applied — the A/B must flip the gate regardless of env.
+        e2.ragged_attn = ragged
+        eng = Engine(cfg, e2, seed=0)
+        toks: dict = {}
+        mixed_ms: list = []
+        dispatches: list = []
+        ragged_steps = 0
+
+        def _step():
+            nonlocal ragged_steps
+            t0 = time.monotonic()
+            outs = eng.step()
+            ms = 1000.0 * (time.monotonic() - t0)
+            if eng.last_step_kind == "mixed":
+                mixed_ms.append(ms)
+                dispatches.append(eng.last_step_attn_dispatches)
+                if eng.last_step_ragged:
+                    ragged_steps += 1
+            for o in outs:
+                toks.setdefault(o.request_id, []).extend(o.new_token_ids)
+
+        sp = SamplingParams(max_tokens=min(gen_len, 16),
+                            temperature=0.0, ignore_eos=True)
+        eng.add_request(EngineRequest(
+            request_id="stream-0",
+            token_ids=[(7001 + j) % vocab + 1 for j in range(plen)],
+            sampling=sp))
+        for _ in range(2):
+            _step()
+        # Prompts landing mid-decode — the mixed iterations under test.
+        for i in range(max(min(ecfg.max_batch_size, 4) - 1, 1)):
+            eng.add_request(EngineRequest(
+                request_id=f"mid-{i}",
+                token_ids=[(9001 + 53 * i + j) % vocab + 1
+                           for j in range(plen)],
+                sampling=sp))
+        steps = 0
+        while eng.has_work() and steps < 500:
+            _step()
+            steps += 1
+        n = len(dispatches)
+        return {
+            "mixed_steps": n,
+            "ragged_steps": ragged_steps,
+            "dispatches_per_mixed_step":
+                round(sum(dispatches) / n, 3) if n else None,
+            "mixed_step_ms_mean":
+                round(sum(mixed_ms) / n, 3) if n else None,
+            "tokens": toks,
+        }
+
+    on = drive(True)
+    off = drive(False)
+    # Temperature-0 streams must not depend on the dispatch plan.
+    identical = on.pop("tokens") == off.pop("tokens")
+    return {
+        "ragged_on": on, "ragged_off": off,
+        "streams_identical": identical,
+        "dispatches_per_mixed_step": {
+            "ragged_on": on["dispatches_per_mixed_step"],
+            "ragged_off": off["dispatches_per_mixed_step"]},
+    }
 
 
 def _maybe_kv_probe(engine, cfg, ecfg) -> dict:

@@ -298,15 +298,15 @@ def main() -> None:
     ap.add_argument("--essential", action="store_true",
                     help="only the owner-question components (XLA gather "
                          "+ the default (B,pages) kernel + scatter + "
-                         "lm_head): ~10 fewer tunnel compiles than the "
-                         "full five-variant kernel A/B")
+                         "lm_head), skipping the ragged one-dispatch "
+                         "A/B — fewer tunnel compiles")
     args = ap.parse_args()
 
     from xllm_service_tpu.ops import attention as att
     from xllm_service_tpu.ops.pallas.paged_attention import (
-        _paged_decode_attention_impl, _paged_decode_attention_mr_impl,
-        _paged_decode_attention_row_impl,
-        _paged_decode_attention_wide_impl)
+        _paged_decode_attention_impl)
+    from xllm_service_tpu.ops.pallas.ragged_attention import (
+        ragged_paged_attention_pallas)
     from xllm_service_tpu.ops import pallas as pallas_mod
 
     if args.small:
@@ -360,21 +360,7 @@ def main() -> None:
         "attn_xla_gather": lambda q, k, v, t, c, kcur, vcur:
             att.paged_decode_attention_current(q, k, v, t, c, kcur, vcur),
         "attn_pallas_grid": functools.partial(
-            _paged_decode_attention_impl, interpret=interpret,
-            transpose_free=False),
-        "attn_pallas_grid_v2": functools.partial(
-            _paged_decode_attention_impl, interpret=interpret,
-            transpose_free=True),
-        "attn_pallas_row_v3": functools.partial(
-            _paged_decode_attention_row_impl, interpret=interpret),
-        "attn_pallas_multirow_v4x8": functools.partial(
-            _paged_decode_attention_mr_impl, rows=8,
-            interpret=interpret),
-        "attn_pallas_multirow_v4x16": functools.partial(
-            _paged_decode_attention_mr_impl, rows=16,
-            interpret=interpret),
-        "attn_pallas_wide_v5": functools.partial(
-            _paged_decode_attention_wide_impl, interpret=interpret),
+            _paged_decode_attention_impl, interpret=interpret),
     }
 
     if args.essential:
@@ -397,6 +383,64 @@ def main() -> None:
             # lower must not hide the others' numbers
             detail[name + "_ms"] = f"error: {type(exc).__name__}: {exc}"
         _mark(name + "_ms", detail[name + "_ms"])
+
+    # Ragged one-dispatch A/B (the XLLM_RAGGED_ATTN conviction,
+    # tools/act_on_convictions.py): a mixed batch of decode rows +
+    # prefill windows served by ONE ragged program vs the SAME rows as
+    # two dispatches (decode bucket, then prefill bucket, both through
+    # the same kernel) — isolating dispatch fusion from kernel quality.
+    if not args.no_decode and not args.essential:
+        T_pf = 8 if args.small else 128
+        nd = max(1, B // 2)
+        npf = max(1, B // 8)
+        pt_r, _ = _page_table(nd + npf, ctx_tokens, ps, P)
+        q_rag = jnp.asarray(
+            rng.normal(size=(nd + npf, T_pf, Hq, D)), dt)
+        qs_r = jnp.concatenate([
+            jnp.full((nd,), ctx_tokens - 1, jnp.int32),
+            jnp.zeros((npf,), jnp.int32)])
+        ln_r = jnp.concatenate([
+            jnp.ones((nd,), jnp.int32),
+            jnp.full((npf,), min(T_pf, ctx_tokens), jnp.int32)])
+
+        def ragged_mixed_build(n):
+            @jax.jit
+            def run():
+                def body(q, _):
+                    out = ragged_paged_attention_pallas(
+                        q, k_pages, v_pages, pt_r, qs_r, ln_r,
+                        interpret=interpret)
+                    return out.astype(q.dtype), ()
+                q_fin, _ = jax.lax.scan(body, q_rag, None, length=n)
+                return q_fin[0, 0, 0]
+            return run
+
+        def ragged_split_build(n):
+            @jax.jit
+            def run():
+                def body(q, _):
+                    o_dec = ragged_paged_attention_pallas(
+                        q[:nd, :1], k_pages, v_pages, pt_r[:nd],
+                        qs_r[:nd], ln_r[:nd], interpret=interpret)
+                    o_pf = ragged_paged_attention_pallas(
+                        q[nd:], k_pages, v_pages, pt_r[nd:],
+                        qs_r[nd:], ln_r[nd:], interpret=interpret)
+                    q2 = q.at[:nd, :1].set(o_dec.astype(q.dtype))
+                    q2 = q2.at[nd:].set(o_pf.astype(q.dtype))
+                    return q2, ()
+                q_fin, _ = jax.lax.scan(body, q_rag, None, length=n)
+                return q_fin[0, 0, 0]
+            return run
+
+        for name, build in (("attn_ragged_mixed_ms", ragged_mixed_build),
+                            ("attn_ragged_split_ms", ragged_split_build)):
+            try:
+                detail[name] = round(
+                    _scan_slope(build, args.n_lo, args.n_hi), 4)
+            except Exception as exc:  # noqa: BLE001 — one failed lower
+                # must not hide the other's number
+                detail[name] = f"error: {type(exc).__name__}: {exc}"
+            _mark(name, detail[name])
 
     # All-layer KV scatter, as the engine issues it once per decode step.
     k_all = jnp.asarray(rng.normal(size=(L, B, Hkv, D)), dt)

@@ -355,6 +355,33 @@ def _fault_counters(target: str) -> dict:
     return out
 
 
+def _mixed_step_counters(target: str) -> dict:
+    """Scrape the worker-plane mixed-step ledger: ragged one-dispatch
+    mixed iterations (``xllm_worker_ragged_dispatches_total``,
+    XLLM_RAGGED_ATTN) vs all mixed iterations
+    (``xllm_worker_steps_total{phase="mixed"}``). Best-effort like the
+    fault-ledger scrape: a target that exports no worker metrics
+    reports zeros."""
+    import http.client
+    host, _, port = target.partition(":")
+    out = {"ragged_dispatches": 0.0, "mixed_steps": 0.0}
+    try:
+        conn = http.client.HTTPConnection(host, int(port or 80),
+                                          timeout=5.0)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode("utf-8", "replace")
+        conn.close()
+    except Exception:  # noqa: BLE001 — scrape is advisory
+        return out
+    for line in text.splitlines():
+        if line.startswith("xllm_worker_ragged_dispatches_total"):
+            out["ragged_dispatches"] += float(line.rsplit(" ", 1)[-1])
+        elif line.startswith("xllm_worker_steps_total{") and \
+                'phase="mixed"' in line:
+            out["mixed_steps"] += float(line.rsplit(" ", 1)[-1])
+    return out
+
+
 def run_chaos_schedule(target: str, stages: List[tuple], t_start: float,
                        stop: threading.Event) -> None:
     """Arm each scheduled failpoint against the live service's admin
@@ -451,6 +478,7 @@ def run_load(target: str, model: str, num_requests: int,
     chaos_stop = threading.Event()
     chaos_th: Optional[threading.Thread] = None
     faults_before: Optional[dict] = None
+    mixed_before = _mixed_step_counters(target)
     if chaos:
         faults_before = _fault_counters(target)
         chaos_th = threading.Thread(
@@ -491,6 +519,16 @@ def run_load(target: str, model: str, num_requests: int,
                                 target_ttft_ms=target_ttft_ms,
                                 target_tpot_ms=target_tpot_ms,
                                 num_requests=num_requests)
+    # Mixed-step ledger across the run (delta of the worker counters):
+    # how many interleaved iterations ran, and how many of those went
+    # through the single ragged dispatch (XLLM_RAGGED_ATTN).
+    mixed_after = _mixed_step_counters(target)
+    ms = mixed_after["mixed_steps"] - mixed_before["mixed_steps"]
+    rd = mixed_after["ragged_dispatches"] - \
+        mixed_before["ragged_dispatches"]
+    summary["mixed_step"] = {
+        "mixed_steps": int(ms), "ragged_dispatches": int(rd),
+        "ragged_share": round(rd / ms, 4) if ms > 0 else None}
     if chaos:
         summary["chaos"] = chaos_stage_summaries(
             results, chaos, wall, target_ttft_ms=target_ttft_ms,

@@ -127,6 +127,20 @@ class TestCensusAot:
         hits = census_pool_copies(compiled.as_text(), pool_shape)
         assert hits == [], hits
 
+    def test_ragged_zero_pool_copies(self, aot, census_env):
+        """The ragged mixed-batch program (XLLM_RAGGED_ATTN): ONE
+        dispatch serving decode rows + prefill windows must keep the
+        prefill program's guarantees — pools donated straight through,
+        ZERO pool-sized copies in the optimized HLO."""
+        aot_compile, _ = aot
+        import tools.aot_copy_census as cc
+        progs = cc.build_programs(tiny=True)
+        fn, args, donate, pool_shape = progs["ragged"]
+        kw = cc._kv_layout_kwargs(args, donate, cc._N_OUT["ragged"])
+        compiled = aot_compile(fn, args, donate_argnums=donate, **kw)
+        hits = census_pool_copies(compiled.as_text(), pool_shape)
+        assert hits == [], hits
+
     def test_restore_scatter_zero_pool_copies(self, aot):
         """The spill-tier restore / cross-worker block-adopt scatter
         (engine ``_kv_scatter``, shared with PD import): donated,

@@ -1075,6 +1075,44 @@ def test_scoped_warmup_covers_bench_schedule():
     assert not recompiles, f"scoped warmup missed programs: {recompiles}"
 
 
+def test_scoped_warmup_covers_ragged_bucket_ladder():
+    """Ragged twin of the scoped-warmup pin: with the one-dispatch
+    mixed step on, warmup pre-compiles the ragged bucket ladder (pow2
+    combined batch × prefill bucket × table width), so the bench-shaped
+    run still triggers ZERO post-warmup recompiles — and actually
+    exercises the ragged program while doing so."""
+    import bench as bench_mod
+
+    cfg = ModelConfig.tiny(vocab_size=256)
+    ecfg = EngineConfig(page_size=16, num_pages=128, max_model_len=128,
+                        max_batch_size=8, max_prefill_tokens=64,
+                        prefill_buckets=(32,), decode_steps=8,
+                        ragged_attn=True)
+    engine = Engine(cfg, ecfg, seed=0)
+    batch, prompt_len, gen_len = 8, 32, 24
+    pf_shapes, widths = bench_mod.scoped_warmup_shapes(
+        ecfg, batch, prompt_len, gen_len)
+    engine.warmup(prefill_shapes=pf_shapes, decode_widths=widths)
+
+    sp = SamplingParams(max_tokens=gen_len, temperature=0.0,
+                        ignore_eos=True)
+    for i in range(batch):
+        engine.add_request(EngineRequest(
+            request_id=f"bench-{i}",
+            token_ids=[(i + j) % (cfg.vocab_size - 1) + 1
+                       for j in range(prompt_len)], sampling=sp))
+    done = 0
+    while engine.has_work():
+        for out in engine.step():
+            if out.finish_reason != FinishReason.NONE:
+                done += 1
+    assert done == batch
+    assert engine.phase_counts["ragged.dispatch"] > 0
+    recompiles = {k: v for k, v in engine.phase_report().items()
+                  if k.endswith(".recompile") and v}
+    assert not recompiles, f"ragged warmup missed programs: {recompiles}"
+
+
 @pytest.mark.slow
 def test_bench_reports_boot_and_recompile_provenance(monkeypatch):
     """The bench result JSON must prove "no routed request ever pays a

@@ -255,3 +255,137 @@ class TestInterleavePipelineMatrix:
         # Pipeline-off runs never speculate, any interleave setting.
         assert results[(False, True)][1]["spec_dispatches"] == 0
         assert results[(False, False)][1]["spec_dispatches"] == 0
+
+
+class TestRaggedMixedStep:
+    """One-dispatch ragged mixed iterations (XLLM_RAGGED_ATTN /
+    EngineConfig.ragged_attn): a mixed iteration packs decode rows and
+    prefill windows into ONE ragged batch served by ONE attention
+    program. Streams must be byte-identical to the legacy split path
+    across the interleave × decode-pipeline rollback matrix, and the
+    dispatch ledger must prove the single launch."""
+
+    @staticmethod
+    def _ecfg(pipeline=False, interleave=True, ragged=None):
+        return EngineConfig(
+            page_size=32, num_pages=16, max_model_len=64,
+            max_batch_size=2, max_prefill_tokens=64,
+            prefill_buckets=(8, 16, 32), decode_steps=4,
+            decode_pipeline=pipeline, interleave=interleave,
+            ragged_attn=ragged)
+
+    def _run(self, pipeline, interleave, ragged):
+        eng = Engine(MCFG, self._ecfg(pipeline, interleave, ragged),
+                     seed=0)
+        eng.add_request(_req("a", range(1, 9), 16))
+        toks, _ = _drive(eng, feed={3: [_req("b", range(3, 11), 16)]})
+        return toks, eng
+
+    def test_matrix_byte_identical_ragged_on_vs_off(self):
+        """Ragged on/off across pipeline on/off: the step STRUCTURE
+        differs (one ragged launch vs a fused burst plus a prefill
+        call; a mixed ragged iteration decodes one token, not a burst),
+        but at temperature=0 the streams are prefix-determined, so
+        every cell must emit identical bytes. Interleave stays on —
+        with it off, prefill and decode never share an iteration, so
+        the ragged path can't fire and the cells degenerate to the
+        plain matrix test above."""
+        results = {(p, rg): self._run(p, True, rg)[0]
+                   for p in (True, False) for rg in (True, False)}
+        streams = list(results.values())
+        assert all(s == streams[0] for s in streams[1:]), results
+        assert len(streams[0]["a"]) == 16 and len(streams[0]["b"]) == 16
+
+    def test_mixed_step_is_one_dispatch(self):
+        """The acceptance pin: a ragged mixed iteration executes exactly
+        ONE attention dispatch, where the legacy split path needs the
+        decode burst plus one per prefill call (pipeline off isolates
+        the count to the iteration that used it)."""
+        seen = {}
+        for ragged in (True, False):
+            eng = Engine(MCFG, self._ecfg(ragged=ragged), seed=0)
+            eng.add_request(_req("a", range(1, 9), 16))
+            for step in range(40):
+                if step == 2:
+                    eng.add_request(_req("b", range(3, 11), 16))
+                eng.step()
+                if eng.last_step_kind == "mixed":
+                    seen[ragged] = (eng.last_step_ragged,
+                                    eng.last_step_attn_dispatches)
+                    break
+            else:
+                raise AssertionError("no mixed iteration observed")
+        assert seen[True] == (True, 1), seen
+        is_ragged, dispatches = seen[False]
+        assert not is_ragged and dispatches >= 2, seen
+
+    def test_ragged_step_ledger_and_reports(self):
+        """The ragged iteration keeps the worker-visible ledger: kind
+        "mixed" with the per-phase token split, the ragged flag and
+        phase counters the obs flush exports, and a "ragged" entry in
+        compile_report."""
+        eng = Engine(MCFG, self._ecfg(ragged=True), seed=0)
+        assert eng.ragged and eng._jit_ragged is not None
+        assert "ragged" in eng.compile_report()
+        eng.add_request(_req("a", range(1, 9), 16))
+        hit = False
+        for step in range(40):
+            if step == 2:
+                eng.add_request(_req("b", range(3, 11), 16))
+            eng.step()
+            if eng.last_step_ragged:
+                hit = True
+                assert eng.last_step_kind == "mixed"
+                assert eng.last_step_decode_tokens == 1
+                assert eng.last_step_prefill_tokens == 8
+                assert eng.last_step_prefill_windows == (8,)
+                break
+        assert hit
+        assert eng.phase_counts["ragged.dispatch"] == 1
+        assert eng.phase_counts["ragged.pack"] == 1
+        assert eng.phase_counts["ragged.post"] == 1
+        # Drain; decode-only and prefill-only iterations never go ragged.
+        toks, _ = _drive(eng)
+        assert eng.phase_counts["ragged.dispatch"] == 1
+        assert eng.compile_report()["ragged"] == 1
+
+    def test_penalized_decode_falls_back_to_split_path(self):
+        """Presence/frequency penalties need the output-token histogram
+        the ragged program doesn't carry — those iterations must take
+        the legacy sections (and still produce correct streams)."""
+        def drive(ragged):
+            eng = Engine(MCFG, self._ecfg(ragged=ragged), seed=0)
+            eng.add_request(EngineRequest(
+                request_id="a", token_ids=list(range(1, 9)),
+                sampling=SamplingParams(max_tokens=8, temperature=0.0,
+                                        presence_penalty=0.5,
+                                        ignore_eos=True)))
+            toks, ragged_steps = {}, 0
+            for step in range(60):
+                if step == 2:
+                    eng.add_request(_req("b", range(3, 11), 8))
+                for o in eng.step():
+                    toks.setdefault(o.request_id, []).extend(
+                        o.new_token_ids)
+                ragged_steps += int(eng.last_step_ragged)
+                if step >= 2 and not eng.has_work():
+                    break
+            return toks, ragged_steps
+
+        on, rs_on = drive(True)
+        off, rs_off = drive(False)
+        # The penalized decoder forces the split path every iteration —
+        # and the fallback is stream-invisible.
+        assert rs_on == 0 and rs_off == 0
+        assert on == off
+        assert len(on["a"]) == 8 and len(on["b"]) == 8
+
+    def test_env_resolution_and_default_off(self, monkeypatch):
+        assert self._ecfg().ragged_attn is None
+        eng = Engine(MCFG, self._ecfg(), seed=0)
+        assert not eng.ragged and eng._jit_ragged is None
+        assert "ragged" not in eng.compile_report()
+        monkeypatch.setenv("XLLM_RAGGED_ATTN", "1")
+        assert self._ecfg().ragged_attn is True
+        monkeypatch.setenv("XLLM_RAGGED_ATTN", "0")
+        assert self._ecfg(ragged=True).ragged_attn is False

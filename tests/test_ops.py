@@ -325,35 +325,6 @@ class TestPallasPagedAttention:
         assert jnp.allclose(ref, out, atol=1e-5), \
             float(jnp.max(jnp.abs(ref - out)))
 
-    def test_transpose_free_variant_matches(self):
-        """The in-place-batched dot_general fold (transpose_free=True)
-        must be numerically identical to the transpose fold — it is the
-        same contraction expressed without the VMEM relayout."""
-        import numpy as np
-        import jax.numpy as jnp
-
-        from xllm_service_tpu.ops.pallas.paged_attention import (
-            paged_decode_attention_pallas)
-
-        rng = np.random.default_rng(7)
-        B, Hq, Hkv, D, P, ps, MP = 3, 8, 2, 32, 16, 8, 6
-        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
-        k = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
-        v = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
-        pt = jnp.asarray(rng.integers(1, P, size=(B, MP)), jnp.int32)
-        ctx = jnp.asarray([13, 1, MP * ps], jnp.int32)
-        kc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
-        vc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
-        for cur in ((None, None), (kc, vc)):
-            ref = paged_decode_attention_pallas(
-                q, k, v, pt, ctx, *cur, interpret=True,
-                transpose_free=False)
-            out = paged_decode_attention_pallas(
-                q, k, v, pt, ctx, *cur, interpret=True,
-                transpose_free=True)
-            assert jnp.allclose(ref, out, atol=1e-6), \
-                float(jnp.max(jnp.abs(ref - out)))
-
     def test_null_pages_masked(self):
         import numpy as np
         import jax.numpy as jnp
@@ -461,216 +432,6 @@ class TestPallasPagedAttention:
             q, k, v, pt, ctx, kc, vc, sliding_window=W, interpret=True)
         assert jnp.allclose(ref, out, atol=1e-5), \
             float(jnp.max(jnp.abs(ref - out)))
-
-    def test_multirow_kernel_matches_reference(self):
-        """Multi-row kernel (XLLM_PALLAS_DECODE_V4: RB rows per grid
-        cell via RB pipelined page streams) vs the XLA reference —
-        ragged contexts, NULL pages, current-token fold, and a batch
-        that does NOT divide the row-group size (pad path)."""
-        import numpy as np
-        import jax.numpy as jnp
-
-        from xllm_service_tpu.ops.attention import (
-            paged_decode_attention, paged_decode_attention_current)
-        from xllm_service_tpu.ops.pallas.paged_attention import (
-            _paged_decode_attention_mr_impl)
-
-        rng = np.random.default_rng(11)
-        B, Hq, Hkv, D, P, ps, MP = 5, 8, 2, 32, 16, 8, 6
-        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
-        k = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
-        v = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
-        pt = np.asarray(rng.integers(1, P, size=(B, MP)), np.int32)
-        pt[1, 2:] = 0                     # NULL-padded table
-        pt = jnp.asarray(pt)
-        ctx = jnp.asarray([13, 9, MP * ps, 1, 25], jnp.int32)
-        kc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
-        vc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
-        for rows in (2, 4):               # 5 % rows != 0 both times
-            ref = paged_decode_attention(q, k, v, pt, ctx)
-            out = _paged_decode_attention_mr_impl(
-                q, k, v, pt, ctx, rows=rows, interpret=True)
-            assert jnp.allclose(ref, out, atol=1e-5), \
-                (rows, float(jnp.max(jnp.abs(ref - out))))
-            ref_c = paged_decode_attention_current(
-                q, k, v, pt, ctx, kc, vc)
-            out_c = _paged_decode_attention_mr_impl(
-                q, k, v, pt, ctx, kc, vc, rows=rows, interpret=True)
-            assert jnp.allclose(ref_c, out_c, atol=1e-5), \
-                (rows, float(jnp.max(jnp.abs(ref_c - out_c))))
-
-    def test_wide_kernel_matches_reference(self):
-        """Wide block-diagonal (B, pages) kernel (XLLM_PALLAS_DECODE_V5)
-        vs the XLA reference: zero in-cell relayouts, flat pools,
-        diagonal selection outside."""
-        import numpy as np
-        import jax.numpy as jnp
-
-        from xllm_service_tpu.ops.attention import (
-            paged_decode_attention, paged_decode_attention_current)
-        from xllm_service_tpu.ops.pallas.paged_attention import (
-            _paged_decode_attention_wide_impl)
-
-        rng = np.random.default_rng(23)
-        B, Hq, Hkv, D, P, ps, MP = 3, 8, 2, 32, 16, 8, 6
-        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
-        k = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
-        v = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
-        pt = np.asarray(rng.integers(1, P, size=(B, MP)), np.int32)
-        pt[1, 1:] = 0
-        pt = jnp.asarray(pt)
-        ctx = jnp.asarray([13, 5, MP * ps], jnp.int32)
-        kc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
-        vc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
-        ref = paged_decode_attention(q, k, v, pt, ctx)
-        out = _paged_decode_attention_wide_impl(q, k, v, pt, ctx,
-                                                interpret=True)
-        assert jnp.allclose(ref, out, atol=1e-5), \
-            float(jnp.max(jnp.abs(ref - out)))
-        ref_c = paged_decode_attention_current(q, k, v, pt, ctx, kc, vc)
-        out_c = _paged_decode_attention_wide_impl(q, k, v, pt, ctx,
-                                                  kc, vc, interpret=True)
-        assert jnp.allclose(ref_c, out_c, atol=1e-5), \
-            float(jnp.max(jnp.abs(ref_c - out_c)))
-
-    def test_row_kernel_matches_reference(self):
-        """Grid-(B,) double-buffered row kernel (XLLM_PALLAS_DECODE_V3)
-        vs the XLA reference, with and without the current-token fold,
-        on ragged contexts and NULL-padded tables."""
-        import numpy as np
-        import jax.numpy as jnp
-
-        from xllm_service_tpu.ops.attention import (
-            paged_decode_attention, paged_decode_attention_current)
-        from xllm_service_tpu.ops.pallas.paged_attention import (
-            _paged_decode_attention_row_impl)
-
-        rng = np.random.default_rng(11)
-        B, Hq, Hkv, D, P, ps, MP = 3, 8, 2, 32, 16, 8, 6
-        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
-        k = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
-        v = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
-        pt = np.asarray(rng.integers(1, P, size=(B, MP)), np.int32)
-        # Row 1 exercises NULL-page padding past its 1-token context.
-        pt[1, 1:] = 0
-        pt = jnp.asarray(pt)
-        ctx = jnp.asarray([13, 1, MP * ps], jnp.int32)
-        kc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
-        vc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
-
-        ref = paged_decode_attention(q, k, v, pt, ctx)
-        out = _paged_decode_attention_row_impl(q, k, v, pt, ctx,
-                                               interpret=True)
-        assert jnp.allclose(ref, out, atol=1e-5), \
-            float(jnp.max(jnp.abs(ref - out)))
-
-        ref_c = paged_decode_attention_current(q, k, v, pt, ctx, kc, vc)
-        out_c = _paged_decode_attention_row_impl(q, k, v, pt, ctx, kc, vc,
-                                                 interpret=True)
-        assert jnp.allclose(ref_c, out_c, atol=1e-5), \
-            float(jnp.max(jnp.abs(ref_c - out_c)))
-
-    def test_row_kernel_zero_context_row(self):
-        """A row with ctx=0 (inactive slot) must not hang the DMA loop
-        and must produce finite output."""
-        import numpy as np
-        import jax.numpy as jnp
-
-        from xllm_service_tpu.ops.pallas.paged_attention import (
-            _paged_decode_attention_row_impl)
-
-        rng = np.random.default_rng(3)
-        B, Hq, Hkv, D, P, ps, MP = 2, 4, 2, 16, 8, 8, 4
-        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
-        k = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
-        v = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
-        pt = jnp.zeros((B, MP), jnp.int32)
-        ctx = jnp.asarray([0, 0], jnp.int32)
-        kc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
-        vc = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
-        out = _paged_decode_attention_row_impl(q, k, v, pt, ctx, kc, vc,
-                                               interpret=True)
-        assert bool(jnp.all(jnp.isfinite(out)))
-
-
-class TestEngineDecodeRowKernelPath:
-    def test_generations_identical_to_xla_path(self, monkeypatch):
-        """Two engines, same seed/prompts — one decoding through the
-        gated row kernel (XLLM_PALLAS_DECODE_V3, interpreter on CPU),
-        one through the XLA gather path — must produce identical greedy
-        tokens through fused multi-step decode bursts."""
-        from xllm_service_tpu.config import EngineConfig, ModelConfig
-        from xllm_service_tpu.runtime.engine import Engine, EngineRequest
-        from xllm_service_tpu.utils.types import SamplingParams
-
-        cfg = ModelConfig.tiny(vocab_size=256)
-        ecfg = EngineConfig(page_size=16, num_pages=64, max_model_len=256,
-                            max_batch_size=4, max_prefill_tokens=128,
-                            prefill_buckets=(16, 32, 64), decode_steps=4)
-        prompts = [list(range(1, 33)), list(range(1, 17)),
-                   [7, 9, 11] * 8]
-        sp = SamplingParams(max_tokens=12, temperature=0.0,
-                            ignore_eos=True)
-
-        def run(kernel: bool):
-            monkeypatch.setenv("XLLM_PALLAS", "1" if kernel else "0")
-            monkeypatch.setenv("XLLM_PALLAS_DECODE_V3",
-                              "1" if kernel else "0")
-            eng = Engine(cfg, ecfg, seed=0)
-            outs = {}
-            for i, p in enumerate(prompts):
-                eng.add_request(EngineRequest(
-                    request_id=f"r{i}", token_ids=list(p), sampling=sp))
-            while eng.has_work():
-                for o in eng.step():
-                    outs.setdefault(o.request_id, []).extend(
-                        o.new_token_ids)
-            return outs
-
-        xla = run(kernel=False)
-        pallas = run(kernel=True)
-        assert set(xla) == set(pallas)
-        for rid in xla:
-            assert xla[rid] == pallas[rid], rid
-
-    def test_generations_identical_multirow(self, monkeypatch):
-        """Same engine-level equivalence for the multi-row kernel
-        (XLLM_PALLAS_DECODE_V4=3 → row groups of 3 over a 4-slot
-        batch, exercising the pad path inside serving)."""
-        from xllm_service_tpu.config import EngineConfig, ModelConfig
-        from xllm_service_tpu.runtime.engine import Engine, EngineRequest
-        from xllm_service_tpu.utils.types import SamplingParams
-
-        cfg = ModelConfig.tiny(vocab_size=256)
-        ecfg = EngineConfig(page_size=16, num_pages=64, max_model_len=256,
-                            max_batch_size=4, max_prefill_tokens=128,
-                            prefill_buckets=(16, 32, 64), decode_steps=4)
-        prompts = [list(range(1, 33)), list(range(1, 17)),
-                   [7, 9, 11] * 8]
-        sp = SamplingParams(max_tokens=12, temperature=0.0,
-                            ignore_eos=True)
-
-        def run(kernel: bool):
-            monkeypatch.setenv("XLLM_PALLAS", "1" if kernel else "0")
-            monkeypatch.setenv("XLLM_PALLAS_DECODE_V4",
-                               "3" if kernel else "0")
-            eng = Engine(cfg, ecfg, seed=0)
-            outs = {}
-            for i, p in enumerate(prompts):
-                eng.add_request(EngineRequest(
-                    request_id=f"r{i}", token_ids=list(p), sampling=sp))
-            while eng.has_work():
-                for o in eng.step():
-                    outs.setdefault(o.request_id, []).extend(
-                        o.new_token_ids)
-            return outs
-
-        xla = run(kernel=False)
-        pallas = run(kernel=True)
-        assert set(xla) == set(pallas)
-        for rid in xla:
-            assert xla[rid] == pallas[rid], rid
-
 
 class TestPagedKvUpdateKernel:
     """The Pallas in-place decode KV write (ops/pallas/kv_update.py) —

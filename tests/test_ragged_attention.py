@@ -1,0 +1,186 @@
+"""Ragged paged-attention kernel vs the XLA reference, via the Pallas
+interpreter on CPU (ops/pallas/ragged_attention.py).
+
+The reference is the pool-gather form the engine's ragged path uses off
+TPU: gather every table page into [B, S, Hkv, D] and run ``mha_prefill``
+with per-row (q_start, length) — exactly the write-then-attend contract
+the kernel implements. Only each row's first ``length`` output rows are
+compared; positions past the ragged tail are padding the engine never
+reads (they must merely stay finite)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from xllm_service_tpu.ops.attention import mha_prefill
+from xllm_service_tpu.ops.pallas.ragged_attention import (
+    ragged_paged_attention_pallas)
+
+
+def _setup(seed=0, B=4, T=16, Hq=8, Hkv=2, D=32, P=32, ps=8, MP=6):
+    rng = np.random.default_rng(seed)
+    k_pages = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+    pt = jnp.asarray(rng.integers(1, P, size=(B, MP)), jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, T, Hq, D)), jnp.float32)
+    return k_pages, v_pages, pt, q
+
+
+def _ref(q, k_pages, v_pages, pt, q_start, lengths, **kw):
+    B = q.shape[0]
+    MP, ps = pt.shape[1], k_pages.shape[-3]
+    Hkv, D = k_pages.shape[-2], k_pages.shape[-1]
+    k = k_pages[pt].reshape(B, MP * ps, Hkv, D)
+    v = v_pages[pt].reshape(B, MP * ps, Hkv, D)
+    return mha_prefill(q, k, v, q_start + lengths, q_start, **kw)
+
+
+def _assert_rows_match(ref, out, lengths, tag="", atol=1e-5):
+    lens = np.asarray(lengths)
+    ref, out = np.asarray(ref), np.asarray(out)
+    for i in range(ref.shape[0]):
+        n = int(lens[i])
+        if n == 0:
+            # Fully-masked padding row: garbage the engine never reads,
+            # but the denominator clamp must keep it finite.
+            assert np.all(np.isfinite(out[i])), (tag, i)
+            continue
+        d = float(np.max(np.abs(ref[i, :n] - out[i, :n])))
+        assert d < atol, (tag, i, d)
+
+
+class TestRaggedPagedAttention:
+    def test_mixed_batch_matches_reference(self):
+        """The headline shape: one batch holding a full prefill window,
+        a mid-prompt continuation, a decode row, and an empty padding
+        row — one kernel dispatch serves them all."""
+        k_pages, v_pages, pt, q = _setup()
+        q_start = jnp.asarray([0, 13, 29, 0], jnp.int32)
+        lengths = jnp.asarray([16, 9, 1, 0], jnp.int32)
+        ref = _ref(q, k_pages, v_pages, pt, q_start, lengths)
+        out = ragged_paged_attention_pallas(
+            q, k_pages, v_pages, pt, q_start, lengths, interpret=True)
+        _assert_rows_match(ref, out, lengths, "mixed")
+
+    def test_prefill_only_batch(self):
+        k_pages, v_pages, pt, q = _setup(seed=1)
+        q_start = jnp.asarray([0, 0, 8, 16], jnp.int32)
+        lengths = jnp.asarray([16, 12, 16, 16], jnp.int32)
+        ref = _ref(q, k_pages, v_pages, pt, q_start, lengths)
+        out = ragged_paged_attention_pallas(
+            q, k_pages, v_pages, pt, q_start, lengths, interpret=True)
+        _assert_rows_match(ref, out, lengths, "prefill")
+
+    def test_decode_only_batch(self):
+        """All rows length = 1 at T = 1 — the degenerate decode bucket
+        (QB clamps to 1; every row early-outs past its own pages)."""
+        k_pages, v_pages, pt, q = _setup(seed=2, T=1)
+        q_start = jnp.asarray([5, 0, 31, 47], jnp.int32)
+        lengths = jnp.asarray([1, 1, 1, 1], jnp.int32)
+        ref = _ref(q, k_pages, v_pages, pt, q_start, lengths)
+        out = ragged_paged_attention_pallas(
+            q, k_pages, v_pages, pt, q_start, lengths, interpret=True)
+        _assert_rows_match(ref, out, lengths, "decode")
+
+    def test_gqa_widening(self):
+        """G = Hq/Hkv query heads share each KV head; the widened
+        [Hkv, QB*G, D] relayout must keep head↔group pairing intact —
+        compare against a per-head exact reference at G = 4 and G = 1
+        (MHA degenerate)."""
+        for Hq, Hkv in ((8, 2), (4, 4)):
+            k_pages, v_pages, pt, q = _setup(seed=3, Hq=Hq, Hkv=Hkv)
+            q_start = jnp.asarray([0, 3, 20, 11], jnp.int32)
+            lengths = jnp.asarray([16, 13, 1, 5], jnp.int32)
+            ref = _ref(q, k_pages, v_pages, pt, q_start, lengths)
+            out = ragged_paged_attention_pallas(
+                q, k_pages, v_pages, pt, q_start, lengths, interpret=True)
+            _assert_rows_match(ref, out, lengths, f"gqa{Hq}/{Hkv}")
+
+    def test_sliding_window_clamp(self):
+        """Static and traced per-layer window forms, including W = 1
+        (self-attention only) and a window smaller than one page — the
+        per-row early-out must never skip a live step."""
+        k_pages, v_pages, pt, q = _setup(seed=4)
+        q_start = jnp.asarray([0, 13, 29, 40], jnp.int32)
+        lengths = jnp.asarray([16, 9, 1, 8], jnp.int32)
+        for W in (1, 5, 7, 100):
+            ref = _ref(q, k_pages, v_pages, pt, q_start, lengths,
+                       sliding_window=W)
+            out = ragged_paged_attention_pallas(
+                q, k_pages, v_pages, pt, q_start, lengths,
+                sliding_window=W, interpret=True)
+            _assert_rows_match(ref, out, lengths, f"win{W}")
+            traced = ragged_paged_attention_pallas(
+                q, k_pages, v_pages, pt, q_start, lengths,
+                sliding_window=jnp.int32(W), interpret=True)
+            _assert_rows_match(ref, traced, lengths, f"traced-win{W}")
+
+    def test_page_boundary_straddle(self):
+        """Rows whose (q_start, length) spans land mid-page on both
+        ends, with a q_block that does NOT divide the page size — every
+        (query block, kv page) pairing crosses a boundary somewhere."""
+        k_pages, v_pages, pt, q = _setup(seed=5, T=12, ps=8)
+        q_start = jnp.asarray([3, 7, 15, 21], jnp.int32)
+        lengths = jnp.asarray([12, 9, 1, 10], jnp.int32)
+        ref = _ref(q, k_pages, v_pages, pt, q_start, lengths)
+        for qb in (1, 2, 3, 4, 6, 12):
+            out = ragged_paged_attention_pallas(
+                q, k_pages, v_pages, pt, q_start, lengths, q_block=qb,
+                interpret=True)
+            _assert_rows_match(ref, out, lengths, f"straddle-qb{qb}")
+
+    def test_model_deltas_match_reference(self):
+        """Gemma soft-cap + scale override and GPT-OSS sinks on the
+        ragged layout (the same no-model-falls-back surface the decode
+        kernel pins)."""
+        k_pages, v_pages, pt, q = _setup(seed=6)
+        rng = np.random.default_rng(7)
+        sinks = jnp.asarray(rng.normal(size=(q.shape[2],)), jnp.float32)
+        q_start = jnp.asarray([0, 13, 29, 0], jnp.int32)
+        lengths = jnp.asarray([16, 9, 1, 0], jnp.int32)
+        cases = [
+            dict(logits_soft_cap=20.0),
+            dict(scale=0.17),
+            dict(sinks=sinks),
+            dict(sliding_window=7, logits_soft_cap=30.0, scale=0.2),
+            dict(sliding_window=4, sinks=sinks),
+        ]
+        for kw in cases:
+            ref = _ref(q, k_pages, v_pages, pt, q_start, lengths, **kw)
+            out = ragged_paged_attention_pallas(
+                q, k_pages, v_pages, pt, q_start, lengths,
+                interpret=True, **kw)
+            _assert_rows_match(ref, out, lengths, str(kw))
+
+    def test_layered_pool_matches_sliced(self):
+        """The traced ``layer`` scalar routes page DMAs into the FULL
+        stacked [L, P, ps, Hkv, D] pools; each layer must match the
+        reference over that layer's slice."""
+        k_pages, v_pages, pt, q = _setup(seed=8)
+        rng = np.random.default_rng(9)
+        L, P, ps, Hkv, D = 3, 32, 8, 2, 32
+        kL = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+        vL = jnp.asarray(rng.normal(size=(L, P, ps, Hkv, D)), jnp.float32)
+        q_start = jnp.asarray([0, 13, 29, 0], jnp.int32)
+        lengths = jnp.asarray([16, 9, 1, 0], jnp.int32)
+        for li in range(L):
+            ref = _ref(q, kL[li], vL[li], pt, q_start, lengths)
+            out = ragged_paged_attention_pallas(
+                q, kL, vL, pt, q_start, lengths, interpret=True,
+                layer=jnp.int32(li))
+            _assert_rows_match(ref, out, lengths, f"layer{li}")
+
+    def test_null_page_padding_masked(self):
+        """Tables padded with NULL page 0 past each row's real pages:
+        the source-bound mask (kv < q_start + length) must keep page-0
+        bytes out of live lanes."""
+        k_pages, v_pages, pt, q = _setup(seed=10)
+        pt = jnp.asarray([[3, 1, 0, 0, 0, 0], [5, 2, 7, 0, 0, 0],
+                          [4, 0, 0, 0, 0, 0], [6, 8, 9, 10, 0, 0]],
+                         jnp.int32)
+        q_start = jnp.asarray([0, 13, 7, 16], jnp.int32)
+        lengths = jnp.asarray([9, 9, 1, 16], jnp.int32)
+        ref = _ref(q, k_pages, v_pages, pt, q_start, lengths)
+        out = ragged_paged_attention_pallas(
+            q, k_pages, v_pages, pt, q_start, lengths, interpret=True)
+        _assert_rows_match(ref, out, lengths, "null-pages")

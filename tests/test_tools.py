@@ -48,17 +48,27 @@ class TestDecide:
             "prefill.attn_pallas_kernel_layer_ms": 0.5})
         assert env.get("XLLM_PALLAS_PREFILL") == "1"
 
-    def test_decode_variant_needs_compile_and_ten_pct(self):
-        probes = ALL_PREFILL_OK + "\nV4 multirow x8: COMPILE OK\n" \
-                                  "V5 wide: COMPILE OK"
-        budget = {"attn_pallas_grid_ms": 0.20,
-                  "attn_pallas_grid_v2_ms": 0.05,   # wins but no compile
-                  "attn_pallas_multirow_v4x8_ms": 0.12,
-                  "attn_pallas_wide_v5_ms": 0.19}   # <10% win
+    def test_ragged_needs_compile_and_fused_win(self):
+        ragged_ok = ("\nRAGGED mixed-batch: COMPILE OK"
+                     "\nRAGGED window+sinks: COMPILE OK")
+        probes = ALL_PREFILL_OK + ragged_ok
+        budget = {"attn_ragged_mixed_ms": 0.12,
+                  "attn_ragged_split_ms": 0.20}
         env = aoc.decide(probes, budget)
-        assert env.get("XLLM_PALLAS_DECODE_V4") == "8"
-        assert "XLLM_PALLAS_DECODE_V2" not in env
-        assert "XLLM_PALLAS_DECODE_V5" not in env
+        assert env.get("XLLM_RAGGED_ATTN") == "1"
+        # Fused slower than the split pair → stays off.
+        env = aoc.decide(probes, {"attn_ragged_mixed_ms": 0.30,
+                                  "attn_ragged_split_ms": 0.20})
+        assert "XLLM_RAGGED_ATTN" not in env
+        # Any ragged compile FAIL vetoes regardless of the A/B.
+        env = aoc.decide(
+            ALL_PREFILL_OK + "\nRAGGED mixed-batch: COMPILE OK"
+                             "\nRAGGED window+sinks: FAIL: Mosaic",
+            budget)
+        assert "XLLM_RAGGED_ATTN" not in env
+        # No budget numbers yet → compile-clean alone doesn't flip it.
+        env = aoc.decide(probes, {})
+        assert "XLLM_RAGGED_ATTN" not in env
 
     def test_empty_inputs_no_decisions(self):
         assert aoc.decide("", {}) == {}

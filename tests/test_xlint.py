@@ -909,6 +909,39 @@ class TestDevicePlaneRegressions:
                 f"{p.label}@{p.line}: kv at {p.kv_positions()} not " \
                 f"in donate_argnums={sorted(p.donate_argnums)}"
 
+    def test_ragged_program_pinned_and_donated(self, real_tree):
+        """The ragged mixed-batch program (engine._jit_ragged, behind
+        XLLM_RAGGED_ATTN) must carry the prefill program's contract —
+        KV pool donated at argnum 2 and boundary layouts pinned — or
+        every fused mixed dispatch pays a pool copy / layout conversion
+        the split path never paid."""
+        from tools.xlint.tracewalk import tracewalk_analyze
+        tw = tracewalk_analyze(real_tree)
+        progs = [p for p in tw.programs
+                 if p.label == "_jit_ragged"
+                 and p.path.endswith("runtime/engine.py")]
+        assert progs, "_jit_ragged not enumerated from engine.py"
+        for p in progs:
+            assert not p.donate_unresolved, p.label
+            assert p.kv_positions(), \
+                "kv param not visible post-partial — walker regression?"
+            assert set(p.kv_positions()) <= p.donate_argnums, \
+                f"kv at {p.kv_positions()} not in " \
+                f"donate_argnums={sorted(p.donate_argnums)}"
+            assert p.pinned, \
+                "_jit_ragged lost its boundary-layout pin (_pin splat)"
+
+    def test_ragged_qblock_default_read_at_import(self, real_tree):
+        """The ragged kernel's q_block default follows the PR-10
+        QBLOCK convention: XLLM_RAGGED_QBLOCK is read ONCE at import —
+        a per-call env read is a host syscall on the hot path and a
+        recompile hazard if the env changes mid-run."""
+        p = "xllm_service_tpu/ops/pallas/ragged_attention.py"
+        src = real_tree.read_text(p)
+        assert "_QBLOCK_DEFAULT" in src
+        findings = run([p], rule_names=["recompile-hazard"])
+        assert findings == [], "\n".join(f.render() for f in findings)
+
     def test_pallas_qblock_default_read_at_import(self, real_tree):
         """The prefill kernel's q_block static was fed from an env
         read PER CALL — an avoidable host syscall on the hot path and

@@ -11,9 +11,10 @@ driver's end-of-round rerun) should serve with:
 - XLLM_PALLAS_PREFILL=1 when every prefill-kernel form Mosaic-compiled
   AND the budget's per-layer A/B shows the kernel beating the XLA
   gather path (the 5.6 s/call structural fix, docs/PERF_NOTES.md).
-- XLLM_PALLAS_DECODE_V2/V4/V5 when that variant compiled AND beat the
-  default (B, pages) grid kernel by >10% in the budget A/B (V3 already
-  lost on hardware in round 3 and stays off unless it now wins).
+- XLLM_RAGGED_ATTN=1 when every probed ragged mixed-batch form
+  Mosaic-compiled AND the budget A/B shows the fused one-dispatch
+  program beating the split prefill+decode pair it replaces (the V2–V5
+  decode experiments are retired; their flags no longer exist).
 
 No log, no decision: missing/partial artifacts leave the current
 defaults untouched (empty .bench_env)."""
@@ -93,31 +94,21 @@ def decide(probes: str, budget: dict) -> dict:
         if isinstance(k, (int, float)) and k > 0 and (g is None or k < g):
             env["XLLM_PALLAS_PREFILL"] = "1"
 
-    # Decode variants: budget per-layer ms vs the default grid kernel.
-    base = budget.get("attn_pallas_grid_ms")
-    if isinstance(base, (int, float)) and base > 0:
-        def compiled(tag: str) -> bool:
-            return f"{tag}: COMPILE OK" in probes
-
-        best_key, best_ms = None, base * 0.9   # >10% win required
-        for key, tag, comp in (
-                ("attn_pallas_grid_v2_ms", "V2", "V2 transpose-free"),
-                ("attn_pallas_multirow_v4x8_ms", "V4x8", "V4 multirow x8"),
-                ("attn_pallas_multirow_v4x16_ms", "V4x16",
-                 "V4 multirow x16"),
-                ("attn_pallas_wide_v5_ms", "V5", "V5 wide")):
-            ms = budget.get(key)
-            if isinstance(ms, (int, float)) and 0 < ms < best_ms \
-                    and compiled(comp):
-                best_key, best_ms = tag, ms
-        if best_key == "V2":
-            env["XLLM_PALLAS_DECODE_V2"] = "1"
-        elif best_key == "V4x8":
-            env["XLLM_PALLAS_DECODE_V4"] = "8"
-        elif best_key == "V4x16":
-            env["XLLM_PALLAS_DECODE_V4"] = "16"
-        elif best_key == "V5":
-            env["XLLM_PALLAS_DECODE_V5"] = "1"
+    # Ragged mixed-batch kernel: one fused dispatch replacing the mixed
+    # iteration's prefill + decode pair. Every probed ragged form must
+    # lower, and the budget A/B (when present) must show the fused
+    # program beating the split pair it replaces.
+    r_lines = [ln for ln in probes.splitlines() if "RAGGED" in ln]
+    r_ok = sum("COMPILE OK" in ln for ln in r_lines)
+    r_fail = any("FAIL" in ln for ln in r_lines)
+    if r_ok >= 2 and not r_fail:
+        fused = budget.get("attn_ragged_mixed_ms")
+        split = budget.get("attn_ragged_split_ms")
+        if not isinstance(split, (int, float)) or split <= 0:
+            split = None
+        if isinstance(fused, (int, float)) and fused > 0 and \
+                (split is None or fused < split):
+            env["XLLM_RAGGED_ATTN"] = "1"
     return env
 
 

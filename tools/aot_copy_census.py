@@ -200,9 +200,23 @@ def build_programs(tiny: bool = False):
             write_then_attend=_WTA[0])
         return jnp.argmax(last, -1).astype(jnp.int32), kv
 
+    # The ragged mixed-batch program (XLLM_RAGGED_ATTN): same packed
+    # [B, T]+(start, lens) surface as prefill but decode rows ride as
+    # length-1 windows; always write-then-attend and never page-aligned
+    # (engine.py _jit_ragged). The pools must stay donated and unmoved
+    # exactly like the prefill program they replace on mixed iterations.
+    def ragged_step(params, tokens, start, lens, kv, ptp):
+        last, _, kv = transformer.forward_prefill(
+            params, cfg, tokens, start, lens, kv, ptp,
+            page_aligned_prefill=False, write_then_attend=True,
+            ragged=True)
+        return jnp.argmax(last, -1).astype(jnp.int32), kv
+
     return {
         "prefill": (prefill_step, (params, tokens, start, lens, kv, ptp),
                     (4,), pool_shape),
+        "ragged": (ragged_step, (params, tokens, start, lens, kv, ptp),
+                   (4,), pool_shape),
         "decode_single": (decode_single, (params, tok, pos, act, kv, pt),
                           (4,), pool_shape),
         "decode_burst": (decode_burst, (params, tok, pos, act, kv, pt),
@@ -237,7 +251,8 @@ def _kv_layout_kwargs(args, donate, n_out, kv_out=None):
     return {"in_shardings": tuple(ins), "out_shardings": tuple(outs)}
 
 
-_N_OUT = {"prefill": 2, "decode_single": 2, "decode_burst": 4}
+_N_OUT = {"prefill": 2, "ragged": 2, "decode_single": 2,
+          "decode_burst": 4}
 
 
 def run_census(tiny: bool = False, modes=(True, False)) -> dict:

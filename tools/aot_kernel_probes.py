@@ -49,10 +49,10 @@ def _probe(name, fn, args, key=None, **kw):
 
 def main() -> int:
     from xllm_service_tpu.ops.pallas.paged_attention import (
-        _paged_decode_attention_impl, _paged_decode_attention_mr_impl,
-        _paged_decode_attention_row_impl,
-        _paged_decode_attention_wide_impl)
+        _paged_decode_attention_impl)
     from xllm_service_tpu.ops.pallas.prefill_attention import _impl
+    from xllm_service_tpu.ops.pallas.ragged_attention import (
+        ragged_paged_attention_pallas)
 
     results = {}
 
@@ -106,19 +106,6 @@ def main() -> int:
             ("V1 window+sinks", _paged_decode_attention_impl,
              (qd, kd, kd, ptd, ctx, kc, kc, winW, sinks),
              dict(interpret=False)),
-            ("V2 transpose-free", _paged_decode_attention_impl,
-             (qd, kd, kd, ptd, ctx, kc, kc),
-             dict(interpret=False, transpose_free=True)),
-            ("V3 row", _paged_decode_attention_row_impl,
-             (qd, kd, kd, ptd, ctx, kc, kc), dict(interpret=False)),
-            ("V4 multirow x8", _paged_decode_attention_mr_impl,
-             (qd, kd, kd, ptd, ctx, kc, kc),
-             dict(interpret=False, rows=8)),
-            ("V4 multirow x16", _paged_decode_attention_mr_impl,
-             (qd, kd, kd, ptd, ctx, kc, kc),
-             dict(interpret=False, rows=16)),
-            ("V5 wide", _paged_decode_attention_wide_impl,
-             (qd, kd, kd, ptd, ctx, kc, kc), dict(interpret=False)),
             ("V1 MLA shape (Hkv=1 D=576)", _paged_decode_attention_impl,
              (q_mla, k_mla, k_mla, ptd, ctx, kc_mla, kc_mla),
              dict(interpret=False, scale=0.1)),
@@ -132,6 +119,37 @@ def main() -> int:
              {}),
     ):
         results[f"decode/{name}"] = _probe(name, fn, args, **kw)
+
+    # ---- unified ragged mixed-batch kernel (XLLM_RAGGED_ATTN) ----
+    qr = sds((8, 256, Hq, D), jnp.bfloat16)
+    ptr = sds((8, MP), jnp.int32)
+    qsr = sds((8,), jnp.int32)
+    lnr = sds((8,), jnp.int32)
+    results["ragged/RAGGED mixed-batch"] = _probe(
+        "RAGGED mixed-batch",
+        lambda q2, k2, v2, p2, s2, l2: ragged_paged_attention_pallas(
+            q2, k2, v2, p2, s2, l2, interpret=False),
+        (qr, kd, kd, ptr, qsr, lnr))
+    results["ragged/RAGGED window+sinks"] = _probe(
+        "RAGGED window+sinks",
+        lambda q2, k2, v2, p2, s2, l2, w2, sk2:
+        ragged_paged_attention_pallas(
+            q2, k2, v2, p2, s2, l2, sliding_window=w2[0], sinks=sk2,
+            interpret=False),
+        (qr, kd, kd, ptr, qsr, lnr, win, sinks))
+    results["ragged/RAGGED softcap+scale"] = _probe(
+        "RAGGED softcap+scale",
+        lambda q2, k2, v2, p2, s2, l2: ragged_paged_attention_pallas(
+            q2, k2, v2, p2, s2, l2, logits_soft_cap=50.0, scale=0.0625,
+            interpret=False),
+        (qr, kd, kd, ptr, qsr, lnr))
+    results["ragged/layered full-pool (L=16)"] = _probe(
+        "RAGGED layered full-pool (L=16)",
+        lambda q2, k2, v2, p2, s2, l2, ll: ragged_paged_attention_pallas(
+            q2, k2, v2, p2, s2, l2, interpret=False, layer=ll),
+        (qr, sds((16, 1024, PS, Hkv, D), jnp.bfloat16),
+         sds((16, 1024, PS, Hkv, D), jnp.bfloat16), ptr, qsr, lnr,
+         sds((), jnp.int32)))
 
     # ---- layered prefill (full 5D pools + traced layer index) ----
     results["prefill/layered full-pool (L=16)"] = _probe(

@@ -1,9 +1,10 @@
-"""AOT compile-checks for the gated decode-kernel variants on v5e.
+"""AOT compile-checks for the gated Pallas kernels on v5e.
 
-V2 is expected to FAIL with "batch dims must be equal" (same dot form
-that killed V3's first version). The round-5 model-delta probes (window /
-soft-cap / scale / sinks in the V1 kernel) are new code Mosaic has never
-lowered on hardware. Run only when no bench holds the chip."""
+The round-5 model-delta probes (window / soft-cap / scale / sinks in
+the V1 kernel) plus the unified ragged mixed-batch kernel
+(XLLM_RAGGED_ATTN) are the forms Mosaic must lower on hardware; the
+retired V2–V5 decode experiments are gone with their flags. Run only
+when no bench holds the chip."""
 import sys
 
 import jax
@@ -14,8 +15,9 @@ sys.path.insert(0, "/root/repo")
 from xllm_service_tpu.utils.jaxcache import enable_compile_cache
 enable_compile_cache()
 from xllm_service_tpu.ops.pallas.paged_attention import (
-    _paged_decode_attention_impl, _paged_decode_attention_mr_impl,
-    _paged_decode_attention_wide_impl)
+    _paged_decode_attention_impl)
+from xllm_service_tpu.ops.pallas.ragged_attention import (
+    ragged_paged_attention_pallas)
 
 B, Hq, Hkv, D, P, ps, MP = 64, 32, 8, 64, 64, 128, 4
 q = jnp.zeros((B, Hq, D), jnp.bfloat16)
@@ -44,18 +46,15 @@ for name, fn, args, kw in (
         ("V1 window+sinks", _paged_decode_attention_impl,
          (q, k, k, pt, ctx, kc, kc, winW, sinks),
          dict(interpret=False)),
-        ("V2 transpose-free", _paged_decode_attention_impl,
-         (q, k, k, pt, ctx, kc, kc),
-         dict(interpret=False, transpose_free=True)),
-        ("V4 multirow x8", _paged_decode_attention_mr_impl,
-         (q, k, k, pt, ctx, kc, kc),
-         dict(interpret=False, rows=8)),
-        ("V4 multirow x16", _paged_decode_attention_mr_impl,
-         (q, k, k, pt, ctx, kc, kc),
-         dict(interpret=False, rows=16)),
-        ("V5 wide", _paged_decode_attention_wide_impl,
-         (q, k, k, pt, ctx, kc, kc),
+        ("RAGGED mixed-batch", ragged_paged_attention_pallas,
+         (jnp.zeros((B, 128, Hq, D), jnp.bfloat16), k, k, pt,
+          jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.int32)),
          dict(interpret=False)),
+        ("RAGGED window+sinks", ragged_paged_attention_pallas,
+         (jnp.zeros((B, 128, Hq, D), jnp.bfloat16), k, k, pt,
+          jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.int32)),
+         dict(interpret=False, sliding_window=jnp.int32(128),
+              sinks=sinks)),
         ("V1 MLA shape (Hkv=1 D=576)", _paged_decode_attention_impl,
          (q_mla, k_mla, k_mla, pt, ctx, kc_mla, kc_mla),
          dict(interpret=False, scale=0.1)),

@@ -761,6 +761,16 @@ class EngineConfig:
     # decode_steps > 1, off for single-step decode. Env
     # XLLM_DECODE_PIPELINE=0/1 overrides.
     decode_pipeline: Optional[bool] = None
+    # One-dispatch ragged mixed steps: when on, an interleaved iteration
+    # with both running decoders and schedulable prefill windows packs
+    # BOTH into one ragged batch (decode rows are length-1 continuation
+    # windows) and launches ONE attention program
+    # (ops/pallas/ragged_attention.py) instead of a decode burst plus a
+    # prefill call. Pure-decode and pure-prefill iterations keep their
+    # dedicated programs (the fused burst + speculation pipeline stays).
+    # None = auto: off (opt-in while the kernel soaks). Env
+    # XLLM_RAGGED_ATTN=0/1 overrides; read once at Engine init.
+    ragged_attn: Optional[bool] = None
     # Token-budget prefill/decode interleaving (staggered admission,
     # arxiv 2512.16134): every engine iteration decodes the running set
     # FIRST (bounding TPOT by construction), then spends the residual of
@@ -822,6 +832,11 @@ class EngineConfig:
             self.decode_pipeline = False
         elif env in ("1", "true", "yes"):
             self.decode_pipeline = True
+        env = os.environ.get("XLLM_RAGGED_ATTN", "").strip()
+        if env in ("0", "false", "no"):
+            self.ragged_attn = False
+        elif env in ("1", "true", "yes"):
+            self.ragged_attn = True
         env = os.environ.get("XLLM_INTERLEAVE", "").strip()
         if env in ("0", "false", "no"):
             self.interleave = False

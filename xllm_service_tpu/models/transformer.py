@@ -166,6 +166,17 @@ def _use_prefill_kernel(window: int, page_size: int) -> bool:
     return prefill_kernel_enabled() and window % page_size == 0
 
 
+def _use_ragged_kernel() -> bool:
+    """Trace-time gate for the ragged mixed-batch kernel: just the base
+    Pallas gate — the ragged layout reads everything through the page
+    table (write-then-attend), so there is no window/page alignment
+    requirement and no separate env knob at this level (the engine's
+    XLLM_RAGGED_ATTN gate decides whether ragged batches are built at
+    all; off TPU the XLA gather reference serves them)."""
+    from xllm_service_tpu.ops import pallas
+    return pallas.enabled()
+
+
 # Sentinel window for full-attention layers when windows ride the layer
 # scan as traced per-layer values (Gemma-2 alternation): larger than any
 # context, so the window mask is a no-op. Shared with the Pallas kernels
@@ -352,6 +363,7 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                     rope_pos: Optional[jnp.ndarray] = None,
                     page_aligned_prefill: bool = True,
                     write_then_attend: bool = False,
+                    ragged: bool = False,
                     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], KVCache]:
     """Prefill ``tokens`` [B, T] (padded; true new-token counts in
     ``lengths``; nonzero ``start_pos`` = prefix-cache hit, those tokens are
@@ -386,6 +398,17 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     call at the bench shape). Default off here; the engine turns it on
     per EngineConfig.write_then_attend.
 
+    ``ragged`` (static): the batch is a RAGGED MIX — rows may be prefill
+    windows (lengths > 1) or single decode continuations (lengths = 1,
+    start_pos = context − 1), assembled by the engine's one-dispatch
+    interleaved step (XLLM_RAGGED_ATTN). Requires ``write_then_attend``
+    (every row's new K/V must land in the pool before attention) and
+    ``page_aligned_prefill=False`` (decode rows start mid-page).
+    Attention dispatches to the ragged Pallas kernel
+    (ops/pallas/ragged_attention.py) when the base Pallas gate is on;
+    otherwise the pool-gather XLA reference below already handles
+    arbitrary (start, length) rows.
+
     Returns (last_logits [B, V] fp32, all_logits [B, T, V] fp32 or None,
     kv'). ``return_all_logits`` (static) gates the full-prompt lm_head: at
     serving shapes a [B, T, V] fp32 tensor is gigabytes of HBM and a T×
@@ -394,6 +417,7 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     """
     if cfg.mla:
         assert mm_embeds is None, "MLA models have no multimodal splice"
+        assert not ragged, "MLA models have no ragged mixed-batch path"
         return _mla_forward_prefill(
             params, cfg, tokens, start_pos, lengths, kv, page_table,
             return_all_logits=return_all_logits,
@@ -456,7 +480,15 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             kp_c, vp_c = write_prefill_kv_layer(
                 kp_c, vp_c, k, v, page_table, start_pos, lengths, li,
                 page_aligned_starts=page_aligned_prefill)
-            if _use_prefill_kernel(T, kp_c.shape[2]):
+            if ragged and _use_ragged_kernel():
+                from xllm_service_tpu.ops.pallas import (
+                    ragged_paged_attention_pallas)
+                attn = ragged_paged_attention_pallas(
+                    q, kp_c, vp_c, page_table, start_pos, lengths,
+                    sliding_window=w_l, sinks=lp.get("sinks"),
+                    logits_soft_cap=cfg.attn_logit_softcapping,
+                    scale=extras.get("scale"), layer=li)
+            elif _use_prefill_kernel(T, kp_c.shape[2]):
                 from xllm_service_tpu.ops.pallas import (
                     paged_prefill_attention_pallas)
                 attn = paged_prefill_attention_pallas(

@@ -66,3 +66,5 @@ from xllm_service_tpu.ops.pallas.paged_attention import (  # noqa: E402,F401
     paged_decode_attention_pallas)
 from xllm_service_tpu.ops.pallas.prefill_attention import (  # noqa: E402,F401
     paged_prefill_attention_pallas, prefill_kernel_enabled)
+from xllm_service_tpu.ops.pallas.ragged_attention import (  # noqa: E402,F401
+    ragged_attn_enabled, ragged_paged_attention_pallas)

@@ -16,12 +16,18 @@ the trailing two block dims full or (8,128)-aligned, so heads stay in the
 block and the GQA grouping happens in-kernel). NULL pages (id 0) and
 positions ≥ context_len are masked; fully out-of-range pages skip compute
 via ``pl.when`` (their DMA lands on page 0 and is discarded).
+
+The V2–V5 experiment variants (transpose-free fold, whole-row manual-DMA
+walk, multi-row cells, wide block-diagonal) were deleted when the ragged
+kernel (ops/pallas/ragged_attention.py) subsumed the mixed-step decode
+path — none of them beat this base kernel on hardware, and their flag
+matrix fragmented the bench slots and xlint pins (docs/PERF_NOTES.md
+keeps the post-mortems).
 """
 
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -29,37 +35,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from xllm_service_tpu.ops.pallas._compat import (
-    CompilerParams as _CompilerParams, HBM as _HBM)
+    CompilerParams as _CompilerParams)
 
 _NEG_INF = -1e30
-
-
-
-def _transpose_free_default() -> bool:
-    """Transpose-free fold: contract the K/V page blocks in their native
-    [ps, Hkv, D] layout by batching the dot_generals over Hkv *in place*
-    (rhs batch dim at position 1) instead of materializing a transposed
-    [Hkv, ps, D] copy in VMEM per grid cell. Numerically identical
-    (interpret-mode bit-exact); gated until Mosaic lowering is validated
-    on hardware. Read per call, like the sibling XLLM_PALLAS gate, so a
-    runtime toggle (bench retry loops, test fixtures) takes effect."""
-    return os.environ.get("XLLM_PALLAS_DECODE_V2", "0") == "1"
-
-
-def _row_kernel_default() -> bool:
-    """Whole-row decode kernel (grid (B,), double-buffered page DMA)
-    instead of one grid cell per (batch, page). The (B, pages) grid pays
-    per-cell overhead on B*MP tiny cells per layer per step — at the
-    bench shape (B=64, MP=8, 16 layers) that is 8192 cell invocations a
-    step, which dwarfs the actual attention FLOPs at decode. The row
-    kernel walks a sequence's pages inside ONE cell with its own
-    double-buffered HBM→VMEM copies, cutting cell count 8x and bounding
-    the page walk at the sequence's true page count (the grid version
-    visits all MP cells; `pl.when` skips compute but not the cell).
-    Gated off until validated on hardware (XLLM_PALLAS_DECODE_V3=1);
-    read per call like the sibling gates so runtime toggles work."""
-    return os.environ.get("XLLM_PALLAS_DECODE_V3", "0") == "1"
-
 
 # Window sentinel: larger than any context. A plain int — module-level
 # jnp constants would be captured as pallas closure constants, which
@@ -71,8 +49,8 @@ from xllm_service_tpu.ops.attention import FULL_WINDOW as _FULL
 def _kernel(ctx_ref, pt_ref, win_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref,
             sk_ref, o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
             pages_per_seq: int, num_kv_heads: int, has_current: bool,
-            transpose_free: bool, logits_soft_cap: float, scale: float,
-            has_sinks: bool, layered: bool = False):
+            logits_soft_cap: float, scale: float, has_sinks: bool,
+            layered: bool = False):
     b = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -104,17 +82,11 @@ def _kernel(ctx_ref, pt_ref, win_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref,
         # 134 MB slice materialization feeding this custom call).
         k = (k_ref[0, 0] if layered else k_ref[0]).astype(jnp.float32)
         v = (v_ref[0, 0] if layered else v_ref[0]).astype(jnp.float32)
-        if transpose_free:
-            # Batch Hkv where it lives: [Hkv,G,D] x [ps,Hkv,D] -> [Hkv,G,ps]
-            logits = jax.lax.dot_general(
-                qg, k, (((2,), (2,)), ((0,), (1,))),
-                preferred_element_type=jnp.float32) * scale
-        else:
-            kt = jnp.transpose(k, (1, 0, 2))                 # [Hkv, ps, D]
-            # Batched over Hkv: [Hkv, G, D] x [Hkv, ps, D] -> [Hkv, G, ps]
-            logits = jax.lax.dot_general(
-                qg, kt, (((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32) * scale
+        kt = jnp.transpose(k, (1, 0, 2))                     # [Hkv, ps, D]
+        # Batched over Hkv: [Hkv, G, D] x [Hkv, ps, D] -> [Hkv, G, ps]
+        logits = jax.lax.dot_general(
+            qg, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
         logits = logits.reshape(hq, page_size)               # [Hq, ps]
         if logits_soft_cap > 0.0:
             logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
@@ -130,19 +102,12 @@ def _kernel(ctx_ref, pt_ref, win_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref,
         corr = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * corr + jnp.sum(prob, axis=-1,
                                              keepdims=True)
-        if transpose_free:
-            # [Hkv, G, ps] x [ps, Hkv, D] -> [Hkv, G, D]
-            pv = jax.lax.dot_general(
-                prob.reshape(num_kv_heads, g, page_size), v,
-                (((2,), (0,)), ((0,), (1,))),
-                preferred_element_type=jnp.float32)
-        else:
-            vt = jnp.transpose(v, (1, 0, 2))
-            # [Hkv, G, ps] x [Hkv, ps, D] -> [Hkv, G, D]
-            pv = jax.lax.dot_general(
-                prob.reshape(num_kv_heads, g, page_size), vt,
-                (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)
+        vt = jnp.transpose(v, (1, 0, 2))
+        # [Hkv, G, ps] x [Hkv, ps, D] -> [Hkv, G, D]
+        pv = jax.lax.dot_general(
+            prob.reshape(num_kv_heads, g, page_size), vt,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
         acc_ref[:] = acc_ref[:] * corr + pv.reshape(hq, d)
         m_ref[:] = m_new
 
@@ -186,492 +151,6 @@ def _kernel(ctx_ref, pt_ref, win_ref, q_ref, k_ref, v_ref, kc_ref, vc_ref,
         o_ref[0] = (acc_fin / denom).astype(o_ref.dtype)
 
 
-def _row_kernel(ctx_ref, pt_ref, qw_ref, k_hbm, v_hbm, kc_ref, vc_ref,
-                o_ref, k_buf, v_buf, sems, *, page_size: int,
-                has_current: bool):
-    """One grid cell = one batch row's whole page walk.
-
-    K/V pools stay in HBM (memory_space=HBM, no automatic pipeline);
-    the kernel issues its own async copies, page p+1 in flight while
-    page p folds into the online-softmax accumulator. The loop runs
-    ceil(ctx/ps) iterations — a short sequence in a wide table does not
-    visit dead pages. Accumulators are fori_loop carries (f32 values,
-    not scratch refs).
-
-    GQA is expressed BLOCK-DIAGONALLY: the caller pre-expands the query
-    to ``q_wide [Hq, Hkv*D]`` (zeros outside each row's own kv-head
-    slice) and the pools arrive flattened to ``[P, ps, Hkv*D]``, so both
-    dots are plain 2D matmuls and the output is ``o_wide [Hq, Hkv*D]``
-    (each row's result lives in its kv-head's lane slice, selected
-    outside). This wastes Hkv× MXU flops on zero blocks — irrelevant
-    next to decode's weight reads — and is what v5e Mosaic actually
-    lowers: per-head shapes need D=64-aligned HBM slices ("must be
-    aligned to tiling (128)") or vector reshapes like (ps, 512)->(ps,
-    8, 64) ("Not Implemented: tpu.reshape"), both of which fail."""
-    b = pl.program_id(0)
-    ctx = ctx_ref[b]
-    npages = (ctx + page_size - 1) // page_size
-
-    hq, w = qw_ref.shape[1], qw_ref.shape[2]
-    qw = qw_ref[0].astype(jnp.float32)                       # [Hq, W]
-
-    def k_dma(slot, p):
-        return pltpu.make_async_copy(k_hbm.at[pt_ref[b, p]],
-                                     k_buf.at[slot], sems.at[slot, 0])
-
-    def v_dma(slot, p):
-        return pltpu.make_async_copy(v_hbm.at[pt_ref[b, p]],
-                                     v_buf.at[slot], sems.at[slot, 1])
-
-    @pl.when(npages > 0)
-    def _prime():
-        k_dma(0, 0).start()
-        v_dma(0, 0).start()
-
-    def fold(p, carry):
-        m, l, acc = carry
-        slot = jax.lax.rem(p, 2)
-
-        @pl.when(p + 1 < npages)
-        def _prefetch_next():
-            nxt = jax.lax.rem(p + 1, 2)
-            k_dma(nxt, p + 1).start()
-            v_dma(nxt, p + 1).start()
-
-        k_dma(slot, p).wait()
-        v_dma(slot, p).wait()
-        k = k_buf[slot].astype(jnp.float32)                  # [ps, W]
-        v = v_buf[slot].astype(jnp.float32)
-        # [Hq, W] x [ps, W] -> [Hq, ps]; block-diagonal zeros in qw keep
-        # each query head inside its own kv head's D-slice.
-        logits = jax.lax.dot_general(
-            qw, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        pos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page_size), 1)
-        mask = pos < ctx
-        logits = jnp.where(mask, logits, _NEG_INF)
-        blk_max = jnp.max(logits, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, blk_max)
-        prob = jnp.where(mask, jnp.exp(logits - m_new), 0.0)  # [Hq, ps]
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(prob, axis=-1, keepdims=True)
-        # [Hq, ps] x [ps, W] -> [Hq, W]; row hq's useful lanes are its
-        # kv head's slice, the rest carry other heads' values and are
-        # dropped by the caller's diagonal selection.
-        pv = jax.lax.dot_general(
-            prob, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc * corr + pv
-
-    m0 = jnp.full((hq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((hq, 1), jnp.float32)
-    acc0 = jnp.zeros((hq, w), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, npages, fold, (m0, l0, acc0))
-
-    if has_current:
-        # The current token's K/V (in-registers, not yet in the pool) as
-        # a final always-valid single-position block.
-        kc = kc_ref[0].astype(jnp.float32)                   # [1, W]
-        vc = vc_ref[0].astype(jnp.float32)
-        lc = jax.lax.dot_general(
-            qw, kc, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [Hq, 1]
-        m_new = jnp.maximum(m, lc)
-        corr = jnp.exp(m - m_new)
-        pc = jnp.exp(lc - m_new)
-        l = l * corr + pc
-        acc = acc * corr + pc * vc
-    o_ref[0] = acc / jnp.maximum(l, 1e-30)
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _paged_decode_attention_row_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
-                                     v_pages: jnp.ndarray,
-                                     page_table: jnp.ndarray,
-                                     context_lens: jnp.ndarray,
-                                     k_cur: jnp.ndarray = None,
-                                     v_cur: jnp.ndarray = None,
-                                     interpret: bool = False) -> jnp.ndarray:
-    B, Hq, D = q.shape
-    _, page_size, Hkv, _ = k_pages.shape
-    G = Hq // Hkv
-    W = Hkv * D
-    has_current = k_cur is not None
-    if not has_current:
-        k_cur = jnp.zeros((B, Hkv, D), q.dtype)
-        v_cur = jnp.zeros((B, Hkv, D), q.dtype)
-
-    # Pre-scale ONCE here instead of scaling page logits in the kernel.
-    scale = 1.0 / (D ** 0.5)
-    eye = jnp.eye(Hkv, dtype=q.dtype)                        # [Hkv, Hkv]
-    # q [B, Hkv, G, D] -> block-diagonal q_wide [B, Hq, Hkv*D].
-    q_wide = (q.astype(jnp.float32) * scale).astype(q.dtype)
-    q_wide = (q_wide.reshape(B, Hkv, G, 1, D)
-              * eye[:, None, :, None]).reshape(B, Hq, W)
-    k_flat = k_pages.reshape(-1, page_size, W)
-    v_flat = v_pages.reshape(-1, page_size, W)
-    kc_flat = k_cur.reshape(B, 1, W)
-    vc_flat = v_cur.reshape(B, 1, W)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,               # context_lens, page_table
-        grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, Hq, W), lambda b, ctx, pt: (b, 0, 0)),
-            pl.BlockSpec(memory_space=_HBM),    # whole K pool
-            pl.BlockSpec(memory_space=_HBM),    # whole V pool
-            pl.BlockSpec((1, 1, W), lambda b, ctx, pt: (b, 0, 0)),
-            pl.BlockSpec((1, 1, W), lambda b, ctx, pt: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, Hq, W), lambda b, ctx, pt: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, page_size, W), k_pages.dtype),
-            pltpu.VMEM((2, page_size, W), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
-    )
-    o_wide = pl.pallas_call(
-        functools.partial(_row_kernel, page_size=page_size,
-                          has_current=has_current),
-        out_shape=jax.ShapeDtypeStruct((B, Hq, W), jnp.float32),
-        grid_spec=grid_spec,
-        compiler_params=_CompilerParams(
-            dimension_semantics=("arbitrary",)),
-        interpret=interpret,
-    )(context_lens, page_table, q_wide, k_flat, v_flat, kc_flat, vc_flat)
-    # Diagonal selection: row hq keeps its own kv head's D-slice.
-    o = jnp.einsum("bhgkd,hk->bhgd",
-                   o_wide.reshape(B, Hkv, G, Hkv, D),
-                   eye.astype(jnp.float32))
-    return o.reshape(B, Hq, D).astype(q.dtype)
-
-
-def _wide_default() -> bool:
-    """Wide block-diagonal variant of the (B, pages) kernel
-    (XLLM_PALLAS_DECODE_V5): same grid, but queries arrive pre-expanded
-    to [Hq, Hkv*D] (zeros outside each row's kv-head slice) against
-    FLAT [P, ps, Hkv*D] pools, so both dots are plain 2D and the cell
-    body has ZERO relayouts — no per-cell [ps, Hkv, D] -> [Hkv, ps, D]
-    transpose (a VMEM relayout paid B*MP*layers times per step). Wastes
-    Hkv x MXU flops on zero blocks, irrelevant at decode. The same
-    trick that made V3 lowerable; here it attacks per-cell cost
-    instead of cell count (V4's axis)."""
-    return os.environ.get("XLLM_PALLAS_DECODE_V5", "0") == "1"
-
-
-def _widen_q(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
-    """[B, Hq, D] -> block-diagonal [B, Hq, Hkv*D] (pre-scaled by the
-    caller if desired): row hq's kv-head slice holds its query vector,
-    all other lanes zero."""
-    B, Hq, D = q.shape
-    G = Hq // num_kv_heads
-    eye = jnp.eye(num_kv_heads, dtype=q.dtype)
-    return (q.reshape(B, num_kv_heads, G, 1, D)
-            * eye[:, None, :, None]).reshape(B, Hq, num_kv_heads * D)
-
-
-def _select_diag(o_wide: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
-    """[B, Hq, Hkv*D] f32 -> [B, Hq, D]: row hq keeps its own kv head's
-    D-slice."""
-    B, Hq, W = o_wide.shape
-    G = Hq // num_kv_heads
-    D = W // num_kv_heads
-    eye = jnp.eye(num_kv_heads, dtype=jnp.float32)
-    return jnp.einsum(
-        "bhgkd,hk->bhgd",
-        o_wide.reshape(B, num_kv_heads, G, num_kv_heads, D),
-        eye).reshape(B, Hq, D)
-
-
-def _wide_kernel(ctx_ref, pt_ref, qw_ref, k_ref, v_ref, kc_ref, vc_ref,
-                 o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
-                 pages_per_seq: int, has_current: bool):
-    b = pl.program_id(0)
-    p = pl.program_id(1)
-
-    @pl.when(p == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    ctx = ctx_ref[b]
-    page_start = p * page_size
-
-    @pl.when(page_start < ctx)
-    def _fold():
-        qw = qw_ref[0].astype(jnp.float32)                   # [Hq, W]
-        k = k_ref[0].astype(jnp.float32)                     # [ps, W]
-        v = v_ref[0].astype(jnp.float32)
-        logits = jax.lax.dot_general(
-            qw, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [Hq, ps]
-        pos = page_start + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page_size), 1)
-        mask = pos < ctx
-        logits = jnp.where(mask, logits, _NEG_INF)
-        m_prev = m_ref[:]
-        blk_max = jnp.max(logits, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, blk_max)
-        prob = jnp.where(mask, jnp.exp(logits - m_new), 0.0)
-        corr = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_ref[:] * corr + jnp.sum(prob, axis=-1,
-                                             keepdims=True)
-        pv = jax.lax.dot_general(
-            prob, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [Hq, W]
-        acc_ref[:] = acc_ref[:] * corr + pv
-        m_ref[:] = m_new
-
-    @pl.when(p == pages_per_seq - 1)
-    def _finalize():
-        m_fin = m_ref[:]
-        l_fin = l_ref[:]
-        acc_fin = acc_ref[:]
-        if has_current:
-            qw = qw_ref[0].astype(jnp.float32)
-            kc = kc_ref[0].astype(jnp.float32)               # [1, W]
-            vc = vc_ref[0].astype(jnp.float32)
-            lc = jax.lax.dot_general(
-                qw, kc, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)          # [Hq, 1]
-            m_new = jnp.maximum(m_fin, lc)
-            corr = jnp.exp(m_fin - m_new)
-            pc = jnp.exp(lc - m_new)
-            l_fin = l_fin * corr + pc
-            acc_fin = acc_fin * corr + pc * vc
-        o_ref[0] = acc_fin / jnp.maximum(l_fin, 1e-30)
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _paged_decode_attention_wide_impl(q: jnp.ndarray,
-                                      k_pages: jnp.ndarray,
-                                      v_pages: jnp.ndarray,
-                                      page_table: jnp.ndarray,
-                                      context_lens: jnp.ndarray,
-                                      k_cur: jnp.ndarray = None,
-                                      v_cur: jnp.ndarray = None,
-                                      interpret: bool = False
-                                      ) -> jnp.ndarray:
-    B, Hq, D = q.shape
-    _, page_size, Hkv, _ = k_pages.shape
-    MP = page_table.shape[1]
-    W = Hkv * D
-    has_current = k_cur is not None
-    if not has_current:
-        k_cur = jnp.zeros((B, Hkv, D), q.dtype)
-        v_cur = jnp.zeros((B, Hkv, D), q.dtype)
-    scale = 1.0 / (D ** 0.5)
-    q_wide = _widen_q((q.astype(jnp.float32) * scale).astype(q.dtype),
-                      Hkv)
-    k_flat = k_pages.reshape(-1, page_size, W)
-    v_flat = v_pages.reshape(-1, page_size, W)
-    kc_flat = k_cur.reshape(B, 1, W)
-    vc_flat = v_cur.reshape(B, 1, W)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, MP),
-        in_specs=[
-            pl.BlockSpec((1, Hq, W), lambda b, p, ctx, pt: (b, 0, 0)),
-            pl.BlockSpec((1, page_size, W),
-                         lambda b, p, ctx, pt: (pt[b, p], 0, 0)),
-            pl.BlockSpec((1, page_size, W),
-                         lambda b, p, ctx, pt: (pt[b, p], 0, 0)),
-            pl.BlockSpec((1, 1, W), lambda b, p, ctx, pt: (b, 0, 0)),
-            pl.BlockSpec((1, 1, W), lambda b, p, ctx, pt: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, Hq, W),
-                               lambda b, p, ctx, pt: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((Hq, 1), jnp.float32),
-            pltpu.VMEM((Hq, 1), jnp.float32),
-            pltpu.VMEM((Hq, W), jnp.float32),
-        ],
-    )
-    o_wide = pl.pallas_call(
-        functools.partial(_wide_kernel, page_size=page_size,
-                          pages_per_seq=MP, has_current=has_current),
-        out_shape=jax.ShapeDtypeStruct((B, Hq, W), jnp.float32),
-        grid_spec=grid_spec,
-        compiler_params=_CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")),
-        interpret=interpret,
-    )(context_lens, page_table, q_wide, k_flat, v_flat, kc_flat,
-      vc_flat)
-    return _select_diag(o_wide, Hkv).astype(q.dtype)
-
-
-def _multirow_default() -> int:
-    """Rows per grid cell for the multi-row kernel (0 = off). The
-    (B, pages) kernel's cost at decode is dominated by CELL COUNT
-    (B x MP x layers tiny invocations per step — ~8k at the bench
-    shape), not attention FLOPs; V3 cut cells to B but serialized the
-    page walk behind manual DMAs and lost. V4 keeps the AUTOMATIC
-    BlockSpec pipeline (the only page-fetch form Mosaic accepts for
-    D=64 pools — manual DMA needs 128-lane-aligned slices) and simply
-    processes XLLM_PALLAS_DECODE_V4 rows per cell: the pool is passed
-    that many times with per-row page-table index maps, so the pipeline
-    still overlaps all fetches while the cell count drops RB-fold."""
-    try:
-        return int(os.environ.get("XLLM_PALLAS_DECODE_V4", "0"))
-    except ValueError:
-        return 0
-
-
-def _mr_kernel(ctx_ref, pt_ref, q_ref, *refs, page_size: int,
-               num_kv_heads: int, rows: int, pages_per_seq: int,
-               has_current: bool):
-    k_refs = refs[:rows]
-    v_refs = refs[rows:2 * rows]
-    kc_ref, vc_ref, o_ref, m_ref, l_ref, acc_ref = refs[2 * rows:]
-    i = pl.program_id(0)
-    p = pl.program_id(1)
-    hq, d = q_ref.shape[1], q_ref.shape[2]
-    g = hq // num_kv_heads
-    row0 = i * rows
-    ctxs = jnp.stack([ctx_ref[row0 + r] for r in range(rows)])   # [RB]
-    scale = 1.0 / (d ** 0.5)
-
-    @pl.when(p == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    page_start = p * page_size
-
-    @pl.when(page_start < jnp.max(ctxs))
-    def _fold():
-        q = q_ref[...].astype(jnp.float32)                # [RB, Hq, D]
-        qg = q.reshape(rows * num_kv_heads, g, d)
-        k = jnp.concatenate([r[...] for r in k_refs], 0)  # [RB, ps, Hkv, D]
-        v = jnp.concatenate([r[...] for r in v_refs], 0)
-        kt = jnp.transpose(k.astype(jnp.float32), (0, 2, 1, 3)) \
-            .reshape(rows * num_kv_heads, page_size, d)
-        vt = jnp.transpose(v.astype(jnp.float32), (0, 2, 1, 3)) \
-            .reshape(rows * num_kv_heads, page_size, d)
-        # [RB*Hkv, G, D] x [RB*Hkv, ps, D] -> [RB*Hkv, G, ps]; batch dim
-        # at index 0 on both sides (the only form v5e Mosaic lowers).
-        logits = jax.lax.dot_general(
-            qg, kt, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale
-        logits = logits.reshape(rows, hq, page_size)
-        pos = page_start + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page_size), 1)                 # [1, ps]
-        # Per-row scalar compares, stacked: reshaping the [RB] ctx
-        # vector to [RB,1,1] is a Mosaic-unlowerable shape cast
-        # ("tpu.reshape vector<8xi32> -> vector<8x1x1xi32>" — offline
-        # v5e AOT probe); scalar-vs-vector broadcasts are fine and RB
-        # is static.
-        mask = jnp.stack([pos < ctx_ref[row0 + r]
-                          for r in range(rows)])          # [RB, 1, ps]
-        logits = jnp.where(mask, logits, _NEG_INF)
-        m_prev = m_ref[...]                               # [RB, Hq, 1]
-        blk_max = jnp.max(logits, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, blk_max)
-        prob = jnp.exp(logits - m_new)
-        prob = jnp.where(mask, prob, 0.0)
-        corr = jnp.exp(m_prev - m_new)
-        l_ref[...] = l_ref[...] * corr + jnp.sum(prob, axis=-1,
-                                                 keepdims=True)
-        pv = jax.lax.dot_general(
-            prob.reshape(rows * num_kv_heads, g, page_size), vt,
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        acc_ref[...] = acc_ref[...] * corr \
-            + pv.reshape(rows, hq, d)
-        m_ref[...] = m_new
-
-    @pl.when(p == pages_per_seq - 1)
-    def _finalize():
-        m_fin = m_ref[...]
-        l_fin = l_ref[...]
-        acc_fin = acc_ref[...]
-        if has_current:
-            q = q_ref[...].astype(jnp.float32)
-            qg4 = q.reshape(rows, num_kv_heads, g, d)
-            kc = kc_ref[...].astype(jnp.float32)          # [RB, Hkv, D]
-            vc = vc_ref[...].astype(jnp.float32)
-            lc = jnp.sum(qg4 * kc[:, :, None, :], -1) * scale
-            lc = lc.reshape(rows, hq, 1)
-            m_new = jnp.maximum(m_fin, lc)
-            corr = jnp.exp(m_fin - m_new)
-            pc = jnp.exp(lc - m_new)
-            l_fin = l_fin * corr + pc
-            vc_full = jnp.broadcast_to(
-                vc[:, :, None, :],
-                (rows, num_kv_heads, g, d)).reshape(rows, hq, d)
-            acc_fin = acc_fin * corr + pc * vc_full
-        denom = jnp.maximum(l_fin, 1e-30)
-        o_ref[...] = (acc_fin / denom).astype(o_ref.dtype)
-
-
-@functools.partial(jax.jit, static_argnames=("rows", "interpret"))
-def _paged_decode_attention_mr_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
-                                    v_pages: jnp.ndarray,
-                                    page_table: jnp.ndarray,
-                                    context_lens: jnp.ndarray,
-                                    k_cur: jnp.ndarray = None,
-                                    v_cur: jnp.ndarray = None,
-                                    rows: int = 8,
-                                    interpret: bool = False
-                                    ) -> jnp.ndarray:
-    B, Hq, D = q.shape
-    _, page_size, Hkv, _ = k_pages.shape
-    MP = page_table.shape[1]
-    has_current = k_cur is not None
-    if not has_current:
-        k_cur = jnp.zeros((B, Hkv, D), q.dtype)
-        v_cur = jnp.zeros((B, Hkv, D), q.dtype)
-    RB = max(1, min(rows, B))
-    pad = (-B) % RB
-    if pad:
-        q = jnp.pad(q, ((0, pad), (0, 0), (0, 0)))
-        k_cur = jnp.pad(k_cur, ((0, pad), (0, 0), (0, 0)))
-        v_cur = jnp.pad(v_cur, ((0, pad), (0, 0), (0, 0)))
-        page_table = jnp.pad(page_table, ((0, pad), (0, 0)))
-        context_lens = jnp.pad(context_lens, (0, pad))
-    Bp = B + pad
-
-    def k_idx(r):
-        return lambda i, p, ctx, pt: (pt[i * RB + r, p], 0, 0, 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,               # context_lens, page_table
-        grid=(Bp // RB, MP),
-        in_specs=[
-            pl.BlockSpec((RB, Hq, D), lambda i, p, ctx, pt: (i, 0, 0)),
-            *[pl.BlockSpec((1, page_size, Hkv, D), k_idx(r))
-              for r in range(RB)],
-            *[pl.BlockSpec((1, page_size, Hkv, D), k_idx(r))
-              for r in range(RB)],
-            pl.BlockSpec((RB, Hkv, D), lambda i, p, ctx, pt: (i, 0, 0)),
-            pl.BlockSpec((RB, Hkv, D), lambda i, p, ctx, pt: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((RB, Hq, D),
-                               lambda i, p, ctx, pt: (i, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((RB, Hq, 1), jnp.float32),
-            pltpu.VMEM((RB, Hq, 1), jnp.float32),
-            pltpu.VMEM((RB, Hq, D), jnp.float32),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(_mr_kernel, page_size=page_size,
-                          num_kv_heads=Hkv, rows=RB, pages_per_seq=MP,
-                          has_current=has_current),
-        out_shape=jax.ShapeDtypeStruct((Bp, Hq, D), q.dtype),
-        grid_spec=grid_spec,
-        compiler_params=_CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")),
-        interpret=interpret,
-    )(context_lens, page_table, q,
-      *([k_pages] * RB), *([v_pages] * RB), k_cur, v_cur)
-    return out[:B]
-
-
 def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
                                   v_pages: jnp.ndarray,
                                   page_table: jnp.ndarray,
@@ -679,7 +158,6 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
                                   k_cur: jnp.ndarray = None,
                                   v_cur: jnp.ndarray = None,
                                   interpret: bool = None,
-                                  transpose_free: bool = None,
                                   sliding_window=0,
                                   logits_soft_cap: float = 0.0,
                                   scale=None,
@@ -694,56 +172,19 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pages: jnp.ndarray,
     ``sliding_window`` is a static int OR a traced int32 scalar (per-layer
     window vectors riding the layer scan — Gemma-2/3, GPT-OSS); 0
     disables. ``logits_soft_cap``/``scale`` static floats (Gemma);
-    ``sinks`` an optional [Hq] array (GPT-OSS). Model deltas are
-    implemented by the base (V1) kernel only — calls carrying any of them
-    route there regardless of the V3/V4/V5 experiment gates.
+    ``sinks`` an optional [Hq] array (GPT-OSS).
 
-    ``transpose_free=None`` resolves the XLLM_PALLAS_DECODE_V2 env var
-    HERE, outside the jit cache, so runtime toggles take effect (the
-    sibling XLLM_PALLAS gate has the same call-time semantics).
     ``interpret=None`` → Pallas interpreter off TPU (XLLM_PALLAS=1 on CPU
     exercises the kernel path in tests instead of crashing in Mosaic)."""
-    if transpose_free is None:
-        transpose_free = _transpose_free_default()
     if interpret is None:
         from xllm_service_tpu.ops import pallas
         interpret = pallas.default_interpret()
-    plain = (isinstance(sliding_window, int) and sliding_window == 0
-             and logits_soft_cap == 0.0 and scale is None
-             and sinks is None)
-    if plain and layer is not None and (
-            _wide_default() or _multirow_default() > 1
-            or _row_kernel_default()):
-        # Experiment-variant A/B with the layered serving path: the
-        # V3/V4/V5 kernels take per-layer pools, so slice here (the
-        # materialization cost is the experiment's to measure — without
-        # this the env knobs would silently no-op from serving).
-        k_pages = jax.lax.dynamic_index_in_dim(
-            k_pages, layer, axis=0, keepdims=False)
-        v_pages = jax.lax.dynamic_index_in_dim(
-            v_pages, layer, axis=0, keepdims=False)
-        layer = None
-    plain = plain and layer is None
-    if plain:
-        if _wide_default():
-            return _paged_decode_attention_wide_impl(
-                q, k_pages, v_pages, page_table, context_lens, k_cur,
-                v_cur, interpret=interpret)
-        mr = _multirow_default()
-        if mr > 1:
-            return _paged_decode_attention_mr_impl(
-                q, k_pages, v_pages, page_table, context_lens, k_cur,
-                v_cur, rows=mr, interpret=interpret)
-        if _row_kernel_default():
-            return _paged_decode_attention_row_impl(
-                q, k_pages, v_pages, page_table, context_lens, k_cur,
-                v_cur, interpret=interpret)
     win = jnp.asarray(sliding_window, jnp.int32).reshape(1)
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     return _paged_decode_attention_impl(
         q, k_pages, v_pages, page_table, context_lens, k_cur, v_cur, win,
-        sinks, interpret=interpret, transpose_free=transpose_free,
+        sinks, interpret=interpret,
         logits_soft_cap=float(logits_soft_cap), scale=float(scale),
         layer=layer)
 
@@ -755,8 +196,8 @@ def _kernel_layered(ctx_ref, pt_ref, win_ref, lyr_ref, *rest, **kw):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("interpret", "transpose_free",
-                                    "logits_soft_cap", "scale"))
+                   static_argnames=("interpret", "logits_soft_cap",
+                                    "scale"))
 def _paged_decode_attention_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
                                  v_pages: jnp.ndarray,
                                  page_table: jnp.ndarray,
@@ -766,7 +207,6 @@ def _paged_decode_attention_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
                                  win: jnp.ndarray = None,
                                  sinks: jnp.ndarray = None,
                                  interpret: bool = False,
-                                 transpose_free: bool = False,
                                  logits_soft_cap: float = 0.0,
                                  scale: float = None,
                                  layer: jnp.ndarray = None) -> jnp.ndarray:
@@ -832,7 +272,6 @@ def _paged_decode_attention_impl(q: jnp.ndarray, k_pages: jnp.ndarray,
         functools.partial(_kernel_layered if layered else _kernel,
                           page_size=page_size, pages_per_seq=MP,
                           num_kv_heads=Hkv, has_current=has_current,
-                          transpose_free=transpose_free,
                           logits_soft_cap=logits_soft_cap, scale=scale,
                           has_sinks=has_sinks, layered=layered),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),

@@ -93,7 +93,7 @@ _FULL = FULL_WINDOW
 
 
 def prefill_kernel_enabled() -> bool:
-    """Call-time gate (sibling of XLLM_PALLAS / XLLM_PALLAS_DECODE_V2):
+    """Call-time gate (sibling of XLLM_PALLAS / XLLM_RAGGED_ATTN):
     off by default until validated on hardware. Requires the base Pallas
     gate too — there is no interpret fallback on the serving path."""
     if os.environ.get("XLLM_PALLAS_PREFILL", "0") != "1":

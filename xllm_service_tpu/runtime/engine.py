@@ -295,6 +295,30 @@ class Engine:
                               write_then_attend=self.write_then_attend),
             donate_argnums=(2,), static_argnums=(12,),
             **_pin(12, 2, 6))
+        # One-dispatch ragged mixed steps (opt-in, XLLM_RAGGED_ATTN or
+        # EngineConfig.ragged_attn): a mixed iteration packs decode rows
+        # (length-1 continuation windows) and prefill windows into ONE
+        # ragged batch served by ONE compiled program. The gate is read
+        # ONCE here and cached — the engine never re-reads the env on
+        # the hot path (xlint recompile-hazard rule). The ragged program
+        # reuses the prefill step verbatim with ragged=True: decode rows
+        # are continuation windows (start=len(tokens)-1, length=1), so
+        # write-then-attend + per-row causal masking already give the
+        # exact decode semantics. MLA models keep the legacy split path
+        # (no ragged kernel for absorbed-MLA pools).
+        rag = getattr(engine_cfg, "ragged_attn", None)
+        if rag is None:
+            from xllm_service_tpu.ops.pallas import ragged_attn_enabled
+            rag = ragged_attn_enabled()
+        self.ragged = bool(rag) and not model_cfg.mla
+        self._jit_ragged = None
+        if self.ragged:
+            self._jit_ragged = jax.jit(
+                functools.partial(_prefill_step, cfg=model_cfg,
+                                  num_top=K, page_aligned=False,
+                                  write_then_attend=True, ragged=True),
+                donate_argnums=(2,), static_argnums=(12,),
+                **_pin(12, 2, 5))
         # Sequence-parallel ring prefill: available when the mesh has an
         # sp axis — prompts longer than the largest single-chip bucket
         # prefill in ONE sp-sharded step instead of many chunked windows.
@@ -397,6 +421,13 @@ class Engine:
         # True when a prefill-first iteration deferred live decodes (the
         # stall the interleaver removes; worker's decode-stall counter).
         self.last_step_decode_deferred = False
+        # Ragged-step ledger: whether the LAST iteration ran the
+        # one-dispatch ragged mixed program, and how many attention-
+        # bearing device dispatches the iteration issued (ragged mixed
+        # step = 1; legacy mixed step = 1 decode burst + 1 per prefill
+        # call). The acceptance pin for the ragged path lives on these.
+        self.last_step_ragged = False
+        self.last_step_attn_dispatches = 0
         self.num_preemptions = 0
         # MoE capacity-drop accounting (VERDICT r2 weak #4: drops must be
         # visible). Monotonic per-engine counter of (token, expert)
@@ -508,6 +539,7 @@ class Engine:
         for name, jitted in (("prefill", self._jit_prefill),
                              ("prefill_plp", self._jit_prefill_plp),
                              ("prefill_ring", self._jit_prefill_ring),
+                             ("ragged", self._jit_ragged),
                              ("decode", self._jit_decode),
                              ("decode_multi", self._jit_decode_multi),
                              ("kv_scatter", _kv_scatter)):
@@ -929,6 +961,8 @@ class Engine:
         self.last_step_prefill_s = 0.0
         self.last_step_prefill_windows = ()
         self.last_step_decode_deferred = False
+        self.last_step_ragged = False
+        self.last_step_attn_dispatches = 0
         if self.interleave:
             outs = self._step_interleaved(outs)
         else:
@@ -942,6 +976,13 @@ class Engine:
         return outs
 
     def _step_interleaved(self, outs: List[StepOutput]) -> List[StepOutput]:
+        if self._jit_ragged is not None and self.running and self.waiting:
+            # One-dispatch ragged mixed step: decode rows and prefill
+            # windows in one batch, one compiled program. Falls back to
+            # the legacy decode-then-prefill sections when the iteration
+            # isn't ragged-eligible (returns False without scheduling).
+            if self._step_ragged_mixed(outs):
+                return outs
         pre = len(outs)
         if self.running:
             outs.extend(self._decode_once())
@@ -1018,6 +1059,194 @@ class Engine:
         if waited_ms < self.prefill_deadline_ms:
             return 0
         return self.ecfg.prefill_buckets[0]
+
+    def _step_ragged_mixed(self, outs: List[StepOutput]) -> bool:
+        """Try to serve this mixed iteration as ONE ragged dispatch.
+
+        Returns False — with NO state mutated beyond page growth — when
+        the iteration is not ragged-eligible, so the caller falls back
+        to the legacy decode-then-prefill sections. Once a prefill
+        batch has been scheduled (windows pinned, members pulled from
+        the waiting queue), the iteration is committed: an eligibility
+        miss discovered after scheduling runs the legacy sections on
+        the already-scheduled batch instead of re-queueing it.
+
+        Ineligible iterations: mrope models (decode rows need the
+        per-slot rope delta, prefill rows explicit 3-D positions — the
+        ragged program carries neither), decode rows using presence/
+        frequency penalties (the prefill program samples without the
+        output-token histogram), ring (> largest bucket) or
+        prompt-logprob windows (dedicated programs), and batches whose
+        decoders all got preempted by the scheduler's page pressure."""
+        if self._mrope:
+            return False
+        # Restore pages-cover-len for every decoder BEFORE scheduling
+        # (legacy order: decode runs first, then the scheduler spends
+        # what's left). Growth may preempt — iterate over a snapshot.
+        for seq in list(self.running):
+            if seq.status == SeqStatus.RUNNING:
+                self._grow_pages(seq)
+        decode_seqs = [s for s in self.running
+                       if s.status == SeqStatus.RUNNING]
+        if not decode_seqs:
+            return False
+        if any(s.req.sampling.presence_penalty
+               or s.req.sampling.frequency_penalty
+               for s in decode_seqs):
+            return False
+        # Ragged decode rows are single-token continuations: each
+        # decoder spends 1 token of the budget (the fused burst's N
+        # tokens don't apply — the ragged program takes one step).
+        budget = self.step_token_budget - len(decode_seqs)
+        if self.waiting:
+            budget = max(budget, self._starvation_quantum())
+        if budget <= 0:
+            return False
+        with self._phase("sched"):
+            batch = self._schedule_prefill(budget)
+        if not batch:
+            return False
+        # Scheduling can preempt decoders (admission page pressure);
+        # preempted ones skip this iteration's decode and re-prefill
+        # later, exactly as on the legacy path.
+        decode_seqs = [s for s in self.running
+                       if s.status == SeqStatus.RUNNING]
+        cap1 = self.ecfg.prefill_buckets[-1]
+        if (not decode_seqs
+                or batch[0].sched_window > cap1
+                or batch[0].req.prompt_logprobs):
+            # Committed but not ragged-servable: run the legacy
+            # sections with the batch the scheduler already pinned.
+            pre = len(outs)
+            if self.running:
+                outs.extend(self._decode_once())
+                self.last_step_decode_tokens = sum(
+                    len(o.new_token_ids) for o in outs[pre:])
+            self._run_prefill_section(batch, outs)
+            return True
+        self._run_ragged(decode_seqs, batch, outs)
+        return True
+
+    def _run_ragged(self, decode_seqs: List[Sequence],
+                    batch: List[Sequence],
+                    outs: List[StepOutput]) -> None:
+        """One ragged dispatch for a mixed iteration: decode rows first
+        (length-1 continuation windows at start = len(tokens) - 1),
+        then the scheduled prefill windows — one packed transfer, one
+        compiled program (``_prefill_step`` with ragged=True), one
+        readback. The ragged program is row-indexed like prefill (not
+        slot-indexed like decode), so the post loops index by row."""
+        self.drain_pipeline()
+        windows = [s.sched_window or self._next_window(s, s.num_computed)
+                   for s in batch]
+        for s in batch:
+            s.sched_window = 0
+        rows = list(decode_seqs) + list(batch)
+        nd = len(decode_seqs)
+        self._note_members(rows)
+        self.last_step_ragged = True
+        self.last_step_prefill_windows = tuple(windows)
+        self.last_step_prefill_tokens = sum(windows)
+        self.last_step_decode_tokens = nd
+        t0 = time.monotonic()
+        with self._phase("ragged.pack"):
+            B = 1 << (len(rows) - 1).bit_length()
+            T = self._bucket(max(windows))
+            # Unlike page-aligned prefill there is no padded overlay
+            # window: the XLA masked writer only touches [start,
+            # start+length), so the table needs exactly each row's own
+            # pages (decode growth and prefill admission already cover
+            # the sampled token's page). Clamped like _table_width —
+            # no row can own more than max_pages_per_seq pages, and the
+            # clamp keeps the width ladder aligned with the decode
+            # widths warmup pre-compiles.
+            mp = max(len(s.pages) for s in rows)
+            MP = min(1 << max(mp - 1, 0).bit_length(),
+                     self.ecfg.max_pages_per_seq)
+            packed = np.zeros((B, _PREFILL_HDR + T + MP), np.int32)
+            for i, seq in enumerate(rows):
+                if i < nd:
+                    start, new = len(seq.tokens) - 1, seq.tokens[-1:]
+                else:
+                    start = seq.num_computed
+                    new = seq.tokens[start:start + windows[i - nd]]
+                packed[i, 0] = start
+                packed[i, 1] = len(new)
+                packed[i, _PREFILL_HDR:_PREFILL_HDR + len(new)] = new
+                packed[i, _PREFILL_HDR + T:
+                       _PREFILL_HDR + T + len(seq.pages)] = seq.pages
+            st_f32, st_i32 = self._sampling_tensors(
+                [s.req.sampling for s in rows], B)
+            bias_ids, bias_vals = self._batch_bias(
+                [s.req.sampling for s in rows], B, self.cfg.vocab_size)
+            self._rng_key, key = jax.random.split(self._rng_key)
+            mm_e = mm_p = None
+            if any(s.req.mm_embeds is not None for s in batch):
+                max_m = max(len(s.req.mm_positions or ()) for s in batch)
+                M = 1 << max(max_m - 1, 0).bit_length()
+                D = self.cfg.hidden_size
+                mm_e = np.zeros((B, M, D), np.float32)
+                mm_p = np.full((B, M), T, np.int32)
+                for j, seq in enumerate(batch):
+                    if seq.req.mm_embeds is None:
+                        continue
+                    for k, pos in enumerate(seq.req.mm_positions):
+                        rel = pos - seq.num_computed
+                        if 0 <= rel < windows[j]:
+                            mm_p[nd + j, k] = rel
+                            mm_e[nd + j, k] = seq.req.mm_embeds[k]
+                mm_e = jnp.asarray(mm_e)
+                mm_p = jnp.asarray(mm_p)
+        cache_before = self._jit_cache_size(self._jit_ragged)
+        with self._phase("ragged.dispatch"):
+            fused, top_ids, top_lps, self.kv, mdrop = \
+                self._jit_ragged(self.params, jnp.asarray(packed),
+                                 self.kv, st_f32, st_i32, key, mm_e,
+                                 mm_p, None, bias_ids, bias_vals, None,
+                                 T)
+        self.last_step_attn_dispatches += 1
+        self._note_recompile("ragged", self._jit_ragged, cache_before)
+        want_top = self._want_top(top_ids, rows)
+        fused, top_ids, top_lps, mdrop = self._read_host(
+            "ragged", fused,
+            top_ids if want_top else None,
+            top_lps if want_top else None, mdrop)
+        next_tok, logprob = _split_tok_lp(fused)
+        self._note_moe_dropped(mdrop)
+        # Batch membership changed (admits): penalty histograms rebuild
+        # from host truth before the next penalized decode.
+        self._counts = None
+
+        now = time.monotonic()
+        with self._phase("ragged.post"):
+            for i, seq in enumerate(decode_seqs):
+                if seq.status == SeqStatus.RUNNING:
+                    seq.num_computed = len(seq.tokens)
+                outs.append(self._append_token(
+                    seq, int(next_tok[i]), float(logprob[i]),
+                    top=self._top_entry(seq, top_ids, top_lps, i)))
+            for j, seq in enumerate(batch):
+                i = nd + j
+                if seq.num_computed + windows[j] < len(seq.tokens):
+                    # Mid-prompt window: requeue for the next window.
+                    seq.num_computed += windows[j]
+                    self._swa_trim(seq)
+                    self._sync_slot(seq)
+                    if seq not in self.waiting:
+                        self.waiting.append(seq)
+                    self._sort_waiting()
+                    continue
+                seq.status = SeqStatus.RUNNING
+                seq.num_computed = len(seq.tokens)
+                seq.first_token_time = now
+                self.running.append(seq)
+                out = self._append_token(
+                    seq, int(next_tok[i]), float(logprob[i]),
+                    top=self._top_entry(seq, top_ids, top_lps, i))
+                out.num_cached_tokens = seq.num_cached_tokens
+                outs.append(out)
+                self._sync_slot(seq)
+        self.last_step_prefill_s = time.monotonic() - t0
 
     def _drain_cancelled(self) -> List[StepOutput]:
         outs = []
@@ -1205,6 +1434,7 @@ class Engine:
                     jitted(self.params, jnp.asarray(packed), self.kv,
                            st_f32, st_i32, key, mm_e, mm_p, None,
                            bias_ids, bias_vals, rope_pos, T)
+        self.last_step_attn_dispatches += 1
         self._note_recompile("prefill_plp" if plp_mode else "prefill",
                              jitted, cache_before)
         want_top = self._want_top(top_ids, batch)
@@ -1288,6 +1518,7 @@ class Engine:
                 self._jit_prefill_ring(
                     self.params, jnp.asarray(packed), self.kv,
                     st_f32, st_i32, key, bias_ids, bias_vals, t_len=T)
+        self.last_step_attn_dispatches += 1
         self._note_recompile("prefill_ring", self._jit_prefill_ring,
                              cache_before)
         want_top = self._want_top(top_ids, (seq,))
@@ -1354,6 +1585,7 @@ class Engine:
                     self.params, packed, self.kv,
                     st_f32, st_i32, key, self._ensure_counts(),
                     *self._ensure_bias())
+        self.last_step_attn_dispatches += 1
         self._note_recompile("decode", self._jit_decode, cache_before)
         want_top = self._want_top(top_ids, self.running)
         fused, top_ids, top_lps, mdrop = self._read_host(
@@ -1520,6 +1752,7 @@ class Engine:
                     self.params, dev_tok, dev_pos, self._dev_active_pt,
                     self.kv, st_f32, st_i32, key, self._ensure_counts(),
                     *self._ensure_bias())
+        self.last_step_attn_dispatches += 1
         self._note_recompile("decode_multi", self._jit_decode_multi,
                              cache_before)
         self.phase_counts["decode_multi.resident_hit"] += int(resident_hit)
@@ -1549,6 +1782,7 @@ class Engine:
                     self.params, burst["fin_tok"], burst["fin_pos"],
                     self._dev_active_pt, self.kv, *self._slot_st, key,
                     self._ensure_counts(), *self._ensure_bias())
+        self.last_step_attn_dispatches += 1
         self._note_recompile("decode_multi", self._jit_decode_multi,
                              cache_before)
         _start_host_copy(fused, top_ids if burst["want_top"] else None,
@@ -2404,6 +2638,30 @@ class Engine:
                 (_, _, _, self.kv, _, _, _, _) = self._jit_decode_multi(
                     self.params, f_tok, f_pos, apt0, self.kv, st_f32,
                     st_i32, key2, None, b_ids, b_vals)
+        # Ragged mixed programs (opt-in): batch bucket = pow2(decoders +
+        # admits) — any rung of the pow2 ladder — at each prefill bucket,
+        # with the table as wide as the wider of the decode widths and
+        # the prefill tables (a ragged batch's width is the max over its
+        # rows' own pages, decode and prefill alike). The cross product
+        # IS the ragged bucket ladder: every shape a mixed iteration of
+        # the covered schedule can form compiles here, keeping the
+        # post-warmup recompile counters at zero with the ragged path on.
+        if self._jit_ragged is not None and extended:
+            t_set = sorted({T for _, T, _ in prefill_shapes})
+            mp_set = sorted({mp for *_, mp in prefill_shapes}
+                            | set(widths))
+            for B in batch_pows:
+                st_f32, st_i32 = self._sampling_tensors([], B)
+                b_ids, b_vals = self._batch_bias([], B,
+                                                 self.cfg.vocab_size)
+                for T in t_set:
+                    for mp in mp_set:
+                        _, _, _, self.kv, _ = self._jit_ragged(
+                            self.params,
+                            jnp.zeros((B, _PREFILL_HDR + T + mp),
+                                      jnp.int32),
+                            self.kv, st_f32, st_i32, key, None, None,
+                            None, b_ids, b_vals, None, T)
         jax.block_until_ready(jax.tree_util.tree_leaves(self.kv)[0])
         return time.monotonic() - t0
 
@@ -2504,7 +2762,8 @@ def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
                   cfg: ModelConfig, num_top: int = 0,
                   with_prompt_lps: bool = False,
                   page_aligned: bool = True,
-                  write_then_attend: bool = False):
+                  write_then_attend: bool = False,
+                  ragged: bool = False):
     start_pos = packed[:, 0]
     lengths = packed[:, 1]
     tokens = packed[:, _PREFILL_HDR:_PREFILL_HDR + t_len]
@@ -2516,7 +2775,7 @@ def _prefill_step(params, packed, kv, st_f32, st_i32, key, mm_embeds=None,
         prompt_lp_targets=plp_targets if with_prompt_lps else None,
         return_stats=True, rope_pos=rope_pos,
         page_aligned_prefill=page_aligned,
-        write_then_attend=write_then_attend)
+        write_then_attend=write_then_attend, ragged=ragged)
     if with_prompt_lps:
         last_logits, _, kv, plp, stats = res
     else:

@@ -1405,6 +1405,16 @@ class Worker:
                 buckets=_PREFILL_QUANTUM_BUCKETS)
             for w in eng.last_step_prefill_windows:
                 h.observe(w, model=m)
+        # One-dispatch mixed iterations (XLLM_RAGGED_ATTN). Materialized
+        # at 0 so a scrape can tell "ragged off / never fired" from
+        # "not exported"; the ragged.pack/dispatch/post phase wall time
+        # rides the phase ledger below like every other engine phase.
+        self.obs.counter(
+            "xllm_worker_ragged_dispatches_total",
+            "mixed prefill+decode iterations served by the single "
+            "ragged attention program (XLLM_RAGGED_ATTN)",
+            labelnames=("model",)).set_total(
+            eng.phase_counts.get("ragged.dispatch", 0), model=m)
         self._flush_phase_ledger(rt)
         self._flush_overlap(rt)
         self._flush_prefix_cache(rt)
