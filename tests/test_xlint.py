@@ -46,7 +46,7 @@ def real_tree():
 
 @pytest.fixture(scope="module")
 def timed_full_run():
-    """ONE cold full-tree 19-rule run, timed, shared by the clean gate
+    """ONE cold full-tree 22-rule run, timed, shared by the clean gate
     and the budget gate — running it twice would double-bill the
     callgraph build against the 870 s tier-1 budget."""
     import time
@@ -57,7 +57,7 @@ def timed_full_run():
 
 class TestRealTree:
     def test_real_tree_is_clean(self, timed_full_run):
-        """The acceptance gate: all nineteen rules over
+        """The acceptance gate: all twenty-two rules over
         xllm_service_tpu/, checked-in allowlists applied, zero
         findings."""
         findings, _t = timed_full_run
@@ -107,7 +107,7 @@ class TestRealTree:
                 f"utils/locks.py docstring table"
 
     def test_full_run_fits_runtime_budget(self, timed_full_run):
-        """All 19 rules (the whole-program concurrency pass, the
+        """All 22 rules (the whole-program concurrency pass, the
         exception-flow/lifecycle pass, AND the device-plane tracewalk,
         callgraph memoized per run) over the real tree in < 30 s — the interprocedural analysis
         must never eat the 870 s tier-1 budget. Typical: ~5 s; the
@@ -352,6 +352,45 @@ class TestPositiveControls:
                in keys
         assert f"{p}::StepEngine._dispatch::_jit_upload::host-extra" \
                in keys
+
+    def test_unbounded_io_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "unbounded-io")
+        p = "xllm_service_tpu/service/bad_timeflow.py"
+        # Root → helper, two primitive classes: queue get and net recv.
+        assert f"{p}::UnboundedServer._drain_one::unbounded:get" in keys
+        assert f"{p}::UnboundedServer._drain_one::unbounded:recv" in keys
+        # The witness chain names root AND site.
+        msg = next(f.message for f in bad_findings
+                   if f.key == f"{p}::UnboundedServer._drain_one"
+                               f"::unbounded:get")
+        assert "_serve_loop" in msg and "_drain_one" in msg
+        # The deliberate shutdown drain in the CLEAN fixture never
+        # appears here (off the serving graph) — pinned by
+        # test_clean_fixture_is_clean.
+
+    def test_deadline_propagation_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "deadline-propagation")
+        p = "xllm_service_tpu/service/bad_timeflow.py"
+        assert f"{p}::FreshConstants.fetch::fresh-timeout:timeout:5.0" \
+               in keys
+        # The PROPAGATED hop in the same function must not fire.
+        assert len([k for k in keys if "FreshConstants" in k]) == 1
+
+    def test_retry_discipline_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "retry-discipline")
+        p = "xllm_service_tpu/service/bad_timeflow.py"
+        assert f"{p}::HandRolledRetry.pump::handrolled-backoff:0" in keys
+
+    def test_flag_hot_path_read_controls(self, bad_findings):
+        """Flag discipline: a documented flag read per-call on the
+        serving path still fires (only the read SITE is wrong — the
+        registry directions stay green for this flag)."""
+        keys = self._keys(bad_findings, "flag-registry")
+        p = "xllm_service_tpu/service/bad_timeflow.py"
+        assert f"{p}::UnboundedServer._drain_one" \
+               f"::hotread:XLLM_FIXTURE_HOTPATH" in keys
+        assert "flags::XLLM_FIXTURE_HOTPATH" not in keys
+        assert "docs::XLLM_FIXTURE_HOTPATH" not in keys
 
 
 class TestNoFalsePositives:
@@ -1031,6 +1070,22 @@ class TestChangedAndSarif:
         assert rc == 1
         assert "sharded-donate" in out
 
+    def test_changed_never_filters_timeflow_rules(self, capsys):
+        """Rules 20-22 attribute an unbounded wait to the blocking
+        SITE, but the edit that exposes it (a new thread root, a
+        wrapper that routes a handler onto the serving path) can live
+        anywhere along the witness chain — they ride --changed
+        unfiltered like 11-19."""
+        rel = os.path.relpath(BAD, REPO_ROOT)
+        for rule, marker in (("unbounded-io", "unbounded:get"),
+                             ("deadline-propagation", "fresh-timeout"),
+                             ("retry-discipline", "handrolled-backoff")):
+            rc = main(["--changed", "HEAD", "--rule", rule,
+                       os.path.join(rel, "xllm_service_tpu")])
+            out = capsys.readouterr().out
+            assert rc == 1, f"{rule} filtered out by --changed"
+            assert marker in out
+
     def test_concurrency_report_cli(self, capsys):
         # subtree scope: CLI shape only — the full-tree report is
         # covered via the shared fixture in TestRealTree/TestCallGraph
@@ -1068,7 +1123,7 @@ class TestCli:
     def test_explain_every_rule_documented(self, capsys):
         """--explain RULE prints the contract, escape hatches, and
         fixture examples from the rule's docstring — asserted
-        substantive for all nineteen rules."""
+        substantive for all twenty-two rules."""
         import inspect
         for r in RULES:
             assert inspect.getdoc(type(r)), \

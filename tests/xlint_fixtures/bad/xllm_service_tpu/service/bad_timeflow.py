@@ -1,0 +1,70 @@
+"""Positive controls for rules 20–22 (time discipline) and the
+flag-registry hot-path read check. Never imported.
+
+One violation per rule, each in its own class so the keys stay
+independent: an unbounded queue get + socket recv two helpers below a
+thread root (rule 20, witness chain), a fresh constant timeout inside
+a deadline'd scope (rule 21), and a hand-rolled backoff sleeping in
+the except arm of an I/O loop (rule 22)."""
+
+import logging
+import os
+import socket
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+
+class UnboundedServer:
+    """Thread root → helper → unbounded blocking: the finding must
+    carry the root→site witness chain."""
+
+    def start(self):
+        threading.Thread(target=self._serve_loop, daemon=True).start()
+
+    def _serve_loop(self):
+        while True:
+            try:
+                self._drain_one()
+            except Exception:
+                logger.exception("serve loop failed")
+                self.serve_failures.inc()
+
+    def _drain_one(self):
+        # Per-call env read on the serving path: the flag-registry
+        # hot-path control (the flag IS documented in the fixture
+        # FLAGS.md — only the read SITE is wrong).
+        if os.environ.get("XLLM_FIXTURE_HOTPATH", "0") == "1":
+            return
+        job = self.q.get()               # unbounded .get(): rule 20
+        sock = self.make_sock()
+        sock.recv(4096)                  # no settimeout in scope
+        return job
+
+
+class FreshConstants:
+    """A deadline'd scope that resets the clock per hop instead of
+    spending the remaining budget."""
+
+    def fetch(self, addr, deadline_s):
+        conn = self.connect(addr, deadline_s)   # propagated: fine
+        # Fresh constant inside the deadline'd scope: three such hops
+        # compose to 15 s against the caller's deadline_s.
+        return self.post(conn, "/fetch", timeout=5.0)
+
+
+class HandRolledRetry:
+    """Fixed-interval sleep in the except arm of an I/O loop: the
+    lockstep-hammer shape RetryPolicy exists to replace."""
+
+    def pump(self, addr):
+        while True:
+            try:
+                s = socket.create_connection(addr)
+                s.sendall(b"ping")
+                return s
+            except OSError:
+                logger.exception("pump reconnect")
+                self.pump_failures.inc()
+                time.sleep(0.2)          # hand-rolled backoff: rule 22

@@ -1,0 +1,94 @@
+"""Near-misses for rules 20–22 — every pattern here is the sanctioned
+form and must produce ZERO findings (the false-positive pin). Never
+imported.
+
+Covers: bounded serving-path waits (literal, config knob, propagated
+parameter), the budget-checked constant poll, receiver boundedness via
+settimeout and via a timeout-carrying constructor handoff, a
+RetryPolicy-routed reconnect loop, and an unbounded drain that is OFF
+the serving graph (no thread root reaches it)."""
+
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+
+class BoundedServer:
+    """Thread-root-reachable blocking, every wait finite."""
+
+    def start(self):
+        from xllm_service_tpu.utils.threads import spawn
+        t = spawn("fixture.bounded", self._serve_loop)
+        return t
+
+    def _serve_loop(self):
+        while True:
+            try:
+                job = self.q.get(timeout=0.5)        # literal bound
+                self._handle(job, self.opts.request_timeout_s)
+            except Exception:
+                logger.exception("serve loop failed")
+                self.serve_failures.inc()
+
+    def _handle(self, job, timeout_s):
+        # Receiver boundedness two ways: an explicit settimeout, and a
+        # constructor handoff of a timeout-named argument (the
+        # conn-pool idiom).
+        sock = self.make_sock()
+        sock.settimeout(timeout_s)
+        sock.recv(4096)                              # bounded above
+        conn = self.make_conn(job.addr, timeout_s)
+        conn.getresponse()                           # bounded by ctor
+
+    def drain_on_shutdown(self):
+        """NOT reachable from any thread root: called by stop() on the
+        main thread, so the unbounded get is outside rule 20's scope
+        (and the sentinel-stop contract bounds it by lifecycle)."""
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+
+
+class PropagatedDeadline:
+    """Deadline'd scopes that spend the REMAINING budget."""
+
+    def fetch(self, addr, deadline_s):
+        t0 = time.monotonic()
+        conn = self.connect(addr, deadline_s)        # propagated
+        remaining = deadline_s - (time.monotonic() - t0)
+        # Derived, not fresh: min() over the remaining budget.
+        return self.post(conn, "/fetch", timeout=min(5.0, remaining))
+
+    def poll_until(self, deadline_s):
+        # The sanctioned bounded-wait idiom: a constant POLL interval
+        # inside a loop that re-checks the budget each tick — the
+        # constant is a wakeup cadence, not a deadline.
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            try:
+                return self.q.get(timeout=0.05)
+            except Exception:
+                logger.exception("poll tick failed")
+                self.poll_failures.inc()
+        return None
+
+
+class PolicyPacedRetry:
+    """Reconnect pacing routed through RetryPolicy: capped, jittered,
+    stop-aware — the sanctioned shape for an I/O retry loop."""
+
+    def pump(self, addr, stop):
+        attempt = 0
+        while not stop.is_set():
+            try:
+                conn = self.make_conn(addr, 5.0)
+                conn.request("POST", "/ping")
+                return conn
+            except Exception:
+                logger.exception("pump reconnect")
+                self.pump_failures.inc()
+                self._retry.sleep(attempt, stop_event=stop)
+                attempt += 1
+        return None

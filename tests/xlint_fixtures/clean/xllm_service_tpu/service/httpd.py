@@ -17,7 +17,10 @@ class Server:
             try:
                 time.sleep(1.0)  # dedicated background thread: legal
                 fut = self.next_job()
-                fut.result()     # blocking here is the thread's job
+                # Bounded wait: even a dedicated thread's blocking is
+                # finite (time-discipline contract, rule 20) — a wedged
+                # job must not wedge the sweeper forever.
+                fut.result(timeout=5.0)
             except Exception:
                 # crash-handled bare-Thread root: logs AND counts
                 logger.exception("sweep failed")

@@ -423,12 +423,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # introduces it (a signature change in models/, a removed
         # staging assignment, a new engine-loop callee) can live in any
         # file the call graph crosses.
+        # Rules 20–22 likewise: an unbounded wait is attributed to the
+        # blocking site, but the edit that exposes it (a new thread
+        # root, a deadline parameter dropped from a caller, I/O added
+        # to a retried helper) can live anywhere along the chain.
         whole_program = {"lock-order-interprocedural",
                          "blocking-under-lock", "thread-root-race",
                          "thread-root-crash", "resource-leak",
                          "swallow-telemetry", "allowlist",
                          "recompile-hazard", "sharded-donation",
-                         "transfer-discipline"}
+                         "transfer-discipline", "unbounded-io",
+                         "deadline-propagation", "retry-discipline"}
         findings = [f for f in findings
                     if f.path in changed or f.rule in whole_program]
 

@@ -573,14 +573,26 @@ class FlagRegistryRule:
     flag is still read somewhere — the flag surface cannot silently
     drift from its documentation in either direction.
 
-    Escape hatch: none — undocumented flags get documented, dead
-    documentation gets deleted.
+    Second contract (flag discipline): flags are read at import or
+    config time, never per-call on the serving path. A per-call
+    ``os.environ.get`` inside a serving-reachable function costs a
+    dict lookup + string parse per request, and — worse — makes the
+    effective config mutable mid-flight: two requests in the same
+    process can observe different values of the "same" knob. Reads
+    inside ``__init__``/``from_env`` are config-time by definition
+    and exempt (lazily-constructed singletons read once).
+
+    Escape hatch: none for the registry direction — undocumented
+    flags get documented, dead documentation gets deleted. Hot-path
+    reads get hoisted to a config attribute; the allowlist exists
+    for reads that are deliberately re-evaluated (none today).
 
     Fixture: tests/xlint_fixtures/bad/.../flags.py."""
 
     name = "flag-registry"
-    describe = ("every XLLM_* env read appears in docs/FLAGS.md (and "
-                "every documented flag is actually read)")
+    describe = ("every XLLM_* env read appears in docs/FLAGS.md, every "
+                "documented flag is actually read, and no flag is read "
+                "per-call on the serving path")
 
     def check(self, tree: RepoTree) -> List[Finding]:
         findings: List[Finding] = []
@@ -620,6 +632,44 @@ class FlagRegistryRule:
                             f"doc, or the read lives outside the "
                             f"package (allowlist with the real "
                             f"reader)"))
+        findings.extend(self._hot_path_reads(tree))
+        return findings
+
+    def _hot_path_reads(self, tree: RepoTree) -> List[Finding]:
+        """Flag discipline: an env read inside a serving-reachable
+        function (per the rule-20 reachability graph) re-parses the
+        environment per request. ``__init__`` and ``from_env`` are
+        config-time scopes and exempt."""
+        from tools.xlint.timeflow import timeflow_analyze
+        tf = timeflow_analyze(tree)
+        findings: List[Finding] = []
+        # innermost enclosing function wins — nested defs have their
+        # own FuncInfo and their own reachability verdict
+        by_path: Dict[str, List] = {}
+        for fi in tf.cg.functions.values():
+            by_path.setdefault(fi.path, []).append(fi)
+        for mod in tree.modules:
+            for name, line in self._env_reads(mod):
+                best = None
+                for fi in by_path.get(mod.path, ()):
+                    lo = fi.node.lineno
+                    hi = getattr(fi.node, "end_lineno", lo) or lo
+                    if lo <= line <= hi and (
+                            best is None
+                            or lo > best.node.lineno):
+                        best = fi
+                if best is None or best.fid not in tf.serving:
+                    continue
+                if best.name in ("__init__", "from_env"):
+                    continue
+                findings.append(Finding(
+                    rule=self.name, path=mod.path, line=line,
+                    key=f"{mod.path}::{best.qualname}::hotread:{name}",
+                    message=f"env gate {name} is read per-call on the "
+                            f"serving path — reachable via "
+                            f"[{tf.witness(best.fid)}]; hoist the read "
+                            f"to __init__/config time and thread the "
+                            f"value through"))
         return findings
 
     @staticmethod
@@ -1353,6 +1403,8 @@ from tools.xlint.lifecycle import (           # noqa: E402 — rules 14–16
     ResourceLeakRule, SwallowTelemetryRule, ThreadRootCrashRule)
 from tools.xlint.tracewalk import (           # noqa: E402 — rules 17–19
     RecompileHazardRule, ShardedDonationRule, TransferDisciplineRule)
+from tools.xlint.timeflow import (            # noqa: E402 — rules 20–22
+    DeadlinePropagationRule, RetryDisciplineRule, UnboundedIoRule)
 
 RULES = [
     MosaicCompatRule(),
@@ -1374,4 +1426,7 @@ RULES = [
     RecompileHazardRule(),
     ShardedDonationRule(),
     TransferDisciplineRule(),
+    UnboundedIoRule(),
+    DeadlinePropagationRule(),
+    RetryDisciplineRule(),
 ]

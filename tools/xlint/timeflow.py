@@ -1,0 +1,615 @@
+"""Rules 20–22: whole-program time discipline over the call graph.
+
+Rule 20 ``unbounded-io`` — every blocking primitive reachable from a
+serving-path thread root (``queue.get()``, ``Event.wait()``,
+``Condition.wait()``, ``Future.result()``, socket/HTTP ``connect`` /
+``recv`` / ``accept`` / ``getresponse``) must carry an explicit finite
+timeout: a literal, a parameter, or a value traceable to a config knob
+(``self.opts.request_timeout_s`` and friends). A timeout-less form on
+the serving path is a finding with the root→site witness chain
+printed; sanctioned shutdown/drain waits (a sentinel-stop queue drain,
+a signal wait on the main thread) live in the allowlist with a prose
+justification — or off the serving path entirely, where the rule does
+not reach.
+
+Rule 21 ``deadline-propagation`` — inside a deadline'd scope (a
+function that RECEIVES a deadline/budget/timeout parameter, or that
+consults a ``deadline``-named attribute such as StoreGuard's
+``deadline_s``), nested blocking calls must derive their timeout from
+the *remaining* budget — ``min(hop, deadline - now)``, the parameter
+itself, or arithmetic over it — never reset to a fresh numeric
+constant. A constant per hop composes to more than the root budget
+across a chain (the PR 6 recovery-anchor and PR 7
+fetch-inside-request-timeout bug class). A constant-timeout poll
+*inside a loop that re-checks the budget* is the sanctioned bounded
+form and is exempt.
+
+Rule 22 ``retry-discipline`` — a loop that pairs retried I/O with a
+sleep on its failure path (``time.sleep`` in an ``except`` handler, or
+a fixed ``Event.wait(const)`` before a ``continue``) is a hand-rolled
+backoff loop. All retry pacing routes through
+``utils/retry.RetryPolicy`` — capped, jittered, deadline- and
+stop-aware — so a store outage cannot turn into a tight 1 Hz hammer
+or an uncapped exponential overflow (the PR 6 incident pair).
+
+All three ride the rule 11–13 memoized analysis: one call-graph build
+per lint run keeps the full 22-rule tier-1 budget under 30 s.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.xlint import Finding, RepoTree
+from tools.xlint import callgraph as cgm
+from tools.xlint.concurrency import analyze as _conc_analyze
+
+# ---------------------------------------------------------------------------
+# Site classification
+# ---------------------------------------------------------------------------
+
+# Network-ish methods that take NO timeout argument: boundedness lives
+# on the receiver (settimeout / a timeout-carrying constructor), so the
+# proof is receiver-provenance inside the enclosing function.
+_NET_RECEIVER_METHODS = {"connect", "recv", "recv_into", "accept",
+                         "getresponse"}
+# Keyword names that denote a per-call time bound.
+_TIMEOUT_KWARGS = ("timeout", "timeout_s", "timeout_ms")
+# Parameter / attribute names that open a deadline'd scope (rule 21).
+# Deliberately time-suffixed where ambiguous: a bare ``budget`` in this
+# repo is a *token* budget (engine._schedule_prefill), not a time one.
+_DEADLINE_NAME_RE = re.compile(
+    r"^(deadline|deadline_s|deadline_ms|timeout|timeout_s|timeout_ms|"
+    r"budget_s|remaining|remaining_s)$")
+# Receivers whose ``.sleep(...)`` is the sanctioned retry pacer.
+_POLICY_RECV_RE = re.compile(r"retry|policy", re.IGNORECASE)
+
+
+def _is_none(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _is_number(node: ast.AST) -> bool:
+    if isinstance(node, ast.UnaryOp) and \
+            isinstance(node.op, (ast.USub, ast.UAdd)):
+        node = node.operand
+    return isinstance(node, ast.Constant) and \
+        isinstance(node.value, (int, float)) and \
+        not isinstance(node.value, bool)
+
+
+def _base_name(expr: ast.AST) -> Optional[str]:
+    """The root Name of an attribute chain: ``conn.sock`` → conn."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _terminal_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _walk_no_nested(node: ast.AST):
+    """ast.walk that does not descend into nested function/lambda
+    bodies (they run later, possibly on another thread)."""
+    work = [node]
+    while work:
+        n = work.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            work.append(child)
+
+
+def _timeout_kw(node: ast.Call) -> Tuple[bool, Optional[ast.AST]]:
+    """→ (present, value) for the first timeout-named keyword."""
+    for kw in node.keywords:
+        if kw.arg in _TIMEOUT_KWARGS:
+            return True, kw.value
+    return False, None
+
+
+def _bounded_receivers(fn_node: ast.AST) -> Set[str]:
+    """Names inside ``fn_node`` whose network boundedness is proven in
+    scope: assigned from a call carrying a timeout argument (ctor
+    ``timeout=`` kwarg, or any argument that is itself a timeout-named
+    variable — the conn-pool handoff), or targeted by a non-None
+    ``settimeout`` call anywhere in the function."""
+    bounded: Set[str] = set()
+    for n in _walk_no_nested(fn_node):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "settimeout" and n.args and \
+                not _is_none(n.args[0]):
+            base = _base_name(n.func.value)
+            if base is not None:
+                bounded.add(base)
+        elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            call = n.value
+            carries = False
+            present, val = _timeout_kw(call)
+            if present and not _is_none(val):
+                carries = True
+            else:
+                for a in call.args:
+                    nm = _terminal_name(a)
+                    if nm is not None and _DEADLINE_NAME_RE.match(nm):
+                        carries = True
+                        break
+            if not carries:
+                continue
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    bounded.add(t.id)
+                elif isinstance(t, ast.Tuple):
+                    for el in t.elts:
+                        if isinstance(el, ast.Name):
+                            bounded.add(el.id)
+    return bounded
+
+
+def classify_unbounded(node: ast.Call, bounded: Set[str]
+                       ) -> Optional[str]:
+    """→ a human description when ``node`` is a blocking primitive
+    with NO finite bound in evidence, else None. Under-approximate by
+    design: a timeout that is any expression counts as bounded here
+    (whether it is the RIGHT expression is rule 21's question)."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    # super().connect() etc: boundedness was fixed at the construction
+    # site of the instance; the override cannot change it.
+    if isinstance(f.value, ast.Call) and \
+            isinstance(f.value.func, ast.Name) and \
+            f.value.func.id == "super":
+        return None
+    attr = f.attr
+    present, val = _timeout_kw(node)
+    if attr == "get" and not node.args:
+        # zero-arg .get() is the queue form (dict/env .get needs a key)
+        if not present or _is_none(val):
+            return ".get() [no timeout]"
+    elif attr == "wait" and not node.args:
+        # Event/Condition/Barrier/Popen .wait() with no bound
+        if not present or _is_none(val):
+            return ".wait() [no timeout]"
+    elif attr == "result" and not node.args:
+        if not present or _is_none(val):
+            return ".result() [no timeout]"
+    elif attr in _NET_RECEIVER_METHODS:
+        base = _base_name(f.value)
+        if base is None or base not in bounded:
+            return f".{attr}() [no socket timeout in scope]"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The shared analysis (memoized per RepoTree, riding rules 11–13's)
+# ---------------------------------------------------------------------------
+
+
+class TimeflowAnalysis:
+    def __init__(self, tree: RepoTree) -> None:
+        self.tree = tree
+        self.conc = _conc_analyze(tree)
+        self.cg = self.conc.cg
+        # fid -> (root rid, parent fid or None): first-discovery BFS
+        # forest over every thread-root entry — the serving set, with
+        # enough structure to print one root→site witness chain.
+        self.serving: Dict[str, Tuple[str, Optional[str]]] = \
+            self._serving_reach()
+
+    def _serving_reach(self) -> Dict[str, Tuple[str, Optional[str]]]:
+        disc: Dict[str, Tuple[str, Optional[str]]] = {}
+        queue: List[str] = []
+        for root in sorted(self.cg.roots, key=lambda r: r.rid):
+            for fid, _held in root.entries:
+                if fid in self.cg.functions and fid not in disc:
+                    disc[fid] = (root.rid, None)
+                    queue.append(fid)
+        i = 0
+        while i < len(queue):
+            fid = queue[i]
+            i += 1
+            rid = disc[fid][0]
+            fi = self.cg.functions[fid]
+            succs = [cs.callee for cs in fi.calls]
+            # A bound-method/function REFERENCE passed as an argument
+            # from a serving function is presumed invoked on the
+            # serving path — the `self._guarded(handler, ...)` wrapper
+            # idiom would otherwise hide every route handler body from
+            # the reachability proof.
+            succs.extend(self._callable_ref_args(fi))
+            for callee in succs:
+                if callee in self.cg.functions and callee not in disc:
+                    disc[callee] = (rid, fid)
+                    queue.append(callee)
+        return disc
+
+    def _callable_ref_args(self, fi: cgm.FuncInfo) -> List[str]:
+        env = self.cg.envs[fi.path]
+        out: List[str] = []
+        for rc in fi.raw_calls:
+            args = list(rc.node.args) + \
+                [kw.value for kw in rc.node.keywords]
+            for a in args:
+                if isinstance(a, ast.Attribute) and \
+                        isinstance(a.value, ast.Name) and \
+                        a.value.id == "self" and fi.cls is not None:
+                    m = self.cg.method(fi.cls, a.attr)
+                    if m is not None:
+                        out.append(m.fid)
+                elif isinstance(a, ast.Name):
+                    cand = f"{fi.path}::{a.id}"
+                    if cand in self.cg.functions:
+                        out.append(cand)
+                    else:
+                        sym = env.sym_import.get(a.id)
+                        if sym is not None:
+                            out.append(f"{sym[0]}::{sym[1]}")
+        return out
+
+    def witness(self, fid: str) -> str:
+        """``root ← via`` chain for a serving function, rendered
+        root-first: ``<rid>: a → b → c``."""
+        chain: List[str] = []
+        cur: Optional[str] = fid
+        while cur is not None:
+            chain.append(cur)
+            cur = self.serving[cur][1]
+        rid = self.serving[fid][0]
+        names = " → ".join(
+            self.cg.functions[f].qualname for f in reversed(chain))
+        return f"{rid}: {names}"
+
+
+_CACHE_ATTR = "_xlint_timeflow_analysis"
+
+
+def timeflow_analyze(tree: RepoTree) -> TimeflowAnalysis:
+    a = getattr(tree, _CACHE_ATTR, None)
+    if a is None:
+        a = TimeflowAnalysis(tree)
+        setattr(tree, _CACHE_ATTR, a)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Rule 20: unbounded-io
+# ---------------------------------------------------------------------------
+
+
+class UnboundedIoRule:
+    """Contract: every blocking primitive reachable from a thread root
+    — queue ``.get()``, ``Event``/``Condition`` ``.wait()``,
+    ``Future.result()``, socket/HTTP ``connect``/``recv``/``accept``/
+    ``getresponse`` — carries an explicit finite timeout (literal,
+    parameter, or config knob) or a receiver-level socket timeout
+    proven in scope. The witness chain root→site is printed with each
+    finding, because the unbounded wait is rarely IN the root: it is
+    three helpers down, where nobody remembers a request thread can
+    reach it.
+
+    Escape hatch: the allowlist, for sanctioned shutdown/drain waits —
+    a sentinel-stop queue drain whose ``stop()`` enqueues the sentinel,
+    a main-thread signal wait. Justify WHY the wait is bounded by
+    process lifecycle rather than by a timeout. Code that is not
+    reachable from any thread root (CLI mains, test helpers) is off
+    the serving path and outside the rule.
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_timeflow.py.
+    Findings chain across files through the call graph, so --changed
+    never filters this rule."""
+
+    name = "unbounded-io"
+    describe = ("blocking primitives reachable from a serving-path "
+                "thread root must carry an explicit finite timeout "
+                "(or a justified shutdown/drain allowlist entry); the "
+                "root→site witness chain is printed")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        a = timeflow_analyze(tree)
+        findings: List[Finding] = []
+        emitted: Set[str] = set()
+        for fid in sorted(a.serving):
+            fi = a.cg.functions[fid]
+            bounded = _bounded_receivers(fi.node)
+            for rc in fi.raw_calls:
+                desc = classify_unbounded(rc.node, bounded)
+                if desc is None:
+                    continue
+                attr = rc.node.func.attr  # type: ignore[union-attr]
+                key = f"{fi.path}::{fi.qualname}::unbounded:{attr}"
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                findings.append(Finding(
+                    rule=self.name, path=fi.path, line=rc.line,
+                    key=key,
+                    message=f"unbounded {desc} on the serving path — "
+                            f"reachable via [{a.witness(fid)}]; give "
+                            f"it a finite timeout traceable to a "
+                            f"config knob, or allowlist the "
+                            f"shutdown/drain path with a "
+                            f"justification"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule 21: deadline-propagation
+# ---------------------------------------------------------------------------
+
+
+def _deadline_scope_names(fi: cgm.FuncInfo) -> Set[str]:
+    """Budget names that put ``fi`` inside a deadline'd scope: matching
+    parameters, plus matching ``self.<attr>`` reads (StoreGuard-style
+    scopes carry the budget as an attribute, not a parameter)."""
+    names: Set[str] = set()
+    args = fi.node.args
+    for p in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+        if _DEADLINE_NAME_RE.match(p.arg):
+            names.add(p.arg)
+    for site in fi.attrs:
+        if site.kind == "read" and _DEADLINE_NAME_RE.match(site.attr):
+            names.add(site.attr)
+    return names
+
+
+def _mentions_budget(node: ast.AST, budget_names: Set[str]) -> bool:
+    for n in _walk_no_nested(node):
+        if isinstance(n, ast.Name) and n.id in budget_names:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in budget_names:
+            return True
+    return False
+
+
+class DeadlinePropagationRule:
+    """Contract: inside a deadline'd scope — a function receiving a
+    deadline/budget/timeout parameter, or reading a deadline-named
+    attribute (StoreGuard's ``deadline_s``) — nested blocking calls
+    derive their timeout from the REMAINING budget (the parameter, or
+    arithmetic over it), never from a fresh numeric constant. One
+    constant per hop composes across a call chain to more than the
+    root budget: the caller's 10 s guarantee quietly becomes 10 s plus
+    every constant below it (PR 6's recovery-anchor and PR 7's
+    fetch-inside-request-timeout fixes were both exactly this).
+
+    Escape hatch: a constant-timeout POLL inside a loop that mentions
+    the budget (``while now < deadline: q.get(timeout=0.05)``) is the
+    sanctioned bounded-wait idiom — each tick re-checks the budget, so
+    the constant is a wakeup interval, not a deadline. Anything else
+    goes to the allowlist with a justification for why the constant
+    cannot stack.
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_timeflow.py.
+    A deadline chain spans files, so --changed never filters this
+    rule."""
+
+    name = "deadline-propagation"
+    describe = ("inside a deadline'd scope (deadline/budget/timeout "
+                "parameter or attribute), nested I/O must derive its "
+                "timeout from the remaining budget, not reset to a "
+                "fresh constant (constant polls that re-check the "
+                "budget in a loop are exempt)")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        a = timeflow_analyze(tree)
+        findings: List[Finding] = []
+        for fid in sorted(a.cg.functions):
+            fi = a.cg.functions[fid]
+            budget = _deadline_scope_names(fi)
+            if not budget:
+                continue
+            loops = [n for n in _walk_no_nested(fi.node)
+                     if isinstance(n, (ast.While, ast.For))]
+            emitted: Set[str] = set()
+            for rc in fi.raw_calls:
+                bad = self._fresh_constant(rc.node)
+                if bad is None:
+                    continue
+                if self._budget_checked_poll(rc.node, loops, budget):
+                    continue
+                label, value = bad
+                key = (f"{fi.path}::{fi.qualname}::"
+                       f"fresh-timeout:{label}:{value}")
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                findings.append(Finding(
+                    rule=self.name, path=fi.path, line=rc.line,
+                    key=key,
+                    message=f"fresh constant timeout {value} inside a "
+                            f"deadline'd scope (budget: "
+                            f"{', '.join(sorted(budget))}) — a per-hop "
+                            f"constant can exceed the root budget "
+                            f"across the chain; derive it from the "
+                            f"remaining budget, e.g. min({value}, "
+                            f"remaining)"))
+        return findings
+
+    @staticmethod
+    def _fresh_constant(node: ast.Call
+                        ) -> Optional[Tuple[str, object]]:
+        """→ (label, value) when the call carries a bare numeric
+        constant as its time bound."""
+        present, val = _timeout_kw(node)
+        if present and val is not None and _is_number(val):
+            return "timeout", ast.literal_eval(val)
+        f = node.func
+        if isinstance(f, ast.Attribute) and \
+                f.attr in ("wait", "get", "result") and \
+                len(node.args) == 1 and not node.keywords and \
+                _is_number(node.args[0]):
+            return f.attr, ast.literal_eval(node.args[0])
+        return None
+
+    @staticmethod
+    def _budget_checked_poll(call: ast.Call, loops: List[ast.AST],
+                             budget: Set[str]) -> bool:
+        for loop in loops:
+            if loop.lineno <= call.lineno <= \
+                    getattr(loop, "end_lineno", loop.lineno) and \
+                    _mentions_budget(loop, budget):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Rule 22: retry-discipline
+# ---------------------------------------------------------------------------
+
+
+def _is_policy_sleep(node: ast.Call) -> bool:
+    """``policy.sleep(attempt, ...)`` / ``self._retry.sleep(...)`` —
+    the sanctioned pacer — or ``time.sleep(policy.delay(n))``."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "sleep":
+        nm = _terminal_name(f.value)
+        if nm is not None and _POLICY_RECV_RE.search(nm):
+            return True
+    for a in node.args:
+        if isinstance(a, ast.Call) and \
+                isinstance(a.func, ast.Attribute) and \
+                a.func.attr in ("delay", "sleep"):
+            nm = _terminal_name(a.func.value)
+            if nm is not None and _POLICY_RECV_RE.search(nm):
+                return True
+    return False
+
+
+class RetryDisciplineRule:
+    """Contract: any loop that retries I/O paces its retries through
+    ``utils/retry.RetryPolicy`` — capped attempts, exponential backoff
+    with jitter, deadline- and stop-aware sleeping. A hand-rolled
+    backoff (``time.sleep`` in the ``except`` arm of an I/O loop, or a
+    fixed ``Event.wait(const)`` before a ``continue``) either hammers
+    a down dependency at a fixed frequency — every instance in
+    lockstep, no jitter, the thundering-herd reconnect — or grows an
+    unclamped exponential (the float-overflow backoff PR 6 fixed).
+
+    Detection is shape-based: a sleep on the FAILURE path of a loop
+    that performs network I/O (directly or through a callee, via the
+    rule 11–13 blocking closure). Periodic loops — sleep at the loop
+    tail, outside any except/continue branch — are not retries and do
+    not fire.
+
+    Escape hatch: route the pacing through RetryPolicy (receivers
+    named ``*retry*``/``*policy*`` are recognized), or allowlist with
+    a justification for why fixed-frequency is correct (none are
+    expected — even infinite supervised reconnect loops want jitter).
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_timeflow.py.
+    The I/O may live in a callee in another file, so --changed never
+    filters this rule."""
+
+    name = "retry-discipline"
+    describe = ("loops pairing retried I/O with a failure-path sleep "
+                "must route through utils/retry.RetryPolicy; "
+                "hand-rolled backoff (sleep in except / fixed wait "
+                "before continue) is a finding")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        a = timeflow_analyze(tree)
+        findings: List[Finding] = []
+        for fid in sorted(a.cg.functions):
+            fi = a.cg.functions[fid]
+            env = a.cg.envs[fi.path]
+            loops = [n for n in _walk_no_nested(fi.node)
+                     if isinstance(n, (ast.While, ast.For))]
+            if not loops:
+                continue
+            idx = 0
+            for loop in loops:
+                if not self._loop_does_io(a, fi, env, loop):
+                    continue
+                for site in self._failure_path_sleeps(loop, env):
+                    key = (f"{fi.path}::{fi.qualname}::"
+                           f"handrolled-backoff:{idx}")
+                    idx += 1
+                    findings.append(Finding(
+                        rule=self.name, path=fi.path, line=site,
+                        key=key,
+                        message="hand-rolled retry backoff: a sleep "
+                                "on the failure path of an I/O loop — "
+                                "route the pacing through "
+                                "utils/retry.RetryPolicy (capped, "
+                                "jittered, deadline- and stop-aware) "
+                                "instead of a fixed interval"))
+        return findings
+
+    @staticmethod
+    def _span(node: ast.AST) -> Tuple[int, int]:
+        return node.lineno, getattr(node, "end_lineno", node.lineno)
+
+    def _loop_does_io(self, a: TimeflowAnalysis, fi: cgm.FuncInfo,
+                      env, loop: ast.AST) -> bool:
+        lo, hi = self._span(loop)
+        for rc in fi.raw_calls:
+            if not lo <= rc.line <= hi:
+                continue
+            f = rc.node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in ("connect", "recv", "recv_into", "accept",
+                               "getresponse", "sendall", "request",
+                               "create_connection", "urlopen"):
+                return True
+            nm = _terminal_name(f)
+            if nm is not None and nm.startswith(("http_json",
+                                                 "http_stream")):
+                return True
+        for cs in fi.calls:
+            if lo <= cs.line <= hi:
+                cats = {c for (c, _d) in
+                        a.conc.trans_blocking.get(cs.callee, {})}
+                if "net" in cats:
+                    return True
+        return False
+
+    def _failure_path_sleeps(self, loop: ast.AST, env) -> List[int]:
+        """Line numbers of sleeps on the loop's failure path: inside an
+        ``except`` handler, or in a statement block that also
+        ``continue``s (the if-non-200 reconnect arm)."""
+        out: List[int] = []
+        for n in _walk_no_nested(loop):
+            blocks: List[List[ast.stmt]] = []
+            if isinstance(n, ast.ExceptHandler):
+                blocks.append(n.body)
+            elif isinstance(n, ast.If):
+                blocks.append(n.body)
+                blocks.append(n.orelse)
+            for body in blocks:
+                is_except = isinstance(n, ast.ExceptHandler)
+                has_continue = any(isinstance(s, ast.Continue)
+                                   for s in body)
+                if not (is_except or has_continue):
+                    continue
+                for stmt in body:
+                    for c in _walk_no_nested(stmt):
+                        if isinstance(c, ast.Call) and \
+                                self._is_sleepish(c, env) and \
+                                not _is_policy_sleep(c):
+                            out.append(c.lineno)
+        return out
+
+    @staticmethod
+    def _is_sleepish(node: ast.Call, env) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "sleep" and isinstance(f.value, ast.Name) and \
+                    f.value.id in env.time_alias:
+                return True
+            if f.attr == "wait" and len(node.args) == 1 and \
+                    _is_number(node.args[0]):
+                return True
+        elif isinstance(f, ast.Name) and f.id in env.sleep_names:
+            return True
+        return False

@@ -561,6 +561,29 @@ class Worker:
             "XLLM_ENGINE_FAULT_LIMIT", "5") or 5)
         self._fault_window_s = float(os.environ.get(
             "XLLM_ENGINE_FAULT_WINDOW_S", "60") or 60)
+        # Flag discipline (xlint flag-registry): serving-path knobs are
+        # read ONCE here at config time, never per-request — a per-call
+        # environ read makes the effective config mutable mid-flight
+        # and re-parses strings on the hot path. Tests monkeypatch the
+        # env and THEN construct the Worker, so __init__ is the
+        # latest-safe read point.
+        self._vision_image_size = int(os.environ.get(
+            "XLLM_VISION_IMAGE_SIZE", "224") or 224)
+        try:
+            self._encode_timeout_s = float(os.environ.get(
+                "XLLM_ENCODE_TIMEOUT_S", "120") or 120)
+        except ValueError:
+            self._encode_timeout_s = 120.0
+        try:
+            self._kv_shuttle_chunk_mb = float(os.environ.get(
+                "XLLM_KV_SHUTTLE_CHUNK_MB", "32"))
+        except ValueError:
+            self._kv_shuttle_chunk_mb = 32.0
+        try:
+            self._kv_fetch_timeout_s = float(os.environ.get(
+                "XLLM_KV_FETCH_TIMEOUT_S", "15") or 15)
+        except ValueError:
+            self._kv_fetch_timeout_s = 15.0
         # Contained-fault timestamps inside the breaker window; engine-
         # loop thread only.
         self._fault_times: "deque[float]" = deque()
@@ -2113,7 +2136,18 @@ class Worker:
                 for frame in asm.on_output(ro):
                     yield frame
             while True:
-                out = live.q.get()
+                try:
+                    out = live.q.get(
+                        timeout=self.opts.request_timeout_s)
+                except queue.Empty:
+                    # Engine stopped producing (hang, wedged step):
+                    # a TYPED timeout frame, never a silent stall —
+                    # the finally cancels the unfinished engine work.
+                    yield sse_frame({"error": {
+                        "message": f"no engine output within "
+                                   f"{self.opts.request_timeout_s:g}s",
+                        "type": "timeout", "code": 504}})
+                    return
                 if out is _ABORT:
                     # Simulated death: break the socket mid-stream (no
                     # [DONE]) so the relay sees what a crash looks like.
@@ -2149,7 +2183,16 @@ class Worker:
             coll.add(ro)
         try:
             while True:
-                out = live.q.get()
+                try:
+                    out = live.q.get(
+                        timeout=self.opts.request_timeout_s)
+                except queue.Empty:
+                    # Same contract as the SSE path: a typed 504, and
+                    # the finally cancels the unfinished engine work.
+                    return Response.error(
+                        504, f"no engine output within "
+                             f"{self.opts.request_timeout_s:g}s",
+                        "timeout")
                 if out is _ABORT:
                     raise RuntimeError("worker died (failpoint)")
                 if isinstance(out, _EngineFault):
@@ -2455,10 +2498,9 @@ class Worker:
                         load_qwen2vl_vision)
                     # Fixed serve-time grid (one compiled tower shape);
                     # must be a multiple of patch_size·spatial_merge_size.
-                    img_size = int(os.environ.get(
-                        "XLLM_VISION_IMAGE_SIZE", "224"))
-                    loaded = load_qwen2vl_vision(self.opts.model_dir,
-                                                 image_size=img_size)
+                    loaded = load_qwen2vl_vision(
+                        self.opts.model_dir,
+                        image_size=self._vision_image_size)
                     if loaded is not None:
                         vcfg, params = loaded
                         from xllm_service_tpu.models import (
@@ -2789,9 +2831,13 @@ class Worker:
                 except Exception:  # noqa: BLE001 — failed mid-pull
                     outcome = "error"
                 try:
+                    # The done-notify rides inside the attempt budget:
+                    # a fresh constant here could stack past the
+                    # caller's XLLM_ENCODE_TIMEOUT_S deadline.
                     http_json("POST", target, "/encode_done",
                               {"uuid": tr.get("uuid"),
-                               "outcome": outcome}, timeout=10.0)
+                               "outcome": outcome},
+                              timeout=min(10.0, timeout))
                 except Exception:  # noqa: BLE001 — holder TTL-sweeps it
                     pass
                 if arr is None:
@@ -2820,11 +2866,7 @@ class Worker:
         emits an encode_fallback event; the resolved stage is recorded
         as the request's "encoded" span."""
         t_start = time.monotonic()
-        try:
-            total = float(os.environ.get(
-                "XLLM_ENCODE_TIMEOUT_S", "120") or 120)
-        except ValueError:
-            total = 120.0
+        total = self._encode_timeout_s
         deadline = t_start + total
         policy = RetryPolicy(max_attempts=1, base_delay_s=0.05,
                              max_delay_s=2.0, multiplier=2.0,
@@ -3062,11 +3104,7 @@ class Worker:
         is the CALLER's to commit, and only on an accepted import — a
         fallback to the monolithic shuttle after these sends must not
         count the same KV block twice in the bandwidth gauge."""
-        try:
-            chunk_mb = float(os.environ.get("XLLM_KV_SHUTTLE_CHUNK_MB",
-                                            "32"))
-        except ValueError:
-            chunk_mb = 32.0
+        chunk_mb = self._kv_shuttle_chunk_mb
         if chunk_mb <= 0 or not hasattr(k, "copy_to_host_async"):
             return 0, 0
         L = int(k.shape[0])
@@ -3892,11 +3930,7 @@ class Worker:
         # a hung/partitioned holder for anything like the full request
         # timeout — recompute is always milliseconds away. Bounded by
         # its own short deadline.
-        try:
-            fetch_timeout = float(os.environ.get(
-                "XLLM_KV_FETCH_TIMEOUT_S", "15") or 15)
-        except ValueError:
-            fetch_timeout = 15.0
+        fetch_timeout = self._kv_fetch_timeout_s
         t0 = time.monotonic()
         try:
             status, body_iter = http_stream_status(

@@ -20,6 +20,7 @@ from xllm_service_tpu.service.coordination import (
 from xllm_service_tpu.service.httpd import (
     HttpServer, Request, Response, Router, http_json)
 from xllm_service_tpu.utils.locks import make_lock
+from xllm_service_tpu.utils.retry import RetryPolicy
 from xllm_service_tpu.utils import threads
 from xllm_service_tpu.utils.threads import spawn
 
@@ -131,6 +132,12 @@ class RemoteStore(CoordinationStore):
         self._watches: Dict[int, threading.Event] = {}
         self._next_watch = 1
         self._lock = make_lock("coordination_net", 60)
+        # Watch-reconnect pacing: jittered so a fleet of watchers does
+        # not hammer a restarting store in 1 Hz lockstep (the loop
+        # itself is infinite by design — supervised restart owns
+        # crashes, this policy owns the cadence).
+        self._watch_retry = RetryPolicy(base_delay_s=0.25,
+                                        max_delay_s=5.0)
 
     def _call(self, method: str, path: str, obj=None):
         status, resp = http_json(method, self.address, path, obj,
@@ -195,6 +202,7 @@ class RemoteStore(CoordinationStore):
         # server's current revision, not 0 (a fresh watcher must not replay
         # the whole retained history).
         rev: Optional[int] = None
+        attempt = 0
         while not stop.is_set() and rev is None:
             try:
                 status, resp = http_json("GET", self.address, "/rev",
@@ -202,7 +210,9 @@ class RemoteStore(CoordinationStore):
                 if status == 200:
                     rev = resp["rev"]
             except Exception:  # noqa: BLE001 — store still booting or
-                stop.wait(1.0)  # unreachable; this loop IS the retry
+                # unreachable; this loop IS the retry
+                self._watch_retry.sleep(attempt, stop_event=stop)
+                attempt += 1
         # Last state this watcher DELIVERED per key — the compaction
         # fallback's baseline. When the server says our revision was
         # compacted away (we reconnected older than
@@ -211,6 +221,7 @@ class RemoteStore(CoordinationStore):
         # STATE DIFF (synthetic DELETEs for vanished keys, PUTs for
         # new/changed) — same contract as EtcdStore._resync.
         known: Dict[str, str] = {}
+        attempt = 0
         while not stop.is_set():
             try:
                 status, resp = http_json(
@@ -218,8 +229,10 @@ class RemoteStore(CoordinationStore):
                     f"/watch?prefix={_q(prefix)}&rev={rev}&timeout=5",
                     timeout=self.timeout + 10)
                 if status != 200:
-                    stop.wait(1.0)
+                    self._watch_retry.sleep(attempt, stop_event=stop)
+                    attempt += 1
                     continue
+                attempt = 0     # healthy exchange resets the backoff
                 if resp["rev"] < rev:
                     # The server restarted with a YOUNGER event log (the
                     # memory-backed store was killed and rebooted): our
@@ -260,7 +273,8 @@ class RemoteStore(CoordinationStore):
                         threads.record_callback_error(
                             "coordination_net.watch_loop", e)
             except Exception:  # noqa: BLE001 — store restarting/unreachable
-                stop.wait(1.0)
+                self._watch_retry.sleep(attempt, stop_event=stop)
+                attempt += 1
 
     def _resync(self, prefix: str, known: Dict[str, str],
                 callback: WatchCallback, stop: threading.Event) -> None:

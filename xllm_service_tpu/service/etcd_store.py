@@ -26,6 +26,7 @@ import base64
 import http.client
 import json
 import logging
+import os
 import threading
 
 
@@ -34,6 +35,7 @@ from typing import Dict, Optional, Tuple
 from xllm_service_tpu.service.coordination import (
     CoordinationStore, InMemoryStore, WatchCallback)
 from xllm_service_tpu.utils.locks import make_lock
+from xllm_service_tpu.utils.retry import RetryPolicy
 from xllm_service_tpu.utils import threads
 from xllm_service_tpu.utils.threads import spawn
 
@@ -85,6 +87,18 @@ class EtcdStore(CoordinationStore):
         self._host, self._port = host, int(port or 2379)
         self._api = api_prefix.rstrip("/")
         self._timeout = timeout_s
+        # Read timeout on the watch STREAM socket (config-time knob).
+        # A watch can sit idle far longer than a unary call, but never
+        # unboundedly: on expiry the loop reconnects from ``next_rev``
+        # and loses nothing. Generous by default — the cost of a spurious
+        # expiry is one reconnect per idle period.
+        self._watch_timeout_s = float(
+            os.environ.get("XLLM_ETCD_WATCH_TIMEOUT_S", "300") or 300)
+        # Reconnect pacing: jittered backoff so a watcher fleet does not
+        # hammer a recovering etcd in lockstep; reset on a healthy
+        # stream so one blip does not leave the cadence degraded.
+        self._watch_retry = RetryPolicy(base_delay_s=0.1,
+                                        max_delay_s=2.0)
         self._watches: Dict[int, Tuple[threading.Event,
                                        Optional[http.client.HTTPConnection]]] \
             = {}
@@ -197,8 +211,13 @@ class EtcdStore(CoordinationStore):
         # Last value the watcher reported per key — the resync diff base
         # when compaction invalidates the resume revision.
         known: Dict[str, str] = {}
+        attempt = 0
         while not stop.is_set():
-            conn = http.client.HTTPConnection(self._host, self._port)
+            # The stream socket gets a (long) read timeout: an idle watch
+            # is normal, an eternally-silent one is indistinguishable from
+            # a dead peer. Expiry just reconnects from next_rev.
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self._watch_timeout_s)
             with self._lock:
                 if wid not in self._watches:
                     return           # cancelled between iterations
@@ -215,6 +234,7 @@ class EtcdStore(CoordinationStore):
                 conn.request("POST", self._api + "/watch", json.dumps(req),
                              {"Content-Type": "application/json"})
                 resp = conn.getresponse()
+                attempt = 0          # stream is up — reset the backoff
                 for line in resp:     # one JSON object per line
                     if stop.is_set():
                         return
@@ -250,7 +270,8 @@ class EtcdStore(CoordinationStore):
             except Exception as e:  # noqa: BLE001 — reconnect from next_rev
                 if not stop.is_set():
                     logger.debug("etcd watch %d reconnecting: %s", wid, e)
-                    stop.wait(0.2)
+                    self._watch_retry.sleep(attempt, stop_event=stop)
+                    attempt += 1
             finally:
                 conn.close()
 
