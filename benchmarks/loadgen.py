@@ -58,6 +58,12 @@ class RequestResult:
     # 0.0 when the trace was unavailable.
     mm: bool = False
     encode_ms: float = 0.0
+    # Service-added latency: request wall time minus the worker-span
+    # received→finished interval (same-plane t_mono stamps from
+    # /admin/trace/<id>) — what the service plane itself cost this
+    # request, as opposed to time the worker spent generating. 0.0 when
+    # the trace (or either worker stage) was unavailable.
+    service_added_ms: float = 0.0
 
 
 def _percentile(vals: List[float], p: float) -> float:
@@ -118,6 +124,17 @@ def summarize_results(results: List[Optional[RequestResult]],
     mm_done = [r for r in ok if r.mm]
     enc = [r.encode_ms for r in mm_done if r.encode_ms > 0]
     extra = {}
+    svc = [r.service_added_ms for r in ok if r.service_added_ms > 0]
+    if svc:
+        # Service-added latency (wall minus the worker received→finished
+        # interval): attributes service-plane overhead per request, so a
+        # bench can distinguish "the model got slower" from "the master
+        # got slower" without a profiler attached.
+        extra["service_added_ms"] = {
+            "num": len(svc),
+            "p50": round(_percentile(svc, 50), 2),
+            "p99": round(_percentile(svc, 99), 2),
+        }
     if mm_done:
         # Per-stage encode latency of the mixed tier (--mm-ratio): the
         # server-side "encoded" span, so it reflects the EPD stage the
@@ -270,23 +287,41 @@ def run_one(target: str, model: str, prompt_len: int, max_tokens: int,
     res.num_tokens = tokens
     if tokens > 1:
         res.tpot_ms = 1000.0 * (last - first) / (tokens - 1)
-    if res.mm and rid:
-        # Pull the server-side "encoded" span for this request — the
-        # per-stage encode latency report. Best-effort: the worker
-        # stage rides a heartbeat, so give it one short retry.
+    if rid:
+        # One best-effort trace fetch serves two per-stage reports: the
+        # mm tier's server-side "encoded" duration, and — for every
+        # completed stream — the worker-plane received→finished
+        # interval behind service_added_ms. Worker stages ride a
+        # heartbeat, so give the fetch one short retry.
         for _ in range(2):
             try:
                 status, span = http_json(
                     "GET", target, f"/admin/trace/{rid}", None,
                     timeout=10.0)
-            except Exception:  # noqa: BLE001 — report stays 0.0
+            except Exception:  # noqa: BLE001 — reports stay 0.0
                 break
             if status == 200:
-                enc = [e for e in span.get("events", [])
-                       if e.get("stage") == "encoded"]
-                if enc:
-                    res.encode_ms = float(enc[0].get("ms", 0.0) or 0.0)
-                    break
+                events = span.get("events", [])
+                if res.mm and not res.encode_ms:
+                    enc = [e for e in events
+                           if e.get("stage") == "encoded"]
+                    if enc:
+                        res.encode_ms = float(
+                            enc[0].get("ms", 0.0) or 0.0)
+                # Same-plane monotonic stamps: the worker's own clock
+                # bounds its generation interval; wall minus that is
+                # what the service plane added (relay, scheduling,
+                # SSE assembly, queueing).
+                w = {e.get("stage"): e.get("t_mono")
+                     for e in events if e.get("plane") == "worker"
+                     and isinstance(e.get("t_mono"), (int, float))}
+                if "received" in w and "finished" in w \
+                        and w["finished"] >= w["received"]:
+                    worker_ms = 1000.0 * (w["finished"] - w["received"])
+                    res.service_added_ms = max(
+                        res.total_ms - worker_ms, 0.0)
+                    if not res.mm or res.encode_ms:
+                        break
             time.sleep(0.5)
     return res
 

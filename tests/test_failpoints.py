@@ -295,6 +295,63 @@ class TestRelayLedger:
         assert usage["usage"]["completion_tokens"] == 1
         assert frames[-1] == b"data: [DONE]\n\n"
 
+    def _plain_delta(self, is_chat, content="ab"):
+        """A worker-rendered pure-delta payload: no xllm ext, no usage,
+        finish_reason null — canonical sse_frame JSON shape."""
+        if is_chat:
+            obj = {"id": "r1", "object": "chat.completion.chunk",
+                   "created": 111, "model": "tiny",
+                   "choices": [{"index": 0,
+                                "delta": {"content": content},
+                                "finish_reason": None}]}
+        else:
+            obj = {"id": "r1", "object": "text_completion",
+                   "created": 111, "model": "tiny",
+                   "choices": [{"index": 0, "text": content,
+                                "logprobs": None,
+                                "finish_reason": None}]}
+        return json.dumps(obj, separators=(",", ":"))
+
+    def test_zerocopy_byte_identity_with_parsed_path(self, monkeypatch):
+        """XLLM_RELAY_ZEROCOPY forwards pure-delta frames verbatim —
+        the fast path must be byte-identical to the parse+re-dump path
+        and keep the ledger's content-frame count consistent."""
+        from xllm_service_tpu.service import recovery
+        for is_chat in (True, False):
+            led_slow, _ = self._mk(is_chat=is_chat)
+            led_fast, _ = self._mk(is_chat=is_chat)
+            opener = (self._role_payload() if is_chat
+                      else self._plain_delta(False, content=""))
+            payloads = [self._plain_delta(is_chat, c)
+                        for c in ("a", "bc", "", "d")]
+            monkeypatch.setattr(recovery, "RELAY_ZEROCOPY", False)
+            led_slow.on_payload(opener)  # first frame always parses
+            slow = [led_slow.on_payload(p) for p in payloads]
+            monkeypatch.setattr(recovery, "RELAY_ZEROCOPY", True)
+            led_fast.on_payload(opener)
+            fast = [led_fast.on_payload(p) for p in payloads]
+            assert fast == slow
+            assert led_fast.content_frames == led_slow.content_frames
+            assert led_fast.template == led_slow.template
+
+    def test_zerocopy_preconditions_route_special_frames_to_parse(self):
+        """Frames the ledger must inspect (ext, usage, finish, role,
+        resumed streams) never qualify for the verbatim fast path."""
+        led, _ = self._mk()
+        assert not led._zerocopy_ok(self._plain_delta(True))  # no tmpl
+        led.on_payload(self._role_payload(created=111))
+        assert led._zerocopy_ok(self._plain_delta(True))
+        assert not led._zerocopy_ok(self._chunk(content="x", ids=(7,)))
+        assert not led._zerocopy_ok(json.dumps(
+            {"id": "r1", "choices": [],
+             "usage": {"prompt_tokens": 1}}, separators=(",", ":")))
+        assert not led._zerocopy_ok(self._plain_delta(True).replace(
+            '"finish_reason":null', '"finish_reason":"stop"'))
+        assert not led._zerocopy_ok(self._role_payload().replace(
+            ", ", ",").replace(": ", ":"))
+        led.resumed = True
+        assert not led._zerocopy_ok(self._plain_delta(True))
+
 
 # ---------------------------------------------------------------------------
 # In-process chaos: die-after-N-tokens mid-stream, both topologies

@@ -30,6 +30,10 @@ def test_loadgen_against_cluster():
         assert summary["req_per_s"] > 0
         assert summary["ttft_ms"]["p50"] > 0
         assert 0.0 <= summary["online_slo"]["ttft"] <= 1.0
+        # Worker spans ride heartbeats, so the service-added
+        # attribution must resolve for the completed requests.
+        assert summary["service_added_ms"]["num"] > 0
+        assert summary["service_added_ms"]["p99"] > 0
     finally:
         for w in workers:
             w.stop()
@@ -172,6 +176,28 @@ def test_summarize_counts_shed_separately():
     assert s["num_shed"] == 1
     assert s["num_errors"] == 1          # shed is policy, not failure
     assert s["shed_rate"] == pytest.approx(1 / 3, abs=1e-3)
+    # No request resolved a worker interval → no service_added block.
+    assert "service_added_ms" not in s
+
+
+def test_summarize_reports_service_added_percentiles():
+    """Wall minus the worker received→finished interval, surfaced as
+    its own percentile block when any request resolved it — the
+    service-overhead attribution every bench now carries."""
+    from benchmarks.loadgen import RequestResult, summarize_results
+    done = [
+        RequestResult(ok=True, ttft_ms=10, tpot_ms=1, total_ms=100,
+                      num_tokens=4, service_added_ms=30.0),
+        RequestResult(ok=True, ttft_ms=10, tpot_ms=1, total_ms=100,
+                      num_tokens=4, service_added_ms=10.0),
+        RequestResult(ok=True, ttft_ms=10, tpot_ms=1, total_ms=100,
+                      num_tokens=4),  # trace unavailable: excluded
+    ]
+    s = summarize_results(done, wall_s=1.0, target_ttft_ms=1000,
+                          target_tpot_ms=1000)
+    assert s["service_added_ms"]["num"] == 2
+    assert s["service_added_ms"]["p50"] == pytest.approx(10.0)
+    assert s["service_added_ms"]["p99"] == pytest.approx(30.0)
 
 
 def test_chaos_stage_summaries_split_and_recovery():

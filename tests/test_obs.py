@@ -275,6 +275,25 @@ class TestSpanStore:
         s.record("r", "received", plane="worker")
         assert len(s.get("r")["events"]) == 2
 
+    def test_get_is_isolated_from_reader_mutation(self):
+        """Copy-then-render: /admin/trace renders from get(), whose
+        deep copy means a reader scribbling on the returned span (or a
+        JSON encoder walking it while a writer appends) can never
+        corrupt the store's own record."""
+        s = SpanStore()
+        s.record("r", "scheduled", t_mono=1.0,
+                 policy={"name": "cache_aware"}, hops=[1])
+        s.annotate("r", tags={"tier": "online"})
+        span = s.get("r")
+        span["events"][0]["policy"]["name"] = "reader-scribble"
+        span["events"][0]["hops"].append(99)
+        span["attrs"]["tags"]["tier"] = "reader-scribble"
+        span["events"].clear()
+        fresh = s.get("r")
+        assert fresh["events"][0]["policy"] == {"name": "cache_aware"}
+        assert fresh["events"][0]["hops"] == [1]
+        assert fresh["attrs"]["tags"] == {"tier": "online"}
+
     def test_ring_evicts_oldest(self):
         s = SpanStore(capacity=2)
         for rid in ("a", "b", "c"):
@@ -495,6 +514,20 @@ class TestEventLog:
         for t in EVENT_TYPES:
             assert t in doc, f"event type {t!r} missing from " \
                              f"docs/OBSERVABILITY.md"
+
+    def test_reads_are_isolated_from_reader_mutation(self):
+        """Copy-then-render for /admin/events: since() deep-copies
+        attr values, so a reader scribbling on a returned event (or a
+        render racing an emitter that still holds the attrs dict) never
+        reaches the ring."""
+        log = EventLog()
+        log.emit("instance_join", detail={"worker": "w:1"},
+                 rids=["a"])
+        got = log.since(0)[0]["attrs"]
+        got["detail"]["worker"] = "reader-scribble"
+        got["rids"].append("b")
+        assert log.since(0)[0]["attrs"] == {
+            "detail": {"worker": "w:1"}, "rids": ["a"]}
 
     def test_ring_bounds_with_visible_drops(self):
         log = EventLog(capacity=3)

@@ -46,7 +46,7 @@ def real_tree():
 
 @pytest.fixture(scope="module")
 def timed_full_run():
-    """ONE cold full-tree 22-rule run, timed, shared by the clean gate
+    """ONE cold full-tree 23-rule run, timed, shared by the clean gate
     and the budget gate — running it twice would double-bill the
     callgraph build against the 870 s tier-1 budget."""
     import time
@@ -57,7 +57,7 @@ def timed_full_run():
 
 class TestRealTree:
     def test_real_tree_is_clean(self, timed_full_run):
-        """The acceptance gate: all twenty-two rules over
+        """The acceptance gate: all twenty-three rules over
         xllm_service_tpu/, checked-in allowlists applied, zero
         findings."""
         findings, _t = timed_full_run
@@ -107,7 +107,7 @@ class TestRealTree:
                 f"utils/locks.py docstring table"
 
     def test_full_run_fits_runtime_budget(self, timed_full_run):
-        """All 22 rules (the whole-program concurrency pass, the
+        """All 23 rules (the whole-program concurrency pass, the
         exception-flow/lifecycle pass, AND the device-plane tracewalk,
         callgraph memoized per run) over the real tree in < 30 s — the interprocedural analysis
         must never eat the 870 s tier-1 budget. Typical: ~5 s; the
@@ -285,6 +285,14 @@ class TestPositiveControls:
         assert f"{p}::failpoint::fixture.bogus_failpoint" in keys
         # Non-literal name: unverifiable statically — also a finding.
         assert f"{p}::failpoint-nonliteral" in keys
+
+    def test_hotpath_section_catalog_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "hotpath-section-catalog")
+        p = "xllm_service_tpu/service/bad_sections.py"
+        # Undeclared section: the closed timing taxonomy rejects it.
+        assert f"{p}::section::fixture.bogus_section" in keys
+        # Non-literal section: unverifiable statically — also a finding.
+        assert f"{p}::section-nonliteral" in keys
 
     def test_thread_root_crash_controls(self, bad_findings):
         keys = self._keys(bad_findings, "thread-root-crash")

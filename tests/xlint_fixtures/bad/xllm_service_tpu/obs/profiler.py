@@ -1,0 +1,5 @@
+"""Fixture catalog for the hotpath-section-catalog rule (bad tree)."""
+
+SECTIONS = (
+    "fixture.ok_section",
+)

@@ -1,0 +1,17 @@
+"""hotpath-section-catalog positive controls: section sites the closed
+timing taxonomy must reject — an undeclared name and a non-literal."""
+
+
+from xllm_service_tpu.obs import profiler
+
+
+def undeclared(payload):
+    # Name not in the fixture SECTIONS catalog.
+    with profiler.section("fixture.bogus_section"):
+        return len(payload)
+
+
+def nonliteral(name, payload):
+    # Cannot be verified statically against the catalog.
+    with profiler.section(name):
+        return len(payload)

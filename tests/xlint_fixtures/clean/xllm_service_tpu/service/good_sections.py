@@ -1,0 +1,18 @@
+"""hotpath-section-catalog near-misses that must NOT fire."""
+
+
+from xllm_service_tpu.obs import profiler
+
+
+class Handler:
+    def __init__(self, config):
+        self.config = config
+
+    def fine(self, payload):
+        # Declared section: clean.
+        with profiler.section("fixture.ok_section"):
+            n = len(payload)
+        # .section() on receivers that are NOT the profiler
+        # (configparser and friends) are out of the rule's namespace.
+        self.config.section("whatever_shape_it_likes")
+        return n

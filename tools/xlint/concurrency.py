@@ -626,6 +626,20 @@ def report(tree: RepoTree) -> Dict[str, object]:
     reasons: Dict[str, int] = {}
     for _fid, u in cg.unresolved_calls():
         reasons[u.reason] = reasons.get(u.reason, 0) + 1
+    # Per-lock view (docs/CONCURRENCY.md "Measured contention" table):
+    # rank + how many thread roots can transitively reach each lock —
+    # the static column that sits next to the bench-measured
+    # xllm_lock_wait_ms numbers (BENCH_SVC_r01.json).
+    from tools.xlint.rules import LOCK_RANK_TABLE
+    reach: Dict[str, int] = {}
+    for r in roots:
+        for nm in r["locks"]:
+            reach[nm] = reach.get(nm, 0) + 1
+    locks = [{"lock": nm, "rank": LOCK_RANK_TABLE.get(nm),
+              "roots_reaching": reach.get(nm, 0)}
+             for nm in sorted(set(LOCK_RANK_TABLE) | set(reach),
+                              key=lambda n: (LOCK_RANK_TABLE.get(n, 999),
+                                             n))]
     return {
         "roots": roots,
         "edges": sorted([list(e) for e in a.edges]),
@@ -633,4 +647,5 @@ def report(tree: RepoTree) -> Dict[str, object]:
         "cycles": a.cycles,
         "functions": len(cg.functions),
         "unresolved_calls": reasons,
+        "locks": locks,
     }

@@ -1210,9 +1210,19 @@ def _load_string_tuple_catalog(tree: RepoTree, module_path: str,
         except SyntaxError:
             return None
     for node in t.body:
-        if isinstance(node, ast.Assign) and any(
-                isinstance(x, ast.Name) and x.id == symbol
-                for x in node.targets):
+        # Both plain and annotated module-level assignment shapes:
+        # ``SECTIONS: Tuple[str, ...] = (...)`` declares a catalog just
+        # as much as ``EVENT_TYPES = (...)`` does.
+        if isinstance(node, ast.Assign):
+            names = [x.id for x in node.targets
+                     if isinstance(x, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.value is not None:
+            names = [node.target.id]
+        else:
+            continue
+        if symbol in names:
             v = node.value
             if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
                 out: Set[str] = set()
@@ -1396,6 +1406,100 @@ class FailpointCatalogRule:
                                      or name.endswith("_failpoints"))
 
 
+# ---------------------------------------------------------------------------
+# Rule 23: hotpath-section-catalog
+# ---------------------------------------------------------------------------
+
+_PROFILER_MODULE = "xllm_service_tpu/obs/profiler.py"
+
+
+def _load_section_catalog(tree: RepoTree) -> Optional[Set[str]]:
+    """The ``SECTIONS`` literal from obs/profiler.py."""
+    return _load_string_tuple_catalog(tree, _PROFILER_MODULE,
+                                      "SECTIONS")
+
+
+class HotpathSectionCatalogRule:
+    """Contract: every ``profiler.section("<name>")`` call site names a
+    section from the obs/profiler.py ``SECTIONS`` catalog — the hot-path
+    timing taxonomy is CLOSED. A free-string section would mint a new
+    ``xllm_service_hotpath_ms{section=...}`` series no dashboard or
+    saturation sweep knows to read, and (worse) would only fail at
+    runtime on the serving path, since ``section()`` raises on unknown
+    names.
+
+    Escape hatch: none — new sections are added to the catalog first
+    (and to the docs/OBSERVABILITY.md table).
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_sections.py."""
+
+    name = "hotpath-section-catalog"
+    describe = ("every profiler.section(\"<name>\") call site uses a "
+                "section declared in the obs/profiler.py SECTIONS "
+                "catalog (closed hot-path timing taxonomy)")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        findings: List[Finding] = []
+        catalog = _load_section_catalog(tree)
+        for mod in tree.modules:
+            if mod.path == _PROFILER_MODULE:
+                continue        # the catalog module itself
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "section"
+                        and self._is_profiler_receiver(node.func.value)):
+                    continue
+                if catalog is None:
+                    findings.append(Finding(
+                        rule=self.name, path=mod.path, line=node.lineno,
+                        key=f"{mod.path}::catalog-missing",
+                        message=f"profiler.section() call but no "
+                                f"SECTIONS literal found in "
+                                f"{_PROFILER_MODULE} — the closed "
+                                f"timing taxonomy has nowhere to live"))
+                    continue
+                arg = node.args[0] if node.args else None
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    if arg.value not in catalog:
+                        findings.append(Finding(
+                            rule=self.name, path=mod.path,
+                            line=node.lineno,
+                            key=f"{mod.path}::section::{arg.value}",
+                            message=f"hot-path section {arg.value!r} "
+                                    f"is not declared in the "
+                                    f"{_PROFILER_MODULE} SECTIONS "
+                                    f"catalog — add it there (and to "
+                                    f"docs/OBSERVABILITY.md) or fix "
+                                    f"the spelling; section() raises "
+                                    f"on unknown names AT RUNTIME, on "
+                                    f"the serving path"))
+                else:
+                    findings.append(Finding(
+                        rule=self.name, path=mod.path, line=node.lineno,
+                        key=f"{mod.path}::section-nonliteral",
+                        message="profiler.section() with a non-literal "
+                                "name — the static checker cannot "
+                                "verify it against the catalog; spell "
+                                "the section inline"))
+        return findings
+
+    @staticmethod
+    def _is_profiler_receiver(expr: ast.AST) -> bool:
+        """The receiver looks like the hot-path profiler: terminal name
+        ``profiler`` / ``_profiler`` / ``*_profiler`` (mirrors
+        EventCatalogRule's name-based namespace — unrelated
+        ``.section()`` APIs like configparser keep theirs)."""
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        return name is not None and (name == "profiler"
+                                     or name.endswith("_profiler"))
+
+
 from tools.xlint.concurrency import (         # noqa: E402 — rules 11–13
     BlockingUnderLockRule, LockOrderInterproceduralRule,
     ThreadRootRaceRule)
@@ -1429,4 +1533,5 @@ RULES = [
     UnboundedIoRule(),
     DeadlinePropagationRule(),
     RetryDisciplineRule(),
+    HotpathSectionCatalogRule(),
 ]

@@ -884,4 +884,14 @@ def options_from_env(**overrides: Any) -> ServiceOptions:
     if os.environ.get("ENABLE_DECODE_RESPONSE_TO_SERVICE", "").lower() in (
             "1", "true", "yes"):
         opts.enable_decode_response_to_service = True
+    raw_mc = os.environ.get("XLLM_MAX_CONCURRENCY", "").strip()
+    if raw_mc and "max_concurrency" not in overrides:
+        # Admission-gate ceiling override: the saturation harness
+        # (benchmarks/service_bench.py --saturate) spawns a master that
+        # must admit thousands of concurrent streams; there is no CLI
+        # flag for it because only benchmarks legitimately raise it.
+        try:
+            opts.max_concurrency = max(1, int(raw_mc))
+        except ValueError:
+            pass
     return opts

@@ -21,6 +21,10 @@ Dependency-free (stdlib only), thread-safe, shared by both planes:
 - ``slo``: the judgment layer — multi-window SLO burn-rate engine and
   the watchdog's anomaly detector, behind ``GET /admin/slo`` and the
   ``xllm_slo_*`` / ``xllm_anomaly_active`` series.
+- ``profiler``: the master watching itself — closed-catalog hot-path
+  section timers (``hotpath-section-catalog`` xlint rule),
+  lock-contention mirrors, per-thread-root CPU, self-gauges, and the
+  ``GET /admin/profile`` stack sampler.
 
 See docs/OBSERVABILITY.md for the full series and stage catalogue.
 """
@@ -35,6 +39,9 @@ from xllm_service_tpu.obs.expfmt import (           # noqa: F401
 from xllm_service_tpu.obs.metrics import (          # noqa: F401
     DEFAULT_LATENCY_BUCKETS_MS, Counter, Gauge, Histogram, Registry,
     default_registry)
+from xllm_service_tpu.obs.profiler import (         # noqa: F401
+    HOTPATH_BUCKETS_MS, SECTIONS)
+from xllm_service_tpu.obs import profiler           # noqa: F401
 from xllm_service_tpu.obs.slo import (              # noqa: F401
     AnomalyDetector, InstanceSignal, SloConfig, SloEngine, SloObjective)
 from xllm_service_tpu.obs.spans import (            # noqa: F401

@@ -32,7 +32,19 @@ import collections
 import time
 from typing import Any, Dict, List, Optional
 
+from xllm_service_tpu.obs import profiler
 from xllm_service_tpu.utils.locks import make_lock
+
+
+def _deep_copy(v: Any) -> Any:
+    """Deep-enough copy (dict/list/tuple of JSON-ish values) for the
+    read side — same rationale as spans._deep_copy."""
+    if isinstance(v, dict):
+        return {k: _deep_copy(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_deep_copy(x) for x in v]
+    return v
+
 
 # The complete event taxonomy (docs/OBSERVABILITY.md documents each).
 # Adding a type means adding it HERE (the event-catalog xlint rule pins
@@ -103,14 +115,16 @@ class EventLog:
             raise ValueError(
                 f"event type {type!r} is not in the obs/events.py "
                 f"catalog {EVENT_TYPES}")
-        with self._lock:
-            self._seq += 1
-            if len(self._ring) == self.capacity:
-                self._dropped += 1
-            self._ring.append({"seq": self._seq, "type": type,
-                               "t_wall": time.time(), "attrs": attrs})
-            self._counts[type] += 1
-            return self._seq
+        with profiler.section("event.emit"):
+            with self._lock:
+                self._seq += 1
+                if len(self._ring) == self.capacity:
+                    self._dropped += 1
+                self._ring.append({"seq": self._seq, "type": type,
+                                   "t_wall": time.time(),
+                                   "attrs": attrs})
+                self._counts[type] += 1
+                return self._seq
 
     # -- querying -------------------------------------------------------
     def since(self, seq: int = 0,
@@ -123,7 +137,11 @@ class EventLog:
         seq numbers — that IS the signal that events were dropped, not
         silently papered over."""
         with self._lock:
-            out = [dict(e, attrs=dict(e["attrs"]))
+            # Deep-enough copies: emit() keeps the caller's attrs dict
+            # by reference, and attr VALUES can be dicts/lists a caller
+            # still mutates — shallow dict(e["attrs"]) leaves those
+            # shared with the live ring mid-render.
+            out = [dict(e, attrs=_deep_copy(e["attrs"]))
                    for e in self._ring if e["seq"] > seq]
         if limit is not None and len(out) > limit:
             out = out[:limit]

@@ -217,6 +217,29 @@ class Histogram(_Family):
             s = self._series.get(self._key(labels))
             return s.total if s is not None else 0
 
+    def set_counts(self, counts: Sequence[int], sum_value: float,
+                   total: Optional[int] = None, **labels: Any) -> None:
+        """Scrape-time mirror of a full bucket distribution another
+        object owns (the profiler's section books, the lock-contention
+        wait books) — the histogram analogue of ``Counter.set_total``.
+        ``counts`` are per-bucket (non-cumulative) and must match this
+        family's bucket count; ``total`` covers overflow samples past
+        the last finite edge (defaults to ``sum(counts)``); the caller
+        owns monotonicity."""
+        if len(counts) != len(self.buckets):
+            raise ValueError(
+                f"{self.name}: set_counts got {len(counts)} buckets, "
+                f"family has {len(self.buckets)}")
+        key = self._key(labels)
+        n_total = int(sum(counts)) if total is None else int(total)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _HistogramSeries(len(self.buckets))
+            s.counts = [int(c) for c in counts]
+            s.total = n_total
+            s.sum = float(sum_value)
+
     def cumulative(self, **labels: Any
                    ) -> Optional[List[Tuple[float, float]]]:
         """Snapshot of one label set's cumulative bucket counts as

@@ -24,7 +24,22 @@ import collections
 import time
 from typing import Any, Dict, List, Optional
 
+from xllm_service_tpu.obs import profiler
 from xllm_service_tpu.utils.locks import make_lock
+
+
+def _deep_copy(v: Any) -> Any:
+    """Deep-enough copy for span/event payloads (dict/list/tuple of
+    JSON-ish values). The read side copies; writers stay cheap. Shallow
+    ``dict(...)`` is NOT enough: ``merge_remote`` nests per-plane attr
+    dicts (and remote events can carry dict/list attr values) that
+    would stay shared with the live span and mutate mid-render."""
+    if isinstance(v, dict):
+        return {k: _deep_copy(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_deep_copy(x) for x in v]
+    return v
+
 
 # Canonical service-plane stage order (docs/OBSERVABILITY.md); extra
 # stages (e.g. "redispatch"/"redispatched") may interleave — the first
@@ -123,14 +138,15 @@ class SpanStore:
                  "t_mono": time.monotonic() if t_mono is None else t_mono,
                  "t_wall": time.time() if t_wall is None else t_wall}
         event.update(attrs)
-        with self._lock:
-            span = self._span_locked(rid)
-            if any(e["stage"] == stage and e["plane"] == plane
-                   for e in span["events"]):
-                return
-            span["events"].append(event)
-            if stage == "finished":
-                self._finished.add(rid)
+        with profiler.section("span.write"):
+            with self._lock:
+                span = self._span_locked(rid)
+                if any(e["stage"] == stage and e["plane"] == plane
+                       for e in span["events"]):
+                    return
+                span["events"].append(event)
+                if stage == "finished":
+                    self._finished.add(rid)
 
     def merge_remote(self, rid: str, plane: str,
                      events: List[Dict[str, Any]],
@@ -166,8 +182,8 @@ class SpanStore:
             span = self._spans.get(rid)
             if span is None:
                 return None
-            events = [dict(e) for e in span["events"]]
-            attrs = dict(span["attrs"])
+            events = [_deep_copy(e) for e in span["events"]]
+            attrs = _deep_copy(span["attrs"])
         events.sort(key=lambda e: e.get("t_wall", 0.0))
         return {"request_id": rid, "attrs": attrs, "events": events}
 
@@ -215,8 +231,9 @@ class SpanStore:
                         for e in span["events"]):
                     continue
                 out.append({"request_id": span["request_id"],
-                            "attrs": dict(span["attrs"]),
-                            "events": [dict(e) for e in span["events"]]})
+                            "attrs": _deep_copy(span["attrs"]),
+                            "events": [_deep_copy(e)
+                                       for e in span["events"]]})
                 if len(out) >= n:
                     break
         out.reverse()
@@ -238,8 +255,8 @@ class SpanStore:
                 span = self._spans.pop(rid, None)
                 if span is not None:
                     out.append({"request_id": rid,
-                                "attrs": dict(span["attrs"]),
-                                "events": [dict(e)
+                                "attrs": _deep_copy(span["attrs"]),
+                                "events": [_deep_copy(e)
                                            for e in span["events"]]})
         return out
 
@@ -251,9 +268,11 @@ class SpanStore:
                 rid = rec["request_id"]
                 if rid in self._spans:
                     continue
-                self._spans[rid] = {"request_id": rid,
-                                    "attrs": dict(rec.get("attrs", {})),
-                                    "events": list(rec.get("events", []))}
+                self._spans[rid] = {
+                    "request_id": rid,
+                    "attrs": _deep_copy(rec.get("attrs", {})),
+                    "events": [_deep_copy(e)
+                               for e in rec.get("events", [])]}
                 self._revive_tombstone_locked(rid)
                 self._finished.add(rid)
                 self._evict_overflow_locked()

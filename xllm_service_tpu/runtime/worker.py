@@ -2244,6 +2244,11 @@ class Worker:
         # Supervised-thread crash / swallowed-callback books
         # (utils/threads.py — process-global, root-labeled).
         threads.flush_metrics(obs)
+        # Self-profiling mirrors on the worker plane too: hot-path
+        # sections (sse.assemble/span.write/event.emit fire here),
+        # sampled lock contention, per-root thread CPU, self-gauges.
+        from xllm_service_tpu.obs import profiler
+        profiler.flush_metrics(obs)
         # Keep-alive reuse pool, labeled with the exporting plane (the
         # pool is process-global — see the service-side exporter note).
         # In the separate-process deployment this is the worker→service

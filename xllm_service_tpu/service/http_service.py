@@ -36,6 +36,7 @@ from xllm_service_tpu.config import ServiceOptions
 from xllm_service_tpu.obs import (
     REQUEST_ID_HEADER, AnomalyDetector, EventLog, Failpoints,
     InstanceSignal, Registry, SloConfig, SloEngine, SpanStore)
+from xllm_service_tpu.obs import profiler
 from xllm_service_tpu.obs.expfmt import fraction_le_from_buckets
 from xllm_service_tpu.service.httpd import (
     Request, Response, Router, http_json, http_stream_status,
@@ -377,6 +378,7 @@ class HttpService:
         router.route("GET", "/admin/slo", self._admin_slo)
         router.route("GET", "/admin/events", self._admin_events)
         router.route("GET", "/admin/debug_bundle", self._admin_debug_bundle)
+        router.route("GET", "/admin/profile", self._admin_profile)
         router.route("POST", "/admin/failpoint", self._admin_failpoint)
         router.route("GET", "/admin/failpoints",
                      self._admin_failpoints_get)
@@ -833,7 +835,11 @@ class HttpService:
                                     yield frame
                                     return
                                 raise _EngineFaultResume(verdict)
-                        frame, n_new = ledger.on_payload(payload)
+                        # The yield stays OUTSIDE the section: a
+                        # suspended generator would bill downstream
+                        # socket writes to the relay.
+                        with profiler.section("relay.frame"):
+                            frame, n_new = ledger.on_payload(payload)
                         if frame is None:
                             # Suppressed (dup role chunk / held-back-only
                             # ledger frame) — its token ids still count.
@@ -1272,6 +1278,10 @@ class HttpService:
             "request spans dropped by ring overflow "
             "(size the ring with XLLM_SPAN_RING)").set_total(
             self.spans.eviction_count())
+        # The master watching itself: hot-path section books, sampled
+        # lock contention, per-root thread CPU, and self-gauges
+        # (obs/profiler.py — scrape-time mirrors, same pattern as above).
+        profiler.flush_metrics(obs)
         return obs.render()
 
     # ------------------------------------------------------------------
@@ -1348,9 +1358,31 @@ class HttpService:
                 "evictions_total": self.spans.eviction_count(),
                 "recent_finished": self.spans.tail(
                     32, finished_only=True)},
+            # The self-profile snapshot (sections/locks/thread-CPU/GC)
+            # WITHOUT a stack-sampling pass — the bundle must stay
+            # cheap; hit /admin/profile?seconds=N for stacks.
+            "profile": profiler.snapshot(),
             "metrics": self._render_metrics(),
         }
         return Response.json(bundle)
+
+    def _admin_profile(self, http_req: Request) -> Response:
+        """Self-profile on demand: the live section/lock-contention/
+        thread-CPU tables plus (with ``?seconds=N``, default 1) a
+        ``sys._current_frames`` stack-sampling pass over that window —
+        collapsed stacks and top functions, JSON. ``seconds=0`` skips
+        sampling and returns the tables alone. The admission gate
+        exempts /admin/, so this answers even at saturation — which is
+        exactly when it's needed."""
+        try:
+            seconds = float(http_req.param("seconds", "1") or 1.0)
+            hz = float(http_req.param("hz", "50") or 50.0)
+        except ValueError:
+            return Response.error(400, "seconds/hz must be numbers")
+        out = profiler.snapshot()
+        if seconds > 0:
+            out["stacks"] = profiler.sample_stacks(seconds, hz=hz)
+        return Response.json(out)
 
     # ------------------------------------------------------------------
     # Fault injection surface: arm failpoints on this plane or (with

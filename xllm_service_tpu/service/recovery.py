@@ -57,6 +57,14 @@ from xllm_service_tpu.utils.types import (
 
 logger = logging.getLogger(__name__)
 
+# Zero-copy relay scan (the saturation sweep's spent finding,
+# docs/PERF_NOTES.md service-plane round): when on, RelayLedger
+# forwards plain mid-stream delta frames VERBATIM after a pure
+# substring scan instead of json.loads + re-serialization per frame.
+# Read once at import — hot-path flag discipline (docs/FLAGS.md).
+RELAY_ZEROCOPY = os.environ.get(
+    "XLLM_RELAY_ZEROCOPY", "").strip() in ("1", "true", "yes")
+
 
 class RecoveryManager:
     """Per-service recovery policy + mechanics. Wired onto the
@@ -383,12 +391,50 @@ class RelayLedger:
         self.created: Optional[int] = None
         self.template: Dict[str, Any] = {}
 
+    def _zerocopy_ok(self, payload: str) -> bool:
+        """True when ``payload`` is provably a plain mid-stream delta
+        the ledger needs nothing from — every check is a substring scan
+        against the deterministic ``sse_frame`` wire format
+        (``json.dumps(obj, separators=(",", ":"))``), and ANY ambiguity
+        answers False (the parsed path is always correct, just slower):
+
+        - not resumed, and the first frame already captured the
+          template/created (the first frame always parses);
+        - no ``"xllm"`` ledger extension (nothing to strip or feed to
+          ``note_delivered``);
+        - no ``"usage"`` key (usage_sent tracking and the resumed-mode
+          rewrite both need the parse);
+        - exactly one ``"finish_reason"`` and it is ``null`` — every
+          assembler delta carries ``"finish_reason":null`` (single
+          choice: recoverable requires n==1), so a finish chunk never
+          takes this path and ``finished`` stays truthful;
+        - chat only: no ``"role"`` key (role chunks and ``role_sent``
+          need the parse)."""
+        return (not self.resumed
+                and bool(self.template)
+                and '"xllm"' not in payload
+                and '"usage"' not in payload
+                and payload.count('"finish_reason"') == 1
+                and '"finish_reason":null' in payload
+                and (not self.is_chat or '"role"' not in payload))
+
     def on_payload(self, payload: str) -> Tuple[Optional[bytes], int]:
         """One SSE payload in → (frame bytes to forward | None to
         suppress, number of NEW tokens it delivered)."""
         if payload.strip() == "[DONE]":
             self.done = True
             return SSE_DONE, 0
+        if RELAY_ZEROCOPY and self._zerocopy_ok(payload):
+            # Pure-delta frame: forward the worker's bytes verbatim
+            # (the worker built them with the same sse_frame renderer,
+            # so the client-visible shape is identical to the parsed
+            # path). No ledger ext ⇒ no note_delivered; content-frame
+            # detection below may only OVER-count, which fails a blind
+            # resume clean instead of ever replaying content.
+            if ('"content":""' if self.is_chat else '"text":""') \
+                    not in payload:
+                self.content_frames += 1
+            return (b"data: " + payload.encode("utf-8") + b"\n\n"), 0
         try:
             obj = json.loads(payload)
         except ValueError:

@@ -26,6 +26,7 @@ import json
 import time
 from typing import Any, Dict, List, Optional
 
+from xllm_service_tpu.obs import profiler
 from xllm_service_tpu.utils.types import (
     FinishReason, LogProb, RequestOutput, Usage)
 
@@ -37,8 +38,9 @@ def _now() -> int:
 
 
 def sse_frame(obj: Dict[str, Any]) -> bytes:
-    return b"data: " + json.dumps(obj, separators=(",", ":")).encode() \
-        + b"\n\n"
+    with profiler.section("sse.assemble"):
+        return b"data: " \
+            + json.dumps(obj, separators=(",", ":")).encode() + b"\n\n"
 
 
 def _chat_logprob_entry(lp: LogProb) -> Dict[str, Any]:

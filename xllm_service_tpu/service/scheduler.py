@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from xllm_service_tpu.config import ServiceOptions
 from xllm_service_tpu.nlp.chat_template import ChatTemplate
+from xllm_service_tpu.obs import profiler
 from xllm_service_tpu.nlp.tokenizer import Tokenizer, TokenizerFactory
 from xllm_service_tpu.service.coordination import (
     KEY_EPOCH_PREFIX, KEY_MASTER, KEY_MASTER_ADDR, CoordinationStore)
@@ -476,7 +477,9 @@ class Scheduler:
             # re-run the election). Collapsing the two is how a store
             # hiccup used to turn into a spurious failover.
             try:
-                lease_alive = self.store.lease_keepalive(self._lease_id)
+                with profiler.section("store.call"):
+                    lease_alive = self.store.lease_keepalive(
+                        self._lease_id)
             except Exception as e:  # noqa: BLE001 — outage; the guard
                 # tracks health and fires the heal callback later
                 logger.debug("keepalive unreachable (store outage?): %s", e)
@@ -509,15 +512,20 @@ class Scheduler:
     # ------------------------------------------------------------------
     def preprocess(self, request: Request) -> None:
         """Chat template + tokenize (fills prompt/token_ids/mm_inputs)."""
-        if request.messages and not request.prompt:
-            prompt, mm = self.chat_template.apply(request.messages)
-            request.prompt = prompt
-            if mm:
-                request.mm_inputs = mm
-        if not request.token_ids and request.prompt:
-            request.token_ids = self.tokenizer.encode(request.prompt)
+        with profiler.section("tokenize"):
+            if request.messages and not request.prompt:
+                prompt, mm = self.chat_template.apply(request.messages)
+                request.prompt = prompt
+                if mm:
+                    request.mm_inputs = mm
+            if not request.token_ids and request.prompt:
+                request.token_ids = self.tokenizer.encode(request.prompt)
 
     def schedule(self, request: Request) -> Tuple[Status, Routing]:
+        with profiler.section("schedule"):
+            return self._schedule_impl(request)
+
+    def _schedule_impl(self, request: Request) -> Tuple[Status, Routing]:
         if not request.service_request_id:
             request.service_request_id = f"req-{short_uuid()}"
         try:

@@ -90,6 +90,16 @@ increasing):
 Production (env unset) pays zero overhead: ``make_lock`` returns plain
 ``threading.Lock``/``RLock``.
 
+Contention telemetry: with ``XLLM_LOCK_PROFILE_SAMPLE=N`` (N >= 1),
+every lock made here samples one acquisition in N — a non-blocking
+try-acquire classifies the acquisition as contended, a contended one
+measures its blocking wait — into a per-lock-name book
+(``contention_snapshot()``). The obs profiler mirrors that book into
+``xllm_lock_wait_ms{lock,rank}`` / ``xllm_lock_contended_total{lock}``
+at scrape time; this module never imports obs (obs imports locks).
+Sampling keeps the measurement from becoming the contention: the book's
+own guard is taken only on the 1-in-N sampled path.
+
 This table is machine-checked: ``tools/xlint`` (rule ``lock-rank``)
 verifies every ``make_lock``/``make_rlock`` declaration against its
 mirror copy (``LOCK_RANK_TABLE`` in tools/xlint/rules.py) and statically
@@ -109,12 +119,95 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import List, Tuple, Union
+import time
+from typing import Dict, List, Tuple, Union
 
 
 def enabled() -> bool:
     return os.environ.get("XLLM_LOCK_CHECK", "").strip() in (
         "1", "true", "yes")
+
+
+def _profile_sample() -> int:
+    """1-in-N acquisition sampling rate; 0 disables. Read once at
+    import (hot-path flag discipline, docs/FLAGS.md)."""
+    raw = os.environ.get("XLLM_LOCK_PROFILE_SAMPLE", "").strip()
+    try:
+        n = int(raw) if raw else 0
+    except ValueError:
+        return 0
+    return n if n >= 1 else 0
+
+
+PROFILE_SAMPLE = _profile_sample()
+
+# Wait-time bucket edges (ms) for the contention book — sub-millisecond
+# resolution because a Python-master lock hold is typically tens of
+# microseconds; the default latency buckets would put every wait in the
+# first bucket.
+LOCK_WAIT_BUCKETS_MS: Tuple[float, ...] = (
+    0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0,
+    10.0, 20.0, 50.0, 100.0, 200.0, 500.0, 1000.0)
+
+
+class _LockBook:
+    __slots__ = ("rank", "sampled", "contended", "wait_counts",
+                 "wait_sum_ms")
+
+    def __init__(self, rank: int) -> None:
+        self.rank = rank
+        self.sampled = 0
+        self.contended = 0
+        self.wait_counts = [0] * len(LOCK_WAIT_BUCKETS_MS)
+        self.wait_sum_ms = 0.0
+
+
+# Keyed by lock NAME (instances sharing a name — e.g. one registry lock
+# per plane object under test — aggregate). Guarded by a raw
+# threading.Lock: innermost, dict updates only, never calls out, and
+# invisible to the rank checker by design.
+_books: Dict[str, _LockBook] = {}
+_books_lock = threading.Lock()
+
+
+def _record_wait(name: str, rank: int, wait_ms: float,
+                 contended: bool) -> None:
+    with _books_lock:
+        b = _books.get(name)
+        if b is None:
+            b = _books[name] = _LockBook(rank)
+        b.sampled += 1
+        if contended:
+            b.contended += 1
+        for i, edge in enumerate(LOCK_WAIT_BUCKETS_MS):
+            if wait_ms <= edge:
+                b.wait_counts[i] += 1
+                break
+        b.wait_sum_ms += wait_ms
+
+
+def contention_snapshot() -> Dict[str, Dict[str, object]]:
+    """Copy of the per-lock contention book: ``{name: {rank, sampled,
+    contended, wait_counts, wait_sum_ms}}``. Counts are of SAMPLED
+    acquisitions (multiply by XLLM_LOCK_PROFILE_SAMPLE to estimate
+    totals); wait_counts align with LOCK_WAIT_BUCKETS_MS."""
+    with _books_lock:
+        return {
+            name: {
+                "rank": b.rank,
+                "sampled": b.sampled,
+                "contended": b.contended,
+                "wait_counts": list(b.wait_counts),
+                "wait_sum_ms": b.wait_sum_ms,
+            }
+            for name, b in _books.items()
+        }
+
+
+def reset_contention() -> None:
+    """Test helper: drop the book (module state is process-global)."""
+    with _books_lock:
+        _books.clear()
 
 
 class LockOrderViolation(AssertionError):
@@ -147,16 +240,36 @@ def _held() -> List[Tuple[str, int]]:
 
 
 class CheckedLock:
-    """Lock wrapper enforcing the global rank order (see module doc)."""
+    """Lock wrapper enforcing the global rank order (see module doc).
 
-    def __init__(self, name: str, rank: int, reentrant: bool = False):
+    ``check=False`` keeps the name/rank identity and the contention
+    sampling but skips rank enforcement — the production shape when only
+    ``XLLM_LOCK_PROFILE_SAMPLE`` is set."""
+
+    def __init__(self, name: str, rank: int, reentrant: bool = False,
+                 check: bool = True):
         self.name = name
         self.rank = rank
         self._reentrant = reentrant
+        self._check = check
         self._lock: Union[threading.Lock, threading.RLock] = (
             threading.RLock() if reentrant else threading.Lock())
         self._owner = -1
         self._depth = 0
+        self._sample_ctr = 0    # racy on purpose: skews sampling, never
+                                # correctness
+
+    def _acquire_profiled(self) -> bool:
+        """Sampled acquisition: classify contended via try-acquire,
+        measure the blocking wait only when contended."""
+        if self._lock.acquire(False):
+            _record_wait(self.name, self.rank, 0.0, False)
+            return True
+        t0 = time.perf_counter()
+        ok = self._lock.acquire()
+        _record_wait(self.name, self.rank,
+                     (time.perf_counter() - t0) * 1000.0, True)
+        return ok
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         me = threading.get_ident()
@@ -164,17 +277,28 @@ class CheckedLock:
             self._lock.acquire()
             self._depth += 1
             return True
-        held = _held()
-        if held and held[-1][1] >= self.rank:
-            msg = (f"acquiring {self.name!r} (rank {self.rank}) while "
-                   f"holding {held} — lock order must be strictly "
-                   f"increasing (utils/locks.py rank table)")
-            _violations.append(msg)
-            raise LockOrderViolation(msg)
-        ok = (self._lock.acquire(blocking) if timeout < 0
-              else self._lock.acquire(blocking, timeout))
+        if self._check:
+            held = _held()
+            if held and held[-1][1] >= self.rank:
+                msg = (f"acquiring {self.name!r} (rank {self.rank}) "
+                       f"while holding {held} — lock order must be "
+                       f"strictly increasing (utils/locks.py rank "
+                       f"table)")
+                _violations.append(msg)
+                raise LockOrderViolation(msg)
+        if PROFILE_SAMPLE > 0 and blocking and timeout < 0:
+            self._sample_ctr += 1
+            if self._sample_ctr >= PROFILE_SAMPLE:
+                self._sample_ctr = 0
+                ok = self._acquire_profiled()
+            else:
+                ok = self._lock.acquire()
+        else:
+            ok = (self._lock.acquire(blocking) if timeout < 0
+                  else self._lock.acquire(blocking, timeout))
         if ok:
-            held.append((self.name, self.rank))
+            if self._check:
+                _held().append((self.name, self.rank))
             if self._reentrant:
                 self._owner = me
                 self._depth = 1
@@ -187,11 +311,12 @@ class CheckedLock:
                 self._lock.release()
                 return
             self._owner = -1
-        held = _held()
-        for i in range(len(held) - 1, -1, -1):
-            if held[i][0] == self.name:
-                del held[i]
-                break
+        if self._check:
+            held = _held()
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] == self.name:
+                    del held[i]
+                    break
         self._lock.release()
 
     def __enter__(self) -> "CheckedLock":
@@ -208,10 +333,18 @@ class CheckedLock:
 
 def make_lock(name: str, rank: int):
     """A plain Lock in production; a rank-checked one under
-    XLLM_LOCK_CHECK."""
-    return CheckedLock(name, rank) if enabled() else threading.Lock()
+    XLLM_LOCK_CHECK; a profiling-only CheckedLock (check off) when only
+    XLLM_LOCK_PROFILE_SAMPLE is set."""
+    if enabled():
+        return CheckedLock(name, rank)
+    if PROFILE_SAMPLE > 0:
+        return CheckedLock(name, rank, check=False)
+    return threading.Lock()
 
 
 def make_rlock(name: str, rank: int):
-    return CheckedLock(name, rank, reentrant=True) if enabled() \
-        else threading.RLock()
+    if enabled():
+        return CheckedLock(name, rank, reentrant=True)
+    if PROFILE_SAMPLE > 0:
+        return CheckedLock(name, rank, reentrant=True, check=False)
+    return threading.RLock()

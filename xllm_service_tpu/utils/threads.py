@@ -168,6 +168,15 @@ class SupervisedThread(threading.Thread):
                     and self._stop_event.is_set())
 
     def run(self) -> None:        # noqa: D102 — Thread contract
+        try:
+            # Lazy import: threads.py sits below obs in the import
+            # graph (obs.metrics imports utils.locks). The profiler
+            # attributes /proc CPU time to this root by native tid.
+            from xllm_service_tpu.obs import profiler
+            profiler.register_thread_root(self.root)
+        except Exception:  # noqa: BLE001 — best-effort CPU attribution;
+            pass           # a root must start even if the profiler can't
+                           # bind its tid (partial deploy, exotic libc)
         attempt = 0
         while True:
             started = time.monotonic()
