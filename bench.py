@@ -210,6 +210,7 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     from xllm_service_tpu.config import EngineConfig, ModelConfig
     from xllm_service_tpu.obs import (
         default_registry, histogram_fraction_le, histogram_quantile)
+    from xllm_service_tpu.obs import steptrace
     from xllm_service_tpu.obs.slo import SloConfig
     from xllm_service_tpu.runtime.engine import Engine, EngineRequest
     from xllm_service_tpu.utils.types import FinishReason, SamplingParams
@@ -344,10 +345,17 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     _STAGE["name"] = "decode"
     t0 = time.monotonic()
     tokens = 0
+    # Per-step roofline attribution against the warmup-captured
+    # cost_analysis table (same verdict arithmetic as the worker's
+    # flight recorder) — (wall ms, tokens, ragged?) per iteration.
+    step_samples = []
     while engine.has_work():
         t_step = time.monotonic()
         step_outs = engine.step()
         step_el = time.monotonic() - t_step
+        step_tok = sum(len(out.new_token_ids) for out in step_outs)
+        step_samples.append(
+            (1000.0 * step_el, step_tok, engine.last_step_ragged))
         for out in step_outs:
             tokens += len(out.new_token_ids)
             if out.new_token_ids:
@@ -438,6 +446,27 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
     peak = _chip_peak_flops(dev)
     mfu = achieved / peak if peak > 0 else None
 
+    # Per-step roofline verdicts over the decode loop: MFU and debt
+    # (wall ms minus the modeled floor) of the MEDIAN iteration, from
+    # the warmup-captured cost_analysis table — the BENCH-side twin of
+    # xllm_worker_step_mfu / xllm_worker_step_debt_ms, so the artifact
+    # and the live exposition share numerators. None when the capture
+    # is off (XLLM_ROOFLINE=0) or the backend would not answer.
+    step_mfu_p50 = decode_debt_ms = None
+    if engine.roofline and step_samples:
+        st_pf, st_pb = steptrace.peaks_for(getattr(dev, "device_kind", ""))
+        verdicts = [steptrace.attribute_step(
+            engine.roofline, kind="decode", step_ms=ms,
+            prefill_tokens=0, decode_tokens=tok,
+            batch_size=ecfg.max_batch_size,
+            decode_steps=ecfg.decode_steps, ragged=ragged,
+            peak_flops=st_pf, peak_bytes_s=st_pb)
+            for ms, tok, ragged in step_samples]
+        mfus = sorted(v["mfu"] for v in verdicts)
+        debts = sorted(v["debt_ms"] for v in verdicts)
+        step_mfu_p50 = round(mfus[len(mfus) // 2], 4)
+        decode_debt_ms = round(debts[len(debts) // 2], 3)
+
     burst = None
     if tiny or os.environ.get("BENCH_BURST") == "1":
         _STAGE["name"] = "burst-goodput"
@@ -520,6 +549,10 @@ def _run_bench(tiny: bool, force_cpu: bool = False,
             "slo_targets_ms": {"ttft": slo_thr["ttft"],
                                "e2e": slo_thr["e2e"]},
             "mfu": round(mfu, 4) if mfu is not None else None,
+            # Median per-step roofline verdict (computed above); the
+            # aggregate "mfu" smooths over scheduling, these do not.
+            "step_mfu_p50": step_mfu_p50,
+            "decode_debt_ms": decode_debt_ms,
             "prefill_tokens_per_s": round(prefill_tokens / prefill_s, 1),
             # Prefill runs the lm_head only on the LAST position per
             # sequence (forward_prefill return_all_logits=False), so

@@ -27,7 +27,7 @@ import json
 import random
 import threading
 import time
-from typing import List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from xllm_service_tpu.service.httpd import (
     http_json, http_stream_status, iter_sse_events)
@@ -648,6 +648,29 @@ def run_closed_loop(target: str, model: str, *,
     return overall
 
 
+def fetch_timeline(target: str, path: str,
+                   seconds: float) -> Dict[str, Any]:
+    """Pull the master's cluster-merged chrome-trace document and write
+    it as a run artifact: the per-request flow chains and per-step
+    engine slices behind this run's latency percentiles. Returns the
+    summary subdict ({"path", "events", "instances"}, or an "error"
+    entry — a missing timeline must not fail the load run)."""
+    try:
+        status, trace = http_json(
+            "GET", target, f"/admin/timeline?seconds={seconds:g}",
+            timeout=30.0)
+    except Exception as e:  # noqa: BLE001 — artifact is best-effort
+        return {"path": path, "error": str(e)}
+    if status != 200 or not isinstance(trace, dict):
+        return {"path": path, "error": f"status {status}"}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(trace, f, sort_keys=True, separators=(",", ":"))
+    meta = trace.get("metadata") or {}
+    return {"path": path,
+            "events": len(trace.get("traceEvents", [])),
+            "instances": list(meta.get("instances", []))}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description="xllm-service-tpu loadgen")
     ap.add_argument("--target", required=True, help="host:port of service")
@@ -684,6 +707,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "[,...]', e.g. 'store.partition@10+15' "
                          "(open-loop only); summary gains per-stage "
                          "pre/during/post goodput + shed + recovery_s")
+    ap.add_argument("--timeline", default="",
+                    help="after the run, fetch the master's cluster-"
+                         "merged GET /admin/timeline and write the "
+                         "chrome://tracing-loadable JSON here "
+                         "(validate/summarize with tools/trace_view.py)"
+                         "; summary gains a timeline subdict")
+    ap.add_argument("--timeline-seconds", type=float, default=120.0,
+                    help="merge window for the --timeline fetch")
     args = ap.parse_args(argv)
 
     if args.chaos and args.closed_loop:
@@ -710,6 +741,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             sharegpt_path=args.sharegpt or None,
             chaos=parse_chaos(args.chaos) if args.chaos else None,
             mm_ratio=args.mm_ratio)
+    if args.timeline:
+        summary["timeline"] = fetch_timeline(
+            args.target, args.timeline, args.timeline_seconds)
     print(json.dumps(summary))
     return 0
 

@@ -788,8 +788,14 @@ def saturate_run(steps: List[int], step_seconds: float, n_workers: int,
                 cl.stop()
             return min(runs, key=lambda r: r["p99_ms"])
 
-        on = best_p99(prof_env)
+        # The off arm turns off BOTH observability layers on the hot
+        # path: the section/lock profiler (XLLM_HOTPATH_PROFILE=0) and
+        # the step-trace/timed-event tail (XLLM_STEPTRACE=0, which also
+        # gates profiler.EVENTS_ENABLED) — so the gate bounds the whole
+        # observatory's added p99, not just the PR-18 half.
+        on = best_p99(dict(prof_env, XLLM_STEPTRACE="1"))
         off = best_p99({"XLLM_HOTPATH_PROFILE": "0",
+                        "XLLM_STEPTRACE": "0",
                         "XLLM_MAX_CONCURRENCY": admit})
         diff = on["p99_ms"] - off["p99_ms"]
         pct = 100.0 * diff / max(off["p99_ms"], 1e-9)

@@ -46,7 +46,7 @@ def real_tree():
 
 @pytest.fixture(scope="module")
 def timed_full_run():
-    """ONE cold full-tree 23-rule run, timed, shared by the clean gate
+    """ONE cold full-tree 24-rule run, timed, shared by the clean gate
     and the budget gate — running it twice would double-bill the
     callgraph build against the 870 s tier-1 budget."""
     import time
@@ -57,7 +57,7 @@ def timed_full_run():
 
 class TestRealTree:
     def test_real_tree_is_clean(self, timed_full_run):
-        """The acceptance gate: all twenty-three rules over
+        """The acceptance gate: all twenty-four rules over
         xllm_service_tpu/, checked-in allowlists applied, zero
         findings."""
         findings, _t = timed_full_run
@@ -107,7 +107,7 @@ class TestRealTree:
                 f"utils/locks.py docstring table"
 
     def test_full_run_fits_runtime_budget(self, timed_full_run):
-        """All 23 rules (the whole-program concurrency pass, the
+        """All 24 rules (the whole-program concurrency pass, the
         exception-flow/lifecycle pass, AND the device-plane tracewalk,
         callgraph memoized per run) over the real tree in < 30 s — the interprocedural analysis
         must never eat the 870 s tier-1 budget. Typical: ~5 s; the
@@ -293,6 +293,19 @@ class TestPositiveControls:
         assert f"{p}::section::fixture.bogus_section" in keys
         # Non-literal section: unverifiable statically — also a finding.
         assert f"{p}::section-nonliteral" in keys
+
+    def test_steptrace_schema_controls(self, bad_findings):
+        keys = self._keys(bad_findings, "steptrace-schema")
+        p = "xllm_service_tpu/service/bad_steptrace.py"
+        # Field outside the closed step-record schema.
+        assert f"{p}::field::stepms" in keys
+        # **kwargs splat: unverifiable statically — also a finding.
+        assert f"{p}::record-splat" in keys
+        # Chrome-trace phase outside CHROME_PHASES (UIs drop it
+        # silently at load time).
+        assert f"{p}::ph::B" in keys
+        # Non-literal phase: unverifiable statically.
+        assert f"{p}::ph-nonliteral" in keys
 
     def test_thread_root_crash_controls(self, bad_findings):
         keys = self._keys(bad_findings, "thread-root-crash")

@@ -1,0 +1,7 @@
+"""Fixture catalog for the steptrace-schema rule (bad tree)."""
+
+STEP_FIELDS = (
+    "seq",
+    "kind",
+    "step_ms",
+)

@@ -1,0 +1,6 @@
+"""Fixture catalog for the steptrace-schema rule (bad tree)."""
+
+CHROME_PHASES = (
+    "X",
+    "M",
+)

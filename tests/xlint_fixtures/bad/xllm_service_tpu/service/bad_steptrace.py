@@ -1,0 +1,27 @@
+"""steptrace-schema positive controls: record fields outside the
+closed schema, an unverifiable splat, and chrome-trace phase literals
+the tracing UIs would silently drop."""
+
+
+class Recorder:
+    def __init__(self, steptrace):
+        self.steptrace = steptrace
+
+    def misfield(self, ms):
+        # Field not in the fixture STEP_FIELDS catalog.
+        return self.steptrace.record(kind="decode", stepms=ms)
+
+    def splat(self, fields):
+        # Cannot be verified statically against the schema.
+        return self.steptrace.record(**fields)
+
+
+def bogus_phase(pid):
+    # "B"/"E" begin/end pairs are not in the fixture catalog (the
+    # exporter only emits complete "X" slices).
+    return {"ph": "B", "pid": pid, "ts": 0, "name": "step"}
+
+
+def nonliteral_phase(ph, pid):
+    # Phase can't be checked against the catalog.
+    return {"ph": ph, "pid": pid, "ts": 0, "name": "step"}

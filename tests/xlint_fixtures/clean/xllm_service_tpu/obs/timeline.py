@@ -1,0 +1,6 @@
+"""Fixture catalog for the steptrace-schema rule (clean tree)."""
+
+CHROME_PHASES = (
+    "X",
+    "M",
+)

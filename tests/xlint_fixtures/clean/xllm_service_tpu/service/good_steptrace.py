@@ -1,0 +1,26 @@
+"""steptrace-schema near-misses that must NOT fire."""
+
+
+class Recorder:
+    def __init__(self, steptrace, ledger):
+        self.steptrace = steptrace
+        self.ledger = ledger
+
+    def fine(self, ms):
+        # Declared fields only: clean.
+        return self.steptrace.record(kind="decode", step_ms=ms)
+
+    def other_record(self, ms):
+        # .record() on receivers that are NOT the flight recorder
+        # (ledgers, loggers) are out of the rule's namespace.
+        return self.ledger.record(anything="goes", latency=ms)
+
+
+def fine_event(pid):
+    # Declared chrome-trace phase: clean.
+    return {"ph": "X", "pid": pid, "ts": 0, "dur": 1, "name": "step"}
+
+
+def unrelated_dict(ph_value):
+    # A dict without a "ph" key is not a chrome-trace event.
+    return {"phase": ph_value, "kind": "decode"}

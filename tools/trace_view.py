@@ -1,0 +1,161 @@
+"""Offline validator/summarizer for /admin/timeline artifacts.
+
+chrome://tracing and Perfetto silently drop malformed events — a typo'd
+phase, a flow with no finish, or a negative timestamp renders as a
+mysteriously empty track, not an error. This tool is the loud version:
+it structurally validates a trace JSON against the contract
+obs/timeline.py emits (and docs/OBSERVABILITY.md documents), then
+prints a per-track summary so a human can sanity-check coverage without
+loading a UI.
+
+Checks:
+
+- top level: ``traceEvents`` list + ``metadata`` dict present;
+- every event: ``ph`` in the closed ``CHROME_PHASES`` catalog, with
+  the per-phase required keys ("X" needs ts/dur/name/pid/tid, "C"
+  needs args, "M" needs args.name, flows need id, ...);
+- timestamps: integers ≥ 0, "X" durations ≥ 1;
+- flow integrity: every flow id has exactly one "s", any number of
+  "t" steps, exactly one "f", with non-decreasing timestamps.
+
+CLI: ``python tools/trace_view.py TRACE.json`` — exits 0 and prints
+the summary when valid, exits 1 with every violation otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Tuple
+
+from xllm_service_tpu.obs.timeline import CHROME_PHASES
+
+# Required keys beyond the universal "ph" per phase type. "s"/"t"/"f"
+# flow events also need ts/pid/tid so the UI can bind them to a slice.
+_REQUIRED: Dict[str, Tuple[str, ...]] = {
+    "X": ("ts", "dur", "name", "pid", "tid"),
+    "M": ("pid", "name", "args"),
+    "C": ("ts", "pid", "name", "args"),
+    "s": ("ts", "pid", "tid", "id", "name"),
+    "t": ("ts", "pid", "tid", "id", "name"),
+    "f": ("ts", "pid", "tid", "id", "name"),
+    "i": ("ts", "pid", "tid", "name"),
+}
+
+
+def validate_trace(trace: Any) -> List[str]:
+    """Every structural violation in ``trace``, as human-readable
+    strings; [] means the artifact is loadable and flow-complete."""
+    errs: List[str] = []
+    if not isinstance(trace, dict):
+        return ["top level: not a JSON object"]
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["top level: missing traceEvents list"]
+    if not isinstance(trace.get("metadata"), dict):
+        errs.append("top level: missing metadata dict")
+    flows: Dict[Any, Dict[str, List[int]]] = {}
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errs.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in CHROME_PHASES:
+            errs.append(f"{where}: unknown ph {ph!r} (catalog: "
+                        f"{'/'.join(CHROME_PHASES)})")
+            continue
+        for key in _REQUIRED[ph]:
+            if key not in ev:
+                errs.append(f"{where}: ph {ph!r} missing {key!r}")
+        ts = ev.get("ts")
+        if ts is not None and (not isinstance(ts, int) or ts < 0):
+            errs.append(f"{where}: ts {ts!r} must be an int ≥ 0")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, int) or dur < 1:
+                errs.append(f"{where}: X dur {dur!r} must be an "
+                            f"int ≥ 1")
+        if ph == "M" and not (isinstance(ev.get("args"), dict)
+                              and "name" in ev["args"]):
+            errs.append(f"{where}: M event needs args.name")
+        if ph == "C" and not isinstance(ev.get("args"), dict):
+            errs.append(f"{where}: C event needs an args dict")
+        if ph in ("s", "t", "f") and "id" in ev:
+            book = flows.setdefault(
+                ev["id"], {"s": [], "t": [], "f": []})
+            book[ph].append(int(ts) if isinstance(ts, int) else -1)
+    for fid in sorted(flows, key=str):
+        book = flows[fid]
+        if len(book["s"]) != 1:
+            errs.append(f"flow {fid!r}: {len(book['s'])} start "
+                        f"events (need exactly 1)")
+        if len(book["f"]) != 1:
+            errs.append(f"flow {fid!r}: {len(book['f'])} finish "
+                        f"events (need exactly 1)")
+        seq = book["s"] + sorted(book["t"]) + book["f"]
+        if any(b < a for a, b in zip(seq, seq[1:])):
+            errs.append(f"flow {fid!r}: timestamps regress along "
+                        f"s→t…→f")
+    return errs
+
+
+def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-track event counts + flow tally for the CLI report (and the
+    tier-1 assertions): {"tracks": {"pid/tid": {ph: n}}, "phases":
+    {ph: n}, "flows": n, "events": n, "instances": [...]}."""
+    events = trace.get("traceEvents", [])
+    tracks: Dict[str, Dict[str, int]] = {}
+    phases: Dict[str, int] = {}
+    flow_ids = set()
+    names: Dict[Tuple[int, int], str] = {}
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        ph = str(ev.get("ph", "?"))
+        phases[ph] = phases.get(ph, 0) + 1
+        if ph == "M" and ev.get("name") in ("process_name",
+                                            "thread_name"):
+            names[(ev.get("pid", 0), ev.get("tid", 0))] = \
+                (ev.get("args") or {}).get("name", "")
+        key = f"{ev.get('pid', 0)}/{ev.get('tid', 0)}"
+        tracks.setdefault(key, {})
+        tracks[key][ph] = tracks[key].get(ph, 0) + 1
+        if ph in ("s", "t", "f") and "id" in ev:
+            flow_ids.add(ev["id"])
+    meta = trace.get("metadata") or {}
+    return {
+        "events": len(events),
+        "phases": dict(sorted(phases.items())),
+        "tracks": dict(sorted(tracks.items())),
+        "track_names": {f"{p}/{t}": n
+                        for (p, t), n in sorted(names.items())},
+        "flows": len(flow_ids),
+        "instances": list(meta.get("instances", [])),
+        "window_s": meta.get("window_s"),
+    }
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 1:
+        print("usage: python tools/trace_view.py TRACE.json",
+              file=sys.stderr)
+        return 2
+    try:
+        with open(argv[0], "r", encoding="utf-8") as f:
+            trace = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"unreadable trace: {e}", file=sys.stderr)
+        return 1
+    errs = validate_trace(trace)
+    if errs:
+        for e in errs:
+            print(f"INVALID: {e}", file=sys.stderr)
+        print(f"{len(errs)} violation(s)", file=sys.stderr)
+        return 1
+    print(json.dumps(summarize(trace), indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -380,6 +380,8 @@ LOCK_RANK_TABLE: Dict[str, int] = {
     "obs.slo": 78,
     "obs.watchdog": 79,
     "obs.events": 80,
+    "obs.steptrace": 85,
+    "obs.stepbooks": 86,
     "worker.embedcache": 87,
     "scheduler.elect": 88,
     "worker.addr": 89,
@@ -1500,6 +1502,145 @@ class HotpathSectionCatalogRule:
                                      or name.endswith("_profiler"))
 
 
+# ---------------------------------------------------------------------------
+# Rule 24: steptrace-schema
+# ---------------------------------------------------------------------------
+
+_STEPTRACE_MODULE = "xllm_service_tpu/obs/steptrace.py"
+_TIMELINE_MODULE = "xllm_service_tpu/obs/timeline.py"
+
+
+def _load_step_field_catalog(tree: RepoTree) -> Optional[Set[str]]:
+    """The ``STEP_FIELDS`` literal from obs/steptrace.py."""
+    return _load_string_tuple_catalog(tree, _STEPTRACE_MODULE,
+                                      "STEP_FIELDS")
+
+
+def _load_chrome_phase_catalog(tree: RepoTree) -> Optional[Set[str]]:
+    """The ``CHROME_PHASES`` literal from obs/timeline.py."""
+    return _load_string_tuple_catalog(tree, _TIMELINE_MODULE,
+                                      "CHROME_PHASES")
+
+
+class SteptraceSchemaRule:
+    """Contract: the step flight-recorder schema and the chrome-trace
+    phase vocabulary are CLOSED. Every ``steptrace.record(<field>=...)``
+    keyword names a field from the obs/steptrace.py ``STEP_FIELDS``
+    catalog (a free-keyed record would raise at runtime, on the engine
+    loop), and every ``{"ph": "<phase>"}`` dict literal uses a phase
+    from the obs/timeline.py ``CHROME_PHASES`` catalog — chrome://
+    tracing silently DROPS events with unknown phases, so a typo'd
+    emitter renders as a mysteriously empty track, not an error.
+
+    Escape hatch: none — new fields/phases are added to the catalogs
+    first (and to the docs/OBSERVABILITY.md schema table).
+
+    Fixture: tests/xlint_fixtures/bad/.../service/bad_steptrace.py."""
+
+    name = "steptrace-schema"
+    describe = ("steptrace.record(field=...) keywords are pinned to the "
+                "obs/steptrace.py STEP_FIELDS catalog and {\"ph\": ...} "
+                "chrome-trace literals to the obs/timeline.py "
+                "CHROME_PHASES catalog (both closed)")
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        findings: List[Finding] = []
+        fields = _load_step_field_catalog(tree)
+        phases = _load_chrome_phase_catalog(tree)
+        for mod in tree.modules:
+            if mod.path in (_STEPTRACE_MODULE, _TIMELINE_MODULE):
+                continue        # the catalog modules themselves
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "record" and \
+                        self._is_steptrace_receiver(node.func.value):
+                    findings.extend(self._check_record(
+                        mod.path, node, fields))
+                elif isinstance(node, ast.Dict):
+                    findings.extend(self._check_ph_dict(
+                        mod.path, node, phases))
+        return findings
+
+    def _check_record(self, path: str, node: ast.Call,
+                      fields: Optional[Set[str]]) -> List[Finding]:
+        out: List[Finding] = []
+        if fields is None:
+            return [Finding(
+                rule=self.name, path=path, line=node.lineno,
+                key=f"{path}::fields-missing",
+                message=f"steptrace.record() call but no STEP_FIELDS "
+                        f"literal found in {_STEPTRACE_MODULE} — the "
+                        f"closed step-record schema has nowhere to "
+                        f"live")]
+        for kw in node.keywords:
+            if kw.arg is None:
+                out.append(Finding(
+                    rule=self.name, path=path, line=node.lineno,
+                    key=f"{path}::record-splat",
+                    message="steptrace.record(**kwargs) with a splat — "
+                            "the static checker cannot verify the "
+                            "field names; spell them inline"))
+            elif kw.arg not in fields:
+                out.append(Finding(
+                    rule=self.name, path=path, line=node.lineno,
+                    key=f"{path}::field::{kw.arg}",
+                    message=f"step-record field {kw.arg!r} is not "
+                            f"declared in the {_STEPTRACE_MODULE} "
+                            f"STEP_FIELDS catalog — add it there (and "
+                            f"to docs/OBSERVABILITY.md) or fix the "
+                            f"spelling; record() raises on unknown "
+                            f"fields AT RUNTIME, on the engine loop"))
+        return out
+
+    def _check_ph_dict(self, path: str, node: ast.Dict,
+                       phases: Optional[Set[str]]) -> List[Finding]:
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant) and k.value == "ph"):
+                continue
+            if phases is None:
+                return [Finding(
+                    rule=self.name, path=path, line=node.lineno,
+                    key=f"{path}::phases-missing",
+                    message=f"chrome-trace event literal but no "
+                            f"CHROME_PHASES catalog found in "
+                            f"{_TIMELINE_MODULE}")]
+            if isinstance(v, ast.Constant) and \
+                    isinstance(v.value, str):
+                if v.value not in phases:
+                    return [Finding(
+                        rule=self.name, path=path, line=node.lineno,
+                        key=f"{path}::ph::{v.value}",
+                        message=f"chrome-trace phase {v.value!r} is "
+                                f"not in the {_TIMELINE_MODULE} "
+                                f"CHROME_PHASES catalog — tracing UIs "
+                                f"silently drop unknown phases; add "
+                                f"it there or fix the spelling")]
+            else:
+                return [Finding(
+                    rule=self.name, path=path, line=node.lineno,
+                    key=f"{path}::ph-nonliteral",
+                    message="chrome-trace event with a non-literal "
+                            "\"ph\" — the static checker cannot "
+                            "verify it against CHROME_PHASES; spell "
+                            "the phase inline")]
+        return []
+
+    @staticmethod
+    def _is_steptrace_receiver(expr: ast.AST) -> bool:
+        """The receiver looks like the step flight recorder: terminal
+        name ``steptrace`` / ``_steptrace`` / ``*_steptrace`` (the same
+        name-based namespace convention as the event/failpoint/section
+        catalog rules — unrelated ``.record()`` APIs keep theirs)."""
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        return name is not None and (name == "steptrace"
+                                     or name.endswith("_steptrace"))
+
+
 from tools.xlint.concurrency import (         # noqa: E402 — rules 11–13
     BlockingUnderLockRule, LockOrderInterproceduralRule,
     ThreadRootRaceRule)
@@ -1534,4 +1675,5 @@ RULES = [
     DeadlinePropagationRule(),
     RetryDisciplineRule(),
     HotpathSectionCatalogRule(),
+    SteptraceSchemaRule(),
 ]

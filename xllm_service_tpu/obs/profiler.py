@@ -93,6 +93,23 @@ def _enabled_from_env() -> bool:
 
 ENABLED = _enabled_from_env()
 
+
+def _events_enabled_from_env() -> bool:
+    # The timed-event tail (per-section slices for the merged timeline,
+    # obs/timeline.py) rides the step-trace flag: XLLM_STEPTRACE=0
+    # turns the per-exit wall-clock read + tail append off together
+    # with the worker's step recorder. Read once at import, like every
+    # hot-path flag.
+    return ENABLED and os.environ.get(
+        "XLLM_STEPTRACE", "1").strip() not in ("0", "false", "no")
+
+
+EVENTS_ENABLED = _events_enabled_from_env()
+
+# Per-thread bounded tail of timed section events (newest EVENT_TAIL
+# per thread) — the raw material for the timeline's hotpath tracks.
+EVENT_TAIL = 256
+
 try:
     _CLK_TCK = float(os.sysconf("SC_CLK_TCK"))
 except (AttributeError, ValueError, OSError):
@@ -135,6 +152,23 @@ def _thread_book() -> Dict[str, _Sect]:
     return book
 
 
+# (thread name, bounded deque of (section, t_wall_end, dur_ms)) — one
+# tail per thread, registered like the books. Appends are thread-local;
+# readers copy under _books_lock.
+_all_event_tails: List[Tuple[str, Any]] = []
+
+
+def _thread_events():
+    tail = getattr(_tls, "events", None)
+    if tail is None:
+        import collections
+        tail = _tls.events = collections.deque(maxlen=EVENT_TAIL)
+        with _books_lock:
+            _all_event_tails.append(
+                (threading.current_thread().name, tail))
+    return tail
+
+
 class _NullSection:
     __slots__ = ()
 
@@ -171,6 +205,8 @@ class _Timer:
                 break
         s.sum_ms += dt_ms
         s.ops += 1
+        if EVENTS_ENABLED:
+            _thread_events().append((self.name, time.time(), dt_ms))
         return False
 
 
@@ -212,7 +248,29 @@ def reset_sections() -> None:
     """Test helper: forget every thread book (process-global state)."""
     with _books_lock:
         _all_books.clear()
+        _all_event_tails.clear()
     _tls.book = None
+    _tls.events = None
+
+
+def recent_events(window_s: float = 0.0,
+                  limit: int = 2048) -> List[Dict[str, Any]]:
+    """Merged copy of every thread's timed-event tail, oldest-first:
+    ``[{name, t_wall, dur_ms, thread}]`` — the timeline's hotpath
+    tracks. ``window_s`` clips to the newest event minus the window."""
+    with _books_lock:
+        tails = [(tname, list(tail))
+                 for tname, tail in _all_event_tails]
+    out: List[Dict[str, Any]] = []
+    for tname, tail in tails:
+        for name, t_wall, dur_ms in tail:
+            out.append({"name": name, "t_wall": t_wall,
+                        "dur_ms": dur_ms, "thread": tname})
+    out.sort(key=lambda e: (e["t_wall"], e["thread"], e["name"]))
+    if window_s > 0 and out:
+        horizon = out[-1]["t_wall"] - window_s
+        out = [e for e in out if e["t_wall"] >= horizon]
+    return out[-limit:]
 
 
 # ---------------------------------------------------------------------------

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import bisect
 import collections
+import os
 import contextlib
 import dataclasses
 import enum
@@ -466,6 +467,22 @@ class Engine:
         self.phase_times: Dict[str, float] = collections.defaultdict(float)
         self.phase_counts: Dict[str, int] = collections.defaultdict(int)
 
+        # Roofline table (obs/steptrace.py consumes it): program →
+        # variant key → {"flops", "bytes", "tokens"}, captured at
+        # warmup via AOT ``.lower().compile().cost_analysis()``. The
+        # AOT compile does NOT share the jit's executable cache, so
+        # every capture is an extra compile — XLLM_ROOFLINE gates the
+        # whole capture and XLLM_ROOFLINE_VARIANTS caps the per-program
+        # variant count (config-time env reads, flag discipline).
+        self.roofline: Dict[str, Dict[str, Dict[str, float]]] = {}
+        self._roofline_enabled = os.environ.get(
+            "XLLM_ROOFLINE", "1").strip() not in ("0", "false", "no")
+        try:
+            self._roofline_cap = max(1, int(os.environ.get(
+                "XLLM_ROOFLINE_VARIANTS", "8")))
+        except ValueError:
+            self._roofline_cap = 8
+
     def _vec_default_layout(self):
         """Default layout for the burst's [B] int32 token/position
         carries (same best-effort contract as _kv_default_layouts)."""
@@ -546,6 +563,33 @@ class Engine:
             if jitted is not None:
                 report[name] = self._jit_cache_size(jitted)
         return report
+
+    def _roofline_capture(self, program: str, key: str, tokens: int,
+                          jitted, *args) -> None:
+        """Capture the compiler's own FLOPs/bytes for one warmup shape
+        into ``self.roofline`` via AOT ``cost_analysis()`` — the
+        numerators behind ``xllm_worker_program_flops/_bytes`` and the
+        per-step MFU/debt attribution (obs/steptrace.py) come from the
+        compiled executable, never from hand math. Best-effort by
+        design: cost_analysis is backend-dependent, and a backend that
+        won't answer must not take warmup down with it."""
+        if not self._roofline_enabled or jitted is None:
+            return
+        table = self.roofline.setdefault(program, {})
+        if key in table or len(table) >= self._roofline_cap:
+            return
+        try:
+            cost = jitted.lower(*args).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            table[key] = {
+                "flops": float(cost.get("flops", 0.0) or 0.0),
+                "bytes": float(cost.get("bytes accessed", 0.0) or 0.0),
+                "tokens": float(max(tokens, 1)),
+            }
+        except Exception as exc:  # noqa: BLE001 — diagnostic capture
+            logger.debug("roofline capture failed for %s/%s: %s",
+                         program, key, exc)
 
     def _read_host(self, phase: str, *arrays):
         """Blocking device→host readback with split attribution.
@@ -2585,11 +2629,14 @@ class Engine:
             b_ids, b_vals = self._batch_bias([], B, self.cfg.vocab_size)
             warm_rp = (jnp.zeros((B, 3, T), jnp.int32)
                        if self._mrope else None)
-            _, _, _, self.kv, _ = self._jit_prefill(
+            pf_args = (
                 self.params,
                 jnp.zeros((B, _PREFILL_HDR + T + mp), jnp.int32),
                 self.kv, st_f32, st_i32, key, None, None, None,
                 b_ids, b_vals, warm_rp, T)
+            self._roofline_capture("prefill", f"B{B}xT{T}xmp{mp}",
+                                   B * T, self._jit_prefill, *pf_args)
+            _, _, _, self.kv, _ = self._jit_prefill(*pf_args)
 
         # Decode (single + fused multi): every pow2 table width. Inactive
         # slots + NULL pages make the KV writes no-ops.
@@ -2616,17 +2663,23 @@ class Engine:
             # steps only near max_model_len, which a scoped bench never
             # approaches) — don't pay a tunnel compile for the other one.
             if decode_widths is None or self.ecfg.decode_steps == 1:
-                *_, self.kv, _, _ = self._jit_decode(
-                    self.params, packed, self.kv, st_f32, st_i32, key,
-                    None, b_ids, b_vals)
+                dec_args = (self.params, packed, self.kv, st_f32,
+                            st_i32, key, None, b_ids, b_vals)
+                self._roofline_capture("decode", f"mp{mp}", Bmax,
+                                       self._jit_decode, *dec_args)
+                *_, self.kv, _, _ = self._jit_decode(*dec_args)
             if self.ecfg.decode_steps > 1:
                 tok0 = jnp.zeros((Bmax,), jnp.int32)
                 pos0 = jnp.zeros((Bmax,), jnp.int32)
                 apt0 = jnp.zeros((Bmax, 2 + mp), jnp.int32)
+                dm_args = (self.params, tok0, pos0, apt0, self.kv,
+                           st_f32, st_i32, key, None, b_ids, b_vals)
+                self._roofline_capture(
+                    "decode_multi", f"mp{mp}",
+                    Bmax * self.ecfg.decode_steps,
+                    self._jit_decode_multi, *dm_args)
                 (_, _, _, self.kv, _, _, f_tok,
-                 f_pos) = self._jit_decode_multi(
-                    self.params, tok0, pos0, apt0, self.kv, st_f32,
-                    st_i32, key, None, b_ids, b_vals)
+                 f_pos) = self._jit_decode_multi(*dm_args)
                 # Second call feeding back the returned device-resident
                 # carries and a split (device-committed) key: the
                 # serving path's resident-reuse signature. Under the
@@ -2656,12 +2709,16 @@ class Engine:
                                                  self.cfg.vocab_size)
                 for T in t_set:
                     for mp in mp_set:
-                        _, _, _, self.kv, _ = self._jit_ragged(
+                        rg_args = (
                             self.params,
                             jnp.zeros((B, _PREFILL_HDR + T + mp),
                                       jnp.int32),
                             self.kv, st_f32, st_i32, key, None, None,
                             None, b_ids, b_vals, None, T)
+                        self._roofline_capture(
+                            "ragged", f"B{B}xT{T}xmp{mp}", B * T,
+                            self._jit_ragged, *rg_args)
+                        _, _, _, self.kv, _ = self._jit_ragged(*rg_args)
         jax.block_until_ready(jax.tree_util.tree_leaves(self.kv)[0])
         return time.monotonic() - t0
 

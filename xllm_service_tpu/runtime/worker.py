@@ -46,6 +46,7 @@ from xllm_service_tpu.nlp.tokenizer import (
     IncrementalDecoder, Tokenizer, TokenizerFactory)
 from xllm_service_tpu.obs import (
     Failpoints, REQUEST_ID_HEADER, Registry, SpanStore)
+from xllm_service_tpu.obs import steptrace
 from xllm_service_tpu.obs.events import EventLog
 from xllm_service_tpu.obs.expfmt import quantile_from_buckets
 from xllm_service_tpu.runtime.engine import Engine, EngineRequest, StepOutput
@@ -542,6 +543,33 @@ class Worker:
         # plane's ring is the cluster's memory; this one is the
         # worker's own black box.
         self.events = EventLog(capacity=256)
+        # Device-plane step flight recorder (obs/steptrace.py): one
+        # fixed-schema record per engine iteration into a bounded ring,
+        # served on GET /admin/steptrace and shipped as a heartbeat
+        # tail. XLLM_STEPTRACE=0 collapses the whole recording path to
+        # the single `if enabled:` branch in _flush_engine_obs.
+        self.steptrace = steptrace.StepTrace()
+        # Per-model cumulative-ledger snapshots backing the per-STEP
+        # deltas in the records (phase ms, speculation outcomes, prefix
+        # hit tokens, free pages). Engine-loop thread only.
+        self._st_phase_snap: Dict[str, Dict[str, float]] = {}
+        self._st_spec_snap: Dict[str, Dict[str, int]] = {}
+        self._st_prefix_snap: Dict[str, int] = {}
+        self._st_free_pages: Dict[str, int] = {}
+        # Last roofline verdict per model, mirrored at scrape time as
+        # xllm_worker_step_mfu / xllm_worker_step_debt_ms.
+        self._st_last: Dict[str, Dict[str, float]] = {}
+        # Highest step seq already DELIVERED on a heartbeat; committed
+        # only on an acked beat (same discipline as _hb_step_cum).
+        self._hb_steps_seq = 0                  # guarded-by: worker.hb
+        # Roofline peaks resolve from the accelerator kind; resolved
+        # once here (device enumeration is not hot-path safe).
+        try:
+            self._device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 — device enumeration can fail
+            # pre-initialization in exotic harnesses; the CPU peaks row
+            # is the documented fallback and MFU stays visibly modeled.
+            self._device_kind = "cpu"
         # Deterministic fault injection (obs/failpoints.py): per-worker
         # so the co-located test harness can kill ONE of two in-process
         # workers; armed via XLLM_FAILPOINTS and POST /admin/failpoint.
@@ -701,6 +729,7 @@ class Worker:
         router.route("POST", "/admin/failpoint", self._serve_failpoint)
         router.route("GET", "/admin/failpoints",
                      self._serve_failpoints)
+        router.route("GET", "/admin/steptrace", self._serve_steptrace)
         self._router = router
         # Jitted embedding fns keyed by model name — a multi-model worker
         # must never run model B's params through model A's closed-over
@@ -1368,11 +1397,13 @@ class Worker:
         eng = rt.engine
         if eng is None:
             return
-        self._engine_load(rt)
+        lm = self._engine_load(rt)
         kind = phase or eng.last_step_kind
         if kind == "idle":
             return
         m = rt.model
+        if self.steptrace.enabled:
+            self._record_step(rt, lm, kind, step_ms)
         pf = eng.last_step_prefill_tokens
         dc = eng.last_step_decode_tokens
         self.obs.counter(
@@ -1441,6 +1472,66 @@ class Worker:
         self._flush_phase_ledger(rt)
         self._flush_overlap(rt)
         self._flush_prefix_cache(rt)
+
+    def _record_step(self, rt: ModelRuntime, lm: LoadMetrics,
+                     kind: str, step_ms: float) -> None:
+        """Append one flight-recorder record for the iteration that just
+        ran (engine-loop thread; call-site gated on
+        ``steptrace.enabled`` so the OFF path builds nothing). Per-step
+        phase/speculation/prefix/page deltas come from snapshot-diffing
+        the engine's cumulative ledgers; the roofline verdict comes from
+        the warmup-captured cost_analysis table."""
+        eng = rt.engine
+        m = rt.model
+        # Phase-ms delta against the previous iteration's snapshot —
+        # includes the <phase>.device_wait / .host_copy splits.
+        snap = self._st_phase_snap.get(m, {})
+        cur = {k: v for k, v in eng.phase_times.items()}
+        phases = {}
+        for k, v in cur.items():
+            d = (v - snap.get(k, 0.0)) * 1e3
+            if d > 0.0005:
+                phases[k] = round(d, 3)
+        self._st_phase_snap[m] = cur
+        om = eng.overlap_metrics()
+        sspec = self._st_spec_snap.get(m, {})
+        spec = {k: int(om[k] - sspec.get(k, 0))
+                for k in ("spec_dispatches", "spec_hits",
+                          "spec_rollbacks")}
+        self._st_spec_snap[m] = {k: int(om[k]) for k in spec}
+        hit_cum = int(eng.prefix_cache_stats()["hit_tokens_total"])
+        hit_delta = hit_cum - self._st_prefix_snap.get(m, 0)
+        self._st_prefix_snap[m] = hit_cum
+        free = int(eng.allocator.num_free)
+        pages_delta = free - self._st_free_pages.get(m, free)
+        self._st_free_pages[m] = free
+        peak_flops, peak_bytes_s = steptrace.peaks_for(self._device_kind)
+        verdict = steptrace.attribute_step(
+            eng.roofline, kind=kind, step_ms=step_ms,
+            prefill_tokens=eng.last_step_prefill_tokens,
+            decode_tokens=eng.last_step_decode_tokens,
+            batch_size=eng.ecfg.max_batch_size,
+            decode_steps=eng.ecfg.decode_steps,
+            ragged=eng.last_step_ragged,
+            peak_flops=peak_flops, peak_bytes_s=peak_bytes_s)
+        self._st_last[m] = {"mfu": verdict["mfu"],
+                            "debt_ms": verdict["debt_ms"]}
+        self.steptrace.record(
+            model=m, kind=kind, step_ms=round(step_ms, 3),
+            prefill_tokens=eng.last_step_prefill_tokens,
+            decode_tokens=eng.last_step_decode_tokens,
+            prefill_windows=eng.last_step_prefill_windows,
+            decode_deferred=eng.last_step_decode_deferred,
+            ragged=eng.last_step_ragged,
+            attn_dispatches=eng.last_step_attn_dispatches,
+            members=eng.step_members,
+            phases=phases, spec=spec,
+            kv_usage=round(float(lm.kv_cache_usage), 4),
+            pages_delta=pages_delta,
+            cache_hit_tokens=hit_delta,
+            flops=verdict["flops"], bytes=verdict["bytes"],
+            mfu=verdict["mfu"], bound=verdict["bound"],
+            debt_ms=verdict["debt_ms"])
 
     def _flush_overlap(self, rt: ModelRuntime) -> None:
         """Decode-pipeline overlap health: speculative-burst
@@ -1813,6 +1904,40 @@ class Worker:
 
     def _serve_failpoints(self, req: Request) -> Response:
         return Response.json(self.failpoints.state())
+
+    def _serve_steptrace(self, req: Request) -> Response:
+        """The step flight recorder, raw: the ring tail (optionally
+        clipped by ``?seconds=N`` / ``?n=N``), the hot-path section
+        tail, and the warmup-captured roofline table — what the
+        master's /admin/timeline pulls and merges."""
+        try:
+            window_s = float(req.param("seconds", "0") or 0)
+        except ValueError:
+            window_s = 0.0
+        try:
+            n = int(req.param("n", "0") or 0)
+        except ValueError:
+            n = 0
+        from xllm_service_tpu.obs import profiler
+        peak_flops, peak_bytes_s = steptrace.peaks_for(self._device_kind)
+        roofline: List[Dict[str, Any]] = []
+        for _m, rt in self.runtimes.items():
+            if rt.engine is None:
+                continue
+            for row in steptrace.roofline_table(
+                    rt.engine.roofline, peak_flops, peak_bytes_s):
+                row["model"] = rt.model
+                roofline.append(row)
+        return Response.json({
+            "name": self.name,
+            "enabled": self.steptrace.enabled,
+            "device_kind": self._device_kind,
+            "peak_flops": peak_flops,
+            "peak_bytes_s": peak_bytes_s,
+            "steps": self.steptrace.tail(n=n, window_s=window_s),
+            "sections": profiler.recent_events(window_s=window_s),
+            "roofline": roofline,
+        })
 
     # ------------------------------------------------------------------
     # Serving
@@ -2241,6 +2366,14 @@ class Worker:
             self._flush_phase_ledger(rt)
             self._flush_overlap(rt)
             self._flush_prefix_cache(rt)
+            # Roofline mirrors: per-program cost_analysis FLOPs/bytes
+            # (warmup-captured, never hardcoded) + the last step's MFU
+            # and decode-debt verdict.
+            last = self._st_last.get(rt.model, {})
+            steptrace.flush_metrics(
+                obs, rt.model, rt.engine.roofline,
+                last.get("mfu", 0.0), last.get("debt_ms", 0.0),
+                device_kind=self._device_kind)
         # Supervised-thread crash / swallowed-callback books
         # (utils/threads.py — process-global, root-labeled).
         threads.flush_metrics(obs)
@@ -4253,6 +4386,18 @@ class Worker:
         # Finished request spans ride the heartbeat to the service's
         # span ring (same correlation id); an undelivered batch is
         # requeued so the next beat retries it.
+        # Step-record tail since the last DELIVERED beat (bounded; the
+        # seq baseline commits only on an acked beat below, so an
+        # undelivered tail is re-shipped — StepBooks dedupes on seq).
+        # Built BEFORE the span drain: nothing may raise between the
+        # drain and its requeue-protected try block.
+        steps_tail: List[Dict[str, Any]] = []
+        steps_seq = self._hb_steps_seq
+        if self.steptrace.enabled:
+            steps_tail = self.steptrace.tail(
+                n=64, since_seq=self._hb_steps_seq)
+            if steps_tail:
+                steps_seq = int(steps_tail[-1].get("seq", steps_seq))
         span_batch = self.spans.drain_finished()
         # Encode-plane beat payload (docs/EPD.md): queue depth + step
         # latency feed the scheduler's cost-aware encode pick; the
@@ -4282,7 +4427,8 @@ class Worker:
                 cache_offloaded=offloaded,
                 cache_offloaded_ssd=offloaded_ssd,
                 model_states=model_states, spans=span_batch,
-                embed_stored=embed_stored, embed_removed=embed_removed)
+                embed_stored=embed_stored, embed_removed=embed_removed,
+                steps=steps_tail)
             self._latency = LatencyMetrics()
             status, ack = http_json("POST", self.service_addr,
                                     "/rpc/heartbeat", stamp(hb.to_json()),
@@ -4321,6 +4467,7 @@ class Worker:
             self._requeue_encode_hb(embed_stored, embed_removed, enc_ms)
         else:
             self._hb_step_cum = step_baseline
+            self._hb_steps_seq = steps_seq
         return status == 200
 
     def _requeue_encode_hb(self, stored: List[str], removed: List[str],

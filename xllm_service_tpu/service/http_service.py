@@ -37,6 +37,7 @@ from xllm_service_tpu.obs import (
     REQUEST_ID_HEADER, AnomalyDetector, EventLog, Failpoints,
     InstanceSignal, Registry, SloConfig, SloEngine, SpanStore)
 from xllm_service_tpu.obs import profiler
+from xllm_service_tpu.obs import steptrace, timeline
 from xllm_service_tpu.obs.expfmt import fraction_le_from_buckets
 from xllm_service_tpu.service.httpd import (
     Request, Response, Router, http_json, http_stream_status,
@@ -199,6 +200,18 @@ class HttpService:
         self.obs = Registry()
         self.spans = SpanStore(capacity=int(os.environ.get(
             "XLLM_SPAN_RING", "2048")))
+        # Heartbeat-shipped worker step flight-recorder tails
+        # (obs/steptrace.py): the /admin/timeline fallback source when
+        # a live worker pull fails mid-incident.
+        self.step_books = steptrace.StepBooks()
+        # Default /admin/timeline merge window; read ONCE here (the
+        # handler is serving-reachable — flag-registry discipline).
+        try:
+            self._timeline_window_s = float(os.environ.get(
+                "XLLM_TIMELINE_WINDOW_S", "60") or 60)
+        except ValueError:
+            self._timeline_window_s = 60.0
+        self._timeline_exports = 0
         self._m_requests = self.obs.counter(
             "xllm_service_requests_total",
             "completion/chat requests accepted by the front door")
@@ -378,6 +391,7 @@ class HttpService:
         router.route("GET", "/admin/slo", self._admin_slo)
         router.route("GET", "/admin/events", self._admin_events)
         router.route("GET", "/admin/debug_bundle", self._admin_debug_bundle)
+        router.route("GET", "/admin/timeline", self._admin_timeline)
         router.route("GET", "/admin/profile", self._admin_profile)
         router.route("POST", "/admin/failpoint", self._admin_failpoint)
         router.route("GET", "/admin/failpoints",
@@ -1278,6 +1292,10 @@ class HttpService:
             "request spans dropped by ring overflow "
             "(size the ring with XLLM_SPAN_RING)").set_total(
             self.spans.eviction_count())
+        obs.counter(
+            "xllm_service_timeline_exports_total",
+            "cluster-merged /admin/timeline documents served").set_total(
+            self._timeline_exports)
         # The master watching itself: hot-path section books, sampled
         # lock contention, per-root thread CPU, and self-gauges
         # (obs/profiler.py — scrape-time mirrors, same pattern as above).
@@ -1362,9 +1380,67 @@ class HttpService:
             # WITHOUT a stack-sampling pass — the bundle must stay
             # cheap; hit /admin/profile?seconds=N for stacks.
             "profile": profiler.snapshot(),
+            # Device-plane step flight recorder, as heartbeats shipped
+            # it (no live worker pulls — the bundle must stay cheap and
+            # answer even when the fleet doesn't): per-instance step-
+            # record tails for the incident's last minutes.
+            "steptrace": {
+                name: self.step_books.tail(name, n=64)
+                for name in self.step_books.instances()},
             "metrics": self._render_metrics(),
         }
         return Response.json(bundle)
+
+    def _admin_timeline(self, http_req: Request) -> Response:
+        """Cluster-merged Perfetto/chrome-trace export
+        (obs/timeline.py): service-plane request spans + hot-path
+        section slices + every worker's step flight recorder, one
+        chrome://tracing-loadable JSON document. Workers are pulled
+        live from ``GET /admin/steptrace`` (bounded timeout); a worker
+        that doesn't answer degrades to its heartbeat-shipped StepBooks
+        tail instead of failing the whole export."""
+        try:
+            window_s = float(http_req.param(
+                "seconds", str(self._timeline_window_s))
+                or self._timeline_window_s)
+        except ValueError:
+            window_s = self._timeline_window_s
+        scheduler = self.scheduler
+        workers: Dict[str, Dict[str, Any]] = {}
+        for name in scheduler.instance_mgr.names():
+            addr = scheduler.instance_mgr.address_of(name)
+            pulled = None
+            if addr is not None:
+                try:
+                    status, resp = http_json(
+                        "GET", addr,
+                        f"/admin/steptrace?seconds={window_s:g}",
+                        timeout=5.0)
+                    if status == 200 and isinstance(resp, dict):
+                        pulled = resp
+                except Exception:  # noqa: BLE001 — degrade to books
+                    pulled = None
+            if pulled is not None:
+                workers[name] = {
+                    "steps": pulled.get("steps", []),
+                    "sections": pulled.get("sections", [])}
+            else:
+                workers[name] = {
+                    "steps": self.step_books.tail(name),
+                    "sections": []}
+        trace = timeline.build_timeline(
+            service_id=scheduler.service_id,
+            spans=self.spans.tail(256),
+            sections=profiler.recent_events(window_s=window_s),
+            workers=workers,
+            window_s=window_s,
+            master_counters={
+                "instances": float(len(workers)),
+                "tracked_requests": float(
+                    len(scheduler.tracked_requests_info()))})
+        self._timeline_exports += 1
+        return Response(body=timeline.render(trace).encode("utf-8"),
+                        content_type="application/json")
 
     def _admin_profile(self, http_req: Request) -> Response:
         """Self-profile on demand: the live section/lock-contention/

@@ -243,6 +243,10 @@ class Heartbeat:
     # service merges them into its span ring under the same correlation
     # id, so /admin/trace/<id> shows worker-side stages too.
     spans: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    # Step flight-recorder tail since the last delivered beat
+    # (obs/steptrace.py STEP_FIELDS records): the master's StepBooks
+    # dedupe on seq, so a re-shipped tail after a failed beat is safe.
+    steps: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
     timestamp: float = dataclasses.field(default_factory=time.time)
 
     def to_json(self) -> Dict[str, Any]:
@@ -259,6 +263,7 @@ class Heartbeat:
             "embed_removed": self.embed_removed,
             "model_states": self.model_states,
             "spans": self.spans,
+            "steps": self.steps,
             "timestamp": self.timestamp,
         }
 
@@ -281,5 +286,6 @@ class Heartbeat:
             embed_removed=list(d.get("embed_removed", [])),
             model_states=dict(d.get("model_states", {})),
             spans=list(d.get("spans", [])),
+            steps=list(d.get("steps", [])),
             timestamp=d.get("timestamp", time.time()),
         )

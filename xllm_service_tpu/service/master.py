@@ -70,6 +70,9 @@ class Master:
         # Worker span stages arrive on the RPC plane (heartbeats) but
         # are queried on the HTTP plane (/admin/trace/<id>): one store.
         self.rpc_service.spans = self.http_service.spans
+        # Step flight-recorder tails arrive the same way and feed the
+        # HTTP plane's /admin/timeline merge: one set of books.
+        self.rpc_service.step_books = self.http_service.step_books
         # Routing audits land on the request's span and in
         # xllm_schedule_decisions_total — the scheduler is built first,
         # so it learns the HTTP plane's span ring/registry here.

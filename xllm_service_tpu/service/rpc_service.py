@@ -31,6 +31,10 @@ class RpcService:
         # heartbeat-shipped worker span stages merge here so the
         # /admin/trace/<id> timeline crosses the plane boundary.
         self.spans = None
+        # StepBooks of the co-resident HttpService (wired by Master):
+        # heartbeat-shipped step flight-recorder tails land here — the
+        # /admin/timeline fallback when a live worker pull fails.
+        self.step_books = None
 
     def install(self, router: Router) -> None:
         router.route("GET", "/rpc/hello",
@@ -60,6 +64,8 @@ class RpcService:
                         rid, plane="worker",
                         events=rec.get("events", []), source=hb.name,
                         attrs=rec.get("attrs") or None)
+        if self.step_books is not None and hb.steps:
+            self.step_books.ingest(hb.name, hb.steps)
         # The ack carries the master epoch (fenced elections) — workers
         # reject an ack whose epoch regresses below one they've already
         # acked (a deposed master still answering) — and the degraded

@@ -56,6 +56,15 @@ increasing):
     80  obs.events                      — cluster event ring (never
                                           calls out; safe under every
                                           serving-path lock)
+    85  obs.steptrace                   — step flight-recorder ring
+                                          (obs/steptrace.py; guards the
+                                          deque+seq only, never calls
+                                          out; written on the engine
+                                          loop, read under worker.hb)
+    86  obs.stepbooks                   — master-side per-instance
+                                          step-record books fed by
+                                          heartbeats (dict of deques
+                                          only, never calls out)
     87  worker.embedcache               — content-addressed embedding
                                           cache + heartbeat digest-delta
                                           buffers (never calls out; the
